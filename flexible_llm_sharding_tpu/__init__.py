@@ -1,0 +1,18 @@
+"""flexible_llm_sharding_tpu — a TPU-native layer-streaming LLM framework.
+
+A brand-new framework with the capabilities of the reference
+``flexible-LLM-sharding`` (see SURVEY.md): run unquantized large LLMs on
+accelerators whose HBM is far smaller than the model by streaming per-layer
+weights host->HBM shard-by-shard, scoring batches of (prefix, suffixes)
+prompts with a shared prefix-KV trick, with data-parallel and interleaved
+pipeline model-parallel multi-chip modes.
+
+Built TPU-first on JAX/XLA: pure-function per-layer forwards jit-compiled
+once per shape family, weights as pytrees streamed with async ``device_put``
+double-buffered against compute, shardings expressed over a
+``jax.sharding.Mesh`` so collectives ride ICI.
+"""
+
+__version__ = "0.1.0"
+
+from flexible_llm_sharding_tpu.config import FrameworkConfig, LlamaConfig  # noqa: F401

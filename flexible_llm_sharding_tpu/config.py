@@ -1,0 +1,104 @@
+"""Typed configuration objects.
+
+The reference threads a raw argparse ``args`` namespace everywhere
+(``/root/reference/utils.py:33,80``) with 10 flags (``/root/reference/main.py:30-49``)
+and a module-level ``max_token_len = 4096`` constant (``/root/reference/utils.py:14``).
+Here the same flag surface becomes a small frozen dataclass, plus a model config
+read from a HuggingFace ``config.json``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any
+
+# The reference's hard sequence cap (/root/reference/utils.py:14). Kept as the
+# default, but configurable here instead of a module constant.
+DEFAULT_MAX_TOKEN_LEN = 4096
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    """Model hyperparameters, mirroring the fields of a HF Llama config.json."""
+
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: int = 32
+    rms_norm_eps: float = 1e-5
+    rope_theta: float = 10000.0
+    max_position_embeddings: int = 4096
+    tie_word_embeddings: bool = False
+    explicit_head_dim: int | None = None  # HF 'head_dim' when != hidden/heads
+
+    @property
+    def head_dim(self) -> int:
+        if self.explicit_head_dim is not None:
+            return self.explicit_head_dim
+        return self.hidden_size // self.num_attention_heads
+
+    @classmethod
+    def from_hf_config(cls, d: dict[str, Any]) -> "LlamaConfig":
+        # Features that change numerics must fail loudly, not silently drop
+        # (rope_scaling support — Llama-3.1 style — is planned, not implied).
+        if d.get("rope_scaling") not in (None, {}):
+            raise NotImplementedError(
+                f"rope_scaling={d['rope_scaling']!r} is not supported yet"
+            )
+        if d.get("attention_bias"):
+            raise NotImplementedError("attention_bias=true is not supported yet")
+        known = {f.name for f in dataclasses.fields(cls)}
+        kwargs = {k: v for k, v in d.items() if k in known}
+        if d.get("head_dim"):
+            kwargs["explicit_head_dim"] = d["head_dim"]
+        kwargs.setdefault("num_key_value_heads", d.get("num_attention_heads", 32))
+        return cls(**kwargs)
+
+    @classmethod
+    def from_pretrained(cls, model_path: str) -> "LlamaConfig":
+        with open(os.path.join(model_path, "config.json")) as f:
+            return cls.from_hf_config(json.load(f))
+
+
+@dataclasses.dataclass(frozen=True)
+class FrameworkConfig:
+    """Runtime flags — the same surface as the reference CLI
+    (``/root/reference/main.py:30-49``) plus TPU-specific knobs.
+
+    ``storage_location`` gains a ``tpu`` value (activations stay in HBM); the
+    reference's ``gpu`` is accepted as an alias. Unlike the reference's
+    ``--data_parallel`` bool footgun (any non-empty string parsed as True,
+    ``/root/reference/main.py:40``), this is a real bool everywhere.
+    """
+
+    model_path: str = "./"
+    num_batch: int = 1
+    layer_num_per_shard: int = 1
+    storage_location: str = "cpu"  # 'tpu' | 'cpu' | 'disk' ('gpu' alias of 'tpu')
+    max_activation_in_cpu: int = 100
+    data_parallel: bool = False
+    disk_folder: str = "./temp"
+    num_gen_token: int = 1
+    # --- TPU-specific knobs (not in the reference) ---
+    max_token_len: int = DEFAULT_MAX_TOKEN_LEN
+    dtype: str = "bfloat16"  # compute/storage dtype on device ('float16'|'bfloat16'|'float32')
+    block_size: int = 8  # prompts batched together per jitted layer call
+    prefetch_depth: int = 1  # shards prefetched ahead of compute (0 = synchronous)
+    num_devices: int = 0  # 0 = all visible devices
+    bucket_multiple: int = 64  # sequence lengths padded up to a multiple of this
+    use_pallas: bool = False  # use Pallas flash-attention kernel where profitable
+
+    def __post_init__(self) -> None:
+        loc = self.storage_location
+        if loc == "gpu":
+            object.__setattr__(self, "storage_location", "tpu")
+        elif loc not in ("tpu", "cpu", "disk"):
+            raise ValueError(f"storage_location must be tpu|cpu|disk, got {loc!r}")
+        if self.layer_num_per_shard < 1:
+            raise ValueError("layer_num_per_shard must be >= 1")
+        if self.num_batch < 1:
+            raise ValueError("num_batch must be >= 1")

@@ -1,0 +1,5 @@
+"""Model families. The reference supports Llama-family causal LMs via
+transformers (``/root/reference/utils.py:101-119``); here the model math is
+owned by the framework as pure jit-able JAX functions."""
+
+from flexible_llm_sharding_tpu.models import llama  # noqa: F401

@@ -1,0 +1,275 @@
+"""Pure-function Llama, matching HF `LlamaForCausalLM` numerics.
+
+The reference drives transformers' `LlamaDecoderLayer` on a meta-device
+skeleton and materialises weights per layer
+(``/root/reference/utils.py:109-131``). TPU-first redesign (SURVEY.md §7):
+layers are *pure functions* over parameter pytrees — nothing is ever
+"installed" into a module; weights are arguments, so streaming a layer is
+just passing a different pytree, and XLA compiles one program per shape
+family that is reused for all layers.
+
+Three forward entry points:
+
+- :func:`prefix_suffix_layer` — the streaming scorer step for one prompt:
+  prefix runs once producing its KV, all suffix continuations attend to the
+  shared prefix KV in one batched call. This is the reference's prefix-KV
+  expand trick (``/root/reference/utils.py:266-279``) as a single fused
+  jittable function.
+- :func:`decoder_layer` — a plain batched layer (monolithic forward /
+  training path).
+- :func:`forward_full` — whole-model forward for golden tests and training.
+
+Parameter pytree layout (all linear kernels stored [in, out], i.e. the
+transpose of HF's [out, in], so matmuls need no transposes on device):
+
+    params = {
+      'embed':  {'embedding': [V, D]},
+      'layers': [ per-layer dicts ... ]     # or stacked with leading axis
+      'norm':   {'scale': [D]},
+      'lm_head': {'kernel': [D, V]},        # absent if tied embeddings
+    }
+    layer = {
+      'input_layernorm': {'scale': [D]},
+      'post_attention_layernorm': {'scale': [D]},
+      'attn': {'wq': [D, nq*hd], 'wk': [D, nkv*hd],
+               'wv': [D, nkv*hd], 'wo': [nq*hd, D]},
+      'mlp':  {'gate': [D, F], 'up': [D, F], 'down': [F, D]},
+    }
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from flexible_llm_sharding_tpu.config import LlamaConfig
+from flexible_llm_sharding_tpu.ops import apply_rope, attention, rms_norm, rope_cos_sin
+from flexible_llm_sharding_tpu.ops.attention import causal_mask, prefix_shared_attention
+
+Params = dict[str, Any]
+
+
+# ---------------------------------------------------------------------------
+# Projections
+# ---------------------------------------------------------------------------
+
+# HIGHEST is a no-op for bf16/fp16 operands (the production dtype — MXU native)
+# but keeps float32 matmuls genuinely float32: XLA's default otherwise lowers
+# fp32 matmuls to reduced precision, which breaks HF-numerics parity.
+_PRECISION = jax.lax.Precision.HIGHEST
+
+
+def _mm(x: jax.Array, w: jax.Array) -> jax.Array:
+    return jnp.matmul(x, w.astype(x.dtype), precision=_PRECISION)
+
+
+def _qkv(attn: Params, cfg: LlamaConfig, x: jax.Array):
+    """x: [..., L, D] -> q [..., L, n_q, hd], k/v [..., L, n_kv, hd]."""
+    hd = cfg.head_dim
+    q = _mm(x, attn["wq"]).reshape(*x.shape[:-1], cfg.num_attention_heads, hd)
+    k = _mm(x, attn["wk"]).reshape(*x.shape[:-1], cfg.num_key_value_heads, hd)
+    v = _mm(x, attn["wv"]).reshape(*x.shape[:-1], cfg.num_key_value_heads, hd)
+    return q, k, v
+
+
+def _out_proj(attn: Params, o: jax.Array) -> jax.Array:
+    """o: [..., L, n_q, hd] -> [..., L, D]."""
+    return _mm(o.reshape(*o.shape[:-2], -1), attn["wo"])
+
+
+def _mlp(mlp: Params, x: jax.Array) -> jax.Array:
+    return _mm(jax.nn.silu(_mm(x, mlp["gate"])) * _mm(x, mlp["up"]), mlp["down"])
+
+
+# ---------------------------------------------------------------------------
+# Layers
+# ---------------------------------------------------------------------------
+
+def embed(params: Params, ids: jax.Array, dtype: jnp.dtype) -> jax.Array:
+    """Token ids [..., L] -> hidden states [..., L, D]."""
+    return params["embedding"].astype(dtype)[ids]
+
+
+def decoder_layer(
+    params: Params,
+    cfg: LlamaConfig,
+    x: jax.Array,
+    positions: jax.Array,
+    mask: jax.Array | None,
+) -> jax.Array:
+    """Plain decoder layer. x: [..., L, D]; positions int [..., L] or [L];
+    mask broadcastable to [..., L, L]."""
+    h = rms_norm(x, params["input_layernorm"]["scale"], cfg.rms_norm_eps)
+    q, k, v = _qkv(params["attn"], cfg, h)
+    cos, sin = rope_cos_sin(positions, cfg.head_dim, cfg.rope_theta)
+    q, k = apply_rope(q, cos, sin), apply_rope(k, cos, sin)
+    x = x + _out_proj(params["attn"], attention(q, k, v, mask))
+    h = rms_norm(x, params["post_attention_layernorm"]["scale"], cfg.rms_norm_eps)
+    return x + _mlp(params["mlp"], h)
+
+
+def prefix_suffix_layer(
+    params: Params,
+    cfg: LlamaConfig,
+    prefix_h: jax.Array,
+    suffix_h: jax.Array,
+    prefix_len: jax.Array,
+) -> tuple[jax.Array, jax.Array]:
+    """One decoder layer over a (prefix, suffixes) prompt — the streaming hot op.
+
+    prefix_h: [Lp, D] right-padded to the Lp bucket; only the first
+        ``prefix_len`` positions are real.
+    suffix_h: [S, Ls, D], right-padded suffix continuations.
+    prefix_len: int32 scalar (dynamic value; shapes stay static).
+
+    Semantics match the reference exactly (``/root/reference/utils.py:270-279``):
+    the prefix runs a causal self-attention once and its (post-RoPE) KV is
+    shared across all S suffixes; each suffix token attends to every real
+    prefix position plus causally within its own suffix, at rotary positions
+    ``prefix_len + i``.
+    """
+    lp, _ = prefix_h.shape
+    s, ls, _ = suffix_h.shape
+    eps = cfg.rms_norm_eps
+
+    # --- prefix: causal self-attention, keep post-RoPE KV ---
+    h = rms_norm(prefix_h, params["input_layernorm"]["scale"], eps)
+    q, k, v = _qkv(params["attn"], cfg, h)
+    cos, sin = rope_cos_sin(jnp.arange(lp), cfg.head_dim, cfg.rope_theta)
+    q, k = apply_rope(q, cos, sin), apply_rope(k, cos, sin)
+    attn_out = attention(q, k, v, causal_mask(lp, lp))
+    prefix_mid = prefix_h + _out_proj(params["attn"], attn_out)
+    h = rms_norm(prefix_mid, params["post_attention_layernorm"]["scale"], eps)
+    prefix_out = prefix_mid + _mlp(params["mlp"], h)
+
+    # --- suffixes: batched attention over [shared prefix KV ; own causal KV],
+    # prefix KV never expanded across suffixes (ops.prefix_shared_attention) ---
+    hs = rms_norm(suffix_h, params["input_layernorm"]["scale"], eps)
+    qs, ks, vs = _qkv(params["attn"], cfg, hs)
+    pos_s = prefix_len + jnp.arange(ls)
+    cos_s, sin_s = rope_cos_sin(pos_s, cfg.head_dim, cfg.rope_theta)
+    qs, ks = apply_rope(qs, cos_s, sin_s), apply_rope(ks, cos_s, sin_s)
+
+    attn_s = prefix_shared_attention(qs, k, v, ks, vs, prefix_len)
+    suffix_mid = suffix_h + _out_proj(params["attn"], attn_s)
+    hs = rms_norm(suffix_mid, params["post_attention_layernorm"]["scale"], eps)
+    suffix_out = suffix_mid + _mlp(params["mlp"], hs)
+    return prefix_out, suffix_out
+
+
+def select_eos_and_norm(
+    params: Params, cfg: LlamaConfig, suffix_h: jax.Array, suffix_eos: jax.Array
+) -> jax.Array:
+    """The reference's ``model.norm`` stage (``/root/reference/utils.py:281-286``):
+    keep only the last real token of each suffix, then RMSNorm.
+
+    suffix_h: [S, Ls, D]; suffix_eos: int [S] (index of last non-pad token).
+    Returns [S, 1, D].
+    """
+    last = jnp.take_along_axis(suffix_h, suffix_eos[:, None, None], axis=1)
+    return rms_norm(last, params["scale"], cfg.rms_norm_eps)
+
+
+def lm_head_scores(params: Params, suffix_h: jax.Array) -> jax.Array:
+    """The reference's ``lm_head`` stage (``/root/reference/utils.py:287-290``):
+    logits of the kept token, softmax -> next-token distribution.
+
+    suffix_h: [S, 1, D] -> float32 scores [S, V].
+    """
+    logits = _mm(suffix_h, params["kernel"])[:, 0]
+    return jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+
+
+# ---------------------------------------------------------------------------
+# Whole-model forward (golden tests, training, monolithic path)
+# ---------------------------------------------------------------------------
+
+def head_params(params: Params) -> Params:
+    """lm_head kernel, honouring tied embeddings (``/root/reference/utils.py:113``)."""
+    if "lm_head" in params and params["lm_head"]:
+        return params["lm_head"]
+    return {"kernel": params["embed"]["embedding"].T}
+
+
+def forward_full(
+    params: Params,
+    cfg: LlamaConfig,
+    ids: jax.Array,
+    dtype: jnp.dtype = jnp.float32,
+) -> jax.Array:
+    """Monolithic causal forward: ids [B, L] -> logits [B, L, V] (float32).
+
+    Used by tests as the reference invariant (sharded layerwise forward must
+    equal the monolithic forward) and by the training step.
+    """
+    b, l = ids.shape
+    x = embed(params["embed"], ids, dtype)
+    positions = jnp.arange(l)
+    mask = causal_mask(l, l)
+    layers = params["layers"]
+    if isinstance(layers, (list, tuple)):
+        for lp in layers:
+            x = decoder_layer(lp, cfg, x, positions, mask)
+    else:  # stacked pytree with leading layer axis -> scan (one compile)
+        def body(h, layer_params):
+            return decoder_layer(layer_params, cfg, h, positions, mask), None
+
+        x, _ = jax.lax.scan(body, x, layers)
+    x = rms_norm(x, params["norm"]["scale"], cfg.rms_norm_eps)
+    logits = _mm(x, head_params(params)["kernel"])
+    return logits.astype(jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# Initialisation (tests / training-from-scratch)
+# ---------------------------------------------------------------------------
+
+def init_layer_params(rng: jax.Array, cfg: LlamaConfig, dtype=jnp.float32) -> Params:
+    d, f, hd = cfg.hidden_size, cfg.intermediate_size, cfg.head_dim
+    nq, nkv = cfg.num_attention_heads, cfg.num_key_value_heads
+    ks = jax.random.split(rng, 7)
+
+    def lin(key, fan_in, fan_out):
+        scale = (2.0 / (fan_in + fan_out)) ** 0.5
+        return (jax.random.normal(key, (fan_in, fan_out)) * scale).astype(dtype)
+
+    return {
+        "input_layernorm": {"scale": jnp.ones((d,), dtype)},
+        "post_attention_layernorm": {"scale": jnp.ones((d,), dtype)},
+        "attn": {
+            "wq": lin(ks[0], d, nq * hd),
+            "wk": lin(ks[1], d, nkv * hd),
+            "wv": lin(ks[2], d, nkv * hd),
+            "wo": lin(ks[3], nq * hd, d),
+        },
+        "mlp": {
+            "gate": lin(ks[4], d, f),
+            "up": lin(ks[5], d, f),
+            "down": lin(ks[6], f, d),
+        },
+    }
+
+
+def init_params(rng: jax.Array, cfg: LlamaConfig, dtype=jnp.float32) -> Params:
+    keys = jax.random.split(rng, cfg.num_hidden_layers + 2)
+    params: Params = {
+        "embed": {
+            "embedding": (
+                jax.random.normal(keys[0], (cfg.vocab_size, cfg.hidden_size)) * 0.02
+            ).astype(dtype)
+        },
+        "layers": [
+            init_layer_params(keys[i + 1], cfg, dtype)
+            for i in range(cfg.num_hidden_layers)
+        ],
+        "norm": {"scale": jnp.ones((cfg.hidden_size,), dtype)},
+    }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = {
+            "kernel": (
+                jax.random.normal(keys[-1], (cfg.hidden_size, cfg.vocab_size)) * 0.02
+            ).astype(dtype)
+        }
+    return params

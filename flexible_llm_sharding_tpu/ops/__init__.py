@@ -1,0 +1,10 @@
+"""TPU-friendly primitive ops: RMSNorm, rotary embeddings, masked attention.
+
+These are the compute substrate the reference delegated to external
+``transformers``/CUDA kernels (SURVEY.md §1 L2, ``/root/reference/utils.py:8-12``).
+Here they are pure jit-able JAX functions designed to fuse well under XLA.
+"""
+
+from flexible_llm_sharding_tpu.ops.norm import rms_norm  # noqa: F401
+from flexible_llm_sharding_tpu.ops.rope import apply_rope, rope_cos_sin  # noqa: F401
+from flexible_llm_sharding_tpu.ops.attention import attention  # noqa: F401

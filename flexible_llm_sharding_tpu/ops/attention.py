@@ -1,0 +1,117 @@
+"""Masked multi-head attention with grouped-query (GQA) support.
+
+This is the FLOP core the reference delegated to transformers' CUDA kernels
+(``/root/reference/utils.py:272-279``). TPU-first design choices:
+
+- QK^T and PV matmuls stay in the model dtype (bf16/fp16) so they tile onto
+  the MXU; only the softmax is done in float32 (matching HF's eager path).
+- The mask is a boolean computed from ``iota`` inside the jitted function —
+  the reference materialises a dense 4096x4096 fp16 mask (32 MB resident,
+  ``/root/reference/utils.py:219-220``); here the mask is fused by XLA and
+  never lives in HBM.
+- No data-dependent shapes: prefix lengths are dynamic *values* folded into
+  the mask, shapes are static per bucket.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = -0.7 * float(jnp.finfo(jnp.float32).max)
+_PRECISION = jax.lax.Precision.HIGHEST  # no-op for bf16/fp16 MXU operands
+
+
+def _grouped_q(q: jax.Array, n_kv: int) -> jax.Array:
+    """[..., Lq, n_q, hd] -> [..., Lq, n_kv, g, hd] without copying."""
+    *lead, lq, n_q, hd = q.shape
+    return q.reshape(*lead, lq, n_kv, n_q // n_kv, hd)
+
+
+def attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mask: jax.Array | None,
+    scale: float | None = None,
+) -> jax.Array:
+    """Scaled dot-product attention with GQA via grouped einsums.
+
+    q: [..., Lq, n_q, hd]; k, v: [..., Lk, n_kv, hd] with n_q % n_kv == 0.
+    mask: broadcastable to [..., Lq, Lk]; True = attend, False = masked.
+    Returns [..., Lq, n_q, hd].
+
+    KV heads are never replicated in memory (no jnp.repeat): queries are
+    reshaped to [n_kv, group] and contracted against the n_kv heads directly —
+    the GQA equivalent of torch's .expand view in the reference's KV trick.
+    """
+    n_q, n_kv = q.shape[-2], k.shape[-2]
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+
+    qr = _grouped_q(q, n_kv)
+    # [..., n_kv, g, Lq, Lk] in model dtype (MXU), softmax in fp32.
+    scores = jnp.einsum("...qngh,...knh->...ngqk", qr, k, precision=_PRECISION)
+    scores = scores.astype(jnp.float32) * scale
+    if mask is not None:
+        scores = jnp.where(mask[..., None, None, :, :], scores, _NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    out = jnp.einsum("...ngqk,...knh->...qngh", probs, v, precision=_PRECISION)
+    return out.reshape(q.shape)
+
+
+def prefix_shared_attention(
+    q: jax.Array,
+    k_prefix: jax.Array,
+    v_prefix: jax.Array,
+    k_suffix: jax.Array,
+    v_suffix: jax.Array,
+    prefix_len: jax.Array,
+    scale: float | None = None,
+) -> jax.Array:
+    """Attention of S suffix continuations over [shared prefix KV ; own causal KV].
+
+    The reference expands the prefix KV across suffixes with torch ``.expand``
+    (a view, ``/root/reference/utils.py:277``); the naive JAX translation
+    (broadcast_to + concatenate) would materialise S copies in HBM. Here the
+    prefix KV stays [Lp, n_kv, hd] — shared by every suffix and every query
+    group — and the two score blocks are computed by separate einsums with a
+    joint softmax across their concatenation.
+
+    q: [S, Ls, n_q, hd] (RoPE already applied at positions prefix_len+i);
+    k_prefix/v_prefix: [Lp, n_kv, hd]; k_suffix/v_suffix: [S, Ls, n_kv, hd];
+    prefix_len: int32 scalar — prefix keys at j >= prefix_len are padding.
+    Returns [S, Ls, n_q, hd].
+    """
+    s, ls, n_q, hd = q.shape
+    lp, n_kv, _ = k_prefix.shape
+    if scale is None:
+        scale = 1.0 / (hd**0.5)
+
+    qr = _grouped_q(q, n_kv)  # [S, Ls, n_kv, g, hd]
+    scores_p = jnp.einsum("sqngh,knh->sngqk", qr, k_prefix, precision=_PRECISION)
+    scores_s = jnp.einsum("sqngh,sknh->sngqk", qr, k_suffix, precision=_PRECISION)
+    scores = (
+        jnp.concatenate([scores_p, scores_s], axis=-1).astype(jnp.float32) * scale
+    )  # [S, n_kv, g, Ls, Lp+Ls]
+
+    # Prefix keys visible iff real; suffix keys causal.
+    kj = jnp.arange(lp + ls)[None, :]
+    qi = jnp.arange(ls)[:, None]
+    mask = jnp.where(kj < lp, kj < prefix_len, (kj - lp) <= qi)  # [Ls, Lp+Ls]
+    scores = jnp.where(mask[None, None, None], scores, _NEG_INF)
+
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    probs_p, probs_s = probs[..., :lp], probs[..., lp:]
+    out = jnp.einsum("sngqk,knh->sqngh", probs_p, v_prefix, precision=_PRECISION)
+    out = out + jnp.einsum(
+        "sngqk,sknh->sqngh", probs_s, v_suffix, precision=_PRECISION
+    )
+    return out.reshape(s, ls, n_q, hd)
+
+
+def causal_mask(lq: int, lk: int, offset: int = 0) -> jax.Array:
+    """Boolean causal mask [lq, lk]: query i attends key j iff j <= i + offset."""
+    qi = jax.lax.broadcasted_iota(jnp.int32, (lq, lk), 0)
+    kj = jax.lax.broadcasted_iota(jnp.int32, (lq, lk), 1)
+    return kj <= qi + offset

@@ -1,0 +1,50 @@
+"""Rotary position embeddings (RoPE), matching HF Llama semantics.
+
+Angle table computed in float32, multiplied in the activation dtype — the same
+contract as transformers' LlamaRotaryEmbedding, which is what the reference's
+decoder layers used. Positions are dynamic *values* (prefix lengths vary per
+prompt) but all shapes are static, so this traces once per shape family.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@functools.lru_cache(maxsize=None)
+def _inv_freq(head_dim: int, theta: float) -> np.ndarray:
+    # Computed in float64 on host (static constant) so the float32 table
+    # matches torch's to the last ulp instead of drifting via pow().
+    return (
+        1.0 / (theta ** (np.arange(0, head_dim, 2, dtype=np.float64) / head_dim))
+    ).astype(np.float32)
+
+
+def rope_cos_sin(
+    positions: jax.Array, head_dim: int, theta: float
+) -> tuple[jax.Array, jax.Array]:
+    """cos/sin tables for given integer positions.
+
+    positions: int array [..., L] -> (cos, sin) float32 [..., L, head_dim//2].
+    """
+    freqs = jnp.asarray(_inv_freq(head_dim, theta))
+    angles = positions.astype(jnp.float32)[..., None] * freqs
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
+    """Rotate q/k. x: [..., L, n_heads, head_dim]; cos/sin: [..., L, head_dim//2].
+
+    Uses the half-split formulation, equivalent to HF's rotate_half with
+    duplicated angle tables: (x1, x2) -> (x1*cos - x2*sin, x2*cos + x1*sin).
+    """
+    half = x.shape[-1] // 2
+    x1, x2 = x[..., :half], x[..., half:]
+    # Broadcast over the heads axis: [..., L, 1, half].
+    c = cos[..., :, None, :].astype(x.dtype)
+    s = sin[..., :, None, :].astype(x.dtype)
+    return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)

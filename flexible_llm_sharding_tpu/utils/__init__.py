@@ -1,0 +1,1 @@
+"""Host-side utilities: checkpoint preparation/loading, tokenisation, prompt I/O."""

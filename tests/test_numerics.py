@@ -1,0 +1,128 @@
+"""Golden numerics: our pure-JAX Llama must match HF `LlamaForCausalLM`
+(the model substrate the reference executes through transformers,
+SURVEY.md §1 L2), and the fused prefix+suffix streaming step must equal the
+monolithic forward — the reference's core implicit invariant (SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import torch
+
+from flexible_llm_sharding_tpu.config import LlamaConfig
+from flexible_llm_sharding_tpu.models import llama
+from flexible_llm_sharding_tpu.utils import checkpoint as ckpt
+
+
+def _hf_model(tiny_cfg: LlamaConfig, seed: int = 0):
+    from transformers import LlamaConfig as HFConfig
+    from transformers import LlamaForCausalLM
+
+    torch.manual_seed(seed)
+    hf_cfg = HFConfig(
+        vocab_size=tiny_cfg.vocab_size,
+        hidden_size=tiny_cfg.hidden_size,
+        intermediate_size=tiny_cfg.intermediate_size,
+        num_hidden_layers=tiny_cfg.num_hidden_layers,
+        num_attention_heads=tiny_cfg.num_attention_heads,
+        num_key_value_heads=tiny_cfg.num_key_value_heads,
+        rms_norm_eps=tiny_cfg.rms_norm_eps,
+        rope_theta=tiny_cfg.rope_theta,
+        max_position_embeddings=tiny_cfg.max_position_embeddings,
+        tie_word_embeddings=tiny_cfg.tie_word_embeddings,
+        attn_implementation="eager",
+    )
+    model = LlamaForCausalLM(hf_cfg).eval()
+    return model
+
+
+def _params_from_hf(model, tiny_cfg: LlamaConfig):
+    sd = {k: v.detach().numpy() for k, v in model.state_dict().items()}
+    layers_sd: dict[str, dict] = {}
+    for k, v in sd.items():
+        layers_sd.setdefault(ckpt.key_to_layer(k), {})[k] = v
+    params = {
+        "embed": ckpt.native_to_pytree(
+            "model.embed_tokens", ckpt.hf_layer_to_native("model.embed_tokens", layers_sd["model.embed_tokens"])
+        ),
+        "layers": [
+            ckpt.native_to_pytree(
+                f"model.layers.{i}",
+                ckpt.hf_layer_to_native(f"model.layers.{i}", layers_sd[f"model.layers.{i}"]),
+            )
+            for i in range(tiny_cfg.num_hidden_layers)
+        ],
+        "norm": ckpt.native_to_pytree("model.norm", ckpt.hf_layer_to_native("model.norm", layers_sd["model.norm"])),
+    }
+    if "lm_head" in layers_sd:
+        params["lm_head"] = ckpt.native_to_pytree(
+            "lm_head", ckpt.hf_layer_to_native("lm_head", layers_sd["lm_head"])
+        )
+    return jax.tree.map(jnp.asarray, params)
+
+
+@pytest.fixture(scope="module")
+def hf_and_params(tiny_cfg):
+    model = _hf_model(tiny_cfg)
+    return model, _params_from_hf(model, tiny_cfg)
+
+
+def test_forward_matches_hf(tiny_cfg, hf_and_params, rng):
+    model, params = hf_and_params
+    ids = rng.integers(0, tiny_cfg.vocab_size, size=(2, 17))
+    with torch.no_grad():
+        hf_logits = model(torch.tensor(ids)).logits.numpy()
+    ours = np.asarray(llama.forward_full(params, tiny_cfg, jnp.asarray(ids)))
+    np.testing.assert_allclose(ours, hf_logits, rtol=2e-4, atol=2e-4)
+
+
+def test_forward_scan_matches_list(tiny_cfg, hf_and_params, rng):
+    _, params = hf_and_params
+    ids = jnp.asarray(rng.integers(0, tiny_cfg.vocab_size, size=(1, 9)))
+    stacked = dict(params)
+    stacked["layers"] = jax.tree.map(lambda *xs: jnp.stack(xs), *params["layers"])
+    a = llama.forward_full(params, tiny_cfg, ids)
+    b = llama.forward_full(stacked, tiny_cfg, ids)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-5)
+
+
+def test_prefix_suffix_streaming_matches_monolithic(tiny_cfg, hf_and_params, rng):
+    """The reference invariant: layerwise prefix-KV streaming == monolithic
+    forward on the concatenated (prefix + suffix) sequence, at the position of
+    each suffix's last real token (``/root/reference/utils.py:266-290``)."""
+    _, params = hf_and_params
+    cfg = tiny_cfg
+    prefix_len_real = 11
+    suffix_lens = [3, 5, 4]
+    s, ls = len(suffix_lens), max(suffix_lens)
+    lp = 16  # bucketed (padded) prefix length
+
+    prefix_ids = rng.integers(1, cfg.vocab_size, size=(prefix_len_real,))
+    suffix_ids_list = [rng.integers(1, cfg.vocab_size, size=(n,)) for n in suffix_lens]
+
+    # --- streaming path ---
+    pad = 0
+    prefix_padded = np.full((lp,), pad, np.int32)
+    prefix_padded[:prefix_len_real] = prefix_ids
+    suffix_padded = np.full((s, ls), pad, np.int32)
+    for i, sid in enumerate(suffix_ids_list):
+        suffix_padded[i, : len(sid)] = sid
+    suffix_eos = jnp.asarray([n - 1 for n in suffix_lens])
+
+    ph = llama.embed(params["embed"], jnp.asarray(prefix_padded), jnp.float32)
+    sh = llama.embed(params["embed"], jnp.asarray(suffix_padded), jnp.float32)
+    plen = jnp.asarray(prefix_len_real, jnp.int32)
+    for layer in params["layers"]:
+        ph, sh = llama.prefix_suffix_layer(layer, cfg, ph, sh, plen)
+    normed = llama.select_eos_and_norm(params["norm"], cfg, sh, suffix_eos)
+    scores = llama.lm_head_scores(llama.head_params(params), normed)
+
+    # --- monolithic path: full forward per suffix on concat(prefix, suffix) ---
+    for i, sid in enumerate(suffix_ids_list):
+        full = np.concatenate([prefix_ids, sid])[None, :]
+        logits = llama.forward_full(params, cfg, jnp.asarray(full))
+        want = jax.nn.softmax(logits[0, -1].astype(jnp.float32))
+        np.testing.assert_allclose(
+            np.asarray(scores[i]), np.asarray(want), rtol=2e-4, atol=2e-5
+        )
