@@ -1,0 +1,8 @@
+from flexible_llm_sharding_tpu.parallel.planner import (
+    ShardPlan,
+    plan_shards_dp,
+    plan_shards_mp,
+    split_prompts_dp,
+)
+
+__all__ = ["ShardPlan", "plan_shards_dp", "plan_shards_mp", "split_prompts_dp"]

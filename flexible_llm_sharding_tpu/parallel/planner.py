@@ -1,0 +1,136 @@
+"""Shard planning: how the layer list is cut into shards and assigned to devices.
+
+Reproduces the reference's planning math exactly
+(``/root/reference/utils.py:144-153``):
+
+- The "layer list" is the FULL execution list — ``model.embed_tokens``,
+  ``model.layers.{i}``, ``model.norm``, ``lm_head`` — not just decoder layers.
+- **DP** (each device streams the whole model over its own prompt slice):
+  ``num_shards = ceil(n_layers / layer_num_per_shard)`` contiguous pieces via
+  ``np.array_split`` (first ``n % num_shards`` pieces get one extra layer).
+- **MP** (interleaved pipeline): shard count is rounded UP to a multiple of the
+  device count, then device ``k`` takes shards ``all_shards[k::num_devices]``
+  (round-robin / interleaved stages, cf. the reference's
+  ``multigpu_flexibility.png``).
+
+Prompt splitting for DP mode matches ``np.array_split(prompts, num_devices)``
+(``/root/reference/main.py:70``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardPlan:
+    """One device's work: a list of shards, each a tuple of global layer indices."""
+
+    shards: tuple[tuple[int, ...], ...]
+    n_layers: int  # total layers in the model's execution list
+    device_rank: int = 0
+    num_devices: int = 1
+
+    @property
+    def num_local_layers(self) -> int:
+        return sum(len(s) for s in self.shards)
+
+    def owns_layer(self, layer_idx: int) -> bool:
+        return any(layer_idx in s for s in self.shards)
+
+
+def _array_split_sizes(n: int, parts: int) -> list[int]:
+    """Sizes produced by ``np.array_split(np.arange(n), parts)``."""
+    base, extra = divmod(n, parts)
+    return [base + 1] * extra + [base] * (parts - extra)
+
+
+def _contiguous_shards(n_layers: int, num_shards: int) -> list[tuple[int, ...]]:
+    out, start = [], 0
+    for size in _array_split_sizes(n_layers, num_shards):
+        out.append(tuple(range(start, start + size)))
+        start += size
+    return out
+
+
+def plan_shards_dp(
+    n_layers: int,
+    layer_num_per_shard: int,
+    device_rank: int = 0,
+    num_devices: int = 1,
+) -> ShardPlan:
+    """DP / single-device plan: contiguous shards, all streamed by this device
+    (``/root/reference/utils.py:145-146``). ``device_rank``/``num_devices``
+    identify the device within a DP group (used e.g. to tag per-rank disk
+    activation files, ``/root/reference/utils.py:172``)."""
+    num_shards = math.ceil(n_layers / layer_num_per_shard)
+    return ShardPlan(
+        shards=tuple(_contiguous_shards(n_layers, num_shards)),
+        n_layers=n_layers,
+        device_rank=device_rank,
+        num_devices=num_devices,
+    )
+
+
+def plan_shards_mp(
+    n_layers: int, layer_num_per_shard: int, device_rank: int, num_devices: int
+) -> ShardPlan:
+    """MP plan for one device: round-robin interleaved stages
+    (``/root/reference/utils.py:150-153``).
+
+    Shard count rounds up to a multiple of ``num_devices`` so every device gets
+    the same number of stages (some possibly empty when n_layers is small).
+    """
+    num_shards = (
+        math.ceil(math.ceil(n_layers / layer_num_per_shard) / num_devices)
+        * num_devices
+    )
+    all_shards = _contiguous_shards(n_layers, num_shards)
+    return ShardPlan(
+        shards=tuple(all_shards[device_rank::num_devices]),
+        n_layers=n_layers,
+        device_rank=device_rank,
+        num_devices=num_devices,
+    )
+
+
+def global_stage_order(n_layers: int, layer_num_per_shard: int, num_devices: int):
+    """All MP stages in execution order as (stage_idx, device_rank, layer_tuple)."""
+    num_shards = (
+        math.ceil(math.ceil(n_layers / layer_num_per_shard) / num_devices)
+        * num_devices
+    )
+    shards = _contiguous_shards(n_layers, num_shards)
+    return [(i, i % num_devices, s) for i, s in enumerate(shards)]
+
+
+def split_prompts_dp(num_prompts: int, num_devices: int) -> list[tuple[int, int]]:
+    """[start, end) prompt ranges per device — ``np.array_split`` semantics
+    (``/root/reference/main.py:70``)."""
+    sizes = _array_split_sizes(num_prompts, num_devices)
+    ranges, start = [], 0
+    for size in sizes:
+        ranges.append((start, start + size))
+        start += size
+    return ranges
+
+
+def batch_ranges(num_prompts: int, num_batch: int) -> list[tuple[int, int]]:
+    """The reference's batching rule (``/root/reference/main.py:19-20``):
+    ``num_batch`` pieces of size ``num_prompts // num_batch`` with the remainder
+    folded into the last piece."""
+    ends = [num_prompts // num_batch * i for i in range(1, num_batch)] + [num_prompts]
+    return list(zip([0] + ends[:-1], ends))
+
+
+__all__ = [
+    "ShardPlan",
+    "plan_shards_dp",
+    "plan_shards_mp",
+    "global_stage_order",
+    "split_prompts_dp",
+    "batch_ranges",
+]

@@ -1,0 +1,106 @@
+"""Intermediate-activation storage between shards.
+
+The reference stashes each prompt's (prefix, suffix) hidden states between
+shard passes in one of three places selected by ``--storage_location``
+(``/root/reference/utils.py:159-213``): device memory (``gpu``), host RAM
+(``cpu``), or disk ``.npy`` files. This module keeps those three backends —
+``tpu`` (HBM), ``cpu`` (host numpy), ``disk`` — with the reference's disk file
+naming contract preserved (``suffix{rank}-{idx:05d}.npy`` /
+``prefix{rank}-{idx:05d}.npy``, ``/root/reference/utils.py:170-177``) so a
+disk-mode run is resumable from the same artifacts.
+
+TPU-first differences:
+
+- Units are *blocks* (a batch of same-bucket prompts = one jitted call), not
+  single prompts; disk files are still written per prompt for contract parity.
+- No spin-wait backpressure (``sleep(1)`` polls at
+  ``/root/reference/utils.py:179-180,189-190``): ordering comes from the
+  executor's deterministic schedule, and ``cpu`` backpressure is a bounded
+  deque of host arrays.
+- ``tpu`` keeps activations as device arrays; ``cpu`` uses
+  ``jax.device_get`` (async transfer flushed at store time); ``disk`` writes
+  float32-preserving raw dtypes via numpy.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import numpy as np
+
+
+class ActivationStore:
+    """Store/fetch (prefix_h, suffix_h) activation pairs keyed by block id.
+
+    prefix_h: [B, Lp, D] or None (after the norm stage);
+    suffix_h: [B, S, Ls, D].
+    """
+
+    def __init__(
+        self,
+        location: str = "cpu",
+        disk_folder: str = "./temp",
+        device_rank: int = 0,
+        rank_tag: bool = False,
+    ):
+        if location not in ("tpu", "cpu", "disk"):
+            raise ValueError(f"storage_location must be tpu|cpu|disk, got {location!r}")
+        self.location = location
+        self.disk_folder = disk_folder
+        # The reference tags disk files with the gpu rank only in DP mode
+        # (/root/reference/utils.py:172): rank_tag mirrors that.
+        self.tag = str(device_rank) if rank_tag else ""
+        self._mem: dict[object, tuple] = {}
+        if location == "disk":
+            os.makedirs(disk_folder, exist_ok=True)
+
+    # -- paths (reference naming contract) ---------------------------------
+    def _paths(self, prompt_idx: int) -> tuple[str, str]:
+        return (
+            os.path.join(self.disk_folder, f"prefix{self.tag}-{prompt_idx:05d}.npy"),
+            os.path.join(self.disk_folder, f"suffix{self.tag}-{prompt_idx:05d}.npy"),
+        )
+
+    # -- block API ---------------------------------------------------------
+    def store(self, block_id, prompt_idxs: list[int], prefix_h, suffix_h) -> None:
+        if self.location == "tpu":
+            self._mem[block_id] = (prefix_h, suffix_h)
+        elif self.location == "cpu":
+            pair = (
+                None if prefix_h is None else jax.device_get(prefix_h),
+                jax.device_get(suffix_h),
+            )
+            self._mem[block_id] = pair
+        else:  # disk — one file pair per prompt, reference contract
+            prefix_np = None if prefix_h is None else np.asarray(jax.device_get(prefix_h))
+            suffix_np = np.asarray(jax.device_get(suffix_h))
+            for row, idx in enumerate(prompt_idxs):
+                ppath, spath = self._paths(idx)
+                np.save(spath, suffix_np[row])
+                if prefix_np is not None:
+                    np.save(ppath, prefix_np[row])
+
+    def fetch(self, block_id, prompt_idxs: list[int], with_prefix: bool = True):
+        """Returns (prefix_h | None, suffix_h) as host or device arrays; the
+        executor device_puts them as part of the next shard's input feed."""
+        if self.location in ("tpu", "cpu"):
+            prefix, suffix = self._mem.pop(block_id)
+            if not with_prefix:
+                prefix = None
+            return prefix, suffix
+        prefixes, suffixes = [], []
+        for idx in prompt_idxs:
+            ppath, spath = self._paths(idx)
+            suffixes.append(np.load(spath))
+            if with_prefix:
+                prefixes.append(np.load(ppath))
+        suffix = np.stack(suffixes)
+        prefix = np.stack(prefixes) if with_prefix else None
+        return prefix, suffix
+
+    def clear(self) -> None:
+        self._mem.clear()
+
+
+__all__ = ["ActivationStore"]

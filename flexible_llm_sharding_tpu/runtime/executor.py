@@ -1,0 +1,406 @@
+"""The streaming sharded executor — the framework's core.
+
+Reference equivalent: ``ShardedLlama.__call__`` (``/root/reference/utils.py:133-305``),
+which streams a Llama through one device layer-by-layer: load a shard of
+layers, run *all* prompts through it, stash activations, evict, next shard.
+
+TPU-first redesign (SURVEY.md §7):
+
+- Layers are pure functions over parameter pytrees; "loading a shard" is one
+  host->HBM ``jax.device_put`` of a stacked pytree, "evicting" is dropping the
+  reference (XLA's allocator reuses the buffer — no ``malloc_trim``/reboot
+  dance, cf. ``/root/reference/utils.py:18-21,134-137``).
+- A shard of k decoder layers runs as ONE jitted program: ``lax.scan`` over
+  the stacked [k, ...] parameter pytree, vmapped over a block of same-bucket
+  prompts. One compile per (bucket-shape, k) family serves all layers and all
+  shards — the reference pays a per-layer Python/dispatch cost instead.
+- Shapes are static (bucketed); true prefix lengths / eos indices are dynamic
+  values folded into masks and gathers, so there is no per-prompt retracing.
+- Weight upload can be overlapped with compute via a prefetch thread
+  (``prefetch_depth >= 1``), replacing the reference's fully serialized
+  load-then-compute loop (``/root/reference/utils.py:228-233`` — its #1
+  inefficiency).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from functools import partial
+from queue import Queue
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from flexible_llm_sharding_tpu.config import FrameworkConfig, LlamaConfig
+from flexible_llm_sharding_tpu.models import llama
+from flexible_llm_sharding_tpu.parallel.planner import ShardPlan, plan_shards_dp
+from flexible_llm_sharding_tpu.runtime.activations import ActivationStore
+from flexible_llm_sharding_tpu.runtime.tokenization import (
+    PromptTokenizer,
+    TokenizedPrompt,
+    make_blocks,
+)
+from flexible_llm_sharding_tpu.utils import checkpoint
+
+Params = dict[str, Any]
+
+_DTYPES = {
+    "float32": jnp.float32,
+    "float16": jnp.float16,
+    "bfloat16": jnp.bfloat16,
+}
+
+
+# ---------------------------------------------------------------------------
+# Jitted stage programs (module-level so the jit cache is shared across
+# executors; cfg is a frozen dataclass -> hashable -> static arg)
+# ---------------------------------------------------------------------------
+
+@partial(jax.jit, static_argnums=(0, 1))
+def _embed_block(cfg: LlamaConfig, dtype, embed_params, prefix_ids, suffix_ids):
+    """ids [B, Lp], [B, S, Ls] -> hidden [B, Lp, D], [B, S, Ls, D]."""
+    return (
+        llama.embed(embed_params, prefix_ids, dtype),
+        llama.embed(embed_params, suffix_ids, dtype),
+    )
+
+
+@partial(jax.jit, static_argnums=(0,), donate_argnums=(2, 3))
+def _decoder_block(cfg: LlamaConfig, stacked, prefix_h, suffix_h, prefix_len):
+    """Scan k stacked decoder layers over a block of prompts.
+
+    stacked: layer pytree with leading [k] axis; prefix_h [B, Lp, D];
+    suffix_h [B, S, Ls, D]; prefix_len int32 [B]. Activations are donated —
+    each scan step's output reuses the input buffers.
+    """
+    step = jax.vmap(llama.prefix_suffix_layer, in_axes=(None, None, 0, 0, 0))
+
+    def body(carry, layer_params):
+        p, s = carry
+        p, s = step(layer_params, cfg, p, s, prefix_len)
+        return (p, s), None
+
+    (prefix_h, suffix_h), _ = jax.lax.scan(body, (prefix_h, suffix_h), stacked)
+    return prefix_h, suffix_h
+
+
+@partial(jax.jit, static_argnums=(0,))
+def _norm_block(cfg: LlamaConfig, norm_params, suffix_h, suffix_eos):
+    """[B, S, Ls, D], eos [B, S] -> last-token normed [B, S, 1, D]
+    (``/root/reference/utils.py:281-286``)."""
+    return jax.vmap(llama.select_eos_and_norm, in_axes=(None, None, 0, 0))(
+        norm_params, cfg, suffix_h, suffix_eos
+    )
+
+
+@jax.jit
+def _head_block(head_params, suffix_h):
+    """[B, S, 1, D] -> float32 scores [B, S, V] (``/root/reference/utils.py:287-290``)."""
+    return jax.vmap(llama.lm_head_scores, in_axes=(None, 0))(head_params, suffix_h)
+
+
+# ---------------------------------------------------------------------------
+# Shard weight source (sync or prefetching)
+# ---------------------------------------------------------------------------
+
+def _is_floating(a: np.ndarray) -> bool:
+    return np.issubdtype(a.dtype, np.floating) or a.dtype.name == "bfloat16"
+
+
+class ShardWeightSource:
+    """Loads shard weights disk -> host -> HBM, optionally prefetching ahead.
+
+    One shard's payload is a dict: ``{"segments": [(kind, params), ...]}``
+    where decoder runs are pre-stacked [k, ...] pytrees ready for scan. With
+    ``prefetch_depth >= 1`` a daemon thread stays ``depth`` shards ahead of
+    compute, so the host->HBM transfer of shard t+1 overlaps the device
+    compute of shard t (the reference serializes these,
+    ``/root/reference/utils.py:228-233``).
+    """
+
+    def __init__(
+        self,
+        model_path: str,
+        layer_names: Sequence[str],
+        shards: Sequence[tuple[int, ...]],
+        np_dtype,
+        device=None,
+        prefetch_depth: int = 1,
+        tied_embeddings: bool = False,
+    ):
+        self.model_path = model_path
+        self.layer_names = list(layer_names)
+        self.shards = list(shards)
+        self.np_dtype = np_dtype
+        self.device = device
+        self.tied = tied_embeddings
+        self.load_time = 0.0  # host-side file->numpy time (cf. load_weights_time)
+        self._q: Queue = Queue(maxsize=max(1, prefetch_depth))
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        if prefetch_depth >= 1:
+            self._thread = threading.Thread(target=self._producer, daemon=True)
+            self._thread.start()
+
+    def close(self) -> None:
+        """Unblock and retire the prefetch thread; drop any queued shards so
+        their HBM buffers are released even if iteration was abandoned."""
+        self._stop.set()
+        if self._thread is not None:
+            while self._thread.is_alive():
+                try:
+                    self._q.get_nowait()
+                except Exception:
+                    self._thread.join(timeout=0.1)
+            self._thread = None
+        while not self._q.empty():
+            try:
+                self._q.get_nowait()
+            except Exception:
+                break
+
+    # -- host side ---------------------------------------------------------
+    def _load_one(self, name: str) -> Params:
+        if name == "lm_head" and self.tied:
+            emb = checkpoint.load_layer(self.model_path, "model.embed_tokens")
+            return {"kernel": np.ascontiguousarray(emb["embedding"].T)}
+        return checkpoint.load_layer(self.model_path, name)
+
+    def _cast(self, tree: Params) -> Params:
+        return jax.tree.map(
+            lambda a: a.astype(self.np_dtype)
+            if _is_floating(a) and a.dtype != self.np_dtype
+            else a,
+            tree,
+        )
+
+    def _build_shard(self, layer_idxs: tuple[int, ...]) -> list[tuple[str, Any]]:
+        """Group a shard's layers into segments: contiguous decoder runs are
+        stacked for scan; embed/norm/head are singleton segments."""
+        segments: list[tuple[str, Any]] = []
+        run: list[Params] = []
+
+        def flush():
+            if run:
+                stacked = jax.tree.map(lambda *xs: np.stack(xs), *run)
+                segments.append(("decoders", stacked))
+                run.clear()
+
+        t0 = time.perf_counter()
+        for idx in layer_idxs:
+            name = self.layer_names[idx]
+            params = self._cast(self._load_one(name))
+            if name.startswith("model.layers."):
+                run.append(params)
+            else:
+                flush()
+                kind = {
+                    "model.embed_tokens": "embed",
+                    "model.norm": "norm",
+                    "lm_head": "head",
+                }[name]
+                segments.append((kind, params))
+        flush()
+        self.load_time += time.perf_counter() - t0
+        return [
+            (kind, jax.device_put(p, self.device) if self.device else jax.device_put(p))
+            for kind, p in segments
+        ]
+
+    # -- prefetch thread ---------------------------------------------------
+    def _put(self, item) -> bool:
+        from queue import Full
+
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=0.2)
+                return True
+            except Full:
+                continue
+        return False
+
+    def _producer(self):
+        for idxs in self.shards:
+            if self._stop.is_set():
+                return
+            try:
+                item = self._build_shard(idxs)
+            except Exception as e:  # surfaced on the consumer side
+                self._put(e)
+                return
+            if not self._put(item):
+                return
+
+    def __iter__(self):
+        if self._thread is None:
+            for idxs in self.shards:
+                yield idxs, self._build_shard(idxs)
+        else:
+            for idxs in self.shards:
+                item = self._q.get()
+                if isinstance(item, Exception):
+                    raise item
+                yield idxs, item
+
+
+# ---------------------------------------------------------------------------
+# The executor
+# ---------------------------------------------------------------------------
+
+class StreamingExecutor:
+    """Single-device layer-streaming scorer — ``ShardedLlama`` equivalent.
+
+    ``__call__(prompts)`` takes ``[(prefix_str, (suffix_str, ...)), ...]`` and
+    returns one float32 ``[n_suffixes, 1, vocab]`` next-token distribution per
+    prompt, exactly the reference's output contract
+    (``/root/reference/utils.py:288-290``).
+    """
+
+    def __init__(
+        self,
+        cfg: FrameworkConfig,
+        device=None,
+        plan: ShardPlan | None = None,
+        tokenizer=None,
+    ):
+        self.cfg = cfg
+        self.model_cfg = LlamaConfig.from_pretrained(cfg.model_path)
+        self.device = device
+        self.dtype = _DTYPES[cfg.dtype]
+        if tokenizer is None:
+            from transformers import AutoTokenizer
+
+            tokenizer = AutoTokenizer.from_pretrained(cfg.model_path)
+        self.tokenizer = PromptTokenizer(
+            tokenizer,
+            max_token_len=cfg.max_token_len,
+            bucket_multiple=cfg.bucket_multiple,
+        )
+        # Full execution list, reference order (/root/reference/utils.py:106-107):
+        # lm_head is always present; when embeddings are tied its kernel is
+        # re-materialised from the embedding file.
+        self.layer_names = checkpoint.layer_names_for(
+            self.model_cfg.num_hidden_layers, tie_word_embeddings=False
+        )
+        self.plan = plan or plan_shards_dp(
+            len(self.layer_names), cfg.layer_num_per_shard
+        )
+        self.stats: dict[str, float] = {}
+
+    # -- numpy dtype for host-side casting ---------------------------------
+    @property
+    def _np_dtype(self):
+        return np.dtype(jnp.dtype(self.dtype).name)
+
+    def _tokenize(self, prompts) -> list[TokenizedPrompt]:
+        return [self.tokenizer(p, s) for p, s in prompts]
+
+    def __call__(self, prompts) -> list[np.ndarray]:
+        t_start = time.perf_counter()
+        toks = self._tokenize(prompts)
+        blocks = make_blocks(toks, self.cfg.block_size)
+        store = ActivationStore(
+            self.cfg.storage_location,
+            self.cfg.disk_folder,
+            device_rank=self.plan.device_rank,
+            rank_tag=self.plan.num_devices > 1 and self.cfg.data_parallel,
+        )
+        source = ShardWeightSource(
+            self.cfg.model_path,
+            self.layer_names,
+            self.plan.shards,
+            self._np_dtype,
+            device=self.device,
+            prefetch_depth=self.cfg.prefetch_depth,
+            tied_embeddings=self.model_cfg.tie_word_embeddings,
+        )
+
+        n_layers = len(self.layer_names)
+        scores: dict[int, np.ndarray] = {}
+        # Per-block device-resident metadata, uploaded once.
+        block_meta = {}
+        for b, idxs in enumerate(blocks):
+            block_meta[b] = (
+                jnp.asarray(np.stack([toks[i].prefix_ids for i in idxs])),
+                jnp.asarray(np.stack([toks[i].suffix_ids for i in idxs])),
+                jnp.asarray(
+                    np.array([toks[i].prefix_len for i in idxs], dtype=np.int32)
+                ),
+                jnp.asarray(np.stack([toks[i].suffix_eos for i in idxs])),
+            )
+
+        compute_time = 0.0
+        try:
+            compute_time = self._stream(source, store, toks, blocks, block_meta, scores)
+        finally:
+            source.close()
+
+        self.stats = {
+            "load_weights_time_s": source.load_time,
+            "compute_wall_s": compute_time,
+            "total_wall_s": time.perf_counter() - t_start,
+            "num_layers_streamed": float(self.plan.num_local_layers),
+        }
+        store.clear()
+        return [scores[i] for i in range(len(prompts))]
+
+    def _stream(self, source, store, toks, blocks, block_meta, scores) -> float:
+        n_layers = len(self.layer_names)
+        compute_time = 0.0
+        for layer_idxs, segments in source:
+            if not layer_idxs:  # MP round-up can yield empty stages
+                continue
+            t0 = time.perf_counter()
+            first, last = layer_idxs[0], layer_idxs[-1]
+            for b, idxs in enumerate(blocks):
+                prefix_ids, suffix_ids, prefix_len, suffix_eos = block_meta[b]
+                if first == 0:
+                    prefix_h, suffix_h = None, None  # produced by embed segment
+                else:
+                    # Prefix states are only consumed by decoder layers; the
+                    # last decoder is index n_layers-3 (norm = -2, head = -1).
+                    with_prefix = first <= n_layers - 3
+                    prefix_h, suffix_h = store.fetch(b, idxs, with_prefix=with_prefix)
+                    suffix_h = jax.device_put(suffix_h, self.device)
+                    if prefix_h is not None:
+                        prefix_h = jax.device_put(prefix_h, self.device)
+
+                for kind, params in segments:
+                    if kind == "embed":
+                        prefix_h, suffix_h = _embed_block(
+                            self.model_cfg, self.dtype, params, prefix_ids, suffix_ids
+                        )
+                    elif kind == "decoders":
+                        prefix_h, suffix_h = _decoder_block(
+                            self.model_cfg, params, prefix_h, suffix_h, prefix_len
+                        )
+                    elif kind == "norm":
+                        suffix_h = _norm_block(
+                            self.model_cfg, params, suffix_h, suffix_eos
+                        )
+                        prefix_h = None
+                    else:  # head
+                        block_scores = np.asarray(
+                            jax.device_get(_head_block(params, suffix_h))
+                        )
+                        for row, i in enumerate(idxs):
+                            s_true = toks[i].num_suffixes
+                            scores[i] = np.expand_dims(
+                                block_scores[row, :s_true], axis=1
+                            )
+
+                if last != n_layers - 1:
+                    store.store(b, idxs, prefix_h, suffix_h)
+            # cpu/disk stores already synced via device_get; for tpu storage
+            # block once per shard so compute_wall_s measures device time (the
+            # prefetch thread keeps uploading the next shard concurrently).
+            if last != n_layers - 1 and self.cfg.storage_location == "tpu":
+                jax.block_until_ready(suffix_h)
+            compute_time += time.perf_counter() - t0
+        return compute_time
+
+
+__all__ = ["StreamingExecutor", "ShardWeightSource"]

@@ -1,0 +1,146 @@
+"""Prompt tokenization for (prefix, suffixes) scoring prompts.
+
+Token-level semantics match the reference exactly
+(``/root/reference/utils.py:102-104,246-258``):
+
+- ``pad_token = eos_token``, right padding;
+- the prefix is tokenized unpadded (keeps its BOS), truncated to
+  ``max_token_len``;
+- suffixes are tokenized as a padded batch and the leading BOS column is
+  stripped (``[:, 1:]``);
+- ``suffix_eos[s]`` = index of the last non-pad token of suffix ``s``.
+
+TPU-first addition: **length bucketing**. The reference feeds each prompt's
+exact ragged shapes to CUDA kernels; under XLA every distinct shape is a new
+compile, so here prefix/suffix lengths are right-padded up to a bucket multiple
+and the number of suffixes up to a small multiple. True lengths travel
+alongside as dynamic *values* (folded into attention masks / eos gathers), so
+padding never changes numerics — only shapes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+
+def bucket_len(n: int, multiple: int, cap: int | None = None) -> int:
+    """Round ``n`` up to a multiple (at least ``multiple``); clamp to ``cap``."""
+    b = max(multiple, ((n + multiple - 1) // multiple) * multiple)
+    return min(b, cap) if cap is not None else b
+
+
+@dataclasses.dataclass
+class TokenizedPrompt:
+    """One (prefix, suffixes) prompt, padded to bucket shapes.
+
+    prefix_ids: int32 [Lp_bucket]  (right-padded with pad_id)
+    suffix_ids: int32 [S_bucket, Ls_bucket]  (padded rows are all pad_id)
+    prefix_len: true prefix length (<= Lp_bucket)
+    suffix_eos: int32 [S_bucket] — last real token index per suffix row
+        (0 for padding rows; their scores are discarded)
+    num_suffixes: true number of suffixes (<= S_bucket)
+    """
+
+    prefix_ids: np.ndarray
+    suffix_ids: np.ndarray
+    prefix_len: int
+    suffix_eos: np.ndarray
+    num_suffixes: int
+
+    @property
+    def bucket_key(self) -> tuple[int, int, int]:
+        return (
+            int(self.prefix_ids.shape[0]),
+            int(self.suffix_ids.shape[0]),
+            int(self.suffix_ids.shape[1]),
+        )
+
+
+class PromptTokenizer:
+    """Wraps a HF tokenizer with the reference's prefix/suffix conventions."""
+
+    def __init__(
+        self,
+        tokenizer,
+        max_token_len: int = 4096,
+        bucket_multiple: int = 64,
+        suffix_count_multiple: int = 4,
+    ):
+        self.tok = tokenizer
+        self.tok.pad_token = self.tok.eos_token
+        self.tok.padding_side = "right"
+        self.pad_id = self.tok.pad_token_id
+        self.max_token_len = max_token_len
+        self.bucket_multiple = bucket_multiple
+        self.suffix_count_multiple = suffix_count_multiple
+
+    def __call__(self, prefix: str, suffixes: tuple[str, ...]) -> TokenizedPrompt:
+        prefix_ids = np.asarray(
+            self.tok(
+                prefix,
+                return_attention_mask=False,
+                truncation=True,
+                max_length=self.max_token_len,
+            )["input_ids"],
+            dtype=np.int32,
+        )
+        # Padded suffix batch, leading BOS stripped (/root/reference/utils.py:252-257).
+        suffix_ids = np.asarray(
+            self.tok(
+                list(suffixes),
+                return_attention_mask=False,
+                truncation=True,
+                max_length=self.max_token_len,
+                padding=True,
+            )["input_ids"],
+            dtype=np.int32,
+        )[:, 1:]
+        s, ls = suffix_ids.shape
+        lp = prefix_ids.shape[0]
+
+        lp_b = bucket_len(lp, self.bucket_multiple, self.max_token_len)
+        ls_b = bucket_len(max(ls, 1), self.bucket_multiple, self.max_token_len)
+        s_b = bucket_len(s, self.suffix_count_multiple)
+
+        prefix_pad = np.full((lp_b,), self.pad_id, dtype=np.int32)
+        prefix_pad[:lp] = prefix_ids  # lp_b >= lp by construction
+        suffix_pad = np.full((s_b, ls_b), self.pad_id, dtype=np.int32)
+        suffix_pad[:s, :ls] = suffix_ids
+
+        # /root/reference/utils.py:258 — last non-pad index, zero-based.
+        eos = np.zeros((s_b,), dtype=np.int32)
+        eos[:s] = np.maximum((suffix_ids != self.pad_id).sum(axis=1) - 1, 0)
+
+        return TokenizedPrompt(
+            prefix_ids=prefix_pad,
+            suffix_ids=suffix_pad,
+            prefix_len=lp,
+            suffix_eos=eos,
+            num_suffixes=s,
+        )
+
+
+def make_blocks(
+    tokenized: list[TokenizedPrompt], block_size: int
+) -> list[list[int]]:
+    """Group prompt indices into execution blocks of up to ``block_size``
+    prompts sharing identical bucket shapes, preserving order within a bucket.
+
+    A block is one jitted device call (vmapped over prompts) — the TPU
+    replacement for the reference's strictly per-prompt loop
+    (``/root/reference/utils.py:239``).
+    """
+    by_key: dict[tuple[int, int, int], list[int]] = {}
+    for i, t in enumerate(tokenized):
+        by_key.setdefault(t.bucket_key, []).append(i)
+    blocks = []
+    for key in sorted(by_key):
+        idxs = by_key[key]
+        for i in range(0, len(idxs), block_size):
+            blocks.append(idxs[i : i + block_size])
+    return blocks
+
+
+__all__ = ["PromptTokenizer", "TokenizedPrompt", "make_blocks", "bucket_len"]

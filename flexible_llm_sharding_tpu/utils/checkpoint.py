@@ -299,7 +299,10 @@ def save_params(params: dict[str, Any], out_dir: str, cfg: LlamaConfig) -> None:
             if isinstance(v, dict):
                 yield from flatten(v, name)
             else:
-                yield name, np.asarray(v)
+                # np.asarray over a jax array can yield a non-contiguous view;
+                # safetensors serializes the raw buffer ignoring strides, so
+                # contiguity is mandatory here.
+                yield name, np.ascontiguousarray(np.asarray(v))
 
     st_save_file(dict(flatten(params["embed"])), os.path.join(out_dir, "model.embed_tokens.safetensors"))
     for i, layer in enumerate(params["layers"]):

@@ -1,0 +1,55 @@
+"""A minimal in-repo stand-in for a HF tokenizer (byte-level), so runtime tests
+need no tokenizer assets on disk. Mirrors the HF call surface the framework
+uses: BOS prepended, right padding, truncation, ``decode``."""
+
+from __future__ import annotations
+
+
+class FakeTokenizer:
+    BOS = 1
+    EOS = 2
+    OFFSET = 3  # byte b -> token b + 3
+
+    def __init__(self, vocab_size: int = 300):
+        self.vocab_size = vocab_size
+        self.eos_token = "</s>"
+        self.pad_token = None
+        self.pad_token_id = self.EOS
+        self.padding_side = "right"
+
+    def __setattr__(self, k, v):
+        object.__setattr__(self, k, v)
+        if k == "pad_token" and v == getattr(self, "eos_token", None):
+            object.__setattr__(self, "pad_token_id", self.EOS)
+
+    def _encode(self, text: str, max_length: int | None) -> list[int]:
+        ids = [self.BOS] + [
+            (b % (self.vocab_size - self.OFFSET)) + self.OFFSET
+            for b in text.encode()
+        ]
+        return ids[:max_length] if max_length else ids
+
+    def __call__(
+        self,
+        text,
+        return_tensors=None,
+        return_attention_mask=False,
+        truncation=False,
+        max_length=None,
+        padding=False,
+    ):
+        if isinstance(text, str):
+            return {"input_ids": self._encode(text, max_length)}
+        seqs = [self._encode(t, max_length) for t in text]
+        if padding:
+            m = max(len(s) for s in seqs)
+            seqs = [s + [self.pad_token_id] * (m - len(s)) for s in seqs]
+        return {"input_ids": seqs}
+
+    def decode(self, token_ids) -> str:
+        ids = token_ids if hasattr(token_ids, "__iter__") else [int(token_ids)]
+        return "".join(
+            chr((int(t) - self.OFFSET) % 256)
+            for t in ids
+            if int(t) >= self.OFFSET
+        )

@@ -1,0 +1,121 @@
+"""End-to-end streaming executor: scores from the layer-streaming path must
+equal the monolithic forward, across storage backends and shard sizes — the
+storage-parametrized scoring test mandated by SURVEY.md §4."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from flexible_llm_sharding_tpu.config import FrameworkConfig
+from flexible_llm_sharding_tpu.models import llama
+from flexible_llm_sharding_tpu.runtime.executor import StreamingExecutor
+from flexible_llm_sharding_tpu.runtime.tokenization import PromptTokenizer, make_blocks
+from flexible_llm_sharding_tpu.utils.checkpoint import save_params
+
+from tests.fake_tokenizer import FakeTokenizer
+
+PROMPTS = [
+    ("The capital of France", (" is Paris", " is Rome", " might be Lyon")),
+    ("Water boils", (" at 100C", " when heated to its boiling point")),
+    ("Two plus two equals", (" four", " five", " twenty-two", " fish")),
+]
+
+
+@pytest.fixture(scope="module")
+def model_dir(tiny_cfg, tmp_path_factory):
+    params = llama.init_params(jax.random.PRNGKey(0), tiny_cfg)
+    d = tmp_path_factory.mktemp("tiny_model")
+    save_params(jax.tree.map(np.asarray, params), str(d), tiny_cfg)
+    return str(d), params
+
+
+def _expected_scores(params, cfg, tok: PromptTokenizer, prompts):
+    """Monolithic forward per (prefix, suffix): softmax at the suffix's last
+    real token — the invariant the streaming path must reproduce."""
+    out = []
+    for prefix, suffixes in prompts:
+        t = tok(prefix, suffixes)
+        rows = []
+        for s in range(t.num_suffixes):
+            n_real = int(t.suffix_eos[s]) + 1
+            full = np.concatenate(
+                [t.prefix_ids[: t.prefix_len], t.suffix_ids[s, :n_real]]
+            )[None, :]
+            logits = llama.forward_full(params, cfg, jnp.asarray(full))
+            rows.append(np.asarray(jax.nn.softmax(logits[0, -1])))
+        out.append(np.stack(rows)[:, None, :])
+    return out
+
+
+@pytest.fixture(scope="module")
+def expected(tiny_cfg, model_dir):
+    _, params = model_dir
+    tok = PromptTokenizer(FakeTokenizer(), bucket_multiple=8)
+    return _expected_scores(params, tiny_cfg, tok, PROMPTS)
+
+
+@pytest.mark.parametrize("storage", ["tpu", "cpu", "disk"])
+def test_executor_matches_monolithic(tiny_cfg, model_dir, expected, storage, tmp_path):
+    path, _ = model_dir
+    cfg = FrameworkConfig(
+        model_path=path,
+        layer_num_per_shard=1,
+        storage_location=storage,
+        disk_folder=str(tmp_path / "acts"),
+        dtype="float32",
+        bucket_multiple=8,
+        block_size=2,
+        prefetch_depth=0,
+    )
+    ex = StreamingExecutor(cfg, tokenizer=FakeTokenizer())
+    got = ex(list(PROMPTS))
+    assert len(got) == len(PROMPTS)
+    for g, w, (_, sfx) in zip(got, expected, PROMPTS):
+        assert g.shape == (len(sfx), 1, tiny_cfg.vocab_size)
+        np.testing.assert_allclose(g, w, rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("lnps", [2, 3, 100])
+def test_executor_shard_sizes(tiny_cfg, model_dir, expected, lnps):
+    path, _ = model_dir
+    cfg = FrameworkConfig(
+        model_path=path,
+        layer_num_per_shard=lnps,
+        storage_location="cpu",
+        dtype="float32",
+        bucket_multiple=8,
+        prefetch_depth=1,  # exercises the prefetch thread
+    )
+    ex = StreamingExecutor(cfg, tokenizer=FakeTokenizer())
+    got = ex(list(PROMPTS))
+    for g, w in zip(got, expected):
+        np.testing.assert_allclose(g, w, rtol=1e-4, atol=1e-5)
+
+
+def test_tokenization_bucketing():
+    tok = PromptTokenizer(FakeTokenizer(), bucket_multiple=8, suffix_count_multiple=4)
+    t = tok("hello world", ("a", "bc", "def"))
+    lp, s, ls = t.prefix_ids.shape[0], *t.suffix_ids.shape
+    assert lp % 8 == 0 and ls % 8 == 0 and s == 4
+    assert t.num_suffixes == 3
+    # BOS stripped from suffixes, kept on prefix.
+    assert t.prefix_ids[0] == FakeTokenizer.BOS
+    assert (t.suffix_ids[:3, 0] != FakeTokenizer.BOS).all()
+    # suffix_eos = last real token, zero-based (ref utils.py:258).
+    assert list(t.suffix_eos[:3]) == [0, 1, 2]
+    # padding rows are all pad.
+    assert (t.suffix_ids[3] == tok.pad_id).all()
+
+
+def test_make_blocks_groups_by_bucket():
+    tok = PromptTokenizer(FakeTokenizer(), bucket_multiple=8)
+    toks = [tok(p, s) for p, s in PROMPTS] * 2
+    blocks = make_blocks(toks, block_size=2)
+    seen = sorted(i for b in blocks for i in b)
+    assert seen == list(range(len(toks)))
+    for b in blocks:
+        assert len(b) <= 2
+        keys = {toks[i].bucket_key for i in b}
+        assert len(keys) == 1

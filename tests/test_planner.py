@@ -1,0 +1,72 @@
+"""Shard-planner math vs the reference's numpy formulation
+(``/root/reference/utils.py:144-153``, ``/root/reference/main.py:19-20,70``)."""
+
+import math
+
+import numpy as np
+import pytest
+
+from flexible_llm_sharding_tpu.parallel.planner import (
+    batch_ranges,
+    global_stage_order,
+    plan_shards_dp,
+    plan_shards_mp,
+    split_prompts_dp,
+)
+
+
+def _ref_dp(n_layers, layer_num_per_shard):
+    num_shards = np.ceil(n_layers / layer_num_per_shard)
+    return [tuple(a) for a in np.array_split(np.arange(n_layers), int(num_shards))]
+
+
+def _ref_mp(n_layers, layer_num_per_shard, rank, num_gpu):
+    num_shards = int(np.ceil(np.ceil(n_layers / layer_num_per_shard) / num_gpu) * num_gpu)
+    all_shards = np.array_split(np.arange(n_layers), num_shards)
+    return [tuple(a) for a in all_shards[rank::num_gpu]]
+
+
+@pytest.mark.parametrize("n_layers", [1, 2, 5, 35, 83])  # 83 = 80 decoders + 3 (70B)
+@pytest.mark.parametrize("lnps", [1, 2, 3, 8, 100])
+def test_dp_plan_matches_reference(n_layers, lnps):
+    plan = plan_shards_dp(n_layers, lnps)
+    assert list(plan.shards) == _ref_dp(n_layers, lnps)
+    flat = [i for s in plan.shards for i in s]
+    assert flat == list(range(n_layers))
+    assert all(len(s) <= lnps for s in plan.shards)
+
+
+@pytest.mark.parametrize("n_layers", [5, 35, 83])
+@pytest.mark.parametrize("lnps", [1, 2, 8])
+@pytest.mark.parametrize("num_gpu", [2, 4, 8])
+def test_mp_plan_matches_reference(n_layers, lnps, num_gpu):
+    plans = [plan_shards_mp(n_layers, lnps, r, num_gpu) for r in range(num_gpu)]
+    for r, plan in enumerate(plans):
+        assert list(plan.shards) == _ref_mp(n_layers, lnps, r, num_gpu)
+    # Union over devices covers every layer exactly once.
+    flat = sorted(i for p in plans for s in p.shards for i in s)
+    assert flat == list(range(n_layers))
+    # Every device gets the same number of stages (round-up rule).
+    counts = {len(p.shards) for p in plans}
+    assert len(counts) == 1
+
+
+def test_global_stage_order_round_robin():
+    stages = global_stage_order(10, 2, num_devices=2)
+    assert [rank for _, rank, _ in stages] == [0, 1, 0, 1, 0, 1]
+    flat = [i for _, _, s in stages for i in s]
+    assert flat == list(range(10))
+
+
+@pytest.mark.parametrize("n,devs", [(10, 3), (7, 2), (5, 8)])
+def test_split_prompts_dp_matches_array_split(n, devs):
+    got = split_prompts_dp(n, devs)
+    want = np.array_split(np.arange(n), devs)
+    for (a, b), w in zip(got, want):
+        assert list(range(a, b)) == list(w)
+
+
+def test_batch_ranges_reference_rule():
+    # /root/reference/main.py:19-20 with num_batch=3, 10 prompts
+    assert batch_ranges(10, 3) == [(0, 3), (3, 6), (6, 10)]
+    assert batch_ranges(5, 1) == [(0, 5)]
