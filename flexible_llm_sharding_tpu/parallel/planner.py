@@ -75,19 +75,21 @@ def plan_shards_dp(
     )
 
 
+def _mp_num_shards(n_layers: int, layer_num_per_shard: int, num_devices: int) -> int:
+    """MP shard count: rounded up to a multiple of ``num_devices`` so every
+    device gets the same number of stages (``/root/reference/utils.py:151``)."""
+    return (
+        math.ceil(math.ceil(n_layers / layer_num_per_shard) / num_devices)
+        * num_devices
+    )
+
+
 def plan_shards_mp(
     n_layers: int, layer_num_per_shard: int, device_rank: int, num_devices: int
 ) -> ShardPlan:
     """MP plan for one device: round-robin interleaved stages
-    (``/root/reference/utils.py:150-153``).
-
-    Shard count rounds up to a multiple of ``num_devices`` so every device gets
-    the same number of stages (some possibly empty when n_layers is small).
-    """
-    num_shards = (
-        math.ceil(math.ceil(n_layers / layer_num_per_shard) / num_devices)
-        * num_devices
-    )
+    (``/root/reference/utils.py:150-153``)."""
+    num_shards = _mp_num_shards(n_layers, layer_num_per_shard, num_devices)
     all_shards = _contiguous_shards(n_layers, num_shards)
     return ShardPlan(
         shards=tuple(all_shards[device_rank::num_devices]),
@@ -99,10 +101,7 @@ def plan_shards_mp(
 
 def global_stage_order(n_layers: int, layer_num_per_shard: int, num_devices: int):
     """All MP stages in execution order as (stage_idx, device_rank, layer_tuple)."""
-    num_shards = (
-        math.ceil(math.ceil(n_layers / layer_num_per_shard) / num_devices)
-        * num_devices
-    )
+    num_shards = _mp_num_shards(n_layers, layer_num_per_shard, num_devices)
     shards = _contiguous_shards(n_layers, num_shards)
     return [(i, i % num_devices, s) for i, s in enumerate(shards)]
 

@@ -1,0 +1,148 @@
+"""Device mesh + sharding rules: how Llama parameters and activations are laid
+out over a TPU slice.
+
+The reference has no tensor/sequence parallelism at all — each layer's full
+weights go to exactly one device (``/root/reference/utils.py:128-130``) and
+"communication" is host-staged tensor copies between Python threads
+(``/root/reference/utils.py:166,193-195``). The TPU-native design replaces all
+of that with one ``jax.sharding.Mesh`` plus ``NamedSharding`` annotations; XLA
+inserts the ICI collectives (all-gather / reduce-scatter / psum) itself.
+
+Mesh axes used across the framework:
+
+- ``dp``  — data parallel: the prompt/batch axis (reference's ``--data_parallel``
+  prompt split, ``/root/reference/main.py:67-70``).
+- ``tp``  — tensor parallel: attention heads / MLP hidden sharding (Megatron
+  layout: column-parallel in-projections, row-parallel out-projections so each
+  layer needs exactly one psum, which XLA emits from the sharding annotations).
+- ``sp``  — sequence/context parallel: long sequences sharded along length for
+  norm/elementwise regions (XLA re-gathers where attention needs full keys).
+
+Parameter layout reminder (models/llama.py): all linear kernels are stored
+``[in, out]`` — the transpose of HF — so "column parallel" = shard the LAST
+axis, "row parallel" = shard the FIRST axis.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from flexible_llm_sharding_tpu.config import LlamaConfig
+
+Params = dict[str, Any]
+
+
+def make_mesh(
+    shape: dict[str, int] | None = None, devices: list | None = None
+) -> Mesh:
+    """Build a Mesh from axis-name -> size.
+
+    ``shape=None`` gives a 1-D ``('dp',)`` mesh over all visible devices.
+    Sizes must multiply to the device count (one axis may be -1 to infer).
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if shape is None:
+        shape = {"dp": len(devices)}
+    names = tuple(shape)
+    sizes = list(shape.values())
+    if -1 in sizes:
+        known = int(np.prod([s for s in sizes if s != -1]))
+        sizes[sizes.index(-1)] = len(devices) // known
+    need = int(np.prod(sizes))
+    if need > len(devices):
+        raise ValueError(
+            f"mesh shape {dict(zip(names, sizes))} needs {need} devices, "
+            f"have {len(devices)}"
+        )
+    arr = np.asarray(devices[:need]).reshape(sizes)
+    return Mesh(arr, names)
+
+
+def layer_specs(tp: str | None = "tp") -> Params:
+    """PartitionSpecs for one decoder layer's params (Megatron TP layout)."""
+    col = P(None, tp)  # [in, out] sharded on out
+    row = P(tp, None)  # [in, out] sharded on in
+    rep = P(None)
+    return {
+        "input_layernorm": {"scale": rep},
+        "post_attention_layernorm": {"scale": rep},
+        "attn": {"wq": col, "wk": col, "wv": col, "wo": row},
+        "mlp": {"gate": col, "up": col, "down": row},
+    }
+
+
+def param_specs(
+    cfg: LlamaConfig,
+    tp: str | None = "tp",
+    stacked: bool = False,
+    pp: str | None = None,
+) -> Params:
+    """PartitionSpec pytree matching ``llama.init_params`` layout.
+
+    ``stacked=True`` means ``params['layers']`` is one pytree with a leading
+    [num_layers] axis (the scan layout); ``pp`` optionally shards that layer
+    axis across a pipeline mesh axis.
+    """
+    lspec = layer_specs(tp)
+    if stacked:
+        layers = jax.tree.map(
+            lambda s: P(pp, *s), lspec, is_leaf=lambda x: isinstance(x, P)
+        )
+    else:
+        layers = [lspec] * cfg.num_hidden_layers
+    specs: Params = {
+        "embed": {"embedding": P(None, tp)},  # [V, D] sharded on hidden
+        "layers": layers,
+        "norm": {"scale": P(None)},
+    }
+    if not cfg.tie_word_embeddings:
+        specs["lm_head"] = {"kernel": P(None, tp)}  # [D, V] sharded on vocab
+    return specs
+
+
+def data_spec(dp: str | None = "dp", sp: str | None = None) -> P:
+    """Token ids [B, L]: batch over dp, optionally sequence over sp."""
+    return P(dp, sp)
+
+
+def check_tp_divisibility(cfg: LlamaConfig, tp_size: int) -> None:
+    """TP constraints — fail loudly before XLA produces a cryptic error."""
+    if cfg.num_attention_heads % tp_size:
+        raise ValueError(
+            f"num_attention_heads={cfg.num_attention_heads} not divisible by tp={tp_size}"
+        )
+    if cfg.num_key_value_heads % tp_size:
+        raise ValueError(
+            f"num_key_value_heads={cfg.num_key_value_heads} not divisible by tp={tp_size}"
+        )
+    if cfg.intermediate_size % tp_size:
+        raise ValueError(
+            f"intermediate_size={cfg.intermediate_size} not divisible by tp={tp_size}"
+        )
+
+
+def tree_shardings(mesh: Mesh, specs: Params) -> Params:
+    """PartitionSpec pytree -> NamedSharding pytree."""
+    return jax.tree.map(
+        lambda s: NamedSharding(mesh, s), specs, is_leaf=lambda x: isinstance(x, P)
+    )
+
+
+def shard_params(params: Params, mesh: Mesh, specs: Params) -> Params:
+    """device_put a (host or device) param pytree onto the mesh per specs."""
+    return jax.device_put(params, tree_shardings(mesh, specs))
+
+
+__all__ = [
+    "make_mesh",
+    "param_specs",
+    "layer_specs",
+    "data_spec",
+    "check_tp_divisibility",
+    "tree_shardings",
+    "shard_params",
+]

@@ -15,8 +15,11 @@ TPU-first differences:
   single prompts; disk files are still written per prompt for contract parity.
 - No spin-wait backpressure (``sleep(1)`` polls at
   ``/root/reference/utils.py:179-180,189-190``): ordering comes from the
-  executor's deterministic schedule, and ``cpu`` backpressure is a bounded
-  deque of host arrays.
+  executor's deterministic schedule. In the streaming (DP/single-device)
+  schedule every block's activations must persist between consecutive shards —
+  the reference's cpu mode holds the same unbounded set
+  (``/root/reference/utils.py:163-168``); its ``max_activation_in_cpu`` bound
+  applies only to MP middle ranks and belongs to the pipeline runner.
 - ``tpu`` keeps activations as device arrays; ``cpu`` uses
   ``jax.device_get`` (async transfer flushed at store time); ``disk`` writes
   float32-preserving raw dtypes via numpy.

@@ -288,6 +288,16 @@ class StreamingExecutor:
         self.plan = plan or plan_shards_dp(
             len(self.layer_names), cfg.layer_num_per_shard
         )
+        # This executor streams every layer itself; a plan that skips layers
+        # (an MP stage plan) needs the pipeline orchestrator's cross-device
+        # activation handoff, which this class does not do.
+        covered = sorted(i for s in self.plan.shards for i in s)
+        if covered != list(range(len(self.layer_names))):
+            raise ValueError(
+                "StreamingExecutor requires a plan covering all layers "
+                "contiguously (DP/single-device); use the MP pipeline runner "
+                "for interleaved stage plans"
+            )
         self.stats: dict[str, float] = {}
 
     # -- numpy dtype for host-side casting ---------------------------------
@@ -318,7 +328,6 @@ class StreamingExecutor:
             tied_embeddings=self.model_cfg.tie_word_embeddings,
         )
 
-        n_layers = len(self.layer_names)
         scores: dict[int, np.ndarray] = {}
         # Per-block device-resident metadata, uploaded once.
         block_meta = {}
@@ -351,8 +360,6 @@ class StreamingExecutor:
         n_layers = len(self.layer_names)
         compute_time = 0.0
         for layer_idxs, segments in source:
-            if not layer_idxs:  # MP round-up can yield empty stages
-                continue
             t0 = time.perf_counter()
             first, last = layer_idxs[0], layer_idxs[-1]
             for b, idxs in enumerate(blocks):

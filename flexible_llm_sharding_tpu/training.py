@@ -1,0 +1,142 @@
+"""Sharded training step over a device mesh.
+
+The reference is inference-only (no optimizer, loss, or backward pass anywhere
+in its 3 files — SURVEY.md §0), but a framework needs a training path to be
+more than a scoring tool, and the multi-chip sharding design (parallel/
+sharding.py) is exercised hardest by the backward pass: TP's row/column layout
+must round-trip gradients with exactly one psum per projection pair, and DP
+gradients must reduce over the ``dp`` axis. XLA derives all of those
+collectives from the NamedSharding annotations below — nothing here issues a
+collective by hand.
+
+Usage:
+    mesh = make_mesh({"dp": 2, "tp": 4})
+    state = TrainState.create(cfg, params, optax.adamw(1e-4), mesh)
+    step = make_train_step(cfg, optimizer, mesh)
+    state, loss = step(state, batch)   # batch: int32 [B, L+1] token ids
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from flexible_llm_sharding_tpu.config import LlamaConfig
+from flexible_llm_sharding_tpu.models import llama
+from flexible_llm_sharding_tpu.parallel.sharding import (
+    data_spec,
+    param_specs,
+    tree_shardings,
+)
+
+Params = dict[str, Any]
+
+
+def next_token_loss(
+    params: Params,
+    cfg: LlamaConfig,
+    tokens: jax.Array,
+    dtype=jnp.bfloat16,
+    pad_id: int | None = None,
+) -> jax.Array:
+    """Mean next-token cross-entropy. tokens: int32 [B, L+1] (inputs=: -1,
+    targets=1:). With ``pad_id``, positions whose target is pad are excluded
+    from the mean (right-padded ragged batches). Logits come back float32
+    from ``forward_full``."""
+    inputs, targets = tokens[:, :-1], tokens[:, 1:]
+    logits = llama.forward_full(params, cfg, inputs, dtype=dtype)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    if pad_id is None:
+        return -jnp.mean(ll)
+    keep = (targets != pad_id).astype(jnp.float32)
+    return -jnp.sum(ll * keep) / jnp.maximum(jnp.sum(keep), 1.0)
+
+
+@dataclasses.dataclass
+class TrainState:
+    """Parameters + optimizer state, both sharded over the mesh."""
+
+    params: Params
+    opt_state: Any
+    step: jax.Array
+
+    @classmethod
+    def create(
+        cls,
+        cfg: LlamaConfig,
+        params: Params,
+        optimizer: optax.GradientTransformation,
+        mesh: Mesh | None = None,
+        tp: str | None = "tp",
+    ) -> "TrainState":
+        if mesh is not None:
+            shardings = tree_shardings(
+                mesh, param_specs(cfg, tp=tp if tp in mesh.axis_names else None)
+            )
+            params = jax.device_put(params, shardings)
+        opt_state = optimizer.init(params)
+        return cls(params=params, opt_state=opt_state, step=jnp.zeros((), jnp.int32))
+
+
+def make_train_step(
+    cfg: LlamaConfig,
+    optimizer: optax.GradientTransformation,
+    mesh: Mesh | None = None,
+    dp: str | None = "dp",
+    dtype=jnp.bfloat16,
+    pad_id: int | None = None,
+) -> Callable[[TrainState, jax.Array], tuple[TrainState, jax.Array]]:
+    """Build the jitted train step.
+
+    With a mesh: batch is sharded over ``dp``; the params' TP layout comes
+    from how ``TrainState.create`` placed them (Megatron specs in
+    parallel/sharding.py). The DP gradient all-reduce and TP activation
+    collectives are inserted by XLA from the sharding annotations — the
+    TPU-native replacement for a NCCL/MPI backend (SURVEY.md §2.3).
+    """
+
+    dp_ax = dp if mesh is not None and dp in mesh.axis_names else None
+
+    def step_fn(state: TrainState, tokens: jax.Array):
+        if mesh is not None and dp_ax is not None:
+            # Pin the batch layout so a replicated host array still runs DP.
+            tokens = jax.lax.with_sharding_constraint(
+                tokens, NamedSharding(mesh, data_spec(dp=dp_ax))
+            )
+        loss, grads = jax.value_and_grad(next_token_loss)(
+            state.params, cfg, tokens, dtype, pad_id
+        )
+        updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        return (
+            TrainState(params=params, opt_state=opt_state, step=state.step + 1),
+            loss,
+        )
+
+    # "Computation follows data": TrainState.create already placed params (and
+    # therefore opt_state) with the TP NamedShardings, and shard_batch places
+    # the tokens over dp — jit compiles against those operand shardings and XLA
+    # inserts the DP grad all-reduce + TP activation collectives. Donation
+    # reuses the old params/opt-state HBM for the new state.
+    return jax.jit(step_fn, donate_argnums=(0,))
+
+
+def shard_batch(mesh: Mesh, tokens, dp: str | None = "dp", sp: str | None = None):
+    """Place a host token batch [B, L] onto the mesh, batch over ``dp``."""
+    dp_ax = dp if dp in mesh.axis_names else None
+    sp_ax = sp if sp is not None and sp in mesh.axis_names else None
+    return jax.device_put(tokens, NamedSharding(mesh, data_spec(dp=dp_ax, sp=sp_ax)))
+
+
+# TrainState must be a pytree for jit/shardings to map over it.
+jax.tree_util.register_dataclass(
+    TrainState, data_fields=["params", "opt_state", "step"], meta_fields=[]
+)
+
+__all__ = ["TrainState", "make_train_step", "next_token_loss", "shard_batch"]

@@ -8,6 +8,13 @@ _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 
+# A TPU-tunnel sitecustomize may have force-set jax_platforms in-process at
+# interpreter start (overriding the env var); re-pin to CPU before any backend
+# is initialised.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
 import numpy as np
 import pytest
 

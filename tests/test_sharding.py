@@ -1,0 +1,92 @@
+"""Mesh sharding + sharded training step on 8 virtual CPU devices (SURVEY.md §4:
+distributed-without-a-cluster via --xla_force_host_platform_device_count)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from flexible_llm_sharding_tpu.models import llama
+from flexible_llm_sharding_tpu.parallel.sharding import (
+    check_tp_divisibility,
+    make_mesh,
+    param_specs,
+    shard_params,
+)
+from flexible_llm_sharding_tpu.training import (
+    TrainState,
+    make_train_step,
+    next_token_loss,
+    shard_batch,
+)
+
+
+def test_make_mesh_shapes():
+    mesh = make_mesh({"dp": 2, "tp": -1})
+    assert mesh.shape == {"dp": 2, "tp": 4}
+    with pytest.raises(ValueError):
+        make_mesh({"dp": 3, "tp": 3})  # 9 > 8 devices
+
+
+def test_tp_divisibility(tiny_cfg):
+    check_tp_divisibility(tiny_cfg, 2)  # 4 heads, 2 kv heads, F=128
+    with pytest.raises(ValueError):
+        check_tp_divisibility(tiny_cfg, 8)  # 4 heads not divisible
+
+
+def test_sharded_forward_matches_single_device(tiny_cfg):
+    params = llama.init_params(jax.random.PRNGKey(0), tiny_cfg)
+    ids = jnp.asarray(np.random.default_rng(1).integers(0, 256, (4, 16)), jnp.int32)
+    want = llama.forward_full(params, tiny_cfg, ids)
+
+    mesh = make_mesh({"dp": 2, "tp": 2})
+    sharded = shard_params(params, mesh, param_specs(tiny_cfg))
+    ids_s = shard_batch(mesh, ids)
+    got = jax.jit(lambda p, i: llama.forward_full(p, tiny_cfg, i))(sharded, ids_s)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-4)
+
+
+def test_sharded_train_step_matches_unsharded(tiny_cfg):
+    params = llama.init_params(jax.random.PRNGKey(0), tiny_cfg)
+    tokens = jnp.asarray(
+        np.random.default_rng(2).integers(0, 256, (4, 17)), jnp.int32
+    )
+    opt = optax.adamw(1e-3)
+
+    # Create both states before stepping: the train step donates its input
+    # state, so the shared source pytree must be fully copied out first.
+    mesh = make_mesh({"dp": 2, "tp": 2})
+    s1 = TrainState.create(
+        tiny_cfg, jax.tree.map(jnp.copy, params), opt, mesh=mesh
+    )
+    s0 = TrainState.create(tiny_cfg, params, opt)
+    step0 = make_train_step(tiny_cfg, opt, dtype=jnp.float32)
+    s0b, loss0 = step0(s0, tokens)
+
+    step1 = make_train_step(tiny_cfg, opt, mesh=mesh, dtype=jnp.float32)
+    s1b, loss1 = step1(s1, shard_batch(mesh, tokens))
+
+    assert np.isfinite(float(loss0))
+    np.testing.assert_allclose(float(loss1), float(loss0), rtol=1e-5)
+    assert int(s1b.step) == 1
+    # Spot-check one updated param matches.
+    w0 = np.asarray(s0b.params["layers"][0]["attn"]["wq"])
+    w1 = np.asarray(s1b.params["layers"][0]["attn"]["wq"])
+    np.testing.assert_allclose(w1, w0, rtol=1e-4, atol=1e-5)
+
+
+def test_loss_decreases(tiny_cfg):
+    params = llama.init_params(jax.random.PRNGKey(3), tiny_cfg)
+    tokens = jnp.asarray(
+        np.random.default_rng(4).integers(0, 256, (8, 17)), jnp.int32
+    )
+    opt = optax.adamw(3e-3)
+    state = TrainState.create(tiny_cfg, params, opt)
+    step = make_train_step(tiny_cfg, opt, dtype=jnp.float32)
+    first = None
+    for _ in range(5):
+        state, loss = step(state, tokens)
+        if first is None:
+            first = float(loss)
+    assert float(loss) < first
