@@ -1,0 +1,124 @@
+"""CLI batch driver — the reference's ``main.py`` surface, TPU-native inside.
+
+Same flag set and pickle contracts (``/root/reference/main.py:30-49,55-58,
+92-98``): input is a pickle of ``[(prefix_str, (suffix_str, ...)), ...]``;
+outputs are a score pickle (one float32 ``[n_suffixes, num_gen_token, vocab]``
+array per prompt) and a ``*_updated.pkl`` prompts file with generated text
+appended to each suffix.
+
+Differences, all deliberate:
+- ``--data_parallel`` parses real booleans (the reference's ``type=bool``
+  treats any non-empty string as True, ``/root/reference/main.py:40``).
+- ``--storage_location`` accepts ``tpu`` (activations stay in HBM); ``gpu``
+  is kept as an alias.
+- TPU-specific knobs (``--dtype``, ``--block_size``, ``--prefetch_depth``,
+  ``--num_devices``, ``--max_token_len``) extend the surface.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import pickle
+import sys
+
+from flexible_llm_sharding_tpu.config import DEFAULT_MAX_TOKEN_LEN, FrameworkConfig
+
+
+def _str2bool(v: str) -> bool:
+    if v.lower() in ("true", "1", "yes"):
+        return True
+    if v.lower() in ("false", "0", "no", ""):
+        return False
+    raise argparse.ArgumentTypeError(f"expected a boolean, got {v!r}")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="flexible-llm-sharding-tpu",
+        description="Layer-streaming LLM scorer/generator for TPU",
+    )
+    p.add_argument("--model_path", type=str, default="./")
+    p.add_argument("--prompt_pickle", type=str, required=True,
+                   help="Path to the input prompt pickle file")
+    p.add_argument("--output_file", type=str, required=True,
+                   help="Path to the LLM output scores file")
+    p.add_argument("--num_batch", type=int, default=1)
+    p.add_argument("--layer_num_per_shard", type=int, default=1)
+    p.add_argument("--storage_location", type=str, default="cpu",
+                   help="'tpu' (HBM), 'cpu' (host RAM), or 'disk'; 'gpu' = alias of 'tpu'")
+    p.add_argument("--max_activation_in_cpu", type=int, default=100)
+    p.add_argument("--data_parallel", type=_str2bool, default=False,
+                   help="True: split prompts across chips; False: interleaved layer pipeline across chips")
+    p.add_argument("--disk_folder", type=str, default="./temp")
+    p.add_argument("--num_gen_token", type=int, default=1,
+                   help="how many new tokens to be generated")
+    # --- TPU-specific ---
+    p.add_argument("--dtype", type=str, default="bfloat16",
+                   choices=["bfloat16", "float16", "float32"])
+    p.add_argument("--block_size", type=int, default=8)
+    p.add_argument("--prefetch_depth", type=int, default=1)
+    p.add_argument("--num_devices", type=int, default=0, help="0 = all visible chips")
+    p.add_argument("--max_token_len", type=int, default=DEFAULT_MAX_TOKEN_LEN)
+    return p
+
+
+def config_from_args(args: argparse.Namespace) -> FrameworkConfig:
+    return FrameworkConfig(
+        model_path=args.model_path,
+        num_batch=args.num_batch,
+        layer_num_per_shard=args.layer_num_per_shard,
+        storage_location=args.storage_location,
+        max_activation_in_cpu=args.max_activation_in_cpu,
+        data_parallel=args.data_parallel,
+        disk_folder=args.disk_folder,
+        num_gen_token=args.num_gen_token,
+        max_token_len=args.max_token_len,
+        dtype=args.dtype,
+        block_size=args.block_size,
+        prefetch_depth=args.prefetch_depth,
+        num_devices=args.num_devices,
+    )
+
+
+def main(argv: list[str] | None = None, tokenizer=None) -> None:
+    args = build_parser().parse_args(argv)
+    print(args, file=sys.stderr)
+    cfg = config_from_args(args)
+
+    if cfg.storage_location == "disk":
+        os.makedirs(cfg.disk_folder, exist_ok=True)
+
+    with open(args.prompt_pickle, "rb") as f:
+        prompts = pickle.load(f)
+
+    from flexible_llm_sharding_tpu.runtime.generation import generation_loop
+    from flexible_llm_sharding_tpu.runtime.orchestration import run_prompts
+
+    if tokenizer is None:
+        from transformers import AutoTokenizer
+
+        tokenizer = AutoTokenizer.from_pretrained(cfg.model_path)
+        tokenizer.pad_token = tokenizer.eos_token
+
+    output_scores, updated = generation_loop(
+        lambda ps: run_prompts(cfg, ps, tokenizer=tokenizer),
+        prompts,
+        cfg.num_gen_token,
+        tokenizer,
+    )
+
+    # Reference file contract (/root/reference/main.py:92-98).
+    with open(args.prompt_pickle.replace(".pkl", "_updated.pkl"), "wb") as f:
+        pickle.dump(updated, f)
+    with open(args.output_file, "wb") as f:
+        pickle.dump(output_scores, f)
+    print(
+        json.dumps({"prompts": len(prompts), "num_gen_token": cfg.num_gen_token}),
+        file=sys.stderr,
+    )
+
+
+if __name__ == "__main__":
+    main()

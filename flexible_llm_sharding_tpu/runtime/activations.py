@@ -15,11 +15,10 @@ TPU-first differences:
   single prompts; disk files are still written per prompt for contract parity.
 - No spin-wait backpressure (``sleep(1)`` polls at
   ``/root/reference/utils.py:179-180,189-190``): ordering comes from the
-  executor's deterministic schedule. In the streaming (DP/single-device)
-  schedule every block's activations must persist between consecutive shards —
-  the reference's cpu mode holds the same unbounded set
-  (``/root/reference/utils.py:163-168``); its ``max_activation_in_cpu`` bound
-  applies only to MP middle ranks and belongs to the pipeline runner.
+  executor's deterministic schedule. The reference's ``max_activation_in_cpu``
+  bound (which *blocks* a producer thread) becomes ``max_in_cpu`` here: once
+  that many prompts' activations are resident in host RAM, further blocks
+  spill to disk — same bound, no deadlock under a single-driver schedule.
 - ``tpu`` keeps activations as device arrays; ``cpu`` uses
   ``jax.device_get`` (async transfer flushed at store time); ``disk`` writes
   float32-preserving raw dtypes via numpy.
@@ -46,6 +45,7 @@ class ActivationStore:
         disk_folder: str = "./temp",
         device_rank: int = 0,
         rank_tag: bool = False,
+        max_in_cpu: int | None = None,
     ):
         if location not in ("tpu", "cpu", "disk"):
             raise ValueError(f"storage_location must be tpu|cpu|disk, got {location!r}")
@@ -55,6 +55,15 @@ class ActivationStore:
         # (/root/reference/utils.py:172): rank_tag mirrors that.
         self.tag = str(device_rank) if rank_tag else ""
         self._mem: dict[object, tuple] = {}
+        # cpu-mode bound (reference's max_activation_in_cpu backpressure,
+        # /root/reference/utils.py:179-180): at most this many prompts' worth
+        # of activations stay in host RAM; overflow blocks spill to disk.
+        # The reference *blocks* a producer thread; here the schedule is
+        # deterministic single-driver, so spilling is the non-deadlocking
+        # equivalent of the same bound.
+        self.max_in_cpu = max_in_cpu
+        self._cpu_prompts = 0
+        self._spilled: set[object] = set()
         if location == "disk":
             os.makedirs(disk_folder, exist_ok=True)
 
@@ -66,32 +75,17 @@ class ActivationStore:
         )
 
     # -- block API ---------------------------------------------------------
-    def store(self, block_id, prompt_idxs: list[int], prefix_h, suffix_h) -> None:
-        if self.location == "tpu":
-            self._mem[block_id] = (prefix_h, suffix_h)
-        elif self.location == "cpu":
-            pair = (
-                None if prefix_h is None else jax.device_get(prefix_h),
-                jax.device_get(suffix_h),
-            )
-            self._mem[block_id] = pair
-        else:  # disk — one file pair per prompt, reference contract
-            prefix_np = None if prefix_h is None else np.asarray(jax.device_get(prefix_h))
-            suffix_np = np.asarray(jax.device_get(suffix_h))
-            for row, idx in enumerate(prompt_idxs):
-                ppath, spath = self._paths(idx)
-                np.save(spath, suffix_np[row])
-                if prefix_np is not None:
-                    np.save(ppath, prefix_np[row])
+    def _store_disk(self, prompt_idxs: list[int], prefix_h, suffix_h) -> None:
+        os.makedirs(self.disk_folder, exist_ok=True)
+        prefix_np = None if prefix_h is None else np.asarray(jax.device_get(prefix_h))
+        suffix_np = np.asarray(jax.device_get(suffix_h))
+        for row, idx in enumerate(prompt_idxs):
+            ppath, spath = self._paths(idx)
+            np.save(spath, suffix_np[row])
+            if prefix_np is not None:
+                np.save(ppath, prefix_np[row])
 
-    def fetch(self, block_id, prompt_idxs: list[int], with_prefix: bool = True):
-        """Returns (prefix_h | None, suffix_h) as host or device arrays; the
-        executor device_puts them as part of the next shard's input feed."""
-        if self.location in ("tpu", "cpu"):
-            prefix, suffix = self._mem.pop(block_id)
-            if not with_prefix:
-                prefix = None
-            return prefix, suffix
+    def _fetch_disk(self, prompt_idxs: list[int], with_prefix: bool):
         prefixes, suffixes = [], []
         for idx in prompt_idxs:
             ppath, spath = self._paths(idx)
@@ -102,8 +96,47 @@ class ActivationStore:
         prefix = np.stack(prefixes) if with_prefix else None
         return prefix, suffix
 
+    def store(self, block_id, prompt_idxs: list[int], prefix_h, suffix_h) -> None:
+        if self.location == "tpu":
+            self._mem[block_id] = (prefix_h, suffix_h)
+        elif self.location == "cpu":
+            over = (
+                self.max_in_cpu is not None
+                and self._cpu_prompts + len(prompt_idxs) > self.max_in_cpu
+                and block_id not in self._mem  # re-stores keep their slot
+            )
+            if over:
+                self._spilled.add(block_id)
+                self._store_disk(prompt_idxs, prefix_h, suffix_h)
+                return
+            if block_id not in self._mem:
+                self._cpu_prompts += len(prompt_idxs)
+            self._mem[block_id] = (
+                None if prefix_h is None else jax.device_get(prefix_h),
+                jax.device_get(suffix_h),
+            )
+        else:  # disk — one file pair per prompt, reference contract
+            self._store_disk(prompt_idxs, prefix_h, suffix_h)
+
+    def fetch(self, block_id, prompt_idxs: list[int], with_prefix: bool = True):
+        """Returns (prefix_h | None, suffix_h) as host or device arrays; the
+        executor device_puts them as part of the next shard's input feed."""
+        if self.location == "cpu" and block_id in self._spilled:
+            self._spilled.discard(block_id)
+            return self._fetch_disk(prompt_idxs, with_prefix)
+        if self.location in ("tpu", "cpu"):
+            prefix, suffix = self._mem.pop(block_id)
+            if self.location == "cpu":
+                self._cpu_prompts -= len(prompt_idxs)
+            if not with_prefix:
+                prefix = None
+            return prefix, suffix
+        return self._fetch_disk(prompt_idxs, with_prefix)
+
     def clear(self) -> None:
         self._mem.clear()
+        self._spilled.clear()
+        self._cpu_prompts = 0
 
 
 __all__ = ["ActivationStore"]

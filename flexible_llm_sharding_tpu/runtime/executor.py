@@ -102,6 +102,98 @@ def _head_block(head_params, suffix_h):
     return jax.vmap(llama.lm_head_scores, in_axes=(None, 0))(head_params, suffix_h)
 
 
+def process_block(
+    model_cfg: LlamaConfig,
+    dtype,
+    segments,
+    layer_idxs,
+    n_layers: int,
+    store,
+    b: int,
+    idxs,
+    meta,
+    device,
+    toks,
+    scores: dict,
+):
+    """Run one shard over one block: fetch its activations (unless this shard
+    starts at the embed layer), apply the segments, scatter any head scores,
+    and store activations for the next shard. The per-block body shared by
+    the single-device executor and the MP pipeline runner — the subtle
+    invariants (prefix states end at the last decoder = index n_layers-3;
+    nothing is stored after the final layer; score rows truncate to the true
+    suffix count) live only here.
+
+    Returns the block's suffix activations (device array) for optional
+    synchronisation by the caller.
+    """
+    first, last = layer_idxs[0], layer_idxs[-1]
+    prefix_ids, suffix_ids, prefix_len, suffix_eos = meta
+    if first == 0:
+        prefix_h, suffix_h = None, None  # produced by the embed segment
+    else:
+        with_prefix = first <= n_layers - 3
+        prefix_h, suffix_h = store.fetch(b, idxs, with_prefix=with_prefix)
+        # Host->HBM upload, or the chip-to-chip ICI hop in pipeline mode.
+        suffix_h = jax.device_put(suffix_h, device)
+        if prefix_h is not None:
+            prefix_h = jax.device_put(prefix_h, device)
+
+    prefix_h, suffix_h, block_scores = apply_segments(
+        model_cfg,
+        dtype,
+        segments,
+        prefix_h,
+        suffix_h,
+        prefix_ids,
+        suffix_ids,
+        prefix_len,
+        suffix_eos,
+    )
+    if block_scores is not None:
+        for row, i in enumerate(idxs):
+            s_true = toks[i].num_suffixes
+            scores[i] = np.expand_dims(block_scores[row, :s_true], axis=1)
+    if last != n_layers - 1:
+        store.store(b, idxs, prefix_h, suffix_h)
+    return suffix_h
+
+
+def apply_segments(
+    model_cfg: LlamaConfig,
+    dtype,
+    segments,
+    prefix_h,
+    suffix_h,
+    prefix_ids,
+    suffix_ids,
+    prefix_len,
+    suffix_eos,
+):
+    """Run one shard's segments over a block.
+
+    Returns (prefix_h, suffix_h, block_scores) where block_scores is the
+    float32 [B, S, V] host array if this shard contained the lm_head, else
+    None. Shared by the single-device executor and the MP pipeline runner.
+    """
+    block_scores = None
+    for kind, params in segments:
+        if kind == "embed":
+            prefix_h, suffix_h = _embed_block(
+                model_cfg, dtype, params, prefix_ids, suffix_ids
+            )
+        elif kind == "decoders":
+            prefix_h, suffix_h = _decoder_block(
+                model_cfg, params, prefix_h, suffix_h, prefix_len
+            )
+        elif kind == "norm":
+            suffix_h = _norm_block(model_cfg, params, suffix_h, suffix_eos)
+            prefix_h = None
+        else:  # head
+            block_scores = np.asarray(jax.device_get(_head_block(params, suffix_h)))
+    return prefix_h, suffix_h, block_scores
+
+
 # ---------------------------------------------------------------------------
 # Shard weight source (sync or prefetching)
 # ---------------------------------------------------------------------------
@@ -130,12 +222,21 @@ class ShardWeightSource:
         device=None,
         prefetch_depth: int = 1,
         tied_embeddings: bool = False,
+        devices: Sequence | None = None,
     ):
         self.model_path = model_path
         self.layer_names = list(layer_names)
         self.shards = list(shards)
         self.np_dtype = np_dtype
-        self.device = device
+        # Either one device for every shard, or (pipeline mode) one target
+        # device per shard — shard t's weights upload straight to its stage's
+        # chip while stage t-1 computes elsewhere.
+        if devices is not None:
+            if len(devices) != len(self.shards):
+                raise ValueError("devices must align 1:1 with shards")
+            self.shard_devices = list(devices)
+        else:
+            self.shard_devices = [device] * len(self.shards)
         self.tied = tied_embeddings
         self.load_time = 0.0  # host-side file->numpy time (cf. load_weights_time)
         self._q: Queue = Queue(maxsize=max(1, prefetch_depth))
@@ -177,7 +278,9 @@ class ShardWeightSource:
             tree,
         )
 
-    def _build_shard(self, layer_idxs: tuple[int, ...]) -> list[tuple[str, Any]]:
+    def _build_shard(
+        self, layer_idxs: tuple[int, ...], device
+    ) -> list[tuple[str, Any]]:
         """Group a shard's layers into segments: contiguous decoder runs are
         stacked for scan; embed/norm/head are singleton segments."""
         segments: list[tuple[str, Any]] = []
@@ -206,7 +309,7 @@ class ShardWeightSource:
         flush()
         self.load_time += time.perf_counter() - t0
         return [
-            (kind, jax.device_put(p, self.device) if self.device else jax.device_put(p))
+            (kind, jax.device_put(p, device) if device else jax.device_put(p))
             for kind, p in segments
         ]
 
@@ -223,11 +326,11 @@ class ShardWeightSource:
         return False
 
     def _producer(self):
-        for idxs in self.shards:
+        for idxs, dev in zip(self.shards, self.shard_devices):
             if self._stop.is_set():
                 return
             try:
-                item = self._build_shard(idxs)
+                item = self._build_shard(idxs, dev)
             except Exception as e:  # surfaced on the consumer side
                 self._put(e)
                 return
@@ -236,8 +339,8 @@ class ShardWeightSource:
 
     def __iter__(self):
         if self._thread is None:
-            for idxs in self.shards:
-                yield idxs, self._build_shard(idxs)
+            for idxs, dev in zip(self.shards, self.shard_devices):
+                yield idxs, self._build_shard(idxs, dev)
         else:
             for idxs in self.shards:
                 item = self._q.get()
@@ -288,15 +391,18 @@ class StreamingExecutor:
         self.plan = plan or plan_shards_dp(
             len(self.layer_names), cfg.layer_num_per_shard
         )
-        # This executor streams every layer itself; a plan that skips layers
-        # (an MP stage plan) needs the pipeline orchestrator's cross-device
-        # activation handoff, which this class does not do.
-        covered = sorted(i for s in self.plan.shards for i in s)
-        if covered != list(range(len(self.layer_names))):
+        # This executor streams every layer itself, in order; a plan that
+        # skips or reorders layers (an MP stage plan) needs the pipeline
+        # runner's cross-device activation handoff, which this class does not
+        # do. Order matters: activations for shard k+1 only exist after
+        # shard k ran, so `covered` is compared UNSORTED, and empty shards
+        # (MP round-up padding) are rejected too.
+        covered = [i for s in self.plan.shards for i in s]
+        if covered != list(range(len(self.layer_names))) or not all(self.plan.shards):
             raise ValueError(
-                "StreamingExecutor requires a plan covering all layers "
-                "contiguously (DP/single-device); use the MP pipeline runner "
-                "for interleaved stage plans"
+                "StreamingExecutor requires a plan covering all layers in "
+                "order with no empty shards (DP/single-device); use the MP "
+                "pipeline runner for interleaved stage plans"
             )
         self.stats: dict[str, float] = {}
 
@@ -317,6 +423,7 @@ class StreamingExecutor:
             self.cfg.disk_folder,
             device_rank=self.plan.device_rank,
             rank_tag=self.plan.num_devices > 1 and self.cfg.data_parallel,
+            max_in_cpu=self.cfg.max_activation_in_cpu,
         )
         source = ShardWeightSource(
             self.cfg.model_path,
@@ -361,50 +468,25 @@ class StreamingExecutor:
         compute_time = 0.0
         for layer_idxs, segments in source:
             t0 = time.perf_counter()
-            first, last = layer_idxs[0], layer_idxs[-1]
             for b, idxs in enumerate(blocks):
-                prefix_ids, suffix_ids, prefix_len, suffix_eos = block_meta[b]
-                if first == 0:
-                    prefix_h, suffix_h = None, None  # produced by embed segment
-                else:
-                    # Prefix states are only consumed by decoder layers; the
-                    # last decoder is index n_layers-3 (norm = -2, head = -1).
-                    with_prefix = first <= n_layers - 3
-                    prefix_h, suffix_h = store.fetch(b, idxs, with_prefix=with_prefix)
-                    suffix_h = jax.device_put(suffix_h, self.device)
-                    if prefix_h is not None:
-                        prefix_h = jax.device_put(prefix_h, self.device)
-
-                for kind, params in segments:
-                    if kind == "embed":
-                        prefix_h, suffix_h = _embed_block(
-                            self.model_cfg, self.dtype, params, prefix_ids, suffix_ids
-                        )
-                    elif kind == "decoders":
-                        prefix_h, suffix_h = _decoder_block(
-                            self.model_cfg, params, prefix_h, suffix_h, prefix_len
-                        )
-                    elif kind == "norm":
-                        suffix_h = _norm_block(
-                            self.model_cfg, params, suffix_h, suffix_eos
-                        )
-                        prefix_h = None
-                    else:  # head
-                        block_scores = np.asarray(
-                            jax.device_get(_head_block(params, suffix_h))
-                        )
-                        for row, i in enumerate(idxs):
-                            s_true = toks[i].num_suffixes
-                            scores[i] = np.expand_dims(
-                                block_scores[row, :s_true], axis=1
-                            )
-
-                if last != n_layers - 1:
-                    store.store(b, idxs, prefix_h, suffix_h)
+                suffix_h = process_block(
+                    self.model_cfg,
+                    self.dtype,
+                    segments,
+                    layer_idxs,
+                    n_layers,
+                    store,
+                    b,
+                    idxs,
+                    block_meta[b],
+                    self.device,
+                    toks,
+                    scores,
+                )
             # cpu/disk stores already synced via device_get; for tpu storage
             # block once per shard so compute_wall_s measures device time (the
             # prefetch thread keeps uploading the next shard concurrently).
-            if last != n_layers - 1 and self.cfg.storage_location == "tpu":
+            if layer_idxs[-1] != n_layers - 1 and self.cfg.storage_location == "tpu":
                 jax.block_until_ready(suffix_h)
             compute_time += time.perf_counter() - t0
         return compute_time

@@ -1,0 +1,67 @@
+"""Greedy multi-token generation over the streaming scorer.
+
+Reference semantics (``/root/reference/main.py:63-90``) reproduced exactly:
+each of ``num_gen_token`` iterations re-runs the full sharded scoring pass on
+the *current* prompts; iteration scores are concatenated along axis 1, so each
+prompt accumulates ``[n_suffixes, num_gen_token, vocab]``; after every
+iteration each suffix is rebuilt as the ORIGINAL suffix string plus the decode
+of the argmax token history so far (greedy only — the reference's
+``--temperature`` flag is commented out, ``/root/reference/main.py:47-48``).
+
+The known scaling cliff is inherited deliberately (SURVEY.md §3.5): per-token
+cost equals full-prompt cost because no KV survives between tokens — the
+streaming design trades that for the tiny-HBM capability.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Callable, Sequence
+
+import numpy as np
+
+Prompt = tuple[str, tuple[str, ...]]
+RunFn = Callable[[list[Prompt]], list[np.ndarray]]
+
+
+def generation_loop(
+    run_fn: RunFn,
+    prompts: Sequence[Prompt],
+    num_gen_token: int,
+    tokenizer,
+) -> tuple[list[np.ndarray], list[Prompt]]:
+    """Run ``num_gen_token`` greedy decode iterations.
+
+    run_fn: scores the current prompts -> one ``[n_suffixes, 1, vocab]``
+    float array per prompt (a single executor, or a multi-device fan-out).
+    Returns (per-prompt ``[n_suffixes, num_gen_token, vocab]`` scores,
+    updated prompts with generated text appended to each suffix).
+    """
+    original = list(prompts)
+    current: list[Prompt] = copy.deepcopy(original)
+    output_scores: list[np.ndarray] = []
+
+    for i_new in range(num_gen_token):
+        outputs = run_fn(current)
+        if i_new == 0:
+            output_scores = list(outputs)
+        else:
+            output_scores = [
+                np.concatenate((old, new), axis=1)
+                for old, new in zip(output_scores, outputs)
+            ]
+        # Rebuild suffixes from the ORIGINAL prompt plus the decoded argmax
+        # history (/root/reference/main.py:85-90).
+        for p_idx, (prefix, suffix) in enumerate(original):
+            new_tokens = np.argmax(output_scores[p_idx], axis=-1)  # [S, i+1]
+            current[p_idx] = (
+                prefix,
+                tuple(
+                    s + tokenizer.decode(t) for s, t in zip(suffix, new_tokens)
+                ),
+            )
+
+    return output_scores, current
+
+
+__all__ = ["generation_loop"]

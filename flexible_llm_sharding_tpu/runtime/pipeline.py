@@ -1,0 +1,174 @@
+"""MP mode: the interleaved layer pipeline across the chips of a slice.
+
+Reference semantics (``/root/reference/utils.py:151-157,189-213`` and the
+``multigpu_flexibility.png`` diagram): contiguous layer shards are assigned
+round-robin to devices (shard k -> device k % N), and a prompt's activations
+hop device-to-device between stages. The reference coordinates this with
+Python threads, a shared activation dict, a ``prompt2layer`` progress table
+polled at 1-second granularity, and (in disk mode) ``.npy`` files as the
+wrap-around transport from the last rank back to rank 0.
+
+TPU-native redesign (SURVEY.md §2.3, §7):
+
+- One host thread drives ALL stages in global execution order; there is no
+  polling control plane. Pipeline concurrency is *emergent from XLA's async
+  dispatch*: the host enqueues stage s+1's jitted call on chip B as soon as
+  stage s's output on chip A is dispatched (not completed); the runtime
+  orders them by data dependency, so chip A computes block b+1 while chip B
+  computes block b — the reference's per-prompt pipelining without a single
+  lock or sleep.
+- Activation hops are ``jax.device_put`` of device-resident arrays —
+  chip-to-chip DMA over ICI (``storage_location='tpu'``), never staged
+  through host RAM the way the reference's ``.cpu()``/``.to(device)`` pairs
+  are. ``cpu``/``disk`` modes keep the reference's host/disk transports
+  (including the per-prompt ``.npy`` file contract for resumability).
+- Weights for stage t+1 upload to *that stage's chip* while stage t computes
+  (per-shard target devices in ShardWeightSource), so weight streaming and
+  compute overlap across the whole pipeline.
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import numpy as np
+
+from flexible_llm_sharding_tpu.config import FrameworkConfig, LlamaConfig
+from flexible_llm_sharding_tpu.parallel.planner import (
+    batch_ranges,
+    global_stage_order,
+)
+from flexible_llm_sharding_tpu.runtime.activations import ActivationStore
+from flexible_llm_sharding_tpu.runtime.executor import (
+    ShardWeightSource,
+    _DTYPES,
+    process_block,
+)
+from flexible_llm_sharding_tpu.runtime.tokenization import PromptTokenizer, make_blocks
+from flexible_llm_sharding_tpu.utils import checkpoint
+
+
+class PipelineRunner:
+    """Drives one full scoring pass through the interleaved stage pipeline."""
+
+    def __init__(self, cfg: FrameworkConfig, devices, tokenizer=None):
+        self.cfg = cfg
+        self.devices = list(devices)
+        self.model_cfg = LlamaConfig.from_pretrained(cfg.model_path)
+        self.dtype = _DTYPES[cfg.dtype]
+        if tokenizer is None:
+            from transformers import AutoTokenizer
+
+            tokenizer = AutoTokenizer.from_pretrained(cfg.model_path)
+        self.tokenizer = PromptTokenizer(
+            tokenizer,
+            max_token_len=cfg.max_token_len,
+            bucket_multiple=cfg.bucket_multiple,
+        )
+        self.layer_names = checkpoint.layer_names_for(
+            self.model_cfg.num_hidden_layers, tie_word_embeddings=False
+        )
+        # (stage_idx, device_rank, layer_tuple) in execution order.
+        self.stages = global_stage_order(
+            len(self.layer_names), cfg.layer_num_per_shard, len(self.devices)
+        )
+        self.stats: dict[str, float] = {}
+
+    @property
+    def _np_dtype(self):
+        import jax.numpy as jnp
+
+        return np.dtype(jnp.dtype(self.dtype).name)
+
+    def __call__(self, prompts) -> list[np.ndarray]:
+        out: list[np.ndarray] = []
+        for lo, hi in batch_ranges(len(prompts), self.cfg.num_batch):
+            out += self._run_batch(prompts[lo:hi])
+        return out
+
+    def _run_batch(self, prompts) -> list[np.ndarray]:
+        t_start = time.perf_counter()
+        toks = [self.tokenizer(p, s) for p, s in prompts]
+        blocks = make_blocks(toks, self.cfg.block_size)
+        store = ActivationStore(
+            self.cfg.storage_location,
+            self.cfg.disk_folder,
+            max_in_cpu=self.cfg.max_activation_in_cpu,
+        )
+        stage_shards = [s for (_, _, s) in self.stages]
+        stage_devs = [self.devices[r] for (_, r, _) in self.stages]
+        source = ShardWeightSource(
+            self.cfg.model_path,
+            self.layer_names,
+            stage_shards,
+            self._np_dtype,
+            devices=stage_devs,
+            prefetch_depth=self.cfg.prefetch_depth,
+            tied_embeddings=self.model_cfg.tie_word_embeddings,
+        )
+
+        n_layers = len(self.layer_names)
+        scores: dict[int, np.ndarray] = {}
+        # Block metadata is uploaded per device on first use (jit operands
+        # must be colocated with that stage's weights).
+        host_meta = {
+            b: (
+                np.stack([toks[i].prefix_ids for i in idxs]),
+                np.stack([toks[i].suffix_ids for i in idxs]),
+                np.array([toks[i].prefix_len for i in idxs], dtype=np.int32),
+                np.stack([toks[i].suffix_eos for i in idxs]),
+            )
+            for b, idxs in enumerate(blocks)
+        }
+        dev_meta: dict[tuple[int, int], tuple] = {}
+
+        def meta_on(b: int, dev) -> tuple:
+            key = (b, id(dev))
+            if key not in dev_meta:
+                dev_meta[key] = tuple(
+                    jax.device_put(a, dev) for a in host_meta[b]
+                )
+            return dev_meta[key]
+
+        try:
+            for ((stage_idx, rank, layer_idxs), (_, segments)) in zip(
+                self.stages, source
+            ):
+                if not layer_idxs:  # round-up padding stage
+                    continue
+                dev = self.devices[rank]
+                for b, idxs in enumerate(blocks):
+                    process_block(
+                        self.model_cfg,
+                        self.dtype,
+                        segments,
+                        layer_idxs,
+                        n_layers,
+                        store,
+                        b,
+                        idxs,
+                        meta_on(b, dev),
+                        dev,
+                        toks,
+                        scores,
+                    )
+        finally:
+            source.close()
+
+        self.stats = {
+            "load_weights_time_s": source.load_time,
+            "total_wall_s": time.perf_counter() - t_start,
+            "num_stages": float(len(self.stages)),
+        }
+        store.clear()
+        return [scores[i] for i in range(len(prompts))]
+
+
+def run_pipeline(
+    cfg: FrameworkConfig, prompts, devices, tokenizer=None
+) -> list[np.ndarray]:
+    return PipelineRunner(cfg, devices, tokenizer=tokenizer)(list(prompts))
+
+
+__all__ = ["PipelineRunner", "run_pipeline"]

@@ -1,0 +1,8 @@
+"""CLI entry point — mirrors the reference's ``python main.py`` invocation
+(``/root/reference/main.py:28``). The implementation lives in
+``flexible_llm_sharding_tpu.cli``."""
+
+from flexible_llm_sharding_tpu.cli import main
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,178 @@
+"""DP fan-out, MP pipeline, generation loop, and CLI end-to-end — all on the
+8 virtual CPU devices (SURVEY.md §4: distributed without a cluster)."""
+
+import pickle
+
+import numpy as np
+import pytest
+
+import jax
+
+from flexible_llm_sharding_tpu.config import FrameworkConfig
+from flexible_llm_sharding_tpu.models import llama
+from flexible_llm_sharding_tpu.runtime.generation import generation_loop
+from flexible_llm_sharding_tpu.runtime.orchestration import run_prompts
+from flexible_llm_sharding_tpu.runtime.pipeline import run_pipeline
+from flexible_llm_sharding_tpu.utils.checkpoint import save_params
+
+from tests.fake_tokenizer import FakeTokenizer
+
+PROMPTS = [
+    ("The capital of France", (" is Paris", " is Rome", " might be Lyon")),
+    ("Water boils", (" at 100C", " when heated to its boiling point")),
+    ("Two plus two equals", (" four", " five", " twenty-two", " fish")),
+    ("The sky is", (" blue", " green")),
+    ("One two three", (" four five", " six")),
+]
+
+
+@pytest.fixture(scope="module")
+def model_dir(tiny_cfg, tmp_path_factory):
+    params = llama.init_params(jax.random.PRNGKey(0), tiny_cfg)
+    d = tmp_path_factory.mktemp("tiny_model_orch")
+    save_params(jax.tree.map(np.asarray, params), str(d), tiny_cfg)
+    return str(d)
+
+
+def _cfg(model_dir, **kw):
+    base = dict(
+        model_path=model_dir,
+        layer_num_per_shard=1,
+        storage_location="cpu",
+        dtype="float32",
+        bucket_multiple=8,
+        block_size=2,
+        prefetch_depth=0,
+    )
+    base.update(kw)
+    return FrameworkConfig(**base)
+
+
+@pytest.fixture(scope="module")
+def single_device_scores(model_dir):
+    cfg = _cfg(model_dir)
+    return run_prompts(cfg, PROMPTS, tokenizer=FakeTokenizer(), devices=jax.devices()[:1])
+
+
+def test_dp_matches_single_device(model_dir, single_device_scores):
+    cfg = _cfg(model_dir, data_parallel=True)
+    got = run_prompts(cfg, PROMPTS, tokenizer=FakeTokenizer(), devices=jax.devices()[:3])
+    assert len(got) == len(PROMPTS)
+    for g, w in zip(got, single_device_scores):
+        np.testing.assert_allclose(g, w, rtol=1e-4, atol=1e-5)
+
+
+def test_dp_more_devices_than_prompts(model_dir, single_device_scores):
+    cfg = _cfg(model_dir, data_parallel=True)
+    got = run_prompts(
+        cfg, PROMPTS[:2], tokenizer=FakeTokenizer(), devices=jax.devices()[:8]
+    )
+    for g, w in zip(got, single_device_scores[:2]):
+        np.testing.assert_allclose(g, w, rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("storage", ["tpu", "cpu", "disk"])
+@pytest.mark.parametrize("n_dev", [2, 3])
+def test_pipeline_matches_single_device(
+    model_dir, single_device_scores, storage, n_dev, tmp_path
+):
+    cfg = _cfg(
+        model_dir,
+        storage_location=storage,
+        disk_folder=str(tmp_path / "acts"),
+        layer_num_per_shard=2,
+        prefetch_depth=1,
+    )
+    got = run_pipeline(
+        cfg, PROMPTS, jax.devices()[:n_dev], tokenizer=FakeTokenizer()
+    )
+    assert len(got) == len(PROMPTS)
+    for g, w in zip(got, single_device_scores):
+        np.testing.assert_allclose(g, w, rtol=1e-4, atol=1e-5)
+
+
+def test_pipeline_num_batch(model_dir, single_device_scores):
+    cfg = _cfg(model_dir, layer_num_per_shard=3, num_batch=2)
+    got = run_pipeline(cfg, PROMPTS, jax.devices()[:2], tokenizer=FakeTokenizer())
+    for g, w in zip(got, single_device_scores):
+        np.testing.assert_allclose(g, w, rtol=1e-4, atol=1e-5)
+
+
+def test_cpu_spill_bound(model_dir, single_device_scores, tmp_path):
+    """max_activation_in_cpu: overflow blocks spill to disk, scores unchanged."""
+    cfg = _cfg(
+        model_dir,
+        max_activation_in_cpu=2,  # < 5 prompts -> forces spill
+        disk_folder=str(tmp_path / "spill"),
+    )
+    got = run_prompts(cfg, PROMPTS, tokenizer=FakeTokenizer(), devices=jax.devices()[:1])
+    for g, w in zip(got, single_device_scores):
+        np.testing.assert_allclose(g, w, rtol=1e-4, atol=1e-5)
+    assert (tmp_path / "spill").exists()  # spill actually happened
+
+
+def test_executor_rejects_bad_plans(model_dir):
+    from flexible_llm_sharding_tpu.parallel.planner import ShardPlan
+    from flexible_llm_sharding_tpu.runtime.executor import StreamingExecutor
+
+    cfg = _cfg(model_dir)
+    n = 7  # tiny model: embed + 4 layers + norm + head
+    good = StreamingExecutor(cfg, tokenizer=FakeTokenizer())
+    assert len(good.layer_names) == n
+    for shards in [
+        ((2, 3), (0, 1), (4, 5, 6)),  # out of order
+        ((0, 1), (), (2, 3, 4, 5, 6)),  # empty shard
+        ((0, 1), (4, 5, 6)),  # gap
+    ]:
+        with pytest.raises(ValueError):
+            StreamingExecutor(
+                cfg,
+                plan=ShardPlan(shards=shards, n_layers=n),
+                tokenizer=FakeTokenizer(),
+            )
+
+
+def test_generation_loop_semantics(model_dir):
+    """Greedy loop: scores accumulate on axis 1; suffixes grow from the
+    ORIGINAL prompt + decoded argmax history (ref main.py:85-90)."""
+    cfg = _cfg(model_dir)
+    tok = FakeTokenizer()
+    run = lambda ps: run_prompts(cfg, ps, tokenizer=tok, devices=jax.devices()[:1])
+    prompts = PROMPTS[:2]
+    scores, updated = generation_loop(run, prompts, 3, tok)
+    for (prefix, sfx), sc, (uprefix, usfx) in zip(prompts, scores, updated):
+        assert sc.shape == (len(sfx), 3, 256)
+        assert uprefix == prefix
+        for orig, new in zip(sfx, usfx):
+            assert new.startswith(orig) and len(new) > len(orig)
+
+
+def test_cli_end_to_end(model_dir, tmp_path):
+    from flexible_llm_sharding_tpu.cli import main
+
+    ppkl = tmp_path / "prompts.pkl"
+    opkl = tmp_path / "scores.pkl"
+    with open(ppkl, "wb") as f:
+        pickle.dump(PROMPTS[:2], f)
+    main(
+        [
+            "--model_path", model_dir,
+            "--prompt_pickle", str(ppkl),
+            "--output_file", str(opkl),
+            "--num_gen_token", "2",
+            "--dtype", "float32",
+            "--num_devices", "1",
+        ],
+        tokenizer=FakeTokenizer(),
+    )
+    with open(opkl, "rb") as f:
+        scores = pickle.load(f)
+    assert len(scores) == 2
+    assert scores[0].shape == (3, 2, 256)
+    with open(tmp_path / "prompts_updated.pkl", "rb") as f:
+        updated = pickle.load(f)
+    assert all(
+        new.startswith(orig)
+        for (_, sfx), (_, usfx) in zip(PROMPTS[:2], updated)
+        for orig, new in zip(sfx, usfx)
+    )
