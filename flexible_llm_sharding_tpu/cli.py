@@ -61,6 +61,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--prefetch_depth", type=int, default=1)
     p.add_argument("--num_devices", type=int, default=0, help="0 = all visible chips")
     p.add_argument("--max_token_len", type=int, default=DEFAULT_MAX_TOKEN_LEN)
+    p.add_argument("--use_pallas", type=_str2bool, default=False,
+                   help="use Pallas flash-attention kernels where shapes allow")
     return p
 
 
@@ -79,6 +81,7 @@ def config_from_args(args: argparse.Namespace) -> FrameworkConfig:
         block_size=args.block_size,
         prefetch_depth=args.prefetch_depth,
         num_devices=args.num_devices,
+        use_pallas=args.use_pallas,
     )
 
 

@@ -46,6 +46,7 @@ import jax.numpy as jnp
 
 from flexible_llm_sharding_tpu.config import LlamaConfig
 from flexible_llm_sharding_tpu.ops import apply_rope, attention, rms_norm, rope_cos_sin
+from flexible_llm_sharding_tpu.ops import pallas_attention
 from flexible_llm_sharding_tpu.ops.attention import causal_mask, prefix_shared_attention
 
 Params = dict[str, Any]
@@ -116,6 +117,7 @@ def prefix_suffix_layer(
     prefix_h: jax.Array,
     suffix_h: jax.Array,
     prefix_len: jax.Array,
+    use_pallas: bool = False,
 ) -> tuple[jax.Array, jax.Array]:
     """One decoder layer over a (prefix, suffixes) prompt — the streaming hot op.
 
@@ -129,17 +131,29 @@ def prefix_suffix_layer(
     shared across all S suffixes; each suffix token attends to every real
     prefix position plus causally within its own suffix, at rotary positions
     ``prefix_len + i``.
+
+    ``use_pallas`` (static) swaps both attention ops for the Pallas flash
+    kernels (ops/pallas_attention.py) when the shapes are eligible — same
+    semantics, no [Lq, Lk] score materialisation.
     """
     lp, _ = prefix_h.shape
     s, ls, _ = suffix_h.shape
     eps = cfg.rms_norm_eps
+    flash = use_pallas and pallas_attention.supports(
+        cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim, ls, lp
+    )
 
     # --- prefix: causal self-attention, keep post-RoPE KV ---
     h = rms_norm(prefix_h, params["input_layernorm"]["scale"], eps)
     q, k, v = _qkv(params["attn"], cfg, h)
     cos, sin = rope_cos_sin(jnp.arange(lp), cfg.head_dim, cfg.rope_theta)
     q, k = apply_rope(q, cos, sin), apply_rope(k, cos, sin)
-    attn_out = attention(q, k, v, causal_mask(lp, lp))
+    if flash:
+        # Rows at i >= prefix_len are padding; the kernel's valid-len mask
+        # additionally skips fully-masked KV blocks.
+        attn_out = pallas_attention.flash_causal_attention(q, k, v, prefix_len)
+    else:
+        attn_out = attention(q, k, v, causal_mask(lp, lp))
     prefix_mid = prefix_h + _out_proj(params["attn"], attn_out)
     h = rms_norm(prefix_mid, params["post_attention_layernorm"]["scale"], eps)
     prefix_out = prefix_mid + _mlp(params["mlp"], h)
@@ -152,7 +166,12 @@ def prefix_suffix_layer(
     cos_s, sin_s = rope_cos_sin(pos_s, cfg.head_dim, cfg.rope_theta)
     qs, ks = apply_rope(qs, cos_s, sin_s), apply_rope(ks, cos_s, sin_s)
 
-    attn_s = prefix_shared_attention(qs, k, v, ks, vs, prefix_len)
+    if flash:
+        attn_s = pallas_attention.flash_prefix_shared_attention(
+            qs, k, v, ks, vs, prefix_len
+        )
+    else:
+        attn_s = prefix_shared_attention(qs, k, v, ks, vs, prefix_len)
     suffix_mid = suffix_h + _out_proj(params["attn"], attn_s)
     hs = rms_norm(suffix_mid, params["post_attention_layernorm"]["scale"], eps)
     suffix_out = suffix_mid + _mlp(params["mlp"], hs)

@@ -1,0 +1,268 @@
+"""Pallas TPU flash-attention kernels for the streaming scorer's hot ops.
+
+The XLA path (ops/attention.py) materialises the [Lq, Lk] score matrix in
+fp32; at the reference's 4096-token cap that is 64 MB per head — far over
+VMEM — so XLA spills it to HBM and the op becomes bandwidth-bound. These
+kernels stream KV through VMEM in blocks with an online softmax (flash
+attention), so scores never leave VMEM and the op stays MXU-bound.
+
+Two kernels, sharing one inner block routine:
+
+- :func:`flash_causal_attention` — causal self-attention with a dynamic
+  valid-length (the prefix pass of ``llama.prefix_suffix_layer``;
+  reference semantics ``/root/reference/utils.py:270-274``).
+- :func:`flash_prefix_shared_attention` — S suffix continuations attending
+  to [shared prefix KV ; own causal KV] with a joint softmax, the kernel
+  form of ``ops.attention.prefix_shared_attention`` (the reference's KV
+  ``.expand`` trick, ``/root/reference/utils.py:272-279``). The prefix KV
+  block is read per (suffix, head, q-block) program straight from HBM-fed
+  VMEM blocks — never copied S times into a concatenated buffer.
+
+Both operate on one head per program (grid dims pick the head and q block);
+GQA is handled by the KV index map (query head h reads KV head
+``h * n_kv // n_q``), so KV heads are never replicated. Inputs keep the
+model dtype (bf16 on the MXU); softmax runs in fp32 VMEM accumulators.
+
+Shape eligibility is checked by :func:`supports`; callers fall back to the
+XLA path otherwise (tiny test models, ragged head dims).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -0.7 * float(jnp.finfo(jnp.float32).max)
+
+_MAX_BLOCK_K = 512  # keys streamed through VMEM per flash step
+_MAX_BLOCK_Q = 128  # query rows per program
+
+
+def _block(n: int, cap: int) -> int:
+    """Largest power-of-two-ish tile <= cap that divides n (n % 64 == 0
+    callers guaranteed by supports(); fall back to n itself)."""
+    for b in (cap, 256, 128, 64):
+        if b <= cap and n % b == 0:
+            return b
+    return n
+
+
+def supports(n_q: int, n_kv: int, head_dim: int, lq: int, lk: int) -> bool:
+    """Kernel eligibility: MXU-aligned head_dim, bucketed q/k lengths."""
+    return (
+        head_dim % 128 == 0
+        and n_q % n_kv == 0
+        and lq % 64 == 0
+        and lk % 64 == 0
+    )
+
+
+def _online_block(q, kb, vb, mask, m, l, acc, scale):
+    """One flash step: fold a KV block into the (m, l, acc) accumulators.
+
+    q [Bq, hd] model dtype; kb/vb [Bk, hd]; mask [Bq, Bk] bool;
+    m/l [Bq, 1] fp32; acc [Bq, hd] fp32.
+    """
+    s = jax.lax.dot_general(
+        q,
+        kb,
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale
+    s = jnp.where(mask, s, _NEG_INF)
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+    p = jnp.exp(s - m_new)
+    alpha = jnp.exp(m - m_new)
+    l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+    acc = acc * alpha + jax.lax.dot_general(
+        p.astype(vb.dtype),
+        vb,
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    return m_new, l, acc
+
+
+def _finish(l, acc, dtype):
+    """acc / l with fully-masked rows (padding queries) zeroed."""
+    return jnp.where(l > 0, acc / jnp.maximum(l, 1e-30), 0.0).astype(dtype)
+
+
+# ---------------------------------------------------------------------------
+# Causal self-attention with dynamic valid length (prefix pass)
+# ---------------------------------------------------------------------------
+
+def _causal_kernel(plen_ref, q_ref, k_ref, v_ref, o_ref, *, scale, lk, bk):
+    # Head-major blocks: q_ref [1, bq, hd]; k_ref/v_ref [1, lk, hd]. The TPU
+    # lowering constrains only the last two block dims, so the head axis must
+    # lead with block size 1.
+    qb = pl.program_id(1)
+    _, bq, hd = q_ref.shape
+    q = q_ref[0]
+    plen = plen_ref[0]
+    qi = qb * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, 1), 0)
+
+    m = jnp.full((bq, 1), _NEG_INF, jnp.float32)
+    l = jnp.zeros((bq, 1), jnp.float32)
+    acc = jnp.zeros((bq, hd), jnp.float32)
+
+    def body(blk, carry):
+        m, l, acc = carry
+        start = blk * bk
+        kb = k_ref[0, pl.ds(start, bk), :]
+        vb = v_ref[0, pl.ds(start, bk), :]
+        kj = start + jax.lax.broadcasted_iota(jnp.int32, (1, bk), 1)
+        mask = (kj <= qi) & (kj < plen)
+        return _online_block(q, kb, vb, mask, m, l, acc, scale)
+
+    # Causal: KV blocks wholly above this q block's diagonal contribute
+    # nothing, and neither do blocks past the valid length (every key there
+    # has kj >= plen) — stop at whichever bound comes first.
+    causal_last = ((qb + 1) * bq + bk - 1) // bk
+    valid_last = (plen + bk - 1) // bk
+    last = jnp.minimum(jnp.minimum(causal_last, valid_last), lk // bk)
+    m, l, acc = jax.lax.fori_loop(0, last, body, (m, l, acc))
+    o_ref[0] = _finish(l, acc, o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("scale", "interpret"))
+def flash_causal_attention(q, k, v, valid_len, scale=None, interpret=False):
+    """q [L, n_q, hd], k/v [L, n_kv, hd], valid_len int32 scalar ->
+    [L, n_q, hd]. Query i attends keys j with j <= i and j < valid_len."""
+    lq, n_q, hd = q.shape
+    lk, n_kv, _ = k.shape
+    if scale is None:
+        scale = 1.0 / (hd**0.5)
+    bq = _block(lq, _MAX_BLOCK_Q)
+    bk = _block(lk, _MAX_BLOCK_K)
+    grid = (n_q, lq // bq)
+    kv_head = lambda h, qb, plen: (h * n_kv // n_q, 0, 0)
+
+    kernel = functools.partial(_causal_kernel, scale=scale, lk=lk, bk=bk)
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, bq, hd), lambda h, qb, plen: (h, qb, 0)),
+                pl.BlockSpec((1, lk, hd), kv_head),
+                pl.BlockSpec((1, lk, hd), kv_head),
+            ],
+            out_specs=pl.BlockSpec((1, bq, hd), lambda h, qb, plen: (h, qb, 0)),
+        ),
+        out_shape=jax.ShapeDtypeStruct((n_q, lq, hd), q.dtype),
+        interpret=interpret,
+    )(
+        jnp.asarray(valid_len, jnp.int32).reshape(1),
+        q.transpose(1, 0, 2),
+        k.transpose(1, 0, 2),
+        v.transpose(1, 0, 2),
+    )
+    return out.transpose(1, 0, 2)
+
+
+# ---------------------------------------------------------------------------
+# Prefix-shared suffix attention (joint softmax over [prefix ; own causal])
+# ---------------------------------------------------------------------------
+
+def _prefix_shared_kernel(
+    plen_ref, q_ref, kp_ref, vp_ref, ks_ref, vs_ref, o_ref, *, scale, lp, bkp
+):
+    # Head-major blocks: q_ref [1, 1, bq, hd]; kp_ref/vp_ref [1, lp, hd];
+    # ks_ref/vs_ref [1, 1, ls, hd].
+    qb = pl.program_id(2)
+    _, _, bq, hd = q_ref.shape
+    q = q_ref[0, 0]
+    plen = plen_ref[0]
+    qi = qb * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, 1), 0)
+
+    m = jnp.full((bq, 1), _NEG_INF, jnp.float32)
+    l = jnp.zeros((bq, 1), jnp.float32)
+    acc = jnp.zeros((bq, hd), jnp.float32)
+
+    # Prefix KV: visible iff the key is real (j < plen); no causality.
+    def p_body(blk, carry):
+        m, l, acc = carry
+        start = blk * bkp
+        kb = kp_ref[0, pl.ds(start, bkp), :]
+        vb = vp_ref[0, pl.ds(start, bkp), :]
+        kj = start + jax.lax.broadcasted_iota(jnp.int32, (1, bkp), 1)
+        mask = jnp.broadcast_to(kj < plen, (bq, bkp))
+        return _online_block(q, kb, vb, mask, m, l, acc, scale)
+
+    # Blocks past the real prefix are fully masked — skip them.
+    n_real = jnp.minimum((plen + bkp - 1) // bkp, lp // bkp)
+    m, l, acc = jax.lax.fori_loop(0, n_real, p_body, (m, l, acc))
+
+    # Own suffix KV: causal within the suffix.
+    ls = ks_ref.shape[2]
+    ks = ks_ref[0, 0]
+    vs = vs_ref[0, 0]
+    kj = jax.lax.broadcasted_iota(jnp.int32, (1, ls), 1)
+    m, l, acc = _online_block(q, ks, vs, kj <= qi, m, l, acc, scale)
+
+    o_ref[0, 0] = _finish(l, acc, o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("scale", "interpret"))
+def flash_prefix_shared_attention(
+    q, k_prefix, v_prefix, k_suffix, v_suffix, prefix_len, scale=None,
+    interpret=False,
+):
+    """Kernel form of ``ops.attention.prefix_shared_attention``.
+
+    q [S, Ls, n_q, hd]; k_prefix/v_prefix [Lp, n_kv, hd] (SHARED across all
+    suffixes); k_suffix/v_suffix [S, Ls, n_kv, hd]; prefix_len int32 scalar.
+    Returns [S, Ls, n_q, hd].
+    """
+    s, ls, n_q, hd = q.shape
+    lp, n_kv, _ = k_prefix.shape
+    if scale is None:
+        scale = 1.0 / (hd**0.5)
+    bq = _block(ls, _MAX_BLOCK_Q)
+    bkp = _block(lp, _MAX_BLOCK_K)
+    grid = (s, n_q, ls // bq)
+    kv_head = lambda si, h, qb, plen: (h * n_kv // n_q, 0, 0)
+    skv_head = lambda si, h, qb, plen: (si, h * n_kv // n_q, 0, 0)
+    q_map = lambda si, h, qb, plen: (si, h, qb, 0)
+
+    kernel = functools.partial(
+        _prefix_shared_kernel, scale=scale, lp=lp, bkp=bkp
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, 1, bq, hd), q_map),
+                pl.BlockSpec((1, lp, hd), kv_head),
+                pl.BlockSpec((1, lp, hd), kv_head),
+                pl.BlockSpec((1, 1, ls, hd), skv_head),
+                pl.BlockSpec((1, 1, ls, hd), skv_head),
+            ],
+            out_specs=pl.BlockSpec((1, 1, bq, hd), q_map),
+        ),
+        out_shape=jax.ShapeDtypeStruct((s, n_q, ls, hd), q.dtype),
+        interpret=interpret,
+    )(
+        jnp.asarray(prefix_len, jnp.int32).reshape(1),
+        q.transpose(0, 2, 1, 3),
+        k_prefix.transpose(1, 0, 2),
+        v_prefix.transpose(1, 0, 2),
+        k_suffix.transpose(0, 2, 1, 3),
+        v_suffix.transpose(0, 2, 1, 3),
+    )
+    return out.transpose(0, 2, 1, 3)
+
+
+__all__ = [
+    "flash_causal_attention",
+    "flash_prefix_shared_attention",
+    "supports",
+]

@@ -54,6 +54,12 @@ _DTYPES = {
 }
 
 
+def np_dtype_for(dtype_name: str) -> np.dtype:
+    """Host-side numpy dtype for a FrameworkConfig.dtype string (bfloat16
+    resolves to the ml_dtypes extension type)."""
+    return np.dtype(jnp.dtype(_DTYPES[dtype_name]).name)
+
+
 # ---------------------------------------------------------------------------
 # Jitted stage programs (module-level so the jit cache is shared across
 # executors; cfg is a frozen dataclass -> hashable -> static arg)
@@ -68,15 +74,21 @@ def _embed_block(cfg: LlamaConfig, dtype, embed_params, prefix_ids, suffix_ids):
     )
 
 
-@partial(jax.jit, static_argnums=(0,), donate_argnums=(2, 3))
-def _decoder_block(cfg: LlamaConfig, stacked, prefix_h, suffix_h, prefix_len):
+@partial(jax.jit, static_argnums=(0, 5), donate_argnums=(2, 3))
+def _decoder_block(
+    cfg: LlamaConfig, stacked, prefix_h, suffix_h, prefix_len, use_pallas=False
+):
     """Scan k stacked decoder layers over a block of prompts.
 
     stacked: layer pytree with leading [k] axis; prefix_h [B, Lp, D];
     suffix_h [B, S, Ls, D]; prefix_len int32 [B]. Activations are donated —
-    each scan step's output reuses the input buffers.
+    each scan step's output reuses the input buffers. ``use_pallas`` (static)
+    routes attention through the flash kernels.
     """
-    step = jax.vmap(llama.prefix_suffix_layer, in_axes=(None, None, 0, 0, 0))
+    step = jax.vmap(
+        partial(llama.prefix_suffix_layer, use_pallas=use_pallas),
+        in_axes=(None, None, 0, 0, 0),
+    )
 
     def body(carry, layer_params):
         p, s = carry
@@ -115,6 +127,7 @@ def process_block(
     device,
     toks,
     scores: dict,
+    use_pallas: bool = False,
 ):
     """Run one shard over one block: fetch its activations (unless this shard
     starts at the embed layer), apply the segments, scatter any head scores,
@@ -149,6 +162,7 @@ def process_block(
         suffix_ids,
         prefix_len,
         suffix_eos,
+        use_pallas,
     )
     if block_scores is not None:
         for row, i in enumerate(idxs):
@@ -169,6 +183,7 @@ def apply_segments(
     suffix_ids,
     prefix_len,
     suffix_eos,
+    use_pallas: bool = False,
 ):
     """Run one shard's segments over a block.
 
@@ -184,7 +199,7 @@ def apply_segments(
             )
         elif kind == "decoders":
             prefix_h, suffix_h = _decoder_block(
-                model_cfg, params, prefix_h, suffix_h, prefix_len
+                model_cfg, params, prefix_h, suffix_h, prefix_len, use_pallas
             )
         elif kind == "norm":
             suffix_h = _norm_block(model_cfg, params, suffix_h, suffix_eos)
@@ -202,68 +217,19 @@ def _is_floating(a: np.ndarray) -> bool:
     return np.issubdtype(a.dtype, np.floating) or a.dtype.name == "bfloat16"
 
 
-class ShardWeightSource:
-    """Loads shard weights disk -> host -> HBM, optionally prefetching ahead.
+class _HostShardLoader:
+    """Host side of weight streaming: disk -> numpy segments, cast to the
+    compute dtype, contiguous decoder runs pre-stacked [k, ...] for scan."""
 
-    One shard's payload is a dict: ``{"segments": [(kind, params), ...]}``
-    where decoder runs are pre-stacked [k, ...] pytrees ready for scan. With
-    ``prefetch_depth >= 1`` a daemon thread stays ``depth`` shards ahead of
-    compute, so the host->HBM transfer of shard t+1 overlaps the device
-    compute of shard t (the reference serializes these,
-    ``/root/reference/utils.py:228-233``).
-    """
-
-    def __init__(
-        self,
-        model_path: str,
-        layer_names: Sequence[str],
-        shards: Sequence[tuple[int, ...]],
-        np_dtype,
-        device=None,
-        prefetch_depth: int = 1,
-        tied_embeddings: bool = False,
-        devices: Sequence | None = None,
-    ):
+    def __init__(self, model_path: str, layer_names: Sequence[str], np_dtype,
+                 tied_embeddings: bool = False):
         self.model_path = model_path
         self.layer_names = list(layer_names)
-        self.shards = list(shards)
         self.np_dtype = np_dtype
-        # Either one device for every shard, or (pipeline mode) one target
-        # device per shard — shard t's weights upload straight to its stage's
-        # chip while stage t-1 computes elsewhere.
-        if devices is not None:
-            if len(devices) != len(self.shards):
-                raise ValueError("devices must align 1:1 with shards")
-            self.shard_devices = list(devices)
-        else:
-            self.shard_devices = [device] * len(self.shards)
         self.tied = tied_embeddings
-        self.load_time = 0.0  # host-side file->numpy time (cf. load_weights_time)
-        self._q: Queue = Queue(maxsize=max(1, prefetch_depth))
-        self._stop = threading.Event()
-        self._thread: threading.Thread | None = None
-        if prefetch_depth >= 1:
-            self._thread = threading.Thread(target=self._producer, daemon=True)
-            self._thread.start()
+        self.load_time = 0.0  # file->numpy wall time (cf. load_weights_time,
+        # /root/reference/utils.py:223,304)
 
-    def close(self) -> None:
-        """Unblock and retire the prefetch thread; drop any queued shards so
-        their HBM buffers are released even if iteration was abandoned."""
-        self._stop.set()
-        if self._thread is not None:
-            while self._thread.is_alive():
-                try:
-                    self._q.get_nowait()
-                except Exception:
-                    self._thread.join(timeout=0.1)
-            self._thread = None
-        while not self._q.empty():
-            try:
-                self._q.get_nowait()
-            except Exception:
-                break
-
-    # -- host side ---------------------------------------------------------
     def _load_one(self, name: str) -> Params:
         if name == "lm_head" and self.tied:
             emb = checkpoint.load_layer(self.model_path, "model.embed_tokens")
@@ -278,11 +244,7 @@ class ShardWeightSource:
             tree,
         )
 
-    def _build_shard(
-        self, layer_idxs: tuple[int, ...], device
-    ) -> list[tuple[str, Any]]:
-        """Group a shard's layers into segments: contiguous decoder runs are
-        stacked for scan; embed/norm/head are singleton segments."""
+    def build_host_shard(self, layer_idxs: tuple[int, ...]) -> list[tuple[str, Any]]:
         segments: list[tuple[str, Any]] = []
         run: list[Params] = []
 
@@ -308,10 +270,83 @@ class ShardWeightSource:
                 segments.append((kind, params))
         flush()
         self.load_time += time.perf_counter() - t0
-        return [
-            (kind, jax.device_put(p, device) if device else jax.device_put(p))
-            for kind, p in segments
-        ]
+        return segments
+
+
+def _place(segments: list[tuple[str, Any]], device) -> list[tuple[str, Any]]:
+    return [
+        (kind, jax.device_put(p, device) if device else jax.device_put(p))
+        for kind, p in segments
+    ]
+
+
+class ShardWeightSource:
+    """Loads shard weights disk -> host -> HBM, optionally prefetching ahead.
+
+    One shard's payload is a dict: ``{"segments": [(kind, params), ...]}``
+    where decoder runs are pre-stacked [k, ...] pytrees ready for scan. With
+    ``prefetch_depth >= 1`` a daemon thread stays ``depth`` shards ahead of
+    compute, so the host->HBM transfer of shard t+1 overlaps the device
+    compute of shard t (the reference serializes these,
+    ``/root/reference/utils.py:228-233``).
+    """
+
+    def __init__(
+        self,
+        model_path: str,
+        layer_names: Sequence[str],
+        shards: Sequence[tuple[int, ...]],
+        np_dtype,
+        device=None,
+        prefetch_depth: int = 1,
+        tied_embeddings: bool = False,
+        devices: Sequence | None = None,
+    ):
+        self.shards = list(shards)
+        # Either one device for every shard, or (pipeline mode) one target
+        # device per shard — shard t's weights upload straight to its stage's
+        # chip while stage t-1 computes elsewhere.
+        if devices is not None:
+            if len(devices) != len(self.shards):
+                raise ValueError("devices must align 1:1 with shards")
+            self.shard_devices = list(devices)
+        else:
+            self.shard_devices = [device] * len(self.shards)
+        self._loader = _HostShardLoader(
+            model_path, layer_names, np_dtype, tied_embeddings
+        )
+        self._q: Queue = Queue(maxsize=max(1, prefetch_depth))
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        if prefetch_depth >= 1:
+            self._thread = threading.Thread(target=self._producer, daemon=True)
+            self._thread.start()
+
+    def close(self) -> None:
+        """Unblock and retire the prefetch thread; drop any queued shards so
+        their HBM buffers are released even if iteration was abandoned."""
+        self._stop.set()
+        if self._thread is not None:
+            while self._thread.is_alive():
+                try:
+                    self._q.get_nowait()
+                except Exception:
+                    self._thread.join(timeout=0.1)
+            self._thread = None
+        while not self._q.empty():
+            try:
+                self._q.get_nowait()
+            except Exception:
+                break
+
+    @property
+    def load_time(self) -> float:
+        return self._loader.load_time
+
+    def _build_shard(
+        self, layer_idxs: tuple[int, ...], device
+    ) -> list[tuple[str, Any]]:
+        return _place(self._loader.build_host_shard(layer_idxs), device)
 
     # -- prefetch thread ---------------------------------------------------
     def _put(self, item) -> bool:
@@ -349,6 +384,137 @@ class ShardWeightSource:
                 yield idxs, item
 
 
+class BroadcastShardSource:
+    """DP weight sharing: ONE disk read + cast per shard, broadcast to every
+    DP chip.
+
+    Replaces the reference's ``DeviceManager`` layer cache
+    (``/root/reference/utils.py:31-75``): its request queue, condition-variable
+    handoff, and per-layer device refcount/eviction protocol collapse into a
+    single producer thread that loads each shard once and feeds one bounded
+    queue per chip; a consumer drops its reference after use and XLA's
+    allocator reclaims the HBM (no eviction bookkeeping).
+
+    ``rounds`` repeats the shard sequence (the executor's ``num_batch`` loop
+    streams the model once per batch, ``/root/reference/main.py:22-23``).
+    """
+
+    def __init__(
+        self,
+        model_path: str,
+        layer_names: Sequence[str],
+        shards: Sequence[tuple[int, ...]],
+        np_dtype,
+        devices: Sequence,
+        prefetch_depth: int = 1,
+        tied_embeddings: bool = False,
+        rounds: int = 1,
+    ):
+        self.shards = list(shards)
+        self.devices = list(devices)
+        self.rounds = rounds
+        self._loader = _HostShardLoader(
+            model_path, layer_names, np_dtype, tied_embeddings
+        )
+        depth = max(1, prefetch_depth)
+        self._queues = [Queue(maxsize=depth) for _ in self.devices]
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._producer, daemon=True)
+        self._thread.start()
+
+    @property
+    def load_time(self) -> float:
+        return self._loader.load_time
+
+    def _put(self, rank: int, item) -> bool:
+        from queue import Full
+
+        while not self._stop.is_set():
+            try:
+                self._queues[rank].put(item, timeout=0.2)
+                return True
+            except Full:
+                continue
+        return False
+
+    def _producer(self):
+        for _ in range(self.rounds):
+            for idxs in self.shards:
+                if self._stop.is_set():
+                    return
+                try:
+                    host = self._loader.build_host_shard(idxs)
+                except Exception as e:
+                    for rank in range(len(self.devices)):
+                        self._put(rank, e)
+                    return
+                for rank, dev in enumerate(self.devices):
+                    # device_put is async — the transfers to the N chips
+                    # overlap each other and the chips' compute.
+                    if not self._put(rank, _place(host, dev)):
+                        return
+
+    def view(self, rank: int) -> "_BroadcastView":
+        """The per-chip consumer handle an executor iterates one round of."""
+        return _BroadcastView(self, rank)
+
+    def close(self) -> None:
+        self._stop.set()
+        while self._thread.is_alive():
+            for q in self._queues:
+                try:
+                    q.get_nowait()
+                except Exception:
+                    pass
+            self._thread.join(timeout=0.1)
+        for q in self._queues:
+            while not q.empty():
+                try:
+                    q.get_nowait()
+                except Exception:
+                    break
+
+
+class _BroadcastView:
+    """One executor-side round of a BroadcastShardSource for one chip."""
+
+    def __init__(self, parent: BroadcastShardSource, rank: int):
+        self._parent = parent
+        self._rank = rank
+
+    @property
+    def load_time(self) -> float:
+        """The SHARED loader's cumulative host load time: the disk is read
+        once for all chips, so per-chip attribution is meaningless — every
+        DP executor reports the same shared total (flagged via
+        ``load_time_shared``)."""
+        return self._parent.load_time
+
+    load_time_shared = True
+
+    def __iter__(self):
+        from queue import Empty
+
+        q = self._parent._queues[self._rank]
+        for idxs in self._parent.shards:
+            while True:  # get with stop-check so close() can unblock us
+                try:
+                    item = q.get(timeout=0.2)
+                    break
+                except Empty:
+                    if self._parent._stop.is_set():
+                        raise RuntimeError(
+                            "BroadcastShardSource closed while streaming "
+                            "(another DP worker failed?)"
+                        ) from None
+            if isinstance(item, Exception):
+                raise item
+            yield idxs, item
+
+    def close(self) -> None:
+        """The shared producer outlives one view; orchestration closes it."""
+
+
 # ---------------------------------------------------------------------------
 # The executor
 # ---------------------------------------------------------------------------
@@ -368,7 +534,13 @@ class StreamingExecutor:
         device=None,
         plan: ShardPlan | None = None,
         tokenizer=None,
+        weight_source_factory: Callable[[], Any] | None = None,
     ):
+        # weight_source_factory: each __call__ obtains its shard stream from
+        # here instead of opening its own ShardWeightSource — DP mode passes
+        # views of one shared BroadcastShardSource so the disk is read once
+        # for all chips.
+        self.weight_source_factory = weight_source_factory
         self.cfg = cfg
         self.model_cfg = LlamaConfig.from_pretrained(cfg.model_path)
         self.device = device
@@ -409,7 +581,7 @@ class StreamingExecutor:
     # -- numpy dtype for host-side casting ---------------------------------
     @property
     def _np_dtype(self):
-        return np.dtype(jnp.dtype(self.dtype).name)
+        return np_dtype_for(self.cfg.dtype)
 
     def _tokenize(self, prompts) -> list[TokenizedPrompt]:
         return [self.tokenizer(p, s) for p, s in prompts]
@@ -425,15 +597,18 @@ class StreamingExecutor:
             rank_tag=self.plan.num_devices > 1 and self.cfg.data_parallel,
             max_in_cpu=self.cfg.max_activation_in_cpu,
         )
-        source = ShardWeightSource(
-            self.cfg.model_path,
-            self.layer_names,
-            self.plan.shards,
-            self._np_dtype,
-            device=self.device,
-            prefetch_depth=self.cfg.prefetch_depth,
-            tied_embeddings=self.model_cfg.tie_word_embeddings,
-        )
+        if self.weight_source_factory is not None:
+            source = self.weight_source_factory()
+        else:
+            source = ShardWeightSource(
+                self.cfg.model_path,
+                self.layer_names,
+                self.plan.shards,
+                self._np_dtype,
+                device=self.device,
+                prefetch_depth=self.cfg.prefetch_depth,
+                tied_embeddings=self.model_cfg.tie_word_embeddings,
+            )
 
         scores: dict[int, np.ndarray] = {}
         # Per-block device-resident metadata, uploaded once.
@@ -460,6 +635,10 @@ class StreamingExecutor:
             "total_wall_s": time.perf_counter() - t_start,
             "num_layers_streamed": float(self.plan.num_local_layers),
         }
+        if getattr(source, "load_time_shared", False):
+            # DP broadcast: the disk is read once for all chips; this stat is
+            # the shared total, not this chip's own.
+            self.stats["load_time_shared"] = 1.0
         store.clear()
         return [scores[i] for i in range(len(prompts))]
 
@@ -482,6 +661,7 @@ class StreamingExecutor:
                     self.device,
                     toks,
                     scores,
+                    use_pallas=self.cfg.use_pallas,
                 )
             # cpu/disk stores already synced via device_get; for tpu storage
             # block once per shard so compute_wall_s measures device time (the
@@ -492,4 +672,10 @@ class StreamingExecutor:
         return compute_time
 
 
-__all__ = ["StreamingExecutor", "ShardWeightSource"]
+__all__ = [
+    "StreamingExecutor",
+    "ShardWeightSource",
+    "BroadcastShardSource",
+    "apply_segments",
+    "process_block",
+]

@@ -22,7 +22,11 @@ from flexible_llm_sharding_tpu.parallel.planner import (
     plan_shards_dp,
     split_prompts_dp,
 )
-from flexible_llm_sharding_tpu.runtime.executor import StreamingExecutor
+from flexible_llm_sharding_tpu.runtime.executor import (
+    BroadcastShardSource,
+    StreamingExecutor,
+    np_dtype_for,
+)
 from flexible_llm_sharding_tpu.runtime.generation import Prompt
 from flexible_llm_sharding_tpu.utils import checkpoint
 
@@ -63,20 +67,36 @@ def run_prompts(
         return _run_batched(ex, prompts, cfg.num_batch)
 
     # DP: prompt ranges per device (np.array_split semantics,
-    # /root/reference/main.py:70), one streaming executor per chip.
+    # /root/reference/main.py:70), one streaming executor per chip. All chips
+    # stream the same shards in lockstep, so the checkpoint is read from disk
+    # ONCE per shard and broadcast (BroadcastShardSource) — the TPU-native
+    # replacement for the reference's DeviceManager layer cache
+    # (/root/reference/utils.py:31-75). Chips whose prompt range is empty
+    # (more chips than prompts) are excluded from the broadcast entirely, so
+    # the producer never waits on an idle chip's queue.
+    model_cfg = LlamaConfig.from_pretrained(cfg.model_path)
     n = len(devices)
     ranges = split_prompts_dp(len(prompts), n)
-    n_exec_layers = len(
-        checkpoint.layer_names_for(
-            LlamaConfig.from_pretrained(cfg.model_path).num_hidden_layers,
-            tie_word_embeddings=False,
-        )
+    layer_names = checkpoint.layer_names_for(
+        model_cfg.num_hidden_layers, tie_word_embeddings=False
+    )
+    n_exec_layers = len(layer_names)
+    plan = plan_shards_dp(n_exec_layers, cfg.layer_num_per_shard)
+    active = [rank for rank in range(n) if ranges[rank][0] < ranges[rank][1]]
+    source = BroadcastShardSource(
+        cfg.model_path,
+        layer_names,
+        plan.shards,
+        np_dtype_for(cfg.dtype),
+        devices=[devices[r] for r in active],
+        prefetch_depth=cfg.prefetch_depth,
+        tied_embeddings=model_cfg.tie_word_embeddings,
+        rounds=cfg.num_batch,
     )
 
-    def run_one(rank: int):
+    def run_one(slot: int) -> list[np.ndarray]:
+        rank = active[slot]
         lo, hi = ranges[rank]
-        if lo == hi:
-            return []
         ex = StreamingExecutor(
             cfg,
             device=devices[rank],
@@ -87,11 +107,21 @@ def run_prompts(
                 num_devices=n,
             ),
             tokenizer=tokenizer,
+            weight_source_factory=lambda: source.view(slot),
         )
         return _run_batched(ex, prompts[lo:hi], cfg.num_batch)
 
-    with ThreadPoolExecutor(max_workers=n) as pool:
-        outputs = list(pool.map(run_one, range(n)))
+    # No `with` block: its shutdown(wait=True) would join workers BEFORE the
+    # finally could close the source — a failed worker stops consuming its
+    # queue and the rest would block forever. Closing the source first sets
+    # its stop flag, which unblocks every stuck producer put / consumer get.
+    pool = ThreadPoolExecutor(max_workers=len(active))
+    futures = [pool.submit(run_one, slot) for slot in range(len(active))]
+    try:
+        outputs = [f.result() for f in futures]
+    finally:
+        source.close()
+        pool.shutdown(wait=True)
     return [s for chunk in outputs for s in chunk]
 
 

@@ -43,6 +43,7 @@ from flexible_llm_sharding_tpu.runtime.activations import ActivationStore
 from flexible_llm_sharding_tpu.runtime.executor import (
     ShardWeightSource,
     _DTYPES,
+    np_dtype_for,
     process_block,
 )
 from flexible_llm_sharding_tpu.runtime.tokenization import PromptTokenizer, make_blocks
@@ -77,9 +78,7 @@ class PipelineRunner:
 
     @property
     def _np_dtype(self):
-        import jax.numpy as jnp
-
-        return np.dtype(jnp.dtype(self.dtype).name)
+        return np_dtype_for(self.cfg.dtype)
 
     def __call__(self, prompts) -> list[np.ndarray]:
         out: list[np.ndarray] = []
@@ -152,6 +151,7 @@ class PipelineRunner:
                         dev,
                         toks,
                         scores,
+                        use_pallas=self.cfg.use_pallas,
                     )
         finally:
             source.close()
