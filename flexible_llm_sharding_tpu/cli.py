@@ -63,6 +63,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--max_token_len", type=int, default=DEFAULT_MAX_TOKEN_LEN)
     p.add_argument("--use_pallas", type=_str2bool, default=False,
                    help="use Pallas flash-attention kernels where shapes allow")
+    p.add_argument("--verbose_metrics", type=_str2bool, default=False,
+                   help="emit one JSON line per structured timing event")
+    p.add_argument("--profile_dir", type=str, default="",
+                   help="write a jax.profiler (Perfetto/XProf) trace here")
+    p.add_argument("--resume", type=_str2bool, default=False,
+                   help="disk mode: resume from the last completed shard")
     return p
 
 
@@ -82,6 +88,9 @@ def config_from_args(args: argparse.Namespace) -> FrameworkConfig:
         prefetch_depth=args.prefetch_depth,
         num_devices=args.num_devices,
         use_pallas=args.use_pallas,
+        verbose_metrics=args.verbose_metrics,
+        profile_dir=args.profile_dir,
+        resume=args.resume,
     )
 
 
@@ -105,22 +114,44 @@ def main(argv: list[str] | None = None, tokenizer=None) -> None:
         tokenizer = AutoTokenizer.from_pretrained(cfg.model_path)
         tokenizer.pad_token = tokenizer.eos_token
 
-    output_scores, updated = generation_loop(
-        lambda ps: run_prompts(cfg, ps, tokenizer=tokenizer),
-        prompts,
-        cfg.num_gen_token,
-        tokenizer,
+    import time
+
+    from flexible_llm_sharding_tpu.utils.metrics import (
+        peak_hbm_gb,
+        profiler_trace,
+        throughput,
     )
+
+    t0 = time.perf_counter()
+    with profiler_trace(cfg.profile_dir or None):
+        output_scores, updated = generation_loop(
+            lambda ps: run_prompts(cfg, ps, tokenizer=tokenizer),
+            prompts,
+            cfg.num_gen_token,
+            tokenizer,
+        )
+    wall = time.perf_counter() - t0
 
     # Reference file contract (/root/reference/main.py:92-98).
     with open(args.prompt_pickle.replace(".pkl", "_updated.pkl"), "wb") as f:
         pickle.dump(updated, f)
     with open(args.output_file, "wb") as f:
         pickle.dump(output_scores, f)
-    print(
-        json.dumps({"prompts": len(prompts), "num_gen_token": cfg.num_gen_token}),
-        file=sys.stderr,
-    )
+    # Final stats line — the reference prints its per-device weight-load time
+    # here (/root/reference/utils.py:304); ours adds throughput and peak HBM.
+    from flexible_llm_sharding_tpu.runtime.orchestration import pick_devices
+
+    gen_tokens = sum(s.shape[0] for s in output_scores) * cfg.num_gen_token
+    stats = {
+        "prompts": len(prompts),
+        "num_gen_token": cfg.num_gen_token,
+        "wall_s": round(wall, 3),
+        **throughput(gen_tokens, wall, chips=len(pick_devices(cfg))),
+    }
+    peak = peak_hbm_gb()
+    if peak is not None:
+        stats["peak_hbm_gb"] = round(peak, 3)
+    print(json.dumps(stats), file=sys.stderr)
 
 
 if __name__ == "__main__":

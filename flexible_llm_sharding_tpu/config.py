@@ -91,6 +91,9 @@ class FrameworkConfig:
     num_devices: int = 0  # 0 = all visible devices
     bucket_multiple: int = 64  # sequence lengths padded up to a multiple of this
     use_pallas: bool = False  # use Pallas flash-attention kernel where profitable
+    verbose_metrics: bool = False  # one JSON line per structured event (stderr)
+    profile_dir: str = ""  # jax.profiler trace output dir ("" = off)
+    resume: bool = False  # disk mode: resume from the last completed shard
 
     def __post_init__(self) -> None:
         loc = self.storage_location

@@ -1,0 +1,175 @@
+"""Ring attention: sequence/context parallelism over a mesh axis.
+
+The reference has NO long-context mechanism — sequence length is hard-capped
+at 4096 and prompts are truncated (``/root/reference/utils.py:14,250,254``).
+This framework makes long context first-class: the sequence axis is sharded
+over the ``sp`` mesh axis, each chip holds one block of Q/K/V, and KV blocks
+rotate around the ring via ``jax.lax.ppermute`` (XLA lowers it to ICI
+neighbour DMA). Each hop folds the visiting KV block into a running online
+softmax (the same flash accumulators as ops/pallas_attention.py), so
+
+- no chip ever materialises more than its own [L/N, L/N] score tile,
+- memory per chip is O(L/N), compute overlaps the ring transfers,
+- total sequence length scales linearly with the number of chips.
+
+This is blockwise ring attention (Liu et al.-style) expressed with mesh
+collectives rather than hand-rolled RDMA: `shard_map` gives the per-chip
+view, `ppermute` moves KV, and XLA schedules transfer/compute overlap.
+
+Causality is handled at block granularity: a visiting KV block whose global
+positions are all greater than every local query position is skipped
+mathematically (its scores mask to -inf and contribute nothing), and the
+per-element mask handles the diagonal block.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+_NEG_INF = -0.7 * float(jnp.finfo(jnp.float32).max)
+_PRECISION = jax.lax.Precision.HIGHEST
+
+
+def _grouped(q: jax.Array, n_kv: int) -> jax.Array:
+    *lead, lq, n_q, hd = q.shape
+    return q.reshape(*lead, lq, n_kv, n_q // n_kv, hd)
+
+
+def _block_update(q, k, v, mask, m, l, acc, scale):
+    """Fold one KV block into online-softmax accumulators (GQA einsums).
+
+    q [Lq, n_kv, g, hd]; k/v [Lk, n_kv, hd]; mask [Lq, Lk] bool;
+    m/l [n_kv, g, Lq, 1] fp32; acc [n_kv, g, Lq, hd] fp32.
+    """
+    s = jnp.einsum("qngh,knh->ngqk", q, k, precision=_PRECISION).astype(
+        jnp.float32
+    ) * scale
+    s = jnp.where(mask[None, None], s, _NEG_INF)
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+    p = jnp.exp(s - m_new)
+    alpha = jnp.exp(m - m_new)
+    l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+    acc = acc * alpha + jnp.einsum(
+        "ngqk,knh->ngqh", p.astype(v.dtype), v, precision=_PRECISION
+    )
+    return m_new, l, acc
+
+
+def _ring_local(q_blk, k_blk, v_blk, *, axis, causal, scale):
+    """Per-chip body under shard_map: q stays, KV rotates around the ring."""
+    n = jax.lax.axis_size(axis)
+    idx = jax.lax.axis_index(axis)
+    lq = q_blk.shape[0]
+    n_kv = k_blk.shape[1]
+    qr = _grouped(q_blk, n_kv)  # [Lq, n_kv, g, hd]
+    g = qr.shape[2]
+    hd = qr.shape[-1]
+
+    m = jnp.full((n_kv, g, lq, 1), _NEG_INF, jnp.float32)
+    l = jnp.zeros((n_kv, g, lq, 1), jnp.float32)
+    acc = jnp.zeros((n_kv, g, lq, hd), jnp.float32)
+
+    qi = idx * lq + jnp.arange(lq)[:, None]  # global query positions
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    k_cur, v_cur = k_blk, v_blk
+    for step in range(n):  # n is static (mesh size)
+        src = (idx - step) % n  # whose KV block we currently hold
+        kj = src * lq + jnp.arange(lq)[None, :]
+        mask = (kj <= qi) if causal else jnp.ones((lq, lq), bool)
+        m, l, acc = _block_update(qr, k_cur, v_cur, mask, m, l, acc, scale)
+        if step != n - 1:
+            # Rotate KV one hop around the ring (ICI neighbour transfer);
+            # XLA overlaps the permute with the next block's compute.
+            k_cur = jax.lax.ppermute(k_cur, axis, perm)
+            v_cur = jax.lax.ppermute(v_cur, axis, perm)
+
+    out = jnp.where(l > 0, acc / jnp.maximum(l, 1e-30), 0.0)
+    # [n_kv, g, Lq, hd] -> [Lq, n_q, hd]
+    return out.transpose(2, 0, 1, 3).reshape(lq, n_kv * g, hd).astype(q_blk.dtype)
+
+
+def ring_self_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    axis: str = "sp",
+    causal: bool = True,
+    scale: float | None = None,
+) -> jax.Array:
+    """Sequence-parallel self-attention over the ``axis`` mesh dimension.
+
+    q [L, n_q, hd]; k/v [L, n_kv, hd]; L must divide evenly by the axis size.
+    Returns [L, n_q, hd], sharded like q. Numerically equal to dense
+    (masked) attention — verified against ops.attention in tests.
+    """
+    lq, n_q, hd = q.shape
+    n = mesh.shape[axis]
+    if lq % n:
+        raise ValueError(f"sequence length {lq} not divisible by {axis}={n}")
+    if scale is None:
+        scale = 1.0 / (hd**0.5)
+
+    fn = functools.partial(_ring_local, axis=axis, causal=causal, scale=scale)
+    spec = P(axis, None, None)
+    shard_fn = jax.shard_map(
+        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
+    )
+    return shard_fn(q, k, v)
+
+
+def ring_decoder_layer(
+    params,
+    cfg,
+    x: jax.Array,
+    mesh: Mesh,
+    axis: str = "sp",
+) -> jax.Array:
+    """A full decoder layer with sequence-parallel (ring) attention.
+
+    x: [L, D] sharded over ``axis``. RoPE positions are global (the chip's
+    block offset is folded in under shard_map). Elementwise/matmul parts
+    run purely locally on each chip's sequence block.
+    """
+    from flexible_llm_sharding_tpu.models import llama
+    from flexible_llm_sharding_tpu.ops import apply_rope, rms_norm, rope_cos_sin
+
+    eps = cfg.rms_norm_eps
+    spec = P(axis, None)
+
+    def local(x_blk):
+        n = jax.lax.axis_size(axis)
+        idx = jax.lax.axis_index(axis)
+        lq = x_blk.shape[0]
+        h = rms_norm(x_blk, params["input_layernorm"]["scale"], eps)
+        q, k, v = llama._qkv(params["attn"], cfg, h)
+        pos = idx * lq + jnp.arange(lq)
+        cos, sin = rope_cos_sin(pos, cfg.head_dim, cfg.rope_theta)
+        q, k = apply_rope(q, cos, sin), apply_rope(k, cos, sin)
+        return x_blk, q, k, v
+
+    qkv_specs = (spec, P(axis, None, None), P(axis, None, None), P(axis, None, None))
+    x0, q, k, v = jax.shard_map(
+        local, mesh=mesh, in_specs=(spec,), out_specs=qkv_specs
+    )(x)
+    attn = ring_self_attention(q, k, v, mesh, axis=axis, causal=True)
+
+    def local_tail(x_blk, attn_blk):
+        mid = x_blk + llama._out_proj(params["attn"], attn_blk)
+        h = rms_norm(mid, params["post_attention_layernorm"]["scale"], eps)
+        return mid + llama._mlp(params["mlp"], h)
+
+    return jax.shard_map(
+        local_tail,
+        mesh=mesh,
+        in_specs=(spec, P(axis, None, None)),
+        out_specs=spec,
+    )(x0, attn)
+
+
+__all__ = ["ring_self_attention", "ring_decoder_layer"]

@@ -24,6 +24,7 @@ TPU-first redesign (SURVEY.md §7):
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from functools import partial
@@ -43,7 +44,7 @@ from flexible_llm_sharding_tpu.runtime.tokenization import (
     TokenizedPrompt,
     make_blocks,
 )
-from flexible_llm_sharding_tpu.utils import checkpoint
+from flexible_llm_sharding_tpu.utils import checkpoint, metrics
 
 Params = dict[str, Any]
 
@@ -219,7 +220,11 @@ def _is_floating(a: np.ndarray) -> bool:
 
 class _HostShardLoader:
     """Host side of weight streaming: disk -> numpy segments, cast to the
-    compute dtype, contiguous decoder runs pre-stacked [k, ...] for scan."""
+    compute dtype, contiguous decoder runs pre-stacked [k, ...] for scan.
+
+    A native readahead pool (utils/native.py, C++ worker threads) warms the
+    NEXT shard's layer files into the page cache while this shard is being
+    cast/stacked, so cold-cache disk latency overlaps host compute."""
 
     def __init__(self, model_path: str, layer_names: Sequence[str], np_dtype,
                  tied_embeddings: bool = False):
@@ -229,6 +234,24 @@ class _HostShardLoader:
         self.tied = tied_embeddings
         self.load_time = 0.0  # file->numpy wall time (cf. load_weights_time,
         # /root/reference/utils.py:223,304)
+        from flexible_llm_sharding_tpu.utils.native import FilePrefetcher
+
+        self._prefetcher = FilePrefetcher(threads=2)
+
+    def close(self) -> None:
+        self._prefetcher.close()
+
+    def warm(self, layer_idxs: tuple[int, ...]) -> None:
+        """Queue a shard's files for page-cache readahead (non-blocking)."""
+        self._prefetcher.prefetch(
+            *(
+                os.path.join(
+                    self.model_path,
+                    f"{self.layer_names[i]}{checkpoint.LAYER_FILE_SUFFIX}",
+                )
+                for i in layer_idxs
+            )
+        )
 
     def _load_one(self, name: str) -> Params:
         if name == "lm_head" and self.tied:
@@ -338,6 +361,10 @@ class ShardWeightSource:
                 self._q.get_nowait()
             except Exception:
                 break
+        # Retire the loader's native readahead pool promptly — a source is
+        # created per executor call and sits in a reference cycle (producer
+        # thread target holds self), so GC alone would strand thread pools.
+        self._loader.close()
 
     @property
     def load_time(self) -> float:
@@ -361,10 +388,12 @@ class ShardWeightSource:
         return False
 
     def _producer(self):
-        for idxs, dev in zip(self.shards, self.shard_devices):
+        for i, (idxs, dev) in enumerate(zip(self.shards, self.shard_devices)):
             if self._stop.is_set():
                 return
             try:
+                if i + 1 < len(self.shards):  # readahead next shard's files
+                    self._loader.warm(self.shards[i + 1])
                 item = self._build_shard(idxs, dev)
             except Exception as e:  # surfaced on the consumer side
                 self._put(e)
@@ -374,7 +403,9 @@ class ShardWeightSource:
 
     def __iter__(self):
         if self._thread is None:
-            for idxs, dev in zip(self.shards, self.shard_devices):
+            for i, (idxs, dev) in enumerate(zip(self.shards, self.shard_devices)):
+                if i + 1 < len(self.shards):
+                    self._loader.warm(self.shards[i + 1])
                 yield idxs, self._build_shard(idxs, dev)
         else:
             for idxs in self.shards:
@@ -439,10 +470,12 @@ class BroadcastShardSource:
 
     def _producer(self):
         for _ in range(self.rounds):
-            for idxs in self.shards:
+            for i, idxs in enumerate(self.shards):
                 if self._stop.is_set():
                     return
                 try:
+                    if i + 1 < len(self.shards):
+                        self._loader.warm(self.shards[i + 1])
                     host = self._loader.build_host_shard(idxs)
                 except Exception as e:
                     for rank in range(len(self.devices)):
@@ -473,6 +506,7 @@ class BroadcastShardSource:
                     q.get_nowait()
                 except Exception:
                     break
+        self._loader.close()
 
 
 class _BroadcastView:
@@ -541,6 +575,9 @@ class StreamingExecutor:
         # views of one shared BroadcastShardSource so the disk is read once
         # for all chips.
         self.weight_source_factory = weight_source_factory
+        self.recorder: metrics.Recorder | None = (
+            metrics.Recorder(verbose=True) if cfg.verbose_metrics else None
+        )
         self.cfg = cfg
         self.model_cfg = LlamaConfig.from_pretrained(cfg.model_path)
         self.device = device
@@ -586,6 +623,64 @@ class StreamingExecutor:
     def _tokenize(self, prompts) -> list[TokenizedPrompt]:
         return [self.tokenizer(p, s) for p, s in prompts]
 
+    # -- disk-mode crash resume --------------------------------------------
+    # The reference's disk mode is accidentally restartable through its .npy
+    # activation files (SURVEY.md §5 "failure detection"); here that becomes
+    # explicit: a progress marker records the last fully-stored shard, and a
+    # resumed run streams only the remaining shards, re-reading the stored
+    # activations. A signature over the prompt/bucket/plan shape guards
+    # against resuming into a different workload.
+
+    def _resume_signature(self, toks) -> str:
+        import hashlib
+
+        h = hashlib.sha1(
+            repr(
+                (
+                    len(toks),
+                    [t.bucket_key for t in toks],
+                    self.plan.shards,
+                    self.cfg.dtype,
+                    self.cfg.block_size,
+                )
+            ).encode()
+        )
+        # Token CONTENT, not just shapes: a generation step appends tokens
+        # without necessarily crossing a bucket boundary, and resuming one
+        # step's activations into another must be rejected.
+        for t in toks:
+            h.update(t.prefix_ids.tobytes())
+            h.update(t.suffix_ids.tobytes())
+        return h.hexdigest()
+
+    def _progress_path(self, store: ActivationStore) -> str:
+        return os.path.join(self.cfg.disk_folder, f"progress{store.tag}.json")
+
+    def _resume_start(self, store: ActivationStore, sig: str) -> int:
+        import json
+
+        if not (self.cfg.resume and self.cfg.storage_location == "disk"):
+            return 0
+        try:
+            with open(self._progress_path(store)) as f:
+                data = json.load(f)
+        except (OSError, ValueError):
+            return 0
+        if data.get("signature") != sig:
+            return 0
+        # The final shard produces the scores and is never marked complete,
+        # so start is always < num_shards.
+        return min(int(data.get("completed_shards", 0)), len(self.plan.shards) - 1)
+
+    def _mark_progress(self, store: ActivationStore, sig: str, done: int) -> None:
+        import json
+
+        path = self._progress_path(store)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"completed_shards": done, "signature": sig}, f)
+        os.replace(tmp, path)  # atomic: a crash mid-write keeps the old marker
+
     def __call__(self, prompts) -> list[np.ndarray]:
         t_start = time.perf_counter()
         toks = self._tokenize(prompts)
@@ -597,13 +692,19 @@ class StreamingExecutor:
             rank_tag=self.plan.num_devices > 1 and self.cfg.data_parallel,
             max_in_cpu=self.cfg.max_activation_in_cpu,
         )
+        resumable = (
+            self.cfg.storage_location == "disk"
+            and self.weight_source_factory is None
+        )
+        sig = self._resume_signature(toks) if resumable else ""
+        start_shard = self._resume_start(store, sig) if resumable else 0
         if self.weight_source_factory is not None:
             source = self.weight_source_factory()
         else:
             source = ShardWeightSource(
                 self.cfg.model_path,
                 self.layer_names,
-                self.plan.shards,
+                self.plan.shards[start_shard:],
                 self._np_dtype,
                 device=self.device,
                 prefetch_depth=self.cfg.prefetch_depth,
@@ -623,11 +724,24 @@ class StreamingExecutor:
                 jnp.asarray(np.stack([toks[i].suffix_eos for i in idxs])),
             )
 
+        def on_shard_done(local_idx: int) -> None:
+            if resumable:
+                done = start_shard + local_idx + 1
+                if done < len(self.plan.shards):  # final shard re-runs always
+                    self._mark_progress(store, sig, done)
+
         compute_time = 0.0
         try:
-            compute_time = self._stream(source, store, toks, blocks, block_meta, scores)
+            compute_time = self._stream(
+                source, store, toks, blocks, block_meta, scores, on_shard_done
+            )
         finally:
             source.close()
+        if resumable:  # completed: drop the marker
+            try:
+                os.remove(self._progress_path(store))
+            except OSError:
+                pass
 
         self.stats = {
             "load_weights_time_s": source.load_time,
@@ -639,13 +753,25 @@ class StreamingExecutor:
             # DP broadcast: the disk is read once for all chips; this stat is
             # the shared total, not this chip's own.
             self.stats["load_time_shared"] = 1.0
+        peak = metrics.peak_hbm_gb(self.device)
+        if peak is not None:
+            self.stats["peak_hbm_gb"] = peak
+        if self.recorder is not None:
+            self.recorder.record(
+                "executor_call",
+                self.stats["total_wall_s"],
+                prompts=len(prompts),
+                **{k: v for k, v in self.stats.items() if k != "total_wall_s"},
+            )
         store.clear()
         return [scores[i] for i in range(len(prompts))]
 
-    def _stream(self, source, store, toks, blocks, block_meta, scores) -> float:
+    def _stream(
+        self, source, store, toks, blocks, block_meta, scores, on_shard_done=None
+    ) -> float:
         n_layers = len(self.layer_names)
         compute_time = 0.0
-        for layer_idxs, segments in source:
+        for shard_i, (layer_idxs, segments) in enumerate(source):
             t0 = time.perf_counter()
             for b, idxs in enumerate(blocks):
                 suffix_h = process_block(
@@ -666,9 +792,16 @@ class StreamingExecutor:
             # cpu/disk stores already synced via device_get; for tpu storage
             # block once per shard so compute_wall_s measures device time (the
             # prefetch thread keeps uploading the next shard concurrently).
-            if layer_idxs[-1] != n_layers - 1 and self.cfg.storage_location == "tpu":
+            # (blocks can be empty: num_batch > prompt count yields ex([]).)
+            if (
+                blocks
+                and layer_idxs[-1] != n_layers - 1
+                and self.cfg.storage_location == "tpu"
+            ):
                 jax.block_until_ready(suffix_h)
             compute_time += time.perf_counter() - t0
+            if on_shard_done is not None:
+                on_shard_done(shard_i)
         return compute_time
 
 

@@ -1,0 +1,108 @@
+"""Observability: structured metrics, HBM stats, profiler hooks.
+
+The reference's observability is a wall-clock counter around the weight load
+printed at the end (``/root/reference/utils.py:223,230-233,304``) plus tqdm
+bars. Here (SURVEY.md §5): the same load-time counter, plus per-shard
+structured events, tokens/sec/chip, peak HBM from the runtime's allocator
+stats, and a ``jax.profiler`` trace context for Perfetto/XProf dumps.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import sys
+import time
+from dataclasses import dataclass, field
+
+
+def device_memory_stats(device=None) -> dict[str, float]:
+    """Allocator stats for one chip (bytes). Empty on backends without
+    memory_stats (CPU)."""
+    import jax
+
+    device = device or jax.devices()[0]
+    stats = getattr(device, "memory_stats", lambda: None)()
+    if not stats:
+        return {}
+    out = {}
+    for key in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit"):
+        if key in stats:
+            out[key] = float(stats[key])
+    return out
+
+
+def peak_hbm_gb(device=None) -> float | None:
+    s = device_memory_stats(device)
+    return s["peak_bytes_in_use"] / 1e9 if "peak_bytes_in_use" in s else None
+
+
+@dataclass
+class Recorder:
+    """Append-only structured event log for one run.
+
+    Events are (name, seconds, extra) tuples; ``summary()`` aggregates by
+    name. ``emit()`` writes one JSON line per event to stderr when verbose.
+    """
+
+    verbose: bool = False
+    events: list[tuple[str, float, dict]] = field(default_factory=list)
+
+    def record(self, name: str, seconds: float, **extra) -> None:
+        self.events.append((name, seconds, extra))
+        if self.verbose:
+            print(
+                json.dumps({"event": name, "seconds": round(seconds, 4), **extra}),
+                file=sys.stderr,
+                flush=True,
+            )
+
+    @contextlib.contextmanager
+    def timed(self, name: str, **extra):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.record(name, time.perf_counter() - t0, **extra)
+
+    def total(self, name: str) -> float:
+        return sum(s for n, s, _ in self.events if n == name)
+
+    def summary(self) -> dict[str, dict[str, float]]:
+        agg: dict[str, dict[str, float]] = {}
+        for name, s, _ in self.events:
+            d = agg.setdefault(name, {"count": 0.0, "seconds": 0.0})
+            d["count"] += 1
+            d["seconds"] += s
+        return agg
+
+
+@contextlib.contextmanager
+def profiler_trace(log_dir: str | None):
+    """``jax.profiler`` trace scope (Perfetto/XProf) when a directory is
+    given; no-op otherwise. View with ``xprof`` or perfetto.dev."""
+    if not log_dir:
+        yield
+        return
+    import jax
+
+    with jax.profiler.trace(log_dir):
+        yield
+
+
+def throughput(tokens: int, seconds: float, chips: int = 1) -> dict[str, float]:
+    """tokens/sec and tokens/sec/chip — the BASELINE.md headline metric."""
+    tps = tokens / seconds if seconds > 0 else 0.0
+    return {
+        "tokens_per_sec": round(tps, 3),
+        "tokens_per_sec_per_chip": round(tps / max(chips, 1), 3),
+    }
+
+
+__all__ = [
+    "Recorder",
+    "device_memory_stats",
+    "peak_hbm_gb",
+    "profiler_trace",
+    "throughput",
+]

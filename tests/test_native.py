@@ -1,0 +1,53 @@
+"""Native C++ file-prefetch library: build, correctness, and fallback."""
+
+import os
+
+import pytest
+
+from flexible_llm_sharding_tpu.utils import native
+
+
+@pytest.fixture(scope="module")
+def payload(tmp_path_factory):
+    d = tmp_path_factory.mktemp("native")
+    p = d / "blob.bin"
+    data = os.urandom(1 << 20) * 3  # 3 MiB, forces multiple chunks
+    p.write_bytes(data)
+    return str(p), data
+
+
+def test_native_lib_builds():
+    """g++ is in the image (environment contract) — the native path must
+    actually compile and load, not silently fall back."""
+    assert native._load_lib() is not None
+
+
+def test_read_file_native_roundtrip(payload):
+    path, data = payload
+    got = native.read_file_native(path)
+    assert got == data
+
+
+def test_prefetcher_native(payload):
+    path, _ = payload
+    fp = native.FilePrefetcher(threads=2)
+    assert fp.native
+    fp.prefetch(path, path)  # idempotent warm
+    fp.prefetch("/nonexistent/file")  # missing file must not crash the pool
+    fp.wait_all()
+    fp.close()
+
+
+def test_prefetcher_python_fallback(payload, monkeypatch):
+    path, _ = payload
+    monkeypatch.setattr(native, "_load_lib", lambda: None)
+    fp = native.FilePrefetcher(threads=1)
+    assert not fp.native
+    fp.prefetch(path, "/nonexistent/file")
+    fp.wait_all()
+    fp.close()
+
+
+def test_read_file_native_missing():
+    with pytest.raises(OSError):
+        native.read_file_native("/nonexistent/file")

@@ -1,0 +1,158 @@
+"""Disk-mode crash resume: a run killed mid-stream restarts from the last
+completed shard and produces identical scores."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+from flexible_llm_sharding_tpu.config import FrameworkConfig
+from flexible_llm_sharding_tpu.models import llama
+from flexible_llm_sharding_tpu.runtime.executor import StreamingExecutor
+from flexible_llm_sharding_tpu.utils.checkpoint import save_params
+
+from tests.fake_tokenizer import FakeTokenizer
+
+PROMPTS = [
+    ("The capital of France", (" is Paris", " is Rome")),
+    ("Two plus two equals", (" four", " five", " fish")),
+]
+
+
+@pytest.fixture(scope="module")
+def model_dir(tiny_cfg, tmp_path_factory):
+    params = llama.init_params(jax.random.PRNGKey(0), tiny_cfg)
+    d = tmp_path_factory.mktemp("tiny_model_resume")
+    save_params(jax.tree.map(np.asarray, params), str(d), tiny_cfg)
+    return str(d)
+
+
+def _cfg(model_dir, disk_folder, resume=False):
+    return FrameworkConfig(
+        model_path=model_dir,
+        layer_num_per_shard=1,
+        storage_location="disk",
+        disk_folder=disk_folder,
+        dtype="float32",
+        bucket_multiple=8,
+        prefetch_depth=0,
+        resume=resume,
+    )
+
+
+class _Bomb(Exception):
+    pass
+
+
+def _run_and_crash_after(ex: StreamingExecutor, prompts, n_shards: int):
+    """Run the executor but kill the stream after n_shards complete."""
+    orig = ex._stream
+
+    def bombed(source, store, toks, blocks, block_meta, scores, cb=None):
+        def exploding(i):
+            if cb is not None:
+                cb(i)
+            if i + 1 >= n_shards:
+                raise _Bomb()
+
+        return orig(source, store, toks, blocks, block_meta, scores, exploding)
+
+    ex._stream = bombed
+    with pytest.raises(_Bomb):
+        ex(prompts)
+
+
+def test_resume_after_crash(tiny_cfg, model_dir, tmp_path):
+    disk = str(tmp_path / "acts")
+
+    # Oracle: uninterrupted run.
+    want = StreamingExecutor(_cfg(model_dir, disk), tokenizer=FakeTokenizer())(
+        list(PROMPTS)
+    )
+
+    # Crash after 3 of 7 shards.
+    disk2 = str(tmp_path / "acts2")
+    ex = StreamingExecutor(_cfg(model_dir, disk2), tokenizer=FakeTokenizer())
+    _run_and_crash_after(ex, list(PROMPTS), 3)
+    marker = json.load(open(os.path.join(disk2, "progress.json")))
+    assert marker["completed_shards"] == 3
+
+    # Resume: must complete and match, streaming only the remaining shards.
+    ex2 = StreamingExecutor(
+        _cfg(model_dir, disk2, resume=True), tokenizer=FakeTokenizer()
+    )
+    got = ex2(list(PROMPTS))
+    assert ex2.stats["num_layers_streamed"] == 7  # plan-level stat unchanged
+    for g, w in zip(got, want):
+        np.testing.assert_allclose(g, w, rtol=1e-5, atol=1e-6)
+    # Marker cleaned up after success.
+    assert not os.path.exists(os.path.join(disk2, "progress.json"))
+
+
+def test_resume_signature_mismatch_restarts(tiny_cfg, model_dir, tmp_path):
+    disk = str(tmp_path / "acts")
+    ex = StreamingExecutor(_cfg(model_dir, disk), tokenizer=FakeTokenizer())
+    _run_and_crash_after(ex, list(PROMPTS), 3)
+
+    # Different prompt set -> signature mismatch -> full restart, still correct.
+    other = [("Completely different", (" one", " two"))]
+    want = StreamingExecutor(
+        _cfg(model_dir, str(tmp_path / "clean")), tokenizer=FakeTokenizer()
+    )(other)
+    got = StreamingExecutor(
+        _cfg(model_dir, disk, resume=True), tokenizer=FakeTokenizer()
+    )(other)
+    np.testing.assert_allclose(got[0], want[0], rtol=1e-5, atol=1e-6)
+
+
+def test_resume_rejects_same_shape_different_tokens(tiny_cfg, model_dir, tmp_path):
+    """Same bucket shapes but different token content must NOT resume —
+    the signature covers token ids, not just shapes."""
+    disk = str(tmp_path / "acts")
+    ex = StreamingExecutor(_cfg(model_dir, disk), tokenizer=FakeTokenizer())
+    _run_and_crash_after(ex, list(PROMPTS), 3)
+
+    # Same lengths as PROMPTS (same buckets), different characters.
+    twisted = [
+        (p.upper(), tuple(s.upper() for s in sfx)) for p, sfx in PROMPTS
+    ]
+    want = StreamingExecutor(
+        _cfg(model_dir, str(tmp_path / "clean")), tokenizer=FakeTokenizer()
+    )(twisted)
+    got = StreamingExecutor(
+        _cfg(model_dir, disk, resume=True), tokenizer=FakeTokenizer()
+    )(twisted)
+    for g, w in zip(got, want):
+        np.testing.assert_allclose(g, w, rtol=1e-5, atol=1e-6)
+
+
+def test_empty_prompt_batch(tiny_cfg, model_dir, tmp_path):
+    """num_batch > prompt count yields ex([]) calls — must be a no-op, not
+    an UnboundLocalError (tpu storage skips its per-shard sync)."""
+    cfg = FrameworkConfig(
+        model_path=model_dir,
+        storage_location="tpu",
+        dtype="float32",
+        bucket_multiple=8,
+        prefetch_depth=0,
+    )
+    ex = StreamingExecutor(cfg, tokenizer=FakeTokenizer())
+    assert ex([]) == []
+
+
+def test_no_resume_flag_ignores_marker(tiny_cfg, model_dir, tmp_path):
+    disk = str(tmp_path / "acts")
+    ex = StreamingExecutor(_cfg(model_dir, disk), tokenizer=FakeTokenizer())
+    _run_and_crash_after(ex, list(PROMPTS), 2)
+    # resume=False: fresh run from shard 0, correct scores.
+    want = StreamingExecutor(
+        _cfg(model_dir, str(tmp_path / "clean")), tokenizer=FakeTokenizer()
+    )(list(PROMPTS))
+    got = StreamingExecutor(_cfg(model_dir, disk), tokenizer=FakeTokenizer())(
+        list(PROMPTS)
+    )
+    for g, w in zip(got, want):
+        np.testing.assert_allclose(g, w, rtol=1e-5, atol=1e-6)
