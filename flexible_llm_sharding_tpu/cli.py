@@ -54,6 +54,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--disk_folder", type=str, default="./temp")
     p.add_argument("--num_gen_token", type=int, default=1,
                    help="how many new tokens to be generated")
+    p.add_argument("--temperature", type=float, default=0.0,
+                   help="0 = greedy (reference behaviour); >0 samples p^(1/T)")
     # --- TPU-specific ---
     p.add_argument("--dtype", type=str, default="bfloat16",
                    choices=["bfloat16", "float16", "float32"])
@@ -69,6 +71,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="write a jax.profiler (Perfetto/XProf) trace here")
     p.add_argument("--resume", type=_str2bool, default=False,
                    help="disk mode: resume from the last completed shard")
+    p.add_argument("--coordinator_address", type=str, default=None,
+                   help="multi-host (DCN) cluster coordinator, host:port; "
+                        "omit for single-host")
+    p.add_argument("--num_processes", type=int, default=None)
+    p.add_argument("--process_id", type=int, default=None)
     return p
 
 
@@ -98,6 +105,14 @@ def main(argv: list[str] | None = None, tokenizer=None) -> None:
     args = build_parser().parse_args(argv)
     print(args, file=sys.stderr)
     cfg = config_from_args(args)
+
+    if args.coordinator_address is not None:
+        from flexible_llm_sharding_tpu.parallel.sharding import initialize_multihost
+
+        idx = initialize_multihost(
+            args.coordinator_address, args.num_processes, args.process_id
+        )
+        print(f"joined cluster as process {idx}", file=sys.stderr)
 
     if cfg.storage_location == "disk":
         os.makedirs(cfg.disk_folder, exist_ok=True)
@@ -129,6 +144,7 @@ def main(argv: list[str] | None = None, tokenizer=None) -> None:
             prompts,
             cfg.num_gen_token,
             tokenizer,
+            temperature=args.temperature,
         )
     wall = time.perf_counter() - t0
 

@@ -34,6 +34,14 @@ class LlamaConfig:
     max_position_embeddings: int = 4096
     tie_word_embeddings: bool = False
     explicit_head_dim: int | None = None  # HF 'head_dim' when != hidden/heads
+    # RoPE scaling, flattened to hashable fields (the config must stay a
+    # frozen/hashable jit static arg): kind None = unscaled, or
+    # 'linear' (Llama-2 long) / 'llama3' (Llama-3.1+ frequency bands).
+    rope_scaling_kind: str | None = None
+    rope_scaling_factor: float = 1.0
+    rope_low_freq_factor: float = 1.0
+    rope_high_freq_factor: float = 4.0
+    rope_original_max_position: int = 8192
 
     @property
     def head_dim(self) -> int:
@@ -41,14 +49,24 @@ class LlamaConfig:
             return self.explicit_head_dim
         return self.hidden_size // self.num_attention_heads
 
+    @property
+    def rope_scaling_spec(self) -> tuple | None:
+        """Hashable spec consumed by ops.rope.rope_cos_sin."""
+        if self.rope_scaling_kind is None:
+            return None
+        if self.rope_scaling_kind == "linear":
+            return ("linear", self.rope_scaling_factor)
+        return (
+            "llama3",
+            self.rope_scaling_factor,
+            self.rope_low_freq_factor,
+            self.rope_high_freq_factor,
+            self.rope_original_max_position,
+        )
+
     @classmethod
     def from_hf_config(cls, d: dict[str, Any]) -> "LlamaConfig":
-        # Features that change numerics must fail loudly, not silently drop
-        # (rope_scaling support — Llama-3.1 style — is planned, not implied).
-        if d.get("rope_scaling") not in (None, {}):
-            raise NotImplementedError(
-                f"rope_scaling={d['rope_scaling']!r} is not supported yet"
-            )
+        # Features that change numerics must fail loudly, not silently drop.
         if d.get("attention_bias"):
             raise NotImplementedError("attention_bias=true is not supported yet")
         known = {f.name for f in dataclasses.fields(cls)}
@@ -56,6 +74,21 @@ class LlamaConfig:
         if d.get("head_dim"):
             kwargs["explicit_head_dim"] = d["head_dim"]
         kwargs.setdefault("num_key_value_heads", d.get("num_attention_heads", 32))
+        rs = d.get("rope_scaling") or {}
+        if rs:
+            kind = rs.get("rope_type", rs.get("type"))
+            if kind not in ("linear", "llama3"):
+                raise NotImplementedError(
+                    f"rope_scaling type {kind!r} is not supported yet"
+                )
+            kwargs["rope_scaling_kind"] = kind
+            kwargs["rope_scaling_factor"] = float(rs.get("factor", 1.0))
+            if kind == "llama3":
+                kwargs["rope_low_freq_factor"] = float(rs.get("low_freq_factor", 1.0))
+                kwargs["rope_high_freq_factor"] = float(rs.get("high_freq_factor", 4.0))
+                kwargs["rope_original_max_position"] = int(
+                    rs.get("original_max_position_embeddings", 8192)
+                )
         return cls(**kwargs)
 
     @classmethod

@@ -104,7 +104,7 @@ def decoder_layer(
     mask broadcastable to [..., L, L]."""
     h = rms_norm(x, params["input_layernorm"]["scale"], cfg.rms_norm_eps)
     q, k, v = _qkv(params["attn"], cfg, h)
-    cos, sin = rope_cos_sin(positions, cfg.head_dim, cfg.rope_theta)
+    cos, sin = rope_cos_sin(positions, cfg.head_dim, cfg.rope_theta, cfg.rope_scaling_spec)
     q, k = apply_rope(q, cos, sin), apply_rope(k, cos, sin)
     x = x + _out_proj(params["attn"], attention(q, k, v, mask))
     h = rms_norm(x, params["post_attention_layernorm"]["scale"], cfg.rms_norm_eps)
@@ -146,7 +146,7 @@ def prefix_suffix_layer(
     # --- prefix: causal self-attention, keep post-RoPE KV ---
     h = rms_norm(prefix_h, params["input_layernorm"]["scale"], eps)
     q, k, v = _qkv(params["attn"], cfg, h)
-    cos, sin = rope_cos_sin(jnp.arange(lp), cfg.head_dim, cfg.rope_theta)
+    cos, sin = rope_cos_sin(jnp.arange(lp), cfg.head_dim, cfg.rope_theta, cfg.rope_scaling_spec)
     q, k = apply_rope(q, cos, sin), apply_rope(k, cos, sin)
     if flash:
         # Rows at i >= prefix_len are padding; the kernel's valid-len mask
@@ -163,7 +163,7 @@ def prefix_suffix_layer(
     hs = rms_norm(suffix_h, params["input_layernorm"]["scale"], eps)
     qs, ks, vs = _qkv(params["attn"], cfg, hs)
     pos_s = prefix_len + jnp.arange(ls)
-    cos_s, sin_s = rope_cos_sin(pos_s, cfg.head_dim, cfg.rope_theta)
+    cos_s, sin_s = rope_cos_sin(pos_s, cfg.head_dim, cfg.rope_theta, cfg.rope_scaling_spec)
     qs, ks = apply_rope(qs, cos_s, sin_s), apply_rope(ks, cos_s, sin_s)
 
     if flash:

@@ -149,7 +149,7 @@ def ring_decoder_layer(
         h = rms_norm(x_blk, params["input_layernorm"]["scale"], eps)
         q, k, v = llama._qkv(params["attn"], cfg, h)
         pos = idx * lq + jnp.arange(lq)
-        cos, sin = rope_cos_sin(pos, cfg.head_dim, cfg.rope_theta)
+        cos, sin = rope_cos_sin(pos, cfg.head_dim, cfg.rope_theta, cfg.rope_scaling_spec)
         q, k = apply_rope(q, cos, sin), apply_rope(k, cos, sin)
         return x_blk, q, k, v
 

@@ -16,22 +16,51 @@ import numpy as np
 
 
 @functools.lru_cache(maxsize=None)
-def _inv_freq(head_dim: int, theta: float) -> np.ndarray:
+def _inv_freq(
+    head_dim: int, theta: float, scaling: tuple | None = None
+) -> np.ndarray:
     # Computed in float64 on host (static constant) so the float32 table
     # matches torch's to the last ulp instead of drifting via pow().
-    return (
-        1.0 / (theta ** (np.arange(0, head_dim, 2, dtype=np.float64) / head_dim))
-    ).astype(np.float32)
+    freq = 1.0 / (
+        theta ** (np.arange(0, head_dim, 2, dtype=np.float64) / head_dim)
+    )
+    if scaling is not None:
+        kind = scaling[0]
+        if kind == "linear":
+            # transformers LlamaLinearScalingRotaryEmbedding semantics.
+            (_, factor) = scaling
+            freq = freq / factor
+        elif kind == "llama3":
+            # transformers _compute_llama3_parameters: low-frequency bands
+            # are scaled down by `factor`, high-frequency bands kept, the
+            # middle smoothly interpolated.
+            (_, factor, low_ff, high_ff, orig_max) = scaling
+            wavelen = 2.0 * np.pi / freq
+            low_wl = orig_max / low_ff
+            high_wl = orig_max / high_ff
+            smooth = (orig_max / wavelen - low_ff) / (high_ff - low_ff)
+            interp = (1.0 - smooth) * freq / factor + smooth * freq
+            freq = np.where(
+                wavelen < high_wl, freq, np.where(wavelen > low_wl, freq / factor, interp)
+            )
+        else:  # pragma: no cover — config parsing rejects unknown kinds
+            raise NotImplementedError(f"rope scaling kind {kind!r}")
+    return freq.astype(np.float32)
 
 
 def rope_cos_sin(
-    positions: jax.Array, head_dim: int, theta: float
+    positions: jax.Array,
+    head_dim: int,
+    theta: float,
+    scaling: tuple | None = None,
 ) -> tuple[jax.Array, jax.Array]:
     """cos/sin tables for given integer positions.
 
     positions: int array [..., L] -> (cos, sin) float32 [..., L, head_dim//2].
+    scaling: hashable scaling spec from ``LlamaConfig.rope_scaling_spec``
+    (None, ("linear", factor), or ("llama3", factor, low, high, orig_max)).
     """
-    freqs = jnp.asarray(_inv_freq(head_dim, theta))
+    freqs = jnp.asarray(_inv_freq(head_dim, theta, scaling))
     angles = positions.astype(jnp.float32)[..., None] * freqs
     return jnp.cos(angles), jnp.sin(angles)
 

@@ -36,6 +36,42 @@ from flexible_llm_sharding_tpu.config import LlamaConfig
 Params = dict[str, Any]
 
 
+def initialize_multihost(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> int:
+    """Join a multi-host (DCN) JAX cluster; returns this process's index.
+
+    The reference tops out at the chips of one host (Python threads in one
+    process, ``/root/reference/main.py:59-76``). On TPU pods the same mesh
+    code spans hosts: call this once at startup on every host (args usually
+    come from the TPU environment automatically), then build meshes from the
+    GLOBAL device list — ``make_mesh`` already uses ``jax.devices()``, which
+    is cluster-wide after initialization. Lay out mesh axes so the
+    fastest-varying (tp/sp) axes stay within a host's ICI domain and only
+    dp crosses DCN. No-op when the cluster is already initialized, or when
+    auto-detection finds a single-process environment; an EXPLICIT
+    coordinator address that fails to connect raises (a silent fallback to
+    single-host would duplicate work and corrupt results).
+    """
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    except RuntimeError as e:
+        if "already initialized" not in str(e).lower():
+            raise
+    except ValueError:
+        # Auto-detection failed (no cluster env) — fine only if the caller
+        # didn't explicitly ask for a cluster.
+        if coordinator_address is not None:
+            raise
+    return jax.process_index()
+
+
 def make_mesh(
     shape: dict[str, int] | None = None, devices: list | None = None
 ) -> Mesh:
@@ -138,6 +174,7 @@ def shard_params(params: Params, mesh: Mesh, specs: Params) -> Params:
 
 
 __all__ = [
+    "initialize_multihost",
     "make_mesh",
     "param_specs",
     "layer_specs",

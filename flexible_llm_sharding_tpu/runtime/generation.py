@@ -5,8 +5,9 @@ each of ``num_gen_token`` iterations re-runs the full sharded scoring pass on
 the *current* prompts; iteration scores are concatenated along axis 1, so each
 prompt accumulates ``[n_suffixes, num_gen_token, vocab]``; after every
 iteration each suffix is rebuilt as the ORIGINAL suffix string plus the decode
-of the argmax token history so far (greedy only — the reference's
-``--temperature`` flag is commented out, ``/root/reference/main.py:47-48``).
+of the token history so far. Decoding is greedy (argmax) by default — exact
+reference behaviour — with optional temperature sampling, the flag the
+reference sketched but left commented out (``/root/reference/main.py:47-48``).
 
 The known scaling cliff is inherited deliberately (SURVEY.md §3.5): per-token
 cost equals full-prompt cost because no KV survives between tokens — the
@@ -29,17 +30,37 @@ def generation_loop(
     prompts: Sequence[Prompt],
     num_gen_token: int,
     tokenizer,
+    temperature: float = 0.0,
+    seed: int = 0,
 ) -> tuple[list[np.ndarray], list[Prompt]]:
-    """Run ``num_gen_token`` greedy decode iterations.
+    """Run ``num_gen_token`` decode iterations (greedy by default).
 
     run_fn: scores the current prompts -> one ``[n_suffixes, 1, vocab]``
     float array per prompt (a single executor, or a multi-device fan-out).
     Returns (per-prompt ``[n_suffixes, num_gen_token, vocab]`` scores,
     updated prompts with generated text appended to each suffix).
+
+    ``temperature > 0`` samples each new token from ``p^(1/T)`` (renormalised)
+    — the reference sketched this flag but left it commented out
+    (``/root/reference/main.py:47-48``); ``0`` is exact reference (argmax)
+    behaviour. Sampling is deterministic given ``seed``.
     """
     original = list(prompts)
     current: list[Prompt] = copy.deepcopy(original)
     output_scores: list[np.ndarray] = []
+    # Sampled-token history [prompt][suffix] — greedy recomputes its history
+    # from argmax each iteration (exact reference semantics); sampling must
+    # remember its own draws instead.
+    sampled: list[list[list[int]]] = [
+        [[] for _ in sfx] for _, sfx in original
+    ]
+    rng = np.random.default_rng(seed)
+
+    def _pick(dist: np.ndarray) -> int:
+        """Sample from p^(1/T) (only called on the temperature>0 path)."""
+        logits = np.log(np.maximum(dist, 1e-30)) / temperature
+        p = np.exp(logits - logits.max())
+        return int(rng.choice(dist.shape[-1], p=p / p.sum()))
 
     for i_new in range(num_gen_token):
         outputs = run_fn(current)
@@ -50,14 +71,21 @@ def generation_loop(
                 np.concatenate((old, new), axis=1)
                 for old, new in zip(output_scores, outputs)
             ]
-        # Rebuild suffixes from the ORIGINAL prompt plus the decoded argmax
+        # Rebuild suffixes from the ORIGINAL prompt plus the decoded token
         # history (/root/reference/main.py:85-90).
         for p_idx, (prefix, suffix) in enumerate(original):
-            new_tokens = np.argmax(output_scores[p_idx], axis=-1)  # [S, i+1]
+            if temperature <= 0:
+                history = np.argmax(output_scores[p_idx], axis=-1)  # [S, i+1]
+            else:
+                for s_idx in range(len(suffix)):
+                    sampled[p_idx][s_idx].append(
+                        _pick(output_scores[p_idx][s_idx, i_new])
+                    )
+                history = np.asarray(sampled[p_idx])
             current[p_idx] = (
                 prefix,
                 tuple(
-                    s + tokenizer.decode(t) for s, t in zip(suffix, new_tokens)
+                    s + tokenizer.decode(t) for s, t in zip(suffix, history)
                 ),
             )
 

@@ -1,0 +1,33 @@
+"""Offline checkpoint splitter CLI — parity with the reference's
+``python prepare_weights.py <bin_dir> <new_file_dir>``
+(``/root/reference/prepare_weights.py:56-62``), with TPU-first extensions:
+``--dtype bfloat16`` casts at split time and ``--layout native`` (default)
+pre-transposes kernels to the framework's [in, out] layout so the streaming
+hot path is a zero-copy mmap. ``--layout hf`` emits reference-identical files.
+"""
+
+import argparse
+import sys
+
+from flexible_llm_sharding_tpu.utils.checkpoint import split_into_layers
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("bin_dir", help="HF checkpoint dir (.bin or .safetensors)")
+    p.add_argument("new_file_dir", help="output dir for per-layer files")
+    p.add_argument("--dtype", default=None, choices=[None, "bfloat16", "float16", "float32"])
+    p.add_argument("--layout", default="native", choices=["native", "hf"])
+    args = p.parse_args(argv)
+    layers = split_into_layers(
+        args.bin_dir,
+        args.new_file_dir,
+        dtype=args.dtype,
+        layout=args.layout,
+        progress=lambda name: print(name, file=sys.stderr),
+    )
+    print(f"wrote {len(layers)} layer files to {args.new_file_dir}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -94,6 +94,38 @@ def test_executor_shard_sizes(tiny_cfg, model_dir, expected, lnps):
         np.testing.assert_allclose(g, w, rtol=1e-4, atol=1e-5)
 
 
+def test_executor_tied_embeddings(tiny_cfg, tmp_path):
+    """Tied-embedding checkpoints (no lm_head file, Llama-3.2 style): the
+    head kernel is re-materialised from the embedding at stream time."""
+    import dataclasses
+
+    cfg_tied = dataclasses.replace(tiny_cfg, tie_word_embeddings=True)
+    params = llama.init_params(jax.random.PRNGKey(7), cfg_tied)
+    assert "lm_head" not in params
+    d = tmp_path / "tied_model"
+    save_params(jax.tree.map(np.asarray, params), str(d), cfg_tied)
+    assert not (d / "lm_head.safetensors").exists()
+
+    cfg = FrameworkConfig(
+        model_path=str(d),
+        storage_location="cpu",
+        dtype="float32",
+        bucket_multiple=8,
+        prefetch_depth=0,
+    )
+    ex = StreamingExecutor(cfg, tokenizer=FakeTokenizer())
+    got = ex(PROMPTS[:1])
+
+    tok = PromptTokenizer(FakeTokenizer(), bucket_multiple=8)
+    t = tok(*PROMPTS[0])
+    full = np.concatenate(
+        [t.prefix_ids[: t.prefix_len], t.suffix_ids[0, : int(t.suffix_eos[0]) + 1]]
+    )[None, :]
+    logits = llama.forward_full(params, cfg_tied, jnp.asarray(full))
+    want = np.asarray(jax.nn.softmax(logits[0, -1]))
+    np.testing.assert_allclose(got[0][0, 0], want, rtol=1e-4, atol=1e-5)
+
+
 def test_tokenization_bucketing():
     tok = PromptTokenizer(FakeTokenizer(), bucket_multiple=8, suffix_count_multiple=4)
     t = tok("hello world", ("a", "bc", "def"))

@@ -147,6 +147,20 @@ def test_generation_loop_semantics(model_dir):
             assert new.startswith(orig) and len(new) > len(orig)
 
 
+def test_generation_with_temperature(model_dir):
+    """temperature>0 samples deterministically per seed and still grows
+    suffixes from the original prompt; temperature=0 equals argmax path."""
+    cfg = _cfg(model_dir)
+    tok = FakeTokenizer()
+    run = lambda ps: run_prompts(cfg, ps, tokenizer=tok, devices=jax.devices()[:1])
+    _, up_a = generation_loop(run, PROMPTS[:1], 2, tok, temperature=0.8, seed=1)
+    _, up_b = generation_loop(run, PROMPTS[:1], 2, tok, temperature=0.8, seed=1)
+    assert up_a == up_b  # deterministic per seed
+    for (_, sfx), (_, usfx) in zip(PROMPTS[:1], up_a):
+        for orig, new in zip(sfx, usfx):
+            assert new.startswith(orig) and len(new) > len(orig)
+
+
 def test_cli_end_to_end(model_dir, tmp_path):
     from flexible_llm_sharding_tpu.cli import main
 

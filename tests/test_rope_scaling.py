@@ -1,0 +1,87 @@
+"""RoPE scaling (Llama-3.1 'llama3' bands and 'linear') vs HF golden."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+import torch
+
+from flexible_llm_sharding_tpu.config import LlamaConfig
+from flexible_llm_sharding_tpu.models import llama
+from flexible_llm_sharding_tpu.ops.rope import _inv_freq
+
+from tests.test_numerics import _params_from_hf
+
+
+def _mk_hf(tiny_cfg, rope_scaling):
+    from transformers import LlamaConfig as HFConfig
+    from transformers import LlamaForCausalLM
+
+    torch.manual_seed(1)
+    hf_cfg = HFConfig(
+        vocab_size=tiny_cfg.vocab_size,
+        hidden_size=tiny_cfg.hidden_size,
+        intermediate_size=tiny_cfg.intermediate_size,
+        num_hidden_layers=2,
+        num_attention_heads=tiny_cfg.num_attention_heads,
+        num_key_value_heads=tiny_cfg.num_key_value_heads,
+        rope_theta=500000.0,
+        max_position_embeddings=tiny_cfg.max_position_embeddings,
+        rope_scaling=rope_scaling,
+        attn_implementation="eager",
+    )
+    return LlamaForCausalLM(hf_cfg).eval(), hf_cfg
+
+
+LLAMA3_SCALING = {
+    "rope_type": "llama3",
+    "factor": 8.0,
+    "low_freq_factor": 1.0,
+    "high_freq_factor": 4.0,
+    "original_max_position_embeddings": 128,
+}
+
+
+def test_config_parses_llama3_scaling(tiny_cfg):
+    cfg = LlamaConfig.from_hf_config(
+        {"hidden_size": 64, "num_attention_heads": 4, "rope_scaling": LLAMA3_SCALING}
+    )
+    assert cfg.rope_scaling_spec == ("llama3", 8.0, 1.0, 4.0, 128)
+    cfg2 = LlamaConfig.from_hf_config(
+        {"rope_scaling": {"rope_type": "linear", "factor": 2.0}}
+    )
+    assert cfg2.rope_scaling_spec == ("linear", 2.0)
+    with pytest.raises(NotImplementedError):
+        LlamaConfig.from_hf_config({"rope_scaling": {"rope_type": "yarn"}})
+
+
+def test_inv_freq_matches_hf_llama3(tiny_cfg):
+    _, hf_cfg = _mk_hf(tiny_cfg, LLAMA3_SCALING)
+    from transformers.modeling_rope_utils import ROPE_INIT_FUNCTIONS
+
+    want, _ = ROPE_INIT_FUNCTIONS["llama3"](hf_cfg, device="cpu")
+    got = _inv_freq(
+        tiny_cfg.hidden_size // tiny_cfg.num_attention_heads,
+        500000.0,
+        ("llama3", 8.0, 1.0, 4.0, 128),
+    )
+    np.testing.assert_allclose(got, want.numpy(), rtol=1e-6, atol=0)
+
+
+@pytest.mark.parametrize(
+    "scaling,spec",
+    [
+        (LLAMA3_SCALING, ("llama3", 8.0, 1.0, 4.0, 128)),
+        ({"rope_type": "linear", "factor": 4.0}, ("linear", 4.0)),
+    ],
+)
+def test_forward_matches_hf_with_scaling(tiny_cfg, rng, scaling, spec):
+    model, hf_cfg = _mk_hf(tiny_cfg, scaling)
+    cfg = LlamaConfig.from_hf_config(hf_cfg.to_dict())
+    assert cfg.rope_scaling_spec == spec
+    params = _params_from_hf(model, cfg)
+    ids = rng.integers(0, cfg.vocab_size, size=(2, 33))
+    with torch.no_grad():
+        hf_logits = model(torch.tensor(ids)).logits.numpy()
+    ours = np.asarray(llama.forward_full(params, cfg, jnp.asarray(ids)))
+    np.testing.assert_allclose(ours, hf_logits, rtol=2e-4, atol=2e-4)

@@ -22,6 +22,16 @@ from flexible_llm_sharding_tpu.training import (
 )
 
 
+def test_initialize_multihost_single_process():
+    from flexible_llm_sharding_tpu.parallel.sharding import initialize_multihost
+
+    # No cluster env: auto-detection failure is tolerated, process index 0.
+    # (The explicit-coordinator failure path is not exercised here: a dead
+    # coordinator address blocks in jax's connect retry loop, not viable in
+    # unit tests.)
+    assert initialize_multihost() == 0
+
+
 def test_make_mesh_shapes():
     mesh = make_mesh({"dp": 2, "tp": -1})
     assert mesh.shape == {"dp": 2, "tp": 4}
