@@ -56,6 +56,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="how many new tokens to be generated")
     p.add_argument("--temperature", type=float, default=0.0,
                    help="0 = greedy (reference behaviour); >0 samples p^(1/T)")
+    p.add_argument("--kv_cache", type=_str2bool, default=False,
+                   help="fast generation: reuse per-layer KV across tokens "
+                        "(token-id append semantics; greedy only; single device)")
     # --- TPU-specific ---
     p.add_argument("--dtype", type=str, default="bfloat16",
                    choices=["bfloat16", "float16", "float32"])
@@ -113,6 +116,12 @@ def main(argv: list[str] | None = None, tokenizer=None) -> None:
             args.coordinator_address, args.num_processes, args.process_id
         )
         print(f"joined cluster as process {idx}", file=sys.stderr)
+    elif args.num_processes is not None or args.process_id is not None:
+        # Without a coordinator every host would silently run the full
+        # workload as process 0 and race on the output files.
+        raise SystemExit(
+            "--num_processes/--process_id require --coordinator_address"
+        )
 
     if cfg.storage_location == "disk":
         os.makedirs(cfg.disk_folder, exist_ok=True)
@@ -139,13 +148,28 @@ def main(argv: list[str] | None = None, tokenizer=None) -> None:
 
     t0 = time.perf_counter()
     with profiler_trace(cfg.profile_dir or None):
-        output_scores, updated = generation_loop(
-            lambda ps: run_prompts(cfg, ps, tokenizer=tokenizer),
-            prompts,
-            cfg.num_gen_token,
-            tokenizer,
-            temperature=args.temperature,
-        )
+        if args.kv_cache:
+            if args.temperature > 0:
+                raise SystemExit("--kv_cache supports greedy decoding only")
+            from flexible_llm_sharding_tpu.runtime.decode import DecodeGenerator
+            from flexible_llm_sharding_tpu.runtime.orchestration import pick_devices
+
+            devs = pick_devices(cfg)
+            if len(devs) > 1:
+                raise SystemExit(
+                    "--kv_cache is single-device; pass --num_devices 1 or "
+                    "use the default generation loop for multi-chip runs"
+                )
+            gen = DecodeGenerator(cfg, device=devs[0], tokenizer=tokenizer)
+            output_scores, updated = gen(prompts)
+        else:
+            output_scores, updated = generation_loop(
+                lambda ps: run_prompts(cfg, ps, tokenizer=tokenizer),
+                prompts,
+                cfg.num_gen_token,
+                tokenizer,
+                temperature=args.temperature,
+            )
     wall = time.perf_counter() - t0
 
     # Reference file contract (/root/reference/main.py:92-98).

@@ -47,7 +47,11 @@ import jax.numpy as jnp
 from flexible_llm_sharding_tpu.config import LlamaConfig
 from flexible_llm_sharding_tpu.ops import apply_rope, attention, rms_norm, rope_cos_sin
 from flexible_llm_sharding_tpu.ops import pallas_attention
-from flexible_llm_sharding_tpu.ops.attention import causal_mask, prefix_shared_attention
+from flexible_llm_sharding_tpu.ops.attention import (
+    causal_mask,
+    decode_attention,
+    prefix_shared_attention,
+)
 
 Params = dict[str, Any]
 
@@ -118,7 +122,8 @@ def prefix_suffix_layer(
     suffix_h: jax.Array,
     prefix_len: jax.Array,
     use_pallas: bool = False,
-) -> tuple[jax.Array, jax.Array]:
+    return_kv: bool = False,
+) -> tuple[jax.Array, ...]:
     """One decoder layer over a (prefix, suffixes) prompt — the streaming hot op.
 
     prefix_h: [Lp, D] right-padded to the Lp bucket; only the first
@@ -175,7 +180,57 @@ def prefix_suffix_layer(
     suffix_mid = suffix_h + _out_proj(params["attn"], attn_s)
     hs = rms_norm(suffix_mid, params["post_attention_layernorm"]["scale"], eps)
     suffix_out = suffix_mid + _mlp(params["mlp"], hs)
+    if return_kv:
+        # Post-RoPE KV, reusable across decode steps (runtime/decode.py).
+        return prefix_out, suffix_out, {"kp": k, "vp": v, "ks": ks, "vs": vs}
     return prefix_out, suffix_out
+
+
+def decode_step_layer(
+    params: Params,
+    cfg: LlamaConfig,
+    x: jax.Array,
+    kv: Params,
+    prefix_len: jax.Array,
+    suffix_eos: jax.Array,
+    t: jax.Array,
+) -> tuple[jax.Array, Params]:
+    """One decoder layer for ONE new token per suffix, against cached KV.
+
+    The KV-cache decode path (no reference equivalent — its generation loop
+    re-streams the full prompt per token, SURVEY.md §3.5). x: [S, 1, D];
+    kv: {'kp','vp' [Lp,n_kv,hd], 'ks','vs' [S,Ls,n_kv,hd],
+    'kg','vg' [S,T,n_kv,hd]} with generated-token slots < t filled;
+    t: int32 scalar (this step's slot). The new token sits at rotary position
+    ``prefix_len + (suffix_eos[s]+1) + t``. Returns (x_out, kv with slot t
+    of kg/vg written).
+    """
+    eps = cfg.rms_norm_eps
+    h = rms_norm(x, params["input_layernorm"]["scale"], eps)
+    q, k_new, v_new = _qkv(params["attn"], cfg, h)  # [S, 1, n, hd]
+    pos = (prefix_len + suffix_eos + 1 + t)[:, None]  # [S, 1]
+    cos, sin = rope_cos_sin(pos, cfg.head_dim, cfg.rope_theta, cfg.rope_scaling_spec)
+    q, k_new = apply_rope(q, cos, sin), apply_rope(k_new, cos, sin)
+
+    kv = dict(kv)
+    kv["kg"] = jax.lax.dynamic_update_slice_in_dim(kv["kg"], k_new, t, axis=1)
+    kv["vg"] = jax.lax.dynamic_update_slice_in_dim(kv["vg"], v_new, t, axis=1)
+
+    attn_out = decode_attention(
+        q,
+        kv["kp"],
+        kv["vp"],
+        kv["ks"],
+        kv["vs"],
+        kv["kg"],
+        kv["vg"],
+        prefix_len,
+        suffix_eos,
+        t,
+    )
+    mid = x + _out_proj(params["attn"], attn_out)
+    h = rms_norm(mid, params["post_attention_layernorm"]["scale"], eps)
+    return mid + _mlp(params["mlp"], h), kv
 
 
 def select_eos_and_norm(

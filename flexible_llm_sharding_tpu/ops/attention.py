@@ -110,6 +110,75 @@ def prefix_shared_attention(
     return out.reshape(s, ls, n_q, hd)
 
 
+def decode_attention(
+    q: jax.Array,
+    k_prefix: jax.Array,
+    v_prefix: jax.Array,
+    k_suffix: jax.Array,
+    v_suffix: jax.Array,
+    k_gen: jax.Array,
+    v_gen: jax.Array,
+    prefix_len: jax.Array,
+    suffix_eos: jax.Array,
+    t: jax.Array,
+    scale: float | None = None,
+) -> jax.Array:
+    """Single-token decode attention over three cached KV regions.
+
+    The KV-cache decode mode's hot op (not in the reference — its generation
+    loop re-runs the whole prompt per token, ``/root/reference/main.py:65-76``;
+    SURVEY.md §3.5 calls this the known scaling cliff). The query is ONE new
+    token per suffix; it attends jointly (one softmax) over:
+
+    - the shared prefix KV  (keys j < prefix_len),
+    - its own suffix KV     (keys j <= suffix_eos[s]),
+    - previously generated tokens' KV incl. itself (keys j <= t).
+
+    q [S, 1, n_q, hd]; k/v_prefix [Lp, n_kv, hd]; k/v_suffix [S, Ls, n_kv, hd];
+    k/v_gen [S, T, n_kv, hd] (slot t already holds this step's KV);
+    prefix_len, t: int32 scalars; suffix_eos int32 [S]. Returns [S, 1, n_q, hd].
+    """
+    s, _, n_q, hd = q.shape
+    n_kv = k_prefix.shape[-2]
+    if scale is None:
+        scale = 1.0 / (hd**0.5)
+    lp = k_prefix.shape[0]
+    ls = k_suffix.shape[1]
+    tmax = k_gen.shape[1]
+
+    qr = _grouped_q(q, n_kv)  # [S, 1, n_kv, g, hd]
+    sp = jnp.einsum("sqngh,knh->sngqk", qr, k_prefix, precision=_PRECISION)
+    ss = jnp.einsum("sqngh,sknh->sngqk", qr, k_suffix, precision=_PRECISION)
+    sg = jnp.einsum("sqngh,sknh->sngqk", qr, k_gen, precision=_PRECISION)
+    scores = (
+        jnp.concatenate([sp, ss, sg], axis=-1).astype(jnp.float32) * scale
+    )  # [S, n_kv, g, 1, Lp+Ls+T]
+
+    jp = jnp.arange(lp)[None, :] < prefix_len  # [1, Lp]
+    js = jnp.arange(ls)[None, :] <= suffix_eos[:, None]  # [S, Ls]
+    jg = jnp.arange(tmax)[None, :] <= t  # [1, T]
+    mask = jnp.concatenate(
+        [
+            jnp.broadcast_to(jp, (s, lp)),
+            js,
+            jnp.broadcast_to(jg, (s, tmax)),
+        ],
+        axis=-1,
+    )  # [S, Lp+Ls+T]
+    scores = jnp.where(mask[:, None, None, None, :], scores, _NEG_INF)
+
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    pp, ps, pg = (
+        probs[..., :lp],
+        probs[..., lp : lp + ls],
+        probs[..., lp + ls :],
+    )
+    out = jnp.einsum("sngqk,knh->sqngh", pp, v_prefix, precision=_PRECISION)
+    out = out + jnp.einsum("sngqk,sknh->sqngh", ps, v_suffix, precision=_PRECISION)
+    out = out + jnp.einsum("sngqk,sknh->sqngh", pg, v_gen, precision=_PRECISION)
+    return out.reshape(s, 1, n_q, hd)
+
+
 def causal_mask(lq: int, lk: int, offset: int = 0) -> jax.Array:
     """Boolean causal mask [lq, lk]: query i attends key j iff j <= i + offset."""
     qi = jax.lax.broadcasted_iota(jnp.int32, (lq, lk), 0)

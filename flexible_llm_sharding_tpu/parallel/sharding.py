@@ -62,7 +62,15 @@ def initialize_multihost(
             process_id=process_id,
         )
     except RuntimeError as e:
-        if "already initialized" not in str(e).lower():
+        msg = str(e).lower()
+        benign = "already initialized" in msg or (
+            # Backends already up (too late to join) is tolerable only when
+            # we were auto-detecting, not when a cluster was explicitly
+            # requested — joining would have to precede any JAX call.
+            coordinator_address is None
+            and "before" in msg
+        )
+        if not benign:
             raise
     except ValueError:
         # Auto-detection failed (no cluster env) — fine only if the caller

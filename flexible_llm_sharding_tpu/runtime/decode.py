@@ -1,0 +1,317 @@
+"""KV-cache decode mode: fast multi-token generation for the streaming executor.
+
+The reference's generation loop re-runs the ENTIRE sharded forward per new
+token — full re-tokenisation, full prompt recompute through every layer
+(``/root/reference/main.py:65-76``; SURVEY.md §3.5 calls it the known scaling
+cliff: per-token cost == full-prompt cost). This module removes the compute
+half of that cliff while keeping the framework's defining constraint (weights
+stream through the chip shard-by-shard, HBM holds only one shard):
+
+- **Prefill** runs the normal streaming pass once, but each decoder layer
+  additionally emits its post-RoPE KV, which is parked per (shard, block) in
+  host RAM (or HBM with ``storage_location='tpu'``).
+- **Each decode step** re-streams the weights (that is the point of the
+  design) but computes only ONE token per suffix per layer against the cached
+  KV — O(1) sequence work instead of O(prefix+suffix).
+
+Semantics note: the reference rebuilds suffix STRINGS per token
+(argmax -> ``tokenizer.decode`` -> re-encode, ``/root/reference/main.py:85-90``),
+which can re-tokenise differently; this mode appends token IDS directly.
+Greedy token choices match token-level greedy decoding exactly (tested
+against the monolithic oracle); the ``_updated.pkl`` text is produced by
+decoding the id history. Use the default (slow) loop for bit-exact reference
+string semantics.
+"""
+
+from __future__ import annotations
+
+import time
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from flexible_llm_sharding_tpu.config import FrameworkConfig, LlamaConfig
+from flexible_llm_sharding_tpu.models import llama
+from flexible_llm_sharding_tpu.parallel.planner import plan_shards_dp
+from flexible_llm_sharding_tpu.runtime.executor import (
+    ShardWeightSource,
+    _embed_block,
+    _norm_block,
+    _head_block,
+    np_dtype_for,
+    _DTYPES,
+)
+from flexible_llm_sharding_tpu.runtime.tokenization import (
+    PromptTokenizer,
+    make_blocks,
+)
+from flexible_llm_sharding_tpu.utils import checkpoint
+
+Params = dict[str, Any]
+
+
+# ---------------------------------------------------------------------------
+# Jitted blocks (module-level: shared jit cache)
+# ---------------------------------------------------------------------------
+
+@partial(jax.jit, static_argnums=(0, 1), donate_argnums=(3, 4))
+def _prefill_decoders(cfg: LlamaConfig, use_pallas, stacked, prefix_h, suffix_h, prefix_len):
+    """Scan k layers over a block, emitting per-layer KV as scan outputs.
+
+    Returns (prefix_h, suffix_h, kv) with kv leaves shaped [k, B, ...].
+    """
+    step = jax.vmap(
+        partial(llama.prefix_suffix_layer, use_pallas=use_pallas, return_kv=True),
+        in_axes=(None, None, 0, 0, 0),
+    )
+
+    def body(carry, layer_params):
+        p, s = carry
+        p, s, kv = step(layer_params, cfg, p, s, prefix_len)
+        return (p, s), kv
+
+    (prefix_h, suffix_h), kv = jax.lax.scan(body, (prefix_h, suffix_h), stacked)
+    return prefix_h, suffix_h, kv
+
+
+@partial(jax.jit, static_argnums=(0,), donate_argnums=(2, 3))
+def _decode_decoders(cfg: LlamaConfig, stacked, kv, x, prefix_len, suffix_eos, t):
+    """Scan k layers' single-token decode over a block.
+
+    kv: pytree with leaves [k, B, ...] (kg/vg slots < t filled); x [B, S, 1, D];
+    prefix_len [B]; suffix_eos [B, S]; t scalar. Returns (x, kv updated at t).
+    kv and x are donated — each step reuses the previous buffers.
+    """
+    step = jax.vmap(llama.decode_step_layer, in_axes=(None, None, 0, 0, 0, 0, None))
+
+    def body(x, layer):
+        layer_params, layer_kv = layer
+        x, layer_kv = step(layer_params, cfg, x, layer_kv, prefix_len, suffix_eos, t)
+        return x, layer_kv
+
+    x, kv = jax.lax.scan(body, x, (stacked, kv))
+    return x, kv
+
+
+@partial(jax.jit, static_argnums=(0,))
+def _decode_norm_head(cfg: LlamaConfig, norm_params, head_params, x):
+    """x [B, S, 1, D] -> float32 next-token distributions [B, S, V]."""
+    from flexible_llm_sharding_tpu.ops import rms_norm
+
+    h = rms_norm(x, norm_params["scale"], cfg.rms_norm_eps)
+    return jax.vmap(llama.lm_head_scores, in_axes=(None, 0))(head_params, h)
+
+
+# ---------------------------------------------------------------------------
+# KV parking between shards / steps
+# ---------------------------------------------------------------------------
+
+class KVStore:
+    """Per-(shard, block) KV pytrees: HBM-resident ('tpu') or host RAM ('cpu'
+    and 'disk' — decode-mode KV always parks in RAM; its per-step access
+    pattern would thrash a disk)."""
+
+    def __init__(self, on_device: bool):
+        self.on_device = on_device
+        self._mem: dict[tuple, Any] = {}
+
+    def put(self, key: tuple, kv) -> None:
+        self._mem[key] = kv if self.on_device else jax.device_get(kv)
+
+    def get(self, key: tuple, device=None):
+        kv = self._mem.pop(key)
+        return kv if self.on_device else jax.device_put(kv, device)
+
+    def clear(self) -> None:
+        self._mem.clear()
+
+
+# ---------------------------------------------------------------------------
+# The decode generator
+# ---------------------------------------------------------------------------
+
+class DecodeGenerator:
+    """Streaming generation with KV reuse across tokens.
+
+    ``__call__(prompts)`` -> (scores, updated_prompts) with the same output
+    shapes as the slow loop: one float32 [n_suffixes, num_gen_token, vocab]
+    per prompt and suffix strings grown by the decoded tokens.
+    """
+
+    def __init__(self, cfg: FrameworkConfig, device=None, tokenizer=None):
+        self.cfg = cfg
+        self.model_cfg = LlamaConfig.from_pretrained(cfg.model_path)
+        self.device = device
+        self.dtype = _DTYPES[cfg.dtype]
+        if tokenizer is None:
+            from transformers import AutoTokenizer
+
+            tokenizer = AutoTokenizer.from_pretrained(cfg.model_path)
+        self.raw_tokenizer = tokenizer
+        self.tokenizer = PromptTokenizer(
+            tokenizer,
+            max_token_len=cfg.max_token_len,
+            bucket_multiple=cfg.bucket_multiple,
+        )
+        self.layer_names = checkpoint.layer_names_for(
+            self.model_cfg.num_hidden_layers, tie_word_embeddings=False
+        )
+        self.plan = plan_shards_dp(len(self.layer_names), cfg.layer_num_per_shard)
+        self.stats: dict[str, float] = {}
+
+    def _source(self) -> ShardWeightSource:
+        return ShardWeightSource(
+            self.cfg.model_path,
+            self.layer_names,
+            self.plan.shards,
+            np_dtype_for(self.cfg.dtype),
+            device=self.device,
+            prefetch_depth=self.cfg.prefetch_depth,
+            tied_embeddings=self.model_cfg.tie_word_embeddings,
+        )
+
+    def __call__(self, prompts, num_gen_token: int | None = None):
+        cfg = self.cfg
+        n_gen = num_gen_token or cfg.num_gen_token
+        t_start = time.perf_counter()
+        toks = [self.tokenizer(p, s) for p, s in prompts]
+        blocks = make_blocks(toks, cfg.block_size)
+        kv_store = KVStore(on_device=cfg.storage_location == "tpu")
+        n_layers = len(self.layer_names)
+
+        block_meta = {
+            b: (
+                jnp.asarray(np.stack([toks[i].prefix_ids for i in idxs])),
+                jnp.asarray(np.stack([toks[i].suffix_ids for i in idxs])),
+                jnp.asarray(np.array([toks[i].prefix_len for i in idxs], np.int32)),
+                jnp.asarray(np.stack([toks[i].suffix_eos for i in idxs])),
+            )
+            for b, idxs in enumerate(blocks)
+        }
+        # Per-block score accumulators [B, S, n_gen, V] and token histories.
+        all_scores: dict[int, list[np.ndarray]] = {b: [] for b in range(len(blocks))}
+        tok_hist: dict[int, list[np.ndarray]] = {b: [] for b in range(len(blocks))}
+
+        # --- prefill: one streaming pass, capturing KV -------------------
+        source = self._source()
+        try:
+            for shard_pos, (layer_idxs, segments) in enumerate(source):
+                for b, idxs in enumerate(blocks):
+                    prefix_ids, suffix_ids, prefix_len, suffix_eos = block_meta[b]
+                    if layer_idxs[0] == 0:
+                        ph, sh = None, None
+                    else:
+                        ph, sh = kv_store.get(("h", b), self.device)
+                    for kind, params in segments:
+                        if kind == "embed":
+                            ph, sh = _embed_block(
+                                self.model_cfg, self.dtype, params, prefix_ids, suffix_ids
+                            )
+                        elif kind == "decoders":
+                            ph, sh, kv = _prefill_decoders(
+                                self.model_cfg, cfg.use_pallas, params, ph, sh, prefix_len
+                            )
+                            # Pre-extend with empty generated-token slots so
+                            # decode scans can donate in place.
+                            bsz, s_b = sh.shape[0], sh.shape[1]
+                            k_l = jax.tree.leaves(kv)[0].shape[0]
+                            # One slot per decode step (n_gen-1 of them);
+                            # min 1 so shapes stay non-degenerate at n_gen=1.
+                            gen_shape = (
+                                k_l, bsz, s_b, max(1, n_gen - 1),
+                                self.model_cfg.num_key_value_heads,
+                                self.model_cfg.head_dim,
+                            )
+                            # Two distinct buffers: kg/vg are donated by the
+                            # decode scan and must not alias.
+                            kv = {
+                                **kv,
+                                "kg": jnp.zeros(gen_shape, self.dtype),
+                                "vg": jnp.zeros(gen_shape, self.dtype),
+                            }
+                            kv_store.put(("kv", shard_pos, b), kv)
+                        elif kind == "norm":
+                            sh = _norm_block(self.model_cfg, params, sh, suffix_eos)
+                            ph = None
+                        else:  # head
+                            dist = np.asarray(jax.device_get(_head_block(params, sh)))
+                            all_scores[b].append(dist)
+                            tok_hist[b].append(np.argmax(dist, axis=-1))
+                    if layer_idxs[-1] != n_layers - 1:
+                        kv_store.put(("h", b), (ph, sh))
+        finally:
+            source.close()
+
+        # --- decode steps: stream weights, one token per suffix ----------
+        for t in range(n_gen - 1):
+            source = self._source()
+            # model.norm always executes before lm_head; its params (set at
+            # the norm shard) are carried here across shard iterations when
+            # the two land in different shards (layer_num_per_shard=1).
+            norm_params = None
+            try:
+                for shard_pos, (layer_idxs, segments) in enumerate(source):
+                    for b, idxs in enumerate(blocks):
+                        _, _, prefix_len, suffix_eos = block_meta[b]
+                        if layer_idxs[0] == 0:
+                            x = None
+                        else:
+                            x = kv_store.get(("x", b), self.device)
+                        for kind, params in segments:
+                            if kind == "embed":
+                                ids = jnp.asarray(
+                                    tok_hist[b][-1][..., None], jnp.int32
+                                )
+                                x = llama.embed(params, ids, self.dtype)
+                            elif kind == "decoders":
+                                kv = kv_store.get(("kv", shard_pos, b), self.device)
+                                x, kv = _decode_decoders(
+                                    self.model_cfg, params, kv, x,
+                                    prefix_len, suffix_eos, jnp.int32(t),
+                                )
+                                kv_store.put(("kv", shard_pos, b), kv)
+                            elif kind == "norm":
+                                norm_params = params  # applied inside the head
+                            else:  # head
+                                assert norm_params is not None
+                                dist = np.asarray(
+                                    jax.device_get(
+                                        _decode_norm_head(
+                                            self.model_cfg, norm_params, params, x
+                                        )
+                                    )
+                                )
+                                all_scores[b].append(dist)
+                                tok_hist[b].append(np.argmax(dist, axis=-1))
+                        if layer_idxs[-1] != n_layers - 1:
+                            kv_store.put(("x", b), x)
+            finally:
+                source.close()
+
+        kv_store.clear()
+        self.stats = {"total_wall_s": time.perf_counter() - t_start}
+
+        # --- assemble outputs in prompt order ----------------------------
+        scores_out: list[np.ndarray] = [None] * len(prompts)  # type: ignore
+        updated: list = list(prompts)
+        for b, idxs in enumerate(blocks):
+            stacked = np.stack(all_scores[b], axis=2)  # [B, S, n_gen, V]
+            hist = np.stack(tok_hist[b], axis=2)  # [B, S, n_gen]
+            for row, i in enumerate(idxs):
+                s_true = toks[i].num_suffixes
+                scores_out[i] = stacked[row, :s_true]
+                prefix, sfx = prompts[i]
+                updated[i] = (
+                    prefix,
+                    tuple(
+                        s + self.raw_tokenizer.decode(hist[row, s_i])
+                        for s_i, s in enumerate(sfx)
+                    ),
+                )
+        return scores_out, updated
+
+
+__all__ = ["DecodeGenerator", "KVStore"]

@@ -76,6 +76,27 @@ def _embed_block(cfg: LlamaConfig, dtype, embed_params, prefix_ids, suffix_ids):
 
 
 @partial(jax.jit, static_argnums=(0, 5), donate_argnums=(2, 3))
+def _decoder_block_kv(
+    cfg: LlamaConfig, stacked, prefix_h, suffix_h, prefix_len, use_pallas=False
+):
+    """Like :func:`_decoder_block`, additionally emitting every layer's
+    post-RoPE KV as scan outputs (leaves [k, B, ...]) — the prefill half of
+    the KV-cache decode mode (runtime/decode.py)."""
+    step = jax.vmap(
+        partial(llama.prefix_suffix_layer, use_pallas=use_pallas, return_kv=True),
+        in_axes=(None, None, 0, 0, 0),
+    )
+
+    def body(carry, layer_params):
+        p, s = carry
+        p, s, kv = step(layer_params, cfg, p, s, prefix_len)
+        return (p, s), kv
+
+    (prefix_h, suffix_h), kv = jax.lax.scan(body, (prefix_h, suffix_h), stacked)
+    return prefix_h, suffix_h, kv
+
+
+@partial(jax.jit, static_argnums=(0, 5), donate_argnums=(2, 3))
 def _decoder_block(
     cfg: LlamaConfig, stacked, prefix_h, suffix_h, prefix_len, use_pallas=False
 ):

@@ -1,0 +1,136 @@
+"""KV-cache decode mode: greedy tokens and per-step distributions must match
+a token-level monolithic oracle (forward_full re-run on the growing ids)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from flexible_llm_sharding_tpu.config import FrameworkConfig
+from flexible_llm_sharding_tpu.models import llama
+from flexible_llm_sharding_tpu.runtime.decode import DecodeGenerator
+from flexible_llm_sharding_tpu.runtime.tokenization import PromptTokenizer
+from flexible_llm_sharding_tpu.utils.checkpoint import save_params
+
+from tests.fake_tokenizer import FakeTokenizer
+
+PROMPTS = [
+    ("The capital of France", (" is Paris", " is Rome")),
+    ("Two plus two equals", (" four", " five", " fish")),
+]
+
+N_GEN = 3
+
+
+@pytest.fixture(scope="module")
+def model(tiny_cfg, tmp_path_factory):
+    params = llama.init_params(jax.random.PRNGKey(0), tiny_cfg)
+    d = tmp_path_factory.mktemp("tiny_model_decode")
+    save_params(jax.tree.map(np.asarray, params), str(d), tiny_cfg)
+    return str(d), params
+
+
+def _oracle(params, cfg, tok, prompts, n_gen):
+    """Token-level greedy decode per suffix via the monolithic forward."""
+    out_scores, out_tokens = [], []
+    for prefix, suffixes in prompts:
+        t = tok(prefix, suffixes)
+        rows_s, rows_t = [], []
+        for s in range(t.num_suffixes):
+            ids = np.concatenate(
+                [t.prefix_ids[: t.prefix_len], t.suffix_ids[s, : int(t.suffix_eos[s]) + 1]]
+            )
+            dists, toks_ = [], []
+            for _ in range(n_gen):
+                logits = llama.forward_full(params, cfg, jnp.asarray(ids[None]))
+                dist = np.asarray(jax.nn.softmax(logits[0, -1]))
+                nxt = int(dist.argmax())
+                dists.append(dist)
+                toks_.append(nxt)
+                ids = np.concatenate([ids, [nxt]])
+            rows_s.append(np.stack(dists))
+            rows_t.append(toks_)
+        out_scores.append(np.stack(rows_s))  # [S, n_gen, V]
+        out_tokens.append(rows_t)
+    return out_scores, out_tokens
+
+
+@pytest.mark.parametrize("storage,lnps", [("cpu", 1), ("tpu", 2), ("cpu", 100)])
+def test_decode_matches_token_level_oracle(tiny_cfg, model, storage, lnps):
+    model_dir, params = model
+    cfg = FrameworkConfig(
+        model_path=model_dir,
+        layer_num_per_shard=lnps,
+        storage_location=storage,
+        dtype="float32",
+        bucket_multiple=8,
+        block_size=2,
+        prefetch_depth=0,
+        num_gen_token=N_GEN,
+    )
+    gen = DecodeGenerator(cfg, tokenizer=FakeTokenizer())
+    scores, updated = gen(list(PROMPTS))
+
+    tok = PromptTokenizer(FakeTokenizer(), bucket_multiple=8)
+    want_scores, want_tokens = _oracle(params, tiny_cfg, tok, PROMPTS, N_GEN)
+
+    for i, (_, sfx) in enumerate(PROMPTS):
+        assert scores[i].shape == (len(sfx), N_GEN, tiny_cfg.vocab_size)
+        np.testing.assert_allclose(
+            scores[i], want_scores[i], rtol=2e-4, atol=1e-5
+        )
+        got_tokens = scores[i].argmax(-1)
+        assert got_tokens.tolist() == want_tokens[i]
+
+    # Updated prompts grow by the decoded token text.
+    for (_, sfx), (_, usfx) in zip(PROMPTS, updated):
+        for orig, new in zip(sfx, usfx):
+            assert new.startswith(orig) and len(new) > len(orig)
+
+
+def test_decode_cli(tiny_cfg, model, tmp_path):
+    import pickle
+
+    from flexible_llm_sharding_tpu.cli import main
+
+    model_dir, _ = model
+    ppkl, opkl = tmp_path / "p.pkl", tmp_path / "s.pkl"
+    with open(ppkl, "wb") as f:
+        pickle.dump(PROMPTS[:1], f)
+    main(
+        [
+            "--model_path", model_dir,
+            "--prompt_pickle", str(ppkl),
+            "--output_file", str(opkl),
+            "--num_gen_token", "2",
+            "--dtype", "float32",
+            "--kv_cache", "true",
+            "--num_devices", "1",
+        ],
+        tokenizer=FakeTokenizer(),
+    )
+    import pickle as pkl
+
+    with open(opkl, "rb") as f:
+        scores = pkl.load(f)
+    assert scores[0].shape == (2, 2, tiny_cfg.vocab_size)
+
+
+def test_decode_single_token(tiny_cfg, model):
+    """n_gen=1 degenerates to a pure scoring pass."""
+    model_dir, params = model
+    cfg = FrameworkConfig(
+        model_path=model_dir,
+        storage_location="cpu",
+        dtype="float32",
+        bucket_multiple=8,
+        prefetch_depth=0,
+        num_gen_token=1,
+    )
+    gen = DecodeGenerator(cfg, tokenizer=FakeTokenizer())
+    scores, _ = gen(list(PROMPTS))
+    tok = PromptTokenizer(FakeTokenizer(), bucket_multiple=8)
+    want_scores, _ = _oracle(params, tiny_cfg, tok, PROMPTS, 1)
+    for got, want in zip(scores, want_scores):
+        np.testing.assert_allclose(got, want, rtol=2e-4, atol=1e-5)
