@@ -74,6 +74,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="write a jax.profiler (Perfetto/XProf) trace here")
     p.add_argument("--resume", type=_str2bool, default=False,
                    help="disk mode: resume from the last completed shard")
+    p.add_argument("--long_context", type=_str2bool, default=False,
+                   help="score prefixes longer than max_token_len exactly "
+                        "via sequence parallelism (cap becomes "
+                        "n_chips * max_token_len) instead of truncating")
     p.add_argument("--coordinator_address", type=str, default=None,
                    help="multi-host (DCN) cluster coordinator, host:port; "
                         "omit for single-host")
@@ -101,6 +105,7 @@ def config_from_args(args: argparse.Namespace) -> FrameworkConfig:
         verbose_metrics=args.verbose_metrics,
         profile_dir=args.profile_dir,
         resume=args.resume,
+        long_context=args.long_context,
     )
 
 
@@ -129,8 +134,41 @@ def main(argv: list[str] | None = None, tokenizer=None) -> None:
     with open(args.prompt_pickle, "rb") as f:
         prompts = pickle.load(f)
 
+    import jax
+
+    def _updated_path(p: str, rank: int | None = None) -> str:
+        # Robust form of the reference's .replace('.pkl', '_updated.pkl')
+        # contract (/root/reference/main.py:92-94): only the extension is
+        # rewritten, so an input without '.pkl' is never silently clobbered.
+        root, ext = os.path.splitext(p)
+        tag = "_updated" if rank is None else f"_updated.rank{rank}"
+        return f"{root}{tag}{ext or '.pkl'}"
+
+    if jax.process_count() > 1:
+        # Multi-host: each process scores its own contiguous prompt slice
+        # (array_split semantics, matching DP) on its LOCAL chips, and writes
+        # rank-suffixed output files — otherwise every host would run the
+        # full workload and race on the same pickles.
+        from flexible_llm_sharding_tpu.parallel.planner import split_prompts_dp
+
+        rank = jax.process_index()
+        lo, hi = split_prompts_dp(len(prompts), jax.process_count())[rank]
+        prompts = prompts[lo:hi]
+        output_file = f"{args.output_file}.rank{rank}"
+        updated_file = _updated_path(args.prompt_pickle, rank)
+        print(
+            f"process {rank}: prompts [{lo}:{hi}) -> {output_file}",
+            file=sys.stderr,
+        )
+    else:
+        output_file = args.output_file
+        updated_file = _updated_path(args.prompt_pickle)
+
     from flexible_llm_sharding_tpu.runtime.generation import generation_loop
-    from flexible_llm_sharding_tpu.runtime.orchestration import run_prompts
+    from flexible_llm_sharding_tpu.runtime.orchestration import (
+        pick_devices,
+        run_prompts,
+    )
 
     if tokenizer is None:
         from transformers import AutoTokenizer
@@ -146,25 +184,51 @@ def main(argv: list[str] | None = None, tokenizer=None) -> None:
         throughput,
     )
 
+    from flexible_llm_sharding_tpu.runtime.tokenization import count_tokens
+
+    # tokens_processed counts every real prefix/suffix token each full-model
+    # pass runs — the same accounting bench.py and BASELINE.md use (the
+    # reference's stats count only generated tokens, which understates the
+    # work by orders of magnitude for scoring workloads).
+    tokens_processed = 0
+
     t0 = time.perf_counter()
     with profiler_trace(cfg.profile_dir or None):
         if args.kv_cache:
             if args.temperature > 0:
                 raise SystemExit("--kv_cache supports greedy decoding only")
-            from flexible_llm_sharding_tpu.runtime.decode import DecodeGenerator
-            from flexible_llm_sharding_tpu.runtime.orchestration import pick_devices
+            if cfg.long_context:
+                raise SystemExit(
+                    "--long_context is not supported with --kv_cache yet; "
+                    "use the default generation loop for over-length prefixes"
+                )
+            from flexible_llm_sharding_tpu.runtime.orchestration import run_decode
 
             devs = pick_devices(cfg)
-            if len(devs) > 1:
+            if len(devs) > 1 and not cfg.data_parallel:
                 raise SystemExit(
-                    "--kv_cache is single-device; pass --num_devices 1 or "
-                    "use the default generation loop for multi-chip runs"
+                    "--kv_cache on multiple chips requires --data_parallel "
+                    "true (prompt-split decode); the interleaved MP pipeline "
+                    "has no KV-cache mode — or pass --num_devices 1"
                 )
-            gen = DecodeGenerator(cfg, device=devs[0], tokenizer=tokenizer)
-            output_scores, updated = gen(prompts)
+            output_scores, updated, tokens_processed = run_decode(
+                cfg, prompts, tokenizer=tokenizer
+            )
         else:
+
+            # Long-context mode actually processes prefixes up to
+            # n_chips * max_token_len; count with the same cap.
+            count_cap = cfg.max_token_len * (
+                len(pick_devices(cfg)) if cfg.long_context else 1
+            )
+
+            def score_fn(ps):
+                nonlocal tokens_processed
+                tokens_processed += count_tokens(tokenizer, ps, count_cap)
+                return run_prompts(cfg, ps, tokenizer=tokenizer)
+
             output_scores, updated = generation_loop(
-                lambda ps: run_prompts(cfg, ps, tokenizer=tokenizer),
+                score_fn,
                 prompts,
                 cfg.num_gen_token,
                 tokenizer,
@@ -173,20 +237,20 @@ def main(argv: list[str] | None = None, tokenizer=None) -> None:
     wall = time.perf_counter() - t0
 
     # Reference file contract (/root/reference/main.py:92-98).
-    with open(args.prompt_pickle.replace(".pkl", "_updated.pkl"), "wb") as f:
+    with open(updated_file, "wb") as f:
         pickle.dump(updated, f)
-    with open(args.output_file, "wb") as f:
+    with open(output_file, "wb") as f:
         pickle.dump(output_scores, f)
     # Final stats line — the reference prints its per-device weight-load time
     # here (/root/reference/utils.py:304); ours adds throughput and peak HBM.
-    from flexible_llm_sharding_tpu.runtime.orchestration import pick_devices
-
     gen_tokens = sum(s.shape[0] for s in output_scores) * cfg.num_gen_token
     stats = {
         "prompts": len(prompts),
         "num_gen_token": cfg.num_gen_token,
         "wall_s": round(wall, 3),
-        **throughput(gen_tokens, wall, chips=len(pick_devices(cfg))),
+        "generated_tokens": gen_tokens,
+        "tokens_processed": tokens_processed,
+        **throughput(tokens_processed, wall, chips=len(pick_devices(cfg))),
     }
     peak = peak_hbm_gb()
     if peak is not None:

@@ -127,6 +127,11 @@ class FrameworkConfig:
     verbose_metrics: bool = False  # one JSON line per structured event (stderr)
     profile_dir: str = ""  # jax.profiler trace output dir ("" = off)
     resume: bool = False  # disk mode: resume from the last completed shard
+    # Long context: prompts whose PREFIX exceeds max_token_len are scored
+    # exactly via sequence parallelism (ring attention over an 'sp' mesh of
+    # the visible chips; cap becomes n_chips * max_token_len) instead of the
+    # reference's silent truncation (/root/reference/utils.py:14,250,254).
+    long_context: bool = False
 
     def __post_init__(self) -> None:
         loc = self.storage_location
@@ -138,3 +143,8 @@ class FrameworkConfig:
             raise ValueError("layer_num_per_shard must be >= 1")
         if self.num_batch < 1:
             raise ValueError("num_batch must be >= 1")
+        if self.num_gen_token < 1:
+            # 0 would deadlock DP decode: the broadcast source is built with
+            # rounds=num_gen_token, so its producer would push nothing while
+            # every consumer blocks on an empty queue.
+            raise ValueError("num_gen_token must be >= 1")

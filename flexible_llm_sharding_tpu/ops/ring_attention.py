@@ -129,12 +129,18 @@ def ring_decoder_layer(
     x: jax.Array,
     mesh: Mesh,
     axis: str = "sp",
+    return_kv: bool = False,
 ) -> jax.Array:
     """A full decoder layer with sequence-parallel (ring) attention.
 
     x: [L, D] sharded over ``axis``. RoPE positions are global (the chip's
     block offset is folded in under shard_map). Elementwise/matmul parts
     run purely locally on each chip's sequence block.
+
+    ``return_kv=True`` additionally returns this layer's post-RoPE (k, v)
+    [L, n_kv, hd], still sharded over ``axis`` — the long-context scorer
+    feeds them to the suffix side's sharded-prefix attention
+    (runtime/longcontext.py).
     """
     from flexible_llm_sharding_tpu.models import llama
     from flexible_llm_sharding_tpu.ops import apply_rope, rms_norm, rope_cos_sin
@@ -164,12 +170,15 @@ def ring_decoder_layer(
         h = rms_norm(mid, params["post_attention_layernorm"]["scale"], eps)
         return mid + llama._mlp(params["mlp"], h)
 
-    return jax.shard_map(
+    out = jax.shard_map(
         local_tail,
         mesh=mesh,
         in_specs=(spec, P(axis, None, None)),
         out_specs=spec,
     )(x0, attn)
+    if return_kv:
+        return out, k, v
+    return out
 
 
 __all__ = ["ring_self_attention", "ring_decoder_layer"]

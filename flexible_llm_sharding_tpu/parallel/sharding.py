@@ -55,6 +55,8 @@ def initialize_multihost(
     coordinator address that fails to connect raises (a silent fallback to
     single-host would duplicate work and corrupt results).
     """
+    if jax.distributed.is_initialized():
+        return jax.process_index()
     try:
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
@@ -62,15 +64,13 @@ def initialize_multihost(
             process_id=process_id,
         )
     except RuntimeError as e:
-        msg = str(e).lower()
-        benign = "already initialized" in msg or (
-            # Backends already up (too late to join) is tolerable only when
-            # we were auto-detecting, not when a cluster was explicitly
-            # requested — joining would have to precede any JAX call.
-            coordinator_address is None
-            and "before" in msg
-        )
-        if not benign:
+        # An explicitly requested cluster must never silently degrade —
+        # duplicate single-host runs would race on output files. In
+        # auto-detect mode the ONLY benign RuntimeError is the called-after-
+        # backend-init guard; a detected cluster that fails to join (e.g.
+        # coordinator connect timeout) must raise too, so unmatched messages
+        # re-raise — fail-loud if JAX ever rewords the guard.
+        if coordinator_address is not None or "before" not in str(e).lower():
             raise
     except ValueError:
         # Auto-detection failed (no cluster env) — fine only if the caller

@@ -64,6 +64,13 @@ class ActivationStore:
         self.max_in_cpu = max_in_cpu
         self._cpu_prompts = 0
         self._spilled: set[object] = set()
+        # cpu-mode async offload: the most recent store keeps its device
+        # arrays (host DMA started via copy_to_host_async) and is finalised
+        # to numpy one store later — so the driver thread never blocks on a
+        # device->host copy in the hot loop (the per-store jax.device_get
+        # was the host sync that serialised MP pipeline stages). Depth 1
+        # bounds the extra HBM to one block's activations.
+        self._pending: list[object] = []
         if location == "disk":
             os.makedirs(disk_folder, exist_ok=True)
 
@@ -100,6 +107,16 @@ class ActivationStore:
         if self.location == "tpu":
             self._mem[block_id] = (prefix_h, suffix_h)
         elif self.location == "cpu":
+            if block_id in self._spilled:
+                # A re-store of a currently-spilled block supersedes the disk
+                # copy; drop it so fetch() can't return stale data.
+                self._spilled.discard(block_id)
+                for idx in prompt_idxs:
+                    for path in self._paths(idx):
+                        try:
+                            os.remove(path)
+                        except OSError:
+                            pass
             over = (
                 self.max_in_cpu is not None
                 and self._cpu_prompts + len(prompt_idxs) > self.max_in_cpu
@@ -111,16 +128,33 @@ class ActivationStore:
                 return
             if block_id not in self._mem:
                 self._cpu_prompts += len(prompt_idxs)
-            self._mem[block_id] = (
-                None if prefix_h is None else jax.device_get(prefix_h),
-                jax.device_get(suffix_h),
-            )
+            for a in (prefix_h, suffix_h):
+                if hasattr(a, "copy_to_host_async"):
+                    a.copy_to_host_async()
+            self._mem[block_id] = (prefix_h, suffix_h)
+            if block_id not in self._pending:
+                self._pending.append(block_id)
+            while len(self._pending) > 1:
+                self._finalize(self._pending.pop(0))
         else:  # disk — one file pair per prompt, reference contract
             self._store_disk(prompt_idxs, prefix_h, suffix_h)
+
+    def _finalize(self, block_id) -> None:
+        """Resolve a cpu-mode block's pending async copy to host numpy,
+        releasing its device buffers."""
+        if block_id in self._mem:
+            p, s = self._mem[block_id]
+            self._mem[block_id] = (
+                None if p is None else np.asarray(p),
+                np.asarray(s),
+            )
 
     def fetch(self, block_id, prompt_idxs: list[int], with_prefix: bool = True):
         """Returns (prefix_h | None, suffix_h) as host or device arrays; the
         executor device_puts them as part of the next shard's input feed."""
+        if self.location == "cpu" and block_id in self._pending:
+            self._pending.remove(block_id)
+            self._finalize(block_id)
         if self.location == "cpu" and block_id in self._spilled:
             self._spilled.discard(block_id)
             return self._fetch_disk(prompt_idxs, with_prefix)
@@ -136,6 +170,7 @@ class ActivationStore:
     def clear(self) -> None:
         self._mem.clear()
         self._spilled.clear()
+        self._pending.clear()
         self._cpu_prompts = 0
 
 

@@ -141,7 +141,18 @@ class DecodeGenerator:
     per prompt and suffix strings grown by the decoded tokens.
     """
 
-    def __init__(self, cfg: FrameworkConfig, device=None, tokenizer=None):
+    def __init__(
+        self,
+        cfg: FrameworkConfig,
+        device=None,
+        tokenizer=None,
+        weight_source_factory=None,
+    ):
+        # weight_source_factory: DP mode passes views of one shared
+        # BroadcastShardSource (rounds = num_gen_token: one per weight
+        # stream — prefill plus each decode step) so the checkpoint is read
+        # from disk once for all chips; see orchestration.run_decode.
+        self.weight_source_factory = weight_source_factory
         self.cfg = cfg
         self.model_cfg = LlamaConfig.from_pretrained(cfg.model_path)
         self.device = device
@@ -162,7 +173,9 @@ class DecodeGenerator:
         self.plan = plan_shards_dp(len(self.layer_names), cfg.layer_num_per_shard)
         self.stats: dict[str, float] = {}
 
-    def _source(self) -> ShardWeightSource:
+    def _source(self):
+        if self.weight_source_factory is not None:
+            return self.weight_source_factory()
         return ShardWeightSource(
             self.cfg.model_path,
             self.layer_names,
@@ -292,7 +305,15 @@ class DecodeGenerator:
                 source.close()
 
         kv_store.clear()
-        self.stats = {"total_wall_s": time.perf_counter() - t_start}
+        self.stats = {
+            "total_wall_s": time.perf_counter() - t_start,
+            # Prefill runs every real prompt token once; each decode step
+            # then runs exactly one new token per true suffix.
+            "tokens_processed": float(
+                sum(t.tokens_processed for t in toks)
+                + sum(t.num_suffixes for t in toks) * max(n_gen - 1, 0)
+            ),
+        }
 
         # --- assemble outputs in prompt order ----------------------------
         scores_out: list[np.ndarray] = [None] * len(prompts)  # type: ignore

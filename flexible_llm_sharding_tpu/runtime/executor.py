@@ -76,27 +76,6 @@ def _embed_block(cfg: LlamaConfig, dtype, embed_params, prefix_ids, suffix_ids):
 
 
 @partial(jax.jit, static_argnums=(0, 5), donate_argnums=(2, 3))
-def _decoder_block_kv(
-    cfg: LlamaConfig, stacked, prefix_h, suffix_h, prefix_len, use_pallas=False
-):
-    """Like :func:`_decoder_block`, additionally emitting every layer's
-    post-RoPE KV as scan outputs (leaves [k, B, ...]) — the prefill half of
-    the KV-cache decode mode (runtime/decode.py)."""
-    step = jax.vmap(
-        partial(llama.prefix_suffix_layer, use_pallas=use_pallas, return_kv=True),
-        in_axes=(None, None, 0, 0, 0),
-    )
-
-    def body(carry, layer_params):
-        p, s = carry
-        p, s, kv = step(layer_params, cfg, p, s, prefix_len)
-        return (p, s), kv
-
-    (prefix_h, suffix_h), kv = jax.lax.scan(body, (prefix_h, suffix_h), stacked)
-    return prefix_h, suffix_h, kv
-
-
-@partial(jax.jit, static_argnums=(0, 5), donate_argnums=(2, 3))
 def _decoder_block(
     cfg: LlamaConfig, stacked, prefix_h, suffix_h, prefix_len, use_pallas=False
 ):
@@ -189,10 +168,46 @@ def process_block(
     if block_scores is not None:
         for row, i in enumerate(idxs):
             s_true = toks[i].num_suffixes
-            scores[i] = np.expand_dims(block_scores[row, :s_true], axis=1)
+            # Device-resident [s_true, 1, V] slice; the host copy starts now
+            # (async DMA) and is resolved by finalize_scores at run end.
+            row_scores = block_scores[row, :s_true, None, :]
+            row_scores.copy_to_host_async()
+            scores[i] = row_scores
     if last != n_layers - 1:
         store.store(b, idxs, prefix_h, suffix_h)
     return suffix_h
+
+
+class ScoreSink(dict):
+    """Per-prompt score collector (prompt_idx -> [S, 1, V]).
+
+    Head-stage slices arrive as device arrays with their host DMA already
+    started (copy_to_host_async); keeping them ALL device-resident until run
+    end would grow HBM with prompt count, so only the newest ``max_device``
+    stay pending — older ones resolve to host numpy (their copy has had
+    whole blocks of compute to finish, so the wait is ~free). The driver
+    thread stays sync-free in the hot loop either way.
+    """
+
+    def __init__(self, max_device: int = 16):
+        super().__init__()
+        self._pending: list = []
+        self.max_device = max_device
+
+    def __setitem__(self, k, v):
+        super().__setitem__(k, v)
+        if hasattr(v, "copy_to_host_async"):
+            self._pending.append(k)
+            while len(self._pending) > self.max_device:
+                kk = self._pending.pop(0)
+                super().__setitem__(kk, np.asarray(jax.device_get(self[kk])))
+
+
+def finalize_scores(scores: dict) -> None:
+    """Resolve the remaining device score slices to host numpy in place —
+    the run's final host sync point (replaces a device_get per block)."""
+    for i, s in scores.items():
+        scores[i] = np.asarray(jax.device_get(s))
 
 
 def apply_segments(
@@ -210,8 +225,11 @@ def apply_segments(
     """Run one shard's segments over a block.
 
     Returns (prefix_h, suffix_h, block_scores) where block_scores is the
-    float32 [B, S, V] host array if this shard contained the lm_head, else
-    None. Shared by the single-device executor and the MP pipeline runner.
+    float32 [B, S, V] DEVICE array if this shard contained the lm_head, else
+    None — no host sync here: a device_get per block would stall the driver
+    thread and serialise pipeline stages; callers convert to numpy once at
+    the end of the run. Shared by the single-device executor and the MP
+    pipeline runner.
     """
     block_scores = None
     for kind, params in segments:
@@ -227,7 +245,7 @@ def apply_segments(
             suffix_h = _norm_block(model_cfg, params, suffix_h, suffix_eos)
             prefix_h = None
         else:  # head
-            block_scores = np.asarray(jax.device_get(_head_block(params, suffix_h)))
+            block_scores = _head_block(params, suffix_h)
     return prefix_h, suffix_h, block_scores
 
 
@@ -530,6 +548,12 @@ class BroadcastShardSource:
         self._loader.close()
 
 
+class SourceClosed(RuntimeError):
+    """The shared weight source was closed mid-stream — a *secondary* error:
+    some other DP worker failed first and orchestration closed the source to
+    unblock everyone. Orchestration surfaces the root cause instead."""
+
+
 class _BroadcastView:
     """One executor-side round of a BroadcastShardSource for one chip."""
 
@@ -558,7 +582,7 @@ class _BroadcastView:
                     break
                 except Empty:
                     if self._parent._stop.is_set():
-                        raise RuntimeError(
+                        raise SourceClosed(
                             "BroadcastShardSource closed while streaming "
                             "(another DP worker failed?)"
                         ) from None
@@ -713,14 +737,17 @@ class StreamingExecutor:
             rank_tag=self.plan.num_devices > 1 and self.cfg.data_parallel,
             max_in_cpu=self.cfg.max_activation_in_cpu,
         )
-        resumable = (
-            self.cfg.storage_location == "disk"
-            and self.weight_source_factory is None
-        )
+        resumable = self.cfg.storage_location == "disk"
         sig = self._resume_signature(toks) if resumable else ""
         start_shard = self._resume_start(store, sig) if resumable else 0
         if self.weight_source_factory is not None:
+            # Shared (DP broadcast) source: it streams EVERY shard to every
+            # chip — a resuming rank cannot slice the stream, so it consumes
+            # and discards the already-completed shards' weights instead
+            # (skip below). Each rank keeps its own progress marker (the
+            # store's rank tag), so ranks may resume from different shards.
             source = self.weight_source_factory()
+            skip = start_shard
         else:
             source = ShardWeightSource(
                 self.cfg.model_path,
@@ -731,8 +758,9 @@ class StreamingExecutor:
                 prefetch_depth=self.cfg.prefetch_depth,
                 tied_embeddings=self.model_cfg.tie_word_embeddings,
             )
+            skip = 0
 
-        scores: dict[int, np.ndarray] = {}
+        scores: dict[int, np.ndarray] = ScoreSink()
         # Per-block device-resident metadata, uploaded once.
         block_meta = {}
         for b, idxs in enumerate(blocks):
@@ -747,17 +775,28 @@ class StreamingExecutor:
 
         def on_shard_done(local_idx: int) -> None:
             if resumable:
-                done = start_shard + local_idx + 1
+                # Own source yields from start_shard; a shared source yields
+                # from 0 with the skipped prefix re-marked harmlessly.
+                done = local_idx + 1 + (0 if skip else start_shard)
                 if done < len(self.plan.shards):  # final shard re-runs always
                     self._mark_progress(store, sig, done)
 
         compute_time = 0.0
         try:
             compute_time = self._stream(
-                source, store, toks, blocks, block_meta, scores, on_shard_done
+                source,
+                store,
+                toks,
+                blocks,
+                block_meta,
+                scores,
+                on_shard_done,
+                n_shards=len(self.plan.shards) - start_shard,
+                skip=skip,
             )
         finally:
             source.close()
+        finalize_scores(scores)
         if resumable:  # completed: drop the marker
             try:
                 os.remove(self._progress_path(store))
@@ -769,6 +808,7 @@ class StreamingExecutor:
             "compute_wall_s": compute_time,
             "total_wall_s": time.perf_counter() - t_start,
             "num_layers_streamed": float(self.plan.num_local_layers),
+            "tokens_processed": float(sum(t.tokens_processed for t in toks)),
         }
         if getattr(source, "load_time_shared", False):
             # DP broadcast: the disk is read once for all chips; this stat is
@@ -788,41 +828,65 @@ class StreamingExecutor:
         return [scores[i] for i in range(len(prompts))]
 
     def _stream(
-        self, source, store, toks, blocks, block_meta, scores, on_shard_done=None
+        self,
+        source,
+        store,
+        toks,
+        blocks,
+        block_meta,
+        scores,
+        on_shard_done=None,
+        n_shards: int | None = None,
+        skip: int = 0,
     ) -> float:
         n_layers = len(self.layer_names)
         compute_time = 0.0
-        for shard_i, (layer_idxs, segments) in enumerate(source):
-            t0 = time.perf_counter()
-            for b, idxs in enumerate(blocks):
-                suffix_h = process_block(
-                    self.model_cfg,
-                    self.dtype,
-                    segments,
-                    layer_idxs,
-                    n_layers,
-                    store,
-                    b,
-                    idxs,
-                    block_meta[b],
-                    self.device,
-                    toks,
-                    scores,
-                    use_pallas=self.cfg.use_pallas,
-                )
-            # cpu/disk stores already synced via device_get; for tpu storage
-            # block once per shard so compute_wall_s measures device time (the
-            # prefetch thread keeps uploading the next shard concurrently).
-            # (blocks can be empty: num_batch > prompt count yields ex([]).)
-            if (
-                blocks
-                and layer_idxs[-1] != n_layers - 1
-                and self.cfg.storage_location == "tpu"
-            ):
-                jax.block_until_ready(suffix_h)
-            compute_time += time.perf_counter() - t0
-            if on_shard_done is not None:
-                on_shard_done(shard_i)
+        total = (n_shards or len(self.plan.shards)) * max(len(blocks), 1)
+        bar = metrics.progress_bar(total, desc="stream", unit="blk")
+        try:
+            for shard_i, (layer_idxs, segments) in enumerate(source):
+                if shard_i < skip:
+                    # Resume over a shared source: this shard already ran in
+                    # the crashed attempt; drop its broadcast weights unused.
+                    del segments
+                    continue
+                t0 = time.perf_counter()
+                for b, idxs in enumerate(blocks):
+                    suffix_h = process_block(
+                        self.model_cfg,
+                        self.dtype,
+                        segments,
+                        layer_idxs,
+                        n_layers,
+                        store,
+                        b,
+                        idxs,
+                        block_meta[b],
+                        self.device,
+                        toks,
+                        scores,
+                        use_pallas=self.cfg.use_pallas,
+                    )
+                    bar.update(1)
+                if not blocks:
+                    bar.update(1)
+                # disk stores sync via device_get; tpu/cpu stores are async
+                # (cpu: copy_to_host_async + depth-1 finalize), so block once
+                # per shard there to keep compute_wall_s a device-time
+                # measure — the prefetch thread keeps uploading the next
+                # shard concurrently. (blocks can be empty: num_batch >
+                # prompt count yields ex([]).)
+                if (
+                    blocks
+                    and layer_idxs[-1] != n_layers - 1
+                    and self.cfg.storage_location in ("tpu", "cpu")
+                ):
+                    jax.block_until_ready(suffix_h)
+                compute_time += time.perf_counter() - t0
+                if on_shard_done is not None:
+                    on_shard_done(shard_i)
+        finally:
+            bar.close()
         return compute_time
 
 
@@ -832,4 +896,7 @@ __all__ = [
     "BroadcastShardSource",
     "apply_segments",
     "process_block",
+    "finalize_scores",
+    "ScoreSink",
+    "SourceClosed",
 ]

@@ -10,7 +10,7 @@ async under XLA, so the threads overlap naturally without a GIL fight.
 
 from __future__ import annotations
 
-from concurrent.futures import ThreadPoolExecutor
+from concurrent.futures import FIRST_EXCEPTION, ThreadPoolExecutor, wait
 from typing import Sequence
 
 import jax
@@ -24,6 +24,7 @@ from flexible_llm_sharding_tpu.parallel.planner import (
 )
 from flexible_llm_sharding_tpu.runtime.executor import (
     BroadcastShardSource,
+    SourceClosed,
     StreamingExecutor,
     np_dtype_for,
 )
@@ -32,10 +33,39 @@ from flexible_llm_sharding_tpu.utils import checkpoint
 
 
 def pick_devices(cfg: FrameworkConfig) -> list:
-    devs = jax.devices()
+    # local_devices, not devices: the streaming executors device_put host
+    # arrays, which only works on THIS process's addressable chips. On a
+    # multi-host cluster each process runs its own prompt slice over its own
+    # chips (cli.py shards by process_index); jax.devices() would hand us
+    # remote, non-addressable devices and fail at the first transfer.
+    devs = jax.local_devices()
     if cfg.num_devices > 0:
         devs = devs[: cfg.num_devices]
     return devs
+
+
+def _gather_dp(pool: ThreadPoolExecutor, futures, source) -> list:
+    """Collect DP worker results without the consumer-crash deadlock: if a
+    worker dies it stops draining its broadcast queue, the producer blocks on
+    that full queue, and every OTHER rank starves — so on the first failure
+    the source is closed (unblocking all queues) BEFORE gathering, and the
+    root-cause exception is re-raised in preference to the secondary
+    SourceClosed errors the surviving workers die with."""
+    try:
+        done, _ = wait(futures, return_when=FIRST_EXCEPTION)
+        if any(f.exception() is not None for f in done):
+            source.close()
+            wait(futures)
+            root = None
+            for f in futures:
+                e = f.exception()
+                if e is not None and (root is None or isinstance(root, SourceClosed)):
+                    root = e
+            raise root
+        return [f.result() for f in futures]
+    finally:
+        source.close()
+        pool.shutdown(wait=True)
 
 
 def _run_batched(ex: StreamingExecutor, prompts: list[Prompt], num_batch: int):
@@ -57,6 +87,50 @@ def run_prompts(
     ``[n_suffixes, 1, vocab]`` array per prompt, in prompt order."""
     prompts = list(prompts)
     devices = devices if devices is not None else pick_devices(cfg)
+
+    if cfg.long_context:
+        # Prompts whose prefix overflows one chip's bucket are scored
+        # exactly over an sp mesh (ring attention); the reference truncates
+        # them instead (/root/reference/utils.py:250,254). The rest take
+        # the normal streaming path.
+        from flexible_llm_sharding_tpu.runtime.longcontext import (
+            LongContextScorer,
+            prefix_token_count,
+        )
+
+        if tokenizer is None:
+            from transformers import AutoTokenizer
+
+            tokenizer = AutoTokenizer.from_pretrained(cfg.model_path)
+        long_idx = [
+            i
+            for i, (p, _) in enumerate(prompts)
+            if prefix_token_count(tokenizer, p) > cfg.max_token_len
+        ]
+        if long_idx:
+            import dataclasses
+
+            scorer = LongContextScorer(cfg, devices=devices, tokenizer=tokenizer)
+            long_scores = scorer([prompts[i] for i in long_idx])
+            long_set = set(long_idx)
+            rest_idx = [i for i in range(len(prompts)) if i not in long_set]
+            rest_cfg = dataclasses.replace(cfg, long_context=False)
+            rest_scores = (
+                run_prompts(
+                    rest_cfg,
+                    [prompts[i] for i in rest_idx],
+                    tokenizer=tokenizer,
+                    devices=devices,
+                )
+                if rest_idx
+                else []
+            )
+            out: list = [None] * len(prompts)
+            for i, s in zip(long_idx, long_scores):
+                out[i] = s
+            for i, s in zip(rest_idx, rest_scores):
+                out[i] = s
+            return out
 
     if len(devices) <= 1 or not cfg.data_parallel:
         if len(devices) > 1:
@@ -111,18 +185,77 @@ def run_prompts(
         )
         return _run_batched(ex, prompts[lo:hi], cfg.num_batch)
 
-    # No `with` block: its shutdown(wait=True) would join workers BEFORE the
-    # finally could close the source — a failed worker stops consuming its
-    # queue and the rest would block forever. Closing the source first sets
-    # its stop flag, which unblocks every stuck producer put / consumer get.
     pool = ThreadPoolExecutor(max_workers=len(active))
     futures = [pool.submit(run_one, slot) for slot in range(len(active))]
-    try:
-        outputs = [f.result() for f in futures]
-    finally:
-        source.close()
-        pool.shutdown(wait=True)
+    outputs = _gather_dp(pool, futures, source)
     return [s for chunk in outputs for s in chunk]
 
 
-__all__ = ["run_prompts", "pick_devices"]
+def run_decode(
+    cfg: FrameworkConfig,
+    prompts: Sequence[Prompt],
+    tokenizer=None,
+    devices: list | None = None,
+):
+    """KV-cache decode over the available devices.
+
+    Single chip: one DecodeGenerator. Multiple chips: DP prompt split
+    (array_split, reference ``/root/reference/main.py:70``) with ONE shared
+    BroadcastShardSource reading the checkpoint once per weight stream —
+    prefill plus each decode step, ``rounds=num_gen_token`` total.
+
+    Returns (scores, updated_prompts, tokens_processed).
+    """
+    from flexible_llm_sharding_tpu.runtime.decode import DecodeGenerator
+
+    prompts = list(prompts)
+    devices = devices if devices is not None else pick_devices(cfg)
+
+    if len(devices) <= 1 or not cfg.data_parallel or len(prompts) <= 1:
+        gen = DecodeGenerator(
+            cfg, device=devices[0] if devices else None, tokenizer=tokenizer
+        )
+        scores, updated = gen(prompts)
+        return scores, updated, int(gen.stats.get("tokens_processed", 0))
+
+    model_cfg = LlamaConfig.from_pretrained(cfg.model_path)
+    n = len(devices)
+    ranges = split_prompts_dp(len(prompts), n)
+    layer_names = checkpoint.layer_names_for(
+        model_cfg.num_hidden_layers, tie_word_embeddings=False
+    )
+    plan = plan_shards_dp(len(layer_names), cfg.layer_num_per_shard)
+    active = [rank for rank in range(n) if ranges[rank][0] < ranges[rank][1]]
+    source = BroadcastShardSource(
+        cfg.model_path,
+        layer_names,
+        plan.shards,
+        np_dtype_for(cfg.dtype),
+        devices=[devices[r] for r in active],
+        prefetch_depth=cfg.prefetch_depth,
+        tied_embeddings=model_cfg.tie_word_embeddings,
+        rounds=cfg.num_gen_token,
+    )
+
+    def run_one(slot: int):
+        rank = active[slot]
+        lo, hi = ranges[rank]
+        gen = DecodeGenerator(
+            cfg,
+            device=devices[rank],
+            tokenizer=tokenizer,
+            weight_source_factory=lambda: source.view(slot),
+        )
+        scores, updated = gen(prompts[lo:hi])
+        return scores, updated, int(gen.stats.get("tokens_processed", 0))
+
+    pool = ThreadPoolExecutor(max_workers=len(active))
+    futures = [pool.submit(run_one, slot) for slot in range(len(active))]
+    outputs = _gather_dp(pool, futures, source)
+    scores = [s for (sc, _, _) in outputs for s in sc]
+    updated = [u for (_, up, _) in outputs for u in up]
+    tokens = sum(t for (_, _, t) in outputs)
+    return scores, updated, tokens
+
+
+__all__ = ["run_prompts", "run_decode", "pick_devices"]

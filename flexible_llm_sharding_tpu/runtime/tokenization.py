@@ -57,6 +57,15 @@ class TokenizedPrompt:
             int(self.suffix_ids.shape[1]),
         )
 
+    @property
+    def tokens_processed(self) -> int:
+        """Real (non-padding) tokens one full-model pass runs for this prompt:
+        the prefix plus every true suffix's real tokens. The shared accounting
+        unit for the CLI stats line, bench.py, and BASELINE.md throughput."""
+        return self.prefix_len + int(
+            (self.suffix_eos[: self.num_suffixes] + 1).sum()
+        )
+
 
 class PromptTokenizer:
     """Wraps a HF tokenizer with the reference's prefix/suffix conventions."""
@@ -122,6 +131,25 @@ class PromptTokenizer:
         )
 
 
+def count_tokens(tokenizer, prompts, max_token_len: int = 4096) -> int:
+    """Tokens one full scoring pass processes for ``prompts``, counted with
+    the same semantics as PromptTokenizer (prefix truncated to
+    ``max_token_len``; per-suffix leading BOS stripped). Host-side only —
+    negligible next to a streaming pass; used by the CLI so its throughput
+    line counts the same thing bench.py does."""
+    total = 0
+    for prefix, suffixes in prompts:
+        pids = tokenizer(
+            prefix, truncation=True, max_length=max_token_len
+        )["input_ids"]
+        total += len(pids)
+        sids = tokenizer(
+            list(suffixes), truncation=True, max_length=max_token_len
+        )["input_ids"]
+        total += sum(max(len(s) - 1, 0) for s in sids)
+    return total
+
+
 def make_blocks(
     tokenized: list[TokenizedPrompt], block_size: int
 ) -> list[list[int]]:
@@ -143,4 +171,10 @@ def make_blocks(
     return blocks
 
 
-__all__ = ["PromptTokenizer", "TokenizedPrompt", "make_blocks", "bucket_len"]
+__all__ = [
+    "PromptTokenizer",
+    "TokenizedPrompt",
+    "make_blocks",
+    "bucket_len",
+    "count_tokens",
+]

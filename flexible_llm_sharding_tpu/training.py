@@ -1,4 +1,4 @@
-"""Sharded training step over a device mesh.
+"""Sharded training over a device mesh.
 
 The reference is inference-only (no optimizer, loss, or backward pass anywhere
 in its 3 files — SURVEY.md §0), but a framework needs a training path to be
@@ -9,11 +9,23 @@ gradients must reduce over the ``dp`` axis. XLA derives all of those
 collectives from the NamedSharding annotations below — nothing here issues a
 collective by hand.
 
+A real training loop needs more than one step function; this module provides:
+
+- :func:`make_train_step` — jitted step, optional gradient accumulation
+  (``accum_steps`` microbatches scanned per update, grads averaged).
+- :func:`make_optimizer` / :func:`make_lr_schedule` — AdamW with global-norm
+  clipping and warmup + cosine/linear decay.
+- :func:`save_train_state` / :func:`restore_train_state` — orbax-backed
+  train-state checkpointing (params + optimizer state + step), restorable
+  onto a fresh mesh.
+
 Usage:
     mesh = make_mesh({"dp": 2, "tp": 4})
-    state = TrainState.create(cfg, params, optax.adamw(1e-4), mesh)
-    step = make_train_step(cfg, optimizer, mesh)
+    opt = make_optimizer(peak_lr=3e-4, warmup_steps=100, total_steps=10_000)
+    state = TrainState.create(cfg, params, opt, mesh)
+    step = make_train_step(cfg, opt, mesh)
     state, loss = step(state, batch)   # batch: int32 [B, L+1] token ids
+    save_train_state(state, "ckpt/step_1000")
 """
 
 from __future__ import annotations
@@ -91,6 +103,7 @@ def make_train_step(
     dp: str | None = "dp",
     dtype=jnp.bfloat16,
     pad_id: int | None = None,
+    accum_steps: int = 1,
 ) -> Callable[[TrainState, jax.Array], tuple[TrainState, jax.Array]]:
     """Build the jitted train step.
 
@@ -99,19 +112,42 @@ def make_train_step(
     parallel/sharding.py). The DP gradient all-reduce and TP activation
     collectives are inserted by XLA from the sharding annotations — the
     TPU-native replacement for a NCCL/MPI backend (SURVEY.md §2.3).
+
+    ``accum_steps > 1``: the batch arrives as [accum_steps, B, L+1] and the
+    update applies the microbatch-averaged gradient.
     """
 
     dp_ax = dp if mesh is not None and dp in mesh.axis_names else None
 
-    def step_fn(state: TrainState, tokens: jax.Array):
+    def grad_of(params, tokens):
         if mesh is not None and dp_ax is not None:
             # Pin the batch layout so a replicated host array still runs DP.
             tokens = jax.lax.with_sharding_constraint(
                 tokens, NamedSharding(mesh, data_spec(dp=dp_ax))
             )
-        loss, grads = jax.value_and_grad(next_token_loss)(
-            state.params, cfg, tokens, dtype, pad_id
+        return jax.value_and_grad(next_token_loss)(
+            params, cfg, tokens, dtype, pad_id
         )
+
+    def step_fn(state: TrainState, tokens: jax.Array):
+        if accum_steps > 1:
+            # tokens [accum_steps, B, L+1]: scan the microbatches, average
+            # grads — one optimizer update per accum_steps forwards, the
+            # standard trick for an effective batch HBM can't hold at once.
+            def micro(carry, mb):
+                loss_sum, gsum = carry
+                l, g = grad_of(state.params, mb)
+                return (loss_sum + l, jax.tree.map(jnp.add, gsum, g)), None
+
+            init = (
+                jnp.zeros((), jnp.float32),
+                jax.tree.map(jnp.zeros_like, state.params),
+            )
+            (loss, grads), _ = jax.lax.scan(micro, init, tokens)
+            loss = loss / accum_steps
+            grads = jax.tree.map(lambda g: g / accum_steps, grads)
+        else:
+            loss, grads = grad_of(state.params, tokens)
         updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
         return (
@@ -127,6 +163,117 @@ def make_train_step(
     return jax.jit(step_fn, donate_argnums=(0,))
 
 
+def make_lr_schedule(
+    peak_lr: float,
+    warmup_steps: int = 0,
+    total_steps: int | None = None,
+    kind: str = "cosine",
+    end_scale: float = 0.1,
+):
+    """Linear warmup to ``peak_lr`` then cosine/linear decay to
+    ``peak_lr * end_scale`` over ``total_steps`` (constant after warmup if
+    ``total_steps`` is None)."""
+    if warmup_steps == 0 and total_steps is None:
+        return peak_lr
+    warm = optax.linear_schedule(0.0, peak_lr, max(warmup_steps, 1))
+    if total_steps is None:
+        decay = optax.constant_schedule(peak_lr)
+    else:
+        decay_steps = max(total_steps - warmup_steps, 1)
+        if kind == "cosine":
+            decay = optax.cosine_decay_schedule(peak_lr, decay_steps, alpha=end_scale)
+        elif kind == "linear":
+            decay = optax.linear_schedule(peak_lr, peak_lr * end_scale, decay_steps)
+        else:
+            raise ValueError(f"unknown schedule kind {kind!r}")
+    return optax.join_schedules([warm, decay], [warmup_steps])
+
+
+def make_optimizer(
+    peak_lr: float = 3e-4,
+    weight_decay: float = 0.1,
+    warmup_steps: int = 0,
+    total_steps: int | None = None,
+    grad_clip: float = 1.0,
+    b1: float = 0.9,
+    b2: float = 0.95,
+    schedule_kind: str = "cosine",
+) -> optax.GradientTransformation:
+    """The standard LLM recipe: global-norm clip -> AdamW on a warmup +
+    decay schedule."""
+    lr = make_lr_schedule(peak_lr, warmup_steps, total_steps, schedule_kind)
+    return optax.chain(
+        optax.clip_by_global_norm(grad_clip),
+        optax.adamw(learning_rate=lr, b1=b1, b2=b2, weight_decay=weight_decay),
+    )
+
+
+def save_train_state(state: TrainState, path: str) -> None:
+    """Checkpoint the full train state (params + optimizer moments + step)
+    with orbax; sharded arrays are gathered/written per-shard by orbax."""
+    import os
+
+    import orbax.checkpoint as ocp
+
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(os.path.abspath(path), state, force=True)
+    # StandardCheckpointer writes asynchronously; block so the checkpoint is
+    # durable when this returns (crash-consistency is the whole point).
+    ckptr.wait_until_finished()
+
+
+def restore_train_state(
+    path: str,
+    cfg: LlamaConfig,
+    optimizer: optax.GradientTransformation,
+    mesh: Mesh | None = None,
+    tp: str | None = "tp",
+    dtype=jnp.float32,
+) -> TrainState:
+    """Restore a :func:`save_train_state` checkpoint and (re)place it on a
+    mesh — the mesh may differ from the one the checkpoint was written on
+    (resharding is a device_put). The restored optimizer state must come
+    from the same optimizer recipe (same pytree structure)."""
+    import os
+
+    import orbax.checkpoint as ocp
+
+    abs_params = jax.eval_shape(
+        lambda: llama.init_params(jax.random.PRNGKey(0), cfg, dtype)
+    )
+    abs_state = jax.eval_shape(
+        lambda p: TrainState(
+            params=p, opt_state=optimizer.init(p), step=jnp.zeros((), jnp.int32)
+        ),
+        abs_params,
+    )
+    restored = ocp.StandardCheckpointer().restore(os.path.abspath(path), abs_state)
+    if mesh is None:
+        return restored
+    # Re-place on the mesh: params get the Megatron specs; optimizer moments
+    # mirror their parameter's sharding (template from a throwaway init).
+    # Leaves the template left on the default device (e.g. step counters from
+    # optimizer.init's eager zeros) must be REPLICATED over the mesh —
+    # restored arrays are committed, and jit rejects mixed device sets.
+    tmpl = TrainState.create(cfg, restored.params, optimizer, mesh=mesh, tp=tp)
+    rep = NamedSharding(mesh, P())
+
+    def place(t, r):
+        if (
+            isinstance(t, jax.Array)
+            and getattr(t.sharding, "num_devices", 1) == mesh.size
+        ):
+            return jax.device_put(r, t.sharding)
+        return jax.device_put(r, rep)
+
+    opt_state = jax.tree.map(place, tmpl.opt_state, restored.opt_state)
+    return TrainState(
+        params=tmpl.params,
+        opt_state=opt_state,
+        step=jax.device_put(restored.step, rep),
+    )
+
+
 def shard_batch(mesh: Mesh, tokens, dp: str | None = "dp", sp: str | None = None):
     """Place a host token batch [B, L] onto the mesh, batch over ``dp``."""
     dp_ax = dp if dp in mesh.axis_names else None
@@ -139,4 +286,13 @@ jax.tree_util.register_dataclass(
     TrainState, data_fields=["params", "opt_state", "step"], meta_fields=[]
 )
 
-__all__ = ["TrainState", "make_train_step", "next_token_loss", "shard_batch"]
+__all__ = [
+    "TrainState",
+    "make_train_step",
+    "make_optimizer",
+    "make_lr_schedule",
+    "next_token_loss",
+    "save_train_state",
+    "restore_train_state",
+    "shard_batch",
+]

@@ -90,6 +90,30 @@ def profiler_trace(log_dir: str | None):
         yield
 
 
+class _NullBar:
+    def update(self, n: int = 1) -> None:
+        pass
+
+    def set_postfix_str(self, s: str) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+
+def progress_bar(total: int, desc: str, unit: str = "it", disable=None):
+    """A tqdm bar over the streaming loops (the reference shows tqdm over the
+    longer of its shard/prompt loops, ``/root/reference/utils.py:226-227,
+    236-238``). ``disable=None`` = tqdm's auto mode: visible on a TTY, silent
+    in CI/pipes. Falls back to a no-op if tqdm is missing."""
+    try:
+        from tqdm import tqdm
+    except ImportError:
+        return _NullBar()
+    return tqdm(total=total, desc=desc, unit=unit, disable=disable,
+                file=sys.stderr)
+
+
 def throughput(tokens: int, seconds: float, chips: int = 1) -> dict[str, float]:
     """tokens/sec and tokens/sec/chip — the BASELINE.md headline metric."""
     tps = tokens / seconds if seconds > 0 else 0.0
@@ -104,5 +128,6 @@ __all__ = [
     "device_memory_stats",
     "peak_hbm_gb",
     "profiler_trace",
+    "progress_bar",
     "throughput",
 ]

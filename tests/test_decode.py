@@ -117,6 +117,69 @@ def test_decode_cli(tiny_cfg, model, tmp_path):
     assert scores[0].shape == (2, 2, tiny_cfg.vocab_size)
 
 
+def test_decode_dp_matches_single_device(tiny_cfg, model):
+    """DP prompt-split decode on 3 virtual chips == single-device decode
+    (VERDICT r1 #5: multi-device KV-cache decode)."""
+    from flexible_llm_sharding_tpu.runtime.orchestration import run_decode
+
+    model_dir, params = model
+    prompts = PROMPTS + [("The sky is", (" blue", " green"))]
+
+    def cfg(dp):
+        return FrameworkConfig(
+            model_path=model_dir,
+            layer_num_per_shard=1,
+            storage_location="cpu",
+            dtype="float32",
+            bucket_multiple=8,
+            block_size=2,
+            prefetch_depth=1,
+            num_gen_token=N_GEN,
+            data_parallel=dp,
+        )
+
+    want, want_up, want_tok = run_decode(
+        cfg(False), prompts, tokenizer=FakeTokenizer(), devices=jax.devices()[:1]
+    )
+    got, got_up, got_tok = run_decode(
+        cfg(True), prompts, tokenizer=FakeTokenizer(), devices=jax.devices()[:3]
+    )
+    assert len(got) == len(prompts)
+    assert got_tok == want_tok > 0
+    assert got_up == want_up
+    for g, w in zip(got, want):
+        np.testing.assert_allclose(g, w, rtol=1e-5, atol=1e-6)
+
+
+def test_decode_dp_cli(tiny_cfg, model, tmp_path):
+    """CLI accepts --kv_cache with multiple chips when --data_parallel."""
+    import pickle
+
+    from flexible_llm_sharding_tpu.cli import main
+
+    model_dir, _ = model
+    ppkl, opkl = tmp_path / "p.pkl", tmp_path / "s.pkl"
+    with open(ppkl, "wb") as f:
+        pickle.dump(PROMPTS, f)
+    main(
+        [
+            "--model_path", model_dir,
+            "--prompt_pickle", str(ppkl),
+            "--output_file", str(opkl),
+            "--num_gen_token", "2",
+            "--dtype", "float32",
+            "--kv_cache", "true",
+            "--data_parallel", "true",
+            "--num_devices", "2",
+        ],
+        tokenizer=FakeTokenizer(),
+    )
+    with open(opkl, "rb") as f:
+        scores = pickle.load(f)
+    assert len(scores) == len(PROMPTS)
+    assert scores[0].shape == (2, 2, tiny_cfg.vocab_size)
+
+
 def test_decode_single_token(tiny_cfg, model):
     """n_gen=1 degenerates to a pure scoring pass."""
     model_dir, params = model

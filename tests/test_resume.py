@@ -51,14 +51,14 @@ def _run_and_crash_after(ex: StreamingExecutor, prompts, n_shards: int):
     """Run the executor but kill the stream after n_shards complete."""
     orig = ex._stream
 
-    def bombed(source, store, toks, blocks, block_meta, scores, cb=None):
+    def bombed(source, store, toks, blocks, block_meta, scores, cb=None, **kw):
         def exploding(i):
             if cb is not None:
                 cb(i)
             if i + 1 >= n_shards:
                 raise _Bomb()
 
-        return orig(source, store, toks, blocks, block_meta, scores, exploding)
+        return orig(source, store, toks, blocks, block_meta, scores, exploding, **kw)
 
     ex._stream = bombed
     with pytest.raises(_Bomb):
@@ -127,6 +127,69 @@ def test_resume_rejects_same_shape_different_tokens(tiny_cfg, model_dir, tmp_pat
     )(twisted)
     for g, w in zip(got, want):
         np.testing.assert_allclose(g, w, rtol=1e-5, atol=1e-6)
+
+
+def test_resume_dp_after_crash(tiny_cfg, model_dir, tmp_path, monkeypatch):
+    """DP disk-mode resume (VERDICT r1 #8): rank 1 crashes mid-stream; the
+    run fails with the ROOT exception (not a deadlock or a secondary
+    SourceClosed); a --resume rerun completes from the per-rank markers and
+    matches the uninterrupted scores."""
+    import glob
+
+    from flexible_llm_sharding_tpu.runtime.orchestration import run_prompts
+
+    disk = str(tmp_path / "acts")
+    prompts = PROMPTS + [
+        ("The sky is", (" blue", " green")),
+        ("One two three", (" four five", " six")),
+    ]
+
+    def dp_cfg(resume):
+        c = _cfg(model_dir, disk, resume=resume)
+        import dataclasses
+
+        return dataclasses.replace(c, data_parallel=True, prefetch_depth=1)
+
+    want = StreamingExecutor(
+        _cfg(model_dir, str(tmp_path / "clean")), tokenizer=FakeTokenizer()
+    )(prompts)
+
+    orig = StreamingExecutor._stream
+
+    def bombed(self, source, store, toks, blocks, block_meta, scores,
+               cb=None, **kw):
+        def exploding(i):
+            if cb is not None:
+                cb(i)
+            if self.plan.device_rank == 1 and i + 1 >= 3:
+                raise _Bomb()
+
+        return orig(self, source, store, toks, blocks, block_meta, scores,
+                    exploding, **kw)
+
+    monkeypatch.setattr(StreamingExecutor, "_stream", bombed)
+    import jax as _jax
+
+    with pytest.raises(_Bomb):  # root cause, not SourceClosed, no deadlock
+        run_prompts(
+            dp_cfg(False), prompts, tokenizer=FakeTokenizer(),
+            devices=_jax.devices()[:3],
+        )
+    monkeypatch.setattr(StreamingExecutor, "_stream", orig)
+
+    # Rank 1 left a marker at 3 completed shards.
+    markers = glob.glob(os.path.join(disk, "progress*.json"))
+    assert any(
+        json.load(open(m)).get("completed_shards") == 3 for m in markers
+    ), markers
+
+    got = run_prompts(
+        dp_cfg(True), prompts, tokenizer=FakeTokenizer(),
+        devices=_jax.devices()[:3],
+    )
+    for g, w in zip(got, want):
+        np.testing.assert_allclose(g, w, rtol=1e-5, atol=1e-6)
+    assert not glob.glob(os.path.join(disk, "progress*.json"))
 
 
 def test_empty_prompt_batch(tiny_cfg, model_dir, tmp_path):

@@ -1,0 +1,89 @@
+"""Training path: grad accumulation, LR schedule, train-state checkpointing
+(VERDICT r1 #9 — make the sharded-training claim real)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from flexible_llm_sharding_tpu.models import llama
+from flexible_llm_sharding_tpu.parallel.sharding import make_mesh
+from flexible_llm_sharding_tpu.training import (
+    TrainState,
+    make_lr_schedule,
+    make_optimizer,
+    make_train_step,
+    restore_train_state,
+    save_train_state,
+    shard_batch,
+)
+
+
+@pytest.fixture(scope="module")
+def setup(tiny_cfg):
+    params = llama.init_params(jax.random.PRNGKey(0), tiny_cfg)
+    rng = np.random.default_rng(3)
+    tokens = jnp.asarray(
+        rng.integers(0, tiny_cfg.vocab_size, (8, 17)), jnp.int32
+    )
+    return tiny_cfg, params, tokens
+
+
+def test_grad_accumulation_matches_full_batch(setup):
+    """accum_steps=2 over two microbatches == one step on the full batch
+    (equal token counts per microbatch, mean loss => grad average)."""
+    cfg, params, tokens = setup
+    opt = optax.adamw(1e-3)
+
+    # The jitted step donates the state, so each state needs its own copy
+    # of the module-scoped params.
+    copy = lambda p: jax.tree.map(jnp.array, p)
+    s_full = TrainState.create(cfg, copy(params), opt)
+    step_full = make_train_step(cfg, opt, dtype=jnp.float32)
+    s_full, loss_full = step_full(s_full, tokens)
+
+    s_acc = TrainState.create(cfg, copy(params), opt)
+    step_acc = make_train_step(cfg, opt, dtype=jnp.float32, accum_steps=2)
+    micro = tokens.reshape(2, 4, 17)
+    s_acc, loss_acc = step_acc(s_acc, micro)
+
+    np.testing.assert_allclose(float(loss_acc), float(loss_full), rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(s_acc.params), jax.tree.leaves(s_full.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6)
+
+
+def test_lr_schedule_shape():
+    sched = make_lr_schedule(1e-3, warmup_steps=10, total_steps=100, kind="cosine")
+    assert float(sched(0)) == 0.0
+    assert float(sched(10)) == pytest.approx(1e-3, rel=1e-6)
+    assert float(sched(100)) == pytest.approx(1e-4, rel=1e-2)  # alpha=0.1
+    assert float(sched(5)) < float(sched(9))  # warming up
+
+
+def test_checkpoint_roundtrip_continues_training(setup, tmp_path):
+    """save at step 2, restore (onto a dp x tp mesh), one more step ==
+    3 uninterrupted steps."""
+    cfg, params, tokens = setup
+    opt = make_optimizer(peak_lr=1e-3, warmup_steps=2, total_steps=50)
+    mesh = make_mesh({"dp": 2, "tp": 2})
+
+    state = TrainState.create(cfg, jax.tree.map(jnp.array, params), opt, mesh=mesh)
+    step = make_train_step(cfg, opt, mesh=mesh, dtype=jnp.float32)
+    batch = shard_batch(mesh, tokens)
+
+    s = state
+    for _ in range(2):
+        s, _ = step(s, batch)
+    save_train_state(s, str(tmp_path / "ckpt"))
+    s3, loss3 = step(s, batch)
+
+    restored = restore_train_state(
+        str(tmp_path / "ckpt"), cfg, opt, mesh=mesh
+    )
+    assert int(restored.step) == 2
+    r3, rloss3 = step(restored, batch)
+    np.testing.assert_allclose(float(rloss3), float(loss3), rtol=1e-6)
+    for a, b in zip(jax.tree.leaves(r3.params), jax.tree.leaves(s3.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-7)
