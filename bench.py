@@ -81,6 +81,13 @@ class BenchTokenizer:
             3 + (zlib.crc32(w.encode()) % (self.VOCAB - 3)) for w in text.split()
         ]
 
+    def decode(self, ids) -> str:
+        # The word-hash is one-way; a stable placeholder keeps the
+        # generation loop's append-to-suffix contract intact.
+        if np.ndim(ids) == 0:
+            ids = [int(ids)]
+        return "".join(f" <tok{int(i)}>" for i in ids)
+
     def __call__(self, text, max_length=None, padding=False, **kw):
         if isinstance(text, str):
             ids = self._ids(text)[:max_length]
@@ -183,7 +190,10 @@ def run_bench(result: dict) -> None:
     result["platform"] = devs[0].platform
 
     from flexible_llm_sharding_tpu.config import FrameworkConfig
-    from flexible_llm_sharding_tpu.utils.metrics import peak_hbm_gb
+    from flexible_llm_sharding_tpu.utils.metrics import (
+        LiveArrayPeakSampler,
+        peak_hbm_gb,
+    )
 
     # Sized so one bench run (incl. first compile) stays in single-digit
     # minutes on one v5e chip, while weights (~0.5 GB) are large enough that
@@ -231,7 +241,8 @@ def run_bench(result: dict) -> None:
     log("warmup/compile ...")
     run_once(fw(2), prompts, tok)
     log("overlapped (prefetch=2) ...")
-    scores, wall_overlap, ex1 = run_once(fw(2), prompts, tok)
+    with LiveArrayPeakSampler() as sampler:
+        scores, wall_overlap, ex1 = run_once(fw(2), prompts, tok)
     log(f"  wall={wall_overlap:.2f}s stats={ex1.stats}")
     assert all(np.isfinite(s).all() for s in scores)
 
@@ -242,6 +253,12 @@ def run_bench(result: dict) -> None:
     peak = peak_hbm_gb()
     if peak is not None:
         result["peak_hbm_gb"] = round(peak, 3)
+    elif sampler.peak_bytes:
+        # Devices behind the axon tunnel report no allocator stats; the
+        # live-array peak (weights + activations + prefetch queue, minus XLA
+        # scratch) is the honest fallback, and is marked as such.
+        result["peak_hbm_gb"] = round(sampler.peak_gb, 3)
+        result["peak_hbm_source"] = "live_arrays"
 
     log("serialized (prefetch=0, reference schedule) ...")
     _, wall_serial, ex0 = run_once(fw(0), prompts, tok)

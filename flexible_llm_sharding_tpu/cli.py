@@ -34,6 +34,12 @@ def _str2bool(v: str) -> bool:
     raise argparse.ArgumentTypeError(f"expected a boolean, got {v!r}")
 
 
+def _str2bool_or_auto(v: str) -> bool | None:
+    if v.lower() == "auto":
+        return None
+    return _str2bool(v)
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="flexible-llm-sharding-tpu",
@@ -66,8 +72,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--prefetch_depth", type=int, default=1)
     p.add_argument("--num_devices", type=int, default=0, help="0 = all visible chips")
     p.add_argument("--max_token_len", type=int, default=DEFAULT_MAX_TOKEN_LEN)
-    p.add_argument("--use_pallas", type=_str2bool, default=False,
-                   help="use Pallas flash-attention kernels where shapes allow")
+    p.add_argument("--use_pallas", type=_str2bool_or_auto, default=None,
+                   help="Pallas flash-attention kernels: true/false, or "
+                        "'auto' (default: on when running on real TPU, "
+                        "where they bench 2-3.5x faster at 4k context)")
     p.add_argument("--verbose_metrics", type=_str2bool, default=False,
                    help="emit one JSON line per structured timing event")
     p.add_argument("--profile_dir", type=str, default="",
@@ -179,6 +187,7 @@ def main(argv: list[str] | None = None, tokenizer=None) -> None:
     import time
 
     from flexible_llm_sharding_tpu.utils.metrics import (
+        LiveArrayPeakSampler,
         peak_hbm_gb,
         profiler_trace,
         throughput,
@@ -193,7 +202,10 @@ def main(argv: list[str] | None = None, tokenizer=None) -> None:
     tokens_processed = 0
 
     t0 = time.perf_counter()
-    with profiler_trace(cfg.profile_dir or None):
+    # The sampler is the peak-HBM fallback for devices whose memory_stats()
+    # is unavailable (e.g. TPU through the axon tunnel).
+    hbm_sampler = LiveArrayPeakSampler()
+    with profiler_trace(cfg.profile_dir or None), hbm_sampler:
         if args.kv_cache:
             if args.temperature > 0:
                 raise SystemExit("--kv_cache supports greedy decoding only")
@@ -255,6 +267,13 @@ def main(argv: list[str] | None = None, tokenizer=None) -> None:
     peak = peak_hbm_gb()
     if peak is not None:
         stats["peak_hbm_gb"] = round(peak, 3)
+    elif hbm_sampler.peak_bytes:
+        stats["peak_hbm_gb"] = round(hbm_sampler.peak_gb, 3)
+        stats["peak_hbm_source"] = "live_arrays"  # excludes XLA scratch
+        if len(pick_devices(cfg)) > 1:
+            # live_arrays sums across every local chip; on multi-chip runs
+            # this is the process-wide total, not the per-chip peak.
+            stats["peak_hbm_scope"] = "process"
     print(json.dumps(stats), file=sys.stderr)
 
 

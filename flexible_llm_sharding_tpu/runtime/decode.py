@@ -225,7 +225,7 @@ class DecodeGenerator:
                             )
                         elif kind == "decoders":
                             ph, sh, kv = _prefill_decoders(
-                                self.model_cfg, cfg.use_pallas, params, ph, sh, prefix_len
+                                self.model_cfg, cfg.pallas_enabled(), params, ph, sh, prefix_len
                             )
                             # Pre-extend with empty generated-token slots so
                             # decode scans can donate in place.

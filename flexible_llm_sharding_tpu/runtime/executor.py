@@ -659,6 +659,7 @@ class StreamingExecutor:
                 "pipeline runner for interleaved stage plans"
             )
         self.stats: dict[str, float] = {}
+        self._use_pallas = cfg.pallas_enabled()
 
     # -- numpy dtype for host-side casting ---------------------------------
     @property
@@ -865,7 +866,7 @@ class StreamingExecutor:
                         self.device,
                         toks,
                         scores,
-                        use_pallas=self.cfg.use_pallas,
+                        use_pallas=self._use_pallas,
                     )
                     bar.update(1)
                 if not blocks:

@@ -243,28 +243,26 @@ class LongContextScorer:
         for _ in range(len(self.plan.shards)):
             _, segments = next(stream)
             for kind, params in segments:
-                    if kind == "embed":
-                        prefix_x = llama.embed(params, prefix_ids, self.dtype)
-                        suffix_h = llama.embed(params, suffix_ids, self.dtype)
-                    elif kind == "decoders":
-                        # Unstack the [k, ...] scan pytree: each layer runs
-                        # as one jitted sharded step (shard_map inside).
-                        k_layers = jax.tree.leaves(params)[0].shape[0]
-                        for i in range(k_layers):
-                            layer = jax.tree.map(lambda a: a[i], params)
-                            prefix_x, suffix_h = self._layer_fn(
-                                layer, prefix_x, suffix_h, prefix_len
-                            )
-                    elif kind == "norm":
-                        suffix_h = llama.select_eos_and_norm(
-                            params, self.model_cfg, suffix_h, suffix_eos
+                if kind == "embed":
+                    prefix_x = llama.embed(params, prefix_ids, self.dtype)
+                    suffix_h = llama.embed(params, suffix_ids, self.dtype)
+                elif kind == "decoders":
+                    # Unstack the [k, ...] scan pytree: each layer runs
+                    # as one jitted sharded step (shard_map inside).
+                    k_layers = jax.tree.leaves(params)[0].shape[0]
+                    for i in range(k_layers):
+                        layer = jax.tree.map(lambda a: a[i], params)
+                        prefix_x, suffix_h = self._layer_fn(
+                            layer, prefix_x, suffix_h, prefix_len
                         )
-                    else:  # head
-                        scores = np.asarray(
-                            jax.device_get(llama.lm_head_scores(params, suffix_h))
-                        )
-        finally:
-            source.close()
+                elif kind == "norm":
+                    suffix_h = llama.select_eos_and_norm(
+                        params, self.model_cfg, suffix_h, suffix_eos
+                    )
+                else:  # head
+                    scores = np.asarray(
+                        jax.device_get(llama.lm_head_scores(params, suffix_h))
+                    )
         return np.expand_dims(scores[: t.num_suffixes], axis=1)
 
 

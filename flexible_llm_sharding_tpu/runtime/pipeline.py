@@ -77,6 +77,7 @@ class PipelineRunner:
             len(self.layer_names), cfg.layer_num_per_shard, len(self.devices)
         )
         self.stats: dict[str, float] = {}
+        self._use_pallas = cfg.pallas_enabled()
         # Per-stage dispatch events; ``dispatch_wall_s`` vs ``total_wall_s``
         # in stats is the pipelining evidence — see _run_batch.
         self.recorder = metrics.Recorder(verbose=cfg.verbose_metrics)
@@ -161,7 +162,7 @@ class PipelineRunner:
                         dev,
                         toks,
                         scores,
-                        use_pallas=self.cfg.use_pallas,
+                        use_pallas=self._use_pallas,
                     )
                     bar.update(1)
                 self.recorder.record(

@@ -37,6 +37,79 @@ def peak_hbm_gb(device=None) -> float | None:
     return s["peak_bytes_in_use"] / 1e9 if "peak_bytes_in_use" in s else None
 
 
+class LiveArrayPeakSampler:
+    """Peak device-resident bytes, sampled from ``jax.live_arrays()``.
+
+    Fallback evidence for platforms whose devices report no allocator stats
+    (``memory_stats() is None`` — e.g. TPU behind the axon tunnel): a daemon
+    thread samples the total bytes of live JAX arrays on the default backend.
+    This counts weights, activations, and queued prefetch shards — everything
+    the framework holds — but NOT XLA's internal scratch inside a running
+    executable; pair it with ``compiled_memory_analysis`` for that side.
+    Use as a context manager; read ``.peak_gb`` after exit.
+    """
+
+    def __init__(self, interval_s: float = 0.05):
+        self.interval_s = interval_s
+        self.peak_bytes = 0
+        self._stop = None
+        self._thread = None
+
+    def _sample(self) -> None:
+        import jax
+
+        try:
+            total = sum(a.nbytes for a in jax.live_arrays())
+        except Exception:
+            return
+        if total > self.peak_bytes:
+            self.peak_bytes = total
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            self._sample()
+            self._stop.wait(self.interval_s)
+
+    def __enter__(self) -> "LiveArrayPeakSampler":
+        import threading
+
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self._stop.set()
+        self._thread.join(timeout=2.0)
+        self._sample()
+
+    @property
+    def peak_gb(self) -> float:
+        return self.peak_bytes / 1e9
+
+
+def compiled_memory_analysis(jitted, *args, **kwargs) -> dict[str, float]:
+    """XLA's own memory accounting for one jitted function at given shapes:
+    argument/output/temp/generated-code bytes. The temp figure is the scratch
+    a ``LiveArrayPeakSampler`` cannot see; argument+temp+output bounds the
+    executable's true HBM footprint."""
+    lowered = jitted.lower(*args, **kwargs)
+    mem = lowered.compile().memory_analysis()
+    if mem is None:
+        return {}
+    out = {}
+    for key in (
+        "argument_size_in_bytes",
+        "output_size_in_bytes",
+        "temp_size_in_bytes",
+        "generated_code_size_in_bytes",
+    ):
+        val = getattr(mem, key, None)
+        if val is not None:
+            out[key] = float(val)
+    return out
+
+
 @dataclass
 class Recorder:
     """Append-only structured event log for one run.
@@ -124,7 +197,9 @@ def throughput(tokens: int, seconds: float, chips: int = 1) -> dict[str, float]:
 
 
 __all__ = [
+    "LiveArrayPeakSampler",
     "Recorder",
+    "compiled_memory_analysis",
     "device_memory_stats",
     "peak_hbm_gb",
     "profiler_trace",
