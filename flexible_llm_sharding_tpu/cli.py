@@ -71,6 +71,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--block_size", type=int, default=8)
     p.add_argument("--prefetch_depth", type=int, default=1)
     p.add_argument("--num_devices", type=int, default=0, help="0 = all visible chips")
+    p.add_argument("--tensor_parallel", type=int, default=1,
+                   help="shard every streamed layer's matmuls over this many "
+                        "chips (Megatron layout over ICI); cuts per-chip "
+                        "weight HBM by the factor. 1 = off")
     p.add_argument("--max_token_len", type=int, default=DEFAULT_MAX_TOKEN_LEN)
     p.add_argument("--use_pallas", type=_str2bool_or_auto, default=None,
                    help="Pallas flash-attention kernels: true/false, or "
@@ -109,6 +113,7 @@ def config_from_args(args: argparse.Namespace) -> FrameworkConfig:
         block_size=args.block_size,
         prefetch_depth=args.prefetch_depth,
         num_devices=args.num_devices,
+        tensor_parallel=args.tensor_parallel,
         use_pallas=args.use_pallas,
         verbose_metrics=args.verbose_metrics,
         profile_dir=args.profile_dir,
@@ -213,6 +218,12 @@ def main(argv: list[str] | None = None, tokenizer=None) -> None:
                 raise SystemExit(
                     "--long_context is not supported with --kv_cache yet; "
                     "use the default generation loop for over-length prefixes"
+                )
+            if cfg.tensor_parallel > 1:
+                raise SystemExit(
+                    "--tensor_parallel is not supported with --kv_cache yet; "
+                    "the decode path streams whole layers per chip — use the "
+                    "default generation loop for TP scoring"
                 )
             from flexible_llm_sharding_tpu.runtime.orchestration import run_decode
 

@@ -128,6 +128,11 @@ class FrameworkConfig:
     # pallas_speedup_4k); shapes the kernel can't tile fall back per-call
     # (models/llama.py checks pallas_attention.supports() at trace time).
     use_pallas: bool | None = None
+    # Tensor parallelism for the streaming scorer: shard every streamed
+    # layer's matmuls Megatron-style over this many chips (per-chip weight
+    # HBM drops by the factor; XLA emits the ICI all-reduces). 1 = off.
+    # Mutually exclusive with data_parallel and the MP pipeline.
+    tensor_parallel: int = 1
     verbose_metrics: bool = False  # one JSON line per structured event (stderr)
     profile_dir: str = ""  # jax.profiler trace output dir ("" = off)
     resume: bool = False  # disk mode: resume from the last completed shard
@@ -152,6 +157,14 @@ class FrameworkConfig:
             # rounds=num_gen_token, so its producer would push nothing while
             # every consumer blocks on an empty queue.
             raise ValueError("num_gen_token must be >= 1")
+        if self.tensor_parallel < 1:
+            raise ValueError("tensor_parallel must be >= 1")
+        if self.tensor_parallel > 1 and self.data_parallel:
+            raise ValueError(
+                "tensor_parallel and data_parallel are mutually exclusive "
+                "(stream one model sharded across chips, OR one replica per "
+                "chip — not both in this executor)"
+            )
 
     def pallas_enabled(self) -> bool:
         """Resolve the tri-state ``use_pallas``: explicit value, or auto —

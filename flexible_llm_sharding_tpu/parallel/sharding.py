@@ -25,7 +25,7 @@ axis, "row parallel" = shard the FIRST axis.
 
 from __future__ import annotations
 
-from typing import Any
+from typing import Any, Sequence
 
 import jax
 import numpy as np
@@ -153,6 +153,56 @@ def data_spec(dp: str | None = "dp", sp: str | None = None) -> P:
     return P(dp, sp)
 
 
+class TpPlacement:
+    """Weight/activation placement for tensor-parallel streaming inference.
+
+    The reference never splits a layer across devices (each layer's full
+    weights land on one GPU, ``/root/reference/utils.py:128-130``); on TPU the
+    idiomatic alternative is Megatron-style sharding over a ``tp`` mesh axis:
+    every streamed shard's matmuls are column/row-partitioned across the
+    chips (``layer_specs``), activations stay replicated, and XLA inserts the
+    ICI all-reduces where the row-parallel products need them. Per-chip
+    weight HBM drops by the tp factor — multiplying with the streaming
+    design's own layer_num_per_shard reduction — and the matmuls ride all
+    chips' MXUs at once.
+
+    Duck-types as the executor's ``device``: ``segment_target(kind)`` gives
+    the ``jax.device_put`` target for one weight segment, ``act`` the target
+    for activations. The jitted block programs need no changes — GSPMD
+    partitions them from the argument shardings.
+    """
+
+    def __init__(self, devices: Sequence):
+        if len(devices) < 2:
+            raise ValueError("TpPlacement needs >= 2 devices")
+        self.mesh = make_mesh({"tp": len(devices)}, list(devices))
+        self.act = NamedSharding(self.mesh, P())
+        rep = jax.tree.map(
+            lambda s: NamedSharding(self.mesh, s),
+            layer_specs("tp"),
+            is_leaf=lambda x: isinstance(x, P),
+        )
+        # Stacked-scan decoder pytrees carry a leading [k] layer axis.
+        self._decoder = jax.tree.map(
+            lambda s: NamedSharding(self.mesh, P(None, *s.spec)), rep
+        )
+        self._by_kind = {
+            "decoders": self._decoder,
+            # Embed/norm are small and read row-wise per token id; replicate.
+            "embed": self.act,
+            "norm": self.act,
+            # Head kernel [D, V] column-sharded: each chip scores a vocab
+            # slice; the softmax's global max/sum become ICI all-reduces.
+            "head": {"kernel": NamedSharding(self.mesh, P(None, "tp"))},
+        }
+
+    def segment_target(self, kind: str):
+        return self._by_kind[kind]
+
+    def check(self, cfg: LlamaConfig) -> None:
+        check_tp_divisibility(cfg, self.mesh.shape["tp"])
+
+
 def check_tp_divisibility(cfg: LlamaConfig, tp_size: int) -> None:
     """TP constraints — fail loudly before XLA produces a cryptic error."""
     if cfg.num_attention_heads % tp_size:
@@ -187,6 +237,7 @@ __all__ = [
     "param_specs",
     "layer_specs",
     "data_spec",
+    "TpPlacement",
     "check_tp_divisibility",
     "tree_shardings",
     "shard_params",

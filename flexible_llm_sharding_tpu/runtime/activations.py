@@ -32,6 +32,30 @@ import jax
 import numpy as np
 
 
+def _save_npy(path: str, arr: np.ndarray) -> None:
+    """np.save that round-trips ml_dtypes extension types (bfloat16, fp8):
+    the npy format stores them as raw void bytes that np.load returns as
+    dtype 'V2', which JAX rejects — so store a same-width uint view instead
+    and let :func:`_load_npy` restore the real dtype."""
+    if arr.dtype.isbuiltin == 0:  # extension dtype numpy can't describe
+        arr = arr.view(np.dtype(f"u{arr.dtype.itemsize}"))
+    np.save(path, arr)
+
+
+def _load_npy(path: str, np_dtype: np.dtype | None) -> np.ndarray:
+    arr = np.load(path)
+    if (
+        np_dtype is not None
+        and arr.dtype != np_dtype
+        and arr.dtype.kind in "uV"
+        and arr.dtype.itemsize == np.dtype(np_dtype).itemsize
+    ):
+        # uint view written by _save_npy (or a raw-void file from an older
+        # run): reinterpret as the executor's compute dtype.
+        arr = arr.view(np_dtype)
+    return arr
+
+
 class ActivationStore:
     """Store/fetch (prefix_h, suffix_h) activation pairs keyed by block id.
 
@@ -46,11 +70,15 @@ class ActivationStore:
         device_rank: int = 0,
         rank_tag: bool = False,
         max_in_cpu: int | None = None,
+        np_dtype: np.dtype | None = None,
     ):
+        # np_dtype: the compute dtype of stored activations; needed to
+        # restore ml_dtypes extension types (bfloat16) from disk files.
         if location not in ("tpu", "cpu", "disk"):
             raise ValueError(f"storage_location must be tpu|cpu|disk, got {location!r}")
         self.location = location
         self.disk_folder = disk_folder
+        self.np_dtype = None if np_dtype is None else np.dtype(np_dtype)
         # The reference tags disk files with the gpu rank only in DP mode
         # (/root/reference/utils.py:172): rank_tag mirrors that.
         self.tag = str(device_rank) if rank_tag else ""
@@ -88,17 +116,17 @@ class ActivationStore:
         suffix_np = np.asarray(jax.device_get(suffix_h))
         for row, idx in enumerate(prompt_idxs):
             ppath, spath = self._paths(idx)
-            np.save(spath, suffix_np[row])
+            _save_npy(spath, suffix_np[row])
             if prefix_np is not None:
-                np.save(ppath, prefix_np[row])
+                _save_npy(ppath, prefix_np[row])
 
     def _fetch_disk(self, prompt_idxs: list[int], with_prefix: bool):
         prefixes, suffixes = [], []
         for idx in prompt_idxs:
             ppath, spath = self._paths(idx)
-            suffixes.append(np.load(spath))
+            suffixes.append(_load_npy(spath, self.np_dtype))
             if with_prefix:
-                prefixes.append(np.load(ppath))
+                prefixes.append(_load_npy(ppath, self.np_dtype))
         suffix = np.stack(suffixes)
         prefix = np.stack(prefixes) if with_prefix else None
         return prefix, suffix

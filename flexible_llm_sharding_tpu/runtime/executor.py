@@ -149,9 +149,11 @@ def process_block(
         with_prefix = first <= n_layers - 3
         prefix_h, suffix_h = store.fetch(b, idxs, with_prefix=with_prefix)
         # Host->HBM upload, or the chip-to-chip ICI hop in pipeline mode.
-        suffix_h = jax.device_put(suffix_h, device)
+        # Under TpPlacement activations are replicated over the tp mesh.
+        act_target = getattr(device, "act", device)
+        suffix_h = jax.device_put(suffix_h, act_target)
         if prefix_h is not None:
-            prefix_h = jax.device_put(prefix_h, device)
+            prefix_h = jax.device_put(prefix_h, act_target)
 
     prefix_h, suffix_h, block_scores = apply_segments(
         model_cfg,
@@ -336,6 +338,11 @@ class _HostShardLoader:
 
 
 def _place(segments: list[tuple[str, Any]], device) -> list[tuple[str, Any]]:
+    if hasattr(device, "segment_target"):  # TpPlacement: per-kind shardings
+        return [
+            (kind, jax.device_put(p, device.segment_target(kind)))
+            for kind, p in segments
+        ]
     return [
         (kind, jax.device_put(p, device) if device else jax.device_put(p))
         for kind, p in segments
@@ -659,7 +666,12 @@ class StreamingExecutor:
                 "pipeline runner for interleaved stage plans"
             )
         self.stats: dict[str, float] = {}
-        self._use_pallas = cfg.pallas_enabled()
+        # Pallas kernels can't be auto-partitioned by GSPMD (pallas_call has
+        # no sharding rule outside shard_map), so a tp-sharded executor
+        # forces the XLA attention path regardless of the pallas setting.
+        self._use_pallas = cfg.pallas_enabled() and not hasattr(
+            device, "segment_target"
+        )
 
     # -- numpy dtype for host-side casting ---------------------------------
     @property
@@ -737,6 +749,7 @@ class StreamingExecutor:
             device_rank=self.plan.device_rank,
             rank_tag=self.plan.num_devices > 1 and self.cfg.data_parallel,
             max_in_cpu=self.cfg.max_activation_in_cpu,
+            np_dtype=self._np_dtype,
         )
         resumable = self.cfg.storage_location == "disk"
         sig = self._resume_signature(toks) if resumable else ""

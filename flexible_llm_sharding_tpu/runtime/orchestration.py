@@ -132,6 +132,24 @@ def run_prompts(
                 out[i] = s
             return out
 
+    if cfg.tensor_parallel > 1:
+        # One streaming executor whose every shard is Megatron-sharded over a
+        # tp mesh: per-chip weight HBM divides by tp, matmuls run on all
+        # chips' MXUs, XLA emits the ICI all-reduces. The reference has no
+        # equivalent — its layers always live whole on one device
+        # (/root/reference/utils.py:128-130).
+        from flexible_llm_sharding_tpu.parallel.sharding import TpPlacement
+
+        if len(devices) < cfg.tensor_parallel:
+            raise ValueError(
+                f"tensor_parallel={cfg.tensor_parallel} needs that many "
+                f"chips, have {len(devices)}"
+            )
+        placement = TpPlacement(devices[: cfg.tensor_parallel])
+        placement.check(LlamaConfig.from_pretrained(cfg.model_path))
+        ex = StreamingExecutor(cfg, device=placement, tokenizer=tokenizer)
+        return _run_batched(ex, prompts, cfg.num_batch)
+
     if len(devices) <= 1 or not cfg.data_parallel:
         if len(devices) > 1:
             from flexible_llm_sharding_tpu.runtime.pipeline import run_pipeline

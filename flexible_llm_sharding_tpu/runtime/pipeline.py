@@ -100,6 +100,7 @@ class PipelineRunner:
             self.cfg.storage_location,
             self.cfg.disk_folder,
             max_in_cpu=self.cfg.max_activation_in_cpu,
+            np_dtype=self._np_dtype,
         )
         stage_shards = [s for (_, _, s) in self.stages]
         stage_devs = [self.devices[r] for (_, r, _) in self.stages]

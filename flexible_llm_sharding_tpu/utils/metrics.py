@@ -58,8 +58,17 @@ class LiveArrayPeakSampler:
     def _sample(self) -> None:
         import jax
 
+        def device_bytes(a) -> int:
+            # Sum the ACTUAL per-device buffers: a replicated/sharded array's
+            # .nbytes is its logical global size, which would undercount a
+            # tp-replicated buffer by the replication factor.
+            try:
+                return sum(s.data.nbytes for s in a.addressable_shards)
+            except Exception:
+                return a.nbytes
+
         try:
-            total = sum(a.nbytes for a in jax.live_arrays())
+            total = sum(device_bytes(a) for a in jax.live_arrays())
         except Exception:
             return
         if total > self.peak_bytes:

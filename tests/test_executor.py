@@ -77,6 +77,37 @@ def test_executor_matches_monolithic(tiny_cfg, model_dir, expected, storage, tmp
         np.testing.assert_allclose(g, w, rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.parametrize("storage", ["disk", "cpu"])
+def test_executor_bfloat16_disk_roundtrip(tiny_cfg, model_dir, storage, tmp_path):
+    """bf16 activations must survive the disk .npy roundtrip: ml_dtypes
+    extension types serialize as raw void bytes that JAX rejects unless the
+    store restores the real dtype (regression: the 7B scale demo crashed at
+    shard 1 of a disk-mode bf16 run). cpu mode with max_in_cpu=1 forces the
+    spill path through the same files."""
+    path, _ = model_dir
+    base = dict(
+        model_path=path,
+        layer_num_per_shard=1,
+        disk_folder=str(tmp_path / "acts"),
+        dtype="bfloat16",
+        bucket_multiple=8,
+        block_size=2,
+        prefetch_depth=0,
+    )
+    ref = StreamingExecutor(
+        FrameworkConfig(storage_location="tpu", **base), tokenizer=FakeTokenizer()
+    )(list(PROMPTS))
+    cfg = FrameworkConfig(
+        storage_location=storage,
+        max_activation_in_cpu=1 if storage == "cpu" else 100,
+        **base,
+    )
+    got = StreamingExecutor(cfg, tokenizer=FakeTokenizer())(list(PROMPTS))
+    for g, w in zip(got, ref):
+        assert np.isfinite(g).all()
+        np.testing.assert_allclose(g, w, rtol=1e-3, atol=1e-4)
+
+
 @pytest.mark.parametrize("lnps", [2, 3, 100])
 def test_executor_shard_sizes(tiny_cfg, model_dir, expected, lnps):
     path, _ = model_dir

@@ -1,0 +1,106 @@
+"""Tensor-parallel streaming inference: Megatron-sharded shards over a tp
+mesh must score identically to the single-device stream (the reference has no
+TP at all — layers always live whole on one device,
+``/root/reference/utils.py:128-130``)."""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax
+
+from flexible_llm_sharding_tpu.config import FrameworkConfig
+from flexible_llm_sharding_tpu.models import llama
+from flexible_llm_sharding_tpu.parallel.sharding import TpPlacement
+from flexible_llm_sharding_tpu.runtime.orchestration import run_prompts
+from flexible_llm_sharding_tpu.utils.checkpoint import save_params
+
+from tests.fake_tokenizer import FakeTokenizer
+
+PROMPTS = [
+    ("The capital of France", (" is Paris", " is Rome", " might be Lyon")),
+    ("Water boils", (" at 100C", " when heated to its boiling point")),
+    ("Two plus two equals", (" four", " five", " twenty-two", " fish")),
+]
+
+
+@pytest.fixture(scope="module")
+def model_dir(tiny_cfg, tmp_path_factory):
+    params = llama.init_params(jax.random.PRNGKey(0), tiny_cfg)
+    d = tmp_path_factory.mktemp("tiny_model_tp")
+    save_params(jax.tree.map(np.asarray, params), str(d), tiny_cfg)
+    return str(d)
+
+
+def _cfg(model_dir, **kw):
+    base = dict(
+        model_path=model_dir,
+        layer_num_per_shard=2,
+        storage_location="cpu",
+        dtype="float32",
+        bucket_multiple=8,
+        block_size=2,
+        prefetch_depth=1,
+    )
+    base.update(kw)
+    return FrameworkConfig(**base)
+
+
+@pytest.fixture(scope="module")
+def single_scores(model_dir):
+    cfg = _cfg(model_dir)
+    return run_prompts(
+        cfg, PROMPTS, tokenizer=FakeTokenizer(), devices=jax.devices()[:1]
+    )
+
+
+@pytest.mark.parametrize("tp", [2])
+def test_tp_matches_single_device(model_dir, single_scores, tp):
+    cfg = _cfg(model_dir, tensor_parallel=tp)
+    got = run_prompts(
+        cfg, PROMPTS, tokenizer=FakeTokenizer(), devices=jax.devices()[:tp]
+    )
+    assert len(got) == len(PROMPTS)
+    for a, b in zip(got, single_scores):
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+
+
+def test_tp_storage_disk(model_dir, single_scores, tmp_path):
+    cfg = _cfg(
+        model_dir,
+        tensor_parallel=2,
+        storage_location="disk",
+        disk_folder=str(tmp_path / "acts"),
+    )
+    got = run_prompts(
+        cfg, PROMPTS, tokenizer=FakeTokenizer(), devices=jax.devices()[:2]
+    )
+    for a, b in zip(got, single_scores):
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+
+
+def test_tp_rejects_bad_head_divisibility(model_dir):
+    # tiny_cfg has 2 kv heads: tp=4 can't divide them.
+    cfg = _cfg(model_dir, tensor_parallel=4)
+    with pytest.raises(ValueError, match="num_key_value_heads"):
+        run_prompts(
+            cfg, PROMPTS, tokenizer=FakeTokenizer(), devices=jax.devices()[:4]
+        )
+
+
+def test_tp_dp_mutually_exclusive(model_dir):
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        _cfg(model_dir, tensor_parallel=2, data_parallel=True)
+
+
+def test_tp_placement_specs():
+    """Column/row layout sanity: wq sharded on out, wo on in, head on vocab."""
+    pl = TpPlacement(jax.devices()[:2])
+    dec = pl.segment_target("decoders")
+    assert dec["attn"]["wq"].spec == jax.sharding.PartitionSpec(None, None, "tp")
+    assert dec["attn"]["wo"].spec == jax.sharding.PartitionSpec(None, "tp", None)
+    assert dec["mlp"]["down"].spec == jax.sharding.PartitionSpec(None, "tp", None)
+    assert pl.segment_target("head")["kernel"].spec == jax.sharding.PartitionSpec(
+        None, "tp"
+    )
