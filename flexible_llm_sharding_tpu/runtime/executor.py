@@ -314,7 +314,14 @@ class _HostShardLoader:
 
         def flush():
             if run:
-                stacked = jax.tree.map(lambda *xs: np.stack(xs), *run)
+                # k=1 shards (layer_num_per_shard=1, the headline low-HBM
+                # config) take a [None] VIEW instead of np.stack's copy —
+                # with the mmap loader that keeps the whole host path
+                # copy-free: page cache -> device DMA.
+                stacked = jax.tree.map(
+                    lambda *xs: xs[0][None] if len(xs) == 1 else np.stack(xs),
+                    *run,
+                )
                 segments.append(("decoders", stacked))
                 run.clear()
 

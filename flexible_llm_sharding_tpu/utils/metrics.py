@@ -57,13 +57,20 @@ class LiveArrayPeakSampler:
 
     def _sample(self) -> None:
         import jax
+        import numpy as np
 
         def device_bytes(a) -> int:
-            # Sum the ACTUAL per-device buffers: a replicated/sharded array's
-            # .nbytes is its logical global size, which would undercount a
-            # tp-replicated buffer by the replication factor.
+            # Actual per-device buffer bytes, from sharding METADATA only: a
+            # replicated array's .nbytes is its logical global size (which
+            # would undercount tp-replication), and touching .data would
+            # materialize view arrays that the next sample then counts.
+            # Donated/deleted arrays hold no HBM.
             try:
-                return sum(s.data.nbytes for s in a.addressable_shards)
+                if a.is_deleted():
+                    return 0
+                sh = a.sharding
+                shard_elems = int(np.prod(sh.shard_shape(a.shape)))
+                return shard_elems * a.dtype.itemsize * len(sh.addressable_devices)
             except Exception:
                 return a.nbytes
 
