@@ -319,7 +319,12 @@ def _mmap_safetensors(path: str) -> dict[str, np.ndarray]:
     for k, meta in header.items():
         b, e = meta["data_offsets"]
         dt = np.dtype(_ST_DTYPES[meta["dtype"]])
-        if e - b != int(np.prod(meta["shape"])) * dt.itemsize or base + e > mm.size:
+        if (
+            b < 0
+            or e < b
+            or e - b != int(np.prod(meta["shape"])) * dt.itemsize
+            or base + e > mm.size
+        ):
             # Truncated/corrupt payload (e.g. a split killed mid-write):
             # the library loader raises the clear format error.
             return st_load_file(path)

@@ -44,3 +44,49 @@ def test_memory_stats_cpu_empty():
 def test_profiler_trace_noop():
     with profiler_trace(None):
         pass
+
+
+def test_live_array_sampler_counts_replication():
+    """A replicated array occupies HBM on EVERY chip: the sampler must count
+    per-device shard bytes (logical nbytes would undercount N-fold), and a
+    deleted/donated array must count zero."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from flexible_llm_sharding_tpu.parallel.sharding import make_mesh
+    from flexible_llm_sharding_tpu.utils.metrics import LiveArrayPeakSampler
+
+    def peak() -> int:
+        s = LiveArrayPeakSampler(interval_s=0.01)
+        with s:
+            time.sleep(0.15)
+        return s.peak_bytes
+
+    # live_arrays() is process-global (other tests' arrays are visible), so
+    # every assertion is a DELTA against this baseline.
+    base = peak()
+
+    mesh = make_mesh({"tp": 4})
+    rep = jax.device_put(
+        jnp.ones((128, 128), jnp.float32), NamedSharding(mesh, P())
+    )
+    with_rep = peak()
+    assert with_rep >= base + 4 * rep.nbytes  # one replica per chip
+
+    col = jax.device_put(
+        jnp.ones((128, 128), jnp.float32), NamedSharding(mesh, P(None, "tp"))
+    )
+    with_col = peak()
+    assert with_col >= with_rep + col.nbytes  # sharded: one logical copy
+
+    col.delete()
+    after_delete = peak()
+    assert after_delete < with_col
+
+    # Sampling must not inflate the measurement (regression: touching
+    # shard.data materialized a new live array per sample, compounding a
+    # 13.5 GB model to a 27 GB 'peak' on a 16 GB chip).
+    assert abs(peak() - after_delete) <= rep.nbytes
