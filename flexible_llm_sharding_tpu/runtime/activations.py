@@ -99,6 +99,8 @@ class ActivationStore:
         # was the host sync that serialised MP pipeline stages). Depth 1
         # bounds the extra HBM to one block's activations.
         self._pending: list[object] = []
+        self._writer = None  # lazy single-thread pool for async disk writes
+        self._write_futs: list = []
         if location == "disk":
             os.makedirs(disk_folder, exist_ok=True)
 
@@ -152,7 +154,7 @@ class ActivationStore:
             )
             if over:
                 self._spilled.add(block_id)
-                self._store_disk(prompt_idxs, prefix_h, suffix_h)
+                self._submit_disk(prompt_idxs, prefix_h, suffix_h)
                 return
             if block_id not in self._mem:
                 self._cpu_prompts += len(prompt_idxs)
@@ -165,7 +167,42 @@ class ActivationStore:
             while len(self._pending) > 1:
                 self._finalize(self._pending.pop(0))
         else:  # disk — one file pair per prompt, reference contract
-            self._store_disk(prompt_idxs, prefix_h, suffix_h)
+            self._submit_disk(prompt_idxs, prefix_h, suffix_h)
+
+    # -- async disk writer -------------------------------------------------
+    # A synchronous _store_disk blocks the driver thread on a device->host
+    # copy plus one file write per prompt, serializing device compute with
+    # file I/O every block (the reference has the same serialization,
+    # /root/reference/utils.py:170-177). A single writer thread overlaps
+    # them; the device arrays it holds are exclusively its own (disk-mode
+    # fetches re-upload from files, so nothing donates these buffers), and
+    # depth is bounded so pending writes can't grow HBM without limit.
+
+    _MAX_PENDING_WRITES = 2
+
+    def _submit_disk(self, prompt_idxs, prefix_h, suffix_h) -> None:
+        for a in (prefix_h, suffix_h):
+            if hasattr(a, "copy_to_host_async"):
+                a.copy_to_host_async()  # start the DMA before queueing
+        if self._writer is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            self._writer = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="act-disk-writer"
+            )
+        self._write_futs.append(
+            self._writer.submit(self._store_disk, prompt_idxs, prefix_h, suffix_h)
+        )
+        while len(self._write_futs) > self._MAX_PENDING_WRITES:
+            self._write_futs.pop(0).result()
+
+    def flush(self) -> None:
+        """Barrier: every queued disk write is durably on disk (re-raising
+        the first writer failure). The executor calls this before advancing
+        a resume progress marker — a marker must never claim a shard whose
+        activation files are still in flight."""
+        while self._write_futs:
+            self._write_futs.pop(0).result()
 
     def _finalize(self, block_id) -> None:
         """Resolve a cpu-mode block's pending async copy to host numpy,
@@ -179,12 +216,17 @@ class ActivationStore:
 
     def fetch(self, block_id, prompt_idxs: list[int], with_prefix: bool = True):
         """Returns (prefix_h | None, suffix_h) as host or device arrays; the
-        executor device_puts them as part of the next shard's input feed."""
+        executor device_puts them as part of the next shard's input feed.
+
+        Disk reads flush the async writer first (the queued write may be this
+        very block's files); in-memory cpu/tpu fetches don't wait on
+        unrelated spill I/O."""
         if self.location == "cpu" and block_id in self._pending:
             self._pending.remove(block_id)
             self._finalize(block_id)
         if self.location == "cpu" and block_id in self._spilled:
             self._spilled.discard(block_id)
+            self.flush()
             return self._fetch_disk(prompt_idxs, with_prefix)
         if self.location in ("tpu", "cpu"):
             prefix, suffix = self._mem.pop(block_id)
@@ -193,13 +235,25 @@ class ActivationStore:
             if not with_prefix:
                 prefix = None
             return prefix, suffix
+        if self._write_futs:
+            self.flush()
         return self._fetch_disk(prompt_idxs, with_prefix)
 
     def clear(self) -> None:
-        self._mem.clear()
-        self._spilled.clear()
-        self._pending.clear()
-        self._cpu_prompts = 0
+        try:
+            if self._write_futs:
+                self.flush()
+        finally:
+            # Shut the writer down even when a flush re-raises a failed
+            # write — a leaked pool would pin its queued device arrays.
+            if self._writer is not None:
+                self._writer.shutdown(wait=True)
+                self._writer = None
+            self._write_futs.clear()
+            self._mem.clear()
+            self._spilled.clear()
+            self._pending.clear()
+            self._cpu_prompts = 0
 
 
 __all__ = ["ActivationStore"]

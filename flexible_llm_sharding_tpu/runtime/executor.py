@@ -800,6 +800,9 @@ class StreamingExecutor:
                 # from 0 with the skipped prefix re-marked harmlessly.
                 done = local_idx + 1 + (0 if skip else start_shard)
                 if done < len(self.plan.shards):  # final shard re-runs always
+                    # The marker must never claim a shard whose activation
+                    # writes are still queued in the async disk writer.
+                    store.flush()
                     self._mark_progress(store, sig, done)
 
         compute_time = 0.0
@@ -815,6 +818,16 @@ class StreamingExecutor:
                 n_shards=len(self.plan.shards) - start_shard,
                 skip=skip,
             )
+        except BaseException:
+            # Error path: retire the async disk writer and drop stored
+            # buffers — a leaked writer pins device arrays in HBM for the
+            # process lifetime. (Success path clears after stats, below,
+            # which also acts as the final write barrier.)
+            try:
+                store.clear()
+            except Exception:
+                pass  # the _stream exception is the root cause; keep it
+            raise
         finally:
             source.close()
         finalize_scores(scores)
@@ -891,17 +904,13 @@ class StreamingExecutor:
                     bar.update(1)
                 if not blocks:
                     bar.update(1)
-                # disk stores sync via device_get; tpu/cpu stores are async
-                # (cpu: copy_to_host_async + depth-1 finalize), so block once
-                # per shard there to keep compute_wall_s a device-time
-                # measure — the prefetch thread keeps uploading the next
-                # shard concurrently. (blocks can be empty: num_batch >
-                # prompt count yields ex([]).)
-                if (
-                    blocks
-                    and layer_idxs[-1] != n_layers - 1
-                    and self.cfg.storage_location in ("tpu", "cpu")
-                ):
+                # Every store path is async now (cpu: copy_to_host_async +
+                # depth-1 finalize; disk: writer thread), so block once per
+                # shard to keep compute_wall_s a device-time measure — the
+                # prefetch thread keeps uploading the next shard, and the
+                # disk writer keeps writing, concurrently with this wait.
+                # (blocks can be empty: num_batch > prompt count -> ex([]).)
+                if blocks and layer_idxs[-1] != n_layers - 1:
                     jax.block_until_ready(suffix_h)
                 compute_time += time.perf_counter() - t0
                 if on_shard_done is not None:
