@@ -172,6 +172,14 @@ class PipelineRunner:
                     stage=stage_idx,
                     rank=rank,
                 )
+        except BaseException:
+            # Same hazard as StreamingExecutor's error path: a leaked async
+            # disk writer would pin queued device arrays in HBM.
+            try:
+                store.clear()
+            except Exception:
+                pass  # the stream exception is the root cause; keep it
+            raise
         finally:
             bar.close()
             source.close()

@@ -240,17 +240,34 @@ def main() -> None:
         max_position_embeddings=4096,
     )
     os.makedirs(WORK, exist_ok=True)
+    workload = {
+        "prompts": args.prompts,
+        "prefix_words": args.prefix_words,
+        "suffix_words": 24,
+        "n_suffix": 4,
+    }
     out = os.path.join(ROOT, "SCALE_r02.json")
     result: dict = {}
-    if os.path.exists(out):  # merge runs across invocations — same model only
+    merged_prior = False
+    if os.path.exists(out):
+        # Merge runs across invocations — only for the SAME model AND the
+        # same prompt workload (stats/flags from a different workload would
+        # masquerade as one coherent result).
         try:
             with open(out) as f:
                 prior = json.load(f)
-            if prior.get("config") == cfg:
+            if prior.get("config") == cfg and prior.get("workload") == workload:
                 result = prior
+                merged_prior = True
         except ValueError:
             pass
-    result.update({"config": cfg, "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ")})
+    result.update(
+        {
+            "config": cfg,
+            "workload": workload,
+            "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ"),
+        }
+    )
 
     total_bytes = build_hf_checkpoint(cfg)
     result["model_gb"] = round(total_bytes / 1e9, 2)
@@ -309,13 +326,17 @@ def main() -> None:
         ]
 
     # --- cpu mode (BASELINE config 1) -------------------------------------
-    # A prior invocation's scores (same deterministic prompts/weights) serve
-    # as the comparison baseline when cpu isn't in this run's configs.
+    # A prior invocation's scores serve as the comparison baseline when cpu
+    # isn't in this run's configs — but only when that invocation provably
+    # ran the SAME model and workload (merged_prior: the artifact's config
+    # and workload both matched; prompts/weights are seed-deterministic).
     scores = None
     cpu_scores_path = os.path.join(WORK, "scores-cpu.pkl")
-    if "cpu" not in configs and os.path.exists(cpu_scores_path):
+    if "cpu" not in configs and merged_prior and os.path.exists(cpu_scores_path):
         with open(cpu_scores_path, "rb") as f:
             scores = pickle.load(f)
+        if len(scores) != args.prompts:
+            scores = None
     if "cpu" in configs:
         log("CLI run: storage_location=cpu, layer_num_per_shard=1 ...")
         stats_cpu = run_cli(cli_argv("cpu"), "cpu")

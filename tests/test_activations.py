@@ -1,0 +1,111 @@
+"""ActivationStore unit tests: async disk writer semantics (flush barriers,
+failure propagation, spill/re-store interplay) — the invariants crash resume
+depends on (executor.py advances the progress marker only after flush())."""
+
+import numpy as np
+import pytest
+
+from flexible_llm_sharding_tpu.runtime.activations import ActivationStore
+
+
+def _block(b=2, lp=4, s=3, ls=2, d=8, seed=0):
+    rng = np.random.default_rng(seed)
+    return (
+        rng.standard_normal((b, lp, d)).astype(np.float32),
+        rng.standard_normal((b, s, ls, d)).astype(np.float32),
+    )
+
+
+def test_disk_store_fetch_roundtrip(tmp_path):
+    st = ActivationStore("disk", str(tmp_path), np_dtype=np.float32)
+    p, s = _block()
+    st.store(0, [0, 1], p, s)
+    gp, gs = st.fetch(0, [0, 1])
+    np.testing.assert_array_equal(gp, p)
+    np.testing.assert_array_equal(gs, s)
+    st.clear()
+
+
+def test_disk_flush_is_durable(tmp_path):
+    """After flush() the per-prompt files exist on disk even though store()
+    returned immediately (async writer)."""
+    st = ActivationStore("disk", str(tmp_path), np_dtype=np.float32)
+    p, s = _block()
+    st.store(0, [0, 1], p, s)
+    st.flush()
+    for idx in (0, 1):
+        assert (tmp_path / f"prefix-{idx:05d}.npy").exists()
+        assert (tmp_path / f"suffix-{idx:05d}.npy").exists()
+    st.clear()
+
+
+def test_writer_failure_surfaces_and_clear_still_shuts_down(tmp_path, monkeypatch):
+    st = ActivationStore("disk", str(tmp_path), np_dtype=np.float32)
+
+    def boom(*a, **k):
+        raise OSError("disk full")
+
+    monkeypatch.setattr(st, "_store_disk", boom)
+    p, s = _block()
+    st.store(0, [0], p, s)
+    with pytest.raises(OSError, match="disk full"):
+        st.flush()
+    # clear() must retire the pool even after the failure...
+    st.store(1, [1], p, s)  # queue another failing write
+    with pytest.raises(OSError):
+        st.clear()
+    assert st._writer is None and not st._write_futs
+    # ...and the store must be reusable afterwards.
+    monkeypatch.undo()
+    st.store(2, [2], p[:1], s[:1])
+    gp, gs = st.fetch(2, [2])
+    np.testing.assert_array_equal(gs, s[:1])
+    st.clear()
+
+
+def test_cpu_spill_restore_supersedes_disk_copy(tmp_path):
+    """A re-store of a spilled block must serve the NEW data (the staleness
+    trap from ADVICE r1), across the async writer."""
+    st = ActivationStore("cpu", str(tmp_path), max_in_cpu=2, np_dtype=np.float32)
+    p0, s0 = _block(seed=0)
+    st.store(0, [0, 1], p0, s0)  # fills the cpu bound
+    p1, s1 = _block(seed=1)
+    st.store(1, [2, 3], p1, s1)  # over bound -> spills to disk
+    p2, s2 = _block(seed=2)
+    st.fetch(0, [0, 1])  # frees the bound
+    st.store(1, [2, 3], p2, s2)  # re-store of the spilled block, in memory
+    _, gs = st.fetch(1, [2, 3])
+    np.testing.assert_array_equal(np.asarray(gs), s2)
+    st.clear()
+
+
+def test_fetch_in_memory_does_not_wait_on_spill_io(tmp_path, monkeypatch):
+    """cpu-mode fetch of an in-memory block must not flush unrelated spill
+    writes (driver stall); only disk reads flush."""
+    st = ActivationStore("cpu", str(tmp_path), max_in_cpu=2, np_dtype=np.float32)
+    flushed = []
+    orig_flush = st.flush
+    monkeypatch.setattr(st, "flush", lambda: (flushed.append(1), orig_flush())[1])
+    p0, s0 = _block(seed=0)
+    st.store(0, [0, 1], p0, s0)
+    p1, s1 = _block(seed=1)
+    st.store(1, [2, 3], p1, s1)  # spill queued
+    st.fetch(0, [0, 1])  # in-memory: no flush
+    assert not flushed
+    st.fetch(1, [2, 3])  # spilled: flush required
+    assert flushed
+    st.clear()
+
+
+def test_bfloat16_survives_spill(tmp_path):
+    import ml_dtypes
+
+    bf16 = np.dtype(ml_dtypes.bfloat16)
+    st = ActivationStore("disk", str(tmp_path), np_dtype=bf16)
+    p, s = _block()
+    p, s = p.astype(bf16), s.astype(bf16)
+    st.store(0, [0, 1], p, s)
+    gp, gs = st.fetch(0, [0, 1])
+    assert gp.dtype == bf16 and gs.dtype == bf16
+    np.testing.assert_array_equal(gp.view(np.uint16), p.view(np.uint16))
+    st.clear()
