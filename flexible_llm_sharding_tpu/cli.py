@@ -85,7 +85,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--profile_dir", type=str, default="",
                    help="write a jax.profiler (Perfetto/XProf) trace here")
     p.add_argument("--resume", type=_str2bool, default=False,
-                   help="disk mode: resume from the last completed shard")
+                   help="disk mode: resume a crashed run from the last "
+                        "completed shard (single-device/DP) or pipeline "
+                        "stage (MP)")
     p.add_argument("--long_context", type=_str2bool, default=False,
                    help="score prefixes longer than max_token_len exactly "
                         "via sequence parallelism (cap becomes "

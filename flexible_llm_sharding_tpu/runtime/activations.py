@@ -71,9 +71,15 @@ class ActivationStore:
         rank_tag: bool = False,
         max_in_cpu: int | None = None,
         np_dtype: np.dtype | None = None,
+        batch: int = 0,
     ):
         # np_dtype: the compute dtype of stored activations; needed to
         # restore ml_dtypes extension types (bfloat16) from disk files.
+        # batch: the num_batch loop index — scopes disk file names (and the
+        # resume marker, via the shared tag) per batch, otherwise batch A's
+        # re-run would overwrite the files a crashed batch B resumes from
+        # (same 0-based prompt indices, same folder). Batch 0 keeps the
+        # reference's exact names.
         if location not in ("tpu", "cpu", "disk"):
             raise ValueError(f"storage_location must be tpu|cpu|disk, got {location!r}")
         self.location = location
@@ -81,7 +87,9 @@ class ActivationStore:
         self.np_dtype = None if np_dtype is None else np.dtype(np_dtype)
         # The reference tags disk files with the gpu rank only in DP mode
         # (/root/reference/utils.py:172): rank_tag mirrors that.
-        self.tag = str(device_rank) if rank_tag else ""
+        self.tag = (str(device_rank) if rank_tag else "") + (
+            f".b{batch}" if batch else ""
+        )
         self._mem: dict[object, tuple] = {}
         # cpu-mode bound (reference's max_activation_in_cpu backpressure,
         # /root/reference/utils.py:179-180): at most this many prompts' worth
@@ -101,31 +109,57 @@ class ActivationStore:
         self._pending: list[object] = []
         self._writer = None  # lazy single-thread pool for async disk writes
         self._write_futs: list = []
+        self._store_gen = 0  # disk write/read generations (see set_shard)
+        self._fetch_gen = 0
         if location == "disk":
             os.makedirs(disk_folder, exist_ok=True)
 
-    # -- paths (reference naming contract) ---------------------------------
-    def _paths(self, prompt_idx: int) -> tuple[str, str]:
+    # -- paths (reference naming contract, plus a write-generation tag) ----
+    def _paths(self, prompt_idx: int, gen: int = 0) -> tuple[str, str]:
+        # gen: disk-mode writes ping-pong between two file generations so a
+        # shard/stage never overwrites its own INPUT files mid-run — the
+        # property crash resume needs (a killed shard k re-runs from the
+        # intact generation (k-1)%2; without this, its partial stores would
+        # have destroyed some of shard k-1's outputs in place). Generation 0
+        # keeps the reference's exact file names
+        # (/root/reference/utils.py:172).
+        g = f".g{gen}" if gen else ""
         return (
-            os.path.join(self.disk_folder, f"prefix{self.tag}-{prompt_idx:05d}.npy"),
-            os.path.join(self.disk_folder, f"suffix{self.tag}-{prompt_idx:05d}.npy"),
+            os.path.join(
+                self.disk_folder, f"prefix{self.tag}-{prompt_idx:05d}{g}.npy"
+            ),
+            os.path.join(
+                self.disk_folder, f"suffix{self.tag}-{prompt_idx:05d}{g}.npy"
+            ),
         )
 
+    def set_shard(self, shard_idx: int) -> None:
+        """Disk mode: declare the shard/stage about to run; its stores go to
+        generation ``shard_idx % 2`` and its fetches read ``(shard_idx-1) % 2``.
+        No-op for tpu/cpu stores (the cpu spill path keeps generation 0 —
+        spills live and die within one shard, so there is no overwrite
+        hazard and no resume)."""
+        if self.location == "disk":
+            self._store_gen = shard_idx % 2
+            self._fetch_gen = (shard_idx - 1) % 2
+
     # -- block API ---------------------------------------------------------
-    def _store_disk(self, prompt_idxs: list[int], prefix_h, suffix_h) -> None:
+    def _store_disk(
+        self, prompt_idxs: list[int], prefix_h, suffix_h, gen: int = 0
+    ) -> None:
         os.makedirs(self.disk_folder, exist_ok=True)
         prefix_np = None if prefix_h is None else np.asarray(jax.device_get(prefix_h))
         suffix_np = np.asarray(jax.device_get(suffix_h))
         for row, idx in enumerate(prompt_idxs):
-            ppath, spath = self._paths(idx)
+            ppath, spath = self._paths(idx, gen)
             _save_npy(spath, suffix_np[row])
             if prefix_np is not None:
                 _save_npy(ppath, prefix_np[row])
 
-    def _fetch_disk(self, prompt_idxs: list[int], with_prefix: bool):
+    def _fetch_disk(self, prompt_idxs: list[int], with_prefix: bool, gen: int = 0):
         prefixes, suffixes = [], []
         for idx in prompt_idxs:
-            ppath, spath = self._paths(idx)
+            ppath, spath = self._paths(idx, gen)
             suffixes.append(_load_npy(spath, self.np_dtype))
             if with_prefix:
                 prefixes.append(_load_npy(ppath, self.np_dtype))
@@ -191,7 +225,14 @@ class ActivationStore:
                 max_workers=1, thread_name_prefix="act-disk-writer"
             )
         self._write_futs.append(
-            self._writer.submit(self._store_disk, prompt_idxs, prefix_h, suffix_h)
+            self._writer.submit(
+                self._store_disk,
+                prompt_idxs,
+                prefix_h,
+                suffix_h,
+                # Captured NOW: the writer may run after set_shard advances.
+                self._store_gen,
+            )
         )
         while len(self._write_futs) > self._MAX_PENDING_WRITES:
             self._write_futs.pop(0).result()
@@ -237,7 +278,7 @@ class ActivationStore:
             return prefix, suffix
         if self._write_futs:
             self.flush()
-        return self._fetch_disk(prompt_idxs, with_prefix)
+        return self._fetch_disk(prompt_idxs, with_prefix, self._fetch_gen)
 
     def clear(self) -> None:
         try:

@@ -44,6 +44,7 @@ from flexible_llm_sharding_tpu.runtime.tokenization import (
     TokenizedPrompt,
     make_blocks,
 )
+from flexible_llm_sharding_tpu.runtime import resume
 from flexible_llm_sharding_tpu.utils import checkpoint, metrics
 
 Params = dict[str, Any]
@@ -688,65 +689,42 @@ class StreamingExecutor:
     def _tokenize(self, prompts) -> list[TokenizedPrompt]:
         return [self.tokenizer(p, s) for p, s in prompts]
 
-    # -- disk-mode crash resume --------------------------------------------
-    # The reference's disk mode is accidentally restartable through its .npy
-    # activation files (SURVEY.md §5 "failure detection"); here that becomes
-    # explicit: a progress marker records the last fully-stored shard, and a
-    # resumed run streams only the remaining shards, re-reading the stored
-    # activations. A signature over the prompt/bucket/plan shape guards
-    # against resuming into a different workload.
+    # -- disk-mode crash resume (markers shared with the pipeline: see
+    # runtime/resume.py for the signature/marker contract) -----------------
 
     def _resume_signature(self, toks) -> str:
-        import hashlib
-
-        h = hashlib.sha1(
-            repr(
-                (
-                    len(toks),
-                    [t.bucket_key for t in toks],
-                    self.plan.shards,
-                    self.cfg.dtype,
-                    self.cfg.block_size,
-                )
-            ).encode()
+        return resume.workload_signature(
+            toks, self.plan.shards, self.cfg.model_path,
+            self.cfg.dtype, self.cfg.block_size,
         )
-        # Token CONTENT, not just shapes: a generation step appends tokens
-        # without necessarily crossing a bucket boundary, and resuming one
-        # step's activations into another must be rejected.
-        for t in toks:
-            h.update(t.prefix_ids.tobytes())
-            h.update(t.suffix_ids.tobytes())
-        return h.hexdigest()
 
-    def _progress_path(self, store: ActivationStore) -> str:
-        return os.path.join(self.cfg.disk_folder, f"progress{store.tag}.json")
+    def _progress_path(self, store: ActivationStore, sig: str) -> str:
+        return resume.marker_path(self.cfg.disk_folder, sig, store.tag)
 
     def _resume_start(self, store: ActivationStore, sig: str) -> int:
-        import json
+        """First shard a resumed run must execute.
 
+        Safe against mid-shard crashes because disk stores ping-pong between
+        two file generations (ActivationStore.set_shard): shard k writes
+        generation k%2 and reads (k-1)%2, so a crashed shard k can never
+        have destroyed its own inputs — the resumed run simply rewrites
+        shard k's outputs from the intact previous generation.
+        """
         if not (self.cfg.resume and self.cfg.storage_location == "disk"):
             return 0
-        try:
-            with open(self._progress_path(store)) as f:
-                data = json.load(f)
-        except (OSError, ValueError):
-            return 0
-        if data.get("signature") != sig:
-            return 0
+        data = resume.read_marker(self._progress_path(store, sig), sig)
         # The final shard produces the scores and is never marked complete,
         # so start is always < num_shards.
         return min(int(data.get("completed_shards", 0)), len(self.plan.shards) - 1)
 
     def _mark_progress(self, store: ActivationStore, sig: str, done: int) -> None:
-        import json
+        resume.write_marker(
+            self._progress_path(store, sig), sig, completed_shards=done
+        )
 
-        path = self._progress_path(store)
-        tmp = path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump({"completed_shards": done, "signature": sig}, f)
-        os.replace(tmp, path)  # atomic: a crash mid-write keeps the old marker
-
-    def __call__(self, prompts) -> list[np.ndarray]:
+    def __call__(self, prompts, batch: int = 0) -> list[np.ndarray]:
+        # batch: the num_batch loop index (scopes disk activation files and
+        # the resume marker per batch — see ActivationStore).
         t_start = time.perf_counter()
         toks = self._tokenize(prompts)
         blocks = make_blocks(toks, self.cfg.block_size)
@@ -757,6 +735,7 @@ class StreamingExecutor:
             rank_tag=self.plan.num_devices > 1 and self.cfg.data_parallel,
             max_in_cpu=self.cfg.max_activation_in_cpu,
             np_dtype=self._np_dtype,
+            batch=batch,
         )
         resumable = self.cfg.storage_location == "disk"
         sig = self._resume_signature(toks) if resumable else ""
@@ -817,6 +796,7 @@ class StreamingExecutor:
                 on_shard_done,
                 n_shards=len(self.plan.shards) - start_shard,
                 skip=skip,
+                start_shard=start_shard,
             )
         except BaseException:
             # Error path: retire the async disk writer and drop stored
@@ -832,10 +812,7 @@ class StreamingExecutor:
             source.close()
         finalize_scores(scores)
         if resumable:  # completed: drop the marker
-            try:
-                os.remove(self._progress_path(store))
-            except OSError:
-                pass
+            resume.remove_marker(self._progress_path(store, sig))
 
         self.stats = {
             "load_weights_time_s": source.load_time,
@@ -872,6 +849,7 @@ class StreamingExecutor:
         on_shard_done=None,
         n_shards: int | None = None,
         skip: int = 0,
+        start_shard: int = 0,
     ) -> float:
         n_layers = len(self.layer_names)
         compute_time = 0.0
@@ -884,6 +862,10 @@ class StreamingExecutor:
                     # the crashed attempt; drop its broadcast weights unused.
                     del segments
                     continue
+                # Global shard index: shared sources yield every shard from
+                # 0 (skip consumed the resumed prefix); an own source yields
+                # only the resumed tail.
+                store.set_shard(shard_i + (0 if skip else start_shard))
                 t0 = time.perf_counter()
                 for b, idxs in enumerate(blocks):
                     suffix_h = process_block(

@@ -70,10 +70,12 @@ def _gather_dp(pool: ThreadPoolExecutor, futures, source) -> list:
 
 def _run_batched(ex: StreamingExecutor, prompts: list[Prompt], num_batch: int):
     """The reference's num_batch loop (``/root/reference/main.py:19-23``):
-    each batch is a full streaming pass (bounds activation-store footprint)."""
+    each batch is a full streaming pass (bounds activation-store footprint).
+    The batch index scopes disk activation files/markers so crash resume of
+    one batch can't be clobbered by another's re-run."""
     out: list[np.ndarray] = []
-    for lo, hi in batch_ranges(len(prompts), num_batch):
-        out += ex(prompts[lo:hi])
+    for i, (lo, hi) in enumerate(batch_ranges(len(prompts), num_batch)):
+        out += ex(prompts[lo:hi], batch=i)
     return out
 
 

@@ -39,6 +39,7 @@ from flexible_llm_sharding_tpu.parallel.planner import (
     batch_ranges,
     global_stage_order,
 )
+from flexible_llm_sharding_tpu.runtime import resume
 from flexible_llm_sharding_tpu.runtime.activations import ActivationStore
 from flexible_llm_sharding_tpu.runtime.executor import (
     ScoreSink,
@@ -88,11 +89,42 @@ class PipelineRunner:
 
     def __call__(self, prompts) -> list[np.ndarray]:
         out: list[np.ndarray] = []
-        for lo, hi in batch_ranges(len(prompts), self.cfg.num_batch):
-            out += self._run_batch(prompts[lo:hi])
+        for i, (lo, hi) in enumerate(batch_ranges(len(prompts), self.cfg.num_batch)):
+            out += self._run_batch(prompts[lo:hi], batch=i)
         return out
 
-    def _run_batch(self, prompts) -> list[np.ndarray]:
+    # -- disk-mode crash resume (MP counterpart of the executor's) ---------
+    # In disk mode every inter-stage handoff is a durable per-prompt .npy
+    # pair (generation ping-pong: see ActivationStore.set_shard), so a
+    # crashed pipeline restarts from the last fully-stored stage — even a
+    # mid-stage crash, whose partial writes went to the OTHER generation.
+    # The signature (runtime/resume.py) guards against resuming into a
+    # different checkpoint, workload, stage plan, or device count (rank
+    # assignment is part of the stage tuples).
+
+    def _resume_signature(self, toks) -> str:
+        return resume.workload_signature(
+            toks,
+            ("mp", [(r, s) for (_, r, s) in self.stages]),
+            self.cfg.model_path,
+            self.cfg.dtype,
+            self.cfg.block_size,
+        )
+
+    def _marker_path(self, sig: str, tag: str) -> str:
+        return resume.marker_path(self.cfg.disk_folder, sig, tag)
+
+    def _resume_start(self, sig: str, tag: str, last_real: int) -> int:
+        if not (self.cfg.resume and self.cfg.storage_location == "disk"):
+            return 0
+        data = resume.read_marker(self._marker_path(sig, tag), sig)
+        # The head stage produces the scores and is never marked complete.
+        return min(int(data.get("completed_stages", 0)), last_real)
+
+    def _mark_stage(self, sig: str, tag: str, done: int) -> None:
+        resume.write_marker(self._marker_path(sig, tag), sig, completed_stages=done)
+
+    def _run_batch(self, prompts, batch: int = 0) -> list[np.ndarray]:
         t_start = time.perf_counter()
         toks = [self.tokenizer(p, s) for p, s in prompts]
         blocks = make_blocks(toks, self.cfg.block_size)
@@ -101,9 +133,18 @@ class PipelineRunner:
             self.cfg.disk_folder,
             max_in_cpu=self.cfg.max_activation_in_cpu,
             np_dtype=self._np_dtype,
+            batch=batch,
         )
-        stage_shards = [s for (_, _, s) in self.stages]
-        stage_devs = [self.devices[r] for (_, r, _) in self.stages]
+        resumable = self.cfg.storage_location == "disk"
+        last_real = max(
+            (i for i, (_, _, s) in enumerate(self.stages) if s), default=0
+        )
+        sig = self._resume_signature(toks) if resumable else ""
+        start_stage = (
+            self._resume_start(sig, store.tag, last_real) if resumable else 0
+        )
+        stage_shards = [s for (_, _, s) in self.stages[start_stage:]]
+        stage_devs = [self.devices[r] for (_, r, _) in self.stages[start_stage:]]
         source = ShardWeightSource(
             self.cfg.model_path,
             self.layer_names,
@@ -138,15 +179,18 @@ class PipelineRunner:
             return dev_meta[key]
 
         bar = metrics.progress_bar(
-            len(self.stages) * max(len(blocks), 1), desc="pipeline", unit="blk"
+            (len(self.stages) - start_stage) * max(len(blocks), 1),
+            desc="pipeline",
+            unit="blk",
         )
         try:
             for ((stage_idx, rank, layer_idxs), (_, segments)) in zip(
-                self.stages, source
+                self.stages[start_stage:], source
             ):
                 if not layer_idxs:  # round-up padding stage
                     bar.update(max(len(blocks), 1))
                     continue
+                store.set_shard(stage_idx)
                 dev = self.devices[rank]
                 t_stage = time.perf_counter()
                 for b, idxs in enumerate(blocks):
@@ -172,6 +216,12 @@ class PipelineRunner:
                     stage=stage_idx,
                     rank=rank,
                 )
+                if resumable and stage_idx < last_real:
+                    # Durable-store barrier, then advance the marker; disk
+                    # mode is already file-synchronized stage-to-stage, so
+                    # this flush costs nothing extra.
+                    store.flush()
+                    self._mark_stage(sig, store.tag, stage_idx + 1)
         except BaseException:
             # Same hazard as StreamingExecutor's error path: a leaked async
             # disk writer would pin queued device arrays in HBM.
@@ -192,6 +242,8 @@ class PipelineRunner:
         # does (/root/reference/utils.py:185-213), with zero polling.
         dispatch_wall = time.perf_counter() - t_start
         finalize_scores(scores)
+        if resumable:  # completed: drop the marker
+            resume.remove_marker(self._marker_path(sig, store.tag))
 
         self.stats = {
             "load_weights_time_s": source.load_time,

@@ -1,0 +1,87 @@
+"""Disk-mode crash-resume markers, shared by the streaming executor and the
+MP pipeline runner.
+
+The reference's disk mode is only *accidentally* restartable through its
+``.npy`` activation files (SURVEY.md §5 "failure detection"); here resume is
+explicit and guarded:
+
+- The marker file is **named by the workload signature** (plus an optional
+  rank tag), so concurrent/successive batches with different prompt sets
+  (``num_batch`` loop) can never consume each other's progress.
+- The signature hashes the model path, prompt token CONTENT, the shard/stage
+  plan, dtype, and block size — resuming into a different checkpoint,
+  workload, device count, or plan silently restarts from zero instead of
+  mixing incompatible activations.
+- Marker writes are atomic (tmp + rename): a crash mid-write keeps the old
+  marker.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from typing import Any
+
+
+def workload_signature(
+    toks, plan_repr: Any, model_path: str, dtype: str, block_size: int
+) -> str:
+    """Hash of everything a resumed run must share with the crashed one."""
+    h = hashlib.sha1(
+        repr(
+            (
+                os.path.abspath(model_path),
+                len(toks),
+                [t.bucket_key for t in toks],
+                plan_repr,
+                dtype,
+                block_size,
+            )
+        ).encode()
+    )
+    # Token CONTENT, not just shapes: a generation step appends tokens
+    # without necessarily crossing a bucket boundary, and resuming one
+    # step's activations into another must be rejected.
+    for t in toks:
+        h.update(t.prefix_ids.tobytes())
+        h.update(t.suffix_ids.tobytes())
+    return h.hexdigest()
+
+
+def marker_path(disk_folder: str, sig: str, tag: str = "") -> str:
+    """Signature-keyed marker file (rank-tagged for DP)."""
+    return os.path.join(disk_folder, f"progress-{sig[:16]}{tag}.json")
+
+
+def read_marker(path: str, sig: str) -> dict:
+    """The marker's fields, or {} when absent/corrupt/foreign-signature."""
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        return {}
+    return data if data.get("signature") == sig else {}
+
+
+def write_marker(path: str, sig: str, **fields) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump({"signature": sig, **fields}, f)
+    os.replace(tmp, path)  # atomic: a crash mid-write keeps the old marker
+
+
+def remove_marker(path: str) -> None:
+    try:
+        os.remove(path)
+    except OSError:
+        pass
+
+
+__all__ = [
+    "workload_signature",
+    "marker_path",
+    "read_marker",
+    "write_marker",
+    "remove_marker",
+]
