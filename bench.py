@@ -16,7 +16,11 @@ loses the measurements that did complete.
 Prints exactly ONE JSON line on stdout:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N,
    "tokens_per_sec": N, "tokens_per_sec_per_chip": N, "peak_hbm_gb": N,
-   "platform": ..., "pallas_speedup_4k": N}
+   "platform": ..., "pallas_speedup_4k": N, "decode_speedup_4tok": N}
+
+decode_speedup_4tok: KV-cache decode vs the reference's full-recompute
+generation algorithm on the same workload (its per-token scaling cliff,
+/root/reference/main.py:63-90).
 """
 
 from __future__ import annotations
@@ -74,19 +78,24 @@ class BenchTokenizer:
     pad_token_id = EOS
     padding_side = "right"
 
-    def _ids(self, text: str) -> list[int]:
+    def _one_id(self, w: str) -> int:
+        # Round-trip for decode()'s output, so the generation loop's
+        # string-rebuild semantics retokenize generated tokens faithfully
+        # (needed for the recompute-vs-kv-cache comparison to be apples to
+        # apples).
+        if w.startswith("tok") and w[3:].isdigit():
+            return int(w[3:]) % self.VOCAB
         # crc32, not hash(): Python's hash() is salted per process, which
         # would vary token ids (and thus timings) between invocations.
-        return [self.BOS] + [
-            3 + (zlib.crc32(w.encode()) % (self.VOCAB - 3)) for w in text.split()
-        ]
+        return 3 + (zlib.crc32(w.encode()) % (self.VOCAB - 3))
+
+    def _ids(self, text: str) -> list[int]:
+        return [self.BOS] + [self._one_id(w) for w in text.split()]
 
     def decode(self, ids) -> str:
-        # The word-hash is one-way; a stable placeholder keeps the
-        # generation loop's append-to-suffix contract intact.
         if np.ndim(ids) == 0:
             ids = [int(ids)]
-        return "".join(f" <tok{int(i)}>" for i in ids)
+        return "".join(f" tok{int(i)}" for i in ids)
 
     def __call__(self, text, max_length=None, padding=False, **kw):
         if isinstance(text, str):
@@ -183,6 +192,51 @@ def bench_pallas(jax, result: dict) -> None:
     result["pallas_speedup_4k"] = round(t_xla / t_flash, 3)
 
 
+def bench_decode(cfg_obj, prompts, tok, result: dict, n_tok: int = 4) -> None:
+    """KV-cache decode vs the reference's full-recompute generation loop
+    (``/root/reference/main.py:63-90`` — per-token cost equals full-prompt
+    cost, its known scaling cliff, SURVEY.md §3.5). Same model, same
+    prompts, same greedy semantics; ``decode_speedup_{n}tok`` is the wall
+    ratio, the framework's headline win over the reference's algorithm."""
+    import dataclasses
+
+    from flexible_llm_sharding_tpu.runtime.decode import DecodeGenerator
+    from flexible_llm_sharding_tpu.runtime.executor import StreamingExecutor
+    from flexible_llm_sharding_tpu.runtime.generation import generation_loop
+
+    cfg_obj = dataclasses.replace(cfg_obj, num_gen_token=n_tok)
+
+    # Warm BOTH paths fully (their jit shapes depend on the prompt block
+    # and on n_tok), then measure — otherwise compile time amortizes over
+    # the recompute path's n_tok passes but lands wholly inside the single
+    # KV pass, skewing the ratio.
+    ex = StreamingExecutor(cfg_obj, tokenizer=tok)
+    generation_loop(ex, prompts, n_tok, tok)
+    gen = DecodeGenerator(cfg_obj, tokenizer=tok)
+    gen(prompts)
+
+    t0 = time.perf_counter()
+    ref_scores, _ = generation_loop(ex, prompts, n_tok, tok)
+    t_recompute = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    kv_scores, _ = gen(prompts)
+    t_kv = time.perf_counter() - t0
+
+    # Same greedy semantics -> same argmax tokens.
+    agree = all(
+        np.array_equal(np.argmax(a, axis=-1), np.argmax(b, axis=-1))
+        for a, b in zip(ref_scores, kv_scores)
+    )
+    log(
+        f"generation {n_tok} tok: recompute={t_recompute:.2f}s "
+        f"kv_cache={t_kv:.2f}s argmax_agree={agree}"
+    )
+    result[f"decode_speedup_{n_tok}tok"] = round(t_recompute / t_kv, 3)
+    if not agree:
+        result["decode_argmax_mismatch"] = True
+
+
 def run_bench(result: dict) -> None:
     jax, devs = _init_jax()
     log(f"devices: {devs}")
@@ -270,6 +324,12 @@ def run_bench(result: dict) -> None:
             bench_pallas(jax, result)
         except Exception:
             log("pallas bench failed:\n" + traceback.format_exc())
+        try:
+            # Small prompt set: the recompute baseline costs n_tok full
+            # streaming passes, twice (warmup + measure).
+            bench_decode(fw(2), prompts[:2], tok, result)
+        except Exception:
+            log("decode bench failed:\n" + traceback.format_exc())
 
 
 def main() -> None:

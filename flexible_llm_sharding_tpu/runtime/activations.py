@@ -122,7 +122,12 @@ class ActivationStore:
         # intact generation (k-1)%2; without this, its partial stores would
         # have destroyed some of shard k-1's outputs in place). Generation 0
         # keeps the reference's exact file names
-        # (/root/reference/utils.py:172).
+        # (/root/reference/utils.py:172). Cost: steady-state disk holds TWO
+        # generations of activation files (the input generation cannot be
+        # reclaimed before the shard completes — that is the safety
+        # property) — activations are small next to the weights being
+        # streamed (~tens of MB/prompt at 7B vs 13.5 GB of weights), and
+        # stale files are simply overwritten by the next same-parity shard.
         g = f".g{gen}" if gen else ""
         return (
             os.path.join(
