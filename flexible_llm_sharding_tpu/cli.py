@@ -229,13 +229,8 @@ def main(argv: list[str] | None = None, tokenizer=None) -> None:
                 )
             from flexible_llm_sharding_tpu.runtime.orchestration import run_decode
 
-            devs = pick_devices(cfg)
-            if len(devs) > 1 and not cfg.data_parallel:
-                raise SystemExit(
-                    "--kv_cache on multiple chips requires --data_parallel "
-                    "true (prompt-split decode); the interleaved MP pipeline "
-                    "has no KV-cache mode — or pass --num_devices 1"
-                )
+            # Multi-chip: --data_parallel true splits prompts across chips;
+            # default is the interleaved MP pipeline with per-stage KV.
             output_scores, updated, tokens_processed = run_decode(
                 cfg, prompts, tokenizer=tokenizer
             )

@@ -123,7 +123,12 @@ class KVStore:
 
     def get(self, key: tuple, device=None):
         kv = self._mem.pop(key)
-        return kv if self.on_device else jax.device_put(kv, device)
+        if self.on_device:
+            # MP pipeline: an activation parked by stage s lives on stage
+            # s's chip; moving it to stage s+1's chip is a device-to-device
+            # ICI hop (a no-op when it's already there).
+            return kv if device is None else jax.device_put(kv, device)
+        return jax.device_put(kv, device)
 
     def clear(self) -> None:
         self._mem.clear()
@@ -147,11 +152,18 @@ class DecodeGenerator:
         device=None,
         tokenizer=None,
         weight_source_factory=None,
+        mp_devices=None,
     ):
         # weight_source_factory: DP mode passes views of one shared
         # BroadcastShardSource (rounds = num_gen_token: one per weight
         # stream — prefill plus each decode step) so the checkpoint is read
         # from disk once for all chips; see orchestration.run_decode.
+        # mp_devices: interleaved-pipeline decode — shard k's weights AND its
+        # parked KV live on chip k % N (the reference's MP assignment,
+        # /root/reference/utils.py:151-153); activations hop chip-to-chip
+        # between stages. Mutually exclusive with weight_source_factory.
+        if weight_source_factory is not None and mp_devices is not None:
+            raise ValueError("mp_devices and weight_source_factory are exclusive")
         self.weight_source_factory = weight_source_factory
         self.cfg = cfg
         self.model_cfg = LlamaConfig.from_pretrained(cfg.model_path)
@@ -170,7 +182,23 @@ class DecodeGenerator:
         self.layer_names = checkpoint.layer_names_for(
             self.model_cfg.num_hidden_layers, tie_word_embeddings=False
         )
-        self.plan = plan_shards_dp(len(self.layer_names), cfg.layer_num_per_shard)
+        if mp_devices is not None and len(mp_devices) > 1:
+            from flexible_llm_sharding_tpu.parallel.planner import (
+                global_stage_order,
+            )
+
+            stages = global_stage_order(
+                len(self.layer_names), cfg.layer_num_per_shard, len(mp_devices)
+            )
+            self.shards = [s for (_, _, s) in stages]
+            self.shard_devices = [mp_devices[r] for (_, r, _) in stages]
+        else:
+            if mp_devices:  # single chip: plain streaming decode
+                device = self.device = mp_devices[0]
+            self.shards = list(
+                plan_shards_dp(len(self.layer_names), cfg.layer_num_per_shard).shards
+            )
+            self.shard_devices = [device] * len(self.shards)
         self.stats: dict[str, float] = {}
 
     def _source(self):
@@ -179,9 +207,9 @@ class DecodeGenerator:
         return ShardWeightSource(
             self.cfg.model_path,
             self.layer_names,
-            self.plan.shards,
+            self.shards,
             np_dtype_for(self.cfg.dtype),
-            device=self.device,
+            devices=self.shard_devices,
             prefetch_depth=self.cfg.prefetch_depth,
             tied_embeddings=self.model_cfg.tie_word_embeddings,
         )
@@ -212,12 +240,15 @@ class DecodeGenerator:
         source = self._source()
         try:
             for shard_pos, (layer_idxs, segments) in enumerate(source):
+                if not layer_idxs:  # MP round-up padding stage
+                    continue
+                dev = self.shard_devices[shard_pos]
                 for b, idxs in enumerate(blocks):
                     prefix_ids, suffix_ids, prefix_len, suffix_eos = block_meta[b]
                     if layer_idxs[0] == 0:
                         ph, sh = None, None
                     else:
-                        ph, sh = kv_store.get(("h", b), self.device)
+                        ph, sh = kv_store.get(("h", b), dev)
                     for kind, params in segments:
                         if kind == "embed":
                             ph, sh = _embed_block(
@@ -267,12 +298,15 @@ class DecodeGenerator:
             norm_params = None
             try:
                 for shard_pos, (layer_idxs, segments) in enumerate(source):
+                    if not layer_idxs:  # MP round-up padding stage
+                        continue
+                    dev = self.shard_devices[shard_pos]
                     for b, idxs in enumerate(blocks):
                         _, _, prefix_len, suffix_eos = block_meta[b]
                         if layer_idxs[0] == 0:
                             x = None
                         else:
-                            x = kv_store.get(("x", b), self.device)
+                            x = kv_store.get(("x", b), dev)
                         for kind, params in segments:
                             if kind == "embed":
                                 ids = jnp.asarray(
@@ -280,7 +314,7 @@ class DecodeGenerator:
                                 )
                                 x = llama.embed(params, ids, self.dtype)
                             elif kind == "decoders":
-                                kv = kv_store.get(("kv", shard_pos, b), self.device)
+                                kv = kv_store.get(("kv", shard_pos, b), dev)
                                 x, kv = _decode_decoders(
                                     self.model_cfg, params, kv, x,
                                     prefix_len, suffix_eos, jnp.int32(t),
@@ -290,10 +324,15 @@ class DecodeGenerator:
                                 norm_params = params  # applied inside the head
                             else:  # head
                                 assert norm_params is not None
+                                # MP: model.norm may live on an earlier
+                                # stage's chip; its scale vector hops here.
                                 dist = np.asarray(
                                     jax.device_get(
                                         _decode_norm_head(
-                                            self.model_cfg, norm_params, params, x
+                                            self.model_cfg,
+                                            jax.device_put(norm_params, dev),
+                                            params,
+                                            x,
                                         )
                                     )
                                 )

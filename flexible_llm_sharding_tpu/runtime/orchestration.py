@@ -231,7 +231,15 @@ def run_decode(
     prompts = list(prompts)
     devices = devices if devices is not None else pick_devices(cfg)
 
-    if len(devices) <= 1 or not cfg.data_parallel or len(prompts) <= 1:
+    if len(devices) > 1 and not cfg.data_parallel:
+        # Interleaved-pipeline decode (reference MP assignment): each
+        # stage's weights and parked KV live on its own chip, activations
+        # hop over ICI; one driver, no prompt split needed.
+        gen = DecodeGenerator(cfg, tokenizer=tokenizer, mp_devices=devices)
+        scores, updated = gen(prompts)
+        return scores, updated, int(gen.stats.get("tokens_processed", 0))
+
+    if len(devices) <= 1 or len(prompts) <= 1:
         gen = DecodeGenerator(
             cfg, device=devices[0] if devices else None, tokenizer=tokenizer
         )

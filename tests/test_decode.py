@@ -197,3 +197,68 @@ def test_decode_single_token(tiny_cfg, model):
     want_scores, _ = _oracle(params, tiny_cfg, tok, PROMPTS, 1)
     for got, want in zip(scores, want_scores):
         np.testing.assert_allclose(got, want, rtol=2e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("storage,lnps,nd", [("tpu", 1, 3), ("cpu", 2, 4)])
+def test_decode_mp_pipeline_matches_oracle(tiny_cfg, model, storage, lnps, nd):
+    """KV-cache decode over the interleaved MP pipeline: per-stage weights
+    AND parked KV on each stage's chip, activations hopping over ICI — must
+    match the token-level monolithic oracle exactly."""
+    model_dir, params = model
+    cfg = FrameworkConfig(
+        model_path=model_dir,
+        layer_num_per_shard=lnps,
+        storage_location=storage,
+        dtype="float32",
+        bucket_multiple=8,
+        block_size=2,
+        prefetch_depth=1,
+        num_gen_token=N_GEN,
+    )
+    tok = PromptTokenizer(FakeTokenizer(), bucket_multiple=8)
+    want_s, want_t = _oracle(params, tiny_cfg, tok, PROMPTS, N_GEN)
+
+    gen = DecodeGenerator(
+        cfg, tokenizer=FakeTokenizer(), mp_devices=jax.devices()[:nd]
+    )
+    got, updated = gen(PROMPTS)
+    fake = FakeTokenizer()
+    for g, w, toks_w, (_, up_sfx), (_, orig_sfx) in zip(
+        got, want_s, want_t, updated, PROMPTS
+    ):
+        assert g.shape == w.shape
+        np.testing.assert_allclose(g, w, rtol=1e-4, atol=1e-5)
+        # Updated suffixes = original + decode of the oracle's greedy tokens.
+        for s_i, orig in enumerate(orig_sfx):
+            assert up_sfx[s_i] == orig + fake.decode(toks_w[s_i])
+
+
+def test_decode_mp_cli(tiny_cfg, model, tmp_path):
+    """--kv_cache on multiple chips WITHOUT --data_parallel routes through
+    the pipeline decode (previously rejected)."""
+    import pickle
+
+    from flexible_llm_sharding_tpu.cli import main
+
+    model_dir, params = model
+    ppkl, opkl = tmp_path / "p.pkl", tmp_path / "s.pkl"
+    with open(ppkl, "wb") as f:
+        pickle.dump(PROMPTS, f)
+    main(
+        [
+            "--model_path", model_dir,
+            "--prompt_pickle", str(ppkl),
+            "--output_file", str(opkl),
+            "--num_gen_token", str(N_GEN),
+            "--dtype", "float32",
+            "--kv_cache", "true",
+            "--num_devices", "3",
+        ],
+        tokenizer=FakeTokenizer(),
+    )
+    with open(opkl, "rb") as f:
+        scores = pickle.load(f)
+    tok = PromptTokenizer(FakeTokenizer(), bucket_multiple=64)
+    want_s, _ = _oracle(params, tiny_cfg, tok, PROMPTS, N_GEN)
+    for g, w in zip(scores, want_s):
+        np.testing.assert_allclose(g, w, rtol=1e-4, atol=1e-5)
