@@ -270,12 +270,16 @@ class DecodeGenerator:
                                 self.model_cfg.head_dim,
                             )
                             # Two distinct buffers: kg/vg are donated by the
-                            # decode scan and must not alias.
-                            kv = {
-                                **kv,
-                                "kg": jnp.zeros(gen_shape, self.dtype),
-                                "vg": jnp.zeros(gen_shape, self.dtype),
-                            }
+                            # decode scan and must not alias. Allocated on
+                            # the STAGE's chip (MP): uncommitted zeros would
+                            # all land on chip 0, concentrating every
+                            # stage's gen-KV there during prefill.
+                            with jax.default_device(dev):
+                                kv = {
+                                    **kv,
+                                    "kg": jnp.zeros(gen_shape, self.dtype),
+                                    "vg": jnp.zeros(gen_shape, self.dtype),
+                                }
                             kv_store.put(("kv", shard_pos, b), kv)
                         elif kind == "norm":
                             sh = _norm_block(self.model_cfg, params, sh, suffix_eos)

@@ -190,17 +190,77 @@ class _NullBar:
         pass
 
 
-def progress_bar(total: int, desc: str, unit: str = "it", disable=None):
+class _WatchdogBar:
+    """Wraps a progress bar with a stall watchdog: if no update lands for
+    ``stall_warn_s`` a warning goes to stderr (repeated each further
+    interval). A wedged accelerator tunnel otherwise means tens of minutes
+    of silence in headless runs — the warning names the stalled loop and
+    how long it has been stuck, which is the whole diagnosis."""
+
+    def __init__(self, bar, desc: str, stall_warn_s: float):
+        import threading
+
+        self._bar = bar
+        self._desc = desc
+        self._interval = stall_warn_s
+        self._last = time.monotonic()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._watch, daemon=True)
+        self._thread.start()
+
+    def _watch(self) -> None:
+        warned = 0
+        while not self._stop.wait(min(self._interval / 4, 30.0)):
+            idle = time.monotonic() - self._last
+            if idle >= self._interval * (warned + 1):
+                warned += 1
+                msg = (
+                    f"[stall] '{self._desc}' has made no progress for "
+                    f"{idle / 60:.1f} min — accelerator transfer/compute "
+                    "may be wedged (tunnel flake?); the run will continue "
+                    "if it unwedges, or can be killed and resumed "
+                    "(--resume, disk mode)"
+                )
+                # tqdm.write, not print: a raw print from this thread would
+                # splice into the bar's in-place-refreshed TTY line.
+                writer = getattr(type(self._bar), "write", None)
+                if callable(writer):
+                    type(self._bar).write(msg, file=sys.stderr)
+                else:
+                    print(msg, file=sys.stderr, flush=True)
+            elif idle < self._interval:
+                warned = 0
+
+    def update(self, n: int = 1) -> None:
+        self._last = time.monotonic()
+        self._bar.update(n)
+
+    def set_postfix_str(self, s: str) -> None:
+        self._bar.set_postfix_str(s)
+
+    def close(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=2.0)
+        self._bar.close()
+
+
+def progress_bar(total: int, desc: str, unit: str = "it", disable=None,
+                 stall_warn_s: float = 600.0):
     """A tqdm bar over the streaming loops (the reference shows tqdm over the
     longer of its shard/prompt loops, ``/root/reference/utils.py:226-227,
     236-238``). ``disable=None`` = tqdm's auto mode: visible on a TTY, silent
-    in CI/pipes. Falls back to a no-op if tqdm is missing."""
+    in CI/pipes. Falls back to a no-op if tqdm is missing. A stall watchdog
+    warns on stderr when no update lands for ``stall_warn_s`` (0 disables)."""
     try:
         from tqdm import tqdm
     except ImportError:
-        return _NullBar()
-    return tqdm(total=total, desc=desc, unit=unit, disable=disable,
-                file=sys.stderr)
+        bar = _NullBar()
+    else:
+        bar = tqdm(total=total, desc=desc, unit=unit, disable=disable,
+                   file=sys.stderr)
+    if stall_warn_s and total > 0:
+        return _WatchdogBar(bar, desc, stall_warn_s)
+    return bar
 
 
 def throughput(tokens: int, seconds: float, chips: int = 1) -> dict[str, float]:

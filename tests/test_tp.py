@@ -66,6 +66,20 @@ def test_tp_matches_single_device(model_dir, single_scores, tp):
         np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
 
 
+def test_tp_bfloat16(model_dir, tmp_path):
+    """TP parity holds in the production dtype too (bf16 collectives)."""
+    cfg1 = _cfg(model_dir, dtype="bfloat16")
+    want = run_prompts(
+        cfg1, PROMPTS, tokenizer=FakeTokenizer(), devices=jax.devices()[:1]
+    )
+    cfg2 = _cfg(model_dir, dtype="bfloat16", tensor_parallel=2)
+    got = run_prompts(
+        cfg2, PROMPTS, tokenizer=FakeTokenizer(), devices=jax.devices()[:2]
+    )
+    for a, b in zip(got, want):
+        np.testing.assert_allclose(a, b, rtol=2e-2, atol=2e-3)
+
+
 def test_tp_storage_disk(model_dir, single_scores, tmp_path):
     cfg = _cfg(
         model_dir,
