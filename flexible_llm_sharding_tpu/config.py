@@ -21,8 +21,17 @@ DEFAULT_MAX_TOKEN_LEN = 4096
 
 @dataclasses.dataclass(frozen=True)
 class LlamaConfig:
-    """Model hyperparameters, mirroring the fields of a HF Llama config.json."""
+    """Model hyperparameters, mirroring the fields of a HF config.json.
 
+    Covers the Llama *family* of decoder architectures: Llama-1/2/3 (the
+    reference's only model, ``/root/reference/utils.py:101,110``), plus the
+    Llama-shaped variants the same streaming machinery runs unchanged —
+    Mistral (sliding-window attention) and Qwen2 (biased Q/K/V projections).
+    The family differences are data, not code paths: bias flags and an
+    optional attention window, all static jit args.
+    """
+
+    model_type: str = "llama"  # 'llama' | 'mistral' | 'qwen2'
     vocab_size: int = 32000
     hidden_size: int = 4096
     intermediate_size: int = 11008
@@ -34,6 +43,16 @@ class LlamaConfig:
     max_position_embeddings: int = 4096
     tie_word_embeddings: bool = False
     explicit_head_dim: int | None = None  # HF 'head_dim' when != hidden/heads
+    # Projection biases. Llama's HF config drives all four attention
+    # projections from one 'attention_bias' flag; Qwen2 hard-codes bias on
+    # q/k/v but none on o_proj, hence the split here.
+    attention_in_bias: bool = False  # bias on wq/wk/wv
+    attention_out_bias: bool = False  # bias on wo
+    mlp_bias: bool = False  # bias on gate/up/down
+    # Sliding-window attention (Mistral; Qwen2 with use_sliding_window).
+    # None = full causal. Semantics match HF masking_utils: query i attends
+    # key j iff j <= i and i - j < sliding_window.
+    sliding_window: int | None = None
     # RoPE scaling, flattened to hashable fields (the config must stay a
     # frozen/hashable jit static arg): kind None = unscaled, or
     # 'linear' (Llama-2 long) / 'llama3' (Llama-3.1+ frequency bands).
@@ -66,11 +85,39 @@ class LlamaConfig:
 
     @classmethod
     def from_hf_config(cls, d: dict[str, Any]) -> "LlamaConfig":
-        # Features that change numerics must fail loudly, not silently drop.
-        if d.get("attention_bias"):
-            raise NotImplementedError("attention_bias=true is not supported yet")
         known = {f.name for f in dataclasses.fields(cls)}
         kwargs = {k: v for k, v in d.items() if k in known}
+        model_type = d.get("model_type", "llama")
+        # Family-specific conventions (numerics-changing features either map
+        # to a native field here or fail loudly — never silently drop).
+        if model_type in ("llama", ""):
+            if d.get("attention_bias"):  # HF Llama: one flag, all four projs
+                kwargs.setdefault("attention_in_bias", True)
+                kwargs.setdefault("attention_out_bias", True)
+            # HF LlamaModel ignores a stray sliding_window key (common in
+            # llamafied/merged exports); honouring it here would silently
+            # change logits vs HF.
+            kwargs["sliding_window"] = None
+        elif model_type == "qwen2":
+            # HF Qwen2 hard-codes bias=True on q/k/v, False on o_proj.
+            kwargs.setdefault("attention_in_bias", True)
+            kwargs.setdefault("attention_out_bias", False)
+            if not d.get("use_sliding_window", False):
+                kwargs["sliding_window"] = None
+            elif d.get("max_window_layers", d.get("num_hidden_layers")) != d.get(
+                "num_hidden_layers"
+            ):
+                raise NotImplementedError(
+                    "qwen2 per-layer sliding window (max_window_layers < "
+                    "num_hidden_layers) is not supported yet"
+                )
+        elif model_type == "mistral":
+            pass  # sliding_window flows through by field name (may be null)
+        else:
+            raise NotImplementedError(
+                f"model_type {model_type!r} is not supported "
+                "(llama, mistral, qwen2 are)"
+            )
         if d.get("head_dim"):
             kwargs["explicit_head_dim"] = d["head_dim"]
         kwargs.setdefault("num_key_value_heads", d.get("num_attention_heads", 32))

@@ -70,22 +70,33 @@ def _mm(x: jax.Array, w: jax.Array) -> jax.Array:
     return jnp.matmul(x, w.astype(x.dtype), precision=_PRECISION)
 
 
+def _lin(x: jax.Array, params: Params, w: str, b: str) -> jax.Array:
+    """Linear with optional bias. Bias keys exist only when the model family
+    uses them (Qwen2 q/k/v, Llama attention_bias/mlp_bias) — presence is a
+    trace-time structural fact, so unbiased models pay nothing."""
+    y = _mm(x, params[w])
+    if b in params:
+        y = y + params[b].astype(y.dtype)
+    return y
+
+
 def _qkv(attn: Params, cfg: LlamaConfig, x: jax.Array):
     """x: [..., L, D] -> q [..., L, n_q, hd], k/v [..., L, n_kv, hd]."""
     hd = cfg.head_dim
-    q = _mm(x, attn["wq"]).reshape(*x.shape[:-1], cfg.num_attention_heads, hd)
-    k = _mm(x, attn["wk"]).reshape(*x.shape[:-1], cfg.num_key_value_heads, hd)
-    v = _mm(x, attn["wv"]).reshape(*x.shape[:-1], cfg.num_key_value_heads, hd)
+    q = _lin(x, attn, "wq", "bq").reshape(*x.shape[:-1], cfg.num_attention_heads, hd)
+    k = _lin(x, attn, "wk", "bk").reshape(*x.shape[:-1], cfg.num_key_value_heads, hd)
+    v = _lin(x, attn, "wv", "bv").reshape(*x.shape[:-1], cfg.num_key_value_heads, hd)
     return q, k, v
 
 
 def _out_proj(attn: Params, o: jax.Array) -> jax.Array:
     """o: [..., L, n_q, hd] -> [..., L, D]."""
-    return _mm(o.reshape(*o.shape[:-2], -1), attn["wo"])
+    return _lin(o.reshape(*o.shape[:-2], -1), attn, "wo", "bo")
 
 
 def _mlp(mlp: Params, x: jax.Array) -> jax.Array:
-    return _mm(jax.nn.silu(_mm(x, mlp["gate"])) * _mm(x, mlp["up"]), mlp["down"])
+    h = jax.nn.silu(_lin(x, mlp, "gate", "bgate")) * _lin(x, mlp, "up", "bup")
+    return _lin(h, mlp, "down", "bdown")
 
 
 # ---------------------------------------------------------------------------
@@ -144,8 +155,21 @@ def prefix_suffix_layer(
     lp, _ = prefix_h.shape
     s, ls, _ = suffix_h.shape
     eps = cfg.rms_norm_eps
-    flash = use_pallas and pallas_attention.supports(
-        cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim, ls, lp
+    window = cfg.sliding_window
+    if window is not None and lp + ls <= window:
+        # Max query-key distance at these (static) bucket shapes is
+        # lp + ls - 1 < window: the band equals full causal, so drop the
+        # window — keeping the flash kernels eligible (the common case for
+        # Mistral's 4096 window under the 4096 token cap).
+        window = None
+    # The flash kernels implement full causal masks only; a *binding*
+    # sliding window falls back to the XLA attention (fused banded mask).
+    flash = (
+        use_pallas
+        and window is None
+        and pallas_attention.supports(
+            cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim, ls, lp
+        )
     )
 
     # --- prefix: causal self-attention, keep post-RoPE KV ---
@@ -158,7 +182,7 @@ def prefix_suffix_layer(
         # additionally skips fully-masked KV blocks.
         attn_out = pallas_attention.flash_causal_attention(q, k, v, prefix_len)
     else:
-        attn_out = attention(q, k, v, causal_mask(lp, lp))
+        attn_out = attention(q, k, v, causal_mask(lp, lp, window=window))
     prefix_mid = prefix_h + _out_proj(params["attn"], attn_out)
     h = rms_norm(prefix_mid, params["post_attention_layernorm"]["scale"], eps)
     prefix_out = prefix_mid + _mlp(params["mlp"], h)
@@ -176,7 +200,7 @@ def prefix_suffix_layer(
             qs, k, v, ks, vs, prefix_len
         )
     else:
-        attn_s = prefix_shared_attention(qs, k, v, ks, vs, prefix_len)
+        attn_s = prefix_shared_attention(qs, k, v, ks, vs, prefix_len, window=window)
     suffix_mid = suffix_h + _out_proj(params["attn"], attn_s)
     hs = rms_norm(suffix_mid, params["post_attention_layernorm"]["scale"], eps)
     suffix_out = suffix_mid + _mlp(params["mlp"], hs)
@@ -227,6 +251,7 @@ def decode_step_layer(
         prefix_len,
         suffix_eos,
         t,
+        window=cfg.sliding_window,
     )
     mid = x + _out_proj(params["attn"], attn_out)
     h = rms_norm(mid, params["post_attention_layernorm"]["scale"], eps)
@@ -281,7 +306,7 @@ def forward_full(
     b, l = ids.shape
     x = embed(params["embed"], ids, dtype)
     positions = jnp.arange(l)
-    mask = causal_mask(l, l)
+    mask = causal_mask(l, l, window=cfg.sliding_window)
     layers = params["layers"]
     if isinstance(layers, (list, tuple)):
         for lp in layers:
@@ -303,26 +328,41 @@ def forward_full(
 def init_layer_params(rng: jax.Array, cfg: LlamaConfig, dtype=jnp.float32) -> Params:
     d, f, hd = cfg.hidden_size, cfg.intermediate_size, cfg.head_dim
     nq, nkv = cfg.num_attention_heads, cfg.num_key_value_heads
-    ks = jax.random.split(rng, 7)
+    ks = jax.random.split(rng, 14)
 
     def lin(key, fan_in, fan_out):
         scale = (2.0 / (fan_in + fan_out)) ** 0.5
         return (jax.random.normal(key, (fan_in, fan_out)) * scale).astype(dtype)
 
+    def bias(key, n):
+        return (jax.random.normal(key, (n,)) * 0.02).astype(dtype)
+
+    attn = {
+        "wq": lin(ks[0], d, nq * hd),
+        "wk": lin(ks[1], d, nkv * hd),
+        "wv": lin(ks[2], d, nkv * hd),
+        "wo": lin(ks[3], nq * hd, d),
+    }
+    if cfg.attention_in_bias:
+        attn |= {
+            "bq": bias(ks[7], nq * hd),
+            "bk": bias(ks[8], nkv * hd),
+            "bv": bias(ks[9], nkv * hd),
+        }
+    if cfg.attention_out_bias:
+        attn["bo"] = bias(ks[10], d)
+    mlp = {
+        "gate": lin(ks[4], d, f),
+        "up": lin(ks[5], d, f),
+        "down": lin(ks[6], f, d),
+    }
+    if cfg.mlp_bias:
+        mlp |= {"bgate": bias(ks[11], f), "bup": bias(ks[12], f), "bdown": bias(ks[13], d)}
     return {
         "input_layernorm": {"scale": jnp.ones((d,), dtype)},
         "post_attention_layernorm": {"scale": jnp.ones((d,), dtype)},
-        "attn": {
-            "wq": lin(ks[0], d, nq * hd),
-            "wk": lin(ks[1], d, nkv * hd),
-            "wv": lin(ks[2], d, nkv * hd),
-            "wo": lin(ks[3], nq * hd, d),
-        },
-        "mlp": {
-            "gate": lin(ks[4], d, f),
-            "up": lin(ks[5], d, f),
-            "down": lin(ks[6], f, d),
-        },
+        "attn": attn,
+        "mlp": mlp,
     }
 
 

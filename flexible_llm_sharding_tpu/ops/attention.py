@@ -68,6 +68,7 @@ def prefix_shared_attention(
     v_suffix: jax.Array,
     prefix_len: jax.Array,
     scale: float | None = None,
+    window: int | None = None,
 ) -> jax.Array:
     """Attention of S suffix continuations over [shared prefix KV ; own causal KV].
 
@@ -95,10 +96,16 @@ def prefix_shared_attention(
         jnp.concatenate([scores_p, scores_s], axis=-1).astype(jnp.float32) * scale
     )  # [S, n_kv, g, Ls, Lp+Ls]
 
-    # Prefix keys visible iff real; suffix keys causal.
+    # Prefix keys visible iff real; suffix keys causal. With a sliding
+    # window, absolute positions are: query qi at prefix_len + qi, prefix key
+    # kj at kj, suffix key kj at prefix_len + (kj - lp) — mask whenever the
+    # query-key distance reaches the window (HF convention: dist < window).
     kj = jnp.arange(lp + ls)[None, :]
     qi = jnp.arange(ls)[:, None]
     mask = jnp.where(kj < lp, kj < prefix_len, (kj - lp) <= qi)  # [Ls, Lp+Ls]
+    if window is not None:
+        abs_k = jnp.where(kj < lp, kj, prefix_len + kj - lp)
+        mask &= (prefix_len + qi) - abs_k < window
     scores = jnp.where(mask[None, None, None], scores, _NEG_INF)
 
     probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
@@ -122,6 +129,7 @@ def decode_attention(
     suffix_eos: jax.Array,
     t: jax.Array,
     scale: float | None = None,
+    window: int | None = None,
 ) -> jax.Array:
     """Single-token decode attention over three cached KV regions.
 
@@ -165,6 +173,24 @@ def decode_attention(
         ],
         axis=-1,
     )  # [S, Lp+Ls+T]
+    if window is not None:
+        # Absolute positions: query at prefix_len + suffix_eos[s] + 1 + t;
+        # prefix key j at j, suffix key j at prefix_len + j, generated key j
+        # at prefix_len + suffix_eos[s] + 1 + j. Sliding window masks keys
+        # at distance >= window (HF convention).
+        q_pos = prefix_len + suffix_eos[:, None] + 1 + t  # [S, 1]
+        abs_k = jnp.concatenate(
+            [
+                jnp.broadcast_to(jnp.arange(lp)[None, :], (s, lp)),
+                prefix_len + jnp.broadcast_to(jnp.arange(ls)[None, :], (s, ls)),
+                prefix_len
+                + suffix_eos[:, None]
+                + 1
+                + jnp.broadcast_to(jnp.arange(tmax)[None, :], (s, tmax)),
+            ],
+            axis=-1,
+        )  # [S, Lp+Ls+T]
+        mask &= q_pos - abs_k < window
     scores = jnp.where(mask[:, None, None, None, :], scores, _NEG_INF)
 
     probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
@@ -179,8 +205,15 @@ def decode_attention(
     return out.reshape(s, 1, n_q, hd)
 
 
-def causal_mask(lq: int, lk: int, offset: int = 0) -> jax.Array:
-    """Boolean causal mask [lq, lk]: query i attends key j iff j <= i + offset."""
+def causal_mask(
+    lq: int, lk: int, offset: int = 0, window: int | None = None
+) -> jax.Array:
+    """Boolean causal mask [lq, lk]: query i attends key j iff j <= i + offset,
+    and — with a sliding ``window`` (Mistral-style) — iff additionally
+    ``(i + offset) - j < window`` (HF masking_utils convention)."""
     qi = jax.lax.broadcasted_iota(jnp.int32, (lq, lk), 0)
     kj = jax.lax.broadcasted_iota(jnp.int32, (lq, lk), 1)
-    return kj <= qi + offset
+    mask = kj <= qi + offset
+    if window is not None:
+        mask &= (qi + offset) - kj < window
+    return mask

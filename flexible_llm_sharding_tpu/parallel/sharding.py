@@ -106,16 +106,32 @@ def make_mesh(
     return Mesh(arr, names)
 
 
-def layer_specs(tp: str | None = "tp") -> Params:
-    """PartitionSpecs for one decoder layer's params (Megatron TP layout)."""
+def layer_specs(tp: str | None = "tp", cfg: LlamaConfig | None = None) -> Params:
+    """PartitionSpecs for one decoder layer's params (Megatron TP layout).
+
+    ``cfg`` adds entries for the bias vectors the model family carries
+    (Qwen2 q/k/v, Llama attention_bias/mlp_bias): a column-parallel
+    projection's bias shards with its output axis; a row-parallel
+    projection's bias is replicated (added once, after the psum).
+    """
     col = P(None, tp)  # [in, out] sharded on out
     row = P(tp, None)  # [in, out] sharded on in
     rep = P(None)
+    bcol = P(tp)  # bias of a column-parallel projection
+    attn: Params = {"wq": col, "wk": col, "wv": col, "wo": row}
+    mlp: Params = {"gate": col, "up": col, "down": row}
+    if cfg is not None:
+        if cfg.attention_in_bias:
+            attn |= {"bq": bcol, "bk": bcol, "bv": bcol}
+        if cfg.attention_out_bias:
+            attn["bo"] = rep
+        if cfg.mlp_bias:
+            mlp |= {"bgate": bcol, "bup": bcol, "bdown": rep}
     return {
         "input_layernorm": {"scale": rep},
         "post_attention_layernorm": {"scale": rep},
-        "attn": {"wq": col, "wk": col, "wv": col, "wo": row},
-        "mlp": {"gate": col, "up": col, "down": row},
+        "attn": attn,
+        "mlp": mlp,
     }
 
 
@@ -131,7 +147,7 @@ def param_specs(
     [num_layers] axis (the scan layout); ``pp`` optionally shards that layer
     axis across a pipeline mesh axis.
     """
-    lspec = layer_specs(tp)
+    lspec = layer_specs(tp, cfg)
     if stacked:
         layers = jax.tree.map(
             lambda s: P(pp, *s), lspec, is_leaf=lambda x: isinstance(x, P)
@@ -172,14 +188,14 @@ class TpPlacement:
     partitions them from the argument shardings.
     """
 
-    def __init__(self, devices: Sequence):
+    def __init__(self, devices: Sequence, cfg: LlamaConfig | None = None):
         if len(devices) < 2:
             raise ValueError("TpPlacement needs >= 2 devices")
         self.mesh = make_mesh({"tp": len(devices)}, list(devices))
         self.act = NamedSharding(self.mesh, P())
         rep = jax.tree.map(
             lambda s: NamedSharding(self.mesh, s),
-            layer_specs("tp"),
+            layer_specs("tp", cfg),
             is_leaf=lambda x: isinstance(x, P),
         )
         # Stacked-scan decoder pytrees carry a leading [k] layer axis.

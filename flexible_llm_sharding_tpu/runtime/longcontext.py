@@ -171,6 +171,12 @@ class LongContextScorer:
     def __init__(self, cfg: FrameworkConfig, devices=None, tokenizer=None):
         self.cfg = cfg
         self.model_cfg = LlamaConfig.from_pretrained(cfg.model_path)
+        if self.model_cfg.sliding_window is not None:
+            raise NotImplementedError(
+                "long_context ring attention implements full causal masks; "
+                "sliding-window models (mistral/qwen2 with use_sliding_window) "
+                "are not supported on this path"
+            )
         devices = list(devices) if devices else None
         self.mesh = make_mesh(
             {"sp": len(devices)} if devices else None, devices=devices

@@ -147,8 +147,9 @@ def run_prompts(
                 f"tensor_parallel={cfg.tensor_parallel} needs that many "
                 f"chips, have {len(devices)}"
             )
-        placement = TpPlacement(devices[: cfg.tensor_parallel])
-        placement.check(LlamaConfig.from_pretrained(cfg.model_path))
+        model_cfg = LlamaConfig.from_pretrained(cfg.model_path)
+        placement = TpPlacement(devices[: cfg.tensor_parallel], model_cfg)
+        placement.check(model_cfg)
         ex = StreamingExecutor(cfg, device=placement, tokenizer=tokenizer)
         return _run_batched(ex, prompts, cfg.num_batch)
 

@@ -134,6 +134,20 @@ _LAYER_MAP = [
     ("mlp.down", "mlp.down_proj.weight", True),
 ]
 
+# Bias vectors (1-D, no transpose), present only in some families (Qwen2
+# q/k/v; Llama with attention_bias/mlp_bias). Consumed when the checkpoint
+# has them, absent from the native file otherwise — models/llama.py treats
+# bias presence as a trace-time structural fact.
+_LAYER_MAP_OPTIONAL = [
+    ("attn.bq", "self_attn.q_proj.bias"),
+    ("attn.bk", "self_attn.k_proj.bias"),
+    ("attn.bv", "self_attn.v_proj.bias"),
+    ("attn.bo", "self_attn.o_proj.bias"),
+    ("mlp.bgate", "mlp.gate_proj.bias"),
+    ("mlp.bup", "mlp.up_proj.bias"),
+    ("mlp.bdown", "mlp.down_proj.bias"),
+]
+
 
 # Non-parameter buffers that may appear in HF checkpoints and carry no weights.
 _IGNORABLE_HF_SUFFIXES = ("rotary_emb.inv_freq",)
@@ -142,8 +156,9 @@ _IGNORABLE_HF_SUFFIXES = ("rotary_emb.inv_freq",)
 def hf_layer_to_native(layer_name: str, sd: dict[str, np.ndarray]) -> dict[str, np.ndarray]:
     """Convert one layer's HF-keyed state dict to native flat keys/layout.
 
-    Raises on tensors the native layout has no slot for (e.g. attention
-    biases of Qwen-style checkpoints) instead of silently dropping them.
+    Projection biases (Qwen2 q/k/v; Llama attention_bias/mlp_bias) map to
+    their native slots when present. Tensors with no slot at all (an unknown
+    architecture's extras) raise instead of silently dropping.
     """
     if layer_name == "model.embed_tokens":
         return {"embedding": sd["model.embed_tokens.weight"]}
@@ -158,13 +173,17 @@ def hf_layer_to_native(layer_name: str, sd: dict[str, np.ndarray]) -> dict[str, 
         w = sd[key]
         consumed.add(key)
         out[native_key] = np.ascontiguousarray(w.T) if transpose else w
+    for native_key, hf_sub in _LAYER_MAP_OPTIONAL:
+        key = f"{layer_name}.{hf_sub}"
+        if key in sd:
+            consumed.add(key)
+            out[native_key] = sd[key]
     leftover = {
         k for k in sd.keys() - consumed if not k.endswith(_IGNORABLE_HF_SUFFIXES)
     }
     if leftover:
         raise ValueError(
-            f"{layer_name}: tensors {sorted(leftover)} have no native-layout slot "
-            "(biased-attention checkpoints are not supported yet)"
+            f"{layer_name}: tensors {sorted(leftover)} have no native-layout slot"
         )
     return out
 
@@ -366,7 +385,7 @@ def save_params(params: dict[str, Any], out_dir: str, cfg: LlamaConfig) -> None:
     if "lm_head" in params and params["lm_head"]:
         st_save_file(dict(flatten(params["lm_head"])), os.path.join(out_dir, "lm_head.safetensors"))
     hf_cfg = {
-        "model_type": "llama",
+        "model_type": cfg.model_type,
         "vocab_size": cfg.vocab_size,
         "hidden_size": cfg.hidden_size,
         "intermediate_size": cfg.intermediate_size,
@@ -377,6 +396,13 @@ def save_params(params: dict[str, Any], out_dir: str, cfg: LlamaConfig) -> None:
         "rope_theta": cfg.rope_theta,
         "max_position_embeddings": cfg.max_position_embeddings,
         "tie_word_embeddings": cfg.tie_word_embeddings,
+        # Native field names round-trip directly through from_hf_config
+        # (explicit values win over the family defaults there).
+        "attention_in_bias": cfg.attention_in_bias,
+        "attention_out_bias": cfg.attention_out_bias,
+        "mlp_bias": cfg.mlp_bias,
+        "sliding_window": cfg.sliding_window,
+        "use_sliding_window": cfg.sliding_window is not None,  # qwen2 gate
     }
     with open(os.path.join(out_dir, "config.json"), "w") as f:
         json.dump(hf_cfg, f)
