@@ -31,7 +31,7 @@ class LlamaConfig:
     optional attention window, all static jit args.
     """
 
-    model_type: str = "llama"  # 'llama' | 'mistral' | 'qwen2'
+    model_type: str = "llama"  # 'llama' | 'mistral' | 'qwen2' | 'mixtral'
     vocab_size: int = 32000
     hidden_size: int = 4096
     intermediate_size: int = 11008
@@ -53,6 +53,10 @@ class LlamaConfig:
     # None = full causal. Semantics match HF masking_utils: query i attends
     # key j iff j <= i and i - j < sliding_window.
     sliding_window: int | None = None
+    # Mixture-of-experts MLP (Mixtral). 0 = dense. Routing matches HF:
+    # softmax over all experts (fp32) -> top-k -> renormalise -> combine.
+    num_local_experts: int = 0
+    num_experts_per_tok: int = 2
     # RoPE scaling, flattened to hashable fields (the config must stay a
     # frozen/hashable jit static arg): kind None = unscaled, or
     # 'linear' (Llama-2 long) / 'llama3' (Llama-3.1+ frequency bands).
@@ -111,13 +115,21 @@ class LlamaConfig:
                     "qwen2 per-layer sliding window (max_window_layers < "
                     "num_hidden_layers) is not supported yet"
                 )
-        elif model_type == "mistral":
-            pass  # sliding_window flows through by field name (may be null)
+        elif model_type in ("mistral", "mixtral"):
+            # sliding_window flows through by field name (may be null);
+            # mixtral's num_local_experts/num_experts_per_tok likewise.
+            if model_type == "mixtral" and not d.get("num_local_experts"):
+                raise ValueError("mixtral config without num_local_experts")
         else:
             raise NotImplementedError(
                 f"model_type {model_type!r} is not supported "
-                "(llama, mistral, qwen2 are)"
+                "(llama, mistral, qwen2, mixtral are)"
             )
+        if model_type != "mixtral":
+            # A stray num_local_experts key in a dense export must not flip
+            # the model into MoE mode (same stray-key defence as
+            # sliding_window above).
+            kwargs["num_local_experts"] = 0
         if d.get("head_dim"):
             kwargs["explicit_head_dim"] = d["head_dim"]
         kwargs.setdefault("num_key_value_heads", d.get("num_attention_heads", 32))

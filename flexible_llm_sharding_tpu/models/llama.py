@@ -94,9 +94,57 @@ def _out_proj(attn: Params, o: jax.Array) -> jax.Array:
     return _lin(o.reshape(*o.shape[:-2], -1), attn, "wo", "bo")
 
 
-def _mlp(mlp: Params, x: jax.Array) -> jax.Array:
+def _dense_mlp(mlp: Params, x: jax.Array) -> jax.Array:
     h = jax.nn.silu(_lin(x, mlp, "gate", "bgate")) * _lin(x, mlp, "up", "bup")
     return _lin(h, mlp, "down", "bdown")
+
+
+def _moe_mlp(mlp: Params, cfg: LlamaConfig, x: jax.Array) -> jax.Array:
+    """Mixture-of-experts MLP (Mixtral), HF-parity routing.
+
+    Routing matches ``MixtralSparseMoeBlock``: softmax over ALL experts in
+    float32, top-k of those probabilities, renormalised by their sum, cast to
+    the input dtype, applied to each expert's FFN output.
+
+    TPU-first compute layout: experts are stacked arrays ``gate/up [E, D, F]``,
+    ``down [E, F, D]`` and every expert runs on every token (one batched
+    einsum per projection, MXU-shaped) with the combine weights zeroing the
+    non-selected experts. In the streaming regime this is the right trade:
+    the executor is weight-transfer-bound, the per-token FLOP surplus (E/k)
+    rides idle MXU cycles, and there is no gather/scatter or ragged shape for
+    XLA to choke on. Under expert parallelism (``layer_specs``) the stacked
+    E axis is sharded over the mesh, so each chip computes only its own
+    experts and GSPMD inserts one psum for the combine — the reference has no
+    MoE at all (dense Llama only, SURVEY.md §2.2 'EP: absent').
+    """
+    e, k = cfg.num_local_experts, cfg.num_experts_per_tok
+    logits = _mm(x, mlp["router"])  # [..., L, E], model dtype (HF gate dtype)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    top_vals, top_idx = jax.lax.top_k(probs, k)  # sorted desc, like torch.topk
+    top_vals = top_vals / jnp.sum(top_vals, axis=-1, keepdims=True)
+    # Scatter the k renormalised weights back onto the E axis.
+    combine = jnp.sum(
+        jax.nn.one_hot(top_idx, e, dtype=jnp.float32) * top_vals[..., None], axis=-2
+    ).astype(x.dtype)  # [..., L, E]
+    h = jax.nn.silu(
+        jnp.einsum("...ld,edf->...lef", x, mlp["gate"].astype(x.dtype), precision=_PRECISION)
+    ) * jnp.einsum("...ld,edf->...lef", x, mlp["up"].astype(x.dtype), precision=_PRECISION)
+    # Fold the combine weights in BEFORE the down projection (scalar per
+    # token-expert, so algebraically identical to HF's weight-after-w2) and
+    # hard-zero non-selected experts with `where`: a plain `h * 0` would turn
+    # an fp16 overflow (inf) in an expert the router never picked into NaN —
+    # a failure HF can't have, since it never computes unselected experts.
+    # This also avoids materialising a [..., L, E, D] per-expert output.
+    c = combine[..., None]  # [..., L, E, 1]
+    h = jnp.where(c != 0, h * c, jnp.zeros_like(h))
+    return jnp.einsum("...lef,efd->...ld", h, mlp["down"].astype(x.dtype), precision=_PRECISION)
+
+
+def _mlp(mlp: Params, x: jax.Array, cfg: LlamaConfig | None = None) -> jax.Array:
+    if "router" in mlp:
+        assert cfg is not None and cfg.num_local_experts > 0
+        return _moe_mlp(mlp, cfg, x)
+    return _dense_mlp(mlp, x)
 
 
 # ---------------------------------------------------------------------------
@@ -123,7 +171,7 @@ def decoder_layer(
     q, k = apply_rope(q, cos, sin), apply_rope(k, cos, sin)
     x = x + _out_proj(params["attn"], attention(q, k, v, mask))
     h = rms_norm(x, params["post_attention_layernorm"]["scale"], cfg.rms_norm_eps)
-    return x + _mlp(params["mlp"], h)
+    return x + _mlp(params["mlp"], h, cfg)
 
 
 def prefix_suffix_layer(
@@ -185,7 +233,7 @@ def prefix_suffix_layer(
         attn_out = attention(q, k, v, causal_mask(lp, lp, window=window))
     prefix_mid = prefix_h + _out_proj(params["attn"], attn_out)
     h = rms_norm(prefix_mid, params["post_attention_layernorm"]["scale"], eps)
-    prefix_out = prefix_mid + _mlp(params["mlp"], h)
+    prefix_out = prefix_mid + _mlp(params["mlp"], h, cfg)
 
     # --- suffixes: batched attention over [shared prefix KV ; own causal KV],
     # prefix KV never expanded across suffixes (ops.prefix_shared_attention) ---
@@ -203,7 +251,7 @@ def prefix_suffix_layer(
         attn_s = prefix_shared_attention(qs, k, v, ks, vs, prefix_len, window=window)
     suffix_mid = suffix_h + _out_proj(params["attn"], attn_s)
     hs = rms_norm(suffix_mid, params["post_attention_layernorm"]["scale"], eps)
-    suffix_out = suffix_mid + _mlp(params["mlp"], hs)
+    suffix_out = suffix_mid + _mlp(params["mlp"], hs, cfg)
     if return_kv:
         # Post-RoPE KV, reusable across decode steps (runtime/decode.py).
         return prefix_out, suffix_out, {"kp": k, "vp": v, "ks": ks, "vs": vs}
@@ -255,7 +303,7 @@ def decode_step_layer(
     )
     mid = x + _out_proj(params["attn"], attn_out)
     h = rms_norm(mid, params["post_attention_layernorm"]["scale"], eps)
-    return mid + _mlp(params["mlp"], h), kv
+    return mid + _mlp(params["mlp"], h, cfg), kv
 
 
 def select_eos_and_norm(
@@ -351,13 +399,27 @@ def init_layer_params(rng: jax.Array, cfg: LlamaConfig, dtype=jnp.float32) -> Pa
         }
     if cfg.attention_out_bias:
         attn["bo"] = bias(ks[10], d)
-    mlp = {
-        "gate": lin(ks[4], d, f),
-        "up": lin(ks[5], d, f),
-        "down": lin(ks[6], f, d),
-    }
-    if cfg.mlp_bias:
-        mlp |= {"bgate": bias(ks[11], f), "bup": bias(ks[12], f), "bdown": bias(ks[13], d)}
+    if cfg.num_local_experts:
+        e = cfg.num_local_experts
+
+        def elin(key, fan_in, fan_out):
+            scale = (2.0 / (fan_in + fan_out)) ** 0.5
+            return (jax.random.normal(key, (e, fan_in, fan_out)) * scale).astype(dtype)
+
+        mlp = {
+            "router": lin(ks[4], d, e),
+            "gate": elin(ks[5], d, f),
+            "up": elin(ks[6], d, f),
+            "down": elin(ks[11], f, d),
+        }
+    else:
+        mlp = {
+            "gate": lin(ks[4], d, f),
+            "up": lin(ks[5], d, f),
+            "down": lin(ks[6], f, d),
+        }
+        if cfg.mlp_bias:
+            mlp |= {"bgate": bias(ks[11], f), "bup": bias(ks[12], f), "bdown": bias(ks[13], d)}
     return {
         "input_layernorm": {"scale": jnp.ones((d,), dtype)},
         "post_attention_layernorm": {"scale": jnp.ones((d,), dtype)},

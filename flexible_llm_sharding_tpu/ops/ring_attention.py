@@ -168,7 +168,7 @@ def ring_decoder_layer(
     def local_tail(x_blk, attn_blk):
         mid = x_blk + llama._out_proj(params["attn"], attn_blk)
         h = rms_norm(mid, params["post_attention_layernorm"]["scale"], eps)
-        return mid + llama._mlp(params["mlp"], h)
+        return mid + llama._mlp(params["mlp"], h, cfg)
 
     out = jax.shard_map(
         local_tail,

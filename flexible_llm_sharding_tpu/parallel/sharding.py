@@ -119,13 +119,21 @@ def layer_specs(tp: str | None = "tp", cfg: LlamaConfig | None = None) -> Params
     rep = P(None)
     bcol = P(tp)  # bias of a column-parallel projection
     attn: Params = {"wq": col, "wk": col, "wv": col, "wo": row}
-    mlp: Params = {"gate": col, "up": col, "down": row}
+    if cfg is not None and cfg.num_local_experts:
+        # Expert parallelism: the stacked [E, ...] expert arrays shard on the
+        # expert axis — each chip computes its own experts for all tokens and
+        # GSPMD inserts one psum for the routed combine (models/llama.py
+        # _moe_mlp). Router stays replicated (it is [D, E], tiny).
+        exp = P(tp, None, None)
+        mlp: Params = {"router": rep, "gate": exp, "up": exp, "down": exp}
+    else:
+        mlp = {"gate": col, "up": col, "down": row}
     if cfg is not None:
         if cfg.attention_in_bias:
             attn |= {"bq": bcol, "bk": bcol, "bv": bcol}
         if cfg.attention_out_bias:
             attn["bo"] = rep
-        if cfg.mlp_bias:
+        if cfg.mlp_bias and not cfg.num_local_experts:
             mlp |= {"bgate": bcol, "bup": bcol, "bdown": rep}
     return {
         "input_layernorm": {"scale": rep},
@@ -229,7 +237,13 @@ def check_tp_divisibility(cfg: LlamaConfig, tp_size: int) -> None:
         raise ValueError(
             f"num_key_value_heads={cfg.num_key_value_heads} not divisible by tp={tp_size}"
         )
-    if cfg.intermediate_size % tp_size:
+    if cfg.num_local_experts:
+        # MoE MLPs shard on the expert axis, not the hidden axis.
+        if cfg.num_local_experts % tp_size:
+            raise ValueError(
+                f"num_local_experts={cfg.num_local_experts} not divisible by tp={tp_size}"
+            )
+    elif cfg.intermediate_size % tp_size:
         raise ValueError(
             f"intermediate_size={cfg.intermediate_size} not divisible by tp={tp_size}"
         )

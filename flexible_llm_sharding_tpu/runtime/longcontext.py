@@ -154,7 +154,7 @@ def sharded_prefix_suffix_layer(
 
     suffix_mid = suffix_h + llama._out_proj(params["attn"], attn_s)
     hs = rms_norm(suffix_mid, params["post_attention_layernorm"]["scale"], eps)
-    suffix_out = suffix_mid + llama._mlp(params["mlp"], hs)
+    suffix_out = suffix_mid + llama._mlp(params["mlp"], hs, cfg)
     return prefix_out, suffix_out
 
 

@@ -166,9 +166,12 @@ def hf_layer_to_native(layer_name: str, sd: dict[str, np.ndarray]) -> dict[str, 
         return {"scale": sd["model.norm.weight"]}
     if layer_name == "lm_head":
         return {"kernel": np.ascontiguousarray(sd["lm_head.weight"].T)}
+    moe = any(".block_sparse_moe." in k for k in sd)
     out = {}
     consumed = set()
     for native_key, hf_sub, transpose in _LAYER_MAP:
+        if moe and native_key.startswith("mlp."):
+            continue  # Mixtral layers carry block_sparse_moe instead
         key = f"{layer_name}.{hf_sub}"
         w = sd[key]
         consumed.add(key)
@@ -178,6 +181,26 @@ def hf_layer_to_native(layer_name: str, sd: dict[str, np.ndarray]) -> dict[str, 
         if key in sd:
             consumed.add(key)
             out[native_key] = sd[key]
+    if moe:
+        # Mixtral MoE: router [E, D] -> [D, E]; per-expert w1 (gate) / w3
+        # (up) [F, D] and w2 (down) [D, F] stack into [E, D, F] / [E, F, D]
+        # native arrays (models/llama.py _moe_mlp layout) — one tensor per
+        # projection so a shard upload stays a single device_put.
+        rk = f"{layer_name}.block_sparse_moe.gate.weight"
+        out["mlp.router"] = np.ascontiguousarray(sd[rk].T)
+        consumed.add(rk)
+        n_exp = 0
+        while f"{layer_name}.block_sparse_moe.experts.{n_exp}.w1.weight" in sd:
+            n_exp += 1
+        if not n_exp:
+            raise ValueError(f"{layer_name}: MoE layer with no expert weights")
+        for native_key, hf_w in (("mlp.gate", "w1"), ("mlp.up", "w3"), ("mlp.down", "w2")):
+            stack = []
+            for ei in range(n_exp):
+                key = f"{layer_name}.block_sparse_moe.experts.{ei}.{hf_w}.weight"
+                stack.append(sd[key].T)
+                consumed.add(key)
+            out[native_key] = np.ascontiguousarray(np.stack(stack))
     leftover = {
         k for k in sd.keys() - consumed if not k.endswith(_IGNORABLE_HF_SUFFIXES)
     }
@@ -403,6 +426,8 @@ def save_params(params: dict[str, Any], out_dir: str, cfg: LlamaConfig) -> None:
         "mlp_bias": cfg.mlp_bias,
         "sliding_window": cfg.sliding_window,
         "use_sliding_window": cfg.sliding_window is not None,  # qwen2 gate
+        "num_local_experts": cfg.num_local_experts,
+        "num_experts_per_tok": cfg.num_experts_per_tok,
     }
     with open(os.path.join(out_dir, "config.json"), "w") as f:
         json.dump(hf_cfg, f)

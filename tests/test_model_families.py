@@ -46,6 +46,19 @@ MISTRAL_CFG = LlamaConfig(
     sliding_window=6,  # small enough that a 17-token sequence exercises it
 )
 
+MIXTRAL_CFG = LlamaConfig(
+    model_type="mixtral",
+    vocab_size=256,
+    hidden_size=64,
+    intermediate_size=128,
+    num_hidden_layers=2,
+    num_attention_heads=4,
+    num_key_value_heads=2,
+    max_position_embeddings=512,
+    num_local_experts=4,
+    num_experts_per_tok=2,
+)
+
 
 # ---------------------------------------------------------------------------
 # Config parsing (HF config.json -> LlamaConfig family conventions)
@@ -158,6 +171,87 @@ def _hf_mistral(cfg: LlamaConfig):
     ).eval()
 
 
+def _hf_mixtral(cfg: LlamaConfig):
+    from transformers import MixtralConfig, MixtralForCausalLM
+
+    torch.manual_seed(0)
+    return MixtralForCausalLM(
+        MixtralConfig(
+            vocab_size=cfg.vocab_size,
+            hidden_size=cfg.hidden_size,
+            intermediate_size=cfg.intermediate_size,
+            num_hidden_layers=cfg.num_hidden_layers,
+            num_attention_heads=cfg.num_attention_heads,
+            num_key_value_heads=cfg.num_key_value_heads,
+            rms_norm_eps=cfg.rms_norm_eps,
+            rope_theta=cfg.rope_theta,
+            max_position_embeddings=cfg.max_position_embeddings,
+            tie_word_embeddings=False,
+            num_local_experts=cfg.num_local_experts,
+            num_experts_per_tok=cfg.num_experts_per_tok,
+            sliding_window=None,
+            attn_implementation="eager",
+        )
+    ).eval()
+
+
+def test_mixtral_forward_matches_hf(rng):
+    """MoE routing parity with MixtralSparseMoeBlock: softmax-then-topk,
+    renormalised, applied to each expert's FFN output."""
+    model = _hf_mixtral(MIXTRAL_CFG)
+    params = _params_from_hf(model, MIXTRAL_CFG)
+    mlp = params["layers"][0]["mlp"]
+    assert mlp["router"].shape == (64, 4) and mlp["gate"].shape == (4, 64, 128)
+    ids = rng.integers(0, MIXTRAL_CFG.vocab_size, size=(2, 17))
+    with torch.no_grad():
+        hf_logits = model(torch.tensor(ids)).logits.numpy()
+    ours = np.asarray(llama.forward_full(params, MIXTRAL_CFG, jnp.asarray(ids)))
+    np.testing.assert_allclose(ours, hf_logits, rtol=2e-4, atol=2e-4)
+
+
+def test_mixtral_split_and_expert_parallel(rng, tmp_path):
+    """HF Mixtral checkpoint -> splitter -> native stacked-expert layout; the
+    streaming executor scores it, and a TpPlacement over 2 virtual chips
+    (expert axis sharded — expert parallelism) gives identical scores."""
+    from flexible_llm_sharding_tpu.parallel.sharding import TpPlacement
+
+    model = _hf_mixtral(MIXTRAL_CFG)
+    src = tmp_path / "hf"
+    model.save_pretrained(str(src))
+    out = tmp_path / "native"
+    ckpt.split_into_layers(str(src), str(out))
+    layer = ckpt.load_layer(str(out), "model.layers.0")
+    assert set(layer["mlp"]) == {"router", "gate", "up", "down"}
+    assert layer["mlp"]["down"].shape == (4, 128, 64)
+    cfg_back = LlamaConfig.from_pretrained(str(out))
+    assert cfg_back.num_local_experts == 4 and cfg_back.model_type == "mixtral"
+
+    prompts = [("The capital of France", (" is Paris", " is Rome", " is a city"))]
+    fw = FrameworkConfig(
+        model_path=str(out),
+        dtype="float32",
+        bucket_multiple=8,
+        layer_num_per_shard=1,
+        prefetch_depth=0,
+    )
+    single = StreamingExecutor(fw, tokenizer=FakeTokenizer())(prompts)
+    placement = TpPlacement(jax.devices()[:2], cfg_back)
+    ep = StreamingExecutor(fw, device=placement, tokenizer=FakeTokenizer())(prompts)
+    for a, b in zip(single, ep):
+        np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5)
+
+    # And the single-device run matches the HF oracle end to end.
+    tok = PromptTokenizer(FakeTokenizer(), bucket_multiple=8)
+    t = tok(prompts[0][0], prompts[0][1])
+    for s in range(t.num_suffixes):
+        n_real = int(t.suffix_eos[s]) + 1
+        full = np.concatenate([t.prefix_ids[: t.prefix_len], t.suffix_ids[s, :n_real]])
+        with torch.no_grad():
+            logits = model(torch.tensor(full[None].astype(np.int64))).logits[0, -1]
+        want = torch.softmax(logits.float(), -1).numpy()
+        np.testing.assert_allclose(single[0][s, 0], want, rtol=2e-4, atol=2e-5)
+
+
 def test_qwen2_forward_matches_hf(rng):
     model = _hf_qwen2(QWEN2_CFG)
     params = _params_from_hf(model, QWEN2_CFG)
@@ -213,7 +307,9 @@ def _stream_scores(params, cfg, prefix_ids, suffix_ids_list, lp_bucket):
     return llama.lm_head_scores(llama.head_params(params), normed)
 
 
-@pytest.mark.parametrize("cfg", [QWEN2_CFG, MISTRAL_CFG], ids=["qwen2", "mistral"])
+@pytest.mark.parametrize(
+    "cfg", [QWEN2_CFG, MISTRAL_CFG, MIXTRAL_CFG], ids=["qwen2", "mistral", "mixtral"]
+)
 def test_streaming_matches_monolithic(cfg, rng):
     """The reference invariant, for each family: layerwise prefix-KV streaming
     == monolithic forward at each suffix's last real token. For Mistral the
@@ -233,7 +329,9 @@ def test_streaming_matches_monolithic(cfg, rng):
         )
 
 
-@pytest.mark.parametrize("cfg", [QWEN2_CFG, MISTRAL_CFG], ids=["qwen2", "mistral"])
+@pytest.mark.parametrize(
+    "cfg", [QWEN2_CFG, MISTRAL_CFG, MIXTRAL_CFG], ids=["qwen2", "mistral", "mixtral"]
+)
 def test_decode_step_matches_monolithic(cfg, rng):
     """KV-cache decode with biases / a binding sliding window: each generated
     token's distribution must equal the monolithic forward on the concatenated
@@ -342,7 +440,9 @@ def test_splitter_carries_biases(tmp_path):
     )
 
 
-@pytest.mark.parametrize("cfg", [QWEN2_CFG, MISTRAL_CFG], ids=["qwen2", "mistral"])
+@pytest.mark.parametrize(
+    "cfg", [QWEN2_CFG, MISTRAL_CFG, MIXTRAL_CFG], ids=["qwen2", "mistral", "mixtral"]
+)
 def test_executor_end_to_end(cfg, rng, tmp_path):
     """The full streaming executor on a biased / sliding-window model:
     streamed scores == monolithic forward (storage=cpu, shards of 2)."""
