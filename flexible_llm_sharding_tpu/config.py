@@ -31,7 +31,7 @@ class LlamaConfig:
     optional attention window, all static jit args.
     """
 
-    model_type: str = "llama"  # 'llama' | 'mistral' | 'qwen2' | 'mixtral'
+    model_type: str = "llama"  # 'llama' | 'mistral' | 'qwen2' | 'qwen3' | 'mixtral'
     vocab_size: int = 32000
     hidden_size: int = 4096
     intermediate_size: int = 11008
@@ -57,6 +57,9 @@ class LlamaConfig:
     # softmax over all experts (fp32) -> top-k -> renormalise -> combine.
     num_local_experts: int = 0
     num_experts_per_tok: int = 2
+    # Per-head-dim RMSNorm on q/k after the head reshape, before RoPE
+    # (Qwen3; HF: 'unlike olmo, only on the head dim').
+    qk_norm: bool = False
     # RoPE scaling, flattened to hashable fields (the config must stay a
     # frozen/hashable jit static arg): kind None = unscaled, or
     # 'linear' (Llama-2 long) / 'llama3' (Llama-3.1+ frequency bands).
@@ -115,6 +118,37 @@ class LlamaConfig:
                     "qwen2 per-layer sliding window (max_window_layers < "
                     "num_hidden_layers) is not supported yet"
                 )
+        elif model_type == "qwen3":
+            # One attention_bias flag for all four projections (like Llama,
+            # default False) + per-head-dim q/k RMSNorm.
+            if d.get("attention_bias"):
+                kwargs.setdefault("attention_in_bias", True)
+                kwargs.setdefault("attention_out_bias", True)
+            kwargs.setdefault("qk_norm", True)
+            # HF resolves: sliding_window = sliding_window if
+            # use_sliding_window else None, then derives per-layer
+            # layer_types from max_window_layers (configuration_qwen3.py).
+            # A uniform result maps to our single window field; a mixed
+            # per-layer pattern must fail loudly, not silently diverge.
+            lt = d.get("layer_types")
+            if lt and len(set(lt)) > 1:
+                raise NotImplementedError(
+                    "qwen3 mixed layer_types (per-layer sliding window) "
+                    "is not supported yet"
+                )
+            if not d.get("use_sliding_window", False) or (
+                lt and all(t == "full_attention" for t in lt)
+            ):
+                kwargs["sliding_window"] = None
+            elif not lt and d.get(
+                "max_window_layers", d.get("num_hidden_layers")
+            ) != d.get("num_hidden_layers"):
+                # No layer_types to consult, but HF would derive a MIXED
+                # pattern from max_window_layers.
+                raise NotImplementedError(
+                    "qwen3 per-layer sliding window (max_window_layers < "
+                    "num_hidden_layers) is not supported yet"
+                )
         elif model_type in ("mistral", "mixtral"):
             # sliding_window flows through by field name (may be null);
             # mixtral's num_local_experts/num_experts_per_tok likewise.
@@ -123,7 +157,7 @@ class LlamaConfig:
         else:
             raise NotImplementedError(
                 f"model_type {model_type!r} is not supported "
-                "(llama, mistral, qwen2, mixtral are)"
+                "(llama, mistral, qwen2, qwen3, mixtral are)"
             )
         if model_type != "mixtral":
             # A stray num_local_experts key in a dense export must not flip

@@ -86,6 +86,10 @@ def _qkv(attn: Params, cfg: LlamaConfig, x: jax.Array):
     q = _lin(x, attn, "wq", "bq").reshape(*x.shape[:-1], cfg.num_attention_heads, hd)
     k = _lin(x, attn, "wk", "bk").reshape(*x.shape[:-1], cfg.num_key_value_heads, hd)
     v = _lin(x, attn, "wv", "bv").reshape(*x.shape[:-1], cfg.num_key_value_heads, hd)
+    if "q_norm" in attn:
+        # Qwen3: per-head-dim RMSNorm on q/k, pre-RoPE.
+        q = rms_norm(q, attn["q_norm"], cfg.rms_norm_eps)
+        k = rms_norm(k, attn["k_norm"], cfg.rms_norm_eps)
     return q, k, v
 
 
@@ -399,6 +403,8 @@ def init_layer_params(rng: jax.Array, cfg: LlamaConfig, dtype=jnp.float32) -> Pa
         }
     if cfg.attention_out_bias:
         attn["bo"] = bias(ks[10], d)
+    if cfg.qk_norm:
+        attn |= {"q_norm": jnp.ones((hd,), dtype), "k_norm": jnp.ones((hd,), dtype)}
     if cfg.num_local_experts:
         e = cfg.num_local_experts
 

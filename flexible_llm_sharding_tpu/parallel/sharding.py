@@ -133,6 +133,8 @@ def layer_specs(tp: str | None = "tp", cfg: LlamaConfig | None = None) -> Params
             attn |= {"bq": bcol, "bk": bcol, "bv": bcol}
         if cfg.attention_out_bias:
             attn["bo"] = rep
+        if cfg.qk_norm:
+            attn |= {"q_norm": rep, "k_norm": rep}  # [head_dim], tiny
         if cfg.mlp_bias and not cfg.num_local_experts:
             mlp |= {"bgate": bcol, "bup": bcol, "bdown": rep}
     return {

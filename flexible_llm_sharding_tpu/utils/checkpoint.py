@@ -143,6 +143,8 @@ _LAYER_MAP_OPTIONAL = [
     ("attn.bk", "self_attn.k_proj.bias"),
     ("attn.bv", "self_attn.v_proj.bias"),
     ("attn.bo", "self_attn.o_proj.bias"),
+    ("attn.q_norm", "self_attn.q_norm.weight"),  # qwen3 per-head-dim RMSNorm
+    ("attn.k_norm", "self_attn.k_norm.weight"),
     ("mlp.bgate", "mlp.gate_proj.bias"),
     ("mlp.bup", "mlp.up_proj.bias"),
     ("mlp.bdown", "mlp.down_proj.bias"),
@@ -428,6 +430,9 @@ def save_params(params: dict[str, Any], out_dir: str, cfg: LlamaConfig) -> None:
         "use_sliding_window": cfg.sliding_window is not None,  # qwen2 gate
         "num_local_experts": cfg.num_local_experts,
         "num_experts_per_tok": cfg.num_experts_per_tok,
+        "qk_norm": cfg.qk_norm,
     }
+    if cfg.explicit_head_dim is not None:
+        hf_cfg["head_dim"] = cfg.explicit_head_dim
     with open(os.path.join(out_dir, "config.json"), "w") as f:
         json.dump(hf_cfg, f)

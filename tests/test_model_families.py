@@ -46,6 +46,19 @@ MISTRAL_CFG = LlamaConfig(
     sliding_window=6,  # small enough that a 17-token sequence exercises it
 )
 
+QWEN3_CFG = LlamaConfig(
+    model_type="qwen3",
+    vocab_size=256,
+    hidden_size=64,
+    intermediate_size=128,
+    num_hidden_layers=3,
+    num_attention_heads=4,
+    num_key_value_heads=2,
+    max_position_embeddings=512,
+    explicit_head_dim=32,  # qwen3 decouples head_dim from hidden/heads
+    qk_norm=True,
+)
+
 MIXTRAL_CFG = LlamaConfig(
     model_type="mixtral",
     vocab_size=256,
@@ -195,6 +208,89 @@ def _hf_mixtral(cfg: LlamaConfig):
     ).eval()
 
 
+def _hf_qwen3(cfg: LlamaConfig):
+    from transformers import Qwen3Config, Qwen3ForCausalLM
+
+    torch.manual_seed(0)
+    return Qwen3ForCausalLM(
+        Qwen3Config(
+            vocab_size=cfg.vocab_size,
+            hidden_size=cfg.hidden_size,
+            intermediate_size=cfg.intermediate_size,
+            num_hidden_layers=cfg.num_hidden_layers,
+            num_attention_heads=cfg.num_attention_heads,
+            num_key_value_heads=cfg.num_key_value_heads,
+            rms_norm_eps=cfg.rms_norm_eps,
+            rope_theta=cfg.rope_theta,
+            max_position_embeddings=cfg.max_position_embeddings,
+            tie_word_embeddings=False,
+            head_dim=cfg.head_dim,
+            use_sliding_window=False,
+            attn_implementation="eager",
+        )
+    ).eval()
+
+
+def test_qwen3_forward_matches_hf(rng):
+    """Per-head-dim q/k RMSNorm (pre-RoPE) + decoupled head_dim."""
+    model = _hf_qwen3(QWEN3_CFG)
+    params = _params_from_hf(model, QWEN3_CFG)
+    assert params["layers"][0]["attn"]["q_norm"].shape == (32,)
+    assert params["layers"][0]["attn"]["wq"].shape == (64, 4 * 32)
+    ids = rng.integers(0, QWEN3_CFG.vocab_size, size=(2, 17))
+    with torch.no_grad():
+        hf_logits = model(torch.tensor(ids)).logits.numpy()
+    ours = np.asarray(llama.forward_full(params, QWEN3_CFG, jnp.asarray(ids)))
+    np.testing.assert_allclose(ours, hf_logits, rtol=2e-4, atol=2e-4)
+
+
+def test_from_hf_qwen3():
+    cfg = LlamaConfig.from_hf_config(
+        {
+            "model_type": "qwen3",
+            "num_hidden_layers": 2,
+            "head_dim": 128,
+            "layer_types": ["full_attention", "full_attention"],
+            "sliding_window": None,
+        }
+    )
+    assert cfg.qk_norm and cfg.sliding_window is None and cfg.head_dim == 128
+    assert not cfg.attention_in_bias
+    with pytest.raises(NotImplementedError):
+        LlamaConfig.from_hf_config(
+            {
+                "model_type": "qwen3",
+                "num_hidden_layers": 2,
+                "use_sliding_window": True,
+                "sliding_window": 64,
+                "layer_types": ["full_attention", "sliding_attention"],
+            }
+        )
+    # Same mixed pattern implied by max_window_layers with no layer_types key
+    # (HF derives it in Qwen3Config.__init__) must also fail loudly.
+    with pytest.raises(NotImplementedError):
+        LlamaConfig.from_hf_config(
+            {
+                "model_type": "qwen3",
+                "num_hidden_layers": 4,
+                "use_sliding_window": True,
+                "sliding_window": 64,
+                "max_window_layers": 2,
+            }
+        )
+    # Uniform sliding window (window on, every layer past max_window_layers=0).
+    cfg = LlamaConfig.from_hf_config(
+        {
+            "model_type": "qwen3",
+            "num_hidden_layers": 2,
+            "use_sliding_window": True,
+            "sliding_window": 64,
+            "layer_types": ["sliding_attention", "sliding_attention"],
+        }
+    )
+    assert cfg.sliding_window == 64
+
+
 def test_mixtral_forward_matches_hf(rng):
     """MoE routing parity with MixtralSparseMoeBlock: softmax-then-topk,
     renormalised, applied to each expert's FFN output."""
@@ -308,7 +404,9 @@ def _stream_scores(params, cfg, prefix_ids, suffix_ids_list, lp_bucket):
 
 
 @pytest.mark.parametrize(
-    "cfg", [QWEN2_CFG, MISTRAL_CFG, MIXTRAL_CFG], ids=["qwen2", "mistral", "mixtral"]
+    "cfg",
+    [QWEN2_CFG, MISTRAL_CFG, MIXTRAL_CFG, QWEN3_CFG],
+    ids=["qwen2", "mistral", "mixtral", "qwen3"],
 )
 def test_streaming_matches_monolithic(cfg, rng):
     """The reference invariant, for each family: layerwise prefix-KV streaming
@@ -330,7 +428,9 @@ def test_streaming_matches_monolithic(cfg, rng):
 
 
 @pytest.mark.parametrize(
-    "cfg", [QWEN2_CFG, MISTRAL_CFG, MIXTRAL_CFG], ids=["qwen2", "mistral", "mixtral"]
+    "cfg",
+    [QWEN2_CFG, MISTRAL_CFG, MIXTRAL_CFG, QWEN3_CFG],
+    ids=["qwen2", "mistral", "mixtral", "qwen3"],
 )
 def test_decode_step_matches_monolithic(cfg, rng):
     """KV-cache decode with biases / a binding sliding window: each generated
@@ -441,7 +541,9 @@ def test_splitter_carries_biases(tmp_path):
 
 
 @pytest.mark.parametrize(
-    "cfg", [QWEN2_CFG, MISTRAL_CFG, MIXTRAL_CFG], ids=["qwen2", "mistral", "mixtral"]
+    "cfg",
+    [QWEN2_CFG, MISTRAL_CFG, MIXTRAL_CFG, QWEN3_CFG],
+    ids=["qwen2", "mistral", "mixtral", "qwen3"],
 )
 def test_executor_end_to_end(cfg, rng, tmp_path):
     """The full streaming executor on a biased / sliding-window model:
