@@ -18,6 +18,10 @@ from typing import Any
 # default, but configurable here instead of a module constant.
 DEFAULT_MAX_TOKEN_LEN = 4096
 
+# MLP gate activations models/llama.py implements (its _ACT table asserts it
+# stays in sync with this set).
+SUPPORTED_ACTIVATIONS = frozenset({"silu", "gelu", "gelu_pytorch_tanh"})
+
 
 @dataclasses.dataclass(frozen=True)
 class LlamaConfig:
@@ -31,7 +35,8 @@ class LlamaConfig:
     optional attention window, all static jit args.
     """
 
-    model_type: str = "llama"  # 'llama' | 'mistral' | 'qwen2' | 'qwen3' | 'mixtral'
+    # 'llama' | 'mistral' | 'qwen2' | 'qwen3' | 'mixtral' | 'gemma'
+    model_type: str = "llama"
     vocab_size: int = 32000
     hidden_size: int = 4096
     intermediate_size: int = 11008
@@ -60,6 +65,15 @@ class LlamaConfig:
     # Per-head-dim RMSNorm on q/k after the head reshape, before RoPE
     # (Qwen3; HF: 'unlike olmo, only on the head dim').
     qk_norm: bool = False
+    # MLP gate activation. 'silu' (llama/mistral/qwen/mixtral),
+    # 'gelu_pytorch_tanh' (gemma), 'gelu' (exact erf).
+    hidden_act: str = "silu"
+    # Gemma conventions: RMSNorm multiplies by (1 + weight) IN FLOAT32
+    # before the downcast (HF PR #29402 — the cast order is quality-
+    # relevant at bf16), and embeddings are scaled by sqrt(hidden_size)
+    # (the normalizer itself rounded to the compute dtype, per HF).
+    norm_unit_offset: bool = False
+    embed_scale: bool = False
     # RoPE scaling, flattened to hashable fields (the config must stay a
     # frozen/hashable jit static arg): kind None = unscaled, or
     # 'linear' (Llama-2 long) / 'llama3' (Llama-3.1+ frequency bands).
@@ -136,19 +150,48 @@ class LlamaConfig:
                     "qwen3 mixed layer_types (per-layer sliding window) "
                     "is not supported yet"
                 )
-            if not d.get("use_sliding_window", False) or (
-                lt and all(t == "full_attention" for t in lt)
-            ):
+            if not d.get("use_sliding_window", False):
                 kwargs["sliding_window"] = None
-            elif not lt and d.get(
-                "max_window_layers", d.get("num_hidden_layers")
-            ) != d.get("num_hidden_layers"):
-                # No layer_types to consult, but HF would derive a MIXED
-                # pattern from max_window_layers.
-                raise NotImplementedError(
-                    "qwen3 per-layer sliding window (max_window_layers < "
-                    "num_hidden_layers) is not supported yet"
-                )
+            elif lt:
+                if all(t == "full_attention" for t in lt):
+                    kwargs["sliding_window"] = None
+                # else uniform sliding_attention: window flows through
+            else:
+                # No layer_types: HF derives layer i as sliding iff
+                # i >= max_window_layers (default 28). Uniform patterns map
+                # to our single window field; a mixed one must fail loudly.
+                mwl = d.get("max_window_layers", 28)
+                n = d.get("num_hidden_layers", 28)
+                if mwl >= n:
+                    kwargs["sliding_window"] = None  # every layer full
+                elif mwl > 0:
+                    raise NotImplementedError(
+                        "qwen3 per-layer sliding window (0 < "
+                        "max_window_layers < num_hidden_layers) is not "
+                        "supported yet"
+                    )
+                # mwl == 0: every layer sliding, window flows through
+            kwargs.setdefault("explicit_head_dim", 128)  # Qwen3Config default
+        elif model_type == "gemma":
+            kwargs.setdefault("norm_unit_offset", True)
+            kwargs.setdefault("embed_scale", True)
+            # GemmaConfig's class defaults (tie=True, head_dim=256) are
+            # OMITTED from config.json by HF's to_diff_dict exactly when the
+            # checkpoint uses them; our dataclass defaults differ, so apply
+            # the family defaults here (explicit values still win).
+            kwargs.setdefault("tie_word_embeddings", True)
+            kwargs.setdefault("explicit_head_dim", 256)
+            # HF GemmaConfig: hidden_activation (None -> gelu_pytorch_tanh)
+            # overrides the legacy hidden_act key.
+            kwargs["hidden_act"] = (
+                d.get("hidden_activation") or d.get("hidden_act") or "gelu_pytorch_tanh"
+            )
+            kwargs["sliding_window"] = None
+        elif model_type in ("gemma2", "gemma3"):
+            raise NotImplementedError(
+                f"{model_type} (attn softcapping / alternating local layers / "
+                "pre-post ffw norms) is not supported yet; gemma (v1) is"
+            )
         elif model_type in ("mistral", "mixtral"):
             # sliding_window flows through by field name (may be null);
             # mixtral's num_local_experts/num_experts_per_tok likewise.
@@ -157,7 +200,7 @@ class LlamaConfig:
         else:
             raise NotImplementedError(
                 f"model_type {model_type!r} is not supported "
-                "(llama, mistral, qwen2, qwen3, mixtral are)"
+                "(llama, mistral, qwen2, qwen3, mixtral, gemma are)"
             )
         if model_type != "mixtral":
             # A stray num_local_experts key in a dense export must not flip
@@ -167,6 +210,13 @@ class LlamaConfig:
         if d.get("head_dim"):
             kwargs["explicit_head_dim"] = d["head_dim"]
         kwargs.setdefault("num_key_value_heads", d.get("num_attention_heads", 32))
+        act = kwargs.get("hidden_act", "silu")
+        if act not in SUPPORTED_ACTIVATIONS:
+            # Must fail here, not as a KeyError deep inside a jitted forward.
+            raise NotImplementedError(
+                f"hidden_act {act!r} is not supported "
+                f"(one of {sorted(SUPPORTED_ACTIVATIONS)})"
+            )
         rs = d.get("rope_scaling") or {}
         if rs:
             kind = rs.get("rope_type", rs.get("type"))

@@ -44,7 +44,7 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 
-from flexible_llm_sharding_tpu.config import LlamaConfig
+from flexible_llm_sharding_tpu.config import SUPPORTED_ACTIVATIONS, LlamaConfig
 from flexible_llm_sharding_tpu.ops import apply_rope, attention, rms_norm, rope_cos_sin
 from flexible_llm_sharding_tpu.ops import pallas_attention
 from flexible_llm_sharding_tpu.ops.attention import (
@@ -98,8 +98,18 @@ def _out_proj(attn: Params, o: jax.Array) -> jax.Array:
     return _lin(o.reshape(*o.shape[:-2], -1), attn, "wo", "bo")
 
 
-def _dense_mlp(mlp: Params, x: jax.Array) -> jax.Array:
-    h = jax.nn.silu(_lin(x, mlp, "gate", "bgate")) * _lin(x, mlp, "up", "bup")
+# MLP gate activations by config.hidden_act; HF's 'gelu' is the exact erf
+# form, 'gelu_pytorch_tanh' (gemma) the tanh approximation.
+_ACT = {
+    "silu": jax.nn.silu,
+    "gelu": lambda x: jax.nn.gelu(x, approximate=False),
+    "gelu_pytorch_tanh": lambda x: jax.nn.gelu(x, approximate=True),
+}
+assert set(_ACT) == set(SUPPORTED_ACTIVATIONS)  # config validates against this
+
+
+def _dense_mlp(mlp: Params, x: jax.Array, act) -> jax.Array:
+    h = act(_lin(x, mlp, "gate", "bgate")) * _lin(x, mlp, "up", "bup")
     return _lin(h, mlp, "down", "bdown")
 
 
@@ -148,16 +158,25 @@ def _mlp(mlp: Params, x: jax.Array, cfg: LlamaConfig | None = None) -> jax.Array
     if "router" in mlp:
         assert cfg is not None and cfg.num_local_experts > 0
         return _moe_mlp(mlp, cfg, x)
-    return _dense_mlp(mlp, x)
+    return _dense_mlp(mlp, x, _ACT[cfg.hidden_act if cfg is not None else "silu"])
 
 
 # ---------------------------------------------------------------------------
 # Layers
 # ---------------------------------------------------------------------------
 
-def embed(params: Params, ids: jax.Array, dtype: jnp.dtype) -> jax.Array:
-    """Token ids [..., L] -> hidden states [..., L, D]."""
-    return params["embedding"].astype(dtype)[ids]
+def embed(
+    params: Params, ids: jax.Array, dtype: jnp.dtype, cfg: LlamaConfig | None = None
+) -> jax.Array:
+    """Token ids [..., L] -> hidden states [..., L, D].
+
+    Gemma (``cfg.embed_scale``) multiplies by sqrt(hidden_size), with the
+    normalizer itself rounded to the compute dtype first (HF PR #29402 —
+    sqrt(3072) becomes 55.5 in fp16, reproduced for parity)."""
+    x = params["embedding"].astype(dtype)[ids]
+    if cfg is not None and cfg.embed_scale:
+        x = x * jnp.asarray(cfg.hidden_size**0.5, dtype)
+    return x
 
 
 def decoder_layer(
@@ -169,12 +188,12 @@ def decoder_layer(
 ) -> jax.Array:
     """Plain decoder layer. x: [..., L, D]; positions int [..., L] or [L];
     mask broadcastable to [..., L, L]."""
-    h = rms_norm(x, params["input_layernorm"]["scale"], cfg.rms_norm_eps)
+    h = rms_norm(x, params["input_layernorm"]["scale"], cfg.rms_norm_eps, cfg.norm_unit_offset)
     q, k, v = _qkv(params["attn"], cfg, h)
     cos, sin = rope_cos_sin(positions, cfg.head_dim, cfg.rope_theta, cfg.rope_scaling_spec)
     q, k = apply_rope(q, cos, sin), apply_rope(k, cos, sin)
     x = x + _out_proj(params["attn"], attention(q, k, v, mask))
-    h = rms_norm(x, params["post_attention_layernorm"]["scale"], cfg.rms_norm_eps)
+    h = rms_norm(x, params["post_attention_layernorm"]["scale"], cfg.rms_norm_eps, cfg.norm_unit_offset)
     return x + _mlp(params["mlp"], h, cfg)
 
 
@@ -225,7 +244,7 @@ def prefix_suffix_layer(
     )
 
     # --- prefix: causal self-attention, keep post-RoPE KV ---
-    h = rms_norm(prefix_h, params["input_layernorm"]["scale"], eps)
+    h = rms_norm(prefix_h, params["input_layernorm"]["scale"], eps, cfg.norm_unit_offset)
     q, k, v = _qkv(params["attn"], cfg, h)
     cos, sin = rope_cos_sin(jnp.arange(lp), cfg.head_dim, cfg.rope_theta, cfg.rope_scaling_spec)
     q, k = apply_rope(q, cos, sin), apply_rope(k, cos, sin)
@@ -236,12 +255,12 @@ def prefix_suffix_layer(
     else:
         attn_out = attention(q, k, v, causal_mask(lp, lp, window=window))
     prefix_mid = prefix_h + _out_proj(params["attn"], attn_out)
-    h = rms_norm(prefix_mid, params["post_attention_layernorm"]["scale"], eps)
+    h = rms_norm(prefix_mid, params["post_attention_layernorm"]["scale"], eps, cfg.norm_unit_offset)
     prefix_out = prefix_mid + _mlp(params["mlp"], h, cfg)
 
     # --- suffixes: batched attention over [shared prefix KV ; own causal KV],
     # prefix KV never expanded across suffixes (ops.prefix_shared_attention) ---
-    hs = rms_norm(suffix_h, params["input_layernorm"]["scale"], eps)
+    hs = rms_norm(suffix_h, params["input_layernorm"]["scale"], eps, cfg.norm_unit_offset)
     qs, ks, vs = _qkv(params["attn"], cfg, hs)
     pos_s = prefix_len + jnp.arange(ls)
     cos_s, sin_s = rope_cos_sin(pos_s, cfg.head_dim, cfg.rope_theta, cfg.rope_scaling_spec)
@@ -254,7 +273,7 @@ def prefix_suffix_layer(
     else:
         attn_s = prefix_shared_attention(qs, k, v, ks, vs, prefix_len, window=window)
     suffix_mid = suffix_h + _out_proj(params["attn"], attn_s)
-    hs = rms_norm(suffix_mid, params["post_attention_layernorm"]["scale"], eps)
+    hs = rms_norm(suffix_mid, params["post_attention_layernorm"]["scale"], eps, cfg.norm_unit_offset)
     suffix_out = suffix_mid + _mlp(params["mlp"], hs, cfg)
     if return_kv:
         # Post-RoPE KV, reusable across decode steps (runtime/decode.py).
@@ -282,7 +301,7 @@ def decode_step_layer(
     of kg/vg written).
     """
     eps = cfg.rms_norm_eps
-    h = rms_norm(x, params["input_layernorm"]["scale"], eps)
+    h = rms_norm(x, params["input_layernorm"]["scale"], eps, cfg.norm_unit_offset)
     q, k_new, v_new = _qkv(params["attn"], cfg, h)  # [S, 1, n, hd]
     pos = (prefix_len + suffix_eos + 1 + t)[:, None]  # [S, 1]
     cos, sin = rope_cos_sin(pos, cfg.head_dim, cfg.rope_theta, cfg.rope_scaling_spec)
@@ -306,7 +325,7 @@ def decode_step_layer(
         window=cfg.sliding_window,
     )
     mid = x + _out_proj(params["attn"], attn_out)
-    h = rms_norm(mid, params["post_attention_layernorm"]["scale"], eps)
+    h = rms_norm(mid, params["post_attention_layernorm"]["scale"], eps, cfg.norm_unit_offset)
     return mid + _mlp(params["mlp"], h, cfg), kv
 
 
@@ -320,7 +339,7 @@ def select_eos_and_norm(
     Returns [S, 1, D].
     """
     last = jnp.take_along_axis(suffix_h, suffix_eos[:, None, None], axis=1)
-    return rms_norm(last, params["scale"], cfg.rms_norm_eps)
+    return rms_norm(last, params["scale"], cfg.rms_norm_eps, cfg.norm_unit_offset)
 
 
 def lm_head_scores(params: Params, suffix_h: jax.Array) -> jax.Array:
@@ -356,7 +375,7 @@ def forward_full(
     equal the monolithic forward) and by the training step.
     """
     b, l = ids.shape
-    x = embed(params["embed"], ids, dtype)
+    x = embed(params["embed"], ids, dtype, cfg)
     positions = jnp.arange(l)
     mask = causal_mask(l, l, window=cfg.sliding_window)
     layers = params["layers"]
@@ -368,7 +387,7 @@ def forward_full(
             return decoder_layer(layer_params, cfg, h, positions, mask), None
 
         x, _ = jax.lax.scan(body, x, layers)
-    x = rms_norm(x, params["norm"]["scale"], cfg.rms_norm_eps)
+    x = rms_norm(x, params["norm"]["scale"], cfg.rms_norm_eps, cfg.norm_unit_offset)
     logits = _mm(x, head_params(params)["kernel"])
     return logits.astype(jnp.float32)
 

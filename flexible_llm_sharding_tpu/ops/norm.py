@@ -11,9 +11,20 @@ import jax
 import jax.numpy as jnp
 
 
-def rms_norm(x: jax.Array, scale: jax.Array, eps: float) -> jax.Array:
-    """y = scale * x / sqrt(mean(x^2) + eps), variance computed in float32."""
+def rms_norm(
+    x: jax.Array, scale: jax.Array, eps: float, unit_offset: bool = False
+) -> jax.Array:
+    """y = scale * x / sqrt(mean(x^2) + eps), variance computed in float32.
+
+    ``unit_offset=True`` is the Gemma convention (HF PR #29402): multiply by
+    ``(1 + scale)`` and do that multiply IN FLOAT32 before the downcast —
+    Llama instead downcasts first and multiplies by ``scale`` in the input
+    dtype. The cast order is quality-relevant at bf16, so both are
+    reproduced exactly.
+    """
     x32 = x.astype(jnp.float32)
     var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
     normed = x32 * jax.lax.rsqrt(var + eps)
+    if unit_offset:
+        return (normed * (1.0 + scale.astype(jnp.float32))).astype(x.dtype)
     return scale * normed.astype(x.dtype)

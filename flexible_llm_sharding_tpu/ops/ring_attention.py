@@ -152,7 +152,7 @@ def ring_decoder_layer(
         n = jax.lax.axis_size(axis)
         idx = jax.lax.axis_index(axis)
         lq = x_blk.shape[0]
-        h = rms_norm(x_blk, params["input_layernorm"]["scale"], eps)
+        h = rms_norm(x_blk, params["input_layernorm"]["scale"], eps, cfg.norm_unit_offset)
         q, k, v = llama._qkv(params["attn"], cfg, h)
         pos = idx * lq + jnp.arange(lq)
         cos, sin = rope_cos_sin(pos, cfg.head_dim, cfg.rope_theta, cfg.rope_scaling_spec)
@@ -167,7 +167,7 @@ def ring_decoder_layer(
 
     def local_tail(x_blk, attn_blk):
         mid = x_blk + llama._out_proj(params["attn"], attn_blk)
-        h = rms_norm(mid, params["post_attention_layernorm"]["scale"], eps)
+        h = rms_norm(mid, params["post_attention_layernorm"]["scale"], eps, cfg.norm_unit_offset)
         return mid + llama._mlp(params["mlp"], h, cfg)
 
     out = jax.shard_map(

@@ -101,7 +101,7 @@ def _decode_norm_head(cfg: LlamaConfig, norm_params, head_params, x):
     """x [B, S, 1, D] -> float32 next-token distributions [B, S, V]."""
     from flexible_llm_sharding_tpu.ops import rms_norm
 
-    h = rms_norm(x, norm_params["scale"], cfg.rms_norm_eps)
+    h = rms_norm(x, norm_params["scale"], cfg.rms_norm_eps, cfg.norm_unit_offset)
     return jax.vmap(llama.lm_head_scores, in_axes=(None, 0))(head_params, h)
 
 
@@ -316,7 +316,7 @@ class DecodeGenerator:
                                 ids = jnp.asarray(
                                     tok_hist[b][-1][..., None], jnp.int32
                                 )
-                                x = llama.embed(params, ids, self.dtype)
+                                x = llama.embed(params, ids, self.dtype, self.model_cfg)
                             elif kind == "decoders":
                                 kv = kv_store.get(("kv", shard_pos, b), dev)
                                 x, kv = _decode_decoders(

@@ -71,8 +71,8 @@ def np_dtype_for(dtype_name: str) -> np.dtype:
 def _embed_block(cfg: LlamaConfig, dtype, embed_params, prefix_ids, suffix_ids):
     """ids [B, Lp], [B, S, Ls] -> hidden [B, Lp, D], [B, S, Ls, D]."""
     return (
-        llama.embed(embed_params, prefix_ids, dtype),
-        llama.embed(embed_params, suffix_ids, dtype),
+        llama.embed(embed_params, prefix_ids, dtype, cfg),
+        llama.embed(embed_params, suffix_ids, dtype, cfg),
     )
 
 

@@ -100,7 +100,7 @@ def sharded_prefix_suffix_layer(
     )
 
     # --- suffix q/k/v at global positions prefix_len + i ---
-    hs = rms_norm(suffix_h, params["input_layernorm"]["scale"], eps)
+    hs = rms_norm(suffix_h, params["input_layernorm"]["scale"], eps, cfg.norm_unit_offset)
     qs, ks, vs = llama._qkv(params["attn"], cfg, hs)
     pos_s = prefix_len + jnp.arange(ls)
     cos_s, sin_s = rope_cos_sin(
@@ -153,7 +153,7 @@ def sharded_prefix_suffix_layer(
     )
 
     suffix_mid = suffix_h + llama._out_proj(params["attn"], attn_s)
-    hs = rms_norm(suffix_mid, params["post_attention_layernorm"]["scale"], eps)
+    hs = rms_norm(suffix_mid, params["post_attention_layernorm"]["scale"], eps, cfg.norm_unit_offset)
     suffix_out = suffix_mid + llama._mlp(params["mlp"], hs, cfg)
     return prefix_out, suffix_out
 
@@ -250,8 +250,8 @@ class LongContextScorer:
             _, segments = next(stream)
             for kind, params in segments:
                 if kind == "embed":
-                    prefix_x = llama.embed(params, prefix_ids, self.dtype)
-                    suffix_h = llama.embed(params, suffix_ids, self.dtype)
+                    prefix_x = llama.embed(params, prefix_ids, self.dtype, self.model_cfg)
+                    suffix_h = llama.embed(params, suffix_ids, self.dtype, self.model_cfg)
                 elif kind == "decoders":
                     # Unstack the [k, ...] scan pytree: each layer runs
                     # as one jitted sharded step (shard_map inside).

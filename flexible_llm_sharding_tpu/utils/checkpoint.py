@@ -431,6 +431,9 @@ def save_params(params: dict[str, Any], out_dir: str, cfg: LlamaConfig) -> None:
         "num_local_experts": cfg.num_local_experts,
         "num_experts_per_tok": cfg.num_experts_per_tok,
         "qk_norm": cfg.qk_norm,
+        "hidden_act": cfg.hidden_act,
+        "norm_unit_offset": cfg.norm_unit_offset,
+        "embed_scale": cfg.embed_scale,
     }
     if cfg.explicit_head_dim is not None:
         hf_cfg["head_dim"] = cfg.explicit_head_dim

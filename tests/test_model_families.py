@@ -59,6 +59,23 @@ QWEN3_CFG = LlamaConfig(
     qk_norm=True,
 )
 
+GEMMA_CFG = LlamaConfig(
+    model_type="gemma",
+    vocab_size=256,
+    hidden_size=64,
+    intermediate_size=128,
+    num_hidden_layers=3,
+    num_attention_heads=4,
+    num_key_value_heads=2,  # gemma-7b is MHA but GQA covers gemma-2b's shape
+    max_position_embeddings=512,
+    rms_norm_eps=1e-6,
+    tie_word_embeddings=True,  # gemma always ties
+    explicit_head_dim=32,
+    hidden_act="gelu_pytorch_tanh",
+    norm_unit_offset=True,
+    embed_scale=True,
+)
+
 MIXTRAL_CFG = LlamaConfig(
     model_type="mixtral",
     vocab_size=256,
@@ -289,6 +306,107 @@ def test_from_hf_qwen3():
         }
     )
     assert cfg.sliding_window == 64
+    # No layer_types: HF derives sliding iff i >= max_window_layers, so
+    # mwl >= n means every layer FULL (window off) and mwl == 0 every layer
+    # sliding (window on) — both uniform, both representable.
+    cfg = LlamaConfig.from_hf_config(
+        {
+            "model_type": "qwen3",
+            "num_hidden_layers": 4,
+            "use_sliding_window": True,
+            "sliding_window": 64,
+            "max_window_layers": 4,
+        }
+    )
+    assert cfg.sliding_window is None
+    cfg = LlamaConfig.from_hf_config(
+        {
+            "model_type": "qwen3",
+            "num_hidden_layers": 4,
+            "use_sliding_window": True,
+            "sliding_window": 64,
+            "max_window_layers": 0,
+        }
+    )
+    assert cfg.sliding_window == 64
+    # head_dim omitted from config.json (equals the Qwen3Config class
+    # default, so HF's to_diff_dict drops it) -> 128, not hidden/heads.
+    cfg = LlamaConfig.from_hf_config(
+        {"model_type": "qwen3", "hidden_size": 1024, "num_attention_heads": 16}
+    )
+    assert cfg.head_dim == 128
+
+
+def _hf_gemma(cfg: LlamaConfig):
+    from transformers import GemmaConfig, GemmaForCausalLM
+
+    torch.manual_seed(0)
+    return GemmaForCausalLM(
+        GemmaConfig(
+            vocab_size=cfg.vocab_size,
+            hidden_size=cfg.hidden_size,
+            intermediate_size=cfg.intermediate_size,
+            num_hidden_layers=cfg.num_hidden_layers,
+            num_attention_heads=cfg.num_attention_heads,
+            num_key_value_heads=cfg.num_key_value_heads,
+            rms_norm_eps=cfg.rms_norm_eps,
+            rope_theta=cfg.rope_theta,
+            max_position_embeddings=cfg.max_position_embeddings,
+            tie_word_embeddings=True,
+            head_dim=cfg.head_dim,
+            hidden_activation="gelu_pytorch_tanh",
+            attn_implementation="eager",
+        )
+    ).eval()
+
+
+def test_gemma_forward_matches_hf(rng):
+    """Gemma's three deltas vs Llama: (1+w) fp32-multiply RMSNorm, tanh-GELU
+    gate activation, sqrt(hidden) embedding scaling (+ tied lm_head)."""
+    model = _hf_gemma(GEMMA_CFG)
+    params = _params_from_hf(model, GEMMA_CFG)
+    # HF keeps a (tied) lm_head view in the state dict; either way the head
+    # must equal the transposed embedding.
+    np.testing.assert_array_equal(
+        np.asarray(llama.head_params(params)["kernel"]),
+        np.asarray(params["embed"]["embedding"]).T,
+    )
+    ids = rng.integers(0, GEMMA_CFG.vocab_size, size=(2, 17))
+    with torch.no_grad():
+        hf_logits = model(torch.tensor(ids)).logits.numpy()
+    ours = np.asarray(llama.forward_full(params, GEMMA_CFG, jnp.asarray(ids)))
+    np.testing.assert_allclose(ours, hf_logits, rtol=2e-4, atol=2e-4)
+
+
+def test_from_hf_gemma():
+    cfg = LlamaConfig.from_hf_config(
+        {
+            "model_type": "gemma",
+            "num_hidden_layers": 2,
+            "hidden_size": 64,
+            "head_dim": 32,
+            "hidden_activation": None,  # HF: None -> gelu_pytorch_tanh
+        }
+    )
+    assert cfg.norm_unit_offset and cfg.embed_scale
+    assert cfg.hidden_act == "gelu_pytorch_tanh" and cfg.head_dim == 32
+    # HF omits tie_word_embeddings from gemma config.json (it equals the
+    # GemmaConfig class default, so to_diff_dict drops it) — the family
+    # default here must be True or the executor asks for a lm_head file
+    # that tied checkpoints never contain.
+    assert cfg.tie_word_embeddings
+    for mt in ("gemma2", "gemma3"):
+        with pytest.raises(NotImplementedError):
+            LlamaConfig.from_hf_config({"model_type": mt})
+    # head_dim omitted (equals GemmaConfig's 256 class default) -> 256.
+    cfg = LlamaConfig.from_hf_config(
+        {"model_type": "gemma", "hidden_size": 3072, "num_attention_heads": 16}
+    )
+    assert cfg.head_dim == 256
+    # Unsupported activation must fail at config load, not as a KeyError
+    # inside a jitted forward.
+    with pytest.raises(NotImplementedError):
+        LlamaConfig.from_hf_config({"model_type": "llama", "hidden_act": "gelu_new"})
 
 
 def test_mixtral_forward_matches_hf(rng):
@@ -394,8 +512,8 @@ def _stream_scores(params, cfg, prefix_ids, suffix_ids_list, lp_bucket):
     for i, sid in enumerate(suffix_ids_list):
         suffix_padded[i, : len(sid)] = sid
     suffix_eos = jnp.asarray([len(x) - 1 for x in suffix_ids_list])
-    ph = llama.embed(params["embed"], jnp.asarray(prefix_padded), jnp.float32)
-    sh = llama.embed(params["embed"], jnp.asarray(suffix_padded), jnp.float32)
+    ph = llama.embed(params["embed"], jnp.asarray(prefix_padded), jnp.float32, cfg)
+    sh = llama.embed(params["embed"], jnp.asarray(suffix_padded), jnp.float32, cfg)
     plen = jnp.asarray(len(prefix_ids), jnp.int32)
     for layer in params["layers"]:
         ph, sh = llama.prefix_suffix_layer(layer, cfg, ph, sh, plen)
@@ -405,8 +523,8 @@ def _stream_scores(params, cfg, prefix_ids, suffix_ids_list, lp_bucket):
 
 @pytest.mark.parametrize(
     "cfg",
-    [QWEN2_CFG, MISTRAL_CFG, MIXTRAL_CFG, QWEN3_CFG],
-    ids=["qwen2", "mistral", "mixtral", "qwen3"],
+    [QWEN2_CFG, MISTRAL_CFG, MIXTRAL_CFG, QWEN3_CFG, GEMMA_CFG],
+    ids=["qwen2", "mistral", "mixtral", "qwen3", "gemma"],
 )
 def test_streaming_matches_monolithic(cfg, rng):
     """The reference invariant, for each family: layerwise prefix-KV streaming
@@ -429,8 +547,8 @@ def test_streaming_matches_monolithic(cfg, rng):
 
 @pytest.mark.parametrize(
     "cfg",
-    [QWEN2_CFG, MISTRAL_CFG, MIXTRAL_CFG, QWEN3_CFG],
-    ids=["qwen2", "mistral", "mixtral", "qwen3"],
+    [QWEN2_CFG, MISTRAL_CFG, MIXTRAL_CFG, QWEN3_CFG, GEMMA_CFG],
+    ids=["qwen2", "mistral", "mixtral", "qwen3", "gemma"],
 )
 def test_decode_step_matches_monolithic(cfg, rng):
     """KV-cache decode with biases / a binding sliding window: each generated
@@ -447,8 +565,8 @@ def test_decode_step_matches_monolithic(cfg, rng):
     suffix_eos = jnp.asarray([len(suffix_ids) - 1])
 
     # Prefill via the streaming layer, keeping KV.
-    ph = llama.embed(params["embed"], jnp.asarray(prefix_padded), jnp.float32)
-    sh = llama.embed(params["embed"], jnp.asarray(suffix_ids[None, :]), jnp.float32)
+    ph = llama.embed(params["embed"], jnp.asarray(prefix_padded), jnp.float32, cfg)
+    sh = llama.embed(params["embed"], jnp.asarray(suffix_ids[None, :]), jnp.float32, cfg)
     kvs = []
     for layer in params["layers"]:
         ph, sh, kv = llama.prefix_suffix_layer(layer, cfg, ph, sh, plen, return_kv=True)
@@ -466,14 +584,16 @@ def test_decode_step_matches_monolithic(cfg, rng):
     )
     for t in range(tmax):
         gen.append(next_id)
-        x = llama.embed(params["embed"], jnp.asarray([[next_id]]), jnp.float32)
+        x = llama.embed(params["embed"], jnp.asarray([[next_id]]), jnp.float32, cfg)
         for li, layer in enumerate(params["layers"]):
             x, kvs[li] = llama.decode_step_layer(
                 layer, cfg, x, kvs[li], plen, suffix_eos, jnp.asarray(t, jnp.int32)
             )
         from flexible_llm_sharding_tpu.ops import rms_norm
 
-        normed = rms_norm(x, params["norm"]["scale"], cfg.rms_norm_eps)
+        normed = rms_norm(
+            x, params["norm"]["scale"], cfg.rms_norm_eps, cfg.norm_unit_offset
+        )
         scores = np.asarray(llama.lm_head_scores(llama.head_params(params), normed))[0]
 
         full = np.concatenate([prefix_ids, suffix_ids, np.asarray(gen)])[None, :]
@@ -542,8 +662,8 @@ def test_splitter_carries_biases(tmp_path):
 
 @pytest.mark.parametrize(
     "cfg",
-    [QWEN2_CFG, MISTRAL_CFG, MIXTRAL_CFG, QWEN3_CFG],
-    ids=["qwen2", "mistral", "mixtral", "qwen3"],
+    [QWEN2_CFG, MISTRAL_CFG, MIXTRAL_CFG, QWEN3_CFG, GEMMA_CFG],
+    ids=["qwen2", "mistral", "mixtral", "qwen3", "gemma"],
 )
 def test_executor_end_to_end(cfg, rng, tmp_path):
     """The full streaming executor on a biased / sliding-window model:
