@@ -42,11 +42,47 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
+def _probe_backend_hung(timeout_s: float = 90.0) -> bool:
+    """Detect a WEDGED accelerator backend via a subprocess probe.
+
+    A wedged tunnel doesn't fail ``jax.devices()`` — it hangs it, and a hung
+    backend-init in THIS process cannot be recovered (no way to re-pin to
+    CPU once initialisation has started). The subprocess takes the hang
+    instead. Only a hang short-circuits to CPU; a fast *failure* falls
+    through to the caller's retry/backoff, which handles transient tunnel
+    contention.
+    """
+    import subprocess
+
+    try:
+        subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=timeout_s,
+            capture_output=True,
+        )
+        return False
+    except subprocess.TimeoutExpired:
+        log(f"backend probe hung >{timeout_s:.0f}s (tunnel wedged)")
+        return True
+    except Exception as e:  # pragma: no cover
+        log(f"backend probe errored: {e!r}")
+        return False
+
+
 def _init_jax(max_tries: int = 4):
-    """jax.devices() with retry/backoff (the axon TPU tunnel can fail
-    transiently under contention), then a CPU fallback so the bench always
-    produces a number — the platform is recorded in the JSON either way."""
+    """jax.devices() with a wedge-safe probe and retry/backoff (the axon TPU
+    tunnel can fail transiently under contention, or hang outright), then a
+    CPU fallback so the bench always produces a number — the platform is
+    recorded in the JSON either way."""
     import jax
+
+    if _probe_backend_hung():
+        log("TPU backend wedged; pinning CPU before first jax use")
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except RuntimeError:
+            pass  # backend already up in this process; use what exists
+        return jax, jax.devices()
 
     delay = 5.0
     for attempt in range(1, max_tries + 1):

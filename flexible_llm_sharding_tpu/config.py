@@ -104,6 +104,40 @@ class LlamaConfig:
             self.rope_original_max_position,
         )
 
+    @staticmethod
+    def _uniform_window(d: dict[str, Any], family: str) -> bool:
+        """Resolve the qwen2/qwen3 sliding-window convention to a single
+        uniform value: returns True if the window applies to EVERY layer,
+        False if to none — raising on per-layer mixed patterns, which one
+        window field cannot represent (silently applying either choice
+        would diverge from HF logits).
+
+        HF semantics (configuration_qwen2/3.py): the window is active only
+        under ``use_sliding_window``, and layer i is sliding iff
+        ``i >= max_window_layers`` (class default 28) — or per the explicit
+        ``layer_types`` list when present.
+        """
+        lt = d.get("layer_types")
+        if lt and len(set(lt)) > 1:
+            raise NotImplementedError(
+                f"{family} mixed layer_types (per-layer sliding window) "
+                "is not supported yet"
+            )
+        if not d.get("use_sliding_window", False):
+            return False
+        if lt:
+            return all(t == "sliding_attention" for t in lt)
+        mwl = d.get("max_window_layers", 28)
+        n = d.get("num_hidden_layers", 28)
+        if mwl >= n:
+            return False  # every layer full attention
+        if mwl > 0:
+            raise NotImplementedError(
+                f"{family} per-layer sliding window (0 < max_window_layers "
+                "< num_hidden_layers) is not supported yet"
+            )
+        return True  # mwl == 0: every layer sliding
+
     @classmethod
     def from_hf_config(cls, d: dict[str, Any]) -> "LlamaConfig":
         known = {f.name for f in dataclasses.fields(cls)}
@@ -123,15 +157,8 @@ class LlamaConfig:
             # HF Qwen2 hard-codes bias=True on q/k/v, False on o_proj.
             kwargs.setdefault("attention_in_bias", True)
             kwargs.setdefault("attention_out_bias", False)
-            if not d.get("use_sliding_window", False):
+            if not cls._uniform_window(d, "qwen2"):
                 kwargs["sliding_window"] = None
-            elif d.get("max_window_layers", d.get("num_hidden_layers")) != d.get(
-                "num_hidden_layers"
-            ):
-                raise NotImplementedError(
-                    "qwen2 per-layer sliding window (max_window_layers < "
-                    "num_hidden_layers) is not supported yet"
-                )
         elif model_type == "qwen3":
             # One attention_bias flag for all four projections (like Llama,
             # default False) + per-head-dim q/k RMSNorm.
@@ -139,38 +166,8 @@ class LlamaConfig:
                 kwargs.setdefault("attention_in_bias", True)
                 kwargs.setdefault("attention_out_bias", True)
             kwargs.setdefault("qk_norm", True)
-            # HF resolves: sliding_window = sliding_window if
-            # use_sliding_window else None, then derives per-layer
-            # layer_types from max_window_layers (configuration_qwen3.py).
-            # A uniform result maps to our single window field; a mixed
-            # per-layer pattern must fail loudly, not silently diverge.
-            lt = d.get("layer_types")
-            if lt and len(set(lt)) > 1:
-                raise NotImplementedError(
-                    "qwen3 mixed layer_types (per-layer sliding window) "
-                    "is not supported yet"
-                )
-            if not d.get("use_sliding_window", False):
+            if not cls._uniform_window(d, "qwen3"):
                 kwargs["sliding_window"] = None
-            elif lt:
-                if all(t == "full_attention" for t in lt):
-                    kwargs["sliding_window"] = None
-                # else uniform sliding_attention: window flows through
-            else:
-                # No layer_types: HF derives layer i as sliding iff
-                # i >= max_window_layers (default 28). Uniform patterns map
-                # to our single window field; a mixed one must fail loudly.
-                mwl = d.get("max_window_layers", 28)
-                n = d.get("num_hidden_layers", 28)
-                if mwl >= n:
-                    kwargs["sliding_window"] = None  # every layer full
-                elif mwl > 0:
-                    raise NotImplementedError(
-                        "qwen3 per-layer sliding window (0 < "
-                        "max_window_layers < num_hidden_layers) is not "
-                        "supported yet"
-                    )
-                # mwl == 0: every layer sliding, window flows through
             kwargs.setdefault("explicit_head_dim", 128)  # Qwen3Config default
         elif model_type == "gemma":
             kwargs.setdefault("norm_unit_offset", True)

@@ -140,7 +140,7 @@ def _moe_mlp(mlp: Params, cfg: LlamaConfig, x: jax.Array) -> jax.Array:
     combine = jnp.sum(
         jax.nn.one_hot(top_idx, e, dtype=jnp.float32) * top_vals[..., None], axis=-2
     ).astype(x.dtype)  # [..., L, E]
-    h = jax.nn.silu(
+    h = _ACT[cfg.hidden_act](
         jnp.einsum("...ld,edf->...lef", x, mlp["gate"].astype(x.dtype), precision=_PRECISION)
     ) * jnp.einsum("...ld,edf->...lef", x, mlp["up"].astype(x.dtype), precision=_PRECISION)
     # Fold the combine weights in BEFORE the down projection (scalar per

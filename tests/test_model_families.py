@@ -109,6 +109,9 @@ def test_from_hf_qwen2_bias_defaults():
 
 
 def test_from_hf_qwen2_window_enabled():
+    # HF derives layer i sliding iff i >= max_window_layers, so mwl == n
+    # means every layer FULL attention (window off) and mwl == 0 every
+    # layer sliding (the only uniform-on pattern).
     cfg = LlamaConfig.from_hf_config(
         {
             "model_type": "qwen2",
@@ -116,6 +119,16 @@ def test_from_hf_qwen2_window_enabled():
             "use_sliding_window": True,
             "sliding_window": 128,
             "max_window_layers": 2,
+        }
+    )
+    assert cfg.sliding_window is None
+    cfg = LlamaConfig.from_hf_config(
+        {
+            "model_type": "qwen2",
+            "num_hidden_layers": 2,
+            "use_sliding_window": True,
+            "sliding_window": 128,
+            "max_window_layers": 0,
         }
     )
     assert cfg.sliding_window == 128
