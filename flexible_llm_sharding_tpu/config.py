@@ -74,6 +74,27 @@ class LlamaConfig:
     # (the normalizer itself rounded to the compute dtype, per HF).
     norm_unit_offset: bool = False
     embed_scale: bool = False
+    # Gemma2 additions. ffw_sandwich_norms: post_attention_layernorm moves
+    # to the attention OUTPUT (before the residual add) and the MLP gets
+    # pre/post_feedforward_layernorms. Softcaps apply soft*tanh(x/soft) to
+    # attention scores (pre-mask) / final logits. query_pre_attn_scalar
+    # replaces head_dim in the attention scale when set. layer_sliding
+    # toggles the sliding window PER LAYER (True = sliding) — Gemma2
+    # alternates, layer_types-derived; None = uniform per sliding_window.
+    ffw_sandwich_norms: bool = False
+    attn_logit_softcap: float | None = None
+    final_logit_softcap: float | None = None
+    query_pre_attn_scalar: float | None = None
+    layer_sliding: tuple[bool, ...] | None = None
+
+    @property
+    def attn_scale(self) -> float:
+        base = (
+            self.query_pre_attn_scalar
+            if self.query_pre_attn_scalar is not None
+            else self.head_dim
+        )
+        return float(base) ** -0.5
     # RoPE scaling, flattened to hashable fields (the config must stay a
     # frozen/hashable jit static arg): kind None = unscaled, or
     # 'linear' (Llama-2 long) / 'llama3' (Llama-3.1+ frequency bands).
@@ -184,10 +205,44 @@ class LlamaConfig:
                 d.get("hidden_activation") or d.get("hidden_act") or "gelu_pytorch_tanh"
             )
             kwargs["sliding_window"] = None
-        elif model_type in ("gemma2", "gemma3"):
+        elif model_type == "gemma2":
+            kwargs.setdefault("norm_unit_offset", True)
+            kwargs.setdefault("embed_scale", True)
+            kwargs.setdefault("tie_word_embeddings", True)
+            kwargs.setdefault("explicit_head_dim", 256)  # Gemma2Config default
+            kwargs["hidden_act"] = (
+                d.get("hidden_activation") or d.get("hidden_act") or "gelu_pytorch_tanh"
+            )
+            kwargs["ffw_sandwich_norms"] = True
+            # setdefault: explicit NATIVE keys (our own saved configs,
+            # including explicit nulls) win over the HF names/defaults.
+            kwargs.setdefault("attn_logit_softcap", d.get("attn_logit_softcapping", 50.0))
+            kwargs.setdefault("final_logit_softcap", d.get("final_logit_softcapping", 30.0))
+            kwargs.setdefault("query_pre_attn_scalar", 256)
+            if "layer_sliding" not in kwargs:
+                # Alternating local/global attention: layer i slides iff
+                # layer_types[i] says so (HF default: every even layer).
+                n = d.get("num_hidden_layers", 26)
+                lt = d.get("layer_types") or [
+                    "sliding_attention" if (i + 1) % 2 else "full_attention"
+                    for i in range(n)
+                ]
+                sliding = tuple(t == "sliding_attention" for t in lt)
+                if len(sliding) != n:
+                    raise ValueError(
+                        f"gemma2 layer_types has {len(sliding)} entries for "
+                        f"{n} layers"
+                    )
+                kwargs.setdefault("sliding_window", 4096)
+                if not any(sliding):
+                    kwargs["sliding_window"] = None
+                elif not all(sliding):
+                    kwargs["layer_sliding"] = sliding
+                # all sliding: uniform window, no per-layer flags needed
+        elif model_type == "gemma3":
             raise NotImplementedError(
-                f"{model_type} (attn softcapping / alternating local layers / "
-                "pre-post ffw norms) is not supported yet; gemma (v1) is"
+                "gemma3 (per-layer rope bases / 5:1 local-global pattern) "
+                "is not supported yet; gemma and gemma2 are"
             )
         elif model_type in ("mistral", "mixtral"):
             # sliding_window flows through by field name (may be null);
@@ -197,7 +252,7 @@ class LlamaConfig:
         else:
             raise NotImplementedError(
                 f"model_type {model_type!r} is not supported "
-                "(llama, mistral, qwen2, qwen3, mixtral, gemma are)"
+                "(llama, mistral, qwen2, qwen3, mixtral, gemma, gemma2 are)"
             )
         if model_type != "mixtral":
             # A stray num_local_experts key in a dense export must not flip
@@ -207,6 +262,9 @@ class LlamaConfig:
         if d.get("head_dim"):
             kwargs["explicit_head_dim"] = d["head_dim"]
         kwargs.setdefault("num_key_value_heads", d.get("num_attention_heads", 32))
+        if kwargs.get("layer_sliding") is not None:
+            # json round-trips tuples as lists; the field must stay hashable.
+            kwargs["layer_sliding"] = tuple(kwargs["layer_sliding"])
         act = kwargs.get("hidden_act", "silu")
         if act not in SUPPORTED_ACTIVATIONS:
             # Must fail here, not as a KeyError deep inside a jitted forward.

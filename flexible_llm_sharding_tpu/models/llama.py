@@ -161,6 +161,64 @@ def _mlp(mlp: Params, x: jax.Array, cfg: LlamaConfig | None = None) -> jax.Array
     return _dense_mlp(mlp, x, _ACT[cfg.hidden_act if cfg is not None else "silu"])
 
 
+def _residual_attn(params: Params, cfg: LlamaConfig, x: jax.Array, attn_out) -> jax.Array:
+    """Residual add of the attention sublayer. Gemma2's sandwich layout
+    (``ffw_sandwich_norms``) norms the sublayer OUTPUT before the add."""
+    y = _out_proj(params["attn"], attn_out)
+    if cfg.ffw_sandwich_norms:
+        y = rms_norm(
+            y,
+            params["post_attention_layernorm"]["scale"],
+            cfg.rms_norm_eps,
+            cfg.norm_unit_offset,
+        )
+    return x + y
+
+
+def _residual_mlp(params: Params, cfg: LlamaConfig, x: jax.Array) -> jax.Array:
+    """Residual add of the MLP sublayer. Standard layout norms the input
+    with post_attention_layernorm; Gemma2 norms input AND output with the
+    pre/post_feedforward_layernorms."""
+    pre = (
+        "pre_feedforward_layernorm"
+        if cfg.ffw_sandwich_norms
+        else "post_attention_layernorm"
+    )
+    h = rms_norm(x, params[pre]["scale"], cfg.rms_norm_eps, cfg.norm_unit_offset)
+    y = _mlp(params["mlp"], h, cfg)
+    if cfg.ffw_sandwich_norms:
+        y = rms_norm(
+            y,
+            params["post_feedforward_layernorm"]["scale"],
+            cfg.rms_norm_eps,
+            cfg.norm_unit_offset,
+        )
+    return x + y
+
+
+def layer_sliding_pattern(cfg: LlamaConfig) -> tuple[bool, ...]:
+    """Per-layer sliding-window flags, one per decoder layer: the explicit
+    pattern (Gemma2 alternation) or the uniform on/off of sliding_window."""
+    if cfg.layer_sliding is not None:
+        return cfg.layer_sliding
+    return (cfg.sliding_window is not None,) * cfg.num_hidden_layers
+
+
+def _effective_window(cfg: LlamaConfig, sliding) -> tuple[int | None, Any]:
+    """Resolve (window, sliding) for one layer.
+
+    ``sliding``: None = uniform (cfg.sliding_window applies as-is); a python
+    bool = static per-layer toggle (folds into the trace); a traced bool
+    scalar = dynamic toggle (Gemma2 layers under one scan program).
+    """
+    window = cfg.sliding_window
+    if window is None or sliding is None:
+        return window, None
+    if isinstance(sliding, bool):
+        return (window if sliding else None), None
+    return window, sliding
+
+
 # ---------------------------------------------------------------------------
 # Layers
 # ---------------------------------------------------------------------------
@@ -187,14 +245,16 @@ def decoder_layer(
     mask: jax.Array | None,
 ) -> jax.Array:
     """Plain decoder layer. x: [..., L, D]; positions int [..., L] or [L];
-    mask broadcastable to [..., L, L]."""
+    mask broadcastable to [..., L, L] (caller bakes any sliding window in)."""
     h = rms_norm(x, params["input_layernorm"]["scale"], cfg.rms_norm_eps, cfg.norm_unit_offset)
     q, k, v = _qkv(params["attn"], cfg, h)
     cos, sin = rope_cos_sin(positions, cfg.head_dim, cfg.rope_theta, cfg.rope_scaling_spec)
     q, k = apply_rope(q, cos, sin), apply_rope(k, cos, sin)
-    x = x + _out_proj(params["attn"], attention(q, k, v, mask))
-    h = rms_norm(x, params["post_attention_layernorm"]["scale"], cfg.rms_norm_eps, cfg.norm_unit_offset)
-    return x + _mlp(params["mlp"], h, cfg)
+    attn_out = attention(
+        q, k, v, mask, scale=cfg.attn_scale, softcap=cfg.attn_logit_softcap
+    )
+    x = _residual_attn(params, cfg, x, attn_out)
+    return _residual_mlp(params, cfg, x)
 
 
 def prefix_suffix_layer(
@@ -205,6 +265,7 @@ def prefix_suffix_layer(
     prefix_len: jax.Array,
     use_pallas: bool = False,
     return_kv: bool = False,
+    sliding=None,
 ) -> tuple[jax.Array, ...]:
     """One decoder layer over a (prefix, suffixes) prompt — the streaming hot op.
 
@@ -226,18 +287,22 @@ def prefix_suffix_layer(
     lp, _ = prefix_h.shape
     s, ls, _ = suffix_h.shape
     eps = cfg.rms_norm_eps
-    window = cfg.sliding_window
+    window, sliding = _effective_window(cfg, sliding)
     if window is not None and lp + ls <= window:
         # Max query-key distance at these (static) bucket shapes is
         # lp + ls - 1 < window: the band equals full causal, so drop the
         # window — keeping the flash kernels eligible (the common case for
         # Mistral's 4096 window under the 4096 token cap).
-        window = None
-    # The flash kernels implement full causal masks only; a *binding*
-    # sliding window falls back to the XLA attention (fused banded mask).
+        window = sliding = None
+    # The flash kernels implement full causal masks with the default scale
+    # only; a *binding* sliding window, a traced per-layer toggle, an
+    # attention softcap, or a custom scale all fall back to the XLA
+    # attention (which fuses the banded mask / tanh cap anyway).
     flash = (
         use_pallas
         and window is None
+        and cfg.attn_logit_softcap is None
+        and cfg.query_pre_attn_scalar is None
         and pallas_attention.supports(
             cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim, ls, lp
         )
@@ -253,10 +318,17 @@ def prefix_suffix_layer(
         # additionally skips fully-masked KV blocks.
         attn_out = pallas_attention.flash_causal_attention(q, k, v, prefix_len)
     else:
-        attn_out = attention(q, k, v, causal_mask(lp, lp, window=window))
-    prefix_mid = prefix_h + _out_proj(params["attn"], attn_out)
-    h = rms_norm(prefix_mid, params["post_attention_layernorm"]["scale"], eps, cfg.norm_unit_offset)
-    prefix_out = prefix_mid + _mlp(params["mlp"], h, cfg)
+        if sliding is None:
+            mask = causal_mask(lp, lp, window=window)
+        else:  # traced per-layer toggle: banded iff this layer slides
+            mask = jnp.where(
+                sliding, causal_mask(lp, lp, window=window), causal_mask(lp, lp)
+            )
+        attn_out = attention(
+            q, k, v, mask, scale=cfg.attn_scale, softcap=cfg.attn_logit_softcap
+        )
+    prefix_mid = _residual_attn(params, cfg, prefix_h, attn_out)
+    prefix_out = _residual_mlp(params, cfg, prefix_mid)
 
     # --- suffixes: batched attention over [shared prefix KV ; own causal KV],
     # prefix KV never expanded across suffixes (ops.prefix_shared_attention) ---
@@ -271,10 +343,20 @@ def prefix_suffix_layer(
             qs, k, v, ks, vs, prefix_len
         )
     else:
-        attn_s = prefix_shared_attention(qs, k, v, ks, vs, prefix_len, window=window)
-    suffix_mid = suffix_h + _out_proj(params["attn"], attn_s)
-    hs = rms_norm(suffix_mid, params["post_attention_layernorm"]["scale"], eps, cfg.norm_unit_offset)
-    suffix_out = suffix_mid + _mlp(params["mlp"], hs, cfg)
+        attn_s = prefix_shared_attention(
+            qs,
+            k,
+            v,
+            ks,
+            vs,
+            prefix_len,
+            scale=cfg.attn_scale,
+            window=window,
+            softcap=cfg.attn_logit_softcap,
+            sliding=sliding,
+        )
+    suffix_mid = _residual_attn(params, cfg, suffix_h, attn_s)
+    suffix_out = _residual_mlp(params, cfg, suffix_mid)
     if return_kv:
         # Post-RoPE KV, reusable across decode steps (runtime/decode.py).
         return prefix_out, suffix_out, {"kp": k, "vp": v, "ks": ks, "vs": vs}
@@ -289,6 +371,7 @@ def decode_step_layer(
     prefix_len: jax.Array,
     suffix_eos: jax.Array,
     t: jax.Array,
+    sliding=None,
 ) -> tuple[jax.Array, Params]:
     """One decoder layer for ONE new token per suffix, against cached KV.
 
@@ -311,6 +394,7 @@ def decode_step_layer(
     kv["kg"] = jax.lax.dynamic_update_slice_in_dim(kv["kg"], k_new, t, axis=1)
     kv["vg"] = jax.lax.dynamic_update_slice_in_dim(kv["vg"], v_new, t, axis=1)
 
+    window, sliding = _effective_window(cfg, sliding)
     attn_out = decode_attention(
         q,
         kv["kp"],
@@ -322,11 +406,13 @@ def decode_step_layer(
         prefix_len,
         suffix_eos,
         t,
-        window=cfg.sliding_window,
+        scale=cfg.attn_scale,
+        window=window,
+        softcap=cfg.attn_logit_softcap,
+        sliding=sliding,
     )
-    mid = x + _out_proj(params["attn"], attn_out)
-    h = rms_norm(mid, params["post_attention_layernorm"]["scale"], eps, cfg.norm_unit_offset)
-    return mid + _mlp(params["mlp"], h, cfg), kv
+    mid = _residual_attn(params, cfg, x, attn_out)
+    return _residual_mlp(params, cfg, mid), kv
 
 
 def select_eos_and_norm(
@@ -342,14 +428,19 @@ def select_eos_and_norm(
     return rms_norm(last, params["scale"], cfg.rms_norm_eps, cfg.norm_unit_offset)
 
 
-def lm_head_scores(params: Params, suffix_h: jax.Array) -> jax.Array:
+def lm_head_scores(
+    params: Params, suffix_h: jax.Array, softcap: float | None = None
+) -> jax.Array:
     """The reference's ``lm_head`` stage (``/root/reference/utils.py:287-290``):
     logits of the kept token, softmax -> next-token distribution.
 
-    suffix_h: [S, 1, D] -> float32 scores [S, V].
+    suffix_h: [S, 1, D] -> float32 scores [S, V]. ``softcap`` is Gemma2's
+    final-logit softcapping, applied before the softmax.
     """
-    logits = _mm(suffix_h, params["kernel"])[:, 0]
-    return jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    logits = _mm(suffix_h, params["kernel"])[:, 0].astype(jnp.float32)
+    if softcap is not None:
+        logits = jnp.tanh(logits / softcap) * softcap
+    return jax.nn.softmax(logits, axis=-1)
 
 
 # ---------------------------------------------------------------------------
@@ -377,19 +468,27 @@ def forward_full(
     b, l = ids.shape
     x = embed(params["embed"], ids, dtype, cfg)
     positions = jnp.arange(l)
-    mask = causal_mask(l, l, window=cfg.sliding_window)
+    full = causal_mask(l, l)
+    banded = causal_mask(l, l, window=cfg.sliding_window)
+    pattern = layer_sliding_pattern(cfg)
     layers = params["layers"]
     if isinstance(layers, (list, tuple)):
-        for lp in layers:
-            x = decoder_layer(lp, cfg, x, positions, mask)
+        for i, lp in enumerate(layers):
+            x = decoder_layer(lp, cfg, x, positions, banded if pattern[i] else full)
     else:  # stacked pytree with leading layer axis -> scan (one compile)
-        def body(h, layer_params):
+        flags = jnp.asarray(pattern)
+
+        def body(h, xs):
+            layer_params, s = xs
+            mask = jnp.where(s, banded, full)
             return decoder_layer(layer_params, cfg, h, positions, mask), None
 
-        x, _ = jax.lax.scan(body, x, layers)
+        x, _ = jax.lax.scan(body, x, (layers, flags))
     x = rms_norm(x, params["norm"]["scale"], cfg.rms_norm_eps, cfg.norm_unit_offset)
-    logits = _mm(x, head_params(params)["kernel"])
-    return logits.astype(jnp.float32)
+    logits = _mm(x, head_params(params)["kernel"]).astype(jnp.float32)
+    if cfg.final_logit_softcap is not None:
+        logits = jnp.tanh(logits / cfg.final_logit_softcap) * cfg.final_logit_softcap
+    return logits
 
 
 # ---------------------------------------------------------------------------
@@ -445,12 +544,16 @@ def init_layer_params(rng: jax.Array, cfg: LlamaConfig, dtype=jnp.float32) -> Pa
         }
         if cfg.mlp_bias:
             mlp |= {"bgate": bias(ks[11], f), "bup": bias(ks[12], f), "bdown": bias(ks[13], d)}
-    return {
+    out = {
         "input_layernorm": {"scale": jnp.ones((d,), dtype)},
         "post_attention_layernorm": {"scale": jnp.ones((d,), dtype)},
         "attn": attn,
         "mlp": mlp,
     }
+    if cfg.ffw_sandwich_norms:
+        out["pre_feedforward_layernorm"] = {"scale": jnp.ones((d,), dtype)}
+        out["post_feedforward_layernorm"] = {"scale": jnp.ones((d,), dtype)}
+    return out
 
 
 def init_params(rng: jax.Array, cfg: LlamaConfig, dtype=jnp.float32) -> Params:

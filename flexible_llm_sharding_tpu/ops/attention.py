@@ -28,12 +28,37 @@ def _grouped_q(q: jax.Array, n_kv: int) -> jax.Array:
     return q.reshape(*lead, lq, n_kv, n_q // n_kv, hd)
 
 
+def _softcap(scores: jax.Array, cap: float | None) -> jax.Array:
+    """Gemma2 attention-logit softcapping: cap * tanh(scores / cap), applied
+    to the scaled fp32 scores BEFORE the mask (HF eager_attention_forward
+    order: scale -> softcap -> mask -> softmax)."""
+    if cap is None:
+        return scores
+    return jnp.tanh(scores / cap) * cap
+
+
+def _window_clause(mask: jax.Array, dist: jax.Array, window: int | None, sliding):
+    """AND the sliding-window visibility into ``mask``.
+
+    ``sliding`` is None (window applies statically) or a traced bool scalar
+    (per-layer toggle under a scan — Gemma2's alternating local/global
+    layers): masked iff sliding AND dist >= window.
+    """
+    if window is None:
+        return mask
+    in_window = dist < window
+    if sliding is not None:
+        in_window = jnp.logical_or(jnp.logical_not(sliding), in_window)
+    return mask & in_window
+
+
 def attention(
     q: jax.Array,
     k: jax.Array,
     v: jax.Array,
     mask: jax.Array | None,
     scale: float | None = None,
+    softcap: float | None = None,
 ) -> jax.Array:
     """Scaled dot-product attention with GQA via grouped einsums.
 
@@ -52,7 +77,7 @@ def attention(
     qr = _grouped_q(q, n_kv)
     # [..., n_kv, g, Lq, Lk] in model dtype (MXU), softmax in fp32.
     scores = jnp.einsum("...qngh,...knh->...ngqk", qr, k, precision=_PRECISION)
-    scores = scores.astype(jnp.float32) * scale
+    scores = _softcap(scores.astype(jnp.float32) * scale, softcap)
     if mask is not None:
         scores = jnp.where(mask[..., None, None, :, :], scores, _NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
@@ -69,6 +94,8 @@ def prefix_shared_attention(
     prefix_len: jax.Array,
     scale: float | None = None,
     window: int | None = None,
+    softcap: float | None = None,
+    sliding=None,
 ) -> jax.Array:
     """Attention of S suffix continuations over [shared prefix KV ; own causal KV].
 
@@ -92,8 +119,9 @@ def prefix_shared_attention(
     qr = _grouped_q(q, n_kv)  # [S, Ls, n_kv, g, hd]
     scores_p = jnp.einsum("sqngh,knh->sngqk", qr, k_prefix, precision=_PRECISION)
     scores_s = jnp.einsum("sqngh,sknh->sngqk", qr, k_suffix, precision=_PRECISION)
-    scores = (
-        jnp.concatenate([scores_p, scores_s], axis=-1).astype(jnp.float32) * scale
+    scores = _softcap(
+        jnp.concatenate([scores_p, scores_s], axis=-1).astype(jnp.float32) * scale,
+        softcap,
     )  # [S, n_kv, g, Ls, Lp+Ls]
 
     # Prefix keys visible iff real; suffix keys causal. With a sliding
@@ -105,7 +133,7 @@ def prefix_shared_attention(
     mask = jnp.where(kj < lp, kj < prefix_len, (kj - lp) <= qi)  # [Ls, Lp+Ls]
     if window is not None:
         abs_k = jnp.where(kj < lp, kj, prefix_len + kj - lp)
-        mask &= (prefix_len + qi) - abs_k < window
+        mask = _window_clause(mask, (prefix_len + qi) - abs_k, window, sliding)
     scores = jnp.where(mask[None, None, None], scores, _NEG_INF)
 
     probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
@@ -130,6 +158,8 @@ def decode_attention(
     t: jax.Array,
     scale: float | None = None,
     window: int | None = None,
+    softcap: float | None = None,
+    sliding=None,
 ) -> jax.Array:
     """Single-token decode attention over three cached KV regions.
 
@@ -158,8 +188,8 @@ def decode_attention(
     sp = jnp.einsum("sqngh,knh->sngqk", qr, k_prefix, precision=_PRECISION)
     ss = jnp.einsum("sqngh,sknh->sngqk", qr, k_suffix, precision=_PRECISION)
     sg = jnp.einsum("sqngh,sknh->sngqk", qr, k_gen, precision=_PRECISION)
-    scores = (
-        jnp.concatenate([sp, ss, sg], axis=-1).astype(jnp.float32) * scale
+    scores = _softcap(
+        jnp.concatenate([sp, ss, sg], axis=-1).astype(jnp.float32) * scale, softcap
     )  # [S, n_kv, g, 1, Lp+Ls+T]
 
     jp = jnp.arange(lp)[None, :] < prefix_len  # [1, Lp]
@@ -190,7 +220,7 @@ def decode_attention(
             ],
             axis=-1,
         )  # [S, Lp+Ls+T]
-        mask &= q_pos - abs_k < window
+        mask = _window_clause(mask, q_pos - abs_k, window, sliding)
     scores = jnp.where(mask[:, None, None, None, :], scores, _NEG_INF)
 
     probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)

@@ -137,12 +137,16 @@ def layer_specs(tp: str | None = "tp", cfg: LlamaConfig | None = None) -> Params
             attn |= {"q_norm": rep, "k_norm": rep}  # [head_dim], tiny
         if cfg.mlp_bias and not cfg.num_local_experts:
             mlp |= {"bgate": bcol, "bup": bcol, "bdown": rep}
-    return {
+    out = {
         "input_layernorm": {"scale": rep},
         "post_attention_layernorm": {"scale": rep},
         "attn": attn,
         "mlp": mlp,
     }
+    if cfg is not None and cfg.ffw_sandwich_norms:
+        out["pre_feedforward_layernorm"] = {"scale": rep}
+        out["post_feedforward_layernorm"] = {"scale": rep}
+    return out
 
 
 def param_specs(
@@ -208,12 +212,19 @@ class TpPlacement:
             layer_specs("tp", cfg),
             is_leaf=lambda x: isinstance(x, P),
         )
-        # Stacked-scan decoder pytrees carry a leading [k] layer axis.
+        # Stacked-scan decoder pytrees carry a leading [k] layer axis, and
+        # ride inside a {"layers", "sliding"} wrapper (the per-layer window
+        # flags of Gemma2-style alternation; None when uniform).
         self._decoder = jax.tree.map(
             lambda s: NamedSharding(self.mesh, P(None, *s.spec)), rep
         )
         self._by_kind = {
-            "decoders": self._decoder,
+            "decoders": {
+                "layers": self._decoder,
+                "sliding": self.act
+                if cfg is not None and cfg.layer_sliding is not None
+                else None,
+            },
             # Embed/norm are small and read row-wise per token id; replicate.
             "embed": self.act,
             "norm": self.act,

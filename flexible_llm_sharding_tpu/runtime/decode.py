@@ -58,41 +58,54 @@ Params = dict[str, Any]
 # ---------------------------------------------------------------------------
 
 @partial(jax.jit, static_argnums=(0, 1), donate_argnums=(3, 4))
-def _prefill_decoders(cfg: LlamaConfig, use_pallas, stacked, prefix_h, suffix_h, prefix_len):
+def _prefill_decoders(cfg: LlamaConfig, use_pallas, seg, prefix_h, suffix_h, prefix_len):
     """Scan k layers over a block, emitting per-layer KV as scan outputs.
 
+    seg: {"layers": [k, ...] pytree, "sliding": bool [k] or None}.
     Returns (prefix_h, suffix_h, kv) with kv leaves shaped [k, B, ...].
     """
-    step = jax.vmap(
-        partial(llama.prefix_suffix_layer, use_pallas=use_pallas, return_kv=True),
-        in_axes=(None, None, 0, 0, 0),
-    )
+    stacked, flags = seg["layers"], seg["sliding"]
 
-    def body(carry, layer_params):
+    def body(carry, xs):
+        layer_params, sliding = xs
         p, s = carry
+        step = jax.vmap(
+            partial(
+                llama.prefix_suffix_layer,
+                use_pallas=use_pallas,
+                return_kv=True,
+                sliding=sliding,
+            ),
+            in_axes=(None, None, 0, 0, 0),
+        )
         p, s, kv = step(layer_params, cfg, p, s, prefix_len)
         return (p, s), kv
 
-    (prefix_h, suffix_h), kv = jax.lax.scan(body, (prefix_h, suffix_h), stacked)
+    (prefix_h, suffix_h), kv = jax.lax.scan(body, (prefix_h, suffix_h), (stacked, flags))
     return prefix_h, suffix_h, kv
 
 
 @partial(jax.jit, static_argnums=(0,), donate_argnums=(2, 3))
-def _decode_decoders(cfg: LlamaConfig, stacked, kv, x, prefix_len, suffix_eos, t):
+def _decode_decoders(cfg: LlamaConfig, seg, kv, x, prefix_len, suffix_eos, t):
     """Scan k layers' single-token decode over a block.
 
+    seg: {"layers": [k, ...] pytree, "sliding": bool [k] or None};
     kv: pytree with leaves [k, B, ...] (kg/vg slots < t filled); x [B, S, 1, D];
     prefix_len [B]; suffix_eos [B, S]; t scalar. Returns (x, kv updated at t).
     kv and x are donated — each step reuses the previous buffers.
     """
-    step = jax.vmap(llama.decode_step_layer, in_axes=(None, None, 0, 0, 0, 0, None))
+    stacked, flags = seg["layers"], seg["sliding"]
 
     def body(x, layer):
-        layer_params, layer_kv = layer
+        layer_params, sliding, layer_kv = layer
+        step = jax.vmap(
+            partial(llama.decode_step_layer, sliding=sliding),
+            in_axes=(None, None, 0, 0, 0, 0, None),
+        )
         x, layer_kv = step(layer_params, cfg, x, layer_kv, prefix_len, suffix_eos, t)
         return x, layer_kv
 
-    x, kv = jax.lax.scan(body, x, (stacked, kv))
+    x, kv = jax.lax.scan(body, x, (stacked, flags, kv))
     return x, kv
 
 
@@ -102,7 +115,10 @@ def _decode_norm_head(cfg: LlamaConfig, norm_params, head_params, x):
     from flexible_llm_sharding_tpu.ops import rms_norm
 
     h = rms_norm(x, norm_params["scale"], cfg.rms_norm_eps, cfg.norm_unit_offset)
-    return jax.vmap(llama.lm_head_scores, in_axes=(None, 0))(head_params, h)
+    return jax.vmap(
+        partial(llama.lm_head_scores, softcap=cfg.final_logit_softcap),
+        in_axes=(None, 0),
+    )(head_params, h)
 
 
 # ---------------------------------------------------------------------------
@@ -212,6 +228,7 @@ class DecodeGenerator:
             devices=self.shard_devices,
             prefetch_depth=self.cfg.prefetch_depth,
             tied_embeddings=self.model_cfg.tie_word_embeddings,
+            layer_sliding=self.model_cfg.layer_sliding,
         )
 
     def __call__(self, prompts, num_gen_token: int | None = None):
@@ -285,7 +302,7 @@ class DecodeGenerator:
                             sh = _norm_block(self.model_cfg, params, sh, suffix_eos)
                             ph = None
                         else:  # head
-                            dist = np.asarray(jax.device_get(_head_block(params, sh)))
+                            dist = np.asarray(jax.device_get(_head_block(self.model_cfg, params, sh)))
                             all_scores[b].append(dist)
                             tok_hist[b].append(np.argmax(dist, axis=-1))
                     if layer_idxs[-1] != n_layers - 1:

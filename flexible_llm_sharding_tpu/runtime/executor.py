@@ -78,26 +78,31 @@ def _embed_block(cfg: LlamaConfig, dtype, embed_params, prefix_ids, suffix_ids):
 
 @partial(jax.jit, static_argnums=(0, 5), donate_argnums=(2, 3))
 def _decoder_block(
-    cfg: LlamaConfig, stacked, prefix_h, suffix_h, prefix_len, use_pallas=False
+    cfg: LlamaConfig, seg, prefix_h, suffix_h, prefix_len, use_pallas=False
 ):
     """Scan k stacked decoder layers over a block of prompts.
 
-    stacked: layer pytree with leading [k] axis; prefix_h [B, Lp, D];
-    suffix_h [B, S, Ls, D]; prefix_len int32 [B]. Activations are donated —
-    each scan step's output reuses the input buffers. ``use_pallas`` (static)
-    routes attention through the flash kernels.
+    seg: {"layers": pytree with leading [k] axis, "sliding": bool [k] per-
+    layer window flags or None (uniform)}; prefix_h [B, Lp, D]; suffix_h
+    [B, S, Ls, D]; prefix_len int32 [B]. Activations are donated — each scan
+    step's output reuses the input buffers. ``use_pallas`` (static) routes
+    attention through the flash kernels.
     """
-    step = jax.vmap(
-        partial(llama.prefix_suffix_layer, use_pallas=use_pallas),
-        in_axes=(None, None, 0, 0, 0),
-    )
+    stacked, flags = seg["layers"], seg["sliding"]
 
-    def body(carry, layer_params):
+    def body(carry, xs):
+        layer_params, sliding = xs
         p, s = carry
+        step = jax.vmap(
+            partial(llama.prefix_suffix_layer, use_pallas=use_pallas, sliding=sliding),
+            in_axes=(None, None, 0, 0, 0),
+        )
         p, s = step(layer_params, cfg, p, s, prefix_len)
         return (p, s), None
 
-    (prefix_h, suffix_h), _ = jax.lax.scan(body, (prefix_h, suffix_h), stacked)
+    # flags may be None: scan treats it as an empty subtree, and the body's
+    # sliding arg arrives as None (the static uniform-window path).
+    (prefix_h, suffix_h), _ = jax.lax.scan(body, (prefix_h, suffix_h), (stacked, flags))
     return prefix_h, suffix_h
 
 
@@ -110,10 +115,14 @@ def _norm_block(cfg: LlamaConfig, norm_params, suffix_h, suffix_eos):
     )
 
 
-@jax.jit
-def _head_block(head_params, suffix_h):
-    """[B, S, 1, D] -> float32 scores [B, S, V] (``/root/reference/utils.py:287-290``)."""
-    return jax.vmap(llama.lm_head_scores, in_axes=(None, 0))(head_params, suffix_h)
+@partial(jax.jit, static_argnums=(0,))
+def _head_block(cfg: LlamaConfig, head_params, suffix_h):
+    """[B, S, 1, D] -> float32 scores [B, S, V] (``/root/reference/utils.py:287-290``);
+    applies Gemma2's final-logit softcap when the config carries one."""
+    return jax.vmap(
+        partial(llama.lm_head_scores, softcap=cfg.final_logit_softcap),
+        in_axes=(None, 0),
+    )(head_params, suffix_h)
 
 
 def process_block(
@@ -248,7 +257,7 @@ def apply_segments(
             suffix_h = _norm_block(model_cfg, params, suffix_h, suffix_eos)
             prefix_h = None
         else:  # head
-            block_scores = _head_block(params, suffix_h)
+            block_scores = _head_block(model_cfg, params, suffix_h)
     return prefix_h, suffix_h, block_scores
 
 
@@ -269,11 +278,12 @@ class _HostShardLoader:
     cast/stacked, so cold-cache disk latency overlaps host compute."""
 
     def __init__(self, model_path: str, layer_names: Sequence[str], np_dtype,
-                 tied_embeddings: bool = False):
+                 tied_embeddings: bool = False, layer_sliding=None):
         self.model_path = model_path
         self.layer_names = list(layer_names)
         self.np_dtype = np_dtype
         self.tied = tied_embeddings
+        self.layer_sliding = layer_sliding  # per-decoder window flags or None
         self.load_time = 0.0  # file->numpy wall time (cf. load_weights_time,
         # /root/reference/utils.py:223,304)
         from flexible_llm_sharding_tpu.utils.native import FilePrefetcher
@@ -312,6 +322,7 @@ class _HostShardLoader:
     def build_host_shard(self, layer_idxs: tuple[int, ...]) -> list[tuple[str, Any]]:
         segments: list[tuple[str, Any]] = []
         run: list[Params] = []
+        run_decoder_idx: list[int] = []
 
         def flush():
             if run:
@@ -323,8 +334,14 @@ class _HostShardLoader:
                     lambda *xs: xs[0][None] if len(xs) == 1 else np.stack(xs),
                     *run,
                 )
-                segments.append(("decoders", stacked))
+                flags = None
+                if self.layer_sliding is not None:
+                    flags = np.asarray(
+                        [self.layer_sliding[i] for i in run_decoder_idx], bool
+                    )
+                segments.append(("decoders", {"layers": stacked, "sliding": flags}))
                 run.clear()
+                run_decoder_idx.clear()
 
         t0 = time.perf_counter()
         for idx in layer_idxs:
@@ -332,6 +349,7 @@ class _HostShardLoader:
             params = self._cast(self._load_one(name))
             if name.startswith("model.layers."):
                 run.append(params)
+                run_decoder_idx.append(int(name.split(".")[2]))
             else:
                 flush()
                 kind = {
@@ -378,6 +396,7 @@ class ShardWeightSource:
         prefetch_depth: int = 1,
         tied_embeddings: bool = False,
         devices: Sequence | None = None,
+        layer_sliding=None,
     ):
         self.shards = list(shards)
         # Either one device for every shard, or (pipeline mode) one target
@@ -390,7 +409,7 @@ class ShardWeightSource:
         else:
             self.shard_devices = [device] * len(self.shards)
         self._loader = _HostShardLoader(
-            model_path, layer_names, np_dtype, tied_embeddings
+            model_path, layer_names, np_dtype, tied_embeddings, layer_sliding
         )
         self._q: Queue = Queue(maxsize=max(1, prefetch_depth))
         self._stop = threading.Event()
@@ -494,12 +513,13 @@ class BroadcastShardSource:
         prefetch_depth: int = 1,
         tied_embeddings: bool = False,
         rounds: int = 1,
+        layer_sliding=None,
     ):
         self.shards = list(shards)
         self.devices = list(devices)
         self.rounds = rounds
         self._loader = _HostShardLoader(
-            model_path, layer_names, np_dtype, tied_embeddings
+            model_path, layer_names, np_dtype, tied_embeddings, layer_sliding
         )
         depth = max(1, prefetch_depth)
         self._queues = [Queue(maxsize=depth) for _ in self.devices]
@@ -757,6 +777,7 @@ class StreamingExecutor:
                 device=self.device,
                 prefetch_depth=self.cfg.prefetch_depth,
                 tied_embeddings=self.model_cfg.tie_word_embeddings,
+                layer_sliding=self.model_cfg.layer_sliding,
             )
             skip = 0
 

@@ -171,11 +171,21 @@ class LongContextScorer:
     def __init__(self, cfg: FrameworkConfig, devices=None, tokenizer=None):
         self.cfg = cfg
         self.model_cfg = LlamaConfig.from_pretrained(cfg.model_path)
-        if self.model_cfg.sliding_window is not None:
+        mc = self.model_cfg
+        if (
+            mc.sliding_window is not None
+            or mc.ffw_sandwich_norms
+            or mc.attn_logit_softcap is not None
+            or mc.query_pre_attn_scalar is not None
+        ):
+            # This scorer's sharded attention implements full causal masks
+            # with the default scale and no softcap, and its layer tail uses
+            # the standard residual layout — accepting a config outside that
+            # envelope would return silently wrong scores.
             raise NotImplementedError(
-                "long_context ring attention implements full causal masks; "
-                "sliding-window models (mistral/qwen2 with use_sliding_window) "
-                "are not supported on this path"
+                "long_context ring attention supports full-causal, "
+                "default-scale, un-softcapped models; sliding-window / "
+                "gemma2-style configs are not supported on this path"
             )
         devices = list(devices) if devices else None
         self.mesh = make_mesh(
@@ -220,6 +230,7 @@ class LongContextScorer:
             device=self._rep,  # device_put accepts a Sharding: replicate
             prefetch_depth=self.cfg.prefetch_depth,
             tied_embeddings=self.model_cfg.tie_word_embeddings,
+            layer_sliding=self.model_cfg.layer_sliding,
         )
         stream = iter(source)
         try:
@@ -254,10 +265,13 @@ class LongContextScorer:
                     suffix_h = llama.embed(params, suffix_ids, self.dtype, self.model_cfg)
                 elif kind == "decoders":
                     # Unstack the [k, ...] scan pytree: each layer runs
-                    # as one jitted sharded step (shard_map inside).
-                    k_layers = jax.tree.leaves(params)[0].shape[0]
+                    # as one jitted sharded step (shard_map inside). The
+                    # scorer rejects windowed models at init, so the
+                    # wrapper's sliding flags are always None here.
+                    stacked = params["layers"]
+                    k_layers = jax.tree.leaves(stacked)[0].shape[0]
                     for i in range(k_layers):
-                        layer = jax.tree.map(lambda a: a[i], params)
+                        layer = jax.tree.map(lambda a: a[i], stacked)
                         prefix_x, suffix_h = self._layer_fn(
                             layer, prefix_x, suffix_h, prefix_len
                         )
@@ -267,7 +281,13 @@ class LongContextScorer:
                     )
                 else:  # head
                     scores = np.asarray(
-                        jax.device_get(llama.lm_head_scores(params, suffix_h))
+                        jax.device_get(
+                            llama.lm_head_scores(
+                                params,
+                                suffix_h,
+                                softcap=self.model_cfg.final_logit_softcap,
+                            )
+                        )
                     )
         return np.expand_dims(scores[: t.num_suffixes], axis=1)
 

@@ -187,6 +187,7 @@ def run_prompts(
         prefetch_depth=cfg.prefetch_depth,
         tied_embeddings=model_cfg.tie_word_embeddings,
         rounds=cfg.num_batch,
+        layer_sliding=model_cfg.layer_sliding,
     )
 
     def run_one(slot: int) -> list[np.ndarray]:
@@ -264,6 +265,7 @@ def run_decode(
         prefetch_depth=cfg.prefetch_depth,
         tied_embeddings=model_cfg.tie_word_embeddings,
         rounds=cfg.num_gen_token,
+        layer_sliding=model_cfg.layer_sliding,
     )
 
     def run_one(slot: int):

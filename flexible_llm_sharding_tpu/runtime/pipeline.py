@@ -153,6 +153,7 @@ class PipelineRunner:
             devices=stage_devs,
             prefetch_depth=self.cfg.prefetch_depth,
             tied_embeddings=self.model_cfg.tie_word_embeddings,
+            layer_sliding=self.model_cfg.layer_sliding,
         )
 
         n_layers = len(self.layer_names)

@@ -145,6 +145,9 @@ _LAYER_MAP_OPTIONAL = [
     ("attn.bo", "self_attn.o_proj.bias"),
     ("attn.q_norm", "self_attn.q_norm.weight"),  # qwen3 per-head-dim RMSNorm
     ("attn.k_norm", "self_attn.k_norm.weight"),
+    # gemma2 sandwich norms around the MLP
+    ("pre_feedforward_layernorm.scale", "pre_feedforward_layernorm.weight"),
+    ("post_feedforward_layernorm.scale", "post_feedforward_layernorm.weight"),
     ("mlp.bgate", "mlp.gate_proj.bias"),
     ("mlp.bup", "mlp.up_proj.bias"),
     ("mlp.bdown", "mlp.down_proj.bias"),
@@ -434,6 +437,11 @@ def save_params(params: dict[str, Any], out_dir: str, cfg: LlamaConfig) -> None:
         "hidden_act": cfg.hidden_act,
         "norm_unit_offset": cfg.norm_unit_offset,
         "embed_scale": cfg.embed_scale,
+        "ffw_sandwich_norms": cfg.ffw_sandwich_norms,
+        "attn_logit_softcap": cfg.attn_logit_softcap,
+        "final_logit_softcap": cfg.final_logit_softcap,
+        "query_pre_attn_scalar": cfg.query_pre_attn_scalar,
+        "layer_sliding": list(cfg.layer_sliding) if cfg.layer_sliding else None,
     }
     if cfg.explicit_head_dim is not None:
         hf_cfg["head_dim"] = cfg.explicit_head_dim

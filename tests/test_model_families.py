@@ -76,6 +76,29 @@ GEMMA_CFG = LlamaConfig(
     embed_scale=True,
 )
 
+GEMMA2_CFG = LlamaConfig(
+    model_type="gemma2",
+    vocab_size=256,
+    hidden_size=64,
+    intermediate_size=128,
+    num_hidden_layers=3,
+    num_attention_heads=4,
+    num_key_value_heads=2,
+    max_position_embeddings=512,
+    rms_norm_eps=1e-6,
+    tie_word_embeddings=True,
+    explicit_head_dim=32,
+    hidden_act="gelu_pytorch_tanh",
+    norm_unit_offset=True,
+    embed_scale=True,
+    ffw_sandwich_norms=True,
+    attn_logit_softcap=50.0,
+    final_logit_softcap=30.0,
+    query_pre_attn_scalar=64,  # != head_dim: exercises the custom scale
+    sliding_window=6,  # binds on 17-token sequences
+    layer_sliding=(True, False, True),  # gemma2 alternation
+)
+
 MIXTRAL_CFG = LlamaConfig(
     model_type="mixtral",
     vocab_size=256,
@@ -169,6 +192,44 @@ def test_save_params_config_roundtrip(tmp_path):
 # ---------------------------------------------------------------------------
 # Golden numerics vs HF
 # ---------------------------------------------------------------------------
+
+def test_gemma2_decode_generator_matches_oracle(tmp_path):
+    """DecodeGenerator on gemma2: the traced per-layer sliding flags flow as
+    scan xs through _prefill_decoders and _decode_decoders (the runtime path,
+    distinct from the static-bool decode_step_layer invariant test)."""
+    from flexible_llm_sharding_tpu.runtime.decode import DecodeGenerator
+
+    cfg = GEMMA2_CFG
+    params = llama.init_params(jax.random.PRNGKey(6), cfg)
+    d = tmp_path / "g2"
+    save_params(jax.tree.map(np.asarray, params), str(d), cfg)
+    assert LlamaConfig.from_pretrained(str(d)).layer_sliding == cfg.layer_sliding
+
+    prompts = [("The capital of France", (" is Paris", " is Rome"))]
+    n_gen = 3
+    fw = FrameworkConfig(
+        model_path=str(d),
+        layer_num_per_shard=1,
+        dtype="float32",
+        bucket_multiple=8,
+        prefetch_depth=0,
+        num_gen_token=n_gen,
+    )
+    gen = DecodeGenerator(fw, tokenizer=FakeTokenizer())
+    scores, _ = gen(prompts)
+
+    tok = PromptTokenizer(FakeTokenizer(), bucket_multiple=8)
+    t = tok(prompts[0][0], prompts[0][1])
+    for s in range(t.num_suffixes):
+        ids = np.concatenate(
+            [t.prefix_ids[: t.prefix_len], t.suffix_ids[s, : int(t.suffix_eos[s]) + 1]]
+        )
+        for g in range(n_gen):
+            logits = llama.forward_full(params, cfg, jnp.asarray(ids[None]))
+            want = np.asarray(jax.nn.softmax(logits[0, -1]))  # softcap inside
+            np.testing.assert_allclose(scores[0][s, g], want, rtol=2e-4, atol=1e-5)
+            ids = np.concatenate([ids, [int(want.argmax())]])
+
 
 def _hf_qwen2(cfg: LlamaConfig):
     from transformers import Qwen2Config, Qwen2ForCausalLM
@@ -408,9 +469,8 @@ def test_from_hf_gemma():
     # default here must be True or the executor asks for a lm_head file
     # that tied checkpoints never contain.
     assert cfg.tie_word_embeddings
-    for mt in ("gemma2", "gemma3"):
-        with pytest.raises(NotImplementedError):
-            LlamaConfig.from_hf_config({"model_type": mt})
+    with pytest.raises(NotImplementedError):
+        LlamaConfig.from_hf_config({"model_type": "gemma3"})
     # head_dim omitted (equals GemmaConfig's 256 class default) -> 256.
     cfg = LlamaConfig.from_hf_config(
         {"model_type": "gemma", "hidden_size": 3072, "num_attention_heads": 16}
@@ -479,6 +539,80 @@ def test_mixtral_split_and_expert_parallel(rng, tmp_path):
         np.testing.assert_allclose(single[0][s, 0], want, rtol=2e-4, atol=2e-5)
 
 
+def _hf_gemma2(cfg: LlamaConfig):
+    from transformers import Gemma2Config, Gemma2ForCausalLM
+
+    torch.manual_seed(0)
+    return Gemma2ForCausalLM(
+        Gemma2Config(
+            vocab_size=cfg.vocab_size,
+            hidden_size=cfg.hidden_size,
+            intermediate_size=cfg.intermediate_size,
+            num_hidden_layers=cfg.num_hidden_layers,
+            num_attention_heads=cfg.num_attention_heads,
+            num_key_value_heads=cfg.num_key_value_heads,
+            rms_norm_eps=cfg.rms_norm_eps,
+            rope_theta=cfg.rope_theta,
+            max_position_embeddings=cfg.max_position_embeddings,
+            tie_word_embeddings=True,
+            head_dim=cfg.head_dim,
+            hidden_activation="gelu_pytorch_tanh",
+            query_pre_attn_scalar=cfg.query_pre_attn_scalar,
+            attn_logit_softcapping=cfg.attn_logit_softcap,
+            final_logit_softcapping=cfg.final_logit_softcap,
+            sliding_window=cfg.sliding_window,
+            attn_implementation="eager",
+        )
+    ).eval()
+
+
+def test_gemma2_forward_matches_hf(rng):
+    """Gemma2's full delta set at once: alternating sliding/full layers (the
+    window binds at 17 tokens), attention + final logit softcapping,
+    query_pre_attn_scalar != head_dim, and the pre/post-feedforward sandwich
+    norms."""
+    model = _hf_gemma2(GEMMA2_CFG)
+    params = _params_from_hf(model, GEMMA2_CFG)
+    assert "pre_feedforward_layernorm" in params["layers"][0]
+    ids = rng.integers(0, GEMMA2_CFG.vocab_size, size=(2, 17))
+    with torch.no_grad():
+        hf_logits = model(torch.tensor(ids)).logits.numpy()
+    ours = np.asarray(llama.forward_full(params, GEMMA2_CFG, jnp.asarray(ids)))
+    np.testing.assert_allclose(ours, hf_logits, rtol=2e-4, atol=2e-4)
+
+
+def test_gemma2_stacked_scan_matches_list(rng):
+    """The alternating window pattern must survive the stacked-scan layout
+    (per-layer flags as scan xs selecting banded vs full masks)."""
+    params = llama.init_params(jax.random.PRNGKey(5), GEMMA2_CFG)
+    ids = jnp.asarray(rng.integers(0, GEMMA2_CFG.vocab_size, size=(1, 15)))
+    stacked = dict(params)
+    stacked["layers"] = jax.tree.map(lambda *xs: jnp.stack(xs), *params["layers"])
+    a = llama.forward_full(params, GEMMA2_CFG, ids)
+    b = llama.forward_full(stacked, GEMMA2_CFG, ids)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-5)
+
+
+def test_from_hf_gemma2():
+    cfg = LlamaConfig.from_hf_config(
+        {"model_type": "gemma2", "num_hidden_layers": 4, "hidden_size": 64}
+    )
+    assert cfg.ffw_sandwich_norms and cfg.norm_unit_offset
+    assert cfg.attn_logit_softcap == 50.0 and cfg.final_logit_softcap == 30.0
+    assert cfg.query_pre_attn_scalar == 256 and cfg.head_dim == 256
+    assert cfg.sliding_window == 4096
+    assert cfg.layer_sliding == (True, False, True, False)  # HF alternation
+    # Uniform patterns collapse to the plain window field.
+    cfg = LlamaConfig.from_hf_config(
+        {
+            "model_type": "gemma2",
+            "num_hidden_layers": 2,
+            "layer_types": ["full_attention", "full_attention"],
+        }
+    )
+    assert cfg.sliding_window is None and cfg.layer_sliding is None
+
+
 def test_qwen2_forward_matches_hf(rng):
     model = _hf_qwen2(QWEN2_CFG)
     params = _params_from_hf(model, QWEN2_CFG)
@@ -528,16 +662,19 @@ def _stream_scores(params, cfg, prefix_ids, suffix_ids_list, lp_bucket):
     ph = llama.embed(params["embed"], jnp.asarray(prefix_padded), jnp.float32, cfg)
     sh = llama.embed(params["embed"], jnp.asarray(suffix_padded), jnp.float32, cfg)
     plen = jnp.asarray(len(prefix_ids), jnp.int32)
-    for layer in params["layers"]:
-        ph, sh = llama.prefix_suffix_layer(layer, cfg, ph, sh, plen)
+    pattern = llama.layer_sliding_pattern(cfg)
+    for layer, sliding in zip(params["layers"], pattern):
+        ph, sh = llama.prefix_suffix_layer(layer, cfg, ph, sh, plen, sliding=sliding)
     normed = llama.select_eos_and_norm(params["norm"], cfg, sh, suffix_eos)
-    return llama.lm_head_scores(llama.head_params(params), normed)
+    return llama.lm_head_scores(
+        llama.head_params(params), normed, softcap=cfg.final_logit_softcap
+    )
 
 
 @pytest.mark.parametrize(
     "cfg",
-    [QWEN2_CFG, MISTRAL_CFG, MIXTRAL_CFG, QWEN3_CFG, GEMMA_CFG],
-    ids=["qwen2", "mistral", "mixtral", "qwen3", "gemma"],
+    [QWEN2_CFG, MISTRAL_CFG, MIXTRAL_CFG, QWEN3_CFG, GEMMA_CFG, GEMMA2_CFG],
+    ids=["qwen2", "mistral", "mixtral", "qwen3", "gemma", "gemma2"],
 )
 def test_streaming_matches_monolithic(cfg, rng):
     """The reference invariant, for each family: layerwise prefix-KV streaming
@@ -560,8 +697,8 @@ def test_streaming_matches_monolithic(cfg, rng):
 
 @pytest.mark.parametrize(
     "cfg",
-    [QWEN2_CFG, MISTRAL_CFG, MIXTRAL_CFG, QWEN3_CFG, GEMMA_CFG],
-    ids=["qwen2", "mistral", "mixtral", "qwen3", "gemma"],
+    [QWEN2_CFG, MISTRAL_CFG, MIXTRAL_CFG, QWEN3_CFG, GEMMA_CFG, GEMMA2_CFG],
+    ids=["qwen2", "mistral", "mixtral", "qwen3", "gemma", "gemma2"],
 )
 def test_decode_step_matches_monolithic(cfg, rng):
     """KV-cache decode with biases / a binding sliding window: each generated
@@ -581,8 +718,11 @@ def test_decode_step_matches_monolithic(cfg, rng):
     ph = llama.embed(params["embed"], jnp.asarray(prefix_padded), jnp.float32, cfg)
     sh = llama.embed(params["embed"], jnp.asarray(suffix_ids[None, :]), jnp.float32, cfg)
     kvs = []
-    for layer in params["layers"]:
-        ph, sh, kv = llama.prefix_suffix_layer(layer, cfg, ph, sh, plen, return_kv=True)
+    pattern = llama.layer_sliding_pattern(cfg)
+    for layer, sliding in zip(params["layers"], pattern):
+        ph, sh, kv = llama.prefix_suffix_layer(
+            layer, cfg, ph, sh, plen, return_kv=True, sliding=sliding
+        )
         n_kv, hd = cfg.num_key_value_heads, cfg.head_dim
         kv["kg"] = jnp.zeros((1, tmax, n_kv, hd))
         kv["vg"] = jnp.zeros((1, tmax, n_kv, hd))
@@ -593,21 +733,32 @@ def test_decode_step_matches_monolithic(cfg, rng):
         params["norm"], cfg, sh, jnp.asarray([len(suffix_ids) - 1])
     )
     next_id = int(
-        np.argmax(np.asarray(llama.lm_head_scores(llama.head_params(params), normed))[0])
+        np.argmax(
+            np.asarray(
+                llama.lm_head_scores(
+                    llama.head_params(params), normed, softcap=cfg.final_logit_softcap
+                )
+            )[0]
+        )
     )
     for t in range(tmax):
         gen.append(next_id)
         x = llama.embed(params["embed"], jnp.asarray([[next_id]]), jnp.float32, cfg)
         for li, layer in enumerate(params["layers"]):
             x, kvs[li] = llama.decode_step_layer(
-                layer, cfg, x, kvs[li], plen, suffix_eos, jnp.asarray(t, jnp.int32)
+                layer, cfg, x, kvs[li], plen, suffix_eos,
+                jnp.asarray(t, jnp.int32), sliding=pattern[li],
             )
         from flexible_llm_sharding_tpu.ops import rms_norm
 
         normed = rms_norm(
             x, params["norm"]["scale"], cfg.rms_norm_eps, cfg.norm_unit_offset
         )
-        scores = np.asarray(llama.lm_head_scores(llama.head_params(params), normed))[0]
+        scores = np.asarray(
+            llama.lm_head_scores(
+                llama.head_params(params), normed, softcap=cfg.final_logit_softcap
+            )
+        )[0]
 
         full = np.concatenate([prefix_ids, suffix_ids, np.asarray(gen)])[None, :]
         logits = llama.forward_full(params, cfg, jnp.asarray(full))
@@ -675,8 +826,8 @@ def test_splitter_carries_biases(tmp_path):
 
 @pytest.mark.parametrize(
     "cfg",
-    [QWEN2_CFG, MISTRAL_CFG, MIXTRAL_CFG, QWEN3_CFG, GEMMA_CFG],
-    ids=["qwen2", "mistral", "mixtral", "qwen3", "gemma"],
+    [QWEN2_CFG, MISTRAL_CFG, MIXTRAL_CFG, QWEN3_CFG, GEMMA_CFG, GEMMA2_CFG],
+    ids=["qwen2", "mistral", "mixtral", "qwen3", "gemma", "gemma2"],
 )
 def test_executor_end_to_end(cfg, rng, tmp_path):
     """The full streaming executor on a biased / sliding-window model:

@@ -112,6 +112,8 @@ def test_tp_placement_specs():
     """Column/row layout sanity: wq sharded on out, wo on in, head on vocab."""
     pl = TpPlacement(jax.devices()[:2])
     dec = pl.segment_target("decoders")
+    assert dec["sliding"] is None  # uniform-window models carry no flags
+    dec = dec["layers"]
     assert dec["attn"]["wq"].spec == jax.sharding.PartitionSpec(None, None, "tp")
     assert dec["attn"]["wo"].spec == jax.sharding.PartitionSpec(None, "tp", None)
     assert dec["mlp"]["down"].spec == jax.sharding.PartitionSpec(None, "tp", None)
