@@ -366,6 +366,25 @@ def run_bench(result: dict) -> None:
 
     if on_tpu:
         try:
+            # int8 weight streaming: same workload, half the bytes over the
+            # host->HBM link (the binding constraint of this design) with
+            # on-device dequant. The ratio quantifies the opt-in
+            # transfer-compression mode.
+            from flexible_llm_sharding_tpu.utils.checkpoint import requantize_native
+
+            q8_path = model_path + "-int8"
+            if not os.path.exists(os.path.join(q8_path, "config.json")):
+                requantize_native(model_path, q8_path)
+            import dataclasses
+
+            q8_cfg = dataclasses.replace(fw(2), model_path=q8_path)
+            run_once(q8_cfg, prompts, tok)  # warm/compile
+            _, wall_q8, _ = run_once(q8_cfg, prompts, tok)
+            log(f"int8 stream: wall={wall_q8:.2f}s (bf16 {wall_overlap:.2f}s)")
+            result["int8_speedup"] = round(wall_overlap / wall_q8, 3)
+        except Exception:
+            log("int8 bench failed:\n" + traceback.format_exc())
+        try:
             bench_pallas(jax, result)
         except Exception:
             log("pallas bench failed:\n" + traceback.format_exc())

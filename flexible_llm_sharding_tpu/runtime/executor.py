@@ -284,6 +284,7 @@ class _HostShardLoader:
         self.np_dtype = np_dtype
         self.tied = tied_embeddings
         self.layer_sliding = layer_sliding  # per-decoder window flags or None
+        self._tied_head: Params | None = None
         self.load_time = 0.0  # file->numpy wall time (cf. load_weights_time,
         # /root/reference/utils.py:223,304)
         from flexible_llm_sharding_tpu.utils.native import FilePrefetcher
@@ -307,17 +308,39 @@ class _HostShardLoader:
 
     def _load_one(self, name: str) -> Params:
         if name == "lm_head" and self.tied:
+            if self._tied_head is not None:
+                return self._tied_head
             emb = checkpoint.load_layer(self.model_path, "model.embed_tokens")
-            return {"kernel": np.ascontiguousarray(emb["embedding"].T)}
+            e = emb["embedding"]
+            if checkpoint.is_quantized_leaf(e):
+                # int8 checkpoints carry per-D scales on [V, D]; the head
+                # kernel [D, V] needs per-V channels, so requantize the
+                # transpose to keep the transfer int8 (second quantization
+                # of already-quantized values — error stays at the int8
+                # level). Cached: weights are immutable for the loader's
+                # lifetime, and the decode loop re-streams lm_head every
+                # token — a dequant+transpose+requant of [V, D] per token
+                # would land on the hot path.
+                q, s = checkpoint._quantize_int8(
+                    np.ascontiguousarray(checkpoint.dequantize_np(e).T)
+                )
+                self._tied_head = {"kernel": {"q8": q, "s": s}}
+            else:
+                self._tied_head = {"kernel": np.ascontiguousarray(e.T)}
+            return self._tied_head
         return checkpoint.load_layer(self.model_path, name)
 
     def _cast(self, tree: Params) -> Params:
-        return jax.tree.map(
-            lambda a: a.astype(self.np_dtype)
-            if _is_floating(a) and a.dtype != self.np_dtype
-            else a,
-            tree,
-        )
+        def one(a):
+            if checkpoint.is_quantized_leaf(a):
+                return a  # int8 payload + fp32 scale travel as stored
+            return (
+                a.astype(self.np_dtype)
+                if _is_floating(a) and a.dtype != self.np_dtype
+                else a
+            )
+
+        return jax.tree.map(one, tree, is_leaf=checkpoint.is_quantized_leaf)
 
     def build_host_shard(self, layer_idxs: tuple[int, ...]) -> list[tuple[str, Any]]:
         segments: list[tuple[str, Any]] = []
@@ -363,16 +386,62 @@ class _HostShardLoader:
         return segments
 
 
-def _place(segments: list[tuple[str, Any]], device) -> list[tuple[str, Any]]:
-    if hasattr(device, "segment_target"):  # TpPlacement: per-kind shardings
-        return [
-            (kind, jax.device_put(p, device.segment_target(kind)))
-            for kind, p in segments
-        ]
-    return [
-        (kind, jax.device_put(p, device) if device else jax.device_put(p))
-        for kind, p in segments
-    ]
+@partial(jax.jit, static_argnums=(1,))
+def _dequant_tree(tree, np_dtype_name: str):
+    """On-device dequantize of every {"q8","s"} leaf-group: int8 crossed the
+    host->HBM link (half the bf16 bytes — the transfer is the streaming
+    bottleneck); one fused kernel expands to the compute dtype in HBM. (No
+    donation: int8 buffers cannot alias the wider outputs anyway; they free
+    as soon as the caller drops the pre-dequant reference.)"""
+    target = jnp.dtype(np_dtype_name)
+
+    def one(n):
+        if not checkpoint.is_quantized_leaf(n):
+            return n
+        q, sc = n["q8"], n["s"]
+        if sc.ndim == 1:
+            # As stored: q [*dims, out], scale [out] — channels trail.
+            shape = (1,) * (q.ndim - 1) + sc.shape
+        else:
+            # Loader-stacked: q [k, *dims, out], scale [k, out].
+            shape = (sc.shape[0],) + (1,) * (q.ndim - 2) + (sc.shape[-1],)
+        return (q.astype(jnp.float32) * sc.reshape(shape)).astype(target)
+
+    return jax.tree.map(one, tree, is_leaf=checkpoint.is_quantized_leaf)
+
+
+def _has_quantized(tree) -> bool:
+    found = False
+
+    def probe(n):
+        nonlocal found
+        found = found or checkpoint.is_quantized_leaf(n)
+        return n
+
+    jax.tree.map(probe, tree, is_leaf=checkpoint.is_quantized_leaf)
+    return found
+
+
+def _place(
+    segments: list[tuple[str, Any]], device, np_dtype=None
+) -> list[tuple[str, Any]]:
+    out = []
+    tp = hasattr(device, "segment_target")  # TpPlacement: per-kind shardings
+    for kind, p in segments:
+        quant = _has_quantized(p)
+        if quant and tp:
+            raise NotImplementedError(
+                "int8-compressed checkpoints are not supported with "
+                "--tensor_parallel yet (requantize to bf16, or run TP off)"
+            )
+        if tp:
+            d = jax.device_put(p, device.segment_target(kind))
+        else:
+            d = jax.device_put(p, device) if device else jax.device_put(p)
+        if quant:
+            d = _dequant_tree(d, np.dtype(np_dtype or np.float32).name)
+        out.append((kind, d))
+    return out
 
 
 class ShardWeightSource:
@@ -446,7 +515,11 @@ class ShardWeightSource:
     def _build_shard(
         self, layer_idxs: tuple[int, ...], device
     ) -> list[tuple[str, Any]]:
-        return _place(self._loader.build_host_shard(layer_idxs), device)
+        return _place(
+            self._loader.build_host_shard(layer_idxs),
+            device,
+            np_dtype=self._loader.np_dtype,
+        )
 
     # -- prefetch thread ---------------------------------------------------
     def _put(self, item) -> bool:
@@ -558,7 +631,9 @@ class BroadcastShardSource:
                 for rank, dev in enumerate(self.devices):
                     # device_put is async — the transfers to the N chips
                     # overlap each other and the chips' compute.
-                    if not self._put(rank, _place(host, dev)):
+                    if not self._put(
+                        rank, _place(host, dev, np_dtype=self._loader.np_dtype)
+                    ):
                         return
 
     def view(self, rank: int) -> "_BroadcastView":

@@ -49,6 +49,54 @@ from flexible_llm_sharding_tpu.config import LlamaConfig
 LAYER_FILE_SUFFIX = ".safetensors"
 NATIVE_LAYOUT_MARKER = "fls_tpu_layout.json"
 
+# int8 weight compression: a quantized tensor is stored as `{key}` (int8)
+# plus `{key}::scale` (float32, one scale per output channel = the last axis
+# of the native [in, out] layout); load_layer regroups the pair into a
+# {"q8", "s"} leaf-group that the executor dequantizes ON DEVICE after the
+# host->HBM transfer — the link carries half the bytes, which is the whole
+# point in the transfer-bound streaming regime. Opt-in
+# (``split_into_layers(dtype='int8')``), approximate (symmetric per-channel
+# round-to-nearest), and self-describing via the layout marker.
+QUANT_SCALE_SUFFIX = "::scale"
+
+
+def _quantize_int8(w: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """Symmetric per-output-channel int8: returns (q [same shape], scale
+    [out]). Channels = the LAST axis of the native [in, out] layout."""
+    w32 = np.asarray(w, np.float32)
+    amax = np.max(np.abs(w32), axis=tuple(range(w32.ndim - 1)))
+    scale = np.maximum(amax, 1e-12).astype(np.float32) / 127.0
+    q = np.clip(np.rint(w32 / scale), -127, 127).astype(np.int8)
+    return q, scale
+
+
+def _quantize_flat(sd: dict[str, np.ndarray]) -> dict[str, np.ndarray]:
+    """int8-encode one flat native state dict: matmul kernels (>= 2-D
+    floats) quantize per output channel and gain a ::scale twin; 1-D
+    tensors (norm scales, biases) are tiny and stay exact in float32.
+    The single rule shared by split_into_layers and requantize_native."""
+    qd: dict[str, np.ndarray] = {}
+    for k, v in sd.items():
+        v = np.asarray(v)
+        if v.ndim >= 2 and (
+            np.issubdtype(v.dtype, np.floating) or v.dtype == _BFLOAT16
+        ):
+            q, sc = _quantize_int8(v)
+            qd[k] = q
+            qd[k + QUANT_SCALE_SUFFIX] = sc
+        else:
+            qd[k] = np.asarray(v, np.float32) if v.dtype == _BFLOAT16 else v
+    return qd
+
+
+def is_quantized_leaf(node) -> bool:
+    return isinstance(node, dict) and set(node) == {"q8", "s"}
+
+
+def dequantize_np(node: dict[str, np.ndarray]) -> np.ndarray:
+    """Host-side dequantize of one {"q8","s"} leaf-group (float32)."""
+    return np.asarray(node["q8"], np.float32) * node["s"]
+
 # ---------------------------------------------------------------------------
 # Key grouping — the reference's rule (/root/reference/prepare_weights.py:21)
 # ---------------------------------------------------------------------------
@@ -282,10 +330,15 @@ def split_into_layers(
         key=lambda l: (min(shard_ids[s] for s in layer2shards[l]), len(layer2shards[l])),
     )
 
+    quantize = dtype == "int8"
+    if quantize and layout != "native":
+        raise ValueError("dtype='int8' requires layout='native'")
     if dtype == "bfloat16":
         if _BFLOAT16 is None:
             raise ImportError("dtype='bfloat16' requires ml_dtypes")
         cast = _BFLOAT16
+    elif quantize:
+        cast = None  # quantized below, after the native-layout conversion
     else:
         cast = np.dtype(dtype) if dtype is not None else None
 
@@ -306,6 +359,8 @@ def split_into_layers(
             sd = {k: np.asarray(v, dtype=cast) if np.issubdtype(np.asarray(v).dtype, np.floating) or v.dtype == _BFLOAT16 else v for k, v in sd.items()}
         if layout == "native":
             sd = hf_layer_to_native(layer, sd)
+        if quantize:
+            sd = _quantize_flat(sd)
         st_save_file(
             {k: np.ascontiguousarray(v) for k, v in sd.items()},
             os.path.join(out_dir, f"{layer}{LAYER_FILE_SUFFIX}"),
@@ -381,13 +436,50 @@ def _mmap_safetensors(path: str) -> dict[str, np.ndarray]:
 
 def load_layer(model_path: str, layer_name: str) -> dict[str, Any]:
     """Load one layer file into a native-layout parameter pytree (numpy;
-    zero-copy mmap views where the file is already native layout)."""
+    zero-copy mmap views where the file is already native layout). int8-
+    compressed tensors come back as {"q8", "s"} leaf-groups, still int8 —
+    dequantization happens on device, after the transfer."""
     flat = _mmap_safetensors(
         os.path.join(model_path, f"{layer_name}{LAYER_FILE_SUFFIX}")
     )
     if not _is_native(flat.keys()):
         flat = hf_layer_to_native(layer_name, flat)
+    if any(k.endswith(QUANT_SCALE_SUFFIX) for k in flat):
+        grouped: dict[str, Any] = {}
+        for k, v in flat.items():
+            if k.endswith(QUANT_SCALE_SUFFIX):
+                continue
+            sk = k + QUANT_SCALE_SUFFIX
+            grouped[k] = {"q8": v, "s": flat[sk]} if sk in flat else v
+        flat = grouped
     return native_to_pytree(layer_name, flat)
+
+
+def requantize_native(src_dir: str, out_dir: str) -> list[str]:
+    """Re-encode an existing NATIVE per-layer checkpoint dir as int8
+    (per-output-channel, same convention as ``split_into_layers(dtype='int8')``)
+    without going back through the HF source. Copies aux files (config.json,
+    tokenizer) alongside. Returns the layer names converted."""
+    os.makedirs(out_dir, exist_ok=True)
+    done = []
+    for fn in sorted(os.listdir(src_dir)):
+        src = os.path.join(src_dir, fn)
+        if not fn.endswith(LAYER_FILE_SUFFIX):
+            if os.path.isfile(src) and fn != NATIVE_LAYOUT_MARKER:
+                shutil.copy(src, os.path.join(out_dir, fn))
+            continue
+        flat = _mmap_safetensors(src)
+        if not _is_native(flat.keys()):
+            raise ValueError(f"{fn}: not native layout (run split_into_layers)")
+        qd = _quantize_flat(flat)
+        st_save_file(
+            {k: np.ascontiguousarray(v) for k, v in qd.items()},
+            os.path.join(out_dir, fn),
+        )
+        done.append(fn[: -len(LAYER_FILE_SUFFIX)])
+    with open(os.path.join(out_dir, NATIVE_LAYOUT_MARKER), "w") as f:
+        json.dump({"layout": "native", "dtype": "int8", "layers": done}, f)
+    return done
 
 
 def save_params(params: dict[str, Any], out_dir: str, cfg: LlamaConfig) -> None:

@@ -1,0 +1,287 @@
+"""int8 weight-streaming: the opt-in transfer-compression mode.
+
+The streaming executor is transfer-bound by design (weights cross the
+host->HBM link once per shard per batch); ``split_into_layers(dtype='int8')``
+halves the bytes on that link and the executor dequantizes on device after
+the transfer. These tests pin the machinery exactly (int8-streamed scores ==
+monolithic forward of the host-dequantized network) and the quantization
+quality loosely (close to fp32 on a tiny model). No reference equivalent —
+the reference streams fp16 only."""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from flexible_llm_sharding_tpu.config import FrameworkConfig, LlamaConfig
+from flexible_llm_sharding_tpu.models import llama
+from flexible_llm_sharding_tpu.runtime.executor import StreamingExecutor
+from flexible_llm_sharding_tpu.runtime.tokenization import PromptTokenizer
+from flexible_llm_sharding_tpu.utils import checkpoint as ckpt
+from flexible_llm_sharding_tpu.utils.checkpoint import save_params
+
+from tests.fake_tokenizer import FakeTokenizer
+
+PROMPTS = [
+    ("The capital of France", (" is Paris", " is Rome")),
+    ("Two plus two equals", (" four", " five", " fish")),
+]
+
+
+def _write_hf_checkpoint(params, cfg: LlamaConfig, path: str) -> None:
+    """Flat HF-keyed single-file checkpoint from a native params pytree
+    (kernels transposed back to HF's [out, in])."""
+    import json
+
+    from safetensors.numpy import save_file
+
+    sd = {
+        "model.embed_tokens.weight": np.asarray(params["embed"]["embedding"]),
+        "model.norm.weight": np.asarray(params["norm"]["scale"]),
+    }
+    if not cfg.tie_word_embeddings:
+        sd["lm_head.weight"] = np.ascontiguousarray(
+            np.asarray(params["lm_head"]["kernel"]).T
+        )
+    hf_sub = {
+        "attn.wq": "self_attn.q_proj.weight",
+        "attn.wk": "self_attn.k_proj.weight",
+        "attn.wv": "self_attn.v_proj.weight",
+        "attn.wo": "self_attn.o_proj.weight",
+        "mlp.gate": "mlp.gate_proj.weight",
+        "mlp.up": "mlp.up_proj.weight",
+        "mlp.down": "mlp.down_proj.weight",
+    }
+    for i, layer in enumerate(params["layers"]):
+        p = f"model.layers.{i}"
+        sd[f"{p}.input_layernorm.weight"] = np.asarray(layer["input_layernorm"]["scale"])
+        sd[f"{p}.post_attention_layernorm.weight"] = np.asarray(
+            layer["post_attention_layernorm"]["scale"]
+        )
+        for nk, hk in hf_sub.items():
+            a, b = nk.split(".")
+            sd[f"{p}.{hk}"] = np.ascontiguousarray(np.asarray(layer[a][b]).T)
+    os.makedirs(path, exist_ok=True)
+    save_file(sd, os.path.join(path, "model.safetensors"))
+    with open(os.path.join(path, "config.json"), "w") as f:
+        json.dump(
+            {
+                "model_type": "llama",
+                "vocab_size": cfg.vocab_size,
+                "hidden_size": cfg.hidden_size,
+                "intermediate_size": cfg.intermediate_size,
+                "num_hidden_layers": cfg.num_hidden_layers,
+                "num_attention_heads": cfg.num_attention_heads,
+                "num_key_value_heads": cfg.num_key_value_heads,
+                "rms_norm_eps": cfg.rms_norm_eps,
+                "tie_word_embeddings": cfg.tie_word_embeddings,
+            },
+            f,
+        )
+
+
+@pytest.fixture(scope="module")
+def dirs(tiny_cfg, tmp_path_factory):
+    """(fp32_native_dir, int8_dir, params)."""
+    params = llama.init_params(jax.random.PRNGKey(0), tiny_cfg)
+    base = tmp_path_factory.mktemp("q8")
+    f32 = base / "f32"
+    save_params(jax.tree.map(np.asarray, params), str(f32), tiny_cfg)
+    hf = base / "hf"
+    _write_hf_checkpoint(params, tiny_cfg, str(hf))
+    q8 = base / "q8"
+    ckpt.split_into_layers(str(hf), str(q8), dtype="int8")
+    return str(f32), str(q8), params
+
+
+def _dequantized_params(q8_dir: str, cfg: LlamaConfig):
+    names = ckpt.layer_names_for(cfg.num_hidden_layers, cfg.tie_word_embeddings)
+    deq = lambda t: jax.tree.map(  # noqa: E731
+        lambda n: ckpt.dequantize_np(n) if ckpt.is_quantized_leaf(n) else n,
+        t,
+        is_leaf=ckpt.is_quantized_leaf,
+    )
+    out = {
+        "embed": deq(ckpt.load_layer(q8_dir, "model.embed_tokens")),
+        "layers": [
+            deq(ckpt.load_layer(q8_dir, f"model.layers.{i}"))
+            for i in range(cfg.num_hidden_layers)
+        ],
+        "norm": deq(ckpt.load_layer(q8_dir, "model.norm")),
+    }
+    if "lm_head" in names:
+        out["lm_head"] = deq(ckpt.load_layer(q8_dir, "lm_head"))
+    return jax.tree.map(jnp.asarray, out)
+
+
+def test_int8_files_half_the_bytes(dirs, tiny_cfg):
+    f32, q8, _ = dirs
+    name = "model.layers.0.safetensors"
+    a, b = os.path.getsize(os.path.join(f32, name)), os.path.getsize(
+        os.path.join(q8, name)
+    )
+    assert b < 0.30 * a  # int8 payload + fp32 scales vs fp32 payload
+    layer = ckpt.load_layer(q8, "model.layers.0")
+    assert ckpt.is_quantized_leaf(layer["attn"]["wq"])
+    assert layer["attn"]["wq"]["q8"].dtype == np.int8
+    # 1-D tensors stay exact.
+    assert not ckpt.is_quantized_leaf(layer["input_layernorm"]["scale"])
+
+
+def test_int8_streaming_matches_dequantized_oracle(dirs, tiny_cfg, tmp_path):
+    """The machinery invariant, EXACT: streaming the int8 checkpoint (int8
+    over the link, on-device dequant) must equal the monolithic forward of
+    the same network dequantized on host."""
+    _, q8, _ = dirs
+    fw = FrameworkConfig(
+        model_path=q8,
+        dtype="float32",
+        bucket_multiple=8,
+        layer_num_per_shard=1,
+        prefetch_depth=1,
+    )
+    got = StreamingExecutor(fw, tokenizer=FakeTokenizer())(PROMPTS)
+
+    params_deq = _dequantized_params(q8, tiny_cfg)
+    tok = PromptTokenizer(FakeTokenizer(), bucket_multiple=8)
+    for (prefix, suffixes), sc in zip(PROMPTS, got):
+        t = tok(prefix, suffixes)
+        for s in range(t.num_suffixes):
+            n_real = int(t.suffix_eos[s]) + 1
+            full = np.concatenate(
+                [t.prefix_ids[: t.prefix_len], t.suffix_ids[s, :n_real]]
+            )[None, :]
+            logits = llama.forward_full(params_deq, tiny_cfg, jnp.asarray(full))
+            want = np.asarray(jax.nn.softmax(logits[0, -1]))
+            np.testing.assert_allclose(sc[s, 0], want, rtol=2e-4, atol=2e-5)
+
+
+def test_int8_close_to_fp32(dirs, tiny_cfg):
+    """Quality smoke: per-channel int8 stays close to the fp32 scores."""
+    f32, q8, _ = dirs
+    def run(path):
+        fw = FrameworkConfig(
+            model_path=path, dtype="float32", bucket_multiple=8, prefetch_depth=0
+        )
+        return StreamingExecutor(fw, tokenizer=FakeTokenizer())(PROMPTS)
+
+    a, b = run(f32), run(q8)
+    for x, y in zip(a, b):
+        assert float(np.abs(x - y).max()) < 0.05
+
+
+def test_int8_tied_embeddings(tiny_cfg, tmp_path):
+    """Tied models requantize the transposed embedding for the head (per-V
+    channels) — streamed scores still match the host-dequantized oracle."""
+    import dataclasses
+
+    cfg = dataclasses.replace(tiny_cfg, tie_word_embeddings=True)
+    params = llama.init_params(jax.random.PRNGKey(1), cfg)
+    hf = tmp_path / "hf"
+    _write_hf_checkpoint(params, cfg, str(hf))
+    q8 = tmp_path / "q8"
+    ckpt.split_into_layers(str(hf), str(q8), dtype="int8")
+
+    fw = FrameworkConfig(
+        model_path=str(q8), dtype="float32", bucket_multiple=8, prefetch_depth=0
+    )
+    got = StreamingExecutor(fw, tokenizer=FakeTokenizer())(PROMPTS[:1])
+
+    # Oracle: dequantized embed/layers/norm, head = requantized transpose
+    # (exactly what the tied loader streams).
+    params_deq = _dequantized_params(str(q8), cfg)
+    emb_q = ckpt.load_layer(str(q8), "model.embed_tokens")["embedding"]
+    kq, ks = ckpt._quantize_int8(
+        np.ascontiguousarray(ckpt.dequantize_np(emb_q).T)
+    )
+    params_deq = dict(params_deq)
+    params_deq["lm_head"] = {"kernel": jnp.asarray(kq.astype(np.float32) * ks)}
+
+    tok = PromptTokenizer(FakeTokenizer(), bucket_multiple=8)
+    prefix, suffixes = PROMPTS[0]
+    t = tok(prefix, suffixes)
+    for s in range(t.num_suffixes):
+        n_real = int(t.suffix_eos[s]) + 1
+        full = np.concatenate(
+            [t.prefix_ids[: t.prefix_len], t.suffix_ids[s, :n_real]]
+        )[None, :]
+        logits = llama.forward_full(params_deq, cfg, jnp.asarray(full))
+        want = np.asarray(jax.nn.softmax(logits[0, -1]))
+        np.testing.assert_allclose(got[0][s, 0], want, rtol=2e-4, atol=2e-5)
+
+
+def test_requantize_native_dir(dirs, tiny_cfg, tmp_path):
+    """requantize_native (native dir -> int8, no HF source needed — the
+    bench's path) produces a checkpoint the executor streams correctly."""
+    f32, _, _ = dirs
+    q8 = tmp_path / "q8b"
+    names = ckpt.requantize_native(f32, str(q8))
+    assert "model.layers.0" in names and os.path.exists(q8 / "config.json")
+
+    fw = FrameworkConfig(
+        model_path=str(q8), dtype="float32", bucket_multiple=8, prefetch_depth=0
+    )
+    got = StreamingExecutor(fw, tokenizer=FakeTokenizer())(PROMPTS[:1])
+    params_deq = _dequantized_params(str(q8), tiny_cfg)
+    tok = PromptTokenizer(FakeTokenizer(), bucket_multiple=8)
+    t = tok(*PROMPTS[0])
+    for s in range(t.num_suffixes):
+        n_real = int(t.suffix_eos[s]) + 1
+        full = np.concatenate(
+            [t.prefix_ids[: t.prefix_len], t.suffix_ids[s, :n_real]]
+        )[None, :]
+        logits = llama.forward_full(params_deq, tiny_cfg, jnp.asarray(full))
+        want = np.asarray(jax.nn.softmax(logits[0, -1]))
+        np.testing.assert_allclose(got[0][s, 0], want, rtol=2e-4, atol=2e-5)
+
+
+def test_int8_stacked_shards_and_moe(tiny_cfg, tmp_path):
+    """layer_num_per_shard >= 2 stacks quantized layers to q8 [k, ...] with
+    scales [k, out] — the dequant must broadcast the scale on its own axis
+    (a plain q*s crashes or silently mis-scales). MoE experts add a 4-D
+    stacked case ([k, E, D, F] with scales [k, F])."""
+    import dataclasses
+
+    from tests.test_model_families import MIXTRAL_CFG
+
+    for cfg, seed in ((tiny_cfg, 2), (MIXTRAL_CFG, 3)):
+        params = llama.init_params(jax.random.PRNGKey(seed), cfg)
+        f32 = tmp_path / f"f32-{cfg.model_type}-{seed}"
+        save_params(jax.tree.map(np.asarray, params), str(f32), cfg)
+        q8 = tmp_path / f"q8-{cfg.model_type}-{seed}"
+        ckpt.requantize_native(str(f32), str(q8))
+
+        fw = FrameworkConfig(
+            model_path=str(q8),
+            dtype="float32",
+            bucket_multiple=8,
+            layer_num_per_shard=2,
+            prefetch_depth=0,
+        )
+        got = StreamingExecutor(fw, tokenizer=FakeTokenizer())(PROMPTS[:1])
+        params_deq = _dequantized_params(str(q8), cfg)
+        tok = PromptTokenizer(FakeTokenizer(), bucket_multiple=8)
+        t = tok(*PROMPTS[0])
+        for s in range(t.num_suffixes):
+            n_real = int(t.suffix_eos[s]) + 1
+            full = np.concatenate(
+                [t.prefix_ids[: t.prefix_len], t.suffix_ids[s, :n_real]]
+            )[None, :]
+            logits = llama.forward_full(params_deq, cfg, jnp.asarray(full))
+            want = np.asarray(jax.nn.softmax(logits[0, -1]))
+            np.testing.assert_allclose(got[0][s, 0], want, rtol=2e-4, atol=2e-5)
+
+
+def test_int8_rejected_under_tensor_parallel(dirs):
+    from flexible_llm_sharding_tpu.parallel.sharding import TpPlacement
+
+    _, q8, _ = dirs
+    fw = FrameworkConfig(
+        model_path=q8, dtype="float32", bucket_multiple=8, prefetch_depth=0
+    )
+    pl = TpPlacement(jax.devices()[:2])
+    with pytest.raises(NotImplementedError, match="int8"):
+        StreamingExecutor(fw, device=pl, tokenizer=FakeTokenizer())(PROMPTS[:1])
