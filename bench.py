@@ -373,7 +373,14 @@ def run_bench(result: dict) -> None:
             from flexible_llm_sharding_tpu.utils.checkpoint import requantize_native
 
             q8_path = model_path + "-int8"
-            if not os.path.exists(os.path.join(q8_path, "config.json")):
+            # The layout marker is written LAST by requantize_native, so a
+            # killed/partial conversion never looks complete; rebuild from
+            # scratch in that case rather than streaming a broken dir.
+            marker = os.path.join(q8_path, "fls_tpu_layout.json")
+            if not os.path.exists(marker):
+                import shutil
+
+                shutil.rmtree(q8_path, ignore_errors=True)
                 requantize_native(model_path, q8_path)
             import dataclasses
 

@@ -86,6 +86,9 @@ class LlamaConfig:
     final_logit_softcap: float | None = None
     query_pre_attn_scalar: float | None = None
     layer_sliding: tuple[bool, ...] | None = None
+    # Gemma3: sliding (local) layers use this UNSCALED rope base while full
+    # (global) layers use rope_theta + rope_scaling. None = single base.
+    rope_local_theta: float | None = None
 
     @property
     def attn_scale(self) -> float:
@@ -239,10 +242,41 @@ class LlamaConfig:
                 elif not all(sliding):
                     kwargs["layer_sliding"] = sliding
                 # all sliding: uniform window, no per-layer flags needed
+        elif model_type == "gemma3_text":
+            kwargs.setdefault("norm_unit_offset", True)
+            kwargs.setdefault("embed_scale", True)
+            kwargs.setdefault("tie_word_embeddings", True)
+            kwargs.setdefault("explicit_head_dim", 256)
+            kwargs.setdefault("qk_norm", True)  # Gemma3RMSNorm, (1+w) style
+            kwargs["hidden_act"] = (
+                d.get("hidden_activation") or d.get("hidden_act") or "gelu_pytorch_tanh"
+            )
+            kwargs["ffw_sandwich_norms"] = True
+            kwargs.setdefault("query_pre_attn_scalar", d.get("query_pre_attn_scalar", 256))
+            kwargs.setdefault("rope_theta", 1_000_000.0)  # global layers
+            kwargs.setdefault("rope_local_theta", d.get("rope_local_base_freq", 10_000.0))
+            if "layer_sliding" not in kwargs:
+                # 5:1 local/global: every 6th layer is full attention.
+                n = d.get("num_hidden_layers", 26)
+                lt = d.get("layer_types") or [
+                    "full_attention" if (i + 1) % 6 == 0 else "sliding_attention"
+                    for i in range(n)
+                ]
+                sliding = tuple(t == "sliding_attention" for t in lt)
+                if len(sliding) != n:
+                    raise ValueError(
+                        f"gemma3 layer_types has {len(sliding)} entries for "
+                        f"{n} layers"
+                    )
+                kwargs.setdefault("sliding_window", 4096)
+                if not any(sliding):
+                    kwargs["sliding_window"] = None
+                elif not all(sliding):
+                    kwargs["layer_sliding"] = sliding
         elif model_type == "gemma3":
             raise NotImplementedError(
-                "gemma3 (per-layer rope bases / 5:1 local-global pattern) "
-                "is not supported yet; gemma and gemma2 are"
+                "gemma3 multimodal checkpoints are not supported; use the "
+                "text model (model_type 'gemma3_text')"
             )
         elif model_type in ("mistral", "mixtral"):
             # sliding_window flows through by field name (may be null);
@@ -252,7 +286,7 @@ class LlamaConfig:
         else:
             raise NotImplementedError(
                 f"model_type {model_type!r} is not supported "
-                "(llama, mistral, qwen2, qwen3, mixtral, gemma, gemma2 are)"
+                "(llama, mistral, qwen2, qwen3, mixtral, gemma, gemma2, gemma3_text are)"
             )
         if model_type != "mixtral":
             # A stray num_local_experts key in a dense export must not flip

@@ -87,9 +87,10 @@ def _qkv(attn: Params, cfg: LlamaConfig, x: jax.Array):
     k = _lin(x, attn, "wk", "bk").reshape(*x.shape[:-1], cfg.num_key_value_heads, hd)
     v = _lin(x, attn, "wv", "bv").reshape(*x.shape[:-1], cfg.num_key_value_heads, hd)
     if "q_norm" in attn:
-        # Qwen3: per-head-dim RMSNorm on q/k, pre-RoPE.
-        q = rms_norm(q, attn["q_norm"], cfg.rms_norm_eps)
-        k = rms_norm(k, attn["k_norm"], cfg.rms_norm_eps)
+        # Per-head-dim RMSNorm on q/k, pre-RoPE (Qwen3 llama-style; Gemma3
+        # (1+w)-style — the family's norm_unit_offset covers both).
+        q = rms_norm(q, attn["q_norm"], cfg.rms_norm_eps, cfg.norm_unit_offset)
+        k = rms_norm(k, attn["k_norm"], cfg.rms_norm_eps, cfg.norm_unit_offset)
     return q, k, v
 
 
@@ -204,6 +205,28 @@ def layer_sliding_pattern(cfg: LlamaConfig) -> tuple[bool, ...]:
     return (cfg.sliding_window is not None,) * cfg.num_hidden_layers
 
 
+def rope_for_layer(cfg: LlamaConfig, positions: jax.Array, sliding):
+    """cos/sin for one layer. Gemma3 gives sliding (local) layers their own
+    UNSCALED rope base while full (global) layers use rope_theta +
+    rope_scaling; other families have a single base. ``sliding`` follows the
+    layer-fn convention: None = uniform per cfg, python bool = static
+    per-layer choice, traced bool = select between the two static tables
+    (both tiny) inside the scan program."""
+    if cfg.rope_local_theta is None:
+        return rope_cos_sin(
+            positions, cfg.head_dim, cfg.rope_theta, cfg.rope_scaling_spec
+        )
+    cos_g, sin_g = rope_cos_sin(
+        positions, cfg.head_dim, cfg.rope_theta, cfg.rope_scaling_spec
+    )
+    cos_l, sin_l = rope_cos_sin(positions, cfg.head_dim, cfg.rope_local_theta, None)
+    if sliding is None:
+        sliding = cfg.sliding_window is not None
+    if isinstance(sliding, bool):
+        return (cos_l, sin_l) if sliding else (cos_g, sin_g)
+    return jnp.where(sliding, cos_l, cos_g), jnp.where(sliding, sin_l, sin_g)
+
+
 def _effective_window(cfg: LlamaConfig, sliding) -> tuple[int | None, Any]:
     """Resolve (window, sliding) for one layer.
 
@@ -243,12 +266,14 @@ def decoder_layer(
     x: jax.Array,
     positions: jax.Array,
     mask: jax.Array | None,
+    sliding=None,
 ) -> jax.Array:
     """Plain decoder layer. x: [..., L, D]; positions int [..., L] or [L];
-    mask broadcastable to [..., L, L] (caller bakes any sliding window in)."""
+    mask broadcastable to [..., L, L] (caller bakes any sliding window in;
+    ``sliding`` only selects the per-layer rope base for gemma3)."""
     h = rms_norm(x, params["input_layernorm"]["scale"], cfg.rms_norm_eps, cfg.norm_unit_offset)
     q, k, v = _qkv(params["attn"], cfg, h)
-    cos, sin = rope_cos_sin(positions, cfg.head_dim, cfg.rope_theta, cfg.rope_scaling_spec)
+    cos, sin = rope_for_layer(cfg, positions, sliding)
     q, k = apply_rope(q, cos, sin), apply_rope(k, cos, sin)
     attn_out = attention(
         q, k, v, mask, scale=cfg.attn_scale, softcap=cfg.attn_logit_softcap
@@ -287,6 +312,7 @@ def prefix_suffix_layer(
     lp, _ = prefix_h.shape
     s, ls, _ = suffix_h.shape
     eps = cfg.rms_norm_eps
+    rope_sliding = sliding  # rope base selection survives the window shortcut
     window, sliding = _effective_window(cfg, sliding)
     if window is not None and lp + ls <= window:
         # Max query-key distance at these (static) bucket shapes is
@@ -311,7 +337,7 @@ def prefix_suffix_layer(
     # --- prefix: causal self-attention, keep post-RoPE KV ---
     h = rms_norm(prefix_h, params["input_layernorm"]["scale"], eps, cfg.norm_unit_offset)
     q, k, v = _qkv(params["attn"], cfg, h)
-    cos, sin = rope_cos_sin(jnp.arange(lp), cfg.head_dim, cfg.rope_theta, cfg.rope_scaling_spec)
+    cos, sin = rope_for_layer(cfg, jnp.arange(lp), rope_sliding)
     q, k = apply_rope(q, cos, sin), apply_rope(k, cos, sin)
     if flash:
         # Rows at i >= prefix_len are padding; the kernel's valid-len mask
@@ -335,7 +361,7 @@ def prefix_suffix_layer(
     hs = rms_norm(suffix_h, params["input_layernorm"]["scale"], eps, cfg.norm_unit_offset)
     qs, ks, vs = _qkv(params["attn"], cfg, hs)
     pos_s = prefix_len + jnp.arange(ls)
-    cos_s, sin_s = rope_cos_sin(pos_s, cfg.head_dim, cfg.rope_theta, cfg.rope_scaling_spec)
+    cos_s, sin_s = rope_for_layer(cfg, pos_s, rope_sliding)
     qs, ks = apply_rope(qs, cos_s, sin_s), apply_rope(ks, cos_s, sin_s)
 
     if flash:
@@ -384,10 +410,11 @@ def decode_step_layer(
     of kg/vg written).
     """
     eps = cfg.rms_norm_eps
+    rope_sliding = sliding
     h = rms_norm(x, params["input_layernorm"]["scale"], eps, cfg.norm_unit_offset)
     q, k_new, v_new = _qkv(params["attn"], cfg, h)  # [S, 1, n, hd]
     pos = (prefix_len + suffix_eos + 1 + t)[:, None]  # [S, 1]
-    cos, sin = rope_cos_sin(pos, cfg.head_dim, cfg.rope_theta, cfg.rope_scaling_spec)
+    cos, sin = rope_for_layer(cfg, pos, rope_sliding)
     q, k_new = apply_rope(q, cos, sin), apply_rope(k_new, cos, sin)
 
     kv = dict(kv)
@@ -474,14 +501,17 @@ def forward_full(
     layers = params["layers"]
     if isinstance(layers, (list, tuple)):
         for i, lp in enumerate(layers):
-            x = decoder_layer(lp, cfg, x, positions, banded if pattern[i] else full)
+            x = decoder_layer(
+                lp, cfg, x, positions,
+                banded if pattern[i] else full, sliding=pattern[i],
+            )
     else:  # stacked pytree with leading layer axis -> scan (one compile)
         flags = jnp.asarray(pattern)
 
         def body(h, xs):
             layer_params, s = xs
             mask = jnp.where(s, banded, full)
-            return decoder_layer(layer_params, cfg, h, positions, mask), None
+            return decoder_layer(layer_params, cfg, h, positions, mask, sliding=s), None
 
         x, _ = jax.lax.scan(body, x, (layers, flags))
     x = rms_norm(x, params["norm"]["scale"], cfg.rms_norm_eps, cfg.norm_unit_offset)

@@ -534,6 +534,12 @@ def save_params(params: dict[str, Any], out_dir: str, cfg: LlamaConfig) -> None:
         "final_logit_softcap": cfg.final_logit_softcap,
         "query_pre_attn_scalar": cfg.query_pre_attn_scalar,
         "layer_sliding": list(cfg.layer_sliding) if cfg.layer_sliding else None,
+        "rope_local_theta": cfg.rope_local_theta,
+        "rope_scaling_kind": cfg.rope_scaling_kind,
+        "rope_scaling_factor": cfg.rope_scaling_factor,
+        "rope_low_freq_factor": cfg.rope_low_freq_factor,
+        "rope_high_freq_factor": cfg.rope_high_freq_factor,
+        "rope_original_max_position": cfg.rope_original_max_position,
     }
     if cfg.explicit_head_dim is not None:
         hf_cfg["head_dim"] = cfg.explicit_head_dim

@@ -13,8 +13,13 @@ while true; do
     if grep -q '"platform": "tpu"' /tmp/bench_hw.json; then
       echo "$(date -u +%H:%M:%S) running scale_demo" >> /tmp/hw_watcher.log
       timeout 5400 python scale_demo.py > /tmp/scale_hw.log 2>&1
-      echo "$(date -u +%H:%M:%S) scale_demo rc=$? artifact: $(ls -la SCALE_r02.json 2>/dev/null)" >> /tmp/hw_watcher.log
-      exit 0
+      rc=$?
+      echo "$(date -u +%H:%M:%S) scale_demo rc=$rc artifact: $(ls -la SCALE_r02.json 2>/dev/null)" >> /tmp/hw_watcher.log
+      # Only stop once the artifact actually exists — a tunnel drop mid-run
+      # (the very failure mode this watcher exists for) must keep retrying.
+      if [ -f SCALE_r02.json ]; then
+        exit 0
+      fi
     fi
   else
     echo "$(date -u +%H:%M:%S) tunnel still down" >> /tmp/hw_watcher.log

@@ -99,6 +99,32 @@ GEMMA2_CFG = LlamaConfig(
     layer_sliding=(True, False, True),  # gemma2 alternation
 )
 
+GEMMA3_CFG = LlamaConfig(
+    model_type="gemma3_text",
+    vocab_size=256,
+    hidden_size=64,
+    intermediate_size=128,
+    num_hidden_layers=3,
+    num_attention_heads=4,
+    num_key_value_heads=2,
+    max_position_embeddings=512,
+    rms_norm_eps=1e-6,
+    tie_word_embeddings=True,
+    explicit_head_dim=32,
+    hidden_act="gelu_pytorch_tanh",
+    norm_unit_offset=True,
+    embed_scale=True,
+    ffw_sandwich_norms=True,
+    qk_norm=True,  # (1+w)-style via norm_unit_offset
+    query_pre_attn_scalar=64,
+    sliding_window=6,
+    layer_sliding=(True, True, False),  # 2 local : 1 global
+    rope_theta=1_000_000.0,  # global layers, linearly scaled
+    rope_scaling_kind="linear",
+    rope_scaling_factor=2.0,
+    rope_local_theta=10_000.0,  # local layers, unscaled
+)
+
 MIXTRAL_CFG = LlamaConfig(
     model_type="mixtral",
     vocab_size=256,
@@ -229,6 +255,91 @@ def test_gemma2_decode_generator_matches_oracle(tmp_path):
             want = np.asarray(jax.nn.softmax(logits[0, -1]))  # softcap inside
             np.testing.assert_allclose(scores[0][s, g], want, rtol=2e-4, atol=1e-5)
             ids = np.concatenate([ids, [int(want.argmax())]])
+
+
+def _hf_gemma3(cfg: LlamaConfig):
+    from transformers import Gemma3ForCausalLM, Gemma3TextConfig
+
+    torch.manual_seed(0)
+    return Gemma3ForCausalLM(
+        Gemma3TextConfig(
+            vocab_size=cfg.vocab_size,
+            hidden_size=cfg.hidden_size,
+            intermediate_size=cfg.intermediate_size,
+            num_hidden_layers=cfg.num_hidden_layers,
+            num_attention_heads=cfg.num_attention_heads,
+            num_key_value_heads=cfg.num_key_value_heads,
+            rms_norm_eps=cfg.rms_norm_eps,
+            rope_theta=cfg.rope_theta,
+            rope_scaling={"rope_type": "linear", "factor": cfg.rope_scaling_factor},
+            rope_local_base_freq=cfg.rope_local_theta,
+            max_position_embeddings=cfg.max_position_embeddings,
+            tie_word_embeddings=True,
+            head_dim=cfg.head_dim,
+            hidden_activation="gelu_pytorch_tanh",
+            query_pre_attn_scalar=cfg.query_pre_attn_scalar,
+            sliding_window=cfg.sliding_window,
+            layer_types=[
+                "sliding_attention" if s else "full_attention"
+                for s in cfg.layer_sliding
+            ],
+            attn_implementation="eager",
+        )
+    ).eval()
+
+
+def test_gemma3_forward_matches_hf(rng):
+    """Gemma3's defining delta: per-layer rope bases — local (sliding)
+    layers at the unscaled local base, global layers at rope_theta with
+    linear scaling — on top of the gemma2 layout minus softcaps, plus
+    (1+w)-style q/k norms. The window binds at 17 tokens."""
+    model = _hf_gemma3(GEMMA3_CFG)
+    params = _params_from_hf(model, GEMMA3_CFG)
+    assert "q_norm" in params["layers"][0]["attn"]
+    assert "pre_feedforward_layernorm" in params["layers"][0]
+    ids = rng.integers(0, GEMMA3_CFG.vocab_size, size=(2, 17))
+    with torch.no_grad():
+        hf_logits = model(torch.tensor(ids)).logits.numpy()
+    ours = np.asarray(llama.forward_full(params, GEMMA3_CFG, jnp.asarray(ids)))
+    np.testing.assert_allclose(ours, hf_logits, rtol=2e-4, atol=2e-4)
+    # The rope-base split genuinely matters: using the global base
+    # everywhere must NOT match.
+    import dataclasses
+
+    wrong = np.asarray(
+        llama.forward_full(
+            params,
+            dataclasses.replace(GEMMA3_CFG, rope_local_theta=None),
+            jnp.asarray(ids),
+        )
+    )
+    assert not np.allclose(wrong, hf_logits, rtol=2e-4, atol=2e-4)
+
+
+def test_gemma3_stacked_scan_matches_list(rng):
+    """Per-layer rope-base selection must survive the stacked-scan layout
+    (traced flag selecting between the two cos/sin tables)."""
+    params = llama.init_params(jax.random.PRNGKey(7), GEMMA3_CFG)
+    ids = jnp.asarray(rng.integers(0, GEMMA3_CFG.vocab_size, size=(1, 15)))
+    stacked = dict(params)
+    stacked["layers"] = jax.tree.map(lambda *xs: jnp.stack(xs), *params["layers"])
+    a = llama.forward_full(params, GEMMA3_CFG, ids)
+    b = llama.forward_full(stacked, GEMMA3_CFG, ids)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-5)
+
+
+def test_from_hf_gemma3_text():
+    cfg = LlamaConfig.from_hf_config(
+        {"model_type": "gemma3_text", "num_hidden_layers": 12, "hidden_size": 64}
+    )
+    assert cfg.qk_norm and cfg.ffw_sandwich_norms and cfg.norm_unit_offset
+    assert cfg.attn_logit_softcap is None and cfg.final_logit_softcap is None
+    assert cfg.rope_theta == 1_000_000.0 and cfg.rope_local_theta == 10_000.0
+    assert cfg.sliding_window == 4096 and cfg.head_dim == 256
+    # HF 5:1 derivation: every 6th layer full.
+    assert cfg.layer_sliding == (True,) * 5 + (False,) + (True,) * 5 + (False,)
+    with pytest.raises(NotImplementedError):
+        LlamaConfig.from_hf_config({"model_type": "gemma3"})  # multimodal
 
 
 def _hf_qwen2(cfg: LlamaConfig):
@@ -673,8 +784,8 @@ def _stream_scores(params, cfg, prefix_ids, suffix_ids_list, lp_bucket):
 
 @pytest.mark.parametrize(
     "cfg",
-    [QWEN2_CFG, MISTRAL_CFG, MIXTRAL_CFG, QWEN3_CFG, GEMMA_CFG, GEMMA2_CFG],
-    ids=["qwen2", "mistral", "mixtral", "qwen3", "gemma", "gemma2"],
+    [QWEN2_CFG, MISTRAL_CFG, MIXTRAL_CFG, QWEN3_CFG, GEMMA_CFG, GEMMA2_CFG, GEMMA3_CFG],
+    ids=["qwen2", "mistral", "mixtral", "qwen3", "gemma", "gemma2", "gemma3"],
 )
 def test_streaming_matches_monolithic(cfg, rng):
     """The reference invariant, for each family: layerwise prefix-KV streaming
@@ -697,8 +808,8 @@ def test_streaming_matches_monolithic(cfg, rng):
 
 @pytest.mark.parametrize(
     "cfg",
-    [QWEN2_CFG, MISTRAL_CFG, MIXTRAL_CFG, QWEN3_CFG, GEMMA_CFG, GEMMA2_CFG],
-    ids=["qwen2", "mistral", "mixtral", "qwen3", "gemma", "gemma2"],
+    [QWEN2_CFG, MISTRAL_CFG, MIXTRAL_CFG, QWEN3_CFG, GEMMA_CFG, GEMMA2_CFG, GEMMA3_CFG],
+    ids=["qwen2", "mistral", "mixtral", "qwen3", "gemma", "gemma2", "gemma3"],
 )
 def test_decode_step_matches_monolithic(cfg, rng):
     """KV-cache decode with biases / a binding sliding window: each generated
@@ -826,8 +937,8 @@ def test_splitter_carries_biases(tmp_path):
 
 @pytest.mark.parametrize(
     "cfg",
-    [QWEN2_CFG, MISTRAL_CFG, MIXTRAL_CFG, QWEN3_CFG, GEMMA_CFG, GEMMA2_CFG],
-    ids=["qwen2", "mistral", "mixtral", "qwen3", "gemma", "gemma2"],
+    [QWEN2_CFG, MISTRAL_CFG, MIXTRAL_CFG, QWEN3_CFG, GEMMA_CFG, GEMMA2_CFG, GEMMA3_CFG],
+    ids=["qwen2", "mistral", "mixtral", "qwen3", "gemma", "gemma2", "gemma3"],
 )
 def test_executor_end_to_end(cfg, rng, tmp_path):
     """The full streaming executor on a biased / sliding-window model:
