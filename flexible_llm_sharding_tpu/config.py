@@ -129,38 +129,55 @@ class LlamaConfig:
         )
 
     @staticmethod
-    def _uniform_window(d: dict[str, Any], family: str) -> bool:
-        """Resolve the qwen2/qwen3 sliding-window convention to a single
-        uniform value: returns True if the window applies to EVERY layer,
-        False if to none — raising on per-layer mixed patterns, which one
-        window field cannot represent (silently applying either choice
-        would diverge from HF logits).
-
-        HF semantics (configuration_qwen2/3.py): the window is active only
-        under ``use_sliding_window``, and layer i is sliding iff
-        ``i >= max_window_layers`` (class default 28) — or per the explicit
-        ``layer_types`` list when present.
-        """
+    def _sliding_pattern(d: dict[str, Any], family: str, default_fn) -> tuple[bool, ...]:
+        """Per-layer sliding flags from ``layer_types`` (validated against
+        num_hidden_layers) or the family's derivation rule ``default_fn(i, n)``."""
+        n = d.get("num_hidden_layers", 26)
         lt = d.get("layer_types")
-        if lt and len(set(lt)) > 1:
-            raise NotImplementedError(
-                f"{family} mixed layer_types (per-layer sliding window) "
-                "is not supported yet"
+        pattern = (
+            tuple(t == "sliding_attention" for t in lt)
+            if lt
+            else tuple(bool(default_fn(i, n)) for i in range(n))
+        )
+        if len(pattern) != n:
+            raise ValueError(
+                f"{family} layer_types has {len(pattern)} entries for {n} layers"
             )
+        return pattern
+
+    @classmethod
+    def _apply_sliding_pattern(
+        cls, kwargs: dict[str, Any], d: dict[str, Any], family: str, default_fn,
+        default_window: int,
+    ) -> None:
+        """Fold a per-layer pattern into (sliding_window, layer_sliding):
+        all-off -> window None; all-on -> uniform window; mixed -> flags.
+        An explicit native layer_sliding key wins untouched."""
+        if "layer_sliding" in kwargs:
+            return
+        pattern = cls._sliding_pattern(d, family, default_fn)
+        kwargs.setdefault("sliding_window", default_window)
+        if not any(pattern):
+            kwargs["sliding_window"] = None
+        elif not all(pattern):
+            kwargs["layer_sliding"] = pattern
+
+    @classmethod
+    def _apply_qwen_window(cls, kwargs: dict[str, Any], d: dict[str, Any]) -> None:
+        """HF qwen2/qwen3: window active only under use_sliding_window; layer
+        i slides iff i >= max_window_layers (class default 28), or per the
+        explicit layer_types list."""
+        if "layer_sliding" in kwargs:  # explicit native key wins
+            return
         if not d.get("use_sliding_window", False):
-            return False
-        if lt:
-            return all(t == "sliding_attention" for t in lt)
+            kwargs["sliding_window"] = None
+            return
         mwl = d.get("max_window_layers", 28)
-        n = d.get("num_hidden_layers", 28)
-        if mwl >= n:
-            return False  # every layer full attention
-        if mwl > 0:
-            raise NotImplementedError(
-                f"{family} per-layer sliding window (0 < max_window_layers "
-                "< num_hidden_layers) is not supported yet"
-            )
-        return True  # mwl == 0: every layer sliding
+        pattern = cls._sliding_pattern(d, "qwen", lambda i, n: i >= mwl)
+        if not any(pattern):
+            kwargs["sliding_window"] = None
+        elif not all(pattern):
+            kwargs["layer_sliding"] = pattern
 
     @classmethod
     def from_hf_config(cls, d: dict[str, Any]) -> "LlamaConfig":
@@ -181,8 +198,7 @@ class LlamaConfig:
             # HF Qwen2 hard-codes bias=True on q/k/v, False on o_proj.
             kwargs.setdefault("attention_in_bias", True)
             kwargs.setdefault("attention_out_bias", False)
-            if not cls._uniform_window(d, "qwen2"):
-                kwargs["sliding_window"] = None
+            cls._apply_qwen_window(kwargs, d)
         elif model_type == "qwen3":
             # One attention_bias flag for all four projections (like Llama,
             # default False) + per-head-dim q/k RMSNorm.
@@ -190,8 +206,7 @@ class LlamaConfig:
                 kwargs.setdefault("attention_in_bias", True)
                 kwargs.setdefault("attention_out_bias", True)
             kwargs.setdefault("qk_norm", True)
-            if not cls._uniform_window(d, "qwen3"):
-                kwargs["sliding_window"] = None
+            cls._apply_qwen_window(kwargs, d)
             kwargs.setdefault("explicit_head_dim", 128)  # Qwen3Config default
         elif model_type == "gemma":
             kwargs.setdefault("norm_unit_offset", True)
@@ -222,26 +237,11 @@ class LlamaConfig:
             kwargs.setdefault("attn_logit_softcap", d.get("attn_logit_softcapping", 50.0))
             kwargs.setdefault("final_logit_softcap", d.get("final_logit_softcapping", 30.0))
             kwargs.setdefault("query_pre_attn_scalar", 256)
-            if "layer_sliding" not in kwargs:
-                # Alternating local/global attention: layer i slides iff
-                # layer_types[i] says so (HF default: every even layer).
-                n = d.get("num_hidden_layers", 26)
-                lt = d.get("layer_types") or [
-                    "sliding_attention" if (i + 1) % 2 else "full_attention"
-                    for i in range(n)
-                ]
-                sliding = tuple(t == "sliding_attention" for t in lt)
-                if len(sliding) != n:
-                    raise ValueError(
-                        f"gemma2 layer_types has {len(sliding)} entries for "
-                        f"{n} layers"
-                    )
-                kwargs.setdefault("sliding_window", 4096)
-                if not any(sliding):
-                    kwargs["sliding_window"] = None
-                elif not all(sliding):
-                    kwargs["layer_sliding"] = sliding
-                # all sliding: uniform window, no per-layer flags needed
+            # Alternating local/global attention (HF default: every even
+            # layer slides).
+            cls._apply_sliding_pattern(
+                kwargs, d, "gemma2", lambda i, n: (i + 1) % 2, 4096
+            )
         elif model_type == "gemma3_text":
             kwargs.setdefault("norm_unit_offset", True)
             kwargs.setdefault("embed_scale", True)
@@ -255,24 +255,10 @@ class LlamaConfig:
             kwargs.setdefault("query_pre_attn_scalar", d.get("query_pre_attn_scalar", 256))
             kwargs.setdefault("rope_theta", 1_000_000.0)  # global layers
             kwargs.setdefault("rope_local_theta", d.get("rope_local_base_freq", 10_000.0))
-            if "layer_sliding" not in kwargs:
-                # 5:1 local/global: every 6th layer is full attention.
-                n = d.get("num_hidden_layers", 26)
-                lt = d.get("layer_types") or [
-                    "full_attention" if (i + 1) % 6 == 0 else "sliding_attention"
-                    for i in range(n)
-                ]
-                sliding = tuple(t == "sliding_attention" for t in lt)
-                if len(sliding) != n:
-                    raise ValueError(
-                        f"gemma3 layer_types has {len(sliding)} entries for "
-                        f"{n} layers"
-                    )
-                kwargs.setdefault("sliding_window", 4096)
-                if not any(sliding):
-                    kwargs["sliding_window"] = None
-                elif not all(sliding):
-                    kwargs["layer_sliding"] = sliding
+            # 5:1 local/global: every 6th layer is full attention.
+            cls._apply_sliding_pattern(
+                kwargs, d, "gemma3", lambda i, n: (i + 1) % 6 != 0, 4096
+            )
         elif model_type == "gemma3":
             raise NotImplementedError(
                 "gemma3 multimodal checkpoints are not supported; use the "

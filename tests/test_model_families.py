@@ -181,16 +181,19 @@ def test_from_hf_qwen2_window_enabled():
         }
     )
     assert cfg.sliding_window == 128
-    with pytest.raises(NotImplementedError):
-        LlamaConfig.from_hf_config(
-            {
-                "model_type": "qwen2",
-                "num_hidden_layers": 4,
-                "use_sliding_window": True,
-                "sliding_window": 128,
-                "max_window_layers": 2,  # per-layer windows unsupported
-            }
-        )
+    # Mixed pattern (layers past max_window_layers slide) maps to
+    # per-layer flags — the same machinery gemma2/gemma3 use.
+    cfg = LlamaConfig.from_hf_config(
+        {
+            "model_type": "qwen2",
+            "num_hidden_layers": 4,
+            "use_sliding_window": True,
+            "sliding_window": 128,
+            "max_window_layers": 2,
+        }
+    )
+    assert cfg.sliding_window == 128
+    assert cfg.layer_sliding == (False, False, True, True)
 
 
 def test_from_hf_mistral_and_llama_bias():
@@ -458,28 +461,28 @@ def test_from_hf_qwen3():
     )
     assert cfg.qk_norm and cfg.sliding_window is None and cfg.head_dim == 128
     assert not cfg.attention_in_bias
-    with pytest.raises(NotImplementedError):
-        LlamaConfig.from_hf_config(
-            {
-                "model_type": "qwen3",
-                "num_hidden_layers": 2,
-                "use_sliding_window": True,
-                "sliding_window": 64,
-                "layer_types": ["full_attention", "sliding_attention"],
-            }
-        )
+    cfg = LlamaConfig.from_hf_config(
+        {
+            "model_type": "qwen3",
+            "num_hidden_layers": 2,
+            "use_sliding_window": True,
+            "sliding_window": 64,
+            "layer_types": ["full_attention", "sliding_attention"],
+        }
+    )
+    assert cfg.layer_sliding == (False, True) and cfg.sliding_window == 64
     # Same mixed pattern implied by max_window_layers with no layer_types key
-    # (HF derives it in Qwen3Config.__init__) must also fail loudly.
-    with pytest.raises(NotImplementedError):
-        LlamaConfig.from_hf_config(
-            {
-                "model_type": "qwen3",
-                "num_hidden_layers": 4,
-                "use_sliding_window": True,
-                "sliding_window": 64,
-                "max_window_layers": 2,
-            }
-        )
+    # (HF derives it in Qwen3Config.__init__).
+    cfg = LlamaConfig.from_hf_config(
+        {
+            "model_type": "qwen3",
+            "num_hidden_layers": 4,
+            "use_sliding_window": True,
+            "sliding_window": 64,
+            "max_window_layers": 2,
+        }
+    )
+    assert cfg.layer_sliding == (False, False, True, True)
     # Uniform sliding window (window on, every layer past max_window_layers=0).
     cfg = LlamaConfig.from_hf_config(
         {
@@ -491,9 +494,8 @@ def test_from_hf_qwen3():
         }
     )
     assert cfg.sliding_window == 64
-    # No layer_types: HF derives sliding iff i >= max_window_layers, so
-    # mwl >= n means every layer FULL (window off) and mwl == 0 every layer
-    # sliding (window on) — both uniform, both representable.
+    # No layer_types: HF derives sliding iff i >= max_window_layers — mwl >= n
+    # means every layer FULL (window off), mwl == 0 every layer sliding.
     cfg = LlamaConfig.from_hf_config(
         {
             "model_type": "qwen3",
@@ -591,6 +593,45 @@ def test_from_hf_gemma():
     # inside a jitted forward.
     with pytest.raises(NotImplementedError):
         LlamaConfig.from_hf_config({"model_type": "llama", "hidden_act": "gelu_new"})
+
+
+def test_qwen3_mixed_window_matches_hf(rng):
+    """Qwen3 with a per-layer window pattern (max_window_layers mid-stack):
+    the layer_sliding machinery must reproduce HF exactly — the window binds
+    at 17 tokens on the sliding layers only."""
+    import dataclasses
+
+    from transformers import Qwen3Config, Qwen3ForCausalLM
+
+    cfg = dataclasses.replace(
+        QWEN3_CFG, sliding_window=6, layer_sliding=(False, True, True)
+    )
+    torch.manual_seed(0)
+    model = Qwen3ForCausalLM(
+        Qwen3Config(
+            vocab_size=cfg.vocab_size,
+            hidden_size=cfg.hidden_size,
+            intermediate_size=cfg.intermediate_size,
+            num_hidden_layers=cfg.num_hidden_layers,
+            num_attention_heads=cfg.num_attention_heads,
+            num_key_value_heads=cfg.num_key_value_heads,
+            rms_norm_eps=cfg.rms_norm_eps,
+            rope_theta=cfg.rope_theta,
+            max_position_embeddings=cfg.max_position_embeddings,
+            tie_word_embeddings=False,
+            head_dim=cfg.head_dim,
+            use_sliding_window=True,
+            sliding_window=6,
+            max_window_layers=1,  # layers 1,2 slide
+            attn_implementation="eager",
+        )
+    ).eval()
+    params = _params_from_hf(model, cfg)
+    ids = rng.integers(0, cfg.vocab_size, size=(2, 17))
+    with torch.no_grad():
+        hf_logits = model(torch.tensor(ids)).logits.numpy()
+    ours = np.asarray(llama.forward_full(params, cfg, jnp.asarray(ids)))
+    np.testing.assert_allclose(ours, hf_logits, rtol=2e-4, atol=2e-4)
 
 
 def test_mixtral_forward_matches_hf(rng):
