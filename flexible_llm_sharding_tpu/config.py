@@ -132,7 +132,9 @@ class LlamaConfig:
     def _sliding_pattern(d: dict[str, Any], family: str, default_fn) -> tuple[bool, ...]:
         """Per-layer sliding flags from ``layer_types`` (validated against
         num_hidden_layers) or the family's derivation rule ``default_fn(i, n)``."""
-        n = d.get("num_hidden_layers", 26)
+        # 32 = this dataclass's num_hidden_layers default, so a derived
+        # pattern always matches the constructed config's layer count.
+        n = d.get("num_hidden_layers", 32)
         lt = d.get("layer_types")
         pattern = (
             tuple(t == "sliding_attention" for t in lt)
@@ -166,18 +168,15 @@ class LlamaConfig:
     def _apply_qwen_window(cls, kwargs: dict[str, Any], d: dict[str, Any]) -> None:
         """HF qwen2/qwen3: window active only under use_sliding_window; layer
         i slides iff i >= max_window_layers (class default 28), or per the
-        explicit layer_types list."""
+        explicit layer_types list. Both HF config classes default
+        sliding_window to 4096."""
         if "layer_sliding" in kwargs:  # explicit native key wins
             return
         if not d.get("use_sliding_window", False):
             kwargs["sliding_window"] = None
             return
         mwl = d.get("max_window_layers", 28)
-        pattern = cls._sliding_pattern(d, "qwen", lambda i, n: i >= mwl)
-        if not any(pattern):
-            kwargs["sliding_window"] = None
-        elif not all(pattern):
-            kwargs["layer_sliding"] = pattern
+        cls._apply_sliding_pattern(kwargs, d, "qwen", lambda i, n: i >= mwl, 4096)
 
     @classmethod
     def from_hf_config(cls, d: dict[str, Any]) -> "LlamaConfig":

@@ -194,6 +194,17 @@ def test_from_hf_qwen2_window_enabled():
     )
     assert cfg.sliding_window == 128
     assert cfg.layer_sliding == (False, False, True, True)
+    # sliding_window absent from config.json: HF class default 4096 applies
+    # (window on, NOT silently full-attention).
+    cfg = LlamaConfig.from_hf_config(
+        {
+            "model_type": "qwen2",
+            "num_hidden_layers": 2,
+            "use_sliding_window": True,
+            "max_window_layers": 0,
+        }
+    )
+    assert cfg.sliding_window == 4096
 
 
 def test_from_hf_mistral_and_llama_bias():

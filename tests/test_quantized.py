@@ -275,6 +275,37 @@ def test_int8_stacked_shards_and_moe(tiny_cfg, tmp_path):
             np.testing.assert_allclose(got[0][s, 0], want, rtol=2e-4, atol=2e-5)
 
 
+def test_int8_kv_cache_decode(dirs, tiny_cfg):
+    """DecodeGenerator over an int8 checkpoint: the dequant in _place feeds
+    the prefill and per-token scans; greedy tokens must match the
+    host-dequantized oracle."""
+    from flexible_llm_sharding_tpu.runtime.decode import DecodeGenerator
+
+    _, q8, _ = dirs
+    n_gen = 2
+    fw = FrameworkConfig(
+        model_path=q8,
+        dtype="float32",
+        bucket_multiple=8,
+        prefetch_depth=0,
+        num_gen_token=n_gen,
+    )
+    scores, _ = DecodeGenerator(fw, tokenizer=FakeTokenizer())(PROMPTS[:1])
+
+    params_deq = _dequantized_params(q8, tiny_cfg)
+    tok = PromptTokenizer(FakeTokenizer(), bucket_multiple=8)
+    t = tok(*PROMPTS[0])
+    for s in range(t.num_suffixes):
+        ids = np.concatenate(
+            [t.prefix_ids[: t.prefix_len], t.suffix_ids[s, : int(t.suffix_eos[s]) + 1]]
+        )
+        for g in range(n_gen):
+            logits = llama.forward_full(params_deq, tiny_cfg, jnp.asarray(ids[None]))
+            want = np.asarray(jax.nn.softmax(logits[0, -1]))
+            np.testing.assert_allclose(scores[0][s, g], want, rtol=2e-4, atol=1e-5)
+            ids = np.concatenate([ids, [int(want.argmax())]])
+
+
 def test_int8_rejected_under_tensor_parallel(dirs):
     from flexible_llm_sharding_tpu.parallel.sharding import TpPlacement
 
