@@ -422,6 +422,31 @@ def _has_quantized(tree) -> bool:
     return found
 
 
+def _quantized_target(host, target):
+    """Adapt a NamedSharding tree (built for the unquantized layout) to a
+    host tree that carries {"q8","s"} leaf-groups: the int8 payload takes
+    the weight's sharding; its per-output-channel scale takes the channel
+    axis of that sharding (plus the stack axis when the loader stacked k
+    layers), so the on-device dequant needs no resharding."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    if checkpoint.is_quantized_leaf(host):
+        spec = tuple(target.spec)
+        if host["s"].ndim == 1:
+            s_spec = P(spec[-1]) if spec else P()
+        else:  # stacked [k, out]
+            s_spec = P(
+                spec[0] if spec else None,
+                spec[-1] if len(spec) > 1 else None,
+            )
+        return {"q8": target, "s": NamedSharding(target.mesh, s_spec)}
+    if isinstance(host, dict):
+        # Some kinds (embed/norm) use ONE sharding for the whole subtree.
+        sub = (lambda k: target[k]) if isinstance(target, dict) else (lambda k: target)
+        return {k: _quantized_target(host[k], sub(k)) for k in host}
+    return target
+
+
 def _place(
     segments: list[tuple[str, Any]], device, np_dtype=None
 ) -> list[tuple[str, Any]]:
@@ -429,13 +454,11 @@ def _place(
     tp = hasattr(device, "segment_target")  # TpPlacement: per-kind shardings
     for kind, p in segments:
         quant = _has_quantized(p)
-        if quant and tp:
-            raise NotImplementedError(
-                "int8-compressed checkpoints are not supported with "
-                "--tensor_parallel yet (requantize to bf16, or run TP off)"
-            )
         if tp:
-            d = jax.device_put(p, device.segment_target(kind))
+            target = device.segment_target(kind)
+            if quant:
+                target = _quantized_target(p, target)
+            d = jax.device_put(p, target)
         else:
             d = jax.device_put(p, device) if device else jax.device_put(p)
         if quant:

@@ -16,7 +16,13 @@ def main(argv=None) -> None:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("bin_dir", help="HF checkpoint dir (.bin or .safetensors)")
     p.add_argument("new_file_dir", help="output dir for per-layer files")
-    p.add_argument("--dtype", default=None, choices=[None, "bfloat16", "float16", "float32"])
+    p.add_argument(
+        "--dtype",
+        default=None,
+        choices=[None, "bfloat16", "float16", "float32", "int8"],
+        help="cast at split time; int8 = per-output-channel weight "
+        "compression (halves the host->HBM bytes; dequantized on device)",
+    )
     p.add_argument("--layout", default="native", choices=["native", "hf"])
     args = p.parse_args(argv)
     layers = split_into_layers(

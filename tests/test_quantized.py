@@ -306,13 +306,18 @@ def test_int8_kv_cache_decode(dirs, tiny_cfg):
             ids = np.concatenate([ids, [int(want.argmax())]])
 
 
-def test_int8_rejected_under_tensor_parallel(dirs):
+def test_int8_composes_with_tensor_parallel(dirs, tiny_cfg):
+    """int8 + TP: the int8 payload takes the Megatron weight sharding and
+    its scale the matching channel-axis sharding, so the on-device dequant
+    runs sharded. Scores must equal the single-device int8 run exactly."""
     from flexible_llm_sharding_tpu.parallel.sharding import TpPlacement
 
     _, q8, _ = dirs
     fw = FrameworkConfig(
         model_path=q8, dtype="float32", bucket_multiple=8, prefetch_depth=0
     )
-    pl = TpPlacement(jax.devices()[:2])
-    with pytest.raises(NotImplementedError, match="int8"):
-        StreamingExecutor(fw, device=pl, tokenizer=FakeTokenizer())(PROMPTS[:1])
+    single = StreamingExecutor(fw, tokenizer=FakeTokenizer())(PROMPTS)
+    pl = TpPlacement(jax.devices()[:2], tiny_cfg)
+    sharded = StreamingExecutor(fw, device=pl, tokenizer=FakeTokenizer())(PROMPTS)
+    for a, b in zip(single, sharded):
+        np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5)
