@@ -263,15 +263,19 @@ class LlamaConfig:
                 "gemma3 multimodal checkpoints are not supported; use the "
                 "text model (model_type 'gemma3_text')"
             )
-        elif model_type in ("mistral", "mixtral"):
+        elif model_type in ("mistral", "mixtral", "phi3"):
             # sliding_window flows through by field name (may be null);
             # mixtral's num_local_experts/num_experts_per_tok likewise.
+            # phi3's fused qkv/gate_up projections are a CHECKPOINT layout
+            # (split at conversion, utils/checkpoint.py), not a model delta;
+            # its longrope scaling is rejected by the generic rope parse.
             if model_type == "mixtral" and not d.get("num_local_experts"):
                 raise ValueError("mixtral config without num_local_experts")
         else:
             raise NotImplementedError(
                 f"model_type {model_type!r} is not supported "
-                "(llama, mistral, qwen2, qwen3, mixtral, gemma, gemma2, gemma3_text are)"
+                "(llama, mistral, phi3, qwen2, qwen3, mixtral, gemma, "
+                "gemma2, gemma3_text are)"
             )
         if model_type != "mixtral":
             # A stray num_local_experts key in a dense export must not flip

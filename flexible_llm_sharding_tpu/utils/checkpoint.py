@@ -220,15 +220,41 @@ def hf_layer_to_native(layer_name: str, sd: dict[str, np.ndarray]) -> dict[str, 
     if layer_name == "lm_head":
         return {"kernel": np.ascontiguousarray(sd["lm_head.weight"].T)}
     moe = any(".block_sparse_moe." in k for k in sd)
+    fused = f"{layer_name}.self_attn.qkv_proj.weight" in sd  # phi3 layout
     out = {}
     consumed = set()
     for native_key, hf_sub, transpose in _LAYER_MAP:
         if moe and native_key.startswith("mlp."):
             continue  # Mixtral layers carry block_sparse_moe instead
+        if fused and native_key in (
+            "attn.wq", "attn.wk", "attn.wv", "mlp.gate", "mlp.up"
+        ):
+            continue  # carried fused; split below
         key = f"{layer_name}.{hf_sub}"
         w = sd[key]
         consumed.add(key)
         out[native_key] = np.ascontiguousarray(w.T) if transpose else w
+    if fused:
+        # Phi3 fuses q/k/v into qkv_proj [(nq+2*nkv)*hd, D] and gate/up into
+        # gate_up_proj [2F, D]. The split needs no config: o_proj's input
+        # width IS nq*hd, and the two kv blocks share the remainder equally.
+        qkv = sd[f"{layer_name}.self_attn.qkv_proj.weight"]
+        consumed.add(f"{layer_name}.self_attn.qkv_proj.weight")
+        nq_hd = out["attn.wo"].shape[0]  # [nq*hd, D] after transpose
+        nkv_hd = (qkv.shape[0] - nq_hd) // 2
+        if qkv.shape[0] != nq_hd + 2 * nkv_hd:
+            raise ValueError(
+                f"{layer_name}: qkv_proj rows {qkv.shape[0]} do not split "
+                f"into q={nq_hd} + 2*kv (o_proj implies nq*hd={nq_hd})"
+            )
+        out["attn.wq"] = np.ascontiguousarray(qkv[:nq_hd].T)
+        out["attn.wk"] = np.ascontiguousarray(qkv[nq_hd : nq_hd + nkv_hd].T)
+        out["attn.wv"] = np.ascontiguousarray(qkv[nq_hd + nkv_hd :].T)
+        gu = sd[f"{layer_name}.mlp.gate_up_proj.weight"]
+        consumed.add(f"{layer_name}.mlp.gate_up_proj.weight")
+        f_dim = gu.shape[0] // 2
+        out["mlp.gate"] = np.ascontiguousarray(gu[:f_dim].T)
+        out["mlp.up"] = np.ascontiguousarray(gu[f_dim:].T)
     for native_key, hf_sub in _LAYER_MAP_OPTIONAL:
         key = f"{layer_name}.{hf_sub}"
         if key in sd:
