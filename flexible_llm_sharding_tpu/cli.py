@@ -221,12 +221,6 @@ def main(argv: list[str] | None = None, tokenizer=None) -> None:
                     "--long_context is not supported with --kv_cache yet; "
                     "use the default generation loop for over-length prefixes"
                 )
-            if cfg.tensor_parallel > 1:
-                raise SystemExit(
-                    "--tensor_parallel is not supported with --kv_cache yet; "
-                    "the decode path streams whole layers per chip — use the "
-                    "default generation loop for TP scoring"
-                )
             from flexible_llm_sharding_tpu.runtime.orchestration import run_decode
 
             # Multi-chip: --data_parallel true splits prompts across chips;

@@ -139,6 +139,9 @@ class KVStore:
 
     def get(self, key: tuple, device=None):
         kv = self._mem.pop(key)
+        # TpPlacement duck-types as a device; activations/KV go to its
+        # replicated sharding (weights alone carry the tp split).
+        device = getattr(device, "act", device)
         if self.on_device:
             # MP pipeline: an activation parked by stage s lives on stage
             # s's chip; moving it to stage s+1's chip is a device-to-device
@@ -215,6 +218,11 @@ class DecodeGenerator:
                 plan_shards_dp(len(self.layer_names), cfg.layer_num_per_shard).shards
             )
             self.shard_devices = [device] * len(self.shards)
+        # Pallas kernels can't be auto-partitioned by GSPMD (same guard as
+        # StreamingExecutor): a tp-sharded decode forces the XLA attention.
+        self._use_pallas = cfg.pallas_enabled() and not hasattr(
+            self.device, "segment_target"
+        )
         self.stats: dict[str, float] = {}
 
     def _source(self):
@@ -273,7 +281,7 @@ class DecodeGenerator:
                             )
                         elif kind == "decoders":
                             ph, sh, kv = _prefill_decoders(
-                                self.model_cfg, cfg.pallas_enabled(), params, ph, sh, prefix_len
+                                self.model_cfg, self._use_pallas, params, ph, sh, prefix_len
                             )
                             # Pre-extend with empty generated-token slots so
                             # decode scans can donate in place.
@@ -291,12 +299,15 @@ class DecodeGenerator:
                             # the STAGE's chip (MP): uncommitted zeros would
                             # all land on chip 0, concentrating every
                             # stage's gen-KV there during prefill.
-                            with jax.default_device(dev):
-                                kv = {
-                                    **kv,
-                                    "kg": jnp.zeros(gen_shape, self.dtype),
-                                    "vg": jnp.zeros(gen_shape, self.dtype),
-                                }
+                            # Allocated directly under the stage chip / the
+                            # tp mesh's replicated sharding — never staged
+                            # through the default chip.
+                            target = getattr(dev, "act", dev)
+                            kv = {
+                                **kv,
+                                "kg": jnp.zeros(gen_shape, self.dtype, device=target),
+                                "vg": jnp.zeros(gen_shape, self.dtype, device=target),
+                            }
                             kv_store.put(("kv", shard_pos, b), kv)
                         elif kind == "norm":
                             sh = _norm_block(self.model_cfg, params, sh, suffix_eos)
@@ -346,12 +357,17 @@ class DecodeGenerator:
                             else:  # head
                                 assert norm_params is not None
                                 # MP: model.norm may live on an earlier
-                                # stage's chip; its scale vector hops here.
+                                # stage's chip; its scale vector hops here
+                                # (TpPlacement resolves to its replicated
+                                # activation sharding).
                                 dist = np.asarray(
                                     jax.device_get(
                                         _decode_norm_head(
                                             self.model_cfg,
-                                            jax.device_put(norm_params, dev),
+                                            jax.device_put(
+                                                norm_params,
+                                                getattr(dev, "act", dev),
+                                            ),
                                             params,
                                             x,
                                         )

@@ -79,6 +79,22 @@ def _run_batched(ex: StreamingExecutor, prompts: list[Prompt], num_batch: int):
     return out
 
 
+def _tp_placement(cfg: FrameworkConfig, devices: list):
+    """Build the Megatron placement for --tensor_parallel (shared by the
+    scoring and decode entry points)."""
+    from flexible_llm_sharding_tpu.parallel.sharding import TpPlacement
+
+    if len(devices) < cfg.tensor_parallel:
+        raise ValueError(
+            f"tensor_parallel={cfg.tensor_parallel} needs that many "
+            f"chips, have {len(devices)}"
+        )
+    model_cfg = LlamaConfig.from_pretrained(cfg.model_path)
+    placement = TpPlacement(devices[: cfg.tensor_parallel], model_cfg)
+    placement.check(model_cfg)
+    return placement
+
+
 def run_prompts(
     cfg: FrameworkConfig,
     prompts: Sequence[Prompt],
@@ -140,17 +156,9 @@ def run_prompts(
         # chips' MXUs, XLA emits the ICI all-reduces. The reference has no
         # equivalent — its layers always live whole on one device
         # (/root/reference/utils.py:128-130).
-        from flexible_llm_sharding_tpu.parallel.sharding import TpPlacement
-
-        if len(devices) < cfg.tensor_parallel:
-            raise ValueError(
-                f"tensor_parallel={cfg.tensor_parallel} needs that many "
-                f"chips, have {len(devices)}"
-            )
-        model_cfg = LlamaConfig.from_pretrained(cfg.model_path)
-        placement = TpPlacement(devices[: cfg.tensor_parallel], model_cfg)
-        placement.check(model_cfg)
-        ex = StreamingExecutor(cfg, device=placement, tokenizer=tokenizer)
+        ex = StreamingExecutor(
+            cfg, device=_tp_placement(cfg, devices), tokenizer=tokenizer
+        )
         return _run_batched(ex, prompts, cfg.num_batch)
 
     if len(devices) <= 1 or not cfg.data_parallel:
@@ -232,6 +240,16 @@ def run_decode(
 
     prompts = list(prompts)
     devices = devices if devices is not None else pick_devices(cfg)
+
+    if cfg.tensor_parallel > 1:
+        # TP decode: one generator whose streamed weights are Megatron-
+        # sharded over the tp mesh; activations and parked KV stay
+        # replicated (weights are the HBM/transfer term the split targets).
+        gen = DecodeGenerator(
+            cfg, device=_tp_placement(cfg, devices), tokenizer=tokenizer
+        )
+        scores, updated = gen(prompts)
+        return scores, updated, int(gen.stats.get("tokens_processed", 0))
 
     if len(devices) > 1 and not cfg.data_parallel:
         # Interleaved-pipeline decode (reference MP assignment): each

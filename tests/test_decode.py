@@ -262,3 +262,33 @@ def test_decode_mp_cli(tiny_cfg, model, tmp_path):
     want_s, _ = _oracle(params, tiny_cfg, tok, PROMPTS, N_GEN)
     for g, w in zip(scores, want_s):
         np.testing.assert_allclose(g, w, rtol=1e-4, atol=1e-5)
+
+
+def test_decode_tensor_parallel_matches_oracle(tiny_cfg, model):
+    """--kv_cache + --tensor_parallel: streamed weights Megatron-sharded
+    over 2 chips, KV replicated; greedy scores must equal the single-device
+    decode (which is itself oracle-pinned above)."""
+    import dataclasses
+
+    from flexible_llm_sharding_tpu.runtime.orchestration import run_decode
+
+    model_dir, params = model
+    cfg = FrameworkConfig(
+        model_path=model_dir,
+        dtype="float32",
+        bucket_multiple=8,
+        block_size=2,
+        prefetch_depth=0,
+        num_gen_token=N_GEN,
+        tensor_parallel=2,
+    )
+    scores_tp, updated_tp, _ = run_decode(
+        cfg, list(PROMPTS), tokenizer=FakeTokenizer()
+    )
+    single = DecodeGenerator(
+        dataclasses.replace(cfg, tensor_parallel=1), tokenizer=FakeTokenizer()
+    )
+    scores_1, updated_1 = single(list(PROMPTS))
+    for a, b in zip(scores_1, scores_tp):
+        np.testing.assert_allclose(b, a, rtol=1e-4, atol=1e-5)
+    assert updated_tp == updated_1
