@@ -139,9 +139,6 @@ class KVStore:
 
     def get(self, key: tuple, device=None):
         kv = self._mem.pop(key)
-        # TpPlacement duck-types as a device; activations/KV go to its
-        # replicated sharding (weights alone carry the tp split).
-        device = getattr(device, "act", device)
         if self.on_device:
             # MP pipeline: an activation parked by stage s lives on stage
             # s's chip; moving it to stage s+1's chip is a device-to-device
@@ -268,12 +265,15 @@ class DecodeGenerator:
                 if not layer_idxs:  # MP round-up padding stage
                     continue
                 dev = self.shard_devices[shard_pos]
+                # Activations/KV target: TpPlacement resolves to its
+                # replicated sharding (weights alone carry the tp split).
+                act_dev = getattr(dev, "act", dev)
                 for b, idxs in enumerate(blocks):
                     prefix_ids, suffix_ids, prefix_len, suffix_eos = block_meta[b]
                     if layer_idxs[0] == 0:
                         ph, sh = None, None
                     else:
-                        ph, sh = kv_store.get(("h", b), dev)
+                        ph, sh = kv_store.get(("h", b), act_dev)
                     for kind, params in segments:
                         if kind == "embed":
                             ph, sh = _embed_block(
@@ -302,11 +302,10 @@ class DecodeGenerator:
                             # Allocated directly under the stage chip / the
                             # tp mesh's replicated sharding — never staged
                             # through the default chip.
-                            target = getattr(dev, "act", dev)
                             kv = {
                                 **kv,
-                                "kg": jnp.zeros(gen_shape, self.dtype, device=target),
-                                "vg": jnp.zeros(gen_shape, self.dtype, device=target),
+                                "kg": jnp.zeros(gen_shape, self.dtype, device=act_dev),
+                                "vg": jnp.zeros(gen_shape, self.dtype, device=act_dev),
                             }
                             kv_store.put(("kv", shard_pos, b), kv)
                         elif kind == "norm":
@@ -333,12 +332,13 @@ class DecodeGenerator:
                     if not layer_idxs:  # MP round-up padding stage
                         continue
                     dev = self.shard_devices[shard_pos]
+                    act_dev = getattr(dev, "act", dev)
                     for b, idxs in enumerate(blocks):
                         _, _, prefix_len, suffix_eos = block_meta[b]
                         if layer_idxs[0] == 0:
                             x = None
                         else:
-                            x = kv_store.get(("x", b), dev)
+                            x = kv_store.get(("x", b), act_dev)
                         for kind, params in segments:
                             if kind == "embed":
                                 ids = jnp.asarray(
@@ -346,7 +346,7 @@ class DecodeGenerator:
                                 )
                                 x = llama.embed(params, ids, self.dtype, self.model_cfg)
                             elif kind == "decoders":
-                                kv = kv_store.get(("kv", shard_pos, b), dev)
+                                kv = kv_store.get(("kv", shard_pos, b), act_dev)
                                 x, kv = _decode_decoders(
                                     self.model_cfg, params, kv, x,
                                     prefix_len, suffix_eos, jnp.int32(t),
@@ -357,17 +357,12 @@ class DecodeGenerator:
                             else:  # head
                                 assert norm_params is not None
                                 # MP: model.norm may live on an earlier
-                                # stage's chip; its scale vector hops here
-                                # (TpPlacement resolves to its replicated
-                                # activation sharding).
+                                # stage's chip; its scale vector hops here.
                                 dist = np.asarray(
                                     jax.device_get(
                                         _decode_norm_head(
                                             self.model_cfg,
-                                            jax.device_put(
-                                                norm_params,
-                                                getattr(dev, "act", dev),
-                                            ),
+                                            jax.device_put(norm_params, act_dev),
                                             params,
                                             x,
                                         )

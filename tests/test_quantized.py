@@ -321,3 +321,26 @@ def test_int8_composes_with_tensor_parallel(dirs, tiny_cfg):
     sharded = StreamingExecutor(fw, device=pl, tokenizer=FakeTokenizer())(PROMPTS)
     for a, b in zip(single, sharded):
         np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("mode", ["dp", "mp"])
+def test_int8_multichip(dirs, tiny_cfg, mode, tmp_path):
+    """int8 checkpoints through the multi-chip orchestration: DP prompt
+    split (broadcast weight stream) and the interleaved MP pipeline both
+    dequantize per chip/stage and must match the single-device int8 run."""
+    from flexible_llm_sharding_tpu.runtime.orchestration import run_prompts
+
+    _, q8, _ = dirs
+    fw = FrameworkConfig(
+        model_path=q8,
+        dtype="float32",
+        bucket_multiple=8,
+        layer_num_per_shard=2,
+        prefetch_depth=1,
+        data_parallel=(mode == "dp"),
+        disk_folder=str(tmp_path / "acts"),
+    )
+    single = StreamingExecutor(fw, tokenizer=FakeTokenizer())(PROMPTS)
+    multi = run_prompts(fw, PROMPTS, tokenizer=FakeTokenizer(), devices=jax.devices()[:3])
+    for a, b in zip(single, multi):
+        np.testing.assert_allclose(b, a, rtol=1e-4, atol=1e-5)
