@@ -295,13 +295,11 @@ class DecodeGenerator:
                                 self.model_cfg.head_dim,
                             )
                             # Two distinct buffers: kg/vg are donated by the
-                            # decode scan and must not alias. Allocated on
-                            # the STAGE's chip (MP): uncommitted zeros would
-                            # all land on chip 0, concentrating every
+                            # decode scan and must not alias. Allocated
+                            # directly under the stage's chip (MP) / the tp
+                            # mesh's replicated sharding: uncommitted zeros
+                            # would all land on chip 0, concentrating every
                             # stage's gen-KV there during prefill.
-                            # Allocated directly under the stage chip / the
-                            # tp mesh's replicated sharding — never staged
-                            # through the default chip.
                             kv = {
                                 **kv,
                                 "kg": jnp.zeros(gen_shape, self.dtype, device=act_dev),
