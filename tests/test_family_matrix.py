@@ -1,0 +1,133 @@
+"""Feature-combination matrix: the family deltas (biases, windows, per-layer
+flags, qk-norm, sandwich norms, softcaps, MoE, rope bases) are independent
+config axes, so combinations NO named architecture uses must still satisfy
+the framework's core invariant — layerwise streaming == monolithic forward —
+and its decode counterpart. Catches interaction bugs the per-family golden
+tests can't (e.g. qk_norm x binding window x sandwich norms)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from flexible_llm_sharding_tpu.config import LlamaConfig
+from flexible_llm_sharding_tpu.models import llama
+
+BASE = dict(
+    vocab_size=128,
+    hidden_size=32,
+    intermediate_size=64,
+    num_hidden_layers=3,
+    num_attention_heads=4,
+    num_key_value_heads=2,
+    max_position_embeddings=256,
+)
+
+# Hand-picked crossings, each mixing deltas that never co-occur in a named
+# family.
+COMBOS = {
+    "bias+window+qknorm": dict(
+        attention_in_bias=True,
+        attention_out_bias=True,
+        sliding_window=5,
+        qk_norm=True,
+    ),
+    "moe+window+gelu": dict(
+        num_local_experts=4,
+        num_experts_per_tok=2,
+        sliding_window=6,
+        hidden_act="gelu",
+    ),
+    "sandwich+perlayer+bias": dict(
+        ffw_sandwich_norms=True,
+        sliding_window=5,
+        layer_sliding=(True, False, True),
+        attention_in_bias=True,
+        norm_unit_offset=True,
+    ),
+    "softcap+moe+embedscale": dict(
+        attn_logit_softcap=20.0,
+        final_logit_softcap=15.0,
+        num_local_experts=4,
+        embed_scale=True,
+        query_pre_attn_scalar=16,
+    ),
+    "ropelocal+qknorm+tied": dict(
+        rope_local_theta=10_000.0,
+        rope_theta=500_000.0,
+        sliding_window=5,
+        layer_sliding=(True, True, False),
+        qk_norm=True,
+        tie_word_embeddings=True,
+        mlp_bias=True,
+    ),
+}
+
+
+@pytest.mark.parametrize("combo", sorted(COMBOS), ids=sorted(COMBOS))
+def test_streaming_and_decode_invariants(combo, rng):
+    import zlib
+
+    cfg = LlamaConfig(**BASE, **COMBOS[combo])
+    # crc32, not hash(): hash() is salted per process, which would vary the
+    # sampled weights between runs.
+    params = llama.init_params(jax.random.PRNGKey(zlib.crc32(combo.encode())), cfg)
+    pattern = llama.layer_sliding_pattern(cfg)
+
+    prefix_ids = rng.integers(1, cfg.vocab_size, size=(9,))
+    suffix_ids = rng.integers(1, cfg.vocab_size, size=(4,))
+    lp, tmax = 12, 2
+
+    # --- streaming scorer path ---
+    prefix_padded = np.zeros((lp,), np.int32)
+    prefix_padded[: len(prefix_ids)] = prefix_ids
+    plen = jnp.asarray(len(prefix_ids), jnp.int32)
+    suffix_eos = jnp.asarray([len(suffix_ids) - 1])
+    ph = llama.embed(params["embed"], jnp.asarray(prefix_padded), jnp.float32, cfg)
+    sh = llama.embed(params["embed"], jnp.asarray(suffix_ids[None]), jnp.float32, cfg)
+    kvs = []
+    for layer, sliding in zip(params["layers"], pattern):
+        ph, sh, kv = llama.prefix_suffix_layer(
+            layer, cfg, ph, sh, plen, return_kv=True, sliding=sliding
+        )
+        kv["kg"] = jnp.zeros((1, tmax, cfg.num_key_value_heads, cfg.head_dim))
+        kv["vg"] = jnp.zeros((1, tmax, cfg.num_key_value_heads, cfg.head_dim))
+        kvs.append(kv)
+    normed = llama.select_eos_and_norm(params["norm"], cfg, sh, suffix_eos)
+    scores = np.asarray(
+        llama.lm_head_scores(
+            llama.head_params(params), normed, softcap=cfg.final_logit_softcap
+        )
+    )[0]
+
+    full = np.concatenate([prefix_ids, suffix_ids])[None, :]
+    logits = llama.forward_full(params, cfg, jnp.asarray(full))
+    want = np.asarray(jax.nn.softmax(logits[0, -1].astype(jnp.float32)))
+    np.testing.assert_allclose(scores, want, rtol=2e-4, atol=2e-5)
+
+    # --- decode path: two greedy tokens vs the monolithic forward ---
+    from flexible_llm_sharding_tpu.ops import rms_norm
+
+    ids_hist = np.concatenate([prefix_ids, suffix_ids])
+    next_id = int(np.argmax(scores))
+    for t in range(tmax):
+        x = llama.embed(params["embed"], jnp.asarray([[next_id]]), jnp.float32, cfg)
+        for li, layer in enumerate(params["layers"]):
+            x, kvs[li] = llama.decode_step_layer(
+                layer, cfg, x, kvs[li], plen, suffix_eos,
+                jnp.asarray(t, jnp.int32), sliding=pattern[li],
+            )
+        normed = rms_norm(
+            x, params["norm"]["scale"], cfg.rms_norm_eps, cfg.norm_unit_offset
+        )
+        step_scores = np.asarray(
+            llama.lm_head_scores(
+                llama.head_params(params), normed, softcap=cfg.final_logit_softcap
+            )
+        )[0]
+        ids_hist = np.concatenate([ids_hist, [next_id]])
+        logits = llama.forward_full(params, cfg, jnp.asarray(ids_hist[None]))
+        want = np.asarray(jax.nn.softmax(logits[0, -1].astype(jnp.float32)))
+        np.testing.assert_allclose(step_scores, want, rtol=2e-4, atol=2e-5)
+        next_id = int(np.argmax(step_scores))
