@@ -66,14 +66,17 @@ COMBOS = {
 
 
 @pytest.mark.parametrize("combo", sorted(COMBOS), ids=sorted(COMBOS))
-def test_streaming_and_decode_invariants(combo, rng):
+def test_streaming_and_decode_invariants(combo):
     import zlib
 
     cfg = LlamaConfig(**BASE, **COMBOS[combo])
     # crc32, not hash(): hash() is salted per process, which would vary the
-    # sampled weights between runs.
-    params = llama.init_params(jax.random.PRNGKey(zlib.crc32(combo.encode())), cfg)
+    # sampled weights between runs; a per-combo rng (not the shared session
+    # fixture) keeps the token ids reproducible in isolation too.
+    seed = zlib.crc32(combo.encode())
+    params = llama.init_params(jax.random.PRNGKey(seed), cfg)
     pattern = llama.layer_sliding_pattern(cfg)
+    rng = np.random.default_rng(seed)
 
     prefix_ids = rng.integers(1, cfg.vocab_size, size=(9,))
     suffix_ids = rng.integers(1, cfg.vocab_size, size=(4,))
