@@ -89,6 +89,24 @@ class LlamaConfig:
     # Gemma3: sliding (local) layers use this UNSCALED rope base while full
     # (global) layers use rope_theta + rope_scaling. None = single base.
     rope_local_theta: float | None = None
+    # Llama4 additions. Chunked attention: local layers (layer_sliding=True)
+    # attend within position chunks of this size instead of a sliding
+    # window (mutually exclusive with sliding_window). layer_rope: per-layer
+    # rope on/off (NoPE global layers). qk_l2_norm: weightless L2 norm on
+    # q/k AFTER rope, rope layers only. attn_temperature_tuning: NoPE-layer
+    # queries scale by log(floor((pos+1)/floor)+1)*coef + 1. moe_layer
+    # pattern: True = that layer's MLP is the (shared + routed top-k
+    # sigmoid-input-scaled) MoE; dense llama4 layers use
+    # intermediate_size_mlp.
+    attention_chunk_size: int | None = None
+    layer_rope: tuple[bool, ...] | None = None
+    rope_interleaved: bool = False  # llama4 complex-pair rotation
+    qk_l2_norm: bool = False
+    attn_temperature_tuning: bool = False
+    attn_floor_scale: float = 8192.0
+    attn_scale_coef: float = 0.1
+    moe_layer_pattern: tuple[bool, ...] | None = None
+    intermediate_size_mlp: int | None = None
 
     @property
     def attn_scale(self) -> float:
@@ -263,6 +281,62 @@ class LlamaConfig:
                 "gemma3 multimodal checkpoints are not supported; use the "
                 "text model (model_type 'gemma3_text')"
             )
+        elif model_type == "llama4_text":
+            kwargs.setdefault("explicit_head_dim", 128)  # Llama4 class default
+            kwargs.setdefault("rope_interleaved", True)
+            if d.get("use_qk_norm", True):
+                kwargs.setdefault("qk_l2_norm", True)
+            kwargs.setdefault("attn_temperature_tuning", d.get("attn_temperature_tuning", True))
+            kwargs.setdefault("attn_floor_scale", float(d.get("floor_scale", 8192)))
+            kwargs.setdefault("attn_scale_coef", float(d.get("attn_scale", 0.1)))
+            n = d.get("num_hidden_layers", 48)
+            # Chunked local layers (3:1 with NoPE full layers by default).
+            lt = d.get("layer_types") or [
+                "full_attention" if (i + 1) % 4 == 0 else "chunked_attention"
+                for i in range(n)
+            ]
+            if len(lt) != n:
+                raise ValueError(
+                    f"llama4 layer_types has {len(lt)} entries for {n} layers"
+                )
+            chunked = tuple(t == "chunked_attention" for t in lt)
+            kwargs.setdefault("attention_chunk_size", d.get("attention_chunk_size", 8192))
+            if not any(chunked):
+                kwargs["attention_chunk_size"] = None
+            elif not all(chunked):
+                kwargs.setdefault("layer_sliding", chunked)
+            # NoPE layers: no_rope_layers[i] == 0.
+            nr = d.get("no_rope_layers") or [
+                0 if (i + 1) % 4 == 0 else 1 for i in range(n)
+            ]
+            if len(nr) != n:
+                raise ValueError(
+                    f"llama4 no_rope_layers has {len(nr)} entries for {n} layers"
+                )
+            if not all(nr):
+                kwargs.setdefault("layer_rope", tuple(bool(x) for x in nr))
+            # MoE interleave: moe_layers when present, else every
+            # interleave_moe_layer_step-th layer.
+            step = d.get("interleave_moe_layer_step", 1)
+            moe_layers = d.get("moe_layers")
+            if moe_layers is None:
+                moe_layers = [i for i in range(n) if (i + 1) % step == 0]
+            if d.get("num_local_experts", 16) and moe_layers:
+                kwargs.setdefault("num_local_experts", d.get("num_local_experts", 16))
+                kwargs.setdefault("num_experts_per_tok", d.get("num_experts_per_tok", 1))
+                if len(moe_layers) != n:
+                    kwargs.setdefault(
+                        "moe_layer_pattern",
+                        tuple(i in set(moe_layers) for i in range(n)),
+                    )
+            else:
+                kwargs["num_local_experts"] = 0
+            kwargs.setdefault("intermediate_size_mlp", d.get("intermediate_size_mlp"))
+        elif model_type == "llama4":
+            raise NotImplementedError(
+                "llama4 multimodal checkpoints are not supported; use the "
+                "text model (model_type 'llama4_text')"
+            )
         elif model_type in ("mistral", "mixtral", "phi3"):
             # sliding_window flows through by field name (may be null);
             # mixtral's num_local_experts/num_experts_per_tok likewise.
@@ -275,9 +349,9 @@ class LlamaConfig:
             raise NotImplementedError(
                 f"model_type {model_type!r} is not supported "
                 "(llama, mistral, phi3, qwen2, qwen3, mixtral, gemma, "
-                "gemma2, gemma3_text are)"
+                "gemma2, gemma3_text, llama4_text are)"
             )
-        if model_type != "mixtral":
+        if model_type not in ("mixtral", "llama4_text"):
             # A stray num_local_experts key in a dense export must not flip
             # the model into MoE mode (same stray-key defence as
             # sliding_window above).
@@ -285,9 +359,14 @@ class LlamaConfig:
         if d.get("head_dim"):
             kwargs["explicit_head_dim"] = d["head_dim"]
         kwargs.setdefault("num_key_value_heads", d.get("num_attention_heads", 32))
-        if kwargs.get("layer_sliding") is not None:
-            # json round-trips tuples as lists; the field must stay hashable.
-            kwargs["layer_sliding"] = tuple(kwargs["layer_sliding"])
+        for key in ("layer_sliding", "layer_rope", "moe_layer_pattern"):
+            if kwargs.get(key) is not None:
+                # json round-trips tuples as lists; fields must stay hashable.
+                kwargs[key] = tuple(kwargs[key])
+        if kwargs.get("sliding_window") and kwargs.get("attention_chunk_size"):
+            raise ValueError(
+                "sliding_window and attention_chunk_size are mutually exclusive"
+            )
         act = kwargs.get("hidden_act", "silu")
         if act not in SUPPORTED_ACTIVATIONS:
             # Must fail here, not as a KeyError deep inside a jitted forward.

@@ -45,7 +45,13 @@ import jax
 import jax.numpy as jnp
 
 from flexible_llm_sharding_tpu.config import SUPPORTED_ACTIVATIONS, LlamaConfig
-from flexible_llm_sharding_tpu.ops import apply_rope, attention, rms_norm, rope_cos_sin
+from flexible_llm_sharding_tpu.ops import (
+    apply_rope,
+    apply_rope_interleaved,
+    attention,
+    rms_norm,
+    rope_cos_sin,
+)
 from flexible_llm_sharding_tpu.ops import pallas_attention
 from flexible_llm_sharding_tpu.ops.attention import (
     causal_mask,
@@ -155,7 +161,40 @@ def _moe_mlp(mlp: Params, cfg: LlamaConfig, x: jax.Array) -> jax.Array:
     return jnp.einsum("...lef,efd->...ld", h, mlp["down"].astype(x.dtype), precision=_PRECISION)
 
 
+def _llama4_moe_mlp(mlp: Params, cfg: LlamaConfig, x: jax.Array) -> jax.Array:
+    """Llama4's MoE: shared expert + top-k routed experts whose INPUT is
+    scaled by the sigmoid of the routed logit (HF Llama4TextMoe/Llama4Router:
+    top-k logits scattered into -inf, sigmoid in fp32, multiplied into the
+    hidden states BEFORE the expert FFN — unlike Mixtral's output weighting).
+    Same compute-all einsum layout as the Mixtral path; zero-scaled expert
+    inputs are hard-zeroed so they can't overflow."""
+    e, k = cfg.num_local_experts, cfg.num_experts_per_tok
+    act = _ACT[cfg.hidden_act]
+    logits = _mm(x, mlp["router"])  # [..., L, E]
+    top_vals, top_idx = jax.lax.top_k(logits.astype(jnp.float32), k)
+    c = jnp.sum(
+        jax.nn.one_hot(top_idx, e, dtype=jnp.float32)
+        * jax.nn.sigmoid(top_vals)[..., None],
+        axis=-2,
+    ).astype(x.dtype)  # [..., L, E]
+    xin = x[..., None, :] * c[..., None]  # [..., L, E, D]
+    xin = jnp.where(c[..., None] != 0, xin, jnp.zeros_like(xin))
+    h = act(
+        jnp.einsum("...led,edf->...lef", xin, mlp["gate"].astype(x.dtype), precision=_PRECISION)
+    ) * jnp.einsum("...led,edf->...lef", xin, mlp["up"].astype(x.dtype), precision=_PRECISION)
+    routed = jnp.einsum(
+        "...lef,efd->...ld", h, mlp["down"].astype(x.dtype), precision=_PRECISION
+    )  # contracts e AND f: sums the experts
+    shared = _mm(
+        act(_mm(x, mlp["shared_gate"])) * _mm(x, mlp["shared_up"]), mlp["shared_down"]
+    )
+    return shared + routed
+
+
 def _mlp(mlp: Params, x: jax.Array, cfg: LlamaConfig | None = None) -> jax.Array:
+    if "shared_gate" in mlp:
+        assert cfg is not None and cfg.num_local_experts > 0
+        return _llama4_moe_mlp(mlp, cfg, x)
     if "router" in mlp:
         assert cfg is not None and cfg.num_local_experts > 0
         return _moe_mlp(mlp, cfg, x)
@@ -198,11 +237,67 @@ def _residual_mlp(params: Params, cfg: LlamaConfig, x: jax.Array) -> jax.Array:
 
 
 def layer_sliding_pattern(cfg: LlamaConfig) -> tuple[bool, ...]:
-    """Per-layer sliding-window flags, one per decoder layer: the explicit
-    pattern (Gemma2 alternation) or the uniform on/off of sliding_window."""
+    """Per-layer local-attention flags, one per decoder layer: the explicit
+    pattern (Gemma2/Llama4 alternation) or the uniform on/off of the
+    configured local form (sliding_window / attention_chunk_size)."""
     if cfg.layer_sliding is not None:
         return cfg.layer_sliding
-    return (cfg.sliding_window is not None,) * cfg.num_hidden_layers
+    local = cfg.sliding_window is not None or cfg.attention_chunk_size is not None
+    return (local,) * cfg.num_hidden_layers
+
+
+def _l2_norm(x: jax.Array, eps: float = 1e-6) -> jax.Array:
+    """Llama4's weightless L2 norm (Llama4TextL2Norm): fp32 rsqrt-mean-square,
+    cast back — applied to q/k AFTER rope on rope layers."""
+    x32 = x.astype(jnp.float32)
+    return (x32 * jax.lax.rsqrt(jnp.mean(jnp.square(x32), -1, keepdims=True) + eps)).astype(x.dtype)
+
+
+def position_qk(cfg: LlamaConfig, q, k, positions, sliding, rope_on):
+    """Apply the per-layer position treatment to fresh q/k heads.
+
+    Standard families: rope at ``positions`` (per-layer base via ``sliding``,
+    gemma3). Llama4 adds: per-layer NoPE (``rope_on`` False/traced-False
+    layers keep q/k un-rotated), a weightless L2 norm on q/k after rope
+    (rope layers only), and temperature-tuned queries on NoPE layers
+    (q *= log(floor((pos+1)/floor)+1)*coef + 1). ``rope_on`` follows the
+    sliding convention: None = always on, python bool = static, traced
+    scalar = selected inside the scan program.
+    """
+    cos, sin = rope_for_layer(cfg, positions, sliding)
+    rot = apply_rope_interleaved if cfg.rope_interleaved else apply_rope
+    q_r, k_r = rot(q, cos, sin), rot(k, cos, sin)
+    if cfg.qk_l2_norm:
+        # HF builds Llama4TextL2Norm with config.rms_norm_eps.
+        q_r = _l2_norm(q_r, cfg.rms_norm_eps)
+        k_r = _l2_norm(k_r, cfg.rms_norm_eps)
+    if rope_on is None or rope_on is True:
+        return q_r, k_r
+    if cfg.attn_temperature_tuning:
+        # HF Llama4: scales = log(floor((pos+1)/floor_scale)+1)*coef + 1,
+        # fp32, applied to the (un-rotated) NoPE queries.
+        pos = jnp.asarray(positions, jnp.float32)
+        temp = (
+            jnp.log(jnp.floor((pos + 1.0) / cfg.attn_floor_scale) + 1.0)
+            * cfg.attn_scale_coef
+            + 1.0
+        )[..., None, None]
+        q_n = (q.astype(jnp.float32) * temp).astype(q.dtype)
+    else:
+        q_n = q
+    if rope_on is False:
+        return q_n, k
+    return (
+        jnp.where(rope_on, q_r, q_n),
+        jnp.where(rope_on, k_r, k),
+    )
+
+
+def layer_rope_pattern(cfg: LlamaConfig) -> tuple[bool, ...]:
+    """Per-layer rope flags (True = rotary applied); all-on when unset."""
+    if cfg.layer_rope is not None:
+        return cfg.layer_rope
+    return (True,) * cfg.num_hidden_layers
 
 
 def rope_for_layer(cfg: LlamaConfig, positions: jax.Array, sliding):
@@ -227,19 +322,23 @@ def rope_for_layer(cfg: LlamaConfig, positions: jax.Array, sliding):
     return jnp.where(sliding, cos_l, cos_g), jnp.where(sliding, sin_l, sin_g)
 
 
-def _effective_window(cfg: LlamaConfig, sliding) -> tuple[int | None, Any]:
-    """Resolve (window, sliding) for one layer.
+def _effective_window(cfg: LlamaConfig, sliding) -> tuple[int | None, int | None, Any]:
+    """Resolve (window, chunk, sliding) for one layer.
 
-    ``sliding``: None = uniform (cfg.sliding_window applies as-is); a python
+    ``sliding``: None = uniform (the cfg local form applies as-is); a python
     bool = static per-layer toggle (folds into the trace); a traced bool
-    scalar = dynamic toggle (Gemma2 layers under one scan program).
+    scalar = dynamic toggle (Gemma2/Llama4 layers under one scan program).
+    Exactly one of window (Mistral-style band) and chunk (Llama4 chunked
+    attention) can be set; both local forms share the toggle machinery.
     """
-    window = cfg.sliding_window
-    if window is None or sliding is None:
-        return window, None
+    window, chunk = cfg.sliding_window, cfg.attention_chunk_size
+    if (window is None and chunk is None) or sliding is None:
+        return window, chunk, None
     if isinstance(sliding, bool):
-        return (window if sliding else None), None
-    return window, sliding
+        if not sliding:
+            return None, None, None
+        return window, chunk, None
+    return window, chunk, sliding
 
 
 # ---------------------------------------------------------------------------
@@ -267,14 +366,14 @@ def decoder_layer(
     positions: jax.Array,
     mask: jax.Array | None,
     sliding=None,
+    rope_on=None,
 ) -> jax.Array:
     """Plain decoder layer. x: [..., L, D]; positions int [..., L] or [L];
-    mask broadcastable to [..., L, L] (caller bakes any sliding window in;
-    ``sliding`` only selects the per-layer rope base for gemma3)."""
+    mask broadcastable to [..., L, L] (caller bakes any local mask in;
+    ``sliding``/``rope_on`` select the per-layer rope base / NoPE)."""
     h = rms_norm(x, params["input_layernorm"]["scale"], cfg.rms_norm_eps, cfg.norm_unit_offset)
     q, k, v = _qkv(params["attn"], cfg, h)
-    cos, sin = rope_for_layer(cfg, positions, sliding)
-    q, k = apply_rope(q, cos, sin), apply_rope(k, cos, sin)
+    q, k = position_qk(cfg, q, k, positions, sliding, rope_on)
     attn_out = attention(
         q, k, v, mask, scale=cfg.attn_scale, softcap=cfg.attn_logit_softcap
     )
@@ -291,6 +390,7 @@ def prefix_suffix_layer(
     use_pallas: bool = False,
     return_kv: bool = False,
     sliding=None,
+    rope_on=None,
 ) -> tuple[jax.Array, ...]:
     """One decoder layer over a (prefix, suffixes) prompt — the streaming hot op.
 
@@ -313,20 +413,25 @@ def prefix_suffix_layer(
     s, ls, _ = suffix_h.shape
     eps = cfg.rms_norm_eps
     rope_sliding = sliding  # rope base selection survives the window shortcut
-    window, sliding = _effective_window(cfg, sliding)
-    if window is not None and lp + ls <= window:
+    window, chunk, sliding = _effective_window(cfg, sliding)
+    if (window is not None and lp + ls <= window) or (
+        chunk is not None and lp + ls <= chunk
+    ):
         # Max query-key distance at these (static) bucket shapes is
-        # lp + ls - 1 < window: the band equals full causal, so drop the
-        # window — keeping the flash kernels eligible (the common case for
-        # Mistral's 4096 window under the 4096 token cap).
-        window = sliding = None
+        # lp + ls - 1 < window (or every position sits in chunk 0): the
+        # local mask equals full causal, so drop it — keeping the flash
+        # kernels eligible (the common case for Mistral's 4096 window and
+        # Llama4's 8192 chunks under the 4096 token cap).
+        window = chunk = sliding = None
     # The flash kernels implement full causal masks with the default scale
-    # only; a *binding* sliding window, a traced per-layer toggle, an
-    # attention softcap, or a custom scale all fall back to the XLA
-    # attention (which fuses the banded mask / tanh cap anyway).
+    # and rotary-everywhere only; a *binding* local mask, a traced per-layer
+    # toggle, NoPE layers, an attention softcap, or a custom scale all fall
+    # back to the XLA attention (which fuses the banded mask / tanh cap).
     flash = (
         use_pallas
         and window is None
+        and chunk is None
+        and rope_on is None
         and cfg.attn_logit_softcap is None
         and cfg.query_pre_attn_scalar is None
         and pallas_attention.supports(
@@ -337,18 +442,19 @@ def prefix_suffix_layer(
     # --- prefix: causal self-attention, keep post-RoPE KV ---
     h = rms_norm(prefix_h, params["input_layernorm"]["scale"], eps, cfg.norm_unit_offset)
     q, k, v = _qkv(params["attn"], cfg, h)
-    cos, sin = rope_for_layer(cfg, jnp.arange(lp), rope_sliding)
-    q, k = apply_rope(q, cos, sin), apply_rope(k, cos, sin)
+    q, k = position_qk(cfg, q, k, jnp.arange(lp), rope_sliding, rope_on)
     if flash:
         # Rows at i >= prefix_len are padding; the kernel's valid-len mask
         # additionally skips fully-masked KV blocks.
         attn_out = pallas_attention.flash_causal_attention(q, k, v, prefix_len)
     else:
         if sliding is None:
-            mask = causal_mask(lp, lp, window=window)
-        else:  # traced per-layer toggle: banded iff this layer slides
+            mask = causal_mask(lp, lp, window=window, chunk=chunk)
+        else:  # traced per-layer toggle: local mask iff this layer is local
             mask = jnp.where(
-                sliding, causal_mask(lp, lp, window=window), causal_mask(lp, lp)
+                sliding,
+                causal_mask(lp, lp, window=window, chunk=chunk),
+                causal_mask(lp, lp),
             )
         attn_out = attention(
             q, k, v, mask, scale=cfg.attn_scale, softcap=cfg.attn_logit_softcap
@@ -361,8 +467,7 @@ def prefix_suffix_layer(
     hs = rms_norm(suffix_h, params["input_layernorm"]["scale"], eps, cfg.norm_unit_offset)
     qs, ks, vs = _qkv(params["attn"], cfg, hs)
     pos_s = prefix_len + jnp.arange(ls)
-    cos_s, sin_s = rope_for_layer(cfg, pos_s, rope_sliding)
-    qs, ks = apply_rope(qs, cos_s, sin_s), apply_rope(ks, cos_s, sin_s)
+    qs, ks = position_qk(cfg, qs, ks, pos_s, rope_sliding, rope_on)
 
     if flash:
         attn_s = pallas_attention.flash_prefix_shared_attention(
@@ -380,6 +485,7 @@ def prefix_suffix_layer(
             window=window,
             softcap=cfg.attn_logit_softcap,
             sliding=sliding,
+            chunk=chunk,
         )
     suffix_mid = _residual_attn(params, cfg, suffix_h, attn_s)
     suffix_out = _residual_mlp(params, cfg, suffix_mid)
@@ -398,6 +504,7 @@ def decode_step_layer(
     suffix_eos: jax.Array,
     t: jax.Array,
     sliding=None,
+    rope_on=None,
 ) -> tuple[jax.Array, Params]:
     """One decoder layer for ONE new token per suffix, against cached KV.
 
@@ -414,14 +521,13 @@ def decode_step_layer(
     h = rms_norm(x, params["input_layernorm"]["scale"], eps, cfg.norm_unit_offset)
     q, k_new, v_new = _qkv(params["attn"], cfg, h)  # [S, 1, n, hd]
     pos = (prefix_len + suffix_eos + 1 + t)[:, None]  # [S, 1]
-    cos, sin = rope_for_layer(cfg, pos, rope_sliding)
-    q, k_new = apply_rope(q, cos, sin), apply_rope(k_new, cos, sin)
+    q, k_new = position_qk(cfg, q, k_new, pos, rope_sliding, rope_on)
 
     kv = dict(kv)
     kv["kg"] = jax.lax.dynamic_update_slice_in_dim(kv["kg"], k_new, t, axis=1)
     kv["vg"] = jax.lax.dynamic_update_slice_in_dim(kv["vg"], v_new, t, axis=1)
 
-    window, sliding = _effective_window(cfg, sliding)
+    window, chunk, sliding = _effective_window(cfg, sliding)
     attn_out = decode_attention(
         q,
         kv["kp"],
@@ -437,6 +543,7 @@ def decode_step_layer(
         window=window,
         softcap=cfg.attn_logit_softcap,
         sliding=sliding,
+        chunk=chunk,
     )
     mid = _residual_attn(params, cfg, x, attn_out)
     return _residual_mlp(params, cfg, mid), kv
@@ -496,24 +603,34 @@ def forward_full(
     x = embed(params["embed"], ids, dtype, cfg)
     positions = jnp.arange(l)
     full = causal_mask(l, l)
-    banded = causal_mask(l, l, window=cfg.sliding_window)
+    banded = causal_mask(
+        l, l, window=cfg.sliding_window, chunk=cfg.attention_chunk_size
+    )
     pattern = layer_sliding_pattern(cfg)
+    rope_pat = layer_rope_pattern(cfg)
     layers = params["layers"]
     if isinstance(layers, (list, tuple)):
         for i, lp in enumerate(layers):
             x = decoder_layer(
                 lp, cfg, x, positions,
-                banded if pattern[i] else full, sliding=pattern[i],
+                banded if pattern[i] else full,
+                sliding=pattern[i], rope_on=rope_pat[i],
             )
     else:  # stacked pytree with leading layer axis -> scan (one compile)
         flags = jnp.asarray(pattern)
+        rflags = jnp.asarray(rope_pat)
 
         def body(h, xs):
-            layer_params, s = xs
-            mask = jnp.where(s, banded, full)
-            return decoder_layer(layer_params, cfg, h, positions, mask, sliding=s), None
+            layer_params, sl, ro = xs
+            mask = jnp.where(sl, banded, full)
+            return (
+                decoder_layer(
+                    layer_params, cfg, h, positions, mask, sliding=sl, rope_on=ro
+                ),
+                None,
+            )
 
-        x, _ = jax.lax.scan(body, x, (layers, flags))
+        x, _ = jax.lax.scan(body, x, (layers, flags, rflags))
     x = rms_norm(x, params["norm"]["scale"], cfg.rms_norm_eps, cfg.norm_unit_offset)
     logits = _mm(x, head_params(params)["kernel"]).astype(jnp.float32)
     if cfg.final_logit_softcap is not None:

@@ -6,5 +6,9 @@ Here they are pure jit-able JAX functions designed to fuse well under XLA.
 """
 
 from flexible_llm_sharding_tpu.ops.norm import rms_norm  # noqa: F401
-from flexible_llm_sharding_tpu.ops.rope import apply_rope, rope_cos_sin  # noqa: F401
+from flexible_llm_sharding_tpu.ops.rope import (  # noqa: F401
+    apply_rope,
+    apply_rope_interleaved,
+    rope_cos_sin,
+)
 from flexible_llm_sharding_tpu.ops.attention import attention  # noqa: F401

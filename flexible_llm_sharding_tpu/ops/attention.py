@@ -37,19 +37,31 @@ def _softcap(scores: jax.Array, cap: float | None) -> jax.Array:
     return jnp.tanh(scores / cap) * cap
 
 
-def _window_clause(mask: jax.Array, dist: jax.Array, window: int | None, sliding):
-    """AND the sliding-window visibility into ``mask``.
+def _local_clause(
+    mask: jax.Array,
+    q_pos: jax.Array,
+    k_pos: jax.Array,
+    window: int | None,
+    sliding,
+    chunk: int | None = None,
+):
+    """AND the local-attention visibility into ``mask``.
 
-    ``sliding`` is None (window applies statically) or a traced bool scalar
-    (per-layer toggle under a scan — Gemma2's alternating local/global
-    layers): masked iff sliding AND dist >= window.
+    Two local forms (mutually exclusive): a sliding ``window`` (visible iff
+    q_pos - k_pos < window, HF convention) or llama4 ``chunk``ed attention
+    (visible iff q_pos // chunk == k_pos // chunk). ``sliding`` is None
+    (applies statically) or a traced bool scalar (per-layer toggle under a
+    scan): masked iff sliding AND outside the local region.
     """
-    if window is None:
+    if window is None and chunk is None:
         return mask
-    in_window = dist < window
+    if window is not None:
+        in_local = (q_pos - k_pos) < window
+    else:
+        in_local = (q_pos // chunk) == (k_pos // chunk)
     if sliding is not None:
-        in_window = jnp.logical_or(jnp.logical_not(sliding), in_window)
-    return mask & in_window
+        in_local = jnp.logical_or(jnp.logical_not(sliding), in_local)
+    return mask & in_local
 
 
 def attention(
@@ -96,6 +108,7 @@ def prefix_shared_attention(
     window: int | None = None,
     softcap: float | None = None,
     sliding=None,
+    chunk: int | None = None,
 ) -> jax.Array:
     """Attention of S suffix continuations over [shared prefix KV ; own causal KV].
 
@@ -131,9 +144,9 @@ def prefix_shared_attention(
     kj = jnp.arange(lp + ls)[None, :]
     qi = jnp.arange(ls)[:, None]
     mask = jnp.where(kj < lp, kj < prefix_len, (kj - lp) <= qi)  # [Ls, Lp+Ls]
-    if window is not None:
+    if window is not None or chunk is not None:
         abs_k = jnp.where(kj < lp, kj, prefix_len + kj - lp)
-        mask = _window_clause(mask, (prefix_len + qi) - abs_k, window, sliding)
+        mask = _local_clause(mask, prefix_len + qi, abs_k, window, sliding, chunk)
     scores = jnp.where(mask[None, None, None], scores, _NEG_INF)
 
     probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
@@ -160,6 +173,7 @@ def decode_attention(
     window: int | None = None,
     softcap: float | None = None,
     sliding=None,
+    chunk: int | None = None,
 ) -> jax.Array:
     """Single-token decode attention over three cached KV regions.
 
@@ -203,7 +217,7 @@ def decode_attention(
         ],
         axis=-1,
     )  # [S, Lp+Ls+T]
-    if window is not None:
+    if window is not None or chunk is not None:
         # Absolute positions: query at prefix_len + suffix_eos[s] + 1 + t;
         # prefix key j at j, suffix key j at prefix_len + j, generated key j
         # at prefix_len + suffix_eos[s] + 1 + j. Sliding window masks keys
@@ -220,7 +234,7 @@ def decode_attention(
             ],
             axis=-1,
         )  # [S, Lp+Ls+T]
-        mask = _window_clause(mask, q_pos - abs_k, window, sliding)
+        mask = _local_clause(mask, q_pos, abs_k, window, sliding, chunk)
     scores = jnp.where(mask[:, None, None, None, :], scores, _NEG_INF)
 
     probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
@@ -236,14 +250,21 @@ def decode_attention(
 
 
 def causal_mask(
-    lq: int, lk: int, offset: int = 0, window: int | None = None
+    lq: int,
+    lk: int,
+    offset: int = 0,
+    window: int | None = None,
+    chunk: int | None = None,
 ) -> jax.Array:
     """Boolean causal mask [lq, lk]: query i attends key j iff j <= i + offset,
     and — with a sliding ``window`` (Mistral-style) — iff additionally
-    ``(i + offset) - j < window`` (HF masking_utils convention)."""
+    ``(i + offset) - j < window`` (HF masking_utils convention) — or with a
+    llama4 ``chunk`` — iff additionally both positions share a chunk."""
     qi = jax.lax.broadcasted_iota(jnp.int32, (lq, lk), 0)
     kj = jax.lax.broadcasted_iota(jnp.int32, (lq, lk), 1)
     mask = kj <= qi + offset
     if window is not None:
         mask &= (qi + offset) - kj < window
+    if chunk is not None:
+        mask &= ((qi + offset) // chunk) == (kj // chunk)
     return mask

@@ -77,3 +77,16 @@ def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
     c = cos[..., :, None, :].astype(x.dtype)
     s = sin[..., :, None, :].astype(x.dtype)
     return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+
+
+def apply_rope_interleaved(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
+    """Llama4's complex-pair rotation: ADJACENT (even, odd) dims form each
+    rotation pair (HF's torch.view_as_complex over reshape(..., -1, 2)),
+    unlike :func:`apply_rope`'s half-split pairing. Computed in float32 and
+    cast back, matching HF's xq.float() * freqs_cis path."""
+    x32 = x.astype(jnp.float32)
+    x1, x2 = x32[..., 0::2], x32[..., 1::2]
+    c = cos[..., :, None, :]
+    s = sin[..., :, None, :]
+    out = jnp.stack([x1 * c - x2 * s, x1 * s + x2 * c], axis=-1)
+    return out.reshape(x.shape).astype(x.dtype)

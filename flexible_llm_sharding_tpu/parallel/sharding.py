@@ -224,6 +224,9 @@ class TpPlacement:
                 "sliding": self.act
                 if cfg is not None and cfg.layer_sliding is not None
                 else None,
+                "rope": self.act
+                if cfg is not None and cfg.layer_rope is not None
+                else None,
             },
             # Embed/norm are small and read row-wise per token id; replicate.
             "embed": self.act,

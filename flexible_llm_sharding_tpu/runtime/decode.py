@@ -61,13 +61,14 @@ Params = dict[str, Any]
 def _prefill_decoders(cfg: LlamaConfig, use_pallas, seg, prefix_h, suffix_h, prefix_len):
     """Scan k layers over a block, emitting per-layer KV as scan outputs.
 
-    seg: {"layers": [k, ...] pytree, "sliding": bool [k] or None}.
+    seg: {"layers": [k, ...] pytree, "sliding": bool [k] or None,
+    "rope": bool [k] or None (llama4 NoPE flags)}.
     Returns (prefix_h, suffix_h, kv) with kv leaves shaped [k, B, ...].
     """
-    stacked, flags = seg["layers"], seg["sliding"]
+    stacked, flags, rflags = seg["layers"], seg["sliding"], seg.get("rope")
 
     def body(carry, xs):
-        layer_params, sliding = xs
+        layer_params, sliding, rope_on = xs
         p, s = carry
         step = jax.vmap(
             partial(
@@ -75,13 +76,16 @@ def _prefill_decoders(cfg: LlamaConfig, use_pallas, seg, prefix_h, suffix_h, pre
                 use_pallas=use_pallas,
                 return_kv=True,
                 sliding=sliding,
+                rope_on=rope_on,
             ),
             in_axes=(None, None, 0, 0, 0),
         )
         p, s, kv = step(layer_params, cfg, p, s, prefix_len)
         return (p, s), kv
 
-    (prefix_h, suffix_h), kv = jax.lax.scan(body, (prefix_h, suffix_h), (stacked, flags))
+    (prefix_h, suffix_h), kv = jax.lax.scan(
+        body, (prefix_h, suffix_h), (stacked, flags, rflags)
+    )
     return prefix_h, suffix_h, kv
 
 
@@ -89,23 +93,24 @@ def _prefill_decoders(cfg: LlamaConfig, use_pallas, seg, prefix_h, suffix_h, pre
 def _decode_decoders(cfg: LlamaConfig, seg, kv, x, prefix_len, suffix_eos, t):
     """Scan k layers' single-token decode over a block.
 
-    seg: {"layers": [k, ...] pytree, "sliding": bool [k] or None};
+    seg: {"layers": [k, ...] pytree, "sliding": bool [k] or None,
+    "rope": bool [k] or None};
     kv: pytree with leaves [k, B, ...] (kg/vg slots < t filled); x [B, S, 1, D];
     prefix_len [B]; suffix_eos [B, S]; t scalar. Returns (x, kv updated at t).
     kv and x are donated — each step reuses the previous buffers.
     """
-    stacked, flags = seg["layers"], seg["sliding"]
+    stacked, flags, rflags = seg["layers"], seg["sliding"], seg.get("rope")
 
     def body(x, layer):
-        layer_params, sliding, layer_kv = layer
+        layer_params, sliding, rope_on, layer_kv = layer
         step = jax.vmap(
-            partial(llama.decode_step_layer, sliding=sliding),
+            partial(llama.decode_step_layer, sliding=sliding, rope_on=rope_on),
             in_axes=(None, None, 0, 0, 0, 0, None),
         )
         x, layer_kv = step(layer_params, cfg, x, layer_kv, prefix_len, suffix_eos, t)
         return x, layer_kv
 
-    x, kv = jax.lax.scan(body, x, (stacked, flags, kv))
+    x, kv = jax.lax.scan(body, x, (stacked, flags, rflags, kv))
     return x, kv
 
 
@@ -234,6 +239,7 @@ class DecodeGenerator:
             prefetch_depth=self.cfg.prefetch_depth,
             tied_embeddings=self.model_cfg.tie_word_embeddings,
             layer_sliding=self.model_cfg.layer_sliding,
+            layer_rope=self.model_cfg.layer_rope,
         )
 
     def __call__(self, prompts, num_gen_token: int | None = None):
@@ -274,6 +280,9 @@ class DecodeGenerator:
                         ph, sh = None, None
                     else:
                         ph, sh = kv_store.get(("h", b), act_dev)
+                    di = 0  # decoders-segment index within this shard: a
+                    # shard can hold SEVERAL scan runs (llama4 interleaves
+                    # dense and MoE layer structures), each with its own KV.
                     for kind, params in segments:
                         if kind == "embed":
                             ph, sh = _embed_block(
@@ -305,7 +314,8 @@ class DecodeGenerator:
                                 "kg": jnp.zeros(gen_shape, self.dtype, device=act_dev),
                                 "vg": jnp.zeros(gen_shape, self.dtype, device=act_dev),
                             }
-                            kv_store.put(("kv", shard_pos, b), kv)
+                            kv_store.put(("kv", shard_pos, di, b), kv)
+                            di += 1
                         elif kind == "norm":
                             sh = _norm_block(self.model_cfg, params, sh, suffix_eos)
                             ph = None
@@ -337,6 +347,7 @@ class DecodeGenerator:
                             x = None
                         else:
                             x = kv_store.get(("x", b), act_dev)
+                        di = 0
                         for kind, params in segments:
                             if kind == "embed":
                                 ids = jnp.asarray(
@@ -344,12 +355,13 @@ class DecodeGenerator:
                                 )
                                 x = llama.embed(params, ids, self.dtype, self.model_cfg)
                             elif kind == "decoders":
-                                kv = kv_store.get(("kv", shard_pos, b), act_dev)
+                                kv = kv_store.get(("kv", shard_pos, di, b), act_dev)
                                 x, kv = _decode_decoders(
                                     self.model_cfg, params, kv, x,
                                     prefix_len, suffix_eos, jnp.int32(t),
                                 )
-                                kv_store.put(("kv", shard_pos, b), kv)
+                                kv_store.put(("kv", shard_pos, di, b), kv)
+                                di += 1
                             elif kind == "norm":
                                 norm_params = params  # applied inside the head
                             else:  # head

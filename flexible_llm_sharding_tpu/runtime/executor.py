@@ -83,26 +83,35 @@ def _decoder_block(
     """Scan k stacked decoder layers over a block of prompts.
 
     seg: {"layers": pytree with leading [k] axis, "sliding": bool [k] per-
-    layer window flags or None (uniform)}; prefix_h [B, Lp, D]; suffix_h
+    layer local-attention flags or None (uniform), "rope": bool [k]
+    per-layer rope flags or None}; prefix_h [B, Lp, D]; suffix_h
     [B, S, Ls, D]; prefix_len int32 [B]. Activations are donated — each scan
     step's output reuses the input buffers. ``use_pallas`` (static) routes
     attention through the flash kernels.
     """
     stacked, flags = seg["layers"], seg["sliding"]
+    rflags = seg.get("rope")
 
     def body(carry, xs):
-        layer_params, sliding = xs
+        layer_params, sliding, rope_on = xs
         p, s = carry
         step = jax.vmap(
-            partial(llama.prefix_suffix_layer, use_pallas=use_pallas, sliding=sliding),
+            partial(
+                llama.prefix_suffix_layer,
+                use_pallas=use_pallas,
+                sliding=sliding,
+                rope_on=rope_on,
+            ),
             in_axes=(None, None, 0, 0, 0),
         )
         p, s = step(layer_params, cfg, p, s, prefix_len)
         return (p, s), None
 
-    # flags may be None: scan treats it as an empty subtree, and the body's
-    # sliding arg arrives as None (the static uniform-window path).
-    (prefix_h, suffix_h), _ = jax.lax.scan(body, (prefix_h, suffix_h), (stacked, flags))
+    # flags may be None: scan treats them as empty subtrees, and the body's
+    # sliding/rope args arrive as None (the static uniform paths).
+    (prefix_h, suffix_h), _ = jax.lax.scan(
+        body, (prefix_h, suffix_h), (stacked, flags, rflags)
+    )
     return prefix_h, suffix_h
 
 
@@ -278,12 +287,14 @@ class _HostShardLoader:
     cast/stacked, so cold-cache disk latency overlaps host compute."""
 
     def __init__(self, model_path: str, layer_names: Sequence[str], np_dtype,
-                 tied_embeddings: bool = False, layer_sliding=None):
+                 tied_embeddings: bool = False, layer_sliding=None,
+                 layer_rope=None):
         self.model_path = model_path
         self.layer_names = list(layer_names)
         self.np_dtype = np_dtype
         self.tied = tied_embeddings
-        self.layer_sliding = layer_sliding  # per-decoder window flags or None
+        self.layer_sliding = layer_sliding  # per-decoder local-attn flags or None
+        self.layer_rope = layer_rope  # per-decoder rope flags (llama4 NoPE)
         self._tied_head: Params | None = None
         self.load_time = 0.0  # file->numpy wall time (cf. load_weights_time,
         # /root/reference/utils.py:223,304)
@@ -362,7 +373,14 @@ class _HostShardLoader:
                     flags = np.asarray(
                         [self.layer_sliding[i] for i in run_decoder_idx], bool
                     )
-                segments.append(("decoders", {"layers": stacked, "sliding": flags}))
+                rflags = None
+                if self.layer_rope is not None:
+                    rflags = np.asarray(
+                        [self.layer_rope[i] for i in run_decoder_idx], bool
+                    )
+                segments.append(
+                    ("decoders", {"layers": stacked, "sliding": flags, "rope": rflags})
+                )
                 run.clear()
                 run_decoder_idx.clear()
 
@@ -371,6 +389,11 @@ class _HostShardLoader:
             name = self.layer_names[idx]
             params = self._cast(self._load_one(name))
             if name.startswith("model.layers."):
+                if run and jax.tree.structure(run[-1]) != jax.tree.structure(params):
+                    # Mixed-structure stacks can't scan as one program
+                    # (llama4 interleaves dense and MoE layers): start a new
+                    # homogeneous run.
+                    flush()
                 run.append(params)
                 run_decoder_idx.append(int(name.split(".")[2]))
             else:
@@ -489,6 +512,7 @@ class ShardWeightSource:
         tied_embeddings: bool = False,
         devices: Sequence | None = None,
         layer_sliding=None,
+        layer_rope=None,
     ):
         self.shards = list(shards)
         # Either one device for every shard, or (pipeline mode) one target
@@ -501,7 +525,8 @@ class ShardWeightSource:
         else:
             self.shard_devices = [device] * len(self.shards)
         self._loader = _HostShardLoader(
-            model_path, layer_names, np_dtype, tied_embeddings, layer_sliding
+            model_path, layer_names, np_dtype, tied_embeddings, layer_sliding,
+            layer_rope,
         )
         self._q: Queue = Queue(maxsize=max(1, prefetch_depth))
         self._stop = threading.Event()
@@ -610,12 +635,14 @@ class BroadcastShardSource:
         tied_embeddings: bool = False,
         rounds: int = 1,
         layer_sliding=None,
+        layer_rope=None,
     ):
         self.shards = list(shards)
         self.devices = list(devices)
         self.rounds = rounds
         self._loader = _HostShardLoader(
-            model_path, layer_names, np_dtype, tied_embeddings, layer_sliding
+            model_path, layer_names, np_dtype, tied_embeddings, layer_sliding,
+            layer_rope,
         )
         depth = max(1, prefetch_depth)
         self._queues = [Queue(maxsize=depth) for _ in self.devices]
@@ -876,6 +903,7 @@ class StreamingExecutor:
                 prefetch_depth=self.cfg.prefetch_depth,
                 tied_embeddings=self.model_cfg.tie_word_embeddings,
                 layer_sliding=self.model_cfg.layer_sliding,
+                layer_rope=self.model_cfg.layer_rope,
             )
             skip = 0
 

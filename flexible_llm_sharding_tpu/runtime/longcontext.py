@@ -174,6 +174,10 @@ class LongContextScorer:
         mc = self.model_cfg
         if (
             mc.sliding_window is not None
+            or mc.attention_chunk_size is not None
+            or mc.layer_rope is not None
+            or mc.rope_interleaved
+            or mc.qk_l2_norm
             or mc.ffw_sandwich_norms
             or mc.attn_logit_softcap is not None
             or mc.query_pre_attn_scalar is not None
@@ -231,6 +235,7 @@ class LongContextScorer:
             prefetch_depth=self.cfg.prefetch_depth,
             tied_embeddings=self.model_cfg.tie_word_embeddings,
             layer_sliding=self.model_cfg.layer_sliding,
+            layer_rope=self.model_cfg.layer_rope,
         )
         stream = iter(source)
         try:

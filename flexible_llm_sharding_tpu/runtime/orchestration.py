@@ -90,6 +90,13 @@ def _tp_placement(cfg: FrameworkConfig, devices: list):
             f"chips, have {len(devices)}"
         )
     model_cfg = LlamaConfig.from_pretrained(cfg.model_path)
+    if model_cfg.model_type == "llama4_text":
+        # Llama4 interleaves structurally different layers (dense vs
+        # shared+routed MoE); TpPlacement's one-spec-per-kind trees cannot
+        # describe that yet.
+        raise NotImplementedError(
+            "--tensor_parallel is not supported for llama4 checkpoints yet"
+        )
     placement = TpPlacement(devices[: cfg.tensor_parallel], model_cfg)
     placement.check(model_cfg)
     return placement
@@ -196,6 +203,7 @@ def run_prompts(
         tied_embeddings=model_cfg.tie_word_embeddings,
         rounds=cfg.num_batch,
         layer_sliding=model_cfg.layer_sliding,
+        layer_rope=model_cfg.layer_rope,
     )
 
     def run_one(slot: int) -> list[np.ndarray]:
@@ -284,6 +292,7 @@ def run_decode(
         tied_embeddings=model_cfg.tie_word_embeddings,
         rounds=cfg.num_gen_token,
         layer_sliding=model_cfg.layer_sliding,
+        layer_rope=model_cfg.layer_rope,
     )
 
     def run_one(slot: int):

@@ -154,6 +154,7 @@ class PipelineRunner:
             prefetch_depth=self.cfg.prefetch_depth,
             tied_embeddings=self.model_cfg.tie_word_embeddings,
             layer_sliding=self.model_cfg.layer_sliding,
+            layer_rope=self.model_cfg.layer_rope,
         )
 
         n_layers = len(self.layer_names)

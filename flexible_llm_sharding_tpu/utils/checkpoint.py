@@ -221,11 +221,13 @@ def hf_layer_to_native(layer_name: str, sd: dict[str, np.ndarray]) -> dict[str, 
         return {"kernel": np.ascontiguousarray(sd["lm_head.weight"].T)}
     moe = any(".block_sparse_moe." in k for k in sd)
     fused = f"{layer_name}.self_attn.qkv_proj.weight" in sd  # phi3 layout
+    ff = any(".feed_forward." in k for k in sd)  # llama4 naming
+    ff_moe = f"{layer_name}.feed_forward.router.weight" in sd
     out = {}
     consumed = set()
     for native_key, hf_sub, transpose in _LAYER_MAP:
-        if moe and native_key.startswith("mlp."):
-            continue  # Mixtral layers carry block_sparse_moe instead
+        if (moe or ff) and native_key.startswith("mlp."):
+            continue  # Mixtral block_sparse_moe / llama4 feed_forward below
         if fused and native_key in (
             "attn.wq", "attn.wk", "attn.wv", "mlp.gate", "mlp.up"
         ):
@@ -260,6 +262,39 @@ def hf_layer_to_native(layer_name: str, sd: dict[str, np.ndarray]) -> dict[str, 
         if key in sd:
             consumed.add(key)
             out[native_key] = sd[key]
+    if ff and not ff_moe:
+        # Llama4 dense layer: feed_forward.{gate,up,down}_proj (its dense
+        # layers use intermediate_size_mlp, distinct from the experts').
+        for native_key, sub in (
+            ("mlp.gate", "gate_proj"), ("mlp.up", "up_proj"), ("mlp.down", "down_proj")
+        ):
+            key = f"{layer_name}.feed_forward.{sub}.weight"
+            out[native_key] = np.ascontiguousarray(sd[key].T)
+            consumed.add(key)
+    if ff_moe:
+        # Llama4 MoE layer: experts.gate_up_proj [E, D, 2F] (ALREADY
+        # [in, out] per expert — a Parameter, not a Linear) splits into
+        # gate/up; experts.down_proj [E, F, D] passes through; router
+        # [E, D] and the shared expert's Linears transpose as usual.
+        gu = sd[f"{layer_name}.feed_forward.experts.gate_up_proj"]
+        consumed.add(f"{layer_name}.feed_forward.experts.gate_up_proj")
+        f_dim = gu.shape[-1] // 2
+        out["mlp.gate"] = np.ascontiguousarray(gu[..., :f_dim])
+        out["mlp.up"] = np.ascontiguousarray(gu[..., f_dim:])
+        dk = f"{layer_name}.feed_forward.experts.down_proj"
+        out["mlp.down"] = sd[dk]
+        consumed.add(dk)
+        rk = f"{layer_name}.feed_forward.router.weight"
+        out["mlp.router"] = np.ascontiguousarray(sd[rk].T)
+        consumed.add(rk)
+        for native_key, sub in (
+            ("mlp.shared_gate", "gate_proj"),
+            ("mlp.shared_up", "up_proj"),
+            ("mlp.shared_down", "down_proj"),
+        ):
+            key = f"{layer_name}.feed_forward.shared_expert.{sub}.weight"
+            out[native_key] = np.ascontiguousarray(sd[key].T)
+            consumed.add(key)
     if moe:
         # Mixtral MoE: router [E, D] -> [D, E]; per-expert w1 (gate) / w3
         # (up) [F, D] and w2 (down) [D, F] stack into [E, D, F] / [E, F, D]
@@ -561,6 +596,15 @@ def save_params(params: dict[str, Any], out_dir: str, cfg: LlamaConfig) -> None:
         "query_pre_attn_scalar": cfg.query_pre_attn_scalar,
         "layer_sliding": list(cfg.layer_sliding) if cfg.layer_sliding else None,
         "rope_local_theta": cfg.rope_local_theta,
+        "attention_chunk_size": cfg.attention_chunk_size,
+        "rope_interleaved": cfg.rope_interleaved,
+        "layer_rope": list(cfg.layer_rope) if cfg.layer_rope else None,
+        "qk_l2_norm": cfg.qk_l2_norm,
+        "attn_temperature_tuning": cfg.attn_temperature_tuning,
+        "attn_floor_scale": cfg.attn_floor_scale,
+        "attn_scale_coef": cfg.attn_scale_coef,
+        "moe_layer_pattern": list(cfg.moe_layer_pattern) if cfg.moe_layer_pattern else None,
+        "intermediate_size_mlp": cfg.intermediate_size_mlp,
         "rope_scaling_kind": cfg.rope_scaling_kind,
         "rope_scaling_factor": cfg.rope_scaling_factor,
         "rope_low_freq_factor": cfg.rope_low_freq_factor,

@@ -735,6 +735,187 @@ def test_phi3_split_and_executor(rng, tmp_path):
         )
 
 
+LLAMA4_CFG = LlamaConfig(
+    model_type="llama4_text",
+    vocab_size=256,
+    hidden_size=32,
+    intermediate_size=48,  # experts + shared expert
+    intermediate_size_mlp=64,  # the DENSE layers' own width
+    num_hidden_layers=3,
+    num_attention_heads=4,
+    num_key_value_heads=2,
+    max_position_embeddings=512,
+    explicit_head_dim=8,
+    num_local_experts=4,
+    num_experts_per_tok=1,
+    moe_layer_pattern=(False, True, False),  # interleave_moe_layer_step=2
+    attention_chunk_size=4,  # binds at 17 tokens
+    rope_interleaved=True,
+    layer_sliding=(True, True, False),
+    layer_rope=(True, True, False),  # NoPE on the full-attention layer
+    qk_l2_norm=True,
+    attn_temperature_tuning=True,
+    attn_floor_scale=4.0,  # temperature != 1 from position 3 on
+    attn_scale_coef=0.1,
+)
+
+
+def _hf_llama4(cfg: LlamaConfig):
+    from transformers import Llama4TextConfig
+    from transformers.models.llama4.modeling_llama4 import Llama4ForCausalLM
+
+    torch.manual_seed(0)
+    return Llama4ForCausalLM(
+        Llama4TextConfig(
+            vocab_size=cfg.vocab_size,
+            hidden_size=cfg.hidden_size,
+            intermediate_size=cfg.intermediate_size,
+            intermediate_size_mlp=cfg.intermediate_size_mlp,
+            num_hidden_layers=cfg.num_hidden_layers,
+            num_attention_heads=cfg.num_attention_heads,
+            num_key_value_heads=cfg.num_key_value_heads,
+            rms_norm_eps=cfg.rms_norm_eps,
+            rope_theta=cfg.rope_theta,
+            max_position_embeddings=cfg.max_position_embeddings,
+            tie_word_embeddings=False,
+            head_dim=cfg.head_dim,
+            num_local_experts=cfg.num_local_experts,
+            num_experts_per_tok=cfg.num_experts_per_tok,
+            interleave_moe_layer_step=2,
+            attention_chunk_size=cfg.attention_chunk_size,
+            layer_types=[
+                "chunked_attention" if s else "full_attention"
+                for s in cfg.layer_sliding
+            ],
+            no_rope_layers=[int(r) for r in cfg.layer_rope],
+            use_qk_norm=cfg.qk_l2_norm,
+            attn_temperature_tuning=cfg.attn_temperature_tuning,
+            floor_scale=cfg.attn_floor_scale,
+            attn_scale=cfg.attn_scale_coef,
+            pad_token_id=0,
+            attn_implementation="eager",
+        )
+    ).eval()
+
+
+def test_llama4_forward_matches_hf(rng):
+    """Llama4's full delta set: chunked local layers (binding at 17 tokens),
+    a NoPE full-attention layer with temperature-tuned queries, post-rope
+    L2 q/k norms, and the interleaved dense / (shared + top-1
+    sigmoid-input-scaled routed) MoE feed-forwards."""
+    model = _hf_llama4(LLAMA4_CFG)
+    params = _params_from_hf(model, LLAMA4_CFG)
+    assert "shared_gate" in params["layers"][1]["mlp"]  # MoE layer
+    assert "router" not in params["layers"][0]["mlp"]  # dense layer
+    assert params["layers"][1]["mlp"]["gate"].shape == (4, 32, 48)
+    assert params["layers"][0]["mlp"]["gate"].shape == (32, 64)
+    ids = rng.integers(1, LLAMA4_CFG.vocab_size, size=(2, 17))
+    with torch.no_grad():
+        hf_logits = model(torch.tensor(ids)).logits.numpy()
+    ours = np.asarray(llama.forward_full(params, LLAMA4_CFG, jnp.asarray(ids)))
+    np.testing.assert_allclose(ours, hf_logits, rtol=3e-4, atol=3e-4)
+
+
+def test_llama4_streaming_matches_monolithic(rng):
+    """The streaming invariant across the mixed dense/MoE, chunked/NoPE
+    stack (per-layer sliding AND rope flags through prefix_suffix_layer)."""
+    model = _hf_llama4(LLAMA4_CFG)
+    params = _params_from_hf(model, LLAMA4_CFG)
+    cfg = LLAMA4_CFG
+    prefix_ids = rng.integers(1, cfg.vocab_size, size=(11,))
+    suffix_ids_list = [rng.integers(1, cfg.vocab_size, size=(n,)) for n in (3, 5)]
+    rope_pat = llama.layer_rope_pattern(cfg)
+    pattern = llama.layer_sliding_pattern(cfg)
+
+    s_cnt, ls = len(suffix_ids_list), max(len(x) for x in suffix_ids_list)
+    prefix_padded = np.zeros((16,), np.int32)
+    prefix_padded[:11] = prefix_ids
+    suffix_padded = np.zeros((s_cnt, ls), np.int32)
+    for i, sid in enumerate(suffix_ids_list):
+        suffix_padded[i, : len(sid)] = sid
+    suffix_eos = jnp.asarray([len(x) - 1 for x in suffix_ids_list])
+    ph = llama.embed(params["embed"], jnp.asarray(prefix_padded), jnp.float32, cfg)
+    sh = llama.embed(params["embed"], jnp.asarray(suffix_padded), jnp.float32, cfg)
+    plen = jnp.asarray(11, jnp.int32)
+    for layer, sl, ro in zip(params["layers"], pattern, rope_pat):
+        ph, sh = llama.prefix_suffix_layer(
+            layer, cfg, ph, sh, plen, sliding=sl, rope_on=ro
+        )
+    normed = llama.select_eos_and_norm(params["norm"], cfg, sh, suffix_eos)
+    scores = llama.lm_head_scores(llama.head_params(params), normed)
+    for i, sid in enumerate(suffix_ids_list):
+        full = np.concatenate([prefix_ids, sid])[None, :]
+        logits = llama.forward_full(params, cfg, jnp.asarray(full))
+        want = jax.nn.softmax(logits[0, -1].astype(jnp.float32))
+        np.testing.assert_allclose(
+            np.asarray(scores[i]), np.asarray(want), rtol=3e-4, atol=3e-5
+        )
+
+
+def test_llama4_split_and_executor(rng, tmp_path):
+    """HF checkpoint -> splitter (feed_forward keys, fused expert gate_up,
+    router, shared expert) -> streaming executor (mixed-structure stacks
+    split into homogeneous scan runs) vs the HF oracle, incl. generation
+    through the decode runtime."""
+    from flexible_llm_sharding_tpu.runtime.decode import DecodeGenerator
+
+    model = _hf_llama4(LLAMA4_CFG)
+    src = tmp_path / "hf"
+    model.save_pretrained(str(src))
+    out = tmp_path / "native"
+    ckpt.split_into_layers(str(src), str(out))
+    layer = ckpt.load_layer(str(out), "model.layers.1")
+    assert set(layer["mlp"]) == {
+        "router", "gate", "up", "down", "shared_gate", "shared_up", "shared_down"
+    }
+    back = LlamaConfig.from_pretrained(str(out))
+    assert back.moe_layer_pattern == (False, True, False)
+    assert back.layer_rope == (True, True, False)
+    assert back.attention_chunk_size == 4
+
+    prompts = [("The capital of France", (" is Paris", " is Rome"))]
+    # layer_num_per_shard=3 forces one shard spanning the dense/MoE/dense
+    # boundary — the loader must split it into homogeneous scan runs.
+    fw = FrameworkConfig(
+        model_path=str(out),
+        dtype="float32",
+        bucket_multiple=8,
+        layer_num_per_shard=3,
+        prefetch_depth=0,
+    )
+    got = StreamingExecutor(fw, tokenizer=FakeTokenizer())(prompts)
+    tok = PromptTokenizer(FakeTokenizer(), bucket_multiple=8)
+    t = tok(*prompts[0])
+    for s in range(t.num_suffixes):
+        n_real = int(t.suffix_eos[s]) + 1
+        full = np.concatenate(
+            [t.prefix_ids[: t.prefix_len], t.suffix_ids[s, :n_real]]
+        ).astype(np.int64)
+        with torch.no_grad():
+            logits = model(torch.tensor(full[None])).logits[0, -1]
+        want = torch.softmax(logits.float(), -1).numpy()
+        np.testing.assert_allclose(got[0][s, 0], want, rtol=3e-4, atol=3e-5)
+
+    # KV-cache decode over the same checkpoint: greedy tokens match the
+    # token-level HF oracle.
+    import dataclasses
+
+    gen = DecodeGenerator(
+        dataclasses.replace(fw, num_gen_token=3), tokenizer=FakeTokenizer()
+    )
+    scores, _ = gen(prompts)
+    for s in range(t.num_suffixes):
+        ids = np.concatenate(
+            [t.prefix_ids[: t.prefix_len], t.suffix_ids[s, : int(t.suffix_eos[s]) + 1]]
+        ).astype(np.int64)
+        for g in range(3):
+            with torch.no_grad():
+                logits = model(torch.tensor(ids[None])).logits[0, -1]
+            want = torch.softmax(logits.float(), -1).numpy()
+            np.testing.assert_allclose(scores[0][s, g], want, rtol=3e-4, atol=3e-5)
+            ids = np.concatenate([ids, [int(want.argmax())]])
+
+
 def test_mixtral_forward_matches_hf(rng):
     """MoE routing parity with MixtralSparseMoeBlock: softmax-then-topk,
     renormalised, applied to each expert's FFN output."""
