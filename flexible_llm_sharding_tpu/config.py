@@ -105,8 +105,20 @@ class LlamaConfig:
     attn_temperature_tuning: bool = False
     attn_floor_scale: float = 8192.0
     attn_scale_coef: float = 0.1
+    # Descriptive round-trip metadata: the runtime derives MoE-vs-dense
+    # structure and the dense width from the checkpoint's weight keys/shapes
+    # (the files are ground truth); these record the pattern for tooling.
     moe_layer_pattern: tuple[bool, ...] | None = None
     intermediate_size_mlp: int | None = None
+
+    def __post_init__(self) -> None:
+        if self.sliding_window is not None and self.attention_chunk_size is not None:
+            # The attention ops implement exactly one local form per model;
+            # both set would make the monolithic and streaming paths mask
+            # differently instead of failing loudly.
+            raise ValueError(
+                "sliding_window and attention_chunk_size are mutually exclusive"
+            )
 
     @property
     def attn_scale(self) -> float:
@@ -147,15 +159,19 @@ class LlamaConfig:
         )
 
     @staticmethod
-    def _sliding_pattern(d: dict[str, Any], family: str, default_fn) -> tuple[bool, ...]:
-        """Per-layer sliding flags from ``layer_types`` (validated against
-        num_hidden_layers) or the family's derivation rule ``default_fn(i, n)``."""
+    def _sliding_pattern(
+        d: dict[str, Any], family: str, default_fn, token: str = "sliding_attention"
+    ) -> tuple[bool, ...]:
+        """Per-layer local-attention flags from ``layer_types`` (validated
+        against num_hidden_layers) or the family's derivation rule
+        ``default_fn(i, n)``. ``token`` is the layer_types value meaning
+        "local" (llama4 uses 'chunked_attention')."""
         # 32 = this dataclass's num_hidden_layers default, so a derived
         # pattern always matches the constructed config's layer count.
         n = d.get("num_hidden_layers", 32)
         lt = d.get("layer_types")
         pattern = (
-            tuple(t == "sliding_attention" for t in lt)
+            tuple(t == token for t in lt)
             if lt
             else tuple(bool(default_fn(i, n)) for i in range(n))
         )
@@ -291,20 +307,19 @@ class LlamaConfig:
             kwargs.setdefault("attn_scale_coef", float(d.get("attn_scale", 0.1)))
             n = d.get("num_hidden_layers", 48)
             # Chunked local layers (3:1 with NoPE full layers by default).
-            lt = d.get("layer_types") or [
-                "full_attention" if (i + 1) % 4 == 0 else "chunked_attention"
-                for i in range(n)
-            ]
-            if len(lt) != n:
-                raise ValueError(
-                    f"llama4 layer_types has {len(lt)} entries for {n} layers"
+            if "layer_sliding" not in kwargs:
+                chunked = cls._sliding_pattern(
+                    d, "llama4",
+                    lambda i, nn: (i + 1) % 4 != 0,
+                    token="chunked_attention",
                 )
-            chunked = tuple(t == "chunked_attention" for t in lt)
-            kwargs.setdefault("attention_chunk_size", d.get("attention_chunk_size", 8192))
-            if not any(chunked):
-                kwargs["attention_chunk_size"] = None
-            elif not all(chunked):
-                kwargs.setdefault("layer_sliding", chunked)
+                kwargs.setdefault(
+                    "attention_chunk_size", d.get("attention_chunk_size", 8192)
+                )
+                if not any(chunked):
+                    kwargs["attention_chunk_size"] = None
+                elif not all(chunked):
+                    kwargs["layer_sliding"] = chunked
             # NoPE layers: no_rope_layers[i] == 0.
             nr = d.get("no_rope_layers") or [
                 0 if (i + 1) % 4 == 0 else 1 for i in range(n)

@@ -53,6 +53,23 @@ COMBOS = {
         embed_scale=True,
         query_pre_attn_scalar=16,
     ),
+    "chunk+nope+temp+qkl2": dict(
+        attention_chunk_size=4,
+        layer_sliding=(True, True, False),
+        layer_rope=(True, False, True),
+        qk_l2_norm=True,
+        attn_temperature_tuning=True,
+        attn_floor_scale=4.0,
+        rope_interleaved=True,
+    ),
+    "chunk+moe+sandwich": dict(
+        attention_chunk_size=5,
+        num_local_experts=4,
+        num_experts_per_tok=2,
+        ffw_sandwich_norms=True,
+        norm_unit_offset=True,
+        embed_scale=True,
+    ),
     "ropelocal+qknorm+tied": dict(
         rope_local_theta=10_000.0,
         rope_theta=500_000.0,
@@ -76,6 +93,7 @@ def test_streaming_and_decode_invariants(combo):
     seed = zlib.crc32(combo.encode())
     params = llama.init_params(jax.random.PRNGKey(seed), cfg)
     pattern = llama.layer_sliding_pattern(cfg)
+    rope_pat = llama.layer_rope_pattern(cfg)
     rng = np.random.default_rng(seed)
 
     prefix_ids = rng.integers(1, cfg.vocab_size, size=(9,))
@@ -90,9 +108,9 @@ def test_streaming_and_decode_invariants(combo):
     ph = llama.embed(params["embed"], jnp.asarray(prefix_padded), jnp.float32, cfg)
     sh = llama.embed(params["embed"], jnp.asarray(suffix_ids[None]), jnp.float32, cfg)
     kvs = []
-    for layer, sliding in zip(params["layers"], pattern):
+    for layer, sliding, rope_on in zip(params["layers"], pattern, rope_pat):
         ph, sh, kv = llama.prefix_suffix_layer(
-            layer, cfg, ph, sh, plen, return_kv=True, sliding=sliding
+            layer, cfg, ph, sh, plen, return_kv=True, sliding=sliding, rope_on=rope_on
         )
         kv["kg"] = jnp.zeros((1, tmax, cfg.num_key_value_heads, cfg.head_dim))
         kv["vg"] = jnp.zeros((1, tmax, cfg.num_key_value_heads, cfg.head_dim))
@@ -119,7 +137,7 @@ def test_streaming_and_decode_invariants(combo):
         for li, layer in enumerate(params["layers"]):
             x, kvs[li] = llama.decode_step_layer(
                 layer, cfg, x, kvs[li], plen, suffix_eos,
-                jnp.asarray(t, jnp.int32), sliding=pattern[li],
+                jnp.asarray(t, jnp.int32), sliding=pattern[li], rope_on=rope_pat[li],
             )
         normed = rms_norm(
             x, params["norm"]["scale"], cfg.rms_norm_eps, cfg.norm_unit_offset
