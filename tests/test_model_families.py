@@ -1296,3 +1296,36 @@ def test_executor_end_to_end(cfg, rng, tmp_path):
             logits = llama.forward_full(params, cfg, jnp.asarray(full))
             want = np.asarray(jax.nn.softmax(logits[0, -1]))
             np.testing.assert_allclose(scores[s, 0], want, rtol=2e-4, atol=2e-5)
+
+
+@pytest.mark.parametrize("mode", ["mp", "dp"])
+def test_llama4_multichip(tmp_path, mode):
+    """Llama4's mixed-structure stacks through the multi-chip orchestration:
+    the interleaved MP pipeline and the DP broadcast stream must match the
+    single-device run (which is HF-oracle-pinned above)."""
+    from flexible_llm_sharding_tpu.runtime.orchestration import run_prompts
+
+    model = _hf_llama4(LLAMA4_CFG)
+    src = tmp_path / "hf"
+    model.save_pretrained(str(src))
+    out = tmp_path / "native"
+    ckpt.split_into_layers(str(src), str(out))
+    prompts = [
+        ("The capital of France", (" is Paris", " is Rome")),
+        ("Two plus two equals", (" four", " five")),
+    ]
+    fw = FrameworkConfig(
+        model_path=str(out),
+        dtype="float32",
+        bucket_multiple=8,
+        layer_num_per_shard=2,  # shards span the dense/MoE boundary
+        prefetch_depth=1,
+        data_parallel=(mode == "dp"),
+        disk_folder=str(tmp_path / "acts"),
+    )
+    single = StreamingExecutor(fw, tokenizer=FakeTokenizer())(prompts)
+    multi = run_prompts(
+        fw, prompts, tokenizer=FakeTokenizer(), devices=jax.devices()[:3]
+    )
+    for a, b in zip(single, multi):
+        np.testing.assert_allclose(b, a, rtol=1e-4, atol=1e-5)

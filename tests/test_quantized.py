@@ -344,3 +344,41 @@ def test_int8_multichip(dirs, tiny_cfg, mode, tmp_path):
     multi = run_prompts(fw, PROMPTS, tokenizer=FakeTokenizer(), devices=jax.devices()[:3])
     for a, b in zip(single, multi):
         np.testing.assert_allclose(b, a, rtol=1e-4, atol=1e-5)
+
+
+def test_int8_llama4_moe(tmp_path):
+    """int8 over llama4's fused-expert tensors: [E, D, F] kernels quantize
+    per final channel and the stacked dequant broadcasts [k, F] scales over
+    the expert/input axes; scores must match the host-dequantized oracle."""
+    from tests.test_model_families import LLAMA4_CFG, _hf_llama4
+
+    model = _hf_llama4(LLAMA4_CFG)
+    src = tmp_path / "hf"
+    model.save_pretrained(str(src))
+    q8 = tmp_path / "q8"
+    ckpt.split_into_layers(str(src), str(q8), dtype="int8")
+    layer = ckpt.load_layer(str(q8), "model.layers.1")
+    assert ckpt.is_quantized_leaf(layer["mlp"]["gate"])
+    assert layer["mlp"]["gate"]["s"].shape == (48,)  # per-F channel
+
+    fw = FrameworkConfig(
+        model_path=str(q8),
+        dtype="float32",
+        bucket_multiple=8,
+        layer_num_per_shard=3,
+        prefetch_depth=0,
+    )
+    prompts = [("The capital of France", (" is Paris", " is Rome"))]
+    got = StreamingExecutor(fw, tokenizer=FakeTokenizer())(prompts)
+
+    params_deq = _dequantized_params(str(q8), LLAMA4_CFG)
+    tok = PromptTokenizer(FakeTokenizer(), bucket_multiple=8)
+    t = tok(*prompts[0])
+    for s in range(t.num_suffixes):
+        n_real = int(t.suffix_eos[s]) + 1
+        full = np.concatenate(
+            [t.prefix_ids[: t.prefix_len], t.suffix_ids[s, :n_real]]
+        )[None, :]
+        logits = llama.forward_full(params_deq, LLAMA4_CFG, jnp.asarray(full))
+        want = np.asarray(jax.nn.softmax(logits[0, -1]))
+        np.testing.assert_allclose(got[0][s, 0], want, rtol=3e-4, atol=3e-5)
