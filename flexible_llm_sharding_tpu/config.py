@@ -58,10 +58,13 @@ class LlamaConfig:
     # None = full causal. Semantics match HF masking_utils: query i attends
     # key j iff j <= i and i - j < sliding_window.
     sliding_window: int | None = None
-    # Mixture-of-experts MLP (Mixtral). 0 = dense. Routing matches HF:
-    # softmax over all experts (fp32) -> top-k -> renormalise -> combine.
+    # Mixture-of-experts MLP (Mixtral / Qwen3-MoE). 0 = dense. Routing
+    # matches HF: softmax over all experts (fp32) -> top-k -> renormalise
+    # (iff moe_norm_topk_prob; HF calls it norm_topk_prob and it is the
+    # ONLY difference between the Mixtral and Qwen3-MoE blocks) -> combine.
     num_local_experts: int = 0
     num_experts_per_tok: int = 2
+    moe_norm_topk_prob: bool = True
     # Per-head-dim RMSNorm on q/k after the head reshape, before RoPE
     # (Qwen3; HF: 'unlike olmo, only on the head dim').
     qk_norm: bool = False
@@ -232,7 +235,7 @@ class LlamaConfig:
             kwargs.setdefault("attention_in_bias", True)
             kwargs.setdefault("attention_out_bias", False)
             cls._apply_qwen_window(kwargs, d)
-        elif model_type == "qwen3":
+        elif model_type in ("qwen3", "qwen3_moe"):
             # One attention_bias flag for all four projections (like Llama,
             # default False) + per-head-dim q/k RMSNorm.
             if d.get("attention_bias"):
@@ -240,7 +243,27 @@ class LlamaConfig:
                 kwargs.setdefault("attention_out_bias", True)
             kwargs.setdefault("qk_norm", True)
             cls._apply_qwen_window(kwargs, d)
-            kwargs.setdefault("explicit_head_dim", 128)  # Qwen3Config default
+            if model_type == "qwen3":
+                # Dense Qwen3Config's class default; Qwen3MoeConfig has NO
+                # head_dim attribute (falls back to hidden/heads), so the
+                # MoE branch must not invent one.
+                kwargs.setdefault("explicit_head_dim", 128)
+            if model_type == "qwen3_moe":
+                if not d.get("num_experts") and not d.get("num_local_experts"):
+                    raise ValueError("qwen3_moe config without num_experts")
+                kwargs.setdefault("num_local_experts", d.get("num_experts", 0))
+                kwargs.setdefault("num_experts_per_tok", d.get("num_experts_per_tok", 8))
+                kwargs.setdefault("moe_norm_topk_prob", d.get("norm_topk_prob", False))
+                # Dense layers (mlp_only_layers / decoder_sparse_step) are a
+                # checkpoint-structure fact; record the pattern as metadata.
+                step = d.get("decoder_sparse_step", 1)
+                only = set(d.get("mlp_only_layers") or [])
+                n = d.get("num_hidden_layers", 32)  # match the dataclass default
+                pattern = tuple(
+                    i not in only and (i + 1) % step == 0 for i in range(n)
+                )
+                if not all(pattern):
+                    kwargs.setdefault("moe_layer_pattern", pattern)
         elif model_type == "gemma":
             kwargs.setdefault("norm_unit_offset", True)
             kwargs.setdefault("embed_scale", True)
@@ -363,10 +386,10 @@ class LlamaConfig:
         else:
             raise NotImplementedError(
                 f"model_type {model_type!r} is not supported "
-                "(llama, mistral, phi3, qwen2, qwen3, mixtral, gemma, "
+                "(llama, mistral, phi3, qwen2, qwen3, qwen3_moe, mixtral, gemma, "
                 "gemma2, gemma3_text, llama4_text are)"
             )
-        if model_type not in ("mixtral", "llama4_text"):
+        if model_type not in ("mixtral", "llama4_text", "qwen3_moe"):
             # A stray num_local_experts key in a dense export must not flip
             # the model into MoE mode (same stray-key defence as
             # sliding_window above).

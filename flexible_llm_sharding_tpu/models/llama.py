@@ -142,7 +142,8 @@ def _moe_mlp(mlp: Params, cfg: LlamaConfig, x: jax.Array) -> jax.Array:
     logits = _mm(x, mlp["router"])  # [..., L, E], model dtype (HF gate dtype)
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
     top_vals, top_idx = jax.lax.top_k(probs, k)  # sorted desc, like torch.topk
-    top_vals = top_vals / jnp.sum(top_vals, axis=-1, keepdims=True)
+    if cfg.moe_norm_topk_prob:  # Mixtral always; Qwen3-MoE per norm_topk_prob
+        top_vals = top_vals / jnp.sum(top_vals, axis=-1, keepdims=True)
     # Scatter the k renormalised weights back onto the E axis.
     combine = jnp.sum(
         jax.nn.one_hot(top_idx, e, dtype=jnp.float32) * top_vals[..., None], axis=-2

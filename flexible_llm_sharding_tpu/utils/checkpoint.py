@@ -206,6 +206,26 @@ _LAYER_MAP_OPTIONAL = [
 _IGNORABLE_HF_SUFFIXES = ("rotary_emb.inv_freq",)
 
 
+def _stack_experts(layer_name, prefix, name_map, sd, out, consumed) -> None:
+    """Stack per-expert Linear weights ``{prefix}.{e}.{hf_name}.weight`` into
+    one transposed [E, in, out] native array per projection (the _moe_mlp
+    einsum layout — one tensor per projection keeps a shard upload a single
+    device_put)."""
+    probe = name_map[0][1]
+    n_exp = 0
+    while f"{prefix}.{n_exp}.{probe}.weight" in sd:
+        n_exp += 1
+    if not n_exp:
+        raise ValueError(f"{layer_name}: MoE layer with no expert weights")
+    for native_key, hf_w in name_map:
+        stack = []
+        for ei in range(n_exp):
+            key = f"{prefix}.{ei}.{hf_w}.weight"
+            stack.append(sd[key].T)
+            consumed.add(key)
+        out[native_key] = np.ascontiguousarray(np.stack(stack))
+
+
 def hf_layer_to_native(layer_name: str, sd: dict[str, np.ndarray]) -> dict[str, np.ndarray]:
     """Convert one layer's HF-keyed state dict to native flat keys/layout.
 
@@ -220,14 +240,15 @@ def hf_layer_to_native(layer_name: str, sd: dict[str, np.ndarray]) -> dict[str, 
     if layer_name == "lm_head":
         return {"kernel": np.ascontiguousarray(sd["lm_head.weight"].T)}
     moe = any(".block_sparse_moe." in k for k in sd)
+    qmoe = f"{layer_name}.mlp.experts.0.gate_proj.weight" in sd  # qwen3_moe
     fused = f"{layer_name}.self_attn.qkv_proj.weight" in sd  # phi3 layout
     ff = any(".feed_forward." in k for k in sd)  # llama4 naming
     ff_moe = f"{layer_name}.feed_forward.router.weight" in sd
     out = {}
     consumed = set()
     for native_key, hf_sub, transpose in _LAYER_MAP:
-        if (moe or ff) and native_key.startswith("mlp."):
-            continue  # Mixtral block_sparse_moe / llama4 feed_forward below
+        if (moe or ff or qmoe) and native_key.startswith("mlp."):
+            continue  # Mixtral / llama4 / qwen3_moe expert layouts below
         if fused and native_key in (
             "attn.wq", "attn.wk", "attn.wv", "mlp.gate", "mlp.up"
         ):
@@ -295,6 +316,18 @@ def hf_layer_to_native(layer_name: str, sd: dict[str, np.ndarray]) -> dict[str, 
             key = f"{layer_name}.feed_forward.shared_expert.{sub}.weight"
             out[native_key] = np.ascontiguousarray(sd[key].T)
             consumed.add(key)
+    if qmoe:
+        # Qwen3-MoE: router at mlp.gate [E, D] -> [D, E]; per-expert
+        # gate/up/down Linears stack into the same [E, D, F] / [E, F, D]
+        # native arrays as Mixtral.
+        rk = f"{layer_name}.mlp.gate.weight"
+        out["mlp.router"] = np.ascontiguousarray(sd[rk].T)
+        consumed.add(rk)
+        _stack_experts(
+            layer_name, f"{layer_name}.mlp.experts",
+            (("mlp.gate", "gate_proj"), ("mlp.up", "up_proj"), ("mlp.down", "down_proj")),
+            sd, out, consumed,
+        )
     if moe:
         # Mixtral MoE: router [E, D] -> [D, E]; per-expert w1 (gate) / w3
         # (up) [F, D] and w2 (down) [D, F] stack into [E, D, F] / [E, F, D]
@@ -303,18 +336,11 @@ def hf_layer_to_native(layer_name: str, sd: dict[str, np.ndarray]) -> dict[str, 
         rk = f"{layer_name}.block_sparse_moe.gate.weight"
         out["mlp.router"] = np.ascontiguousarray(sd[rk].T)
         consumed.add(rk)
-        n_exp = 0
-        while f"{layer_name}.block_sparse_moe.experts.{n_exp}.w1.weight" in sd:
-            n_exp += 1
-        if not n_exp:
-            raise ValueError(f"{layer_name}: MoE layer with no expert weights")
-        for native_key, hf_w in (("mlp.gate", "w1"), ("mlp.up", "w3"), ("mlp.down", "w2")):
-            stack = []
-            for ei in range(n_exp):
-                key = f"{layer_name}.block_sparse_moe.experts.{ei}.{hf_w}.weight"
-                stack.append(sd[key].T)
-                consumed.add(key)
-            out[native_key] = np.ascontiguousarray(np.stack(stack))
+        _stack_experts(
+            layer_name, f"{layer_name}.block_sparse_moe.experts",
+            (("mlp.gate", "w1"), ("mlp.up", "w3"), ("mlp.down", "w2")),
+            sd, out, consumed,
+        )
     leftover = {
         k for k in sd.keys() - consumed if not k.endswith(_IGNORABLE_HF_SUFFIXES)
     }
@@ -586,6 +612,7 @@ def save_params(params: dict[str, Any], out_dir: str, cfg: LlamaConfig) -> None:
         "use_sliding_window": cfg.sliding_window is not None,  # qwen2 gate
         "num_local_experts": cfg.num_local_experts,
         "num_experts_per_tok": cfg.num_experts_per_tok,
+        "moe_norm_topk_prob": cfg.moe_norm_topk_prob,
         "qk_norm": cfg.qk_norm,
         "hidden_act": cfg.hidden_act,
         "norm_unit_offset": cfg.norm_unit_offset,

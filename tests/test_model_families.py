@@ -1329,3 +1329,116 @@ def test_llama4_multichip(tmp_path, mode):
     )
     for a, b in zip(single, multi):
         np.testing.assert_allclose(b, a, rtol=1e-4, atol=1e-5)
+
+
+QWEN3_MOE_CFG = LlamaConfig(
+    model_type="qwen3_moe",
+    vocab_size=256,
+    hidden_size=64,
+    intermediate_size=96,  # moe_intermediate_size on the HF side
+    num_hidden_layers=3,
+    num_attention_heads=4,
+    num_key_value_heads=2,
+    max_position_embeddings=512,
+    explicit_head_dim=32,
+    qk_norm=True,
+    num_local_experts=4,
+    num_experts_per_tok=2,
+    moe_norm_topk_prob=True,  # Qwen3-30B-A3B setting
+)
+
+
+def _hf_qwen3_moe(cfg: LlamaConfig, norm_topk: bool):
+    from transformers import Qwen3MoeConfig, Qwen3MoeForCausalLM
+
+    torch.manual_seed(0)
+    return Qwen3MoeForCausalLM(
+        Qwen3MoeConfig(
+            vocab_size=cfg.vocab_size,
+            hidden_size=cfg.hidden_size,
+            intermediate_size=128,  # dense width (unused: all layers MoE)
+            moe_intermediate_size=cfg.intermediate_size,
+            num_hidden_layers=cfg.num_hidden_layers,
+            num_attention_heads=cfg.num_attention_heads,
+            num_key_value_heads=cfg.num_key_value_heads,
+            rms_norm_eps=cfg.rms_norm_eps,
+            rope_theta=cfg.rope_theta,
+            max_position_embeddings=cfg.max_position_embeddings,
+            tie_word_embeddings=False,
+            head_dim=cfg.head_dim,
+            num_experts=cfg.num_local_experts,
+            num_experts_per_tok=cfg.num_experts_per_tok,
+            norm_topk_prob=norm_topk,
+            decoder_sparse_step=1,
+            mlp_only_layers=[],
+            use_sliding_window=False,
+            attn_implementation="eager",
+        )
+    ).eval()
+
+
+@pytest.mark.parametrize("norm_topk", [True, False], ids=["renorm", "raw"])
+def test_qwen3_moe_forward_matches_hf(rng, norm_topk):
+    """Qwen3-MoE: qwen3 attention (per-head q/k RMSNorm) + the Mixtral MoE
+    block with HF's norm_topk_prob switch — the blocks' only difference."""
+    import dataclasses
+
+    cfg = dataclasses.replace(QWEN3_MOE_CFG, moe_norm_topk_prob=norm_topk)
+    model = _hf_qwen3_moe(cfg, norm_topk)
+    params = _params_from_hf(model, cfg)
+    assert params["layers"][0]["mlp"]["router"].shape == (64, 4)
+    assert "q_norm" in params["layers"][0]["attn"]
+    ids = rng.integers(0, cfg.vocab_size, size=(2, 17))
+    with torch.no_grad():
+        hf_logits = model(torch.tensor(ids)).logits.numpy()
+    ours = np.asarray(llama.forward_full(params, cfg, jnp.asarray(ids)))
+    np.testing.assert_allclose(ours, hf_logits, rtol=2e-4, atol=2e-4)
+
+
+def test_from_hf_qwen3_moe_head_dim():
+    """Qwen3MoeConfig has NO head_dim attribute (HF falls back to
+    hidden/heads) — the dense-qwen3 128 default must not leak in."""
+    cfg = LlamaConfig.from_hf_config(
+        {
+            "model_type": "qwen3_moe",
+            "hidden_size": 1024,
+            "num_attention_heads": 16,
+            "num_experts": 8,
+            "num_hidden_layers": 4,
+        }
+    )
+    assert cfg.head_dim == 64  # hidden/heads, not 128
+    assert cfg.num_local_experts == 8 and cfg.qk_norm
+
+
+def test_qwen3_moe_split_and_executor(rng, tmp_path):
+    """save_pretrained -> splitter (mlp.gate router + per-expert Linears
+    stacked) -> streaming executor vs the HF oracle."""
+    model = _hf_qwen3_moe(QWEN3_MOE_CFG, True)
+    src = tmp_path / "hf"
+    model.save_pretrained(str(src))
+    out = tmp_path / "native"
+    ckpt.split_into_layers(str(src), str(out))
+    layer = ckpt.load_layer(str(out), "model.layers.0")
+    assert set(layer["mlp"]) == {"router", "gate", "up", "down"}
+    assert layer["mlp"]["gate"].shape == (4, 64, 96)
+    back = LlamaConfig.from_pretrained(str(out))
+    assert back.num_local_experts == 4 and back.moe_norm_topk_prob
+    assert back.qk_norm and back.model_type == "qwen3_moe"
+
+    prompts = [("The capital of France", (" is Paris", " is Rome"))]
+    fw = FrameworkConfig(
+        model_path=str(out), dtype="float32", bucket_multiple=8, prefetch_depth=0
+    )
+    got = StreamingExecutor(fw, tokenizer=FakeTokenizer())(prompts)
+    tok = PromptTokenizer(FakeTokenizer(), bucket_multiple=8)
+    t = tok(*prompts[0])
+    for s in range(t.num_suffixes):
+        n_real = int(t.suffix_eos[s]) + 1
+        full = np.concatenate(
+            [t.prefix_ids[: t.prefix_len], t.suffix_ids[s, :n_real]]
+        ).astype(np.int64)
+        with torch.no_grad():
+            logits = model(torch.tensor(full[None])).logits[0, -1]
+        want = torch.softmax(logits.float(), -1).numpy()
+        np.testing.assert_allclose(got[0][s, 0], want, rtol=2e-4, atol=2e-5)
