@@ -69,7 +69,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--dtype", type=str, default="bfloat16",
                    choices=["bfloat16", "float16", "float32"])
     p.add_argument("--block_size", type=int, default=8)
-    p.add_argument("--prefetch_depth", type=int, default=1)
+    p.add_argument("--prefetch_depth", type=int, default=None,
+                   help="shards uploaded ahead of compute; default auto "
+                        "(2 on TPU, 0 on the CPU backend where there is no "
+                        "host->device link to overlap); 0 = serialized")
     p.add_argument("--num_devices", type=int, default=0, help="0 = all visible chips")
     p.add_argument("--tensor_parallel", type=int, default=1,
                    help="shard every streamed layer's matmuls over this many "

@@ -22,6 +22,45 @@ DEFAULT_MAX_TOKEN_LEN = 4096
 # stays in sync with this set).
 SUPPORTED_ACTIVATIONS = frozenset({"silu", "gelu", "gelu_pytorch_tanh"})
 
+# Fields copied by name from ANY foreign HF config.json — they mean the same
+# thing across the supported families. Everything else is family-gated below
+# (see from_hf_config's stray-key defence).
+_UNIVERSAL_HF_FIELDS = frozenset({
+    "model_type", "vocab_size", "hidden_size", "intermediate_size",
+    "num_hidden_layers", "num_attention_heads", "num_key_value_heads",
+    "rms_norm_eps", "rope_theta", "max_position_embeddings",
+    "tie_word_embeddings", "hidden_act", "mlp_bias",
+})
+
+# Extra fields a foreign config.json may contribute, per declared model_type
+# (these are real HF config attributes for that family; the family branch
+# supplies the defaults when absent).
+_FAMILY_HF_FIELDS: dict[str, frozenset[str]] = {
+    "mistral": frozenset({"sliding_window"}),
+    "qwen2": frozenset({"sliding_window"}),
+    "qwen3": frozenset({"sliding_window"}),
+    "qwen3_moe": frozenset(
+        {"sliding_window", "num_local_experts", "num_experts_per_tok"}
+    ),
+    "mixtral": frozenset(
+        {"sliding_window", "num_local_experts", "num_experts_per_tok"}
+    ),
+    "phi3": frozenset({"sliding_window"}),
+    "gemma2": frozenset({"query_pre_attn_scalar", "sliding_window"}),
+    "gemma3_text": frozenset(
+        {"query_pre_attn_scalar", "sliding_window", "rope_local_theta"}
+    ),
+    "llama4_text": frozenset(
+        {
+            "num_local_experts",
+            "num_experts_per_tok",
+            "attention_chunk_size",
+            "intermediate_size_mlp",
+            "attn_temperature_tuning",
+        }
+    ),
+}
+
 
 @dataclasses.dataclass(frozen=True)
 class LlamaConfig:
@@ -218,8 +257,26 @@ class LlamaConfig:
     @classmethod
     def from_hf_config(cls, d: dict[str, Any]) -> "LlamaConfig":
         known = {f.name for f in dataclasses.fields(cls)}
-        kwargs = {k: v for k, v in d.items() if k in known}
         model_type = d.get("model_type", "llama")
+        # Configs this framework saved itself (save_params marks them) carry
+        # every native field explicitly and round-trip by field name. A
+        # FOREIGN config.json only contributes fields that mean the same
+        # thing for its declared model_type: a stray numerics-changing key
+        # in a merged/"llamafied" export (qk_norm, attention_chunk_size,
+        # layer_sliding, softcaps, ...) must be ignored, not silently
+        # honoured — the family branches below re-derive those from the HF
+        # names instead.
+        # Migration: configs saved by earlier framework versions predate the
+        # marker but always wrote native-only field names (attention_in_bias
+        # is unconditional in save_params) — no foreign HF export carries it.
+        native = bool(d.get("fls_native")) or "attention_in_bias" in d
+        if native:
+            kwargs = {k: v for k, v in d.items() if k in known}
+        else:
+            allowed = _UNIVERSAL_HF_FIELDS | _FAMILY_HF_FIELDS.get(
+                model_type, frozenset()
+            )
+            kwargs = {k: v for k, v in d.items() if k in known and k in allowed}
         # Family-specific conventions (numerics-changing features either map
         # to a native field here or fail loudly — never silently drop).
         if model_type in ("llama", ""):
@@ -273,20 +330,25 @@ class LlamaConfig:
             # the family defaults here (explicit values still win).
             kwargs.setdefault("tie_word_embeddings", True)
             kwargs.setdefault("explicit_head_dim", 256)
-            # HF GemmaConfig: hidden_activation (None -> gelu_pytorch_tanh)
-            # overrides the legacy hidden_act key.
-            kwargs["hidden_act"] = (
-                d.get("hidden_activation") or d.get("hidden_act") or "gelu_pytorch_tanh"
-            )
+            # HF GemmaMLP IGNORES the legacy hidden_act key entirely: when
+            # hidden_activation is None it forces gelu_pytorch_tanh (the
+            # original google/gemma config.json ships hidden_act='gelu' and
+            # HF still runs the tanh approximation). Only a native config's
+            # explicit hidden_act wins.
+            if not native:
+                kwargs["hidden_act"] = (
+                    d.get("hidden_activation") or "gelu_pytorch_tanh"
+                )
             kwargs["sliding_window"] = None
         elif model_type == "gemma2":
             kwargs.setdefault("norm_unit_offset", True)
             kwargs.setdefault("embed_scale", True)
             kwargs.setdefault("tie_word_embeddings", True)
             kwargs.setdefault("explicit_head_dim", 256)  # Gemma2Config default
-            kwargs["hidden_act"] = (
-                d.get("hidden_activation") or d.get("hidden_act") or "gelu_pytorch_tanh"
-            )
+            if not native:  # HF Gemma*MLP ignores the legacy hidden_act key
+                kwargs["hidden_act"] = (
+                    d.get("hidden_activation") or "gelu_pytorch_tanh"
+                )
             kwargs["ffw_sandwich_norms"] = True
             # setdefault: explicit NATIVE keys (our own saved configs,
             # including explicit nulls) win over the HF names/defaults.
@@ -304,9 +366,10 @@ class LlamaConfig:
             kwargs.setdefault("tie_word_embeddings", True)
             kwargs.setdefault("explicit_head_dim", 256)
             kwargs.setdefault("qk_norm", True)  # Gemma3RMSNorm, (1+w) style
-            kwargs["hidden_act"] = (
-                d.get("hidden_activation") or d.get("hidden_act") or "gelu_pytorch_tanh"
-            )
+            if not native:  # HF Gemma*MLP ignores the legacy hidden_act key
+                kwargs["hidden_act"] = (
+                    d.get("hidden_activation") or "gelu_pytorch_tanh"
+                )
             kwargs["ffw_sandwich_norms"] = True
             kwargs.setdefault("query_pre_attn_scalar", d.get("query_pre_attn_scalar", 256))
             kwargs.setdefault("rope_theta", 1_000_000.0)  # global layers
@@ -458,7 +521,14 @@ class FrameworkConfig:
     max_token_len: int = DEFAULT_MAX_TOKEN_LEN
     dtype: str = "bfloat16"  # compute/storage dtype on device ('float16'|'bfloat16'|'float32')
     block_size: int = 8  # prompts batched together per jitted layer call
-    prefetch_depth: int = 1  # shards prefetched ahead of compute (0 = synchronous)
+    # Shards prefetched ahead of compute (0 = synchronous, the reference's
+    # serialized schedule). None = auto: 2 on an accelerator backend (overlap
+    # the host->HBM upload of shard t+1 with shard t's compute), 0 on the CPU
+    # backend — there "device" memory IS host memory, so there is no transfer
+    # link to overlap and the producer thread only steals cores/GIL from
+    # XLA:CPU's own compute (measured: prefetch=2 is ~10% SLOWER than the
+    # serialized schedule on CPU; see bench.py).
+    prefetch_depth: int | None = None
     num_devices: int = 0  # 0 = all visible devices
     bucket_multiple: int = 64  # sequence lengths padded up to a multiple of this
     # Pallas flash-attention kernels. None = auto: enabled on TPU, where they
@@ -497,12 +567,28 @@ class FrameworkConfig:
             raise ValueError("num_gen_token must be >= 1")
         if self.tensor_parallel < 1:
             raise ValueError("tensor_parallel must be >= 1")
+        if self.prefetch_depth is not None and self.prefetch_depth < 0:
+            raise ValueError("prefetch_depth must be >= 0 (or None for auto)")
         if self.tensor_parallel > 1 and self.data_parallel:
             raise ValueError(
                 "tensor_parallel and data_parallel are mutually exclusive "
                 "(stream one model sharded across chips, OR one replica per "
                 "chip — not both in this executor)"
             )
+
+    def effective_prefetch_depth(self) -> int:
+        """Resolve the tri-state ``prefetch_depth``: explicit value, or auto —
+        2 when the default backend is an accelerator (real host->HBM link to
+        hide), 0 on CPU (the overlapped schedule degenerates: no link, and
+        the producer thread contends with XLA:CPU compute for cores)."""
+        if self.prefetch_depth is not None:
+            return self.prefetch_depth
+        try:
+            import jax
+
+            return 2 if jax.devices()[0].platform != "cpu" else 0
+        except Exception:
+            return 0
 
     def pallas_enabled(self) -> bool:
         """Resolve the tri-state ``use_pallas``: explicit value, or auto —

@@ -382,6 +382,43 @@ def decoder_layer(
     return _residual_mlp(params, cfg, x)
 
 
+def _flash_tp_causal(mesh, q, k, v, plen, local_on, kw):
+    """flash_causal_attention under tensor parallelism: shard_map over the
+    (embarrassingly parallel) heads axis — pallas_call has no GSPMD
+    partitioning rule, so the kernel runs per-shard on each chip's head
+    slice. GQA ratios survive the split (both head counts divide by tp)."""
+    from jax.sharding import PartitionSpec as P
+
+    flag = jnp.asarray(True if local_on is None else local_on)
+    h = P(None, "tp", None)
+    f = lambda q, k, v, plen, flag: pallas_attention.flash_causal_attention(
+        q, k, v, plen, local_on=flag, **kw
+    )
+    return jax.shard_map(
+        f, mesh=mesh, in_specs=(h, h, h, P(), P()), out_specs=h,
+        check_vma=False,
+    )(q, k, v, plen, flag)
+
+
+def _flash_tp_prefix_shared(mesh, qs, kp, vp, ks, vs, plen, local_on, kw):
+    """flash_prefix_shared_attention under tensor parallelism (see
+    ``_flash_tp_causal``)."""
+    from jax.sharding import PartitionSpec as P
+
+    flag = jnp.asarray(True if local_on is None else local_on)
+    hq = P(None, None, "tp", None)  # [S, Ls, heads, hd]
+    hp = P(None, "tp", None)  # [Lp, kv_heads, hd]
+    f = lambda qs, kp, vp, ks, vs, plen, flag: (
+        pallas_attention.flash_prefix_shared_attention(
+            qs, kp, vp, ks, vs, plen, local_on=flag, **kw
+        )
+    )
+    return jax.shard_map(
+        f, mesh=mesh, in_specs=(hq, hp, hp, hq, hq, P(), P()), out_specs=hq,
+        check_vma=False,
+    )(qs, kp, vp, ks, vs, plen, flag)
+
+
 def prefix_suffix_layer(
     params: Params,
     cfg: LlamaConfig,
@@ -392,6 +429,7 @@ def prefix_suffix_layer(
     return_kv: bool = False,
     sliding=None,
     rope_on=None,
+    tp_mesh=None,
 ) -> tuple[jax.Array, ...]:
     """One decoder layer over a (prefix, suffixes) prompt — the streaming hot op.
 
@@ -424,20 +462,20 @@ def prefix_suffix_layer(
         # kernels eligible (the common case for Mistral's 4096 window and
         # Llama4's 8192 chunks under the 4096 token cap).
         window = chunk = sliding = None
-    # The flash kernels implement full causal masks with the default scale
-    # and rotary-everywhere only; a *binding* local mask, a traced per-layer
-    # toggle, NoPE layers, an attention softcap, or a custom scale all fall
-    # back to the XLA attention (which fuses the banded mask / tanh cap).
-    flash = (
-        use_pallas
-        and window is None
-        and chunk is None
-        and rope_on is None
-        and cfg.attn_logit_softcap is None
-        and cfg.query_pre_attn_scalar is None
-        and pallas_attention.supports(
-            cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim, ls, lp
-        )
+    # The flash kernels carry the full family surface — custom scale
+    # (query_pre_attn_scalar), softcap, sliding window / chunked masks, and
+    # the traced per-layer local toggle; NoPE/temperature handling lives in
+    # position_qk, OUTSIDE the attention op. Only shape eligibility gates
+    # them (tiny head dims / ragged buckets fall back to XLA attention).
+    # Under tensor parallelism (``tp_mesh``) the kernels run per head-shard
+    # via shard_map, so eligibility is checked on PER-SHARD head counts.
+    tp_size = tp_mesh.shape["tp"] if tp_mesh is not None else 1
+    flash = use_pallas and pallas_attention.supports(
+        cfg.num_attention_heads // tp_size,
+        cfg.num_key_value_heads // tp_size,
+        cfg.head_dim,
+        ls,
+        lp,
     )
 
     # --- prefix: causal self-attention, keep post-RoPE KV ---
@@ -447,7 +485,20 @@ def prefix_suffix_layer(
     if flash:
         # Rows at i >= prefix_len are padding; the kernel's valid-len mask
         # additionally skips fully-masked KV blocks.
-        attn_out = pallas_attention.flash_causal_attention(q, k, v, prefix_len)
+        flash_kw = dict(
+            scale=cfg.attn_scale,
+            window=window,
+            chunk=chunk,
+            softcap=cfg.attn_logit_softcap,
+        )
+        if tp_mesh is not None:
+            attn_out = _flash_tp_causal(
+                tp_mesh, q, k, v, prefix_len, sliding, flash_kw
+            )
+        else:
+            attn_out = pallas_attention.flash_causal_attention(
+                q, k, v, prefix_len, local_on=sliding, **flash_kw
+            )
     else:
         if sliding is None:
             mask = causal_mask(lp, lp, window=window, chunk=chunk)
@@ -471,9 +522,14 @@ def prefix_suffix_layer(
     qs, ks = position_qk(cfg, qs, ks, pos_s, rope_sliding, rope_on)
 
     if flash:
-        attn_s = pallas_attention.flash_prefix_shared_attention(
-            qs, k, v, ks, vs, prefix_len
-        )
+        if tp_mesh is not None:
+            attn_s = _flash_tp_prefix_shared(
+                tp_mesh, qs, k, v, ks, vs, prefix_len, sliding, flash_kw
+            )
+        else:
+            attn_s = pallas_attention.flash_prefix_shared_attention(
+                qs, k, v, ks, vs, prefix_len, local_on=sliding, **flash_kw
+            )
     else:
         attn_s = prefix_shared_attention(
             qs,

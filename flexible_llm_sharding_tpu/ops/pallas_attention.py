@@ -23,6 +23,18 @@ GQA is handled by the KV index map (query head h reads KV head
 ``h * n_kv // n_q``), so KV heads are never replicated. Inputs keep the
 model dtype (bf16 on the MXU); softmax runs in fp32 VMEM accumulators.
 
+Model-family envelope (mirrors the XLA ops' full surface):
+
+- ``scale`` — custom attention scale (Gemma2's query_pre_attn_scalar).
+- ``softcap`` — Gemma2/3 attention-logit softcapping, applied to the scaled
+  fp32 scores before the mask (HF eager order: scale -> softcap -> mask).
+- ``window`` / ``chunk`` — Mistral/Qwen sliding window or Llama4 chunked
+  attention (static ints); KV blocks wholly outside the local region are
+  SKIPPED, not just masked, so a binding window also cuts FLOPs/bandwidth.
+- ``local_on`` — the per-layer local-attention toggle (Gemma2/3, Llama4
+  alternation under one ``lax.scan`` program): a traced bool that rides the
+  scalar-prefetch channel next to ``prefix_len``.
+
 Shape eligibility is checked by :func:`supports`; callers fall back to the
 XLA path otherwise (tiny test models, ragged head dims).
 """
@@ -61,7 +73,7 @@ def supports(n_q: int, n_kv: int, head_dim: int, lq: int, lk: int) -> bool:
     )
 
 
-def _online_block(q, kb, vb, mask, m, l, acc, scale):
+def _online_block(q, kb, vb, mask, m, l, acc, scale, softcap=None):
     """One flash step: fold a KV block into the (m, l, acc) accumulators.
 
     q [Bq, hd] model dtype; kb/vb [Bk, hd]; mask [Bq, Bk] bool;
@@ -73,6 +85,8 @@ def _online_block(q, kb, vb, mask, m, l, acc, scale):
         dimension_numbers=(((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
     ) * scale
+    if softcap is not None:
+        s = jnp.tanh(s / softcap) * softcap
     s = jnp.where(mask, s, _NEG_INF)
     m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
     p = jnp.exp(s - m_new)
@@ -92,18 +106,48 @@ def _finish(l, acc, dtype):
     return jnp.where(l > 0, acc / jnp.maximum(l, 1e-30), 0.0).astype(dtype)
 
 
+def _local_mask(mask, q_pos, k_pos, window, chunk, local_on):
+    """AND the local-attention clause into ``mask`` (ops.attention
+    ``_local_clause`` semantics): visible iff within the sliding ``window``
+    (q - k < window) or sharing a position ``chunk``; a False ``local_on``
+    (the traced per-layer toggle) disables the clause."""
+    if window is not None:
+        in_local = (q_pos - k_pos) < window
+    elif chunk is not None:
+        in_local = (q_pos // chunk) == (k_pos // chunk)
+    else:
+        return mask
+    return mask & (jnp.logical_not(local_on) | in_local)
+
+
+def _local_start_block(first_q_pos, window, chunk, bk, local_on):
+    """First KV block that can contain a visible key for a q block whose
+    FIRST query sits at absolute position ``first_q_pos`` — blocks before it
+    are wholly outside the local region for every query in the block (later
+    queries only look further right). 0 when the layer's toggle is off."""
+    if window is not None:
+        first_vis = jnp.maximum(first_q_pos - window + 1, 0)
+    else:
+        first_vis = (first_q_pos // chunk) * chunk
+    return jnp.where(local_on, first_vis // bk, 0)
+
+
 # ---------------------------------------------------------------------------
 # Causal self-attention with dynamic valid length (prefix pass)
 # ---------------------------------------------------------------------------
 
-def _causal_kernel(plen_ref, q_ref, k_ref, v_ref, o_ref, *, scale, lk, bk):
+def _causal_kernel(
+    flags_ref, q_ref, k_ref, v_ref, o_ref, *, scale, lk, bk, window, chunk,
+    softcap,
+):
     # Head-major blocks: q_ref [1, bq, hd]; k_ref/v_ref [1, lk, hd]. The TPU
     # lowering constrains only the last two block dims, so the head axis must
     # lead with block size 1.
     qb = pl.program_id(1)
     _, bq, hd = q_ref.shape
     q = q_ref[0]
-    plen = plen_ref[0]
+    plen = flags_ref[0]
+    local_on = flags_ref[1] != 0
     qi = qb * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, 1), 0)
 
     m = jnp.full((bq, 1), _NEG_INF, jnp.float32)
@@ -116,23 +160,50 @@ def _causal_kernel(plen_ref, q_ref, k_ref, v_ref, o_ref, *, scale, lk, bk):
         kb = k_ref[0, pl.ds(start, bk), :]
         vb = v_ref[0, pl.ds(start, bk), :]
         kj = start + jax.lax.broadcasted_iota(jnp.int32, (1, bk), 1)
-        mask = (kj <= qi) & (kj < plen)
-        return _online_block(q, kb, vb, mask, m, l, acc, scale)
+        mask = _local_mask(
+            (kj <= qi) & (kj < plen), qi, kj, window, chunk, local_on
+        )
+        return _online_block(q, kb, vb, mask, m, l, acc, scale, softcap)
 
     # Causal: KV blocks wholly above this q block's diagonal contribute
     # nothing, and neither do blocks past the valid length (every key there
-    # has kj >= plen) — stop at whichever bound comes first.
+    # has kj >= plen) — stop at whichever bound comes first. A binding local
+    # form also skips blocks wholly before the window/chunk.
     causal_last = ((qb + 1) * bq + bk - 1) // bk
     valid_last = (plen + bk - 1) // bk
     last = jnp.minimum(jnp.minimum(causal_last, valid_last), lk // bk)
-    m, l, acc = jax.lax.fori_loop(0, last, body, (m, l, acc))
+    first = jnp.int32(0)
+    if window is not None or chunk is not None:
+        first = _local_start_block(qb * bq, window, chunk, bk, local_on)
+    m, l, acc = jax.lax.fori_loop(first, last, body, (m, l, acc))
     o_ref[0] = _finish(l, acc, o_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("scale", "interpret"))
-def flash_causal_attention(q, k, v, valid_len, scale=None, interpret=False):
+def _flags(prefix_len, local_on) -> jax.Array:
+    """Scalar-prefetch payload: [prefix_len, local_on] int32. ``local_on``
+    None means the static local form (if any) applies unconditionally."""
+    flag = jnp.asarray(True if local_on is None else local_on)
+    return jnp.stack(
+        [jnp.asarray(prefix_len, jnp.int32), flag.astype(jnp.int32)]
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("scale", "window", "chunk", "softcap", "interpret"),
+)
+def flash_causal_attention(
+    q, k, v, valid_len, scale=None, window=None, chunk=None, softcap=None,
+    local_on=None, interpret=None,
+):
     """q [L, n_q, hd], k/v [L, n_kv, hd], valid_len int32 scalar ->
-    [L, n_q, hd]. Query i attends keys j with j <= i and j < valid_len."""
+    [L, n_q, hd]. Query i attends keys j with j <= i and j < valid_len,
+    optionally restricted to a sliding ``window`` / position ``chunk``
+    (``local_on``: traced per-layer toggle, None = on)."""
+    if interpret is None:
+        # Auto: compiled on real TPU, interpreter elsewhere (lets the CPU
+        # test mesh exercise the kernels end-to-end, incl. under shard_map).
+        interpret = jax.default_backend() != "tpu"
     lq, n_q, hd = q.shape
     lk, n_kv, _ = k.shape
     if scale is None:
@@ -140,25 +211,28 @@ def flash_causal_attention(q, k, v, valid_len, scale=None, interpret=False):
     bq = _block(lq, _MAX_BLOCK_Q)
     bk = _block(lk, _MAX_BLOCK_K)
     grid = (n_q, lq // bq)
-    kv_head = lambda h, qb, plen: (h * n_kv // n_q, 0, 0)
+    kv_head = lambda h, qb, flags: (h * n_kv // n_q, 0, 0)
 
-    kernel = functools.partial(_causal_kernel, scale=scale, lk=lk, bk=bk)
+    kernel = functools.partial(
+        _causal_kernel, scale=scale, lk=lk, bk=bk, window=window, chunk=chunk,
+        softcap=softcap,
+    )
     out = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=grid,
             in_specs=[
-                pl.BlockSpec((1, bq, hd), lambda h, qb, plen: (h, qb, 0)),
+                pl.BlockSpec((1, bq, hd), lambda h, qb, flags: (h, qb, 0)),
                 pl.BlockSpec((1, lk, hd), kv_head),
                 pl.BlockSpec((1, lk, hd), kv_head),
             ],
-            out_specs=pl.BlockSpec((1, bq, hd), lambda h, qb, plen: (h, qb, 0)),
+            out_specs=pl.BlockSpec((1, bq, hd), lambda h, qb, flags: (h, qb, 0)),
         ),
         out_shape=jax.ShapeDtypeStruct((n_q, lq, hd), q.dtype),
         interpret=interpret,
     )(
-        jnp.asarray(valid_len, jnp.int32).reshape(1),
+        _flags(valid_len, local_on),
         q.transpose(1, 0, 2),
         k.transpose(1, 0, 2),
         v.transpose(1, 0, 2),
@@ -171,15 +245,20 @@ def flash_causal_attention(q, k, v, valid_len, scale=None, interpret=False):
 # ---------------------------------------------------------------------------
 
 def _prefix_shared_kernel(
-    plen_ref, q_ref, kp_ref, vp_ref, ks_ref, vs_ref, o_ref, *, scale, lp, bkp
+    flags_ref, q_ref, kp_ref, vp_ref, ks_ref, vs_ref, o_ref, *, scale, lp,
+    bkp, window, chunk, softcap,
 ):
     # Head-major blocks: q_ref [1, 1, bq, hd]; kp_ref/vp_ref [1, lp, hd];
     # ks_ref/vs_ref [1, 1, ls, hd].
     qb = pl.program_id(2)
     _, _, bq, hd = q_ref.shape
     q = q_ref[0, 0]
-    plen = plen_ref[0]
+    plen = flags_ref[0]
+    local_on = flags_ref[1] != 0
     qi = qb * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, 1), 0)
+    # Absolute positions: suffix query i sits at prefix_len + i; prefix key
+    # j at j; suffix key j at prefix_len + j (ops.attention convention).
+    q_abs = plen + qi
 
     m = jnp.full((bq, 1), _NEG_INF, jnp.float32)
     l = jnp.zeros((bq, 1), jnp.float32)
@@ -192,34 +271,52 @@ def _prefix_shared_kernel(
         kb = kp_ref[0, pl.ds(start, bkp), :]
         vb = vp_ref[0, pl.ds(start, bkp), :]
         kj = start + jax.lax.broadcasted_iota(jnp.int32, (1, bkp), 1)
-        mask = jnp.broadcast_to(kj < plen, (bq, bkp))
-        return _online_block(q, kb, vb, mask, m, l, acc, scale)
+        mask = _local_mask(
+            jnp.broadcast_to(kj < plen, (bq, bkp)), q_abs, kj, window, chunk,
+            local_on,
+        )
+        return _online_block(q, kb, vb, mask, m, l, acc, scale, softcap)
 
-    # Blocks past the real prefix are fully masked — skip them.
+    # Blocks past the real prefix are fully masked — skip them; with a
+    # binding local form, so are blocks wholly before the earliest visible
+    # key of this q block's FIRST query.
     n_real = jnp.minimum((plen + bkp - 1) // bkp, lp // bkp)
-    m, l, acc = jax.lax.fori_loop(0, n_real, p_body, (m, l, acc))
+    first = jnp.int32(0)
+    if window is not None or chunk is not None:
+        first = _local_start_block(plen + qb * bq, window, chunk, bkp, local_on)
+        first = jnp.minimum(first, n_real)
+    m, l, acc = jax.lax.fori_loop(first, n_real, p_body, (m, l, acc))
 
-    # Own suffix KV: causal within the suffix.
+    # Own suffix KV: causal within the suffix (distance (plen+qi)-(plen+kj)
+    # = qi-kj, so the window clause needs no plen; the chunk clause does).
     ls = ks_ref.shape[2]
     ks = ks_ref[0, 0]
     vs = vs_ref[0, 0]
     kj = jax.lax.broadcasted_iota(jnp.int32, (1, ls), 1)
-    m, l, acc = _online_block(q, ks, vs, kj <= qi, m, l, acc, scale)
+    mask = _local_mask(kj <= qi, q_abs, plen + kj, window, chunk, local_on)
+    m, l, acc = _online_block(q, ks, vs, mask, m, l, acc, scale, softcap)
 
     o_ref[0, 0] = _finish(l, acc, o_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("scale", "interpret"))
+@functools.partial(
+    jax.jit,
+    static_argnames=("scale", "window", "chunk", "softcap", "interpret"),
+)
 def flash_prefix_shared_attention(
     q, k_prefix, v_prefix, k_suffix, v_suffix, prefix_len, scale=None,
-    interpret=False,
+    window=None, chunk=None, softcap=None, local_on=None, interpret=None,
 ):
     """Kernel form of ``ops.attention.prefix_shared_attention``.
 
     q [S, Ls, n_q, hd]; k_prefix/v_prefix [Lp, n_kv, hd] (SHARED across all
     suffixes); k_suffix/v_suffix [S, Ls, n_kv, hd]; prefix_len int32 scalar.
+    ``window``/``chunk``/``softcap``/``scale`` mirror the XLA op;
+    ``local_on`` is the traced per-layer local toggle (None = on).
     Returns [S, Ls, n_q, hd].
     """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
     s, ls, n_q, hd = q.shape
     lp, n_kv, _ = k_prefix.shape
     if scale is None:
@@ -227,12 +324,13 @@ def flash_prefix_shared_attention(
     bq = _block(ls, _MAX_BLOCK_Q)
     bkp = _block(lp, _MAX_BLOCK_K)
     grid = (s, n_q, ls // bq)
-    kv_head = lambda si, h, qb, plen: (h * n_kv // n_q, 0, 0)
-    skv_head = lambda si, h, qb, plen: (si, h * n_kv // n_q, 0, 0)
-    q_map = lambda si, h, qb, plen: (si, h, qb, 0)
+    kv_head = lambda si, h, qb, flags: (h * n_kv // n_q, 0, 0)
+    skv_head = lambda si, h, qb, flags: (si, h * n_kv // n_q, 0, 0)
+    q_map = lambda si, h, qb, flags: (si, h, qb, 0)
 
     kernel = functools.partial(
-        _prefix_shared_kernel, scale=scale, lp=lp, bkp=bkp
+        _prefix_shared_kernel, scale=scale, lp=lp, bkp=bkp, window=window,
+        chunk=chunk, softcap=softcap,
     )
     out = pl.pallas_call(
         kernel,
@@ -251,7 +349,7 @@ def flash_prefix_shared_attention(
         out_shape=jax.ShapeDtypeStruct((s, n_q, ls, hd), q.dtype),
         interpret=interpret,
     )(
-        jnp.asarray(prefix_len, jnp.int32).reshape(1),
+        _flags(prefix_len, local_on),
         q.transpose(0, 2, 1, 3),
         k_prefix.transpose(1, 0, 2),
         v_prefix.transpose(1, 0, 2),

@@ -236,7 +236,7 @@ class DecodeGenerator:
             self.shards,
             np_dtype_for(self.cfg.dtype),
             devices=self.shard_devices,
-            prefetch_depth=self.cfg.prefetch_depth,
+            prefetch_depth=self.cfg.effective_prefetch_depth(),
             tied_embeddings=self.model_cfg.tie_word_embeddings,
             layer_sliding=self.model_cfg.layer_sliding,
             layer_rope=self.model_cfg.layer_rope,

@@ -76,9 +76,10 @@ def _embed_block(cfg: LlamaConfig, dtype, embed_params, prefix_ids, suffix_ids):
     )
 
 
-@partial(jax.jit, static_argnums=(0, 5), donate_argnums=(2, 3))
+@partial(jax.jit, static_argnums=(0, 5, 6), donate_argnums=(2, 3))
 def _decoder_block(
-    cfg: LlamaConfig, seg, prefix_h, suffix_h, prefix_len, use_pallas=False
+    cfg: LlamaConfig, seg, prefix_h, suffix_h, prefix_len, use_pallas=False,
+    tp_mesh=None,
 ):
     """Scan k stacked decoder layers over a block of prompts.
 
@@ -87,7 +88,8 @@ def _decoder_block(
     per-layer rope flags or None}; prefix_h [B, Lp, D]; suffix_h
     [B, S, Ls, D]; prefix_len int32 [B]. Activations are donated — each scan
     step's output reuses the input buffers. ``use_pallas`` (static) routes
-    attention through the flash kernels.
+    attention through the flash kernels; ``tp_mesh`` (static, hashable)
+    makes them run per head-shard via shard_map under tensor parallelism.
     """
     stacked, flags = seg["layers"], seg["sliding"]
     rflags = seg.get("rope")
@@ -101,6 +103,7 @@ def _decoder_block(
                 use_pallas=use_pallas,
                 sliding=sliding,
                 rope_on=rope_on,
+                tp_mesh=tp_mesh,
             ),
             in_axes=(None, None, 0, 0, 0),
         )
@@ -148,6 +151,7 @@ def process_block(
     toks,
     scores: dict,
     use_pallas: bool = False,
+    tp_mesh=None,
 ):
     """Run one shard over one block: fetch its activations (unless this shard
     starts at the embed layer), apply the segments, scatter any head scores,
@@ -185,6 +189,7 @@ def process_block(
         prefix_len,
         suffix_eos,
         use_pallas,
+        tp_mesh,
     )
     if block_scores is not None:
         for row, i in enumerate(idxs):
@@ -242,6 +247,7 @@ def apply_segments(
     prefix_len,
     suffix_eos,
     use_pallas: bool = False,
+    tp_mesh=None,
 ):
     """Run one shard's segments over a block.
 
@@ -260,7 +266,8 @@ def apply_segments(
             )
         elif kind == "decoders":
             prefix_h, suffix_h = _decoder_block(
-                model_cfg, params, prefix_h, suffix_h, prefix_len, use_pallas
+                model_cfg, params, prefix_h, suffix_h, prefix_len, use_pallas,
+                tp_mesh,
             )
         elif kind == "norm":
             suffix_h = _norm_block(model_cfg, params, suffix_h, suffix_eos)
@@ -422,12 +429,10 @@ def _dequant_tree(tree, np_dtype_name: str):
         if not checkpoint.is_quantized_leaf(n):
             return n
         q, sc = n["q8"], n["s"]
-        if sc.ndim == 1:
-            # As stored: q [*dims, out], scale [out] — channels trail.
-            shape = (1,) * (q.ndim - 1) + sc.shape
-        else:
-            # Loader-stacked: q [k, *dims, out], scale [k, out].
-            shape = (sc.shape[0],) + (1,) * (q.ndim - 2) + (sc.shape[-1],)
+        # Scale keeps the payload's leading (stack/expert) axes + trailing
+        # channel axis; reduced middle axes broadcast. Covers stored [out],
+        # stacked [k, out], per-expert [E, out], stacked [k, E, out].
+        shape = checkpoint._scale_expand(sc, q.ndim)
         return (q.astype(jnp.float32) * sc.reshape(shape)).astype(target)
 
     return jax.tree.map(one, tree, is_leaf=checkpoint.is_quantized_leaf)
@@ -454,14 +459,13 @@ def _quantized_target(host, target):
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     if checkpoint.is_quantized_leaf(host):
-        spec = tuple(target.spec)
-        if host["s"].ndim == 1:
-            s_spec = P(spec[-1]) if spec else P()
-        else:  # stacked [k, out]
-            s_spec = P(
-                spec[0] if spec else None,
-                spec[-1] if len(spec) > 1 else None,
-            )
+        q_ndim = np.ndim(host["q8"])
+        s_ndim = np.ndim(host["s"])
+        # Pad the (possibly truncated) spec to the payload's rank, then give
+        # the scale the payload's leading axes + its trailing channel axis —
+        # the sharding-side mirror of checkpoint._scale_expand.
+        spec = tuple(target.spec) + (None,) * (q_ndim - len(tuple(target.spec)))
+        s_spec = P(*(spec[: s_ndim - 1] + (spec[-1],))) if s_ndim else P()
         return {"q8": target, "s": NamedSharding(target.mesh, s_spec)}
     if isinstance(host, dict):
         # Some kinds (embed/norm) use ONE sharding for the whole subtree.
@@ -820,10 +824,12 @@ class StreamingExecutor:
             )
         self.stats: dict[str, float] = {}
         # Pallas kernels can't be auto-partitioned by GSPMD (pallas_call has
-        # no sharding rule outside shard_map), so a tp-sharded executor
-        # forces the XLA attention path regardless of the pallas setting.
-        self._use_pallas = cfg.pallas_enabled() and not hasattr(
-            device, "segment_target"
+        # no sharding rule), so under TpPlacement the flash calls run inside
+        # a shard_map over the heads axis (llama._flash_tp_*); the placement's
+        # mesh rides into the jitted blocks as a static arg.
+        self._use_pallas = cfg.pallas_enabled()
+        self._tp_mesh = (
+            device.mesh if hasattr(device, "segment_target") else None
         )
 
     # -- numpy dtype for host-side casting ---------------------------------
@@ -900,7 +906,7 @@ class StreamingExecutor:
                 self.plan.shards[start_shard:],
                 self._np_dtype,
                 device=self.device,
-                prefetch_depth=self.cfg.prefetch_depth,
+                prefetch_depth=self.cfg.effective_prefetch_depth(),
                 tied_embeddings=self.model_cfg.tie_word_embeddings,
                 layer_sliding=self.model_cfg.layer_sliding,
                 layer_rope=self.model_cfg.layer_rope,
@@ -1029,6 +1035,7 @@ class StreamingExecutor:
                         toks,
                         scores,
                         use_pallas=self._use_pallas,
+                        tp_mesh=self._tp_mesh,
                     )
                     bar.update(1)
                 if not blocks:

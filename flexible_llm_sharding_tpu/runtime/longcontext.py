@@ -232,7 +232,7 @@ class LongContextScorer:
             list(self.plan.shards) * max(len(prompts), 1),
             np_dtype_for(self.cfg.dtype),
             device=self._rep,  # device_put accepts a Sharding: replicate
-            prefetch_depth=self.cfg.prefetch_depth,
+            prefetch_depth=self.cfg.effective_prefetch_depth(),
             tied_embeddings=self.model_cfg.tie_word_embeddings,
             layer_sliding=self.model_cfg.layer_sliding,
             layer_rope=self.model_cfg.layer_rope,

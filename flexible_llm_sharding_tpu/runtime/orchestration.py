@@ -199,7 +199,7 @@ def run_prompts(
         plan.shards,
         np_dtype_for(cfg.dtype),
         devices=[devices[r] for r in active],
-        prefetch_depth=cfg.prefetch_depth,
+        prefetch_depth=cfg.effective_prefetch_depth(),
         tied_embeddings=model_cfg.tie_word_embeddings,
         rounds=cfg.num_batch,
         layer_sliding=model_cfg.layer_sliding,
@@ -288,7 +288,7 @@ def run_decode(
         plan.shards,
         np_dtype_for(cfg.dtype),
         devices=[devices[r] for r in active],
-        prefetch_depth=cfg.prefetch_depth,
+        prefetch_depth=cfg.effective_prefetch_depth(),
         tied_embeddings=model_cfg.tie_word_embeddings,
         rounds=cfg.num_gen_token,
         layer_sliding=model_cfg.layer_sliding,

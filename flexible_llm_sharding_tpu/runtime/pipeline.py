@@ -151,7 +151,7 @@ class PipelineRunner:
             stage_shards,
             self._np_dtype,
             devices=stage_devs,
-            prefetch_depth=self.cfg.prefetch_depth,
+            prefetch_depth=self.cfg.effective_prefetch_depth(),
             tied_embeddings=self.model_cfg.tie_word_embeddings,
             layer_sliding=self.model_cfg.layer_sliding,
             layer_rope=self.model_cfg.layer_rope,

@@ -61,13 +61,31 @@ QUANT_SCALE_SUFFIX = "::scale"
 
 
 def _quantize_int8(w: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
-    """Symmetric per-output-channel int8: returns (q [same shape], scale
-    [out]). Channels = the LAST axis of the native [in, out] layout."""
+    """Symmetric per-output-channel int8: returns (q [same shape], scale).
+
+    2-D [in, out] kernels get one scale per output channel (the last axis).
+    3-D [E, in, out] stacked MoE expert kernels get one scale per (expert,
+    output channel) — amax over axis 1 only, scale [E, out] — so an expert
+    with small weights does not inherit the largest expert's scale (which
+    would inflate its quantization error well beyond the dense per-channel
+    level)."""
     w32 = np.asarray(w, np.float32)
-    amax = np.max(np.abs(w32), axis=tuple(range(w32.ndim - 1)))
+    reduce_axes = tuple(range(w32.ndim - 1)) if w32.ndim < 3 else (1,)
+    amax = np.max(np.abs(w32), axis=reduce_axes)
     scale = np.maximum(amax, 1e-12).astype(np.float32) / 127.0
-    q = np.clip(np.rint(w32 / scale), -127, 127).astype(np.int8)
+    q = np.clip(
+        np.rint(w32 / scale.reshape(_scale_expand(scale, w32.ndim))), -127, 127
+    ).astype(np.int8)
     return q, scale
+
+
+def _scale_expand(scale: np.ndarray, q_ndim: int):
+    """Broadcast shape for a quantization scale against its int8 payload:
+    the scale keeps the payload's leading axes (stack/expert) and trailing
+    channel axis; the reduced middle axes become size 1. Covers all four
+    layouts — stored [out] / stacked [k, out] / per-expert [E, out] /
+    stacked-per-expert [k, E, out]."""
+    return scale.shape[:-1] + (1,) * (q_ndim - scale.ndim) + scale.shape[-1:]
 
 
 def _quantize_flat(sd: dict[str, np.ndarray]) -> dict[str, np.ndarray]:
@@ -95,7 +113,9 @@ def is_quantized_leaf(node) -> bool:
 
 def dequantize_np(node: dict[str, np.ndarray]) -> np.ndarray:
     """Host-side dequantize of one {"q8","s"} leaf-group (float32)."""
-    return np.asarray(node["q8"], np.float32) * node["s"]
+    q = np.asarray(node["q8"], np.float32)
+    s = np.asarray(node["s"])
+    return q * s.reshape(_scale_expand(s, q.ndim))
 
 # ---------------------------------------------------------------------------
 # Key grouping — the reference's rule (/root/reference/prepare_weights.py:21)
@@ -592,6 +612,10 @@ def save_params(params: dict[str, Any], out_dir: str, cfg: LlamaConfig) -> None:
     if "lm_head" in params and params["lm_head"]:
         st_save_file(dict(flatten(params["lm_head"])), os.path.join(out_dir, "lm_head.safetensors"))
     hf_cfg = {
+        # Marks a config this framework wrote itself: every native field is
+        # explicit and from_hf_config round-trips them all by name. Foreign
+        # configs (no marker) get the per-family stray-key defence instead.
+        "fls_native": True,
         "model_type": cfg.model_type,
         "vocab_size": cfg.vocab_size,
         "hidden_size": cfg.hidden_size,

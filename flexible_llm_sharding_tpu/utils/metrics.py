@@ -263,6 +263,96 @@ def progress_bar(total: int, desc: str, unit: str = "it", disable=None,
     return bar
 
 
+def model_flops_per_token(cfg, context_len: int = 0) -> float:
+    """Analytic forward FLOPs per processed token for a LlamaConfig.
+
+    2 FLOPs per matmul MAC over every parameter that participates in a
+    matmul (projections, MLP, lm_head — embeddings are a gather, not FLOPs),
+    plus the attention score/value terms (4*ctx*head_dim per query head per
+    token at mean context ``context_len``). MoE layers count only the
+    ACTIVE experts per token (top-k, + llama4's shared expert) plus the
+    router. This is the numerator of MFU — the standard "model FLOPs"
+    convention (no recompute, no masking discounts).
+    """
+    h = cfg.hidden_size
+    hd = cfg.head_dim
+    q_dim = cfg.num_attention_heads * hd
+    kv_dim = cfg.num_key_value_heads * hd
+    attn_proj = h * q_dim + 2 * h * kv_dim + q_dim * h
+    attn_scores = 2 * context_len * hd * cfg.num_attention_heads  # QK^T + AV MACs
+
+    n = cfg.num_hidden_layers
+    moe_pattern = cfg.moe_layer_pattern or (
+        ((True,) * n) if cfg.num_local_experts else ((False,) * n)
+    )
+    dense_inter = (
+        cfg.intermediate_size_mlp
+        if cfg.intermediate_size_mlp is not None
+        else cfg.intermediate_size
+    )
+    total = 0.0
+    for is_moe in moe_pattern:
+        if is_moe:
+            active = cfg.num_experts_per_tok + (
+                1 if cfg.model_type == "llama4_text" else 0  # shared expert
+            )
+            mlp = active * 3 * h * cfg.intermediate_size + h * cfg.num_local_experts
+        else:
+            mlp = 3 * h * dense_inter
+        total += 2 * (attn_proj + mlp) + 2 * attn_scores
+    total += 2 * h * cfg.vocab_size  # lm_head
+    return float(total)
+
+
+# Peak dense bf16 FLOP/s per chip, by device_kind substring (public TPU
+# specs; the MFU denominator).
+_PEAK_BF16_FLOPS = (
+    ("v6", 918e12),  # Trillium / v6e
+    ("v5p", 459e12),
+    ("v5e", 197e12),
+    ("v5 lite", 197e12),
+    ("v5litepod", 197e12),
+    ("v4", 275e12),
+)
+
+
+def measure_host_to_hbm_gbps(device=None, mb: int = 256) -> float:
+    """Effective host->device transfer bandwidth (GB/s): one timed
+    ``device_put`` of an ``mb``-MB buffer, after a SAME-SHAPE warm transfer
+    so backend init, first-transfer setup, and the readback compile all land
+    outside the timed region. Completion is observed with a device_get of a
+    scalar sum rather than block_until_ready (which is unreliable through
+    the axon tunnel). The binding constraint of weight streaming — every
+    throughput artifact should carry this number for legibility."""
+    import time
+
+    import jax
+    import jax.numpy as jnp  # noqa: F401
+
+    import numpy as np
+
+    device = device or jax.devices()[0]
+    buf = np.ones((mb, 1024, 1024 // 4), np.float32)
+    a = jax.device_put(buf, device)  # warm: same shape/dtype as the timed put
+    jax.device_get(a.sum())  # warm the readback compile too
+    t0 = time.perf_counter()
+    a = jax.device_put(buf, device)
+    jax.device_get(a.sum())
+    return buf.nbytes / 1e9 / (time.perf_counter() - t0)
+
+
+def chip_peak_flops(device=None) -> float | None:
+    """Peak bf16 FLOP/s for one chip, or None when unknown (CPU, new kinds)."""
+    import jax
+
+    device = device or jax.devices()[0]
+    kind = (getattr(device, "device_kind", "") or "").lower()
+    for token, peak in _PEAK_BF16_FLOPS:
+        if token in kind:
+            return peak
+    return None
+
+
 def throughput(tokens: int, seconds: float, chips: int = 1) -> dict[str, float]:
     """tokens/sec and tokens/sec/chip — the BASELINE.md headline metric."""
     tps = tokens / seconds if seconds > 0 else 0.0
@@ -275,6 +365,8 @@ def throughput(tokens: int, seconds: float, chips: int = 1) -> dict[str, float]:
 __all__ = [
     "LiveArrayPeakSampler",
     "Recorder",
+    "chip_peak_flops",
+    "model_flops_per_token",
     "compiled_memory_analysis",
     "device_memory_stats",
     "peak_hbm_gb",

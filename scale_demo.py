@@ -15,7 +15,7 @@ locally available TPU, end to end through the real offline + online tooling:
    modes, recording peak HBM and throughput,
 4. kills the disk-mode run mid-stream (SIGKILL) and completes it with
    ``--resume true`` — exercising crash resume on a real workload,
-5. verifies all scores are finite and writes ``SCALE_r02.json``.
+5. verifies all scores are finite and writes ``SCALE_r03.json``.
 
 The pass criterion mirrors BASELINE.md's ≤16 GB-HBM-for-70B target scaled to
 the built model: peak HBM must be a small fraction of total weight bytes.
@@ -213,11 +213,11 @@ def main() -> None:
     p.add_argument("--keep", action="store_true")
     p.add_argument("--skip_disk", action="store_true")
     p.add_argument(
-        "--configs", default="cpu,disk",
+        "--configs", default="cpu,tpu,disk",
         help="comma list of runs: cpu (BASELINE cfg 1: lnps=1 acts in RAM), "
              "disk (BASELINE cfg 3: lnps=1 acts on disk + kill/resume), "
              "tpu (BASELINE cfg 2: lnps=8 acts in HBM). Results merge into "
-             "an existing SCALE_r02.json",
+             "an existing SCALE_r03.json",
     )
     args = p.parse_args()
     if args.child:
@@ -246,7 +246,7 @@ def main() -> None:
         "suffix_words": 24,
         "n_suffix": 4,
     }
-    out = os.path.join(ROOT, "SCALE_r02.json")
+    out = os.path.join(ROOT, "SCALE_r03.json")
     result: dict = {}
     merged_prior = False
     if os.path.exists(out):
@@ -276,19 +276,44 @@ def main() -> None:
     # by model_gb / link_bw per full pass; recording it makes the throughput
     # numbers interpretable across platforms (the axon tunnel here is ~100x
     # slower than a real v5e host link).
+    # Subprocess: the parent must not initialise the accelerator backend
+    # (the CLI children own it); the probe itself is the shared helper so
+    # BENCH and SCALE artifacts report comparable numbers.
     probe = subprocess.run(
         [sys.executable, "-c",
-         "import time,numpy as np,jax;"
-         "x=np.ones((256,1024,1024),np.float32);d=jax.devices()[0];"
-         "t0=time.perf_counter();a=jax.device_put(x,d);a.block_until_ready();"
-         "print(x.nbytes/1e9/(time.perf_counter()-t0))"],
+         "import jax;"
+         "from flexible_llm_sharding_tpu.utils.metrics import"
+         " measure_host_to_hbm_gbps;"
+         "d=jax.devices()[0];"
+         "print(measure_host_to_hbm_gbps(d));"
+         "print(getattr(d,'device_kind',d.platform))"],
         capture_output=True, text=True, cwd=ROOT,
     )
     try:
-        result["host_to_hbm_gbps"] = round(float(probe.stdout.strip().splitlines()[-1]), 3)
-        log(f"host->HBM link: {result['host_to_hbm_gbps']} GB/s")
+        lines = probe.stdout.strip().splitlines()
+        result["host_to_hbm_gbps"] = round(float(lines[-2]), 3)
+        result["device_kind"] = lines[-1]
+        log(f"host->HBM link: {result['host_to_hbm_gbps']} GB/s "
+            f"({result['device_kind']})")
     except (ValueError, IndexError):
         log("bandwidth probe failed: " + probe.stderr[-200:])
+
+    # Analytic model FLOPs/token (MFU numerator) for the built config; each
+    # run's mfu is derived from its tokens_per_sec in the post-pass below.
+    from flexible_llm_sharding_tpu.config import LlamaConfig
+    from flexible_llm_sharding_tpu.utils.metrics import (
+        _PEAK_BF16_FLOPS,
+        model_flops_per_token,
+    )
+
+    fpt = model_flops_per_token(
+        LlamaConfig(**{k: v for k, v in cfg.items()}), args.prefix_words
+    )
+    result["model_flops_per_token"] = round(fpt)
+    kind = (result.get("device_kind") or "").lower()
+    peak_flops = next(
+        (p for token, p in _PEAK_BF16_FLOPS if token in kind), None
+    )
 
     # Offline split through the real CLI (reference step 1).
     if not os.path.exists(os.path.join(NATIVE_DIR, "fls_tpu_layout.json")):
@@ -397,6 +422,17 @@ def main() -> None:
                     for a, b in zip(scores, dscores)
                 )
             )
+
+    # Per-config MFU (transfer-bound by design — read against the link
+    # bandwidth above; the number exists so "is it actually fast" is
+    # judgeable against the chip's peak).
+    if peak_flops:
+        for key in ("cpu", "tpu", "disk_resume"):
+            stats = result.get(key)
+            if isinstance(stats, dict) and stats.get("tokens_per_sec"):
+                stats["mfu"] = round(
+                    fpt * stats["tokens_per_sec"] / peak_flops, 6
+                )
 
     peak = result.get("cpu", {}).get("peak_hbm_gb")
     if peak is not None:

@@ -1,23 +1,26 @@
 #!/bin/bash
 # Waits for the TPU tunnel to recover, then captures the hardware evidence
-# artifacts in sequence: bench.py (BENCH JSON) and scale_demo.py
-# (SCALE_r02.json). Probes in a subprocess so a wedged tunnel can't hang
-# the watcher itself.
+# artifacts in sequence: bench.py (which persists BENCH_TPU_latest.json on
+# any successful on-TPU run) and scale_demo.py (SCALE_r03.json). Probes in
+# a subprocess so a wedged tunnel can't hang the watcher itself.
 cd /root/repo
 while true; do
   if timeout 90 python -c "import jax.numpy as j; (j.ones((64,64))@j.ones((64,64))).sum().block_until_ready()" >/dev/null 2>&1; then
     echo "$(date -u +%H:%M:%S) tunnel up - running bench" >> /tmp/hw_watcher.log
     BENCH_DEADLINE_S=2400 timeout 2700 python bench.py > /tmp/bench_hw.json 2> /tmp/bench_hw.log
     echo "$(date -u +%H:%M:%S) bench rc=$? $(cat /tmp/bench_hw.json)" >> /tmp/hw_watcher.log
-    # Only spend scale-demo time if bench really ran on TPU.
-    if grep -q '"platform": "tpu"' /tmp/bench_hw.json; then
+    # Only spend scale-demo time if bench really ran on TPU. Check the
+    # TOP-LEVEL platform key: a substring grep would false-positive on the
+    # embedded tpu_capture that CPU-fallback runs fold into their JSON.
+    if python -c "import json,sys; sys.exit(0 if json.load(open('/tmp/bench_hw.json')).get('platform')=='tpu' else 1)" 2>/dev/null; then
       echo "$(date -u +%H:%M:%S) running scale_demo" >> /tmp/hw_watcher.log
-      timeout 5400 python scale_demo.py > /tmp/scale_hw.log 2>&1
+      timeout 7200 python scale_demo.py > /tmp/scale_hw.log 2>&1
       rc=$?
-      echo "$(date -u +%H:%M:%S) scale_demo rc=$rc artifact: $(ls -la SCALE_r02.json 2>/dev/null)" >> /tmp/hw_watcher.log
-      # Only stop once the artifact actually exists — a tunnel drop mid-run
+      echo "$(date -u +%H:%M:%S) scale_demo rc=$rc artifact: $(ls -la SCALE_r03.json 2>/dev/null)" >> /tmp/hw_watcher.log
+      # Only stop once the artifacts actually exist — a tunnel drop mid-run
       # (the very failure mode this watcher exists for) must keep retrying.
-      if [ -f SCALE_r02.json ]; then
+      if [ -f SCALE_r03.json ] && python -c "import json,sys; sys.exit(0 if json.load(open('BENCH_TPU_latest.json')).get('platform')=='tpu' else 1)" 2>/dev/null; then
+        echo "$(date -u +%H:%M:%S) all hardware evidence captured" >> /tmp/hw_watcher.log
         exit 0
       fi
     fi

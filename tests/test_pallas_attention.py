@@ -69,6 +69,94 @@ def test_flash_prefix_shared_matches_xla(plen):
     )
 
 
+@pytest.mark.parametrize("window,chunk", [(128, None), (None, 192)])
+@pytest.mark.parametrize("local_on", [None, True, False])
+def test_flash_causal_local_forms(window, chunk, local_on):
+    """Sliding-window / chunked masks (+ the traced per-layer toggle) match
+    the XLA banded mask — the Gemma2/3 / binding-window Mistral / Llama4
+    envelope the kernels gained in r3."""
+    rng = np.random.default_rng(3)
+    lq, n_q, n_kv, hd, valid = 256, 4, 2, 128, 200
+    q = _rand(rng, lq, n_q, hd)
+    k = _rand(rng, lq, n_kv, hd)
+    v = _rand(rng, lq, n_kv, hd)
+
+    flag = None if local_on is None else jnp.asarray(local_on)
+    got = flash_causal_attention(
+        q, k, v, valid, window=window, chunk=chunk, local_on=flag,
+        interpret=True,
+    )
+    use_local = local_on is None or local_on
+    kj = jnp.arange(lq)[None, :]
+    mask = causal_mask(
+        lq, lq,
+        window=window if use_local else None,
+        chunk=chunk if use_local else None,
+    ) & (kj < valid)
+    want = attention(q, k, v, mask)
+    np.testing.assert_allclose(
+        np.asarray(got)[:valid], np.asarray(want)[:valid], rtol=2e-5, atol=2e-5
+    )
+
+
+@pytest.mark.parametrize("plen", [576, 130])
+@pytest.mark.parametrize("window,chunk", [(200, None), (None, 256)])
+def test_flash_prefix_shared_local_forms(plen, window, chunk):
+    """Windowed/chunked prefix-shared attention vs the XLA op, with the
+    window binding INSIDE the (dynamic-length) prefix."""
+    rng = np.random.default_rng(4)
+    s, ls, n_q, n_kv, hd, lp = 2, 64, 4, 2, 128, 640
+    q = _rand(rng, s, ls, n_q, hd)
+    kp = _rand(rng, lp, n_kv, hd)
+    vp = _rand(rng, lp, n_kv, hd)
+    ks = _rand(rng, s, ls, n_kv, hd)
+    vs = _rand(rng, s, ls, n_kv, hd)
+
+    got = flash_prefix_shared_attention(
+        q, kp, vp, ks, vs, plen, window=window, chunk=chunk, interpret=True
+    )
+    want = prefix_shared_attention(
+        q, kp, vp, ks, vs, jnp.int32(plen), window=window, chunk=chunk
+    )
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_flash_softcap_and_scale():
+    """Gemma2-style attention: softcap + query_pre_attn_scalar scale."""
+    rng = np.random.default_rng(5)
+    s, ls, n_q, n_kv, hd, lp = 2, 64, 4, 4, 128, 256
+    q = _rand(rng, s, ls, n_q, hd)
+    kp = _rand(rng, lp, n_kv, hd)
+    vp = _rand(rng, lp, n_kv, hd)
+    ks = _rand(rng, s, ls, n_kv, hd)
+    vs = _rand(rng, s, ls, n_kv, hd)
+    scale, cap = 224.0**-0.5, 50.0
+
+    got = flash_prefix_shared_attention(
+        q, kp, vp, ks, vs, 200, scale=scale, softcap=cap, interpret=True
+    )
+    want = prefix_shared_attention(
+        q, kp, vp, ks, vs, jnp.int32(200), scale=scale, softcap=cap
+    )
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5
+    )
+
+    got_c = flash_causal_attention(
+        q[0], kp[:64], vp[:64], 50, scale=scale, softcap=cap, interpret=True
+    )
+    kj = jnp.arange(64)[None, :]
+    want_c = attention(
+        q[0], kp[:64], vp[:64], causal_mask(64, 64) & (kj < 50),
+        scale=scale, softcap=cap,
+    )
+    np.testing.assert_allclose(
+        np.asarray(got_c)[:50], np.asarray(want_c)[:50], rtol=2e-5, atol=2e-5
+    )
+
+
 def test_flash_bf16():
     rng = np.random.default_rng(2)
     s, ls, n_q, n_kv, hd, lp = 2, 64, 4, 4, 128, 128

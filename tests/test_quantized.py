@@ -348,8 +348,9 @@ def test_int8_multichip(dirs, tiny_cfg, mode, tmp_path):
 
 def test_int8_llama4_moe(tmp_path):
     """int8 over llama4's fused-expert tensors: [E, D, F] kernels quantize
-    per final channel and the stacked dequant broadcasts [k, F] scales over
-    the expert/input axes; scores must match the host-dequantized oracle."""
+    per (expert, output channel) — scale [E, F], amax over the input axis
+    only — so an expert with small weights does not inherit the largest
+    expert's scale; scores must match the host-dequantized oracle."""
     from tests.test_model_families import LLAMA4_CFG, _hf_llama4
 
     model = _hf_llama4(LLAMA4_CFG)
@@ -359,7 +360,7 @@ def test_int8_llama4_moe(tmp_path):
     ckpt.split_into_layers(str(src), str(q8), dtype="int8")
     layer = ckpt.load_layer(str(q8), "model.layers.1")
     assert ckpt.is_quantized_leaf(layer["mlp"]["gate"])
-    assert layer["mlp"]["gate"]["s"].shape == (48,)  # per-F channel
+    assert layer["mlp"]["gate"]["s"].shape == (4, 48)  # per (expert, F)
 
     fw = FrameworkConfig(
         model_path=str(q8),

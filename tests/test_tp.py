@@ -108,6 +108,50 @@ def test_tp_dp_mutually_exclusive(model_dir):
         _cfg(model_dir, tensor_parallel=2, data_parallel=True)
 
 
+def test_tp_pallas_flash(tmp_path_factory):
+    """Flash attention under tensor parallelism: the kernels run per
+    head-shard inside a shard_map (pallas_call has no GSPMD rule), and must
+    match both the XLA path and the single-device flash path. Needs a
+    flash-eligible shape: head_dim 128, 64-multiple buckets."""
+    from flexible_llm_sharding_tpu.config import LlamaConfig
+
+    cfg = LlamaConfig(
+        vocab_size=128,
+        hidden_size=256,
+        intermediate_size=384,
+        num_hidden_layers=2,
+        num_attention_heads=2,
+        num_key_value_heads=2,
+        max_position_embeddings=512,
+    )
+    params = llama.init_params(jax.random.PRNGKey(1), cfg)
+    d = tmp_path_factory.mktemp("pallas_tp_model")
+    save_params(jax.tree.map(np.asarray, params), str(d), cfg)
+
+    def run(**kw):
+        c = FrameworkConfig(
+            model_path=str(d),
+            layer_num_per_shard=2,
+            storage_location="cpu",
+            dtype="float32",
+            bucket_multiple=64,
+            block_size=2,
+            prefetch_depth=0,
+            **kw,
+        )
+        n = kw.get("tensor_parallel", 1)
+        return run_prompts(
+            c, PROMPTS[:2], tokenizer=FakeTokenizer(), devices=jax.devices()[:n]
+        )
+
+    want = run(use_pallas=False)
+    got_flash = run(use_pallas=True)
+    got_tp = run(use_pallas=True, tensor_parallel=2)
+    for a, b, c in zip(want, got_flash, got_tp):
+        np.testing.assert_allclose(b, a, rtol=2e-5, atol=2e-6)
+        np.testing.assert_allclose(c, a, rtol=2e-5, atol=2e-6)
+
+
 def test_tp_placement_specs():
     """Column/row layout sanity: wq sharded on out, wo on in, head on vocab."""
     pl = TpPlacement(jax.devices()[:2])
