@@ -562,6 +562,7 @@ def decode_step_layer(
     t: jax.Array,
     sliding=None,
     rope_on=None,
+    use_pallas: bool = False,
 ) -> tuple[jax.Array, Params]:
     """One decoder layer for ONE new token per suffix, against cached KV.
 
@@ -571,7 +572,9 @@ def decode_step_layer(
     'kg','vg' [S,T,n_kv,hd]} with generated-token slots < t filled;
     t: int32 scalar (this step's slot). The new token sits at rotary position
     ``prefix_len + (suffix_eos[s]+1) + t``. Returns (x_out, kv with slot t
-    of kg/vg written).
+    of kg/vg written). ``use_pallas`` (static) swaps the attention for the
+    flash decode kernel when the head shapes are eligible — unlike the XLA
+    op it skips prefix-KV blocks past the real prefix length.
     """
     eps = cfg.rms_norm_eps
     rope_sliding = sliding
@@ -585,23 +588,44 @@ def decode_step_layer(
     kv["vg"] = jax.lax.dynamic_update_slice_in_dim(kv["vg"], v_new, t, axis=1)
 
     window, chunk, sliding = _effective_window(cfg, sliding)
-    attn_out = decode_attention(
-        q,
-        kv["kp"],
-        kv["vp"],
-        kv["ks"],
-        kv["vs"],
-        kv["kg"],
-        kv["vg"],
-        prefix_len,
-        suffix_eos,
-        t,
-        scale=cfg.attn_scale,
-        window=window,
-        softcap=cfg.attn_logit_softcap,
-        sliding=sliding,
-        chunk=chunk,
-    )
+    if use_pallas and pallas_attention.supports_decode(
+        cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
+    ):
+        attn_out = pallas_attention.flash_decode_attention(
+            q,
+            kv["kp"],
+            kv["vp"],
+            kv["ks"],
+            kv["vs"],
+            kv["kg"],
+            kv["vg"],
+            prefix_len,
+            suffix_eos,
+            t,
+            scale=cfg.attn_scale,
+            window=window,
+            softcap=cfg.attn_logit_softcap,
+            local_on=sliding,
+            chunk=chunk,
+        )
+    else:
+        attn_out = decode_attention(
+            q,
+            kv["kp"],
+            kv["vp"],
+            kv["ks"],
+            kv["vs"],
+            kv["kg"],
+            kv["vg"],
+            prefix_len,
+            suffix_eos,
+            t,
+            scale=cfg.attn_scale,
+            window=window,
+            softcap=cfg.attn_logit_softcap,
+            sliding=sliding,
+            chunk=chunk,
+        )
     mid = _residual_attn(params, cfg, x, attn_out)
     return _residual_mlp(params, cfg, mid), kv
 
@@ -758,6 +782,47 @@ def init_layer_params(rng: jax.Array, cfg: LlamaConfig, dtype=jnp.float32) -> Pa
         out["pre_feedforward_layernorm"] = {"scale": jnp.ones((d,), dtype)}
         out["post_feedforward_layernorm"] = {"scale": jnp.ones((d,), dtype)}
     return out
+
+
+def init_mixed_params(rng: jax.Array, cfg: LlamaConfig, dtype=jnp.float32) -> Params:
+    """Random params for a MIXED dense/MoE stack (``cfg.moe_layer_pattern``):
+    dense layers at the family's dense width (llama4 ``intermediate_size_mlp``),
+    MoE layers with stacked experts — plus llama4's shared expert. Used by
+    tests and the multichip dryrun to build the checkpoint structure the
+    splitter produces from real llama4/qwen3_moe weights."""
+    import dataclasses
+
+    assert cfg.moe_layer_pattern is not None
+    dense_cfg = dataclasses.replace(
+        cfg,
+        model_type="llama",
+        num_local_experts=0,
+        intermediate_size=cfg.intermediate_size_mlp or cfg.intermediate_size,
+        moe_layer_pattern=None,
+        intermediate_size_mlp=None,
+    )
+    moe_cfg = dataclasses.replace(cfg, moe_layer_pattern=None)
+    keys = jax.random.split(rng, cfg.num_hidden_layers)
+    layers = []
+    for i, is_moe in enumerate(cfg.moe_layer_pattern):
+        lp = init_layer_params(keys[i], moe_cfg if is_moe else dense_cfg, dtype)
+        if is_moe and cfg.model_type == "llama4_text":
+            d, f = cfg.hidden_size, cfg.intermediate_size
+            ks = jax.random.split(jax.random.fold_in(keys[i], 99), 3)
+
+            def lin(key, fan_in, fan_out):
+                scale = (2.0 / (fan_in + fan_out)) ** 0.5
+                return (jax.random.normal(key, (fan_in, fan_out)) * scale).astype(dtype)
+
+            lp["mlp"] |= {
+                "shared_gate": lin(ks[0], d, f),
+                "shared_up": lin(ks[1], d, f),
+                "shared_down": lin(ks[2], f, d),
+            }
+        layers.append(lp)
+    params = init_params(jax.random.fold_in(rng, 1), dense_cfg, dtype)
+    params["layers"] = layers
+    return params
 
 
 def init_params(rng: jax.Array, cfg: LlamaConfig, dtype=jnp.float32) -> Params:

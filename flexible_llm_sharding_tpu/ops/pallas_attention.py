@@ -359,8 +359,186 @@ def flash_prefix_shared_attention(
     return out.transpose(0, 2, 1, 3)
 
 
+# ---------------------------------------------------------------------------
+# Single-token decode attention over three cached KV regions
+# ---------------------------------------------------------------------------
+
+def _decode_kernel(
+    flags_ref, q_ref, kp_ref, vp_ref, ks_ref, vs_ref, kg_ref, vg_ref, o_ref,
+    *, scale, lp, bkp, window, chunk, softcap,
+):
+    # Head-major blocks: q_ref [1, 1, gp, hd] (the query group rows of one
+    # (suffix, kv-head) program, padded to the sublane multiple);
+    # kp_ref/vp_ref [1, lp, hd]; ks_ref/vs_ref/kg_ref/vg_ref [1, 1, L, hd].
+    si = pl.program_id(0)
+    _, _, gp, hd = q_ref.shape
+    q = q_ref[0, 0]
+    plen = flags_ref[0]
+    t = flags_ref[1]
+    local_on = flags_ref[2] != 0
+    eos = flags_ref[3 + si]
+    # The one new token sits at absolute position plen + eos + 1 + t
+    # (ops.attention.decode_attention convention).
+    q_abs = plen + eos + 1 + t
+
+    m = jnp.full((gp, 1), _NEG_INF, jnp.float32)
+    l = jnp.zeros((gp, 1), jnp.float32)
+    acc = jnp.zeros((gp, hd), jnp.float32)
+
+    # Shared prefix KV: visible iff the key is real (j < plen).
+    def p_body(blk, carry):
+        m, l, acc = carry
+        start = blk * bkp
+        kb = kp_ref[0, pl.ds(start, bkp), :]
+        vb = vp_ref[0, pl.ds(start, bkp), :]
+        kj = start + jax.lax.broadcasted_iota(jnp.int32, (1, bkp), 1)
+        mask = _local_mask(
+            jnp.broadcast_to(kj < plen, (gp, bkp)), q_abs, kj, window, chunk,
+            local_on,
+        )
+        return _online_block(q, kb, vb, mask, m, l, acc, scale, softcap)
+
+    n_real = jnp.minimum((plen + bkp - 1) // bkp, lp // bkp)
+    first = jnp.int32(0)
+    if window is not None or chunk is not None:
+        first = jnp.minimum(
+            _local_start_block(q_abs, window, chunk, bkp, local_on), n_real
+        )
+    m, l, acc = jax.lax.fori_loop(first, n_real, p_body, (m, l, acc))
+
+    # Own suffix KV: keys j <= eos; absolute position plen + j.
+    ls = ks_ref.shape[2]
+    kj = jax.lax.broadcasted_iota(jnp.int32, (1, ls), 1)
+    mask = _local_mask(
+        jnp.broadcast_to(kj <= eos, (gp, ls)), q_abs, plen + kj, window,
+        chunk, local_on,
+    )
+    m, l, acc = _online_block(
+        q, ks_ref[0, 0], vs_ref[0, 0], mask, m, l, acc, scale, softcap
+    )
+
+    # Generated-token KV: keys j <= t (slot t holds this step's own KV);
+    # absolute position plen + eos + 1 + j.
+    tm = kg_ref.shape[2]
+    kj = jax.lax.broadcasted_iota(jnp.int32, (1, tm), 1)
+    mask = _local_mask(
+        jnp.broadcast_to(kj <= t, (gp, tm)), q_abs, plen + eos + 1 + kj,
+        window, chunk, local_on,
+    )
+    m, l, acc = _online_block(
+        q, kg_ref[0, 0], vg_ref[0, 0], mask, m, l, acc, scale, softcap
+    )
+
+    o_ref[0, 0] = _finish(l, acc, o_ref.dtype)
+
+
+def supports_decode(n_q: int, n_kv: int, head_dim: int) -> bool:
+    """Decode-kernel eligibility: MXU-aligned head_dim and whole query
+    groups; ragged KV lengths are padded inside the wrapper (masks already
+    exclude the padding), so lengths never disqualify."""
+    return head_dim % 128 == 0 and n_q % n_kv == 0
+
+
+def _pad_dim(a, axis: int, mult: int):
+    p = (-a.shape[axis]) % mult
+    if not p:
+        return a
+    pad = [(0, 0)] * a.ndim
+    pad[axis] = (0, p)
+    return jnp.pad(a, pad)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("scale", "window", "chunk", "softcap", "interpret"),
+)
+def flash_decode_attention(
+    q, k_prefix, v_prefix, k_suffix, v_suffix, k_gen, v_gen, prefix_len,
+    suffix_eos, t, scale=None, window=None, chunk=None, softcap=None,
+    local_on=None, interpret=None,
+):
+    """Kernel form of ``ops.attention.decode_attention`` — ONE new token per
+    suffix attending jointly over [shared prefix KV ; own suffix KV ;
+    generated KV] (the KV-cache decode hot loop; the reference re-streams
+    the whole prompt per token instead, ``/root/reference/main.py:65-76``).
+
+    q [S, 1, n_q, hd]; k/v_prefix [Lp, n_kv, hd]; k/v_suffix [S, Ls, n_kv, hd];
+    k/v_gen [S, T, n_kv, hd]; prefix_len/t int32 scalars; suffix_eos int32 [S].
+    Returns [S, 1, n_q, hd]. Unlike the XLA op, KV blocks past the real
+    prefix (and wholly outside a binding window/chunk) are SKIPPED, so a
+    short prompt in a long bucket only pays for its real keys.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    s, _, n_q, hd = q.shape
+    lp, n_kv, _ = k_prefix.shape
+    g = n_q // n_kv
+    if scale is None:
+        scale = 1.0 / (hd**0.5)
+
+    # Head-major layouts; ragged axes pad up (masks exclude the padding):
+    # the query group to the fp32 sublane multiple, KV lengths to the lane
+    # tiling. All pads are no-ops at bucketed shapes.
+    qg = _pad_dim(q.reshape(s, n_kv, g, hd), 2, 8)
+    gp = qg.shape[2]
+    kp = _pad_dim(k_prefix.transpose(1, 0, 2), 1, 64)
+    vp = _pad_dim(v_prefix.transpose(1, 0, 2), 1, 64)
+    ks = _pad_dim(k_suffix.transpose(0, 2, 1, 3), 2, 64)
+    vs = _pad_dim(v_suffix.transpose(0, 2, 1, 3), 2, 64)
+    kg = _pad_dim(k_gen.transpose(0, 2, 1, 3), 2, 64)
+    vg = _pad_dim(v_gen.transpose(0, 2, 1, 3), 2, 64)
+    lpp = kp.shape[1]
+    bkp = _block(lpp, _MAX_BLOCK_K)
+
+    # Scalar-prefetch payload: [plen, t, local_on, eos_0..eos_{S-1}].
+    local_flag = jnp.asarray(True if local_on is None else local_on)
+    flags = jnp.concatenate(
+        [
+            jnp.stack(
+                [
+                    jnp.asarray(prefix_len, jnp.int32),
+                    jnp.asarray(t, jnp.int32),
+                    local_flag.astype(jnp.int32),
+                ]
+            ),
+            jnp.asarray(suffix_eos, jnp.int32),
+        ]
+    )
+
+    grid = (s, n_kv)
+    kv_head = lambda si, h, flags: (h, 0, 0)
+    skv = lambda si, h, flags: (si, h, 0, 0)
+
+    kernel = functools.partial(
+        _decode_kernel, scale=scale, lp=lpp, bkp=bkp, window=window,
+        chunk=chunk, softcap=softcap,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, 1, gp, hd), skv),
+                pl.BlockSpec((1, lpp, hd), kv_head),
+                pl.BlockSpec((1, lpp, hd), kv_head),
+                pl.BlockSpec((1, 1, ks.shape[2], hd), skv),
+                pl.BlockSpec((1, 1, ks.shape[2], hd), skv),
+                pl.BlockSpec((1, 1, kg.shape[2], hd), skv),
+                pl.BlockSpec((1, 1, kg.shape[2], hd), skv),
+            ],
+            out_specs=pl.BlockSpec((1, 1, gp, hd), skv),
+        ),
+        out_shape=jax.ShapeDtypeStruct((s, n_kv, gp, hd), q.dtype),
+        interpret=interpret,
+    )(flags, qg, kp, vp, ks, vs, kg, vg)
+    return out[:, :, :g].reshape(s, 1, n_q, hd)
+
+
 __all__ = [
     "flash_causal_attention",
     "flash_prefix_shared_attention",
+    "flash_decode_attention",
     "supports",
+    "supports_decode",
 ]

@@ -59,7 +59,7 @@ def _block_update(q, k, v, mask, m, l, acc, scale):
     return m_new, l, acc
 
 
-def _ring_local(q_blk, k_blk, v_blk, *, axis, causal, scale):
+def _ring_local(q_blk, k_blk, v_blk, *, axis, causal, scale, window=None):
     """Per-chip body under shard_map: q stays, KV rotates around the ring."""
     n = jax.lax.axis_size(axis)
     idx = jax.lax.axis_index(axis)
@@ -81,6 +81,9 @@ def _ring_local(q_blk, k_blk, v_blk, *, axis, causal, scale):
         src = (idx - step) % n  # whose KV block we currently hold
         kj = src * lq + jnp.arange(lq)[None, :]
         mask = (kj <= qi) if causal else jnp.ones((lq, lq), bool)
+        if window is not None:
+            # Sliding-window visibility (HF convention: q - k < window).
+            mask = mask & ((qi - kj) < window)
         m, l, acc = _block_update(qr, k_cur, v_cur, mask, m, l, acc, scale)
         if step != n - 1:
             # Rotate KV one hop around the ring (ICI neighbour transfer);
@@ -101,10 +104,13 @@ def ring_self_attention(
     axis: str = "sp",
     causal: bool = True,
     scale: float | None = None,
+    window: int | None = None,
 ) -> jax.Array:
     """Sequence-parallel self-attention over the ``axis`` mesh dimension.
 
     q [L, n_q, hd]; k/v [L, n_kv, hd]; L must divide evenly by the axis size.
+    ``window`` ANDs a sliding-window clause into the causal mask (blocks
+    entirely outside the window contribute nothing to the online softmax).
     Returns [L, n_q, hd], sharded like q. Numerically equal to dense
     (masked) attention — verified against ops.attention in tests.
     """
@@ -115,7 +121,9 @@ def ring_self_attention(
     if scale is None:
         scale = 1.0 / (hd**0.5)
 
-    fn = functools.partial(_ring_local, axis=axis, causal=causal, scale=scale)
+    fn = functools.partial(
+        _ring_local, axis=axis, causal=causal, scale=scale, window=window
+    )
     spec = P(axis, None, None)
     shard_fn = jax.shard_map(
         fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
@@ -130,12 +138,17 @@ def ring_decoder_layer(
     mesh: Mesh,
     axis: str = "sp",
     return_kv: bool = False,
+    sliding: bool = False,
 ) -> jax.Array:
     """A full decoder layer with sequence-parallel (ring) attention.
 
     x: [L, D] sharded over ``axis``. RoPE positions are global (the chip's
     block offset is folded in under shard_map). Elementwise/matmul parts
     run purely locally on each chip's sequence block.
+
+    ``sliding=True`` applies the model's ``cfg.sliding_window`` to the ring
+    attention (Mistral-style local layers; the reference truncates long
+    prompts instead, ``/root/reference/utils.py:250,254``).
 
     ``return_kv=True`` additionally returns this layer's post-RoPE (k, v)
     [L, n_kv, hd], still sharded over ``axis`` — the long-context scorer
@@ -163,7 +176,10 @@ def ring_decoder_layer(
     x0, q, k, v = jax.shard_map(
         local, mesh=mesh, in_specs=(spec,), out_specs=qkv_specs
     )(x)
-    attn = ring_self_attention(q, k, v, mesh, axis=axis, causal=True)
+    attn = ring_self_attention(
+        q, k, v, mesh, axis=axis, causal=True,
+        window=cfg.sliding_window if sliding else None,
+    )
 
     def local_tail(x_blk, attn_blk):
         mid = x_blk + llama._out_proj(params["attn"], attn_blk)

@@ -106,26 +106,42 @@ def make_mesh(
     return Mesh(arr, names)
 
 
-def layer_specs(tp: str | None = "tp", cfg: LlamaConfig | None = None) -> Params:
+def layer_specs(
+    tp: str | None = "tp",
+    cfg: LlamaConfig | None = None,
+    mlp_kind: str | None = None,
+) -> Params:
     """PartitionSpecs for one decoder layer's params (Megatron TP layout).
 
     ``cfg`` adds entries for the bias vectors the model family carries
     (Qwen2 q/k/v, Llama attention_bias/mlp_bias): a column-parallel
     projection's bias shards with its output axis; a row-parallel
     projection's bias is replicated (added once, after the psum).
+
+    ``mlp_kind`` overrides the MLP structure for models that interleave
+    structurally different layers (llama4 / qwen3_moe dense interleave):
+    ``"dense"`` or ``"moe"``; ``None`` derives it from ``cfg`` (MoE iff the
+    config declares experts).
     """
     col = P(None, tp)  # [in, out] sharded on out
     row = P(tp, None)  # [in, out] sharded on in
     rep = P(None)
     bcol = P(tp)  # bias of a column-parallel projection
     attn: Params = {"wq": col, "wk": col, "wv": col, "wo": row}
-    if cfg is not None and cfg.num_local_experts:
+    if mlp_kind is None:
+        mlp_kind = "moe" if (cfg is not None and cfg.num_local_experts) else "dense"
+    if mlp_kind == "moe":
         # Expert parallelism: the stacked [E, ...] expert arrays shard on the
         # expert axis — each chip computes its own experts for all tokens and
         # GSPMD inserts one psum for the routed combine (models/llama.py
         # _moe_mlp). Router stays replicated (it is [D, E], tiny).
         exp = P(tp, None, None)
         mlp: Params = {"router": rep, "gate": exp, "up": exp, "down": exp}
+        if cfg is not None and cfg.model_type == "llama4_text":
+            # Llama4's always-on shared expert is a plain Megatron MLP
+            # alongside the expert-sharded routed stack (_llama4_moe_mlp);
+            # its row-parallel down-projection folds into the same psum.
+            mlp |= {"shared_gate": col, "shared_up": col, "shared_down": row}
     else:
         mlp = {"gate": col, "up": col, "down": row}
     if cfg is not None:
@@ -207,16 +223,24 @@ class TpPlacement:
             raise ValueError("TpPlacement needs >= 2 devices")
         self.mesh = make_mesh({"tp": len(devices)}, list(devices))
         self.act = NamedSharding(self.mesh, P())
-        rep = jax.tree.map(
-            lambda s: NamedSharding(self.mesh, s),
-            layer_specs("tp", cfg),
-            is_leaf=lambda x: isinstance(x, P),
-        )
-        # Stacked-scan decoder pytrees carry a leading [k] layer axis, and
-        # ride inside a {"layers", "sliding"} wrapper (the per-layer window
-        # flags of Gemma2-style alternation; None when uniform).
-        self._decoder = jax.tree.map(
-            lambda s: NamedSharding(self.mesh, P(None, *s.spec)), rep
+
+        def decoder_tree(mlp_kind: str | None):
+            # Stacked-scan decoder pytrees carry a leading [k] layer axis.
+            return jax.tree.map(
+                lambda s: NamedSharding(self.mesh, P(None, *s)),
+                layer_specs("tp", cfg, mlp_kind=mlp_kind),
+                is_leaf=lambda x: isinstance(x, P),
+            )
+
+        self._decoder = decoder_tree(None)
+        # Mixed dense/MoE stacks (llama4, qwen3_moe dense interleave) produce
+        # structurally different "decoders" segments — the loader splits them
+        # into homogeneous scan runs, and segment_target picks the matching
+        # spec tree per run by the host structure.
+        self._decoder_dense = (
+            decoder_tree("dense")
+            if cfg is not None and cfg.num_local_experts and cfg.moe_layer_pattern
+            else self._decoder
         )
         self._by_kind = {
             "decoders": {
@@ -236,8 +260,18 @@ class TpPlacement:
             "head": {"kernel": NamedSharding(self.mesh, P(None, "tp"))},
         }
 
-    def segment_target(self, kind: str):
-        return self._by_kind[kind]
+    def segment_target(self, kind: str, host=None):
+        """Sharding target for one streamed segment. ``host`` (the host-side
+        pytree about to be device_put) disambiguates mixed dense/MoE models:
+        a decoder run without a router takes the dense Megatron specs."""
+        target = self._by_kind[kind]
+        if (
+            kind == "decoders"
+            and host is not None
+            and "router" not in host["layers"]["mlp"]
+        ):
+            target = dict(target, layers=self._decoder_dense)
+        return target
 
     def check(self, cfg: LlamaConfig) -> None:
         check_tp_divisibility(cfg, self.mesh.shape["tp"])
@@ -258,6 +292,21 @@ def check_tp_divisibility(cfg: LlamaConfig, tp_size: int) -> None:
         if cfg.num_local_experts % tp_size:
             raise ValueError(
                 f"num_local_experts={cfg.num_local_experts} not divisible by tp={tp_size}"
+            )
+        # Dense interleave layers (llama4 intermediate_size_mlp, qwen3_moe
+        # mlp_only_layers) and llama4's shared expert shard on their own
+        # hidden axis like any Megatron MLP.
+        dense_f = cfg.intermediate_size_mlp or (
+            cfg.intermediate_size if cfg.moe_layer_pattern else None
+        )
+        if cfg.model_type == "llama4_text" and cfg.intermediate_size % tp_size:
+            raise ValueError(
+                f"shared-expert intermediate_size={cfg.intermediate_size} "
+                f"not divisible by tp={tp_size}"
+            )
+        if dense_f and dense_f % tp_size:
+            raise ValueError(
+                f"dense-layer intermediate size {dense_f} not divisible by tp={tp_size}"
             )
     elif cfg.intermediate_size % tp_size:
         raise ValueError(

@@ -89,8 +89,10 @@ def _prefill_decoders(cfg: LlamaConfig, use_pallas, seg, prefix_h, suffix_h, pre
     return prefix_h, suffix_h, kv
 
 
-@partial(jax.jit, static_argnums=(0,), donate_argnums=(2, 3))
-def _decode_decoders(cfg: LlamaConfig, seg, kv, x, prefix_len, suffix_eos, t):
+@partial(jax.jit, static_argnums=(0, 1), donate_argnums=(3, 4))
+def _decode_decoders(
+    cfg: LlamaConfig, use_pallas, seg, kv, x, prefix_len, suffix_eos, t
+):
     """Scan k layers' single-token decode over a block.
 
     seg: {"layers": [k, ...] pytree, "sliding": bool [k] or None,
@@ -104,7 +106,12 @@ def _decode_decoders(cfg: LlamaConfig, seg, kv, x, prefix_len, suffix_eos, t):
     def body(x, layer):
         layer_params, sliding, rope_on, layer_kv = layer
         step = jax.vmap(
-            partial(llama.decode_step_layer, sliding=sliding, rope_on=rope_on),
+            partial(
+                llama.decode_step_layer,
+                sliding=sliding,
+                rope_on=rope_on,
+                use_pallas=use_pallas,
+            ),
             in_axes=(None, None, 0, 0, 0, 0, None),
         )
         x, layer_kv = step(layer_params, cfg, x, layer_kv, prefix_len, suffix_eos, t)
@@ -357,8 +364,8 @@ class DecodeGenerator:
                             elif kind == "decoders":
                                 kv = kv_store.get(("kv", shard_pos, di, b), act_dev)
                                 x, kv = _decode_decoders(
-                                    self.model_cfg, params, kv, x,
-                                    prefix_len, suffix_eos, jnp.int32(t),
+                                    self.model_cfg, self._use_pallas, params,
+                                    kv, x, prefix_len, suffix_eos, jnp.int32(t),
                                 )
                                 kv_store.put(("kv", shard_pos, di, b), kv)
                                 di += 1

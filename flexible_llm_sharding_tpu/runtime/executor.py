@@ -482,7 +482,7 @@ def _place(
     for kind, p in segments:
         quant = _has_quantized(p)
         if tp:
-            target = device.segment_target(kind)
+            target = device.segment_target(kind, p)
             if quant:
                 target = _quantized_target(p, target)
             d = jax.device_put(p, target)

@@ -81,6 +81,7 @@ def sharded_prefix_suffix_layer(
     prefix_x: jax.Array,
     suffix_h: jax.Array,
     prefix_len: jax.Array,
+    sliding: bool = False,
 ):
     """One decoder layer of the long-context scoring step.
 
@@ -88,15 +89,19 @@ def sharded_prefix_suffix_layer(
     suffix_h [S, Ls, D] replicated; prefix_len int32 scalar (true length).
     Semantics match :func:`llama.prefix_suffix_layer` exactly — the suffix
     side sees one joint softmax over all real prefix keys plus its own
-    causal keys at rotary positions ``prefix_len + i``.
+    causal keys at rotary positions ``prefix_len + i``. ``sliding=True``
+    applies ``cfg.sliding_window`` to both the ring prefix attention and the
+    suffix side's visibility (the window clause of ops.attention's dense op,
+    here folded into the sharded partial-softmax masks).
     """
     s_cnt, ls, _ = suffix_h.shape
     eps = cfg.rms_norm_eps
     scale = 1.0 / (cfg.head_dim**0.5)
+    window = cfg.sliding_window if sliding else None
 
     # --- prefix: ring attention layer, keeping its post-RoPE KV ---
     prefix_out, k_all, v_all = ring_decoder_layer(
-        params, cfg, prefix_x, mesh, axis=axis, return_kv=True
+        params, cfg, prefix_x, mesh, axis=axis, return_kv=True, sliding=sliding
     )
 
     # --- suffix q/k/v at global positions prefix_len + i ---
@@ -119,7 +124,12 @@ def sharded_prefix_suffix_layer(
         idx = jax.lax.axis_index(axis)
         lblk = k_blk.shape[0]
         kj = idx * lblk + jnp.arange(lblk)[None, None, :]  # global key pos
-        mask = jnp.broadcast_to(kj < plen, (s_cnt, ls, lblk))
+        vis = kj < plen
+        if window is not None:
+            # Suffix query i sits at global position plen + i.
+            qi = plen + jnp.arange(ls)[None, :, None]
+            vis = vis & ((qi - kj) < window)
+        mask = jnp.broadcast_to(vis, (s_cnt, ls, lblk))
         m, l, acc = _partials(qr, k_blk, v_blk, mask, scale)
         m_g = jax.lax.pmax(m, axis)
         corr = jnp.exp(m - m_g)
@@ -135,9 +145,10 @@ def sharded_prefix_suffix_layer(
         check_vma=False,
     )(qr, k_all, v_all, prefix_len)
 
-    # --- own suffix block: causal within the suffix ---
+    # --- own suffix block: causal within the suffix (window clause on the
+    # relative offsets — both sides carry the same plen shift) ---
     m_s, l_s, acc_s = _partials(
-        qr, ks, vs, causal_mask(ls, ls)[None], scale
+        qr, ks, vs, causal_mask(ls, ls, window=window)[None], scale
     )
 
     # --- merge the two accumulator sets (one joint softmax) ---
@@ -173,23 +184,26 @@ class LongContextScorer:
         self.model_cfg = LlamaConfig.from_pretrained(cfg.model_path)
         mc = self.model_cfg
         if (
-            mc.sliding_window is not None
-            or mc.attention_chunk_size is not None
+            mc.attention_chunk_size is not None
             or mc.layer_rope is not None
             or mc.rope_interleaved
             or mc.qk_l2_norm
             or mc.ffw_sandwich_norms
             or mc.attn_logit_softcap is not None
             or mc.query_pre_attn_scalar is not None
+            or (mc.sliding_window is not None and mc.rope_local_theta is not None)
         ):
-            # This scorer's sharded attention implements full causal masks
-            # with the default scale and no softcap, and its layer tail uses
-            # the standard residual layout — accepting a config outside that
-            # envelope would return silently wrong scores.
+            # This scorer's sharded attention implements causal (optionally
+            # sliding-window) masks with the default scale and no softcap,
+            # and its layer tail uses the standard residual layout —
+            # accepting a config outside that envelope would return silently
+            # wrong scores. (Sliding windows ARE supported — Mistral/Qwen2
+            # uniform or per-layer — but not gemma3's per-window rope base.)
             raise NotImplementedError(
-                "long_context ring attention supports full-causal, "
-                "default-scale, un-softcapped models; sliding-window / "
-                "gemma2-style configs are not supported on this path"
+                "long_context ring attention supports causal or "
+                "sliding-window, default-scale, un-softcapped models; "
+                "chunked/llama4 and gemma2/3-style configs are not supported "
+                "on this path"
             )
         devices = list(devices) if devices else None
         self.mesh = make_mesh(
@@ -214,9 +228,11 @@ class LongContextScorer:
         self._rep = NamedSharding(self.mesh, P())
         self._seq = NamedSharding(self.mesh, P("sp"))
         self._layer_fn = jax.jit(
-            lambda params, px, sh, plen: sharded_prefix_suffix_layer(
-                params, self.model_cfg, self.mesh, "sp", px, sh, plen
-            )
+            lambda params, px, sh, plen, sliding: sharded_prefix_suffix_layer(
+                params, self.model_cfg, self.mesh, "sp", px, sh, plen,
+                sliding=sliding,
+            ),
+            static_argnums=4,  # two traces at most: local and global layers
         )
         self.stats: dict[str, float] = {}
 
@@ -271,14 +287,23 @@ class LongContextScorer:
                 elif kind == "decoders":
                     # Unstack the [k, ...] scan pytree: each layer runs
                     # as one jitted sharded step (shard_map inside). The
-                    # scorer rejects windowed models at init, so the
-                    # wrapper's sliding flags are always None here.
+                    # wrapper's sliding flags (per-layer local/global mix,
+                    # e.g. Qwen2 max_window_layers) pick the traced variant;
+                    # None flags mean uniform — every layer slides iff the
+                    # config carries a window.
                     stacked = params["layers"]
+                    flags = params.get("sliding")
+                    uniform = self.model_cfg.sliding_window is not None
                     k_layers = jax.tree.leaves(stacked)[0].shape[0]
                     for i in range(k_layers):
                         layer = jax.tree.map(lambda a: a[i], stacked)
+                        sliding = (
+                            bool(np.asarray(flags)[i])
+                            if flags is not None
+                            else uniform
+                        )
                         prefix_x, suffix_h = self._layer_fn(
-                            layer, prefix_x, suffix_h, prefix_len
+                            layer, prefix_x, suffix_h, prefix_len, sliding
                         )
                 elif kind == "norm":
                     suffix_h = llama.select_eos_and_norm(

@@ -90,13 +90,6 @@ def _tp_placement(cfg: FrameworkConfig, devices: list):
             f"chips, have {len(devices)}"
         )
     model_cfg = LlamaConfig.from_pretrained(cfg.model_path)
-    if model_cfg.model_type == "llama4_text":
-        # Llama4 interleaves structurally different layers (dense vs
-        # shared+routed MoE); TpPlacement's one-spec-per-kind trees cannot
-        # describe that yet.
-        raise NotImplementedError(
-            "--tensor_parallel is not supported for llama4 checkpoints yet"
-        )
     placement = TpPlacement(devices[: cfg.tensor_parallel], model_cfg)
     placement.check(model_cfg)
     return placement

@@ -327,7 +327,6 @@ def measure_host_to_hbm_gbps(device=None, mb: int = 256) -> float:
     import time
 
     import jax
-    import jax.numpy as jnp  # noqa: F401
 
     import numpy as np
 

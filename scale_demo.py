@@ -307,7 +307,7 @@ def main() -> None:
     )
 
     fpt = model_flops_per_token(
-        LlamaConfig(**{k: v for k, v in cfg.items()}), args.prefix_words
+        LlamaConfig(**cfg), args.prefix_words
     )
     result["model_flops_per_token"] = round(fpt)
     kind = (result.get("device_kind") or "").lower()

@@ -89,6 +89,43 @@ def test_decode_matches_token_level_oracle(tiny_cfg, model, storage, lnps):
             assert new.startswith(orig) and len(new) > len(orig)
 
 
+def test_decode_flash_kernel_matches_oracle(tmp_path_factory):
+    """KV decode with the flash decode kernel (use_pallas=True, interpret on
+    the CPU mesh): per-step distributions and greedy tokens must match the
+    token-level oracle. Needs a flash-eligible head_dim (128)."""
+    from flexible_llm_sharding_tpu.config import LlamaConfig
+
+    cfg = LlamaConfig(
+        vocab_size=256,
+        hidden_size=256,
+        intermediate_size=384,
+        num_hidden_layers=2,
+        num_attention_heads=2,
+        num_key_value_heads=2,
+        max_position_embeddings=512,
+    )
+    params = llama.init_params(jax.random.PRNGKey(8), cfg)
+    d = tmp_path_factory.mktemp("decode_flash_model")
+    save_params(jax.tree.map(np.asarray, params), str(d), cfg)
+
+    fw = FrameworkConfig(
+        model_path=str(d),
+        dtype="float32",
+        bucket_multiple=64,
+        block_size=2,
+        prefetch_depth=0,
+        num_gen_token=N_GEN,
+        use_pallas=True,
+    )
+    scores, _ = DecodeGenerator(fw, tokenizer=FakeTokenizer())(list(PROMPTS))
+
+    tok = PromptTokenizer(FakeTokenizer(), bucket_multiple=64)
+    want_scores, want_tokens = _oracle(params, cfg, tok, PROMPTS, N_GEN)
+    for i in range(len(PROMPTS)):
+        np.testing.assert_allclose(scores[i], want_scores[i], rtol=2e-4, atol=1e-5)
+        assert scores[i].argmax(-1).tolist() == want_tokens[i]
+
+
 def test_decode_cli(tiny_cfg, model, tmp_path):
     import pickle
 

@@ -157,6 +157,73 @@ def test_flash_softcap_and_scale():
     )
 
 
+@pytest.mark.parametrize("plen,t", [(500, 2), (130, 0)])
+@pytest.mark.parametrize("window", [None, 200])
+def test_flash_decode_matches_xla(plen, t, window):
+    """Flash decode kernel (three-region joint softmax, ragged-length
+    padding inside the wrapper) vs ops.attention.decode_attention."""
+    from flexible_llm_sharding_tpu.ops.attention import decode_attention
+    from flexible_llm_sharding_tpu.ops.pallas_attention import (
+        flash_decode_attention,
+    )
+
+    rng = np.random.default_rng(6)
+    s, ls, n_q, n_kv, hd, lp, tmax = 3, 48, 8, 2, 128, 576, 3
+    q = _rand(rng, s, 1, n_q, hd)
+    kp = _rand(rng, lp, n_kv, hd)
+    vp = _rand(rng, lp, n_kv, hd)
+    ks = _rand(rng, s, ls, n_kv, hd)
+    vs = _rand(rng, s, ls, n_kv, hd)
+    kg = _rand(rng, s, tmax, n_kv, hd)
+    vg = _rand(rng, s, tmax, n_kv, hd)
+    eos = jnp.asarray([5, 47, 20], jnp.int32)
+
+    got = flash_decode_attention(
+        q, kp, vp, ks, vs, kg, vg, jnp.int32(plen), eos, jnp.int32(t),
+        window=window, interpret=True,
+    )
+    want = decode_attention(
+        q, kp, vp, ks, vs, kg, vg, jnp.int32(plen), eos, jnp.int32(t),
+        window=window,
+    )
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_flash_decode_under_vmap_scan():
+    """The decode runtime runs the kernel inside vmap (block axis) + scan
+    (layer axis) — the exact composition _decode_decoders uses."""
+    from flexible_llm_sharding_tpu.ops.attention import decode_attention
+    from flexible_llm_sharding_tpu.ops.pallas_attention import (
+        flash_decode_attention,
+    )
+
+    rng = np.random.default_rng(7)
+    b, s, ls, n_q, n_kv, hd, lp, tmax = 2, 2, 64, 4, 4, 128, 128, 2
+    q = _rand(rng, b, s, 1, n_q, hd)
+    kp = _rand(rng, b, lp, n_kv, hd)
+    vp = _rand(rng, b, lp, n_kv, hd)
+    ks = _rand(rng, b, s, ls, n_kv, hd)
+    vs = _rand(rng, b, s, ls, n_kv, hd)
+    kg = _rand(rng, b, s, tmax, n_kv, hd)
+    vg = _rand(rng, b, s, tmax, n_kv, hd)
+    plen = jnp.asarray([100, 64], jnp.int32)
+    eos = jnp.asarray([[3, 60], [10, 2]], jnp.int32)
+    t = jnp.int32(1)
+
+    f = lambda fn: jax.vmap(
+        lambda *a: fn(*a, t, interpret=True)
+        if fn is flash_decode_attention
+        else fn(*a, t)
+    )(q, kp, vp, ks, vs, kg, vg, plen, eos)
+    got = f(flash_decode_attention)
+    want = f(decode_attention)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5
+    )
+
+
 def test_flash_bf16():
     rng = np.random.default_rng(2)
     s, ls, n_q, n_kv, hd, lp = 2, 64, 4, 4, 128, 128

@@ -152,6 +152,90 @@ def test_tp_pallas_flash(tmp_path_factory):
         np.testing.assert_allclose(c, a, rtol=2e-5, atol=2e-6)
 
 
+def _mixed_moe_model(tmp_path_factory, name: str, cfg):
+    """Build + save a mixed dense/MoE native checkpoint (the structure
+    llama4 / qwen3_moe's dense interleave produce from real weights)."""
+    params = llama.init_mixed_params(jax.random.PRNGKey(7), cfg)
+    d = tmp_path_factory.mktemp(name)
+    save_params(jax.tree.map(np.asarray, params), str(d), cfg)
+    return str(d)
+
+
+def _tp_vs_single(model_dir, tol=dict(rtol=1e-5, atol=1e-6), **kw):
+    want = run_prompts(
+        _cfg(model_dir, **kw), PROMPTS, tokenizer=FakeTokenizer(),
+        devices=jax.devices()[:1],
+    )
+    got = run_prompts(
+        _cfg(model_dir, tensor_parallel=2, **kw), PROMPTS,
+        tokenizer=FakeTokenizer(), devices=jax.devices()[:2],
+    )
+    assert len(got) == len(want)
+    for a, b in zip(got, want):
+        np.testing.assert_allclose(a, b, **tol)
+
+
+def test_tp_llama4_mixed_moe(tmp_path_factory):
+    """Llama4 under TP (VERDICT r2 item 7): mixed dense / (shared + routed
+    MoE) stacks split into homogeneous scan runs, each run taking its own
+    spec tree — dense Megatron specs without a router, expert-axis +
+    shared-expert specs with one. NoPE flags ride along as replicated xs."""
+    from flexible_llm_sharding_tpu.config import LlamaConfig
+
+    cfg = LlamaConfig(
+        model_type="llama4_text",
+        vocab_size=288,
+        hidden_size=64,
+        intermediate_size=32,  # experts + shared expert
+        intermediate_size_mlp=48,  # dense layers' own width
+        num_hidden_layers=3,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        explicit_head_dim=16,
+        max_position_embeddings=512,
+        num_local_experts=2,
+        num_experts_per_tok=1,
+        moe_layer_pattern=(False, True, True),
+        layer_rope=(True, True, False),  # NoPE full-attention layer
+        rope_interleaved=True,
+        qk_l2_norm=True,
+        attn_temperature_tuning=True,
+        attn_floor_scale=4.0,
+        attn_scale_coef=0.1,
+        tie_word_embeddings=False,
+    )
+    d = _mixed_moe_model(tmp_path_factory, "l4_tp_model", cfg)
+    # layer_num_per_shard=3 spans the dense/MoE boundary in one shard.
+    _tp_vs_single(d, layer_num_per_shard=3)
+
+
+def test_tp_qwen3_moe_dense_interleave(tmp_path_factory):
+    """qwen3_moe with mlp_only_layers (ADVICE r2: previously died inside
+    device_put with an opaque structure mismatch): dense runs take dense
+    specs, MoE runs the expert-axis specs."""
+    from flexible_llm_sharding_tpu.config import LlamaConfig
+
+    cfg = LlamaConfig(
+        model_type="qwen3_moe",
+        vocab_size=288,
+        hidden_size=64,
+        intermediate_size=48,
+        num_hidden_layers=3,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        explicit_head_dim=16,
+        max_position_embeddings=512,
+        num_local_experts=2,
+        num_experts_per_tok=2,
+        moe_norm_topk_prob=True,
+        moe_layer_pattern=(True, False, True),
+        qk_norm=True,
+        tie_word_embeddings=False,
+    )
+    d = _mixed_moe_model(tmp_path_factory, "q3moe_tp_model", cfg)
+    _tp_vs_single(d, layer_num_per_shard=2)
+
+
 def test_tp_placement_specs():
     """Column/row layout sanity: wq sharded on out, wo on in, head on vocab."""
     pl = TpPlacement(jax.devices()[:2])
