@@ -820,7 +820,13 @@ def init_mixed_params(rng: jax.Array, cfg: LlamaConfig, dtype=jnp.float32) -> Pa
                 "shared_down": lin(ks[2], f, d),
             }
         layers.append(lp)
-    params = init_params(jax.random.fold_in(rng, 1), dense_cfg, dtype)
+    # embed/norm/lm_head only — a 0-layer view skips building (and then
+    # discarding) a full dense layer stack.
+    params = init_params(
+        jax.random.fold_in(rng, 1),
+        dataclasses.replace(dense_cfg, num_hidden_layers=0),
+        dtype,
+    )
     params["layers"] = layers
     return params
 

@@ -49,6 +49,22 @@ from flexible_llm_sharding_tpu.parallel.sharding import (
 Params = dict[str, Any]
 
 
+def token_cross_entropy(
+    logits: jax.Array, targets: jax.Array, pad_id: int | None = None
+) -> jax.Array:
+    """Mean next-token cross-entropy from logits [..., L, V] and int targets
+    [..., L]. With ``pad_id``, positions whose target is pad are excluded
+    from the mean (right-padded ragged batches). Shared by the monolithic
+    loss below and the layer-streamed trainer's tail (training_stream.py) so
+    the two paths cannot drift."""
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    if pad_id is None:
+        return -jnp.mean(ll)
+    keep = (targets != pad_id).astype(jnp.float32)
+    return -jnp.sum(ll * keep) / jnp.maximum(jnp.sum(keep), 1.0)
+
+
 def next_token_loss(
     params: Params,
     cfg: LlamaConfig,
@@ -57,17 +73,10 @@ def next_token_loss(
     pad_id: int | None = None,
 ) -> jax.Array:
     """Mean next-token cross-entropy. tokens: int32 [B, L+1] (inputs=: -1,
-    targets=1:). With ``pad_id``, positions whose target is pad are excluded
-    from the mean (right-padded ragged batches). Logits come back float32
-    from ``forward_full``."""
+    targets=1:). Logits come back float32 from ``forward_full``."""
     inputs, targets = tokens[:, :-1], tokens[:, 1:]
     logits = llama.forward_full(params, cfg, inputs, dtype=dtype)
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    if pad_id is None:
-        return -jnp.mean(ll)
-    keep = (targets != pad_id).astype(jnp.float32)
-    return -jnp.sum(ll * keep) / jnp.maximum(jnp.sum(keep), 1.0)
+    return token_cross_entropy(logits, targets, pad_id)
 
 
 @dataclasses.dataclass

@@ -9,10 +9,14 @@ while true; do
     echo "$(date -u +%H:%M:%S) tunnel up - running bench" >> /tmp/hw_watcher.log
     BENCH_DEADLINE_S=2400 timeout 2700 python bench.py > /tmp/bench_hw.json 2> /tmp/bench_hw.log
     echo "$(date -u +%H:%M:%S) bench rc=$? $(cat /tmp/bench_hw.json)" >> /tmp/hw_watcher.log
-    # Only spend scale-demo time if bench really ran on TPU. Check the
-    # TOP-LEVEL platform key: a substring grep would false-positive on the
-    # embedded tpu_capture that CPU-fallback runs fold into their JSON.
-    if python -c "import json,sys; sys.exit(0 if json.load(open('/tmp/bench_hw.json')).get('platform')=='tpu' else 1)" 2>/dev/null; then
+    # Only spend scale-demo time if bench really ran on TPU *and produced a
+    # number*: a deadline-partial emission carries platform=tpu with null
+    # values when the tunnel wedged mid-run — following it with a 2h
+    # scale_demo on the same wedged link wastes the whole retry cycle.
+    # Check the TOP-LEVEL platform key: a substring grep would
+    # false-positive on the embedded tpu_capture that CPU-fallback runs
+    # fold into their JSON.
+    if python -c "import json,sys; d=json.load(open('/tmp/bench_hw.json')); sys.exit(0 if d.get('platform')=='tpu' and d.get('value') is not None else 1)" 2>/dev/null; then
       echo "$(date -u +%H:%M:%S) running scale_demo" >> /tmp/hw_watcher.log
       timeout 7200 python scale_demo.py > /tmp/scale_hw.log 2>&1
       rc=$?
