@@ -419,6 +419,31 @@ def _flash_tp_prefix_shared(mesh, qs, kp, vp, ks, vs, plen, local_on, kw):
     )(qs, kp, vp, ks, vs, plen, flag)
 
 
+def _flash_tp_decode(mesh, q, kp, vp, ks, vs, kg, vg, plen, eos, t, local_on, kw):
+    """flash_decode_attention under tensor parallelism (see
+    ``_flash_tp_causal``): heads are embarrassingly parallel, so the kernel
+    runs per head-shard inside a shard_map; replicated KV inputs reshard to
+    the head split at entry."""
+    from jax.sharding import PartitionSpec as P
+
+    flag = jnp.asarray(True if local_on is None else local_on)
+    hq = P(None, None, "tp", None)  # [S, 1, heads, hd]
+    hp = P(None, "tp", None)  # [Lp, kv_heads, hd]
+    hs = P(None, None, "tp", None)  # [S, L, kv_heads, hd]
+    f = lambda q, kp, vp, ks, vs, kg, vg, plen, eos, t, flag: (
+        pallas_attention.flash_decode_attention(
+            q, kp, vp, ks, vs, kg, vg, plen, eos, t, local_on=flag, **kw
+        )
+    )
+    return jax.shard_map(
+        f,
+        mesh=mesh,
+        in_specs=(hq, hp, hp, hs, hs, hs, hs, P(), P(), P(), P()),
+        out_specs=hq,
+        check_vma=False,
+    )(q, kp, vp, ks, vs, kg, vg, plen, eos, t, flag)
+
+
 def prefix_suffix_layer(
     params: Params,
     cfg: LlamaConfig,
@@ -563,6 +588,7 @@ def decode_step_layer(
     sliding=None,
     rope_on=None,
     use_pallas: bool = False,
+    tp_mesh=None,
 ) -> tuple[jax.Array, Params]:
     """One decoder layer for ONE new token per suffix, against cached KV.
 
@@ -574,7 +600,8 @@ def decode_step_layer(
     ``prefix_len + (suffix_eos[s]+1) + t``. Returns (x_out, kv with slot t
     of kg/vg written). ``use_pallas`` (static) swaps the attention for the
     flash decode kernel when the head shapes are eligible — unlike the XLA
-    op it skips prefix-KV blocks past the real prefix length.
+    op it skips prefix-KV blocks past the real prefix length. Under tensor
+    parallelism (``tp_mesh``) the kernel runs per head-shard via shard_map.
     """
     eps = cfg.rms_norm_eps
     rope_sliding = sliding
@@ -588,26 +615,39 @@ def decode_step_layer(
     kv["vg"] = jax.lax.dynamic_update_slice_in_dim(kv["vg"], v_new, t, axis=1)
 
     window, chunk, sliding = _effective_window(cfg, sliding)
+    tp_size = tp_mesh.shape["tp"] if tp_mesh is not None else 1
     if use_pallas and pallas_attention.supports_decode(
-        cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
+        cfg.num_attention_heads // tp_size,
+        cfg.num_key_value_heads // tp_size,
+        cfg.head_dim,
     ):
-        attn_out = pallas_attention.flash_decode_attention(
-            q,
-            kv["kp"],
-            kv["vp"],
-            kv["ks"],
-            kv["vs"],
-            kv["kg"],
-            kv["vg"],
-            prefix_len,
-            suffix_eos,
-            t,
+        flash_kw = dict(
             scale=cfg.attn_scale,
             window=window,
             softcap=cfg.attn_logit_softcap,
-            local_on=sliding,
             chunk=chunk,
         )
+        if tp_mesh is not None:
+            attn_out = _flash_tp_decode(
+                tp_mesh, q, kv["kp"], kv["vp"], kv["ks"], kv["vs"],
+                kv["kg"], kv["vg"], prefix_len, suffix_eos, t, sliding,
+                flash_kw,
+            )
+        else:
+            attn_out = pallas_attention.flash_decode_attention(
+                q,
+                kv["kp"],
+                kv["vp"],
+                kv["ks"],
+                kv["vs"],
+                kv["kg"],
+                kv["vg"],
+                prefix_len,
+                suffix_eos,
+                t,
+                local_on=sliding,
+                **flash_kw,
+            )
     else:
         attn_out = decode_attention(
             q,

@@ -30,6 +30,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from flexible_llm_sharding_tpu.ops.attention import _local_clause, _softcap
+
 _NEG_INF = -0.7 * float(jnp.finfo(jnp.float32).max)
 _PRECISION = jax.lax.Precision.HIGHEST
 
@@ -39,15 +41,19 @@ def _grouped(q: jax.Array, n_kv: int) -> jax.Array:
     return q.reshape(*lead, lq, n_kv, n_q // n_kv, hd)
 
 
-def _block_update(q, k, v, mask, m, l, acc, scale):
+def _block_update(q, k, v, mask, m, l, acc, scale, softcap=None):
     """Fold one KV block into online-softmax accumulators (GQA einsums).
 
     q [Lq, n_kv, g, hd]; k/v [Lk, n_kv, hd]; mask [Lq, Lk] bool;
-    m/l [n_kv, g, Lq, 1] fp32; acc [n_kv, g, Lq, hd] fp32.
+    m/l [n_kv, g, Lq, 1] fp32; acc [n_kv, g, Lq, hd] fp32. ``softcap`` is
+    Gemma2's logit softcapping, applied to the scaled scores before the
+    mask (HF eager order) — tanh is monotone, so capping per block commutes
+    with the online max/sum.
     """
     s = jnp.einsum("qngh,knh->ngqk", q, k, precision=_PRECISION).astype(
         jnp.float32
     ) * scale
+    s = _softcap(s, softcap)
     s = jnp.where(mask[None, None], s, _NEG_INF)
     m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
     p = jnp.exp(s - m_new)
@@ -59,7 +65,10 @@ def _block_update(q, k, v, mask, m, l, acc, scale):
     return m_new, l, acc
 
 
-def _ring_local(q_blk, k_blk, v_blk, *, axis, causal, scale, window=None):
+def _ring_local(
+    q_blk, k_blk, v_blk, *, axis, causal, scale, window=None, chunk=None,
+    softcap=None,
+):
     """Per-chip body under shard_map: q stays, KV rotates around the ring."""
     n = jax.lax.axis_size(axis)
     idx = jax.lax.axis_index(axis)
@@ -81,10 +90,12 @@ def _ring_local(q_blk, k_blk, v_blk, *, axis, causal, scale, window=None):
         src = (idx - step) % n  # whose KV block we currently hold
         kj = src * lq + jnp.arange(lq)[None, :]
         mask = (kj <= qi) if causal else jnp.ones((lq, lq), bool)
-        if window is not None:
-            # Sliding-window visibility (HF convention: q - k < window).
-            mask = mask & ((qi - kj) < window)
-        m, l, acc = _block_update(qr, k_cur, v_cur, mask, m, l, acc, scale)
+        # Window/chunk visibility via the shared clause (ops.attention) so
+        # the ring and the suffix-side partial-softmax masks can't drift.
+        mask = _local_clause(mask, qi, kj, window, None, chunk)
+        m, l, acc = _block_update(
+            qr, k_cur, v_cur, mask, m, l, acc, scale, softcap
+        )
         if step != n - 1:
             # Rotate KV one hop around the ring (ICI neighbour transfer);
             # XLA overlaps the permute with the next block's compute.
@@ -105,14 +116,18 @@ def ring_self_attention(
     causal: bool = True,
     scale: float | None = None,
     window: int | None = None,
+    chunk: int | None = None,
+    softcap: float | None = None,
 ) -> jax.Array:
     """Sequence-parallel self-attention over the ``axis`` mesh dimension.
 
     q [L, n_q, hd]; k/v [L, n_kv, hd]; L must divide evenly by the axis size.
-    ``window`` ANDs a sliding-window clause into the causal mask (blocks
-    entirely outside the window contribute nothing to the online softmax).
-    Returns [L, n_q, hd], sharded like q. Numerically equal to dense
-    (masked) attention — verified against ops.attention in tests.
+    ``window``/``chunk`` AND a local-attention clause into the causal mask
+    (blocks entirely outside the local region contribute nothing to the
+    online softmax); ``softcap`` is Gemma2's logit softcapping; ``scale``
+    covers query_pre_attn_scalar families. Returns [L, n_q, hd], sharded
+    like q. Numerically equal to dense (masked) attention — verified
+    against ops.attention in tests.
     """
     lq, n_q, hd = q.shape
     n = mesh.shape[axis]
@@ -122,7 +137,8 @@ def ring_self_attention(
         scale = 1.0 / (hd**0.5)
 
     fn = functools.partial(
-        _ring_local, axis=axis, causal=causal, scale=scale, window=window
+        _ring_local, axis=axis, causal=causal, scale=scale, window=window,
+        chunk=chunk, softcap=softcap,
     )
     spec = P(axis, None, None)
     shard_fn = jax.shard_map(
@@ -139,6 +155,7 @@ def ring_decoder_layer(
     axis: str = "sp",
     return_kv: bool = False,
     sliding: bool = False,
+    rope_on: bool = True,
 ) -> jax.Array:
     """A full decoder layer with sequence-parallel (ring) attention.
 
@@ -146,30 +163,37 @@ def ring_decoder_layer(
     block offset is folded in under shard_map). Elementwise/matmul parts
     run purely locally on each chip's sequence block.
 
-    ``sliding=True`` applies the model's ``cfg.sliding_window`` to the ring
-    attention (Mistral-style local layers; the reference truncates long
-    prompts instead, ``/root/reference/utils.py:250,254``).
+    The full model-family surface rides the model library's own helpers —
+    ``position_qk`` (per-layer rope bases, NoPE + temperature tuning,
+    interleaved rope, post-rope L2 norms), ``_residual_attn`` /
+    ``_residual_mlp`` (Gemma2 sandwich layouts, MoE feed-forwards), plus
+    softcap / custom scale / window / chunk in the ring mask — so any layer
+    the streaming executor can run, the sp mesh can run too. ``sliding`` and
+    ``rope_on`` are this layer's STATIC per-layer flags (the scorer unstacks
+    scan runs, so at most four traces: local/global x rope/NoPE). The
+    reference truncates long prompts instead
+    (``/root/reference/utils.py:250,254``).
 
-    ``return_kv=True`` additionally returns this layer's post-RoPE (k, v)
+    ``return_kv=True`` additionally returns this layer's post-rope (k, v)
     [L, n_kv, hd], still sharded over ``axis`` — the long-context scorer
     feeds them to the suffix side's sharded-prefix attention
     (runtime/longcontext.py).
     """
     from flexible_llm_sharding_tpu.models import llama
-    from flexible_llm_sharding_tpu.ops import apply_rope, rms_norm, rope_cos_sin
+    from flexible_llm_sharding_tpu.ops import rms_norm
 
     eps = cfg.rms_norm_eps
     spec = P(axis, None)
+    window = cfg.sliding_window if sliding else None
+    chunk = cfg.attention_chunk_size if sliding else None
 
     def local(x_blk):
-        n = jax.lax.axis_size(axis)
         idx = jax.lax.axis_index(axis)
         lq = x_blk.shape[0]
         h = rms_norm(x_blk, params["input_layernorm"]["scale"], eps, cfg.norm_unit_offset)
         q, k, v = llama._qkv(params["attn"], cfg, h)
         pos = idx * lq + jnp.arange(lq)
-        cos, sin = rope_cos_sin(pos, cfg.head_dim, cfg.rope_theta, cfg.rope_scaling_spec)
-        q, k = apply_rope(q, cos, sin), apply_rope(k, cos, sin)
+        q, k = llama.position_qk(cfg, q, k, pos, sliding, rope_on)
         return x_blk, q, k, v
 
     qkv_specs = (spec, P(axis, None, None), P(axis, None, None), P(axis, None, None))
@@ -177,14 +201,13 @@ def ring_decoder_layer(
         local, mesh=mesh, in_specs=(spec,), out_specs=qkv_specs
     )(x)
     attn = ring_self_attention(
-        q, k, v, mesh, axis=axis, causal=True,
-        window=cfg.sliding_window if sliding else None,
+        q, k, v, mesh, axis=axis, causal=True, scale=cfg.attn_scale,
+        window=window, chunk=chunk, softcap=cfg.attn_logit_softcap,
     )
 
     def local_tail(x_blk, attn_blk):
-        mid = x_blk + llama._out_proj(params["attn"], attn_blk)
-        h = rms_norm(mid, params["post_attention_layernorm"]["scale"], eps, cfg.norm_unit_offset)
-        return mid + llama._mlp(params["mlp"], h, cfg)
+        mid = llama._residual_attn(params, cfg, x_blk, attn_blk)
+        return llama._residual_mlp(params, cfg, mid)
 
     out = jax.shard_map(
         local_tail,

@@ -57,8 +57,10 @@ Params = dict[str, Any]
 # Jitted blocks (module-level: shared jit cache)
 # ---------------------------------------------------------------------------
 
-@partial(jax.jit, static_argnums=(0, 1), donate_argnums=(3, 4))
-def _prefill_decoders(cfg: LlamaConfig, use_pallas, seg, prefix_h, suffix_h, prefix_len):
+@partial(jax.jit, static_argnums=(0, 1, 2), donate_argnums=(4, 5))
+def _prefill_decoders(
+    cfg: LlamaConfig, use_pallas, tp_mesh, seg, prefix_h, suffix_h, prefix_len
+):
     """Scan k layers over a block, emitting per-layer KV as scan outputs.
 
     seg: {"layers": [k, ...] pytree, "sliding": bool [k] or None,
@@ -77,6 +79,7 @@ def _prefill_decoders(cfg: LlamaConfig, use_pallas, seg, prefix_h, suffix_h, pre
                 return_kv=True,
                 sliding=sliding,
                 rope_on=rope_on,
+                tp_mesh=tp_mesh,
             ),
             in_axes=(None, None, 0, 0, 0),
         )
@@ -89,9 +92,9 @@ def _prefill_decoders(cfg: LlamaConfig, use_pallas, seg, prefix_h, suffix_h, pre
     return prefix_h, suffix_h, kv
 
 
-@partial(jax.jit, static_argnums=(0, 1), donate_argnums=(3, 4))
+@partial(jax.jit, static_argnums=(0, 1, 2), donate_argnums=(4, 5))
 def _decode_decoders(
-    cfg: LlamaConfig, use_pallas, seg, kv, x, prefix_len, suffix_eos, t
+    cfg: LlamaConfig, use_pallas, tp_mesh, seg, kv, x, prefix_len, suffix_eos, t
 ):
     """Scan k layers' single-token decode over a block.
 
@@ -111,6 +114,7 @@ def _decode_decoders(
                 sliding=sliding,
                 rope_on=rope_on,
                 use_pallas=use_pallas,
+                tp_mesh=tp_mesh,
             ),
             in_axes=(None, None, 0, 0, 0, 0, None),
         )
@@ -227,10 +231,13 @@ class DecodeGenerator:
                 plan_shards_dp(len(self.layer_names), cfg.layer_num_per_shard).shards
             )
             self.shard_devices = [device] * len(self.shards)
-        # Pallas kernels can't be auto-partitioned by GSPMD (same guard as
-        # StreamingExecutor): a tp-sharded decode forces the XLA attention.
-        self._use_pallas = cfg.pallas_enabled() and not hasattr(
-            self.device, "segment_target"
+        # Pallas kernels can't be auto-partitioned by GSPMD, so under
+        # TpPlacement the flash calls run inside a shard_map over the heads
+        # axis (llama._flash_tp_*); the placement's mesh rides into the
+        # jitted blocks as a static arg (same design as StreamingExecutor).
+        self._use_pallas = cfg.pallas_enabled()
+        self._tp_mesh = (
+            self.device.mesh if hasattr(self.device, "segment_target") else None
         )
         self.stats: dict[str, float] = {}
 
@@ -297,7 +304,8 @@ class DecodeGenerator:
                             )
                         elif kind == "decoders":
                             ph, sh, kv = _prefill_decoders(
-                                self.model_cfg, self._use_pallas, params, ph, sh, prefix_len
+                                self.model_cfg, self._use_pallas,
+                                self._tp_mesh, params, ph, sh, prefix_len,
                             )
                             # Pre-extend with empty generated-token slots so
                             # decode scans can donate in place.
@@ -364,8 +372,9 @@ class DecodeGenerator:
                             elif kind == "decoders":
                                 kv = kv_store.get(("kv", shard_pos, di, b), act_dev)
                                 x, kv = _decode_decoders(
-                                    self.model_cfg, self._use_pallas, params,
-                                    kv, x, prefix_len, suffix_eos, jnp.int32(t),
+                                    self.model_cfg, self._use_pallas,
+                                    self._tp_mesh, params, kv, x, prefix_len,
+                                    suffix_eos, jnp.int32(t),
                                 )
                                 kv_store.put(("kv", shard_pos, di, b), kv)
                                 di += 1

@@ -33,8 +33,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from flexible_llm_sharding_tpu.config import FrameworkConfig, LlamaConfig
 from flexible_llm_sharding_tpu.models import llama
-from flexible_llm_sharding_tpu.ops import apply_rope, rms_norm, rope_cos_sin
-from flexible_llm_sharding_tpu.ops.attention import causal_mask
+from flexible_llm_sharding_tpu.ops import rms_norm
+from flexible_llm_sharding_tpu.ops.attention import _local_clause, _softcap
 from flexible_llm_sharding_tpu.ops.ring_attention import ring_decoder_layer
 from flexible_llm_sharding_tpu.parallel.planner import plan_shards_dp
 from flexible_llm_sharding_tpu.parallel.sharding import make_mesh
@@ -52,16 +52,21 @@ _NEG_INF = -0.7 * float(jnp.finfo(jnp.float32).max)
 _PRECISION = jax.lax.Precision.HIGHEST
 
 
-def _partials(qr, k, v, mask, scale):
+def _partials(qr, k, v, mask, scale, softcap=None):
     """Flash accumulators of ``qr`` against one KV block.
 
     qr [S, Ls, n_kv, g, hd]; k/v [S?, Lk, n_kv, hd] or [Lk, n_kv, hd]
     (shared); mask broadcastable to [S, Ls, Lk]. Returns m, l
-    [S, n_kv, g, Ls, 1] and acc [S, n_kv, g, Ls, hd], all fp32.
+    [S, n_kv, g, Ls, 1] and acc [S, n_kv, g, Ls, hd], all fp32. ``softcap``
+    (Gemma2) caps the scaled scores before the mask; tanh is monotone, so
+    per-block capping commutes with the cross-block log-sum-exp merge.
     """
     shared = k.ndim == 3
     eq = "sqngh,knh->sngqk" if shared else "sqngh,sknh->sngqk"
-    s = jnp.einsum(eq, qr, k, precision=_PRECISION).astype(jnp.float32) * scale
+    s = _softcap(
+        jnp.einsum(eq, qr, k, precision=_PRECISION).astype(jnp.float32) * scale,
+        softcap,
+    )
     s = jnp.where(mask[:, None, None], s, _NEG_INF)
     m = jnp.max(s, axis=-1, keepdims=True)
     p = jnp.exp(s - m)
@@ -82,6 +87,7 @@ def sharded_prefix_suffix_layer(
     suffix_h: jax.Array,
     prefix_len: jax.Array,
     sliding: bool = False,
+    rope_on: bool = True,
 ):
     """One decoder layer of the long-context scoring step.
 
@@ -89,29 +95,30 @@ def sharded_prefix_suffix_layer(
     suffix_h [S, Ls, D] replicated; prefix_len int32 scalar (true length).
     Semantics match :func:`llama.prefix_suffix_layer` exactly — the suffix
     side sees one joint softmax over all real prefix keys plus its own
-    causal keys at rotary positions ``prefix_len + i``. ``sliding=True``
-    applies ``cfg.sliding_window`` to both the ring prefix attention and the
-    suffix side's visibility (the window clause of ops.attention's dense op,
-    here folded into the sharded partial-softmax masks).
+    causal keys at positions ``prefix_len + i``. The full family surface
+    comes from the model library's own helpers (``position_qk``,
+    ``_residual_attn``/``_residual_mlp``) plus scale/softcap/window/chunk in
+    the partial-softmax masks; ``sliding``/``rope_on`` are this layer's
+    STATIC flags.
     """
     s_cnt, ls, _ = suffix_h.shape
     eps = cfg.rms_norm_eps
-    scale = 1.0 / (cfg.head_dim**0.5)
+    scale = cfg.attn_scale
+    softcap = cfg.attn_logit_softcap
     window = cfg.sliding_window if sliding else None
+    chunk = cfg.attention_chunk_size if sliding else None
 
-    # --- prefix: ring attention layer, keeping its post-RoPE KV ---
+    # --- prefix: ring attention layer, keeping its post-rope KV ---
     prefix_out, k_all, v_all = ring_decoder_layer(
-        params, cfg, prefix_x, mesh, axis=axis, return_kv=True, sliding=sliding
+        params, cfg, prefix_x, mesh, axis=axis, return_kv=True,
+        sliding=sliding, rope_on=rope_on,
     )
 
     # --- suffix q/k/v at global positions prefix_len + i ---
     hs = rms_norm(suffix_h, params["input_layernorm"]["scale"], eps, cfg.norm_unit_offset)
     qs, ks, vs = llama._qkv(params["attn"], cfg, hs)
     pos_s = prefix_len + jnp.arange(ls)
-    cos_s, sin_s = rope_cos_sin(
-        pos_s, cfg.head_dim, cfg.rope_theta, cfg.rope_scaling_spec
-    )
-    qs, ks = apply_rope(qs, cos_s, sin_s), apply_rope(ks, cos_s, sin_s)
+    qs, ks = llama.position_qk(cfg, qs, ks, pos_s, sliding, rope_on)
 
     n_kv = cfg.num_key_value_heads
     g = cfg.num_attention_heads // n_kv
@@ -120,17 +127,16 @@ def sharded_prefix_suffix_layer(
     # --- per-chip partial softmax over the local prefix-KV block, merged
     # with a log-sum-exp pmax/psum across the ring ---
     def local_partials(qr, k_blk, v_blk, plen):
-        n = jax.lax.axis_size(axis)
         idx = jax.lax.axis_index(axis)
         lblk = k_blk.shape[0]
         kj = idx * lblk + jnp.arange(lblk)[None, None, :]  # global key pos
         vis = kj < plen
-        if window is not None:
+        if window is not None or chunk is not None:
             # Suffix query i sits at global position plen + i.
             qi = plen + jnp.arange(ls)[None, :, None]
-            vis = vis & ((qi - kj) < window)
+            vis = _local_clause(vis, qi, kj, window, None, chunk)
         mask = jnp.broadcast_to(vis, (s_cnt, ls, lblk))
-        m, l, acc = _partials(qr, k_blk, v_blk, mask, scale)
+        m, l, acc = _partials(qr, k_blk, v_blk, mask, scale, softcap)
         m_g = jax.lax.pmax(m, axis)
         corr = jnp.exp(m - m_g)
         return m_g, jax.lax.psum(l * corr, axis), jax.lax.psum(acc * corr, axis)
@@ -145,11 +151,17 @@ def sharded_prefix_suffix_layer(
         check_vma=False,
     )(qr, k_all, v_all, prefix_len)
 
-    # --- own suffix block: causal within the suffix (window clause on the
-    # relative offsets — both sides carry the same plen shift) ---
-    m_s, l_s, acc_s = _partials(
-        qr, ks, vs, causal_mask(ls, ls, window=window)[None], scale
-    )
+    # --- own suffix block: causal within the suffix; local clauses need the
+    # absolute positions (the window's relative offsets cancel the plen
+    # shift, the chunk boundaries do not) ---
+    qi = jnp.arange(ls)[:, None]
+    kj = jnp.arange(ls)[None, :]
+    suffix_mask = kj <= qi
+    if window is not None or chunk is not None:
+        suffix_mask = _local_clause(
+            suffix_mask, prefix_len + qi, prefix_len + kj, window, None, chunk
+        )
+    m_s, l_s, acc_s = _partials(qr, ks, vs, suffix_mask[None], scale, softcap)
 
     # --- merge the two accumulator sets (one joint softmax) ---
     m = jnp.maximum(m_p, m_s)
@@ -163,9 +175,8 @@ def sharded_prefix_suffix_layer(
         .astype(suffix_h.dtype)
     )
 
-    suffix_mid = suffix_h + llama._out_proj(params["attn"], attn_s)
-    hs = rms_norm(suffix_mid, params["post_attention_layernorm"]["scale"], eps, cfg.norm_unit_offset)
-    suffix_out = suffix_mid + llama._mlp(params["mlp"], hs, cfg)
+    suffix_mid = llama._residual_attn(params, cfg, suffix_h, attn_s)
+    suffix_out = llama._residual_mlp(params, cfg, suffix_mid)
     return prefix_out, suffix_out
 
 
@@ -182,29 +193,6 @@ class LongContextScorer:
     def __init__(self, cfg: FrameworkConfig, devices=None, tokenizer=None):
         self.cfg = cfg
         self.model_cfg = LlamaConfig.from_pretrained(cfg.model_path)
-        mc = self.model_cfg
-        if (
-            mc.attention_chunk_size is not None
-            or mc.layer_rope is not None
-            or mc.rope_interleaved
-            or mc.qk_l2_norm
-            or mc.ffw_sandwich_norms
-            or mc.attn_logit_softcap is not None
-            or mc.query_pre_attn_scalar is not None
-            or (mc.sliding_window is not None and mc.rope_local_theta is not None)
-        ):
-            # This scorer's sharded attention implements causal (optionally
-            # sliding-window) masks with the default scale and no softcap,
-            # and its layer tail uses the standard residual layout —
-            # accepting a config outside that envelope would return silently
-            # wrong scores. (Sliding windows ARE supported — Mistral/Qwen2
-            # uniform or per-layer — but not gemma3's per-window rope base.)
-            raise NotImplementedError(
-                "long_context ring attention supports causal or "
-                "sliding-window, default-scale, un-softcapped models; "
-                "chunked/llama4 and gemma2/3-style configs are not supported "
-                "on this path"
-            )
         devices = list(devices) if devices else None
         self.mesh = make_mesh(
             {"sp": len(devices)} if devices else None, devices=devices
@@ -228,11 +216,15 @@ class LongContextScorer:
         self._rep = NamedSharding(self.mesh, P())
         self._seq = NamedSharding(self.mesh, P("sp"))
         self._layer_fn = jax.jit(
-            lambda params, px, sh, plen, sliding: sharded_prefix_suffix_layer(
-                params, self.model_cfg, self.mesh, "sp", px, sh, plen,
-                sliding=sliding,
+            lambda params, px, sh, plen, sliding, rope_on: (
+                sharded_prefix_suffix_layer(
+                    params, self.model_cfg, self.mesh, "sp", px, sh, plen,
+                    sliding=sliding, rope_on=rope_on,
+                )
             ),
-            static_argnums=4,  # two traces at most: local and global layers
+            # Static per-layer flags: at most four traces (local/global ×
+            # rope/NoPE).
+            static_argnums=(4, 5),
         )
         self.stats: dict[str, float] = {}
 
@@ -287,13 +279,18 @@ class LongContextScorer:
                 elif kind == "decoders":
                     # Unstack the [k, ...] scan pytree: each layer runs
                     # as one jitted sharded step (shard_map inside). The
-                    # wrapper's sliding flags (per-layer local/global mix,
-                    # e.g. Qwen2 max_window_layers) pick the traced variant;
+                    # wrapper's sliding/rope flags (per-layer local/global
+                    # mixes, llama4 NoPE layers) pick the traced variant;
                     # None flags mean uniform — every layer slides iff the
-                    # config carries a window.
+                    # config carries a local form, and rope is on.
                     stacked = params["layers"]
                     flags = params.get("sliding")
-                    uniform = self.model_cfg.sliding_window is not None
+                    rflags = params.get("rope")
+                    mc = self.model_cfg
+                    uniform = (
+                        mc.sliding_window is not None
+                        or mc.attention_chunk_size is not None
+                    )
                     k_layers = jax.tree.leaves(stacked)[0].shape[0]
                     for i in range(k_layers):
                         layer = jax.tree.map(lambda a: a[i], stacked)
@@ -302,8 +299,14 @@ class LongContextScorer:
                             if flags is not None
                             else uniform
                         )
+                        rope_on = (
+                            bool(np.asarray(rflags)[i])
+                            if rflags is not None
+                            else True
+                        )
                         prefix_x, suffix_h = self._layer_fn(
-                            layer, prefix_x, suffix_h, prefix_len, sliding
+                            layer, prefix_x, suffix_h, prefix_len, sliding,
+                            rope_on,
                         )
                 elif kind == "norm":
                     suffix_h = llama.select_eos_and_norm(

@@ -86,11 +86,12 @@ def _tail_loss_vjp(cfg: LlamaConfig, norm_p, head_p, x, targets, pad_id):
 
     def f(norm_p, head_p, x):
         h = rms_norm(x, norm_p["scale"], cfg.rms_norm_eps, cfg.norm_unit_offset)
-        logits = llama._mm(h, head_p["kernel"]).astype(jnp.float32)
-        if cfg.final_logit_softcap is not None:
-            logits = (
-                jnp.tanh(logits / cfg.final_logit_softcap) * cfg.final_logit_softcap
-            )
+        from flexible_llm_sharding_tpu.ops.attention import _softcap
+
+        logits = _softcap(
+            llama._mm(h, head_p["kernel"]).astype(jnp.float32),
+            cfg.final_logit_softcap,
+        )
         return token_cross_entropy(logits, targets, pad_id)
 
     loss, vjp = jax.vjp(f, norm_p, head_p, x)

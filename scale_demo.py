@@ -279,22 +279,26 @@ def main() -> None:
     # Subprocess: the parent must not initialise the accelerator backend
     # (the CLI children own it); the probe itself is the shared helper so
     # BENCH and SCALE artifacts report comparable numbers.
-    probe = subprocess.run(
-        [sys.executable, "-c",
-         "import jax;"
-         "from flexible_llm_sharding_tpu.utils.metrics import"
-         " measure_host_to_hbm_gbps;"
-         "d=jax.devices()[0];"
-         "print(measure_host_to_hbm_gbps(d));"
-         "print(getattr(d,'device_kind',d.platform))"],
-        capture_output=True, text=True, cwd=ROOT,
-    )
     try:
+        # Hard timeout: a wedged tunnel otherwise hangs the probe child
+        # forever and the demo never reaches the actual runs.
+        probe = subprocess.run(
+            [sys.executable, "-c",
+             "import jax;"
+             "from flexible_llm_sharding_tpu.utils.metrics import"
+             " measure_host_to_hbm_gbps;"
+             "d=jax.devices()[0];"
+             "print(measure_host_to_hbm_gbps(d));"
+             "print(getattr(d,'device_kind',d.platform))"],
+            capture_output=True, text=True, cwd=ROOT, timeout=300,
+        )
         lines = probe.stdout.strip().splitlines()
         result["host_to_hbm_gbps"] = round(float(lines[-2]), 3)
         result["device_kind"] = lines[-1]
         log(f"host->HBM link: {result['host_to_hbm_gbps']} GB/s "
             f"({result['device_kind']})")
+    except subprocess.TimeoutExpired:
+        log("bandwidth probe timed out (wedged tunnel?) — continuing")
     except (ValueError, IndexError):
         log("bandwidth probe failed: " + probe.stderr[-200:])
 

@@ -114,6 +114,99 @@ def test_long_prefix_sliding_window(tiny_cfg, tmp_path_factory, layer_sliding):
         np.testing.assert_allclose(g, w, rtol=2e-4, atol=1e-5)
 
 
+GEMMA2ISH = dict(
+    model_type="gemma2",
+    vocab_size=256,
+    hidden_size=64,
+    intermediate_size=128,
+    num_hidden_layers=4,
+    num_attention_heads=4,
+    num_key_value_heads=2,
+    explicit_head_dim=16,
+    max_position_embeddings=512,
+    tie_word_embeddings=False,
+    hidden_act="gelu_pytorch_tanh",
+    norm_unit_offset=True,
+    embed_scale=True,
+    ffw_sandwich_norms=True,
+    attn_logit_softcap=50.0,
+    final_logit_softcap=30.0,
+    query_pre_attn_scalar=24,
+    sliding_window=48,
+    layer_sliding=(True, False, True, False),
+)
+GEMMA3ISH = dict(
+    GEMMA2ISH,
+    model_type="gemma3_text",
+    qk_norm=True,
+    attn_logit_softcap=None,
+    final_logit_softcap=None,
+    rope_theta=1_000_000.0,
+    rope_local_theta=10_000.0,
+)
+LLAMA4ISH = dict(
+    model_type="llama4_text",
+    vocab_size=288,
+    hidden_size=64,
+    intermediate_size=32,
+    intermediate_size_mlp=48,
+    num_hidden_layers=3,
+    num_attention_heads=4,
+    num_key_value_heads=2,
+    explicit_head_dim=16,
+    max_position_embeddings=512,
+    num_local_experts=2,
+    num_experts_per_tok=1,
+    moe_layer_pattern=(False, True, True),
+    layer_sliding=(True, True, False),
+    attention_chunk_size=32,
+    layer_rope=(True, True, False),
+    rope_interleaved=True,
+    qk_l2_norm=True,
+    attn_temperature_tuning=True,
+    attn_floor_scale=4.0,
+    attn_scale_coef=0.1,
+    tie_word_embeddings=False,
+)
+
+
+@pytest.mark.parametrize("family", ["gemma2", "gemma3", "llama4"])
+def test_long_prefix_full_family_surface(tmp_path_factory, family):
+    """The long-context path covers the ENTIRE family surface by riding the
+    model library's own helpers (position_qk, residual layouts) — gemma2
+    (softcaps, sandwich norms, query_pre_attn_scalar, alternating windows),
+    gemma3 (per-window rope bases, q/k norms), llama4 (chunked attention
+    crossing chip boundaries, NoPE + temperature-tuned queries, interleaved
+    rope, mixed dense / shared+routed MoE stacks). Exact scores vs the
+    untruncated single-device oracle."""
+    from flexible_llm_sharding_tpu.config import LlamaConfig
+
+    cfg = LlamaConfig(**{"gemma2": GEMMA2ISH, "gemma3": GEMMA3ISH,
+                         "llama4": LLAMA4ISH}[family])
+    init = (
+        llama.init_mixed_params if cfg.moe_layer_pattern else llama.init_params
+    )
+    params = init(jax.random.PRNGKey(5), cfg)
+    d = tmp_path_factory.mktemp(f"longctx_{family}")
+    save_params(jax.tree.map(np.asarray, params), str(d), cfg)
+
+    want = run_prompts(
+        _cfg(str(d), max_token_len=512),
+        PROMPTS,
+        tokenizer=FakeTokenizer(),
+        devices=jax.devices()[:1],
+    )
+    got = run_prompts(
+        _cfg(str(d), max_token_len=64, long_context=True),
+        PROMPTS,
+        tokenizer=FakeTokenizer(),
+        devices=jax.devices()[:4],
+    )
+    for g, w in zip(got, want):
+        assert g.shape == w.shape
+        np.testing.assert_allclose(g, w, rtol=3e-4, atol=2e-5)
+
+
 def test_long_context_cli(model_dir, tmp_path):
     from flexible_llm_sharding_tpu.cli import main
 

@@ -236,6 +236,51 @@ def test_tp_qwen3_moe_dense_interleave(tmp_path_factory):
     _tp_vs_single(d, layer_num_per_shard=2)
 
 
+def test_tp_pallas_flash_decode(tmp_path_factory):
+    """KV-cache decode with the flash decode kernel under tensor
+    parallelism: the kernel runs per head-shard inside a shard_map
+    (llama._flash_tp_decode). Greedy per-step distributions must match the
+    single-device XLA decode."""
+    from flexible_llm_sharding_tpu.config import LlamaConfig
+    from flexible_llm_sharding_tpu.runtime.orchestration import run_decode
+
+    cfg = LlamaConfig(
+        vocab_size=256,
+        hidden_size=256,
+        intermediate_size=384,
+        num_hidden_layers=2,
+        num_attention_heads=2,
+        num_key_value_heads=2,
+        max_position_embeddings=512,
+    )
+    params = llama.init_params(jax.random.PRNGKey(9), cfg)
+    d = tmp_path_factory.mktemp("pallas_tp_decode")
+    save_params(jax.tree.map(np.asarray, params), str(d), cfg)
+
+    def run(**kw):
+        c = FrameworkConfig(
+            model_path=str(d),
+            dtype="float32",
+            bucket_multiple=64,
+            block_size=2,
+            prefetch_depth=0,
+            num_gen_token=2,
+            **kw,
+        )
+        n = kw.get("tensor_parallel", 1)
+        scores, _, _ = run_decode(
+            c, PROMPTS[:2], tokenizer=FakeTokenizer(),
+            devices=jax.devices()[:n],
+        )
+        return scores
+
+    want = run(use_pallas=False)
+    got = run(use_pallas=True, tensor_parallel=2)
+    for a, b in zip(want, got):
+        np.testing.assert_allclose(b, a, rtol=2e-5, atol=2e-6)
+        assert (np.argmax(a, -1) == np.argmax(b, -1)).all()
+
+
 def test_tp_placement_specs():
     """Column/row layout sanity: wq sharded on out, wo on in, head on vocab."""
     pl = TpPlacement(jax.devices()[:2])
