@@ -82,12 +82,11 @@ def _tail_loss_vjp(cfg: LlamaConfig, norm_p, head_p, x, targets, pad_id):
     semantics, incl. final softcap and pad masking). Returns
     (loss, d_norm, d_head, d_x)."""
 
+    from flexible_llm_sharding_tpu.ops.attention import _softcap
     from flexible_llm_sharding_tpu.training import token_cross_entropy
 
     def f(norm_p, head_p, x):
         h = rms_norm(x, norm_p["scale"], cfg.rms_norm_eps, cfg.norm_unit_offset)
-        from flexible_llm_sharding_tpu.ops.attention import _softcap
-
         logits = _softcap(
             llama._mm(h, head_p["kernel"]).astype(jnp.float32),
             cfg.final_logit_softcap,

@@ -207,6 +207,29 @@ def test_long_prefix_full_family_surface(tmp_path_factory, family):
         np.testing.assert_allclose(g, w, rtol=3e-4, atol=2e-5)
 
 
+def test_long_context_int8_stream(model_dir, tmp_path):
+    """int8 weight streaming composes with the sp mesh: the replicated
+    device_put carries int8 payloads + scales, the on-device dequant runs
+    replicated, and scores stay close to the fp32 long-context run."""
+    from flexible_llm_sharding_tpu.utils.checkpoint import requantize_native
+
+    q8 = tmp_path / "q8"
+    requantize_native(model_dir, str(q8))
+
+    kw = dict(max_token_len=64, long_context=True)
+    want = run_prompts(
+        _cfg(model_dir, **kw), PROMPTS[:1],
+        tokenizer=FakeTokenizer(), devices=jax.devices()[:4],
+    )
+    got = run_prompts(
+        _cfg(str(q8), **kw), PROMPTS[:1],
+        tokenizer=FakeTokenizer(), devices=jax.devices()[:4],
+    )
+    assert got[0].shape == want[0].shape
+    assert np.isfinite(got[0]).all()
+    assert float(np.abs(got[0] - want[0]).max()) < 0.05  # int8 quality bar
+
+
 def test_long_context_cli(model_dir, tmp_path):
     from flexible_llm_sharding_tpu.cli import main
 

@@ -90,6 +90,26 @@ def test_streamed_windowed_family(tiny_cfg, rng):
     _assert_params_close(tr.params, want_params)
 
 
+def test_streamed_moe_family(rng):
+    """MoE layers stream-train too: expert/router grads flow through the
+    compute-all einsum layout under vjp, matching the monolithic step."""
+    from tests.test_model_families import MIXTRAL_CFG
+
+    params = jax.tree.map(
+        np.asarray, llama.init_params(jax.random.PRNGKey(5), MIXTRAL_CFG)
+    )
+    tokens = rng.integers(1, MIXTRAL_CFG.vocab_size, size=(2, 11)).astype(np.int32)
+
+    want_loss, want_params = _monolithic_step(MIXTRAL_CFG, params, tokens)
+    tr = StreamedTrainer(
+        MIXTRAL_CFG, params, lr=LR, grad_clip=CLIP, weight_decay=WD
+    )
+    got_loss = tr.step(tokens)
+
+    np.testing.assert_allclose(got_loss, want_loss, rtol=1e-6)
+    _assert_params_close(tr.params, want_params)
+
+
 def test_streamed_from_checkpoint_roundtrip(tiny_cfg, rng, tmp_path):
     """from_pretrained streams layers off a native checkpoint; save() writes
     one back that scores identically to the in-memory params."""
