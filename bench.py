@@ -347,6 +347,22 @@ def bench_decode(cfg_obj, prompts, tok, result: dict, n_tok: int = 4) -> None:
     if not agree:
         result["decode_argmax_mismatch"] = True
 
+    import jax
+
+    if jax.default_backend() == "tpu":
+        # Flash decode kernel vs the XLA decode op (the production path is
+        # auto = flash on TPU, so the measured `gen` above already used it;
+        # this isolates the kernel's own contribution).
+        gen_xla = DecodeGenerator(
+            dataclasses.replace(cfg_obj, use_pallas=False), tokenizer=tok
+        )
+        gen_xla(prompts)  # warm/compile
+        t0 = time.perf_counter()
+        gen_xla(prompts)
+        t_xla_dec = time.perf_counter() - t0
+        log(f"decode attention: xla={t_xla_dec:.2f}s flash={t_kv:.2f}s")
+        result["pallas_decode_speedup"] = round(t_xla_dec / t_kv, 3)
+
 
 def run_bench(result: dict) -> None:
     jax, devs = _init_jax()

@@ -219,11 +219,8 @@ def main(argv: list[str] | None = None, tokenizer=None) -> None:
         if args.kv_cache:
             if args.temperature > 0:
                 raise SystemExit("--kv_cache supports greedy decoding only")
-            if cfg.long_context:
-                raise SystemExit(
-                    "--long_context is not supported with --kv_cache yet; "
-                    "use the default generation loop for over-length prefixes"
-                )
+            # --long_context composes: run_decode routes over-length
+            # prefixes to the sp-mesh LongContextDecoder.
             from flexible_llm_sharding_tpu.runtime.orchestration import run_decode
 
             # Multi-chip: --data_parallel true splits prompts across chips;

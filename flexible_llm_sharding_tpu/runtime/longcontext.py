@@ -88,6 +88,7 @@ def sharded_prefix_suffix_layer(
     prefix_len: jax.Array,
     sliding: bool = False,
     rope_on: bool = True,
+    return_kv: bool = False,
 ):
     """One decoder layer of the long-context scoring step.
 
@@ -177,7 +178,113 @@ def sharded_prefix_suffix_layer(
 
     suffix_mid = llama._residual_attn(params, cfg, suffix_h, attn_s)
     suffix_out = llama._residual_mlp(params, cfg, suffix_mid)
+    if return_kv:
+        # Post-rope KV for the long-context KV-decode path: prefix KV stays
+        # SHARDED over the sp mesh, suffix KV replicated.
+        return prefix_out, suffix_out, {"kp": k_all, "vp": v_all, "ks": ks, "vs": vs}
     return prefix_out, suffix_out
+
+
+def sharded_decode_layer(
+    params: Params,
+    cfg: LlamaConfig,
+    mesh: Mesh,
+    axis: str,
+    x: jax.Array,
+    kv: Params,
+    prefix_len: jax.Array,
+    suffix_eos: jax.Array,
+    t: jax.Array,
+    sliding: bool = False,
+    rope_on: bool = True,
+):
+    """One decoder layer for ONE new token per suffix against cached KV
+    whose PREFIX region is sharded over the sp mesh.
+
+    The sequence-parallel analogue of :func:`llama.decode_step_layer`
+    (semantics identical — one joint softmax over prefix/suffix/generated
+    keys): each chip folds its own prefix-KV block into flash accumulators
+    for the replicated single-token queries, the partials merge with a
+    log-sum-exp pmax/psum, and the replicated suffix + generated regions
+    fold in locally. x [S, 1, D] replicated; kv: {'kp','vp' [Lp, n_kv, hd]
+    sp-sharded, 'ks','vs' [S, Ls, n_kv, hd], 'kg','vg' [S, T, n_kv, hd]
+    replicated}; prefix_len/t int32 scalars; suffix_eos int32 [S].
+    Returns (x_out, kv with slot t of kg/vg written).
+    """
+    s_cnt = x.shape[0]
+    eps = cfg.rms_norm_eps
+    scale = cfg.attn_scale
+    softcap = cfg.attn_logit_softcap
+    window = cfg.sliding_window if sliding else None
+    chunk = cfg.attention_chunk_size if sliding else None
+
+    h = rms_norm(x, params["input_layernorm"]["scale"], eps, cfg.norm_unit_offset)
+    q, k_new, v_new = llama._qkv(params["attn"], cfg, h)  # [S, 1, n, hd]
+    pos = (prefix_len + suffix_eos + 1 + t)[:, None]  # [S, 1]
+    q, k_new = llama.position_qk(cfg, q, k_new, pos, sliding, rope_on)
+
+    kv = dict(kv)
+    kv["kg"] = jax.lax.dynamic_update_slice_in_dim(kv["kg"], k_new, t, axis=1)
+    kv["vg"] = jax.lax.dynamic_update_slice_in_dim(kv["vg"], v_new, t, axis=1)
+
+    n_kv = cfg.num_key_value_heads
+    g = cfg.num_attention_heads // n_kv
+    qr = q.reshape(s_cnt, 1, n_kv, g, cfg.head_dim)
+    q_abs = (prefix_len + suffix_eos + 1 + t)[:, None, None]  # [S, 1, 1]
+
+    # --- sharded prefix region: per-chip partials, log-sum-exp merge ---
+    def local_partials(qr, k_blk, v_blk, plen, q_abs):
+        idx = jax.lax.axis_index(axis)
+        lblk = k_blk.shape[0]
+        kj = idx * lblk + jnp.arange(lblk)[None, None, :]  # global key pos
+        vis = jnp.broadcast_to(kj < plen, (s_cnt, 1, lblk))
+        if window is not None or chunk is not None:
+            vis = _local_clause(vis, q_abs, kj, window, None, chunk)
+        m, l, acc = _partials(qr, k_blk, v_blk, vis, scale, softcap)
+        m_g = jax.lax.pmax(m, axis)
+        corr = jnp.exp(m - m_g)
+        return m_g, jax.lax.psum(l * corr, axis), jax.lax.psum(acc * corr, axis)
+
+    rep = P()
+    blk = P(axis, None, None)
+    m_p, l_p, acc_p = jax.shard_map(
+        local_partials,
+        mesh=mesh,
+        in_specs=(rep, blk, blk, rep, rep),
+        out_specs=(rep, rep, rep),
+        check_vma=False,
+    )(qr, kv["kp"], kv["vp"], prefix_len, q_abs)
+
+    # --- own suffix region: keys j <= eos at absolute positions plen + j ---
+    ls = kv["ks"].shape[1]
+    kj = jnp.arange(ls)[None, None, :]
+    vis = jnp.broadcast_to(kj <= suffix_eos[:, None, None], (s_cnt, 1, ls))
+    if window is not None or chunk is not None:
+        vis = _local_clause(vis, q_abs, prefix_len + kj, window, None, chunk)
+    m_s, l_s, acc_s = _partials(qr, kv["ks"], kv["vs"], vis, scale, softcap)
+
+    # --- generated region: keys j <= t at plen + eos + 1 + j ---
+    tm = kv["kg"].shape[1]
+    kj = jnp.arange(tm)[None, None, :]
+    vis = jnp.broadcast_to(kj <= t, (s_cnt, 1, tm))
+    if window is not None or chunk is not None:
+        abs_k = prefix_len + suffix_eos[:, None, None] + 1 + kj
+        vis = _local_clause(vis, q_abs, abs_k, window, None, chunk)
+    m_g3, l_g3, acc_g3 = _partials(qr, kv["kg"], kv["vg"], vis, scale, softcap)
+
+    # --- merge the three accumulator sets (one joint softmax) ---
+    m = jnp.maximum(jnp.maximum(m_p, m_s), m_g3)
+    cp, cs, cg = jnp.exp(m_p - m), jnp.exp(m_s - m), jnp.exp(m_g3 - m)
+    l = l_p * cp + l_s * cs + l_g3 * cg
+    out = (acc_p * cp + acc_s * cs + acc_g3 * cg) / jnp.maximum(l, 1e-30)
+    # [S, n_kv, g, 1, hd] -> [S, 1, n_q, hd]
+    attn = (
+        out.transpose(0, 3, 1, 2, 4)
+        .reshape(s_cnt, 1, n_kv * g, cfg.head_dim)
+        .astype(x.dtype)
+    )
+    mid = llama._residual_attn(params, cfg, x, attn)
+    return llama._residual_mlp(params, cfg, mid), kv
 
 
 class LongContextScorer:
@@ -228,16 +335,28 @@ class LongContextScorer:
         )
         self.stats: dict[str, float] = {}
 
-    def __call__(self, prompts) -> list[np.ndarray]:
-        t0 = time.perf_counter()
-        prompts = list(prompts)
-        # ONE weight source for the whole batch (shard list repeated per
-        # prompt): a cold source per prompt would re-read the checkpoint
-        # with no prefetch overlap between prompts.
-        source = ShardWeightSource(
+    def _layer_flags(self, seg: Params, i: int) -> tuple[bool, bool]:
+        """(sliding, rope_on) for unstacked layer ``i`` of one decoders
+        segment: the wrapper's per-layer flags (local/global mixes, llama4
+        NoPE) when present, else uniform — every layer slides iff the config
+        carries a local form, and rope is on."""
+        flags, rflags = seg.get("sliding"), seg.get("rope")
+        mc = self.model_cfg
+        uniform = (
+            mc.sliding_window is not None or mc.attention_chunk_size is not None
+        )
+        sliding = bool(np.asarray(flags)[i]) if flags is not None else uniform
+        rope_on = bool(np.asarray(rflags)[i]) if rflags is not None else True
+        return sliding, rope_on
+
+    def _make_source(self, repeats: int) -> ShardWeightSource:
+        """ONE weight source for a whole batch (shard list repeated
+        ``repeats`` times): a cold source per pass would re-read the
+        checkpoint with no prefetch overlap between passes."""
+        return ShardWeightSource(
             self.cfg.model_path,
             self.layer_names,
-            list(self.plan.shards) * max(len(prompts), 1),
+            list(self.plan.shards) * max(repeats, 1),
             np_dtype_for(self.cfg.dtype),
             device=self._rep,  # device_put accepts a Sharding: replicate
             prefetch_depth=self.cfg.effective_prefetch_depth(),
@@ -245,6 +364,11 @@ class LongContextScorer:
             layer_sliding=self.model_cfg.layer_sliding,
             layer_rope=self.model_cfg.layer_rope,
         )
+
+    def __call__(self, prompts) -> list[np.ndarray]:
+        t0 = time.perf_counter()
+        prompts = list(prompts)
+        source = self._make_source(len(prompts))
         stream = iter(source)
         try:
             out = [self._score_one(p, s, stream) for p, s in prompts]
@@ -277,33 +401,14 @@ class LongContextScorer:
                     prefix_x = llama.embed(params, prefix_ids, self.dtype, self.model_cfg)
                     suffix_h = llama.embed(params, suffix_ids, self.dtype, self.model_cfg)
                 elif kind == "decoders":
-                    # Unstack the [k, ...] scan pytree: each layer runs
-                    # as one jitted sharded step (shard_map inside). The
-                    # wrapper's sliding/rope flags (per-layer local/global
-                    # mixes, llama4 NoPE layers) pick the traced variant;
-                    # None flags mean uniform — every layer slides iff the
-                    # config carries a local form, and rope is on.
+                    # Unstack the [k, ...] scan pytree: each layer runs as
+                    # one jitted sharded step (shard_map inside); per-layer
+                    # flags pick among the (at most four) traced variants.
                     stacked = params["layers"]
-                    flags = params.get("sliding")
-                    rflags = params.get("rope")
-                    mc = self.model_cfg
-                    uniform = (
-                        mc.sliding_window is not None
-                        or mc.attention_chunk_size is not None
-                    )
                     k_layers = jax.tree.leaves(stacked)[0].shape[0]
                     for i in range(k_layers):
                         layer = jax.tree.map(lambda a: a[i], stacked)
-                        sliding = (
-                            bool(np.asarray(flags)[i])
-                            if flags is not None
-                            else uniform
-                        )
-                        rope_on = (
-                            bool(np.asarray(rflags)[i])
-                            if rflags is not None
-                            else True
-                        )
+                        sliding, rope_on = self._layer_flags(params, i)
                         prefix_x, suffix_h = self._layer_fn(
                             layer, prefix_x, suffix_h, prefix_len, sliding,
                             rope_on,
@@ -325,9 +430,214 @@ class LongContextScorer:
         return np.expand_dims(scores[: t.num_suffixes], axis=1)
 
 
+class LongContextDecoder(LongContextScorer):
+    """KV-cache decode for prompts whose prefix exceeds one chip's cap.
+
+    Composes the framework's two headline extensions: long context (the sp
+    mesh, where the reference truncates) and KV-cache generation (where the
+    reference re-runs the whole prompt per token). The prefill pass is the
+    scorer's sharded forward, additionally parking every layer's KV — the
+    prefix region stays SHARDED over the mesh, suffix/generated regions
+    replicated — and each decode step streams the weights once more, runs
+    :func:`sharded_decode_layer` per layer (one new token per suffix), and
+    scores through norm + lm_head. Greedy, token-id append semantics
+    (matches ``runtime/decode.py DecodeGenerator``).
+    """
+
+    def __init__(self, cfg: FrameworkConfig, devices=None, tokenizer=None):
+        if tokenizer is None:
+            from transformers import AutoTokenizer
+
+            tokenizer = AutoTokenizer.from_pretrained(cfg.model_path)
+        super().__init__(cfg, devices=devices, tokenizer=tokenizer)
+        self.raw_tokenizer = tokenizer
+        self._prefill_fn = jax.jit(
+            lambda params, px, sh, plen, sliding, rope_on: (
+                sharded_prefix_suffix_layer(
+                    params, self.model_cfg, self.mesh, "sp", px, sh, plen,
+                    sliding=sliding, rope_on=rope_on, return_kv=True,
+                )
+            ),
+            static_argnums=(4, 5),
+        )
+        self._decode_fn = jax.jit(
+            lambda params, x, kv, plen, eos, tt, sliding, rope_on: (
+                sharded_decode_layer(
+                    params, self.model_cfg, self.mesh, "sp", x, kv, plen,
+                    eos, tt, sliding=sliding, rope_on=rope_on,
+                )
+            ),
+            static_argnums=(6, 7),
+            # The caller overwrites kv_layers[li] with the result, so the
+            # old cache (incl. the sp-sharded prefix KV — the big buffer on
+            # exactly this path) updates in place instead of copying per
+            # layer per token.
+            donate_argnums=(2,),
+        )
+
+    def __call__(self, prompts):
+        """Returns (scores, updated_prompts, tokens_processed) — the
+        ``orchestration.run_decode`` contract. scores[i]: float32
+        [n_suffixes, num_gen_token, vocab]."""
+        t0 = time.perf_counter()
+        prompts = list(prompts)
+        n_gen = max(self.cfg.num_gen_token, 1)
+        # Prefill + (n_gen - 1) decode streams per prompt, in order.
+        source = self._make_source(max(len(prompts), 1) * n_gen)
+        stream = iter(source)
+        scores_out, updated, tokens = [], [], 0.0
+        try:
+            for prefix, suffixes in prompts:
+                dists, hist, tp = self._generate_one(
+                    prefix, suffixes, stream, n_gen
+                )
+                scores_out.append(dists)
+                updated.append(
+                    (
+                        prefix,
+                        tuple(
+                            s + self.raw_tokenizer.decode(hist[s_i])
+                            for s_i, s in enumerate(suffixes)
+                        ),
+                    )
+                )
+                tokens += tp
+        finally:
+            source.close()
+        self.stats = {
+            "total_wall_s": time.perf_counter() - t0,
+            "load_weights_time_s": source.load_time,
+            "tokens_processed": tokens,
+        }
+        return scores_out, updated, int(tokens)
+
+    def _generate_one(self, prefix: str, suffixes: tuple, stream, n_gen: int):
+        t = self.tokenizer(prefix, suffixes)
+        lp = bucket_len(
+            len(t.prefix_ids), self.cfg.bucket_multiple * self.sp, self.cap
+        )
+        prefix_ids = np.full((lp,), self.tokenizer.pad_id, np.int32)
+        prefix_ids[: len(t.prefix_ids)] = t.prefix_ids
+        prefix_ids = jax.device_put(jnp.asarray(prefix_ids), self._seq)
+        suffix_ids = jax.device_put(jnp.asarray(t.suffix_ids), self._rep)
+        prefix_len = jnp.int32(t.prefix_len)
+        suffix_eos = jax.device_put(jnp.asarray(t.suffix_eos), self._rep)
+        s_cnt = t.suffix_ids.shape[0]
+        n_kv, hd = self.model_cfg.num_key_value_heads, self.model_cfg.head_dim
+
+        kv_layers: list[Params] = []
+        dists: list[np.ndarray] = []  # per-step [S_true, V]
+
+        # --- prefill: sharded forward, parking per-layer KV ---------------
+        prefix_x = suffix_h = None
+        for _ in range(len(self.plan.shards)):
+            _, segments = next(stream)
+            for kind, params in segments:
+                if kind == "embed":
+                    prefix_x = llama.embed(params, prefix_ids, self.dtype, self.model_cfg)
+                    suffix_h = llama.embed(params, suffix_ids, self.dtype, self.model_cfg)
+                elif kind == "decoders":
+                    stacked = params["layers"]
+                    k_layers = jax.tree.leaves(stacked)[0].shape[0]
+                    for i in range(k_layers):
+                        layer = jax.tree.map(lambda a: a[i], stacked)
+                        sliding, rope_on = self._layer_flags(params, i)
+                        prefix_x, suffix_h, kv = self._prefill_fn(
+                            layer, prefix_x, suffix_h, prefix_len, sliding,
+                            rope_on,
+                        )
+                        gen_shape = (s_cnt, max(1, n_gen - 1), n_kv, hd)
+                        kv_layers.append(
+                            kv
+                            | {
+                                "kg": jax.device_put(
+                                    jnp.zeros(gen_shape, self.dtype), self._rep
+                                ),
+                                "vg": jax.device_put(
+                                    jnp.zeros(gen_shape, self.dtype), self._rep
+                                ),
+                            }
+                        )
+                elif kind == "norm":
+                    suffix_h = llama.select_eos_and_norm(
+                        params, self.model_cfg, suffix_h, suffix_eos
+                    )
+                else:  # head
+                    dists.append(
+                        np.asarray(
+                            jax.device_get(
+                                llama.lm_head_scores(
+                                    params,
+                                    suffix_h,
+                                    softcap=self.model_cfg.final_logit_softcap,
+                                )
+                            )
+                        )[: t.num_suffixes]
+                    )
+
+        # --- decode steps: one token per suffix per stream ----------------
+        for step in range(n_gen - 1):
+            last = dists[-1].argmax(axis=-1)  # [S_true]
+            ids = np.full((s_cnt, 1), int(last[0]) if len(last) else 0, np.int64)
+            ids[: t.num_suffixes, 0] = last
+            ids = jax.device_put(jnp.asarray(ids), self._rep)
+            x = None
+            norm_params = None
+            li = 0
+            for _ in range(len(self.plan.shards)):
+                _, segments = next(stream)
+                for kind, params in segments:
+                    if kind == "embed":
+                        x = llama.embed(params, ids, self.dtype, self.model_cfg)
+                    elif kind == "decoders":
+                        stacked = params["layers"]
+                        k_layers = jax.tree.leaves(stacked)[0].shape[0]
+                        for i in range(k_layers):
+                            layer = jax.tree.map(lambda a: a[i], stacked)
+                            sliding, rope_on = self._layer_flags(params, i)
+                            x, kv_layers[li] = self._decode_fn(
+                                layer, x, kv_layers[li], prefix_len,
+                                suffix_eos, jnp.int32(step), sliding, rope_on,
+                            )
+                            li += 1
+                    elif kind == "norm":
+                        norm_params = params
+                    else:  # head
+                        normed = rms_norm(
+                            x,
+                            norm_params["scale"],
+                            self.model_cfg.rms_norm_eps,
+                            self.model_cfg.norm_unit_offset,
+                        )
+                        dists.append(
+                            np.asarray(
+                                jax.device_get(
+                                    llama.lm_head_scores(
+                                        params,
+                                        normed,
+                                        softcap=self.model_cfg.final_logit_softcap,
+                                    )
+                                )
+                            )[: t.num_suffixes]
+                        )
+
+        hist = np.stack([d.argmax(axis=-1) for d in dists], axis=1)  # [S, n_gen]
+        scores = np.stack(dists, axis=1)  # [S_true, n_gen, V]
+        tokens = float(
+            t.tokens_processed + t.num_suffixes * max(n_gen - 1, 0)
+        )
+        return scores, hist, tokens
+
+
 def prefix_token_count(tokenizer, prefix: str) -> int:
     """Untruncated prefix token count — the long-context routing predicate."""
     return len(tokenizer(prefix)["input_ids"])
 
 
-__all__ = ["LongContextScorer", "sharded_prefix_suffix_layer", "prefix_token_count"]
+__all__ = [
+    "LongContextScorer",
+    "LongContextDecoder",
+    "sharded_prefix_suffix_layer",
+    "sharded_decode_layer",
+    "prefix_token_count",
+]

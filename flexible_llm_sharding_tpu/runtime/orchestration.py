@@ -79,6 +79,36 @@ def _run_batched(ex: StreamingExecutor, prompts: list[Prompt], num_batch: int):
     return out
 
 
+def _long_context_split(cfg: FrameworkConfig, prompts, tokenizer):
+    """The long-context routing predicate, shared by the scoring and decode
+    entry points: returns (tokenizer, long_idx, rest_idx) — indices of
+    prompts whose prefix overflows one chip's cap (routed to the sp mesh;
+    the reference truncates them, ``/root/reference/utils.py:250,254``)."""
+    from flexible_llm_sharding_tpu.runtime.longcontext import prefix_token_count
+
+    if tokenizer is None:
+        from transformers import AutoTokenizer
+
+        tokenizer = AutoTokenizer.from_pretrained(cfg.model_path)
+    long_idx = [
+        i
+        for i, (p, _) in enumerate(prompts)
+        if prefix_token_count(tokenizer, p) > cfg.max_token_len
+    ]
+    long_set = set(long_idx)
+    rest_idx = [i for i in range(len(prompts)) if i not in long_set]
+    return tokenizer, long_idx, rest_idx
+
+
+def _merge_by_index(n: int, *parts) -> list:
+    """parts: (idx_list, values) pairs -> one list in original prompt order."""
+    out: list = [None] * n
+    for idxs, vals in parts:
+        for i, v in zip(idxs, vals):
+            out[i] = v
+    return out
+
+
 def _tp_placement(cfg: FrameworkConfig, devices: list):
     """Build the Megatron placement for --tensor_parallel (shared by the
     scoring and decode entry points)."""
@@ -108,34 +138,23 @@ def run_prompts(
 
     if cfg.long_context:
         # Prompts whose prefix overflows one chip's bucket are scored
-        # exactly over an sp mesh (ring attention); the reference truncates
-        # them instead (/root/reference/utils.py:250,254). The rest take
-        # the normal streaming path.
+        # exactly over an sp mesh (ring attention); the rest take the
+        # normal streaming path.
         from flexible_llm_sharding_tpu.runtime.longcontext import (
             LongContextScorer,
-            prefix_token_count,
         )
 
-        if tokenizer is None:
-            from transformers import AutoTokenizer
-
-            tokenizer = AutoTokenizer.from_pretrained(cfg.model_path)
-        long_idx = [
-            i
-            for i, (p, _) in enumerate(prompts)
-            if prefix_token_count(tokenizer, p) > cfg.max_token_len
-        ]
+        tokenizer, long_idx, rest_idx = _long_context_split(
+            cfg, prompts, tokenizer
+        )
         if long_idx:
             import dataclasses
 
             scorer = LongContextScorer(cfg, devices=devices, tokenizer=tokenizer)
             long_scores = scorer([prompts[i] for i in long_idx])
-            long_set = set(long_idx)
-            rest_idx = [i for i in range(len(prompts)) if i not in long_set]
-            rest_cfg = dataclasses.replace(cfg, long_context=False)
             rest_scores = (
                 run_prompts(
-                    rest_cfg,
+                    dataclasses.replace(cfg, long_context=False),
                     [prompts[i] for i in rest_idx],
                     tokenizer=tokenizer,
                     devices=devices,
@@ -143,12 +162,9 @@ def run_prompts(
                 if rest_idx
                 else []
             )
-            out: list = [None] * len(prompts)
-            for i, s in zip(long_idx, long_scores):
-                out[i] = s
-            for i, s in zip(rest_idx, rest_scores):
-                out[i] = s
-            return out
+            return _merge_by_index(
+                len(prompts), (long_idx, long_scores), (rest_idx, rest_scores)
+            )
 
     if cfg.tensor_parallel > 1:
         # One streaming executor whose every shard is Megatron-sharded over a
@@ -241,6 +257,42 @@ def run_decode(
 
     prompts = list(prompts)
     devices = devices if devices is not None else pick_devices(cfg)
+
+    if cfg.long_context:
+        # Prompts whose prefix overflows one chip's bucket decode over the
+        # sp mesh with sharded prefix KV (the reference would truncate them
+        # AND re-run the full prompt per token); the rest take the normal
+        # KV-decode paths below.
+        from flexible_llm_sharding_tpu.runtime.longcontext import (
+            LongContextDecoder,
+        )
+
+        tokenizer, long_idx, rest_idx = _long_context_split(
+            cfg, prompts, tokenizer
+        )
+        if long_idx:
+            import dataclasses
+
+            dec = LongContextDecoder(cfg, devices=devices, tokenizer=tokenizer)
+            l_scores, l_updated, l_tokens = dec([prompts[i] for i in long_idx])
+            if rest_idx:
+                r_scores, r_updated, r_tokens = run_decode(
+                    dataclasses.replace(cfg, long_context=False),
+                    [prompts[i] for i in rest_idx],
+                    tokenizer=tokenizer,
+                    devices=devices,
+                )
+            else:
+                r_scores, r_updated, r_tokens = [], [], 0
+            return (
+                _merge_by_index(
+                    len(prompts), (long_idx, l_scores), (rest_idx, r_scores)
+                ),
+                _merge_by_index(
+                    len(prompts), (long_idx, l_updated), (rest_idx, r_updated)
+                ),
+                l_tokens + r_tokens,
+            )
 
     if cfg.tensor_parallel > 1:
         # TP decode: one generator whose streamed weights are Megatron-
