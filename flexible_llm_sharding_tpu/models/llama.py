@@ -491,7 +491,9 @@ def prefix_suffix_layer(
     # (query_pre_attn_scalar), softcap, sliding window / chunked masks, and
     # the traced per-layer local toggle; NoPE/temperature handling lives in
     # position_qk, OUTSIDE the attention op. Only shape eligibility gates
-    # them (tiny head dims / ragged buckets fall back to XLA attention).
+    # them (tiny head dims / ragged buckets fall back to XLA attention;
+    # ragged head dims >= 64 like phi3's 96 pad to the lane multiple inside
+    # the kernels).
     # Under tensor parallelism (``tp_mesh``) the kernels run per head-shard
     # via shard_map, so eligibility is checked on PER-SHARD head counts.
     tp_size = tp_mesh.shape["tp"] if tp_mesh is not None else 1

@@ -35,8 +35,11 @@ Model-family envelope (mirrors the XLA ops' full surface):
   alternation under one ``lax.scan`` program): a traced bool that rides the
   scalar-prefetch channel next to ``prefix_len``.
 
-Shape eligibility is checked by :func:`supports`; callers fall back to the
-XLA path otherwise (tiny test models, ragged head dims).
+Shape eligibility is checked by :func:`supports` / :func:`supports_decode`;
+callers fall back to the XLA path otherwise. Ragged head dims >= 64 (phi3's
+96) are zero-padded to the lane multiple inside the scoring wrappers (exact;
+at most 2x lanes); tiny head dims, unbucketed lengths, and — for the decode
+kernel — any non-128-multiple head dim fall back to XLA.
 """
 
 from __future__ import annotations
@@ -64,13 +67,25 @@ def _block(n: int, cap: int) -> int:
 
 
 def supports(n_q: int, n_kv: int, head_dim: int, lq: int, lk: int) -> bool:
-    """Kernel eligibility: MXU-aligned head_dim, bucketed q/k lengths."""
+    """Kernel eligibility: whole query groups and bucketed q/k lengths.
+    Ragged head dims >= 64 (phi3's 96) are zero-padded to the lane multiple
+    inside the wrappers — exact, since zero channels contribute nothing to
+    QK^T and the padded V channels are sliced off, and the pad costs at most
+    2x lanes. Tinier head dims fall back to XLA (an 8x pad would waste more
+    MXU/bandwidth than the kernel saves)."""
     return (
-        head_dim % 128 == 0
-        and n_q % n_kv == 0
+        n_q % n_kv == 0
         and lq % 64 == 0
         and lk % 64 == 0
+        and (head_dim % 128 == 0 or head_dim >= 64)
     )
+
+
+def _pad_head_dim(*arrays):
+    """Zero-pad the trailing head_dim axis of each array to a multiple of
+    128 (the TPU lane width). Returns (padded_arrays, original_hd)."""
+    hd = arrays[0].shape[-1]
+    return tuple(_pad_dim(a, -1, 128) for a in arrays), hd
 
 
 def _online_block(q, kb, vb, mask, m, l, acc, scale, softcap=None):
@@ -208,6 +223,8 @@ def flash_causal_attention(
     lk, n_kv, _ = k.shape
     if scale is None:
         scale = 1.0 / (hd**0.5)
+    (q, k, v), hd_true = _pad_head_dim(q, k, v)
+    hd = q.shape[-1]
     bq = _block(lq, _MAX_BLOCK_Q)
     bk = _block(lk, _MAX_BLOCK_K)
     grid = (n_q, lq // bq)
@@ -237,7 +254,7 @@ def flash_causal_attention(
         k.transpose(1, 0, 2),
         v.transpose(1, 0, 2),
     )
-    return out.transpose(1, 0, 2)
+    return out.transpose(1, 0, 2)[..., :hd_true]
 
 
 # ---------------------------------------------------------------------------
@@ -321,6 +338,10 @@ def flash_prefix_shared_attention(
     lp, n_kv, _ = k_prefix.shape
     if scale is None:
         scale = 1.0 / (hd**0.5)
+    (q, k_prefix, v_prefix, k_suffix, v_suffix), hd_true = _pad_head_dim(
+        q, k_prefix, v_prefix, k_suffix, v_suffix
+    )
+    hd = q.shape[-1]
     bq = _block(ls, _MAX_BLOCK_Q)
     bkp = _block(lp, _MAX_BLOCK_K)
     grid = (s, n_q, ls // bq)
@@ -356,7 +377,7 @@ def flash_prefix_shared_attention(
         k_suffix.transpose(0, 2, 1, 3),
         v_suffix.transpose(0, 2, 1, 3),
     )
-    return out.transpose(0, 2, 1, 3)
+    return out.transpose(0, 2, 1, 3)[..., :hd_true]
 
 
 # ---------------------------------------------------------------------------
@@ -433,10 +454,13 @@ def _decode_kernel(
 
 
 def supports_decode(n_q: int, n_kv: int, head_dim: int) -> bool:
-    """Decode-kernel eligibility: MXU-aligned head_dim and whole query
-    groups; ragged KV lengths are padded inside the wrapper (masks already
-    exclude the padding), so lengths never disqualify."""
-    return head_dim % 128 == 0 and n_q % n_kv == 0
+    """Decode-kernel eligibility: whole query groups and a lane-aligned
+    head_dim. Unlike the scoring kernels, ragged head dims DON'T pad here:
+    the wrapper would re-pad the entire parked KV cache every layer every
+    token — a full-cache HBM round trip added to exactly the bandwidth-bound
+    loop the kernel exists to speed up — so those models keep the XLA decode
+    op. (Ragged KV lengths still pad; masks exclude the padding.)"""
+    return n_q % n_kv == 0 and head_dim % 128 == 0
 
 
 def _pad_dim(a, axis: int, mult: int):
@@ -475,6 +499,10 @@ def flash_decode_attention(
     g = n_q // n_kv
     if scale is None:
         scale = 1.0 / (hd**0.5)
+    (q, k_prefix, v_prefix, k_suffix, v_suffix, k_gen, v_gen), hd_true = (
+        _pad_head_dim(q, k_prefix, v_prefix, k_suffix, v_suffix, k_gen, v_gen)
+    )
+    hd = q.shape[-1]
 
     # Head-major layouts; ragged axes pad up (masks exclude the padding):
     # the query group to the fp32 sublane multiple, KV lengths to the lane
@@ -532,7 +560,7 @@ def flash_decode_attention(
         out_shape=jax.ShapeDtypeStruct((s, n_kv, gp, hd), q.dtype),
         interpret=interpret,
     )(flags, qg, kp, vp, ks, vs, kg, vg)
-    return out[:, :, :g].reshape(s, 1, n_q, hd)
+    return out[:, :, :g, :hd_true].reshape(s, 1, n_q, hd_true)
 
 
 __all__ = [

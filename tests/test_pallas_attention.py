@@ -24,11 +24,61 @@ def _rand(rng, *shape):
 
 
 def test_supports():
+    from flexible_llm_sharding_tpu.ops.pallas_attention import supports_decode
+
     assert supports(16, 16, 128, 256, 256)
     assert supports(32, 8, 128, 64, 4096)
-    assert not supports(4, 2, 16, 64, 64)  # tiny head dim
+    assert supports(4, 2, 96, 64, 64)  # ragged head dim >= 64: padded inside
+    assert not supports(4, 2, 16, 64, 64)  # tiny head dim: XLA is cheaper
     assert not supports(16, 16, 128, 100, 256)  # ragged length
     assert not supports(15, 4, 128, 64, 64)  # n_q not multiple of n_kv
+    # Decode never pads head dims (it would re-pad the parked KV cache
+    # every layer every token).
+    assert supports_decode(8, 2, 128)
+    assert not supports_decode(8, 2, 96)
+
+
+@pytest.mark.parametrize("hd", [96, 64])
+def test_flash_ragged_head_dim(hd):
+    """Head dims off the 128-lane multiple (phi3's 96) zero-pad inside the
+    wrappers — exact vs the XLA ops on all three kernels."""
+    from flexible_llm_sharding_tpu.ops.attention import decode_attention
+    from flexible_llm_sharding_tpu.ops.pallas_attention import (
+        flash_decode_attention,
+    )
+
+    rng = np.random.default_rng(9)
+    s, ls, n_q, n_kv, lp, tmax, plen = 2, 64, 4, 2, 128, 2, 100
+    q = _rand(rng, s, ls, n_q, hd)
+    kp = _rand(rng, lp, n_kv, hd)
+    vp = _rand(rng, lp, n_kv, hd)
+    ks = _rand(rng, s, ls, n_kv, hd)
+    vs = _rand(rng, s, ls, n_kv, hd)
+
+    got = flash_prefix_shared_attention(q, kp, vp, ks, vs, plen, interpret=True)
+    want = prefix_shared_attention(q, kp, vp, ks, vs, jnp.int32(plen))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5)
+
+    kj = jnp.arange(lp)[None, :]
+    qc = _rand(rng, lp, n_q, hd)
+    got_c = flash_causal_attention(qc, kp, vp, plen, interpret=True)
+    want_c = attention(qc, kp, vp, causal_mask(lp, lp) & (kj < plen))
+    np.testing.assert_allclose(
+        np.asarray(got_c)[:plen], np.asarray(want_c)[:plen], rtol=2e-5, atol=2e-5
+    )
+
+    qd = _rand(rng, s, 1, n_q, hd)
+    kg = _rand(rng, s, tmax, n_kv, hd)
+    vg = _rand(rng, s, tmax, n_kv, hd)
+    eos = jnp.asarray([5, 60], jnp.int32)
+    got_d = flash_decode_attention(
+        qd, kp, vp, ks, vs, kg, vg, jnp.int32(plen), eos, jnp.int32(1),
+        interpret=True,
+    )
+    want_d = decode_attention(
+        qd, kp, vp, ks, vs, kg, vg, jnp.int32(plen), eos, jnp.int32(1)
+    )
+    np.testing.assert_allclose(np.asarray(got_d), np.asarray(want_d), rtol=2e-5, atol=2e-5)
 
 
 @pytest.mark.parametrize("n_q,n_kv", [(4, 4), (8, 2)])
