@@ -62,6 +62,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="how many new tokens to be generated")
     p.add_argument("--temperature", type=float, default=0.0,
                    help="0 = greedy (reference behaviour); >0 samples p^(1/T)")
+    p.add_argument("--top_k", type=int, default=0,
+                   help="sampling: keep only the k most probable tokens (0 = off)")
+    p.add_argument("--top_p", type=float, default=0.0,
+                   help="sampling: nucleus truncation at cumulative mass p (0 = off)")
     p.add_argument("--kv_cache", type=_str2bool, default=False,
                    help="fast generation: reuse per-layer KV across tokens "
                         "(token-id append semantics; greedy only; single device)")
@@ -131,6 +135,10 @@ def main(argv: list[str] | None = None, tokenizer=None) -> None:
     args = build_parser().parse_args(argv)
     print(args, file=sys.stderr)
     cfg = config_from_args(args)
+
+    if (args.top_k or args.top_p) and args.temperature <= 0:
+        # Silent no-op filters would masquerade as sampling.
+        raise SystemExit("--top_k/--top_p require --temperature > 0")
 
     if args.coordinator_address is not None:
         from flexible_llm_sharding_tpu.parallel.sharding import initialize_multihost
@@ -247,6 +255,8 @@ def main(argv: list[str] | None = None, tokenizer=None) -> None:
                 cfg.num_gen_token,
                 tokenizer,
                 temperature=args.temperature,
+                top_k=args.top_k,
+                top_p=args.top_p,
             )
     wall = time.perf_counter() - t0
 

@@ -73,12 +73,7 @@ def supports(n_q: int, n_kv: int, head_dim: int, lq: int, lk: int) -> bool:
     QK^T and the padded V channels are sliced off, and the pad costs at most
     2x lanes. Tinier head dims fall back to XLA (an 8x pad would waste more
     MXU/bandwidth than the kernel saves)."""
-    return (
-        n_q % n_kv == 0
-        and lq % 64 == 0
-        and lk % 64 == 0
-        and (head_dim % 128 == 0 or head_dim >= 64)
-    )
+    return n_q % n_kv == 0 and lq % 64 == 0 and lk % 64 == 0 and head_dim >= 64
 
 
 def _pad_head_dim(*arrays):

@@ -25,6 +25,40 @@ Prompt = tuple[str, tuple[str, ...]]
 RunFn = Callable[[list[Prompt]], list[np.ndarray]]
 
 
+def sample_token(
+    dist: np.ndarray,
+    rng: np.random.Generator,
+    temperature: float,
+    top_k: int = 0,
+    top_p: float = 0.0,
+) -> int:
+    """Draw one token from a next-token distribution with the standard
+    decoding controls: temperature reshaping ``p^(1/T)``, then top-k
+    truncation, then nucleus (top-p) truncation, renormalised. ``top_k=0`` /
+    ``top_p=0`` disable their filter (HF convention: top_p keeps the
+    smallest prefix of the sorted distribution whose mass reaches p,
+    always including the most probable token)."""
+    logits = np.log(np.maximum(dist, 1e-30)) / max(temperature, 1e-6)
+    p = np.exp(logits - logits.max())
+    p = p / p.sum()
+    if top_k and top_k < p.shape[-1]:
+        # Exactly k survivors even under ties (argsort breaks them by
+        # index, like torch.topk).
+        drop = np.argsort(-p, kind="stable")[top_k:]
+        p[drop] = 0.0
+        p = p / p.sum()  # HF order: nucleus applies to the RENORMALIZED mass
+    if 0.0 < top_p < 1.0:
+        order = np.argsort(-p, kind="stable")
+        csum = np.cumsum(p[order])
+        # Keep tokens up to AND INCLUDING the one that crosses p.
+        cut = int(np.searchsorted(csum, top_p)) + 1
+        keep = np.zeros_like(p, dtype=bool)
+        keep[order[:cut]] = True
+        p = np.where(keep, p, 0.0)
+    p = p / p.sum()
+    return int(rng.choice(dist.shape[-1], p=p))
+
+
 def generation_loop(
     run_fn: RunFn,
     prompts: Sequence[Prompt],
@@ -32,6 +66,8 @@ def generation_loop(
     tokenizer,
     temperature: float = 0.0,
     seed: int = 0,
+    top_k: int = 0,
+    top_p: float = 0.0,
 ) -> tuple[list[np.ndarray], list[Prompt]]:
     """Run ``num_gen_token`` decode iterations (greedy by default).
 
@@ -43,7 +79,9 @@ def generation_loop(
     ``temperature > 0`` samples each new token from ``p^(1/T)`` (renormalised)
     — the reference sketched this flag but left it commented out
     (``/root/reference/main.py:47-48``); ``0`` is exact reference (argmax)
-    behaviour. Sampling is deterministic given ``seed``.
+    behaviour. ``top_k``/``top_p`` truncate the sampling distribution (only
+    meaningful with temperature > 0). Sampling is deterministic given
+    ``seed``.
     """
     original = list(prompts)
     current: list[Prompt] = copy.deepcopy(original)
@@ -57,10 +95,7 @@ def generation_loop(
     rng = np.random.default_rng(seed)
 
     def _pick(dist: np.ndarray) -> int:
-        """Sample from p^(1/T) (only called on the temperature>0 path)."""
-        logits = np.log(np.maximum(dist, 1e-30)) / temperature
-        p = np.exp(logits - logits.max())
-        return int(rng.choice(dist.shape[-1], p=p / p.sum()))
+        return sample_token(dist, rng, temperature, top_k, top_p)
 
     for i_new in range(num_gen_token):
         outputs = run_fn(current)
@@ -92,4 +127,4 @@ def generation_loop(
     return output_scores, current
 
 
-__all__ = ["generation_loop"]
+__all__ = ["generation_loop", "sample_token"]

@@ -161,6 +161,67 @@ def test_generation_with_temperature(model_dir):
             assert new.startswith(orig) and len(new) > len(orig)
 
 
+def test_sample_token_filters():
+    """top-k keeps exactly the k most probable tokens; top-p keeps the
+    smallest sorted prefix reaching mass p (always incl. the argmax);
+    temperature->0 concentrates on the argmax."""
+    from flexible_llm_sharding_tpu.runtime.generation import sample_token
+
+    rng = np.random.default_rng(0)
+    dist = np.array([0.5, 0.25, 0.15, 0.07, 0.03])
+
+    draws = {sample_token(dist, rng, 1.0, top_k=2) for _ in range(200)}
+    assert draws == {0, 1}
+    # p=0.74 < 0.5+0.25: tokens {0,1} just cover it.
+    draws = {sample_token(dist, rng, 1.0, top_p=0.74) for _ in range(200)}
+    assert draws == {0, 1}
+    # A tiny p still keeps the most probable token.
+    draws = {sample_token(dist, rng, 1.0, top_p=0.01) for _ in range(50)}
+    assert draws == {0}
+    # Near-zero temperature is argmax.
+    assert sample_token(dist, rng, 1e-6) == 0
+    # Filters compose in HF order: k=3 survivors renormalize to
+    # [.555, .278, .167]; nucleus 0.80 then keeps exactly {0, 1}.
+    draws = {
+        sample_token(dist, rng, 1.0, top_k=3, top_p=0.80) for _ in range(200)
+    }
+    assert draws == {0, 1}
+    # Ties at the k-th probability: still exactly k survivors.
+    tied = np.array([0.3, 0.2, 0.2, 0.2, 0.1])
+    draws = {sample_token(tied, rng, 1.0, top_k=2) for _ in range(200)}
+    assert draws == {0, 1}
+
+
+def test_generation_top_k_p(model_dir):
+    """top_k/top_p flow through the loop and CLI flag surface."""
+    cfg = _cfg(model_dir)
+    tok = FakeTokenizer()
+    run = lambda ps: run_prompts(cfg, ps, tokenizer=tok, devices=jax.devices()[:1])
+    _, up_a = generation_loop(
+        run, PROMPTS[:1], 2, tok, temperature=0.8, seed=1, top_k=5, top_p=0.9
+    )
+    _, up_b = generation_loop(
+        run, PROMPTS[:1], 2, tok, temperature=0.8, seed=1, top_k=5, top_p=0.9
+    )
+    assert up_a == up_b
+    for (_, sfx), (_, usfx) in zip(PROMPTS[:1], up_a):
+        for orig, new in zip(sfx, usfx):
+            assert new.startswith(orig) and len(new) > len(orig)
+
+    from flexible_llm_sharding_tpu.cli import main
+
+    with pytest.raises(SystemExit, match="temperature"):
+        main(
+            [
+                "--model_path", model_dir,
+                "--prompt_pickle", "x.pkl",
+                "--output_file", "y.pkl",
+                "--top_k", "5",
+            ],
+            tokenizer=tok,
+        )
+
+
 def test_cli_end_to_end(model_dir, tmp_path):
     from flexible_llm_sharding_tpu.cli import main
 
