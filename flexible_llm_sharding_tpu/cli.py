@@ -66,9 +66,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="sampling: keep only the k most probable tokens (0 = off)")
     p.add_argument("--top_p", type=float, default=0.0,
                    help="sampling: nucleus truncation at cumulative mass p (0 = off)")
+    p.add_argument("--seed", type=int, default=0,
+                   help="sampling rng seed (temperature > 0)")
     p.add_argument("--kv_cache", type=_str2bool, default=False,
                    help="fast generation: reuse per-layer KV across tokens "
-                        "(token-id append semantics; greedy only; single device)")
+                        "(token-id append semantics; greedy or sampled)")
     # --- TPU-specific ---
     p.add_argument("--dtype", type=str, default="bfloat16",
                    choices=["bfloat16", "float16", "float32"])
@@ -128,17 +130,21 @@ def config_from_args(args: argparse.Namespace) -> FrameworkConfig:
         profile_dir=args.profile_dir,
         resume=args.resume,
         long_context=args.long_context,
+        temperature=args.temperature,
+        top_k=args.top_k,
+        top_p=args.top_p,
+        seed=args.seed,
     )
 
 
 def main(argv: list[str] | None = None, tokenizer=None) -> None:
     args = build_parser().parse_args(argv)
     print(args, file=sys.stderr)
-    cfg = config_from_args(args)
-
     if (args.top_k or args.top_p) and args.temperature <= 0:
-        # Silent no-op filters would masquerade as sampling.
+        # Friendly form of the FrameworkConfig validation: silent no-op
+        # filters would masquerade as sampling.
         raise SystemExit("--top_k/--top_p require --temperature > 0")
+    cfg = config_from_args(args)
 
     if args.coordinator_address is not None:
         from flexible_llm_sharding_tpu.parallel.sharding import initialize_multihost
@@ -225,8 +231,7 @@ def main(argv: list[str] | None = None, tokenizer=None) -> None:
     hbm_sampler = LiveArrayPeakSampler()
     with profiler_trace(cfg.profile_dir or None), hbm_sampler:
         if args.kv_cache:
-            if args.temperature > 0:
-                raise SystemExit("--kv_cache supports greedy decoding only")
+            # Sampling composes (cfg carries temperature/top_k/top_p/seed);
             # --long_context composes: run_decode routes over-length
             # prefixes to the sp-mesh LongContextDecoder.
             from flexible_llm_sharding_tpu.runtime.orchestration import run_decode
@@ -255,6 +260,7 @@ def main(argv: list[str] | None = None, tokenizer=None) -> None:
                 cfg.num_gen_token,
                 tokenizer,
                 temperature=args.temperature,
+                seed=args.seed,
                 top_k=args.top_k,
                 top_p=args.top_p,
             )

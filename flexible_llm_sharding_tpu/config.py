@@ -549,6 +549,13 @@ class FrameworkConfig:
     # the visible chips; cap becomes n_chips * max_token_len) instead of the
     # reference's silent truncation (/root/reference/utils.py:14,250,254).
     long_context: bool = False
+    # Sampling controls (generation_loop.sample_token semantics): 0 = greedy
+    # argmax (exact reference behaviour, /root/reference/main.py:47-48 left
+    # the temperature flag commented out). Deterministic given seed.
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 0.0
+    seed: int = 0
 
     def __post_init__(self) -> None:
         loc = self.storage_location
@@ -575,6 +582,9 @@ class FrameworkConfig:
                 "(stream one model sharded across chips, OR one replica per "
                 "chip — not both in this executor)"
             )
+        if (self.top_k or self.top_p) and self.temperature <= 0:
+            # Silent no-op filters would masquerade as sampling.
+            raise ValueError("top_k/top_p require temperature > 0")
 
     def effective_prefetch_depth(self) -> int:
         """Resolve the tri-state ``prefetch_depth``: explicit value, or auto —

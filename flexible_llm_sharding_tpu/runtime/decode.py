@@ -278,6 +278,23 @@ class DecodeGenerator:
         all_scores: dict[int, list[np.ndarray]] = {b: [] for b in range(len(blocks))}
         tok_hist: dict[int, list[np.ndarray]] = {b: [] for b in range(len(blocks))}
 
+        # Token selection: greedy argmax (default), or temperature/top-k/
+        # top-p sampling (deterministic per cfg.seed; padded suffix rows
+        # never advance the rng). Scores stay the RAW distributions.
+        from flexible_llm_sharding_tpu.runtime.generation import make_picker
+
+        picker = make_picker(cfg)
+        real_rows = {
+            b: np.array(
+                [
+                    [si < toks[i].num_suffixes for si in range(toks[idxs[0]].suffix_ids.shape[0])]
+                    for i in idxs
+                ]
+            )
+            for b, idxs in enumerate(blocks)
+        }
+        pick = lambda dist, b: picker(dist, real=real_rows[b])  # noqa: E731
+
         # --- prefill: one streaming pass, capturing KV -------------------
         source = self._source()
         try:
@@ -337,7 +354,7 @@ class DecodeGenerator:
                         else:  # head
                             dist = np.asarray(jax.device_get(_head_block(self.model_cfg, params, sh)))
                             all_scores[b].append(dist)
-                            tok_hist[b].append(np.argmax(dist, axis=-1))
+                            tok_hist[b].append(pick(dist, b))
                     if layer_idxs[-1] != n_layers - 1:
                         kv_store.put(("h", b), (ph, sh))
         finally:
@@ -395,7 +412,7 @@ class DecodeGenerator:
                                     )
                                 )
                                 all_scores[b].append(dist)
-                                tok_hist[b].append(np.argmax(dist, axis=-1))
+                                tok_hist[b].append(pick(dist, b))
                         if layer_idxs[-1] != n_layers - 1:
                             kv_store.put(("x", b), x)
             finally:

@@ -59,6 +59,33 @@ def sample_token(
     return int(rng.choice(dist.shape[-1], p=p))
 
 
+def make_picker(cfg, rng: np.random.Generator | None = None):
+    """Token selector shared by the KV-decode paths: greedy argmax when
+    ``cfg.temperature`` is 0, else per-row :func:`sample_token` with the
+    config's temperature/top_k/top_p (ONE rng, seeded from ``cfg.seed``,
+    advanced in row-major order — deterministic per seed).
+
+    The returned ``pick(dist, real=None)`` maps ``[..., V]`` distributions
+    to ``[...]`` int tokens. ``real`` (bool, broadcast to the leading shape)
+    marks rows whose token is actually consumed: padded suffix rows fall
+    back to argmax WITHOUT advancing the rng, so real-token draws don't
+    depend on unrelated batch composition or bucket padding."""
+    if cfg.temperature <= 0:
+        return lambda dist, real=None: np.argmax(dist, axis=-1)
+    rng = rng if rng is not None else np.random.default_rng(cfg.seed)
+
+    def pick(dist: np.ndarray, real=None) -> np.ndarray:
+        out = np.argmax(dist, axis=-1)
+        for idx in np.ndindex(*dist.shape[:-1]):
+            if real is None or real[idx]:
+                out[idx] = sample_token(
+                    dist[idx], rng, cfg.temperature, cfg.top_k, cfg.top_p
+                )
+        return out
+
+    return pick
+
+
 def generation_loop(
     run_fn: RunFn,
     prompts: Sequence[Prompt],

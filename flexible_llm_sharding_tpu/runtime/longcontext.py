@@ -486,10 +486,17 @@ class LongContextDecoder(LongContextScorer):
         source = self._make_source(max(len(prompts), 1) * n_gen)
         stream = iter(source)
         scores_out, updated, tokens = [], [], 0.0
+        # Greedy argmax (default) or temperature/top-k/top-p sampling via
+        # the shared picker; ONE rng for the batch (deterministic per
+        # cfg.seed; dists here are already sliced to real suffixes). Scores
+        # stay the raw distributions either way.
+        from flexible_llm_sharding_tpu.runtime.generation import make_picker
+
+        pick = make_picker(self.cfg)
         try:
             for prefix, suffixes in prompts:
                 dists, hist, tp = self._generate_one(
-                    prefix, suffixes, stream, n_gen
+                    prefix, suffixes, stream, n_gen, pick
                 )
                 scores_out.append(dists)
                 updated.append(
@@ -511,7 +518,9 @@ class LongContextDecoder(LongContextScorer):
         }
         return scores_out, updated, int(tokens)
 
-    def _generate_one(self, prefix: str, suffixes: tuple, stream, n_gen: int):
+    def _generate_one(
+        self, prefix: str, suffixes: tuple, stream, n_gen: int, pick
+    ):
         t = self.tokenizer(prefix, suffixes)
         lp = bucket_len(
             len(t.prefix_ids), self.cfg.bucket_multiple * self.sp, self.cap
@@ -576,8 +585,9 @@ class LongContextDecoder(LongContextScorer):
                     )
 
         # --- decode steps: one token per suffix per stream ----------------
+        hist_rows = [pick(dists[-1])]  # [S_true] per emitted step
         for step in range(n_gen - 1):
-            last = dists[-1].argmax(axis=-1)  # [S_true]
+            last = hist_rows[-1]  # [S_true]
             ids = np.full((s_cnt, 1), int(last[0]) if len(last) else 0, np.int64)
             ids[: t.num_suffixes, 0] = last
             ids = jax.device_put(jnp.asarray(ids), self._rep)
@@ -620,8 +630,9 @@ class LongContextDecoder(LongContextScorer):
                                 )
                             )[: t.num_suffixes]
                         )
+            hist_rows.append(pick(dists[-1]))
 
-        hist = np.stack([d.argmax(axis=-1) for d in dists], axis=1)  # [S, n_gen]
+        hist = np.stack(hist_rows, axis=1)  # [S, n_gen]
         scores = np.stack(dists, axis=1)  # [S_true, n_gen, V]
         tokens = float(
             t.tokens_processed + t.num_suffixes * max(n_gen - 1, 0)

@@ -89,6 +89,42 @@ def test_decode_matches_token_level_oracle(tiny_cfg, model, storage, lnps):
             assert new.startswith(orig) and len(new) > len(orig)
 
 
+def test_decode_sampling_deterministic(tiny_cfg, model):
+    """temperature/top-k/top-p sampling in KV decode: deterministic per
+    seed, raw distributions unchanged (step 0 equals the greedy run's),
+    suffixes still grow."""
+    import dataclasses
+
+    model_dir, _ = model
+    fw = FrameworkConfig(
+        model_path=model_dir,
+        dtype="float32",
+        bucket_multiple=8,
+        block_size=2,
+        prefetch_depth=0,
+        num_gen_token=3,
+        temperature=0.8,
+        top_k=20,
+        top_p=0.95,
+        seed=3,
+    )
+    a, ua = DecodeGenerator(fw, tokenizer=FakeTokenizer())(list(PROMPTS))
+    b, ub = DecodeGenerator(fw, tokenizer=FakeTokenizer())(list(PROMPTS))
+    assert ua == ub
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(x, y)
+
+    g, _ = DecodeGenerator(
+        dataclasses.replace(fw, temperature=0.0, top_k=0, top_p=0.0),
+        tokenizer=FakeTokenizer(),
+    )(list(PROMPTS))
+    for x, y in zip(a, g):
+        np.testing.assert_allclose(x[:, 0], y[:, 0], rtol=1e-6)
+    for (_, sfx), (_, usfx) in zip(PROMPTS, ua):
+        for orig, new in zip(sfx, usfx):
+            assert new.startswith(orig) and len(new) > len(orig)
+
+
 def test_decode_flash_kernel_matches_oracle(tmp_path_factory):
     """KV decode with the flash decode kernel (use_pallas=True, interpret on
     the CPU mesh): per-step distributions and greedy tokens must match the

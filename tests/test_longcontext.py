@@ -275,6 +275,37 @@ def test_long_context_kv_decode(model_dir, tiny_cfg):
     assert tokens > 0
 
 
+def test_long_context_kv_decode_sampling(model_dir):
+    """Sampling through the sp-mesh decoder: deterministic per seed, raw
+    step-0 distributions equal the greedy run's, suffixes grow."""
+    import dataclasses
+
+    from flexible_llm_sharding_tpu.runtime.orchestration import run_decode
+
+    cfg = _cfg(
+        model_dir, max_token_len=64, long_context=True, num_gen_token=3,
+        temperature=0.8, top_k=20, top_p=0.95, seed=5,
+    )
+    a, ua, _ = run_decode(
+        cfg, PROMPTS[:1], tokenizer=FakeTokenizer(), devices=jax.devices()[:4]
+    )
+    b, ub, _ = run_decode(
+        cfg, PROMPTS[:1], tokenizer=FakeTokenizer(), devices=jax.devices()[:4]
+    )
+    assert ua == ub
+    np.testing.assert_array_equal(a[0], b[0])
+    g, _, _ = run_decode(
+        dataclasses.replace(cfg, temperature=0.0, top_k=0, top_p=0.0),
+        PROMPTS[:1],
+        tokenizer=FakeTokenizer(),
+        devices=jax.devices()[:4],
+    )
+    np.testing.assert_allclose(a[0][:, 0], g[0][:, 0], rtol=1e-6)
+    for (_, sfx), (_, usfx) in zip(PROMPTS[:1], ua):
+        for orig, new in zip(sfx, usfx):
+            assert new.startswith(orig) and len(new) > len(orig)
+
+
 def test_long_context_kv_decode_windowed(tiny_cfg, tmp_path_factory):
     """The decode-side window clauses (sharded prefix partials, suffix and
     generated regions all carry absolute positions): a binding Mistral-style
