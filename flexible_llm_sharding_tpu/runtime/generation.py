@@ -75,7 +75,13 @@ def make_picker(cfg, rng: np.random.Generator | None = None):
     rng = rng if rng is not None else np.random.default_rng(cfg.seed)
 
     def pick(dist: np.ndarray, real=None) -> np.ndarray:
-        out = np.argmax(dist, axis=-1)
+        # argmax only where a padded row needs a placeholder; every real
+        # row's entry is overwritten by its draw.
+        out = (
+            np.empty(dist.shape[:-1], np.int64)
+            if real is None
+            else np.argmax(dist, axis=-1)
+        )
         for idx in np.ndindex(*dist.shape[:-1]):
             if real is None or real[idx]:
                 out[idx] = sample_token(

@@ -278,6 +278,107 @@ class StreamedTrainer:
 
         save_params(self.params, out_dir, self.cfg)
 
+    # -- full train-state checkpointing (params + moments + step) -----------
+    def save_state(self, out_dir: str) -> None:
+        """Durable train state: the native per-layer params checkpoint plus
+        one ``opt-<segment>.npz`` per segment holding its AdamW moments and
+        a ``train_state.json`` with the step counter — everything needed to
+        resume training after a crash, written segment-by-segment (host RAM
+        never holds a second copy of the model).
+
+        ATOMIC against the crash it exists for: everything is written into a
+        ``.tmp`` sibling and swapped into place only when complete, so a
+        crash mid-save can never pair new params with stale moments (or
+        destroy the previous checkpoint)."""
+        import json
+        import os
+        import shutil
+
+        tmp = out_dir.rstrip("/\\") + ".tmp"
+        shutil.rmtree(tmp, ignore_errors=True)
+        self.save(tmp)
+
+        def dump(name: str, state) -> None:
+            # np.savez silently mangles ml_dtypes (bfloat16 -> raw '|V2');
+            # widen such leaves to float32 (exact) and restore re-narrows to
+            # the template leaf's dtype.
+            def savable(x):
+                x = np.asarray(x)
+                return (
+                    x.astype(np.float32)
+                    if x.dtype.kind == "V" or x.dtype.name in ("bfloat16", "float16")
+                    else x
+                )
+
+            leaves, _ = jax.tree.flatten(state)
+            np.savez(
+                os.path.join(tmp, f"opt-{name}.npz"),
+                **{f"l{i}": savable(x) for i, x in enumerate(leaves)},
+            )
+
+        dump("embed", self.opt_state["embed"])
+        dump("norm", self.opt_state["norm"])
+        dump("lm_head", self.opt_state["lm_head"])
+        for i, s in enumerate(self.opt_state["layers"]):
+            dump(f"layer{i}", s)
+        with open(os.path.join(tmp, "train_state.json"), "w") as f:
+            json.dump({"step": self.step_count}, f)
+
+        if os.path.isdir(out_dir):
+            old = out_dir.rstrip("/\\") + ".old"
+            shutil.rmtree(old, ignore_errors=True)
+            os.rename(out_dir, old)
+            os.rename(tmp, out_dir)
+            shutil.rmtree(old, ignore_errors=True)
+        else:
+            os.rename(tmp, out_dir)
+
+    def restore_state(self, ckpt_dir: str) -> None:
+        """Resume from :meth:`save_state`: reload params layer-by-layer and
+        every segment's moments + the step counter. The trainer must have
+        been constructed with the same optimizer recipe (the moment pytree
+        structures must match)."""
+        import json
+        import os
+
+        from flexible_llm_sharding_tpu.utils import checkpoint
+
+        self.params["embed"] = checkpoint.load_layer(ckpt_dir, "model.embed_tokens")
+        self.params["norm"] = checkpoint.load_layer(ckpt_dir, "model.norm")
+        self.params["lm_head"] = checkpoint.load_layer(ckpt_dir, "lm_head")
+        for i in range(self.cfg.num_hidden_layers):
+            self.params["layers"][i] = checkpoint.load_layer(
+                ckpt_dir, f"model.layers.{i}"
+            )
+
+        def load(name: str, template):
+            data = np.load(os.path.join(ckpt_dir, f"opt-{name}.npz"))
+            leaves, treedef = jax.tree.flatten(template)
+            if len(data.files) != len(leaves):
+                raise ValueError(
+                    f"opt-{name}.npz has {len(data.files)} leaves, trainer "
+                    f"expects {len(leaves)} — different optimizer recipe?"
+                )
+            # Re-narrow to the template's dtype (save widened bf16/fp16
+            # moments to float32, which is exact in that direction).
+            return jax.tree.unflatten(
+                treedef,
+                [
+                    data[f"l{i}"].astype(np.asarray(t).dtype)
+                    for i, t in enumerate(leaves)
+                ],
+            )
+
+        self.opt_state["embed"] = load("embed", self.opt_state["embed"])
+        self.opt_state["norm"] = load("norm", self.opt_state["norm"])
+        self.opt_state["lm_head"] = load("lm_head", self.opt_state["lm_head"])
+        for i in range(self.cfg.num_hidden_layers):
+            self.opt_state["layers"][i] = load(
+                f"layer{i}", self.opt_state["layers"][i]
+            )
+        with open(os.path.join(ckpt_dir, "train_state.json")) as f:
+            self.step_count = int(json.load(f)["step"])
+
 
 # Re-exported for symmetry with training.py's surface.
 __all__ = ["StreamedTrainer"]

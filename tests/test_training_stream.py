@@ -128,6 +128,75 @@ def test_streamed_from_checkpoint_roundtrip(tiny_cfg, rng, tmp_path):
     _assert_params_close(reloaded.params, tr.params, rtol=0, atol=0)
 
 
+def test_streamed_state_checkpoint_resume(tiny_cfg, rng, tmp_path):
+    """Crash-resume for streamed training: save_state after step 1, restore
+    into a FRESH trainer, run step 2 — params must equal the uninterrupted
+    two-step run exactly (moments and step counter survived)."""
+    params = jax.tree.map(
+        np.asarray, llama.init_params(jax.random.PRNGKey(7), tiny_cfg)
+    )
+    t1 = rng.integers(1, tiny_cfg.vocab_size, size=(2, 11)).astype(np.int32)
+    t2 = rng.integers(1, tiny_cfg.vocab_size, size=(2, 11)).astype(np.int32)
+
+    straight = StreamedTrainer(
+        tiny_cfg, params, lr=LR, grad_clip=CLIP, weight_decay=WD
+    )
+    straight.step(t1)
+    straight.step(t2)
+
+    tr = StreamedTrainer(tiny_cfg, params, lr=LR, grad_clip=CLIP, weight_decay=WD)
+    tr.step(t1)
+    ck = tmp_path / "state"
+    tr.save_state(str(ck))
+
+    resumed = StreamedTrainer(
+        tiny_cfg,
+        jax.tree.map(np.zeros_like, params),  # garbage start: restore must win
+        lr=LR,
+        grad_clip=CLIP,
+        weight_decay=WD,
+    )
+    resumed.restore_state(str(ck))
+    assert resumed.step_count == 1
+    resumed.step(t2)
+
+    np.testing.assert_allclose(
+        jax.tree.leaves(resumed.params)[0], jax.tree.leaves(straight.params)[0]
+    )
+    _assert_params_close(resumed.params, straight.params, rtol=1e-7, atol=1e-8)
+
+
+def test_streamed_state_checkpoint_bf16(tiny_cfg, rng, tmp_path):
+    """bf16 params/moments survive the npz round trip (np.savez mangles
+    ml_dtypes to raw void bytes; save widens to float32 — exact — and
+    restore re-narrows to the template dtype). Also: saving twice into the
+    same dir swaps atomically instead of mixing generations."""
+    params = jax.tree.map(
+        lambda a: np.asarray(a, jnp.bfloat16),
+        llama.init_params(jax.random.PRNGKey(8), tiny_cfg),
+    )
+    tokens = rng.integers(1, tiny_cfg.vocab_size, size=(1, 9)).astype(np.int32)
+    tr = StreamedTrainer(tiny_cfg, params, lr=LR, dtype=jnp.bfloat16)
+    tr.step(tokens)
+    ck = tmp_path / "state"
+    tr.save_state(str(ck))
+    tr.step(tokens)
+    tr.save_state(str(ck))  # overwrite: tmp-swap path
+
+    resumed = StreamedTrainer(tiny_cfg, params, lr=LR, dtype=jnp.bfloat16)
+    resumed.restore_state(str(ck))
+    assert resumed.step_count == 2
+    for got, want in zip(
+        jax.tree.leaves(resumed.opt_state), jax.tree.leaves(tr.opt_state)
+    ):
+        got, want = np.asarray(got), np.asarray(want)
+        assert got.dtype == want.dtype
+        np.testing.assert_array_equal(
+            got.astype(np.float32), want.astype(np.float32)
+        )
+    resumed.step(tokens)  # moments usable: the resumed update runs
+
+
 def test_streamed_rejects_tied(tiny_cfg):
     cfg = dataclasses.replace(tiny_cfg, tie_word_embeddings=True)
     params = llama.init_params(jax.random.PRNGKey(4), cfg)
