@@ -172,12 +172,20 @@ class LlamaConfig:
         return float(base) ** -0.5
     # RoPE scaling, flattened to hashable fields (the config must stay a
     # frozen/hashable jit static arg): kind None = unscaled, or
-    # 'linear' (Llama-2 long) / 'llama3' (Llama-3.1+ frequency bands).
+    # 'linear' (Llama-2 long) / 'llama3' (Llama-3.1+ frequency bands) /
+    # 'yarn' (NTK-by-parts: Qwen2.5-long / DeepSeek-style checkpoints).
     rope_scaling_kind: str | None = None
     rope_scaling_factor: float = 1.0
     rope_low_freq_factor: float = 1.0
     rope_high_freq_factor: float = 4.0
     rope_original_max_position: int = 8192
+    # yarn-only: ramp boundaries, the cos/sin attention factor (resolved at
+    # parse time from attention_factor / mscale / mscale_all_dim / factor),
+    # and whether the correction range truncates to whole dims (HF default).
+    rope_beta_fast: float = 32.0
+    rope_beta_slow: float = 1.0
+    rope_attention_factor: float = 1.0
+    rope_truncate: bool = True
 
     @property
     def head_dim(self) -> int:
@@ -192,6 +200,16 @@ class LlamaConfig:
             return None
         if self.rope_scaling_kind == "linear":
             return ("linear", self.rope_scaling_factor)
+        if self.rope_scaling_kind == "yarn":
+            return (
+                "yarn",
+                self.rope_scaling_factor,
+                self.rope_beta_fast,
+                self.rope_beta_slow,
+                self.rope_original_max_position,
+                self.rope_attention_factor,
+                self.rope_truncate,
+            )
         return (
             "llama3",
             self.rope_scaling_factor,
@@ -478,18 +496,43 @@ class LlamaConfig:
         rs = d.get("rope_scaling") or {}
         if rs:
             kind = rs.get("rope_type", rs.get("type"))
-            if kind not in ("linear", "llama3"):
+            if kind not in ("linear", "llama3", "yarn"):
                 raise NotImplementedError(
                     f"rope_scaling type {kind!r} is not supported yet"
                 )
+            factor = float(rs.get("factor", 1.0))
             kwargs["rope_scaling_kind"] = kind
-            kwargs["rope_scaling_factor"] = float(rs.get("factor", 1.0))
+            kwargs["rope_scaling_factor"] = factor
             if kind == "llama3":
                 kwargs["rope_low_freq_factor"] = float(rs.get("low_freq_factor", 1.0))
                 kwargs["rope_high_freq_factor"] = float(rs.get("high_freq_factor", 4.0))
                 kwargs["rope_original_max_position"] = int(
                     rs.get("original_max_position_embeddings", 8192)
                 )
+            elif kind == "yarn":
+                import math
+
+                kwargs["rope_beta_fast"] = float(rs.get("beta_fast") or 32)
+                kwargs["rope_beta_slow"] = float(rs.get("beta_slow") or 1)
+                kwargs["rope_truncate"] = bool(rs.get("truncate", True))
+                kwargs["rope_original_max_position"] = int(
+                    rs.get("original_max_position_embeddings")
+                    or d.get("max_position_embeddings", 2048)
+                )
+                # HF _compute_yarn_parameters: attention_factor wins; else
+                # derived from factor (and DeepSeek's mscale pair).
+                af = rs.get("attention_factor")
+                if af is None:
+                    def get_mscale(scale, m=1.0):
+                        return 1.0 if scale <= 1 else 0.1 * m * math.log(scale) + 1.0
+
+                    ms, mad = rs.get("mscale"), rs.get("mscale_all_dim")
+                    af = (
+                        get_mscale(factor, ms) / get_mscale(factor, mad)
+                        if ms and mad
+                        else get_mscale(factor)
+                    )
+                kwargs["rope_attention_factor"] = float(af)
         return cls(**kwargs)
 
     @classmethod

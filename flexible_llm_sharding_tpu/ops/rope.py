@@ -43,9 +43,47 @@ def _inv_freq(
             freq = np.where(
                 wavelen < high_wl, freq, np.where(wavelen > low_wl, freq / factor, interp)
             )
+        elif kind == "yarn":
+            # transformers _compute_yarn_parameters (NTK-by-parts,
+            # arXiv:2309.00071): high-frequency dims extrapolate (keep the
+            # base frequencies), low-frequency dims interpolate (divide by
+            # `factor`), with a linear ramp between the correction dims
+            # derived from beta_fast/beta_slow rotations at the original
+            # context length. The attention factor rides the spec and is
+            # applied to cos/sin in rope_cos_sin.
+            (_, factor, beta_fast, beta_slow, orig_max, _af, truncate) = scaling
+
+            def correction_dim(num_rot):
+                return (
+                    head_dim
+                    * np.log(orig_max / (num_rot * 2.0 * np.pi))
+                    / (2.0 * np.log(theta))
+                )
+
+            low, high = correction_dim(beta_fast), correction_dim(beta_slow)
+            if truncate:
+                low, high = np.floor(low), np.ceil(high)
+            low, high = max(low, 0.0), min(high, head_dim - 1.0)
+            if low == high:
+                high += 0.001  # prevent singularity (HF linear_ramp_factor)
+            ramp = np.clip(
+                (np.arange(head_dim // 2, dtype=np.float64) - low) / (high - low),
+                0.0,
+                1.0,
+            )
+            extrap_factor = 1.0 - ramp
+            freq = (freq / factor) * (1.0 - extrap_factor) + freq * extrap_factor
         else:  # pragma: no cover — config parsing rejects unknown kinds
             raise NotImplementedError(f"rope scaling kind {kind!r}")
     return freq.astype(np.float32)
+
+
+def rope_attention_scale(scaling: tuple | None) -> float:
+    """Post-processing factor HF applies to the cos/sin tables (yarn's
+    attention/mscale factor; 1.0 for every other kind)."""
+    if scaling is not None and scaling[0] == "yarn":
+        return float(scaling[5])
+    return 1.0
 
 
 def rope_cos_sin(
@@ -62,6 +100,9 @@ def rope_cos_sin(
     """
     freqs = jnp.asarray(_inv_freq(head_dim, theta, scaling))
     angles = positions.astype(jnp.float32)[..., None] * freqs
+    att = rope_attention_scale(scaling)
+    if att != 1.0:  # yarn: cos/sin scaled by the attention factor
+        return jnp.cos(angles) * att, jnp.sin(angles) * att
     return jnp.cos(angles), jnp.sin(angles)
 
 

@@ -300,15 +300,14 @@ class StreamedTrainer:
 
         def dump(name: str, state) -> None:
             # np.savez silently mangles ml_dtypes (bfloat16 -> raw '|V2');
-            # widen such leaves to float32 (exact) and restore re-narrows to
-            # the template leaf's dtype.
+            # store a same-width uint view instead (zero growth, exact) and
+            # restore reinterprets to the template leaf's dtype — the same
+            # trick as activations._save_npy/_load_npy.
             def savable(x):
                 x = np.asarray(x)
-                return (
-                    x.astype(np.float32)
-                    if x.dtype.kind == "V" or x.dtype.name in ("bfloat16", "float16")
-                    else x
-                )
+                if x.dtype.isbuiltin == 0:  # extension dtype (bf16, fp8)
+                    return x.view(np.dtype(f"u{x.dtype.itemsize}"))
+                return x
 
             leaves, _ = jax.tree.flatten(state)
             np.savez(
@@ -343,6 +342,13 @@ class StreamedTrainer:
 
         from flexible_llm_sharding_tpu.utils import checkpoint
 
+        if not os.path.isdir(ckpt_dir):
+            # A crash BETWEEN save_state's two renames leaves the complete
+            # previous checkpoint parked at the '.old' sibling; recover it.
+            old = ckpt_dir.rstrip("/\\") + ".old"
+            if os.path.isdir(old):
+                os.rename(old, ckpt_dir)
+
         self.params["embed"] = checkpoint.load_layer(ckpt_dir, "model.embed_tokens")
         self.params["norm"] = checkpoint.load_layer(ckpt_dir, "model.norm")
         self.params["lm_head"] = checkpoint.load_layer(ckpt_dir, "lm_head")
@@ -359,14 +365,19 @@ class StreamedTrainer:
                     f"opt-{name}.npz has {len(data.files)} leaves, trainer "
                     f"expects {len(leaves)} — different optimizer recipe?"
                 )
-            # Re-narrow to the template's dtype (save widened bf16/fp16
-            # moments to float32, which is exact in that direction).
+            def restore_leaf(a, t):
+                td = np.asarray(t).dtype
+                if (
+                    a.dtype != td
+                    and a.dtype.kind in "uV"
+                    and a.dtype.itemsize == td.itemsize
+                ):
+                    return a.view(td)  # uint view written by dump()
+                return a if a.dtype == td else a.astype(td)
+
             return jax.tree.unflatten(
                 treedef,
-                [
-                    data[f"l{i}"].astype(np.asarray(t).dtype)
-                    for i, t in enumerate(leaves)
-                ],
+                [restore_leaf(data[f"l{i}"], t) for i, t in enumerate(leaves)],
             )
 
         self.opt_state["embed"] = load("embed", self.opt_state["embed"])

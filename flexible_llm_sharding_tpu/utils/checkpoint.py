@@ -661,6 +661,10 @@ def save_params(params: dict[str, Any], out_dir: str, cfg: LlamaConfig) -> None:
         "rope_low_freq_factor": cfg.rope_low_freq_factor,
         "rope_high_freq_factor": cfg.rope_high_freq_factor,
         "rope_original_max_position": cfg.rope_original_max_position,
+        "rope_beta_fast": cfg.rope_beta_fast,
+        "rope_beta_slow": cfg.rope_beta_slow,
+        "rope_attention_factor": cfg.rope_attention_factor,
+        "rope_truncate": cfg.rope_truncate,
     }
     if cfg.explicit_head_dim is not None:
         hf_cfg["head_dim"] = cfg.explicit_head_dim

@@ -42,6 +42,15 @@ LLAMA3_SCALING = {
 }
 
 
+YARN_SCALING = {
+    "rope_type": "yarn",
+    "factor": 4.0,
+    "beta_fast": 32,
+    "beta_slow": 1,
+    "original_max_position_embeddings": 128,
+}
+
+
 def test_config_parses_llama3_scaling(tiny_cfg):
     cfg = LlamaConfig.from_hf_config(
         {"hidden_size": 64, "num_attention_heads": 4, "rope_scaling": LLAMA3_SCALING}
@@ -52,7 +61,43 @@ def test_config_parses_llama3_scaling(tiny_cfg):
     )
     assert cfg2.rope_scaling_spec == ("linear", 2.0)
     with pytest.raises(NotImplementedError):
-        LlamaConfig.from_hf_config({"rope_scaling": {"rope_type": "yarn"}})
+        LlamaConfig.from_hf_config({"rope_scaling": {"rope_type": "longrope"}})
+
+
+def test_config_parses_yarn_scaling():
+    import math
+
+    cfg = LlamaConfig.from_hf_config(
+        {"hidden_size": 64, "num_attention_heads": 4, "rope_scaling": YARN_SCALING}
+    )
+    want_af = 0.1 * math.log(4.0) + 1.0  # derived from factor
+    assert cfg.rope_scaling_spec == ("yarn", 4.0, 32.0, 1.0, 128, want_af, True)
+    # Explicit attention_factor wins; DeepSeek's mscale pair derives a ratio.
+    cfg2 = LlamaConfig.from_hf_config(
+        {"rope_scaling": dict(YARN_SCALING, attention_factor=1.25)}
+    )
+    assert cfg2.rope_attention_factor == 1.25
+    cfg3 = LlamaConfig.from_hf_config(
+        {"rope_scaling": dict(YARN_SCALING, mscale=0.707, mscale_all_dim=0.707)}
+    )
+    assert cfg3.rope_attention_factor == pytest.approx(1.0)
+
+
+def test_inv_freq_matches_hf_yarn(tiny_cfg):
+    _, hf_cfg = _mk_hf(tiny_cfg, YARN_SCALING)
+    from transformers.modeling_rope_utils import ROPE_INIT_FUNCTIONS
+
+    from flexible_llm_sharding_tpu.ops.rope import rope_attention_scale
+
+    want, want_af = ROPE_INIT_FUNCTIONS["yarn"](hf_cfg, device="cpu")
+    cfg = LlamaConfig.from_hf_config(hf_cfg.to_dict())
+    got = _inv_freq(
+        tiny_cfg.hidden_size // tiny_cfg.num_attention_heads,
+        500000.0,
+        cfg.rope_scaling_spec,
+    )
+    np.testing.assert_allclose(got, want.numpy(), rtol=1e-6, atol=0)
+    assert rope_attention_scale(cfg.rope_scaling_spec) == pytest.approx(want_af)
 
 
 def test_inv_freq_matches_hf_llama3(tiny_cfg):
@@ -73,12 +118,16 @@ def test_inv_freq_matches_hf_llama3(tiny_cfg):
     [
         (LLAMA3_SCALING, ("llama3", 8.0, 1.0, 4.0, 128)),
         ({"rope_type": "linear", "factor": 4.0}, ("linear", 4.0)),
+        (YARN_SCALING, None),  # spec carries a derived float: checked by kind
     ],
 )
 def test_forward_matches_hf_with_scaling(tiny_cfg, rng, scaling, spec):
     model, hf_cfg = _mk_hf(tiny_cfg, scaling)
     cfg = LlamaConfig.from_hf_config(hf_cfg.to_dict())
-    assert cfg.rope_scaling_spec == spec
+    if spec is not None:
+        assert cfg.rope_scaling_spec == spec
+    else:
+        assert cfg.rope_scaling_spec[0] == scaling["rope_type"]
     params = _params_from_hf(model, cfg)
     ids = rng.integers(0, cfg.vocab_size, size=(2, 33))
     with torch.no_grad():
