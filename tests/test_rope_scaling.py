@@ -113,6 +113,51 @@ def test_inv_freq_matches_hf_llama3(tiny_cfg):
     np.testing.assert_allclose(got, want.numpy(), rtol=1e-6, atol=0)
 
 
+def test_yarn_split_and_cli(tiny_cfg, tmp_path):
+    """yarn checkpoint end-to-end: HF save_pretrained -> splitter (foreign
+    config parse) -> streaming CLI scores vs the HF oracle."""
+    import os
+    import pickle
+
+    from flexible_llm_sharding_tpu import cli
+    from flexible_llm_sharding_tpu.runtime.tokenization import PromptTokenizer
+    from flexible_llm_sharding_tpu.utils import checkpoint as ckpt
+
+    from tests.fake_tokenizer import FakeTokenizer
+
+    model, _ = _mk_hf(tiny_cfg, YARN_SCALING)
+    src = tmp_path / "hf"
+    model.save_pretrained(str(src))
+    out = tmp_path / "native"
+    ckpt.split_into_layers(str(src), str(out))
+
+    prompts = [("The capital of France", (" is Paris", " is Rome"))]
+    ppkl, opkl = tmp_path / "p.pkl", tmp_path / "s.pkl"
+    with open(ppkl, "wb") as f:
+        pickle.dump(prompts, f)
+    cli.main(
+        ["--model_path", str(out), "--prompt_pickle", str(ppkl),
+         "--output_file", str(opkl), "--dtype", "float32",
+         "--num_gen_token", "1"],
+        tokenizer=FakeTokenizer(),
+    )
+    with open(opkl, "rb") as f:
+        got = pickle.load(f)
+
+    tok = PromptTokenizer(FakeTokenizer(), bucket_multiple=64)
+    t = tok(*prompts[0])
+    for s in range(t.num_suffixes):
+        full = np.concatenate(
+            [t.prefix_ids[: t.prefix_len], t.suffix_ids[s, : int(t.suffix_eos[s]) + 1]]
+        ).astype(np.int64)
+        with torch.no_grad():
+            want = torch.softmax(
+                model(torch.tensor(full[None])).logits[0, -1].float(), -1
+            ).numpy()
+        np.testing.assert_allclose(got[0][s, 0], want, rtol=3e-4, atol=3e-5)
+    assert os.path.exists(out / "config.json")
+
+
 @pytest.mark.parametrize(
     "scaling,spec",
     [
