@@ -5,10 +5,13 @@
 # a subprocess so a wedged tunnel can't hang the watcher itself.
 cd /root/repo
 while true; do
-  if timeout 90 python -c "import jax.numpy as j; (j.ones((64,64))@j.ones((64,64))).sum().block_until_ready()" >/dev/null 2>&1; then
+  # -k: a wedged tunnel probe can ignore SIGTERM for many minutes; escalate
+  # to SIGKILL so one stuck probe can't stall the whole retry loop.
+  if timeout -k 10 90 python -c "import jax.numpy as j; (j.ones((64,64))@j.ones((64,64))).sum().block_until_ready()" >/dev/null 2>&1; then
     echo "$(date -u +%H:%M:%S) tunnel up - running bench" >> /tmp/hw_watcher.log
-    BENCH_DEADLINE_S=2400 timeout 2700 python bench.py > /tmp/bench_hw.json 2> /tmp/bench_hw.log
-    echo "$(date -u +%H:%M:%S) bench rc=$? $(cat /tmp/bench_hw.json)" >> /tmp/hw_watcher.log
+    BENCH_DEADLINE_S=2400 timeout -k 10 2700 python bench.py > /tmp/bench_hw.json 2> /tmp/bench_hw.log
+    rc=$?  # save BEFORE the $(date)/$(cat) substitutions reset $?
+    echo "$(date -u +%H:%M:%S) bench rc=$rc $(cat /tmp/bench_hw.json)" >> /tmp/hw_watcher.log
     # Only spend scale-demo time if bench really ran on TPU *and produced a
     # number*: a deadline-partial emission carries platform=tpu with null
     # values when the tunnel wedged mid-run — following it with a 2h
@@ -18,7 +21,7 @@ while true; do
     # fold into their JSON.
     if python -c "import json,sys; d=json.load(open('/tmp/bench_hw.json')); sys.exit(0 if d.get('platform')=='tpu' and d.get('value') is not None else 1)" 2>/dev/null; then
       echo "$(date -u +%H:%M:%S) running scale_demo" >> /tmp/hw_watcher.log
-      timeout 7200 python scale_demo.py > /tmp/scale_hw.log 2>&1
+      timeout -k 10 7200 python scale_demo.py > /tmp/scale_hw.log 2>&1
       rc=$?
       echo "$(date -u +%H:%M:%S) scale_demo rc=$rc artifact: $(ls -la SCALE_r03.json 2>/dev/null)" >> /tmp/hw_watcher.log
       # Only stop once the artifacts actually exist — a tunnel drop mid-run
