@@ -21,8 +21,14 @@ def device_memory_stats(device=None) -> dict[str, float]:
     memory_stats (CPU)."""
     import jax
 
-    device = device or jax.devices()[0]
-    stats = getattr(device, "memory_stats", lambda: None)()
+    # local_devices, not devices: on a multi-host cluster jax.devices()[0]
+    # is process 0's chip, and MemoryStats on a non-addressable device
+    # raises on every other rank.
+    device = device or jax.local_devices()[0]
+    try:
+        stats = getattr(device, "memory_stats", lambda: None)()
+    except Exception:  # tunnel-backed devices can also refuse the query
+        return {}
     if not stats:
         return {}
     out = {}
@@ -330,7 +336,7 @@ def measure_host_to_hbm_gbps(device=None, mb: int = 256) -> float:
 
     import numpy as np
 
-    device = device or jax.devices()[0]
+    device = device or jax.local_devices()[0]  # addressable on every rank
     buf = np.ones((mb, 1024, 1024 // 4), np.float32)
     a = jax.device_put(buf, device)  # warm: same shape/dtype as the timed put
     jax.device_get(a.sum())  # warm the readback compile too
@@ -344,7 +350,7 @@ def chip_peak_flops(device=None) -> float | None:
     """Peak bf16 FLOP/s for one chip, or None when unknown (CPU, new kinds)."""
     import jax
 
-    device = device or jax.devices()[0]
+    device = device or jax.local_devices()[0]  # addressable on every rank
     kind = (getattr(device, "device_kind", "") or "").lower()
     for token, peak in _PEAK_BF16_FLOPS:
         if token in kind:

@@ -21,6 +21,12 @@ import pytest
 from flexible_llm_sharding_tpu.config import LlamaConfig
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: spawns subprocesses / long-running integration tests"
+    )
+
+
 @pytest.fixture(scope="session")
 def tiny_cfg() -> LlamaConfig:
     return LlamaConfig(
