@@ -45,7 +45,8 @@ cli.main(
         "--prompt_pickle", {ppkl!r},
         "--output_file", {opkl!r},
         "--dtype", "float32",
-        "--num_gen_token", "1",
+        "--num_gen_token", {n_gen!r},
+        "--kv_cache", {kv!r},
         "--coordinator_address", {coord!r},
         "--num_processes", "2",
         "--process_id", sys.argv[1],
@@ -56,7 +57,8 @@ cli.main(
 
 
 @pytest.mark.slow
-def test_two_process_cluster_matches_single(tiny_cfg, tmp_path):
+@pytest.mark.parametrize("kv_cache", [False, True])
+def test_two_process_cluster_matches_single(tiny_cfg, tmp_path, kv_cache):
     params = llama.init_params(jax.random.PRNGKey(0), tiny_cfg)
     model = tmp_path / "model"
     save_params(jax.tree.map(np.asarray, params), str(model), tiny_cfg)
@@ -78,6 +80,8 @@ def test_two_process_cluster_matches_single(tiny_cfg, tmp_path):
             ppkl=str(ppkl),
             opkl=str(opkl),
             coord=f"localhost:{port}",
+            n_gen="2" if kv_cache else "1",
+            kv="true" if kv_cache else "false",
         )
     )
     env = dict(
@@ -117,14 +121,22 @@ def test_two_process_cluster_matches_single(tiny_cfg, tmp_path):
         r1 = pickle.load(f)
     assert len(r0) == 2 and len(r1) == 1
 
-    want = run_prompts(
-        FrameworkConfig(
-            model_path=str(model), dtype="float32", prefetch_depth=0
-        ),
-        PROMPTS,
-        tokenizer=FakeTokenizer(),
-        devices=jax.devices()[:1],
+    cfg = FrameworkConfig(
+        model_path=str(model),
+        dtype="float32",
+        prefetch_depth=0,
+        num_gen_token=2 if kv_cache else 1,
     )
+    if kv_cache:
+        from flexible_llm_sharding_tpu.runtime.orchestration import run_decode
+
+        want, _, _ = run_decode(
+            cfg, PROMPTS, tokenizer=FakeTokenizer(), devices=jax.devices()[:1]
+        )
+    else:
+        want = run_prompts(
+            cfg, PROMPTS, tokenizer=FakeTokenizer(), devices=jax.devices()[:1]
+        )
     for got, exp in zip(r0 + r1, want):
         np.testing.assert_allclose(got[:, 0], np.asarray(exp)[:, 0], rtol=1e-5, atol=1e-6)
 
