@@ -256,21 +256,28 @@ class StreamedTrainer:
     @classmethod
     def from_pretrained(cls, model_path: str, dtype=jnp.float32, **kw):
         """Build from a native per-layer checkpoint dir (the splitter's
-        output) — layers are loaded one at a time, never all on device."""
+        output) — layers are loaded one at a time, never all on device.
+        int8 checkpoints dequantize at load (training needs real-valued
+        params for the optimizer; the int8 error becomes the fine-tune's
+        starting point)."""
         from flexible_llm_sharding_tpu.utils import checkpoint
+
+        def load(name: str) -> Params:
+            return checkpoint.dequantize_tree_np(
+                checkpoint.load_layer(model_path, name)
+            )
 
         cfg = LlamaConfig.from_pretrained(model_path)
         params: Params = {
-            "embed": checkpoint.load_layer(model_path, "model.embed_tokens"),
+            "embed": load("model.embed_tokens"),
             "layers": [
-                checkpoint.load_layer(model_path, f"model.layers.{i}")
-                for i in range(cfg.num_hidden_layers)
+                load(f"model.layers.{i}") for i in range(cfg.num_hidden_layers)
             ],
-            "norm": checkpoint.load_layer(model_path, "model.norm"),
+            "norm": load("model.norm"),
         }
         if not cfg.tie_word_embeddings:
-            params["lm_head"] = checkpoint.load_layer(model_path, "lm_head")
-        return cls(cfg, params, **kw)
+            params["lm_head"] = load("lm_head")
+        return cls(cfg, params, dtype=dtype, **kw)
 
     def save(self, out_dir: str) -> None:
         """Write the current params as a native per-layer checkpoint."""

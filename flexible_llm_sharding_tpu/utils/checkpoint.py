@@ -541,6 +541,20 @@ def _mmap_safetensors(path: str) -> dict[str, np.ndarray]:
     return out
 
 
+def dequantize_tree_np(tree):
+    """Host-side dequantize of every {"q8","s"} leaf-group in a pytree —
+    for consumers that need real-valued host params (the streamed trainer;
+    test oracles). The streaming executors dequantize ON DEVICE instead
+    (runtime/executor._dequant_tree), after the int8 bytes cross the link."""
+    import jax
+
+    return jax.tree.map(
+        lambda n: dequantize_np(n) if is_quantized_leaf(n) else n,
+        tree,
+        is_leaf=is_quantized_leaf,
+    )
+
+
 def load_layer(model_path: str, layer_name: str) -> dict[str, Any]:
     """Load one layer file into a native-layout parameter pytree (numpy;
     zero-copy mmap views where the file is already native layout). int8-

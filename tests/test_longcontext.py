@@ -230,17 +230,38 @@ def test_long_context_int8_stream(model_dir, tmp_path):
     assert float(np.abs(got[0] - want[0]).max()) < 0.05  # int8 quality bar
 
 
+def _assert_decode_matches_oracle(
+    scores_p, params, model_cfg, prompt, n_gen, rtol=2e-4, atol=1e-5
+):
+    """Token-level greedy oracle (forward_full on the growing ids) for ONE
+    prompt's decode scores [S, n_gen, V] — the shared protocol of every
+    long-context KV-decode test."""
+    import jax.numpy as jnp
+
+    from flexible_llm_sharding_tpu.models import llama
+    from flexible_llm_sharding_tpu.runtime.tokenization import PromptTokenizer
+
+    tok = PromptTokenizer(FakeTokenizer(), max_token_len=512, bucket_multiple=8)
+    t = tok(*prompt)
+    for s in range(t.num_suffixes):
+        ids = np.concatenate(
+            [t.prefix_ids[: t.prefix_len], t.suffix_ids[s, : int(t.suffix_eos[s]) + 1]]
+        )
+        for g in range(n_gen):
+            logits = llama.forward_full(params, model_cfg, jnp.asarray(ids[None]))
+            want = np.asarray(jax.nn.softmax(logits[0, -1]))
+            np.testing.assert_allclose(scores_p[s, g], want, rtol=rtol, atol=atol)
+            ids = np.concatenate([ids, [int(want.argmax())]])
+
+
 def test_long_context_kv_decode(model_dir, tiny_cfg):
     """KV-cache decode composes with the sp mesh (previously a loud CLI
     reject): the long prompt prefills once with sharded prefix KV and
     decodes one token per suffix per stream; per-step distributions and
     greedy tokens must match the token-level monolithic oracle. The short
     prompt routes to the normal KV-decode path in the same call."""
-    import jax.numpy as jnp
-
     from flexible_llm_sharding_tpu.models import llama
     from flexible_llm_sharding_tpu.runtime.orchestration import run_decode
-    from flexible_llm_sharding_tpu.runtime.tokenization import PromptTokenizer
 
     n_gen = 3
     cfg = _cfg(
@@ -251,24 +272,9 @@ def test_long_context_kv_decode(model_dir, tiny_cfg):
     )
 
     params = llama.init_params(jax.random.PRNGKey(0), tiny_cfg)
-    tok = PromptTokenizer(FakeTokenizer(), max_token_len=512, bucket_multiple=8)
-    for p_i, (prefix, suffixes) in enumerate(PROMPTS):
-        t = tok(prefix, suffixes)
-        assert scores[p_i].shape == (len(suffixes), n_gen, tiny_cfg.vocab_size)
-        for s in range(t.num_suffixes):
-            ids = np.concatenate(
-                [
-                    t.prefix_ids[: t.prefix_len],
-                    t.suffix_ids[s, : int(t.suffix_eos[s]) + 1],
-                ]
-            )
-            for g in range(n_gen):
-                logits = llama.forward_full(params, tiny_cfg, jnp.asarray(ids[None]))
-                want = np.asarray(jax.nn.softmax(logits[0, -1]))
-                np.testing.assert_allclose(
-                    scores[p_i][s, g], want, rtol=2e-4, atol=1e-5
-                )
-                ids = np.concatenate([ids, [int(want.argmax())]])
+    for p_i, prompt in enumerate(PROMPTS):
+        assert scores[p_i].shape == (len(prompt[1]), n_gen, tiny_cfg.vocab_size)
+        _assert_decode_matches_oracle(scores[p_i], params, tiny_cfg, prompt, n_gen)
     for (_, sfx), (_, usfx) in zip(PROMPTS, updated):
         for orig, new in zip(sfx, usfx):
             assert new.startswith(orig) and len(new) > len(orig)
@@ -312,11 +318,8 @@ def test_long_context_kv_decode_windowed(tiny_cfg, tmp_path_factory):
     window must still match the token-level oracle past the cap."""
     import dataclasses
 
-    import jax.numpy as jnp
-
     from flexible_llm_sharding_tpu.models import llama
     from flexible_llm_sharding_tpu.runtime.orchestration import run_decode
-    from flexible_llm_sharding_tpu.runtime.tokenization import PromptTokenizer
 
     cfg_m = dataclasses.replace(
         tiny_cfg, model_type="mistral", sliding_window=48
@@ -332,18 +335,33 @@ def test_long_context_kv_decode_windowed(tiny_cfg, tmp_path_factory):
     scores, _, _ = run_decode(
         cfg, PROMPTS[:1], tokenizer=FakeTokenizer(), devices=jax.devices()[:4]
     )
+    _assert_decode_matches_oracle(scores[0], params, cfg_m, PROMPTS[0], n_gen)
 
-    tok = PromptTokenizer(FakeTokenizer(), max_token_len=512, bucket_multiple=8)
-    t = tok(*PROMPTS[0])
-    for s in range(t.num_suffixes):
-        ids = np.concatenate(
-            [t.prefix_ids[: t.prefix_len], t.suffix_ids[s, : int(t.suffix_eos[s]) + 1]]
-        )
-        for g in range(n_gen):
-            logits = llama.forward_full(params, cfg_m, jnp.asarray(ids[None]))
-            want = np.asarray(jax.nn.softmax(logits[0, -1]))
-            np.testing.assert_allclose(scores[0][s, g], want, rtol=2e-4, atol=1e-5)
-            ids = np.concatenate([ids, [int(want.argmax())]])
+
+def test_long_context_kv_decode_llama4(tmp_path_factory):
+    """The sp-mesh decode layer across the full llama4 delta set: chunked
+    attention with chunk boundaries at ABSOLUTE positions, NoPE layers with
+    temperature-tuned queries, interleaved rope, mixed dense/MoE stacks —
+    greedy decode past the cap must match the token-level oracle."""
+    from flexible_llm_sharding_tpu.config import LlamaConfig
+    from flexible_llm_sharding_tpu.models import llama
+    from flexible_llm_sharding_tpu.runtime.orchestration import run_decode
+
+    cfg_m = LlamaConfig(**LLAMA4ISH)
+    params = llama.init_mixed_params(jax.random.PRNGKey(9), cfg_m)
+    d = tmp_path_factory.mktemp("longctx_decode_l4")
+    save_params(jax.tree.map(np.asarray, params), str(d), cfg_m)
+
+    n_gen = 2
+    cfg = _cfg(
+        str(d), max_token_len=64, long_context=True, num_gen_token=n_gen
+    )
+    scores, _, _ = run_decode(
+        cfg, PROMPTS[:1], tokenizer=FakeTokenizer(), devices=jax.devices()[:4]
+    )
+    _assert_decode_matches_oracle(
+        scores[0], params, cfg_m, PROMPTS[0], n_gen, rtol=3e-4, atol=2e-5
+    )
 
 
 def test_long_context_cli(model_dir, tmp_path):

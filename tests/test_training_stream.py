@@ -197,6 +197,28 @@ def test_streamed_state_checkpoint_bf16(tiny_cfg, rng, tmp_path):
     resumed.step(tokens)  # moments usable: the resumed update runs
 
 
+def test_streamed_from_int8_checkpoint(tiny_cfg, rng, tmp_path):
+    """Fine-tuning FROM an int8 checkpoint: params dequantize at load and a
+    step runs (the int8 error is the starting point, not a crash inside
+    AdamW on integer leaves)."""
+    from flexible_llm_sharding_tpu.utils.checkpoint import requantize_native
+
+    params = llama.init_params(jax.random.PRNGKey(9), tiny_cfg)
+    f32 = tmp_path / "f32"
+    save_params(jax.tree.map(np.asarray, params), str(f32), tiny_cfg)
+    q8 = tmp_path / "q8"
+    requantize_native(str(f32), str(q8))
+
+    tr = StreamedTrainer.from_pretrained(str(q8), lr=LR)
+    assert all(
+        np.asarray(leaf).dtype.kind == "f" for leaf in jax.tree.leaves(tr.params)
+    )
+    tokens = rng.integers(1, tiny_cfg.vocab_size, size=(1, 9)).astype(np.int32)
+    l0 = tr.step(tokens)
+    l1 = tr.step(tokens)
+    assert np.isfinite([l0, l1]).all() and l1 < l0
+
+
 def test_streamed_rejects_tied(tiny_cfg):
     cfg = dataclasses.replace(tiny_cfg, tie_word_embeddings=True)
     params = llama.init_params(jax.random.PRNGKey(4), cfg)
