@@ -619,12 +619,11 @@ class FrameworkConfig:
             raise ValueError("tensor_parallel must be >= 1")
         if self.prefetch_depth is not None and self.prefetch_depth < 0:
             raise ValueError("prefetch_depth must be >= 0 (or None for auto)")
-        if self.tensor_parallel > 1 and self.data_parallel:
-            raise ValueError(
-                "tensor_parallel and data_parallel are mutually exclusive "
-                "(stream one model sharded across chips, OR one replica per "
-                "chip — not both in this executor)"
-            )
+        # tensor_parallel + data_parallel COMPOSE: the visible chips
+        # partition into dp groups of tp chips each; every group streams the
+        # model Megatron-sharded over its own tp sub-mesh while the prompt
+        # batch splits across groups (orchestration validates the chip
+        # count at run time, when the device list is known).
         if (self.top_k or self.top_p) and self.temperature <= 0:
             # Silent no-op filters would masquerade as sampling.
             raise ValueError("top_k/top_p require temperature > 0")

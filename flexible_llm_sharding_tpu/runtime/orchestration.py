@@ -125,6 +125,37 @@ def _tp_placement(cfg: FrameworkConfig, devices: list):
     return placement
 
 
+def _dp_targets(cfg: FrameworkConfig, devices: list, model_cfg):
+    """Execution targets for the DP prompt split: the chips themselves, or —
+    with ``tensor_parallel > 1`` (dp x tp composition) — one ``TpPlacement``
+    per group of tp chips."""
+    tp = cfg.tensor_parallel
+    if tp <= 1:
+        return list(devices), len(devices)
+    n = len(devices) // tp
+    if n < 2:
+        raise ValueError(
+            f"data_parallel with tensor_parallel={tp} needs at least "
+            f"{2 * tp} chips (2+ groups of tp), have {len(devices)}; drop "
+            "--data_parallel for single-group tensor parallelism"
+        )
+    if len(devices) % tp:
+        import sys
+
+        print(
+            f"dp x tp: {len(devices) % tp} of {len(devices)} chips idle "
+            f"(device count not a multiple of tensor_parallel={tp})",
+            file=sys.stderr,
+        )
+    from flexible_llm_sharding_tpu.parallel.sharding import TpPlacement
+
+    targets = [
+        TpPlacement(devices[g * tp : (g + 1) * tp], model_cfg) for g in range(n)
+    ]
+    targets[0].check(model_cfg)  # same config for every group: check once
+    return targets, n
+
+
 def run_prompts(
     cfg: FrameworkConfig,
     prompts: Sequence[Prompt],
@@ -134,6 +165,8 @@ def run_prompts(
     """Score all prompts once over the available devices -> one
     ``[n_suffixes, 1, vocab]`` array per prompt, in prompt order."""
     prompts = list(prompts)
+    if not prompts:
+        return []
     devices = devices if devices is not None else pick_devices(cfg)
 
     if cfg.long_context:
@@ -166,7 +199,7 @@ def run_prompts(
                 len(prompts), (long_idx, long_scores), (rest_idx, rest_scores)
             )
 
-    if cfg.tensor_parallel > 1:
+    if cfg.tensor_parallel > 1 and not cfg.data_parallel:
         # One streaming executor whose every shard is Megatron-sharded over a
         # tp mesh: per-chip weight HBM divides by tp, matmuls run on all
         # chips' MXUs, XLA emits the ICI all-reduces. The reference has no
@@ -177,7 +210,11 @@ def run_prompts(
         )
         return _run_batched(ex, prompts, cfg.num_batch)
 
-    if len(devices) <= 1 or not cfg.data_parallel:
+    # dp x tp must NOT degrade to the single-device/pipeline branches on a
+    # short device list — _dp_targets fails loudly instead (an unsharded
+    # stream of a model that needed tp to fit HBM would OOM or mislead).
+    dp_tp = cfg.tensor_parallel > 1 and cfg.data_parallel
+    if not dp_tp and (len(devices) <= 1 or not cfg.data_parallel):
         if len(devices) > 1:
             from flexible_llm_sharding_tpu.runtime.pipeline import run_pipeline
 
@@ -185,16 +222,19 @@ def run_prompts(
         ex = StreamingExecutor(cfg, device=devices[0], tokenizer=tokenizer)
         return _run_batched(ex, prompts, cfg.num_batch)
 
-    # DP: prompt ranges per device (np.array_split semantics,
-    # /root/reference/main.py:70), one streaming executor per chip. All chips
-    # stream the same shards in lockstep, so the checkpoint is read from disk
-    # ONCE per shard and broadcast (BroadcastShardSource) — the TPU-native
-    # replacement for the reference's DeviceManager layer cache
-    # (/root/reference/utils.py:31-75). Chips whose prompt range is empty
-    # (more chips than prompts) are excluded from the broadcast entirely, so
-    # the producer never waits on an idle chip's queue.
+    # DP: prompt ranges per execution target (np.array_split semantics,
+    # /root/reference/main.py:70), one streaming executor per target. All
+    # targets stream the same shards in lockstep, so the checkpoint is read
+    # from disk ONCE per shard and broadcast (BroadcastShardSource) — the
+    # TPU-native replacement for the reference's DeviceManager layer cache
+    # (/root/reference/utils.py:31-75). Targets whose prompt range is empty
+    # (more targets than prompts) are excluded from the broadcast entirely,
+    # so the producer never waits on an idle queue. With tensor_parallel > 1
+    # the targets are GROUPS of tp chips (dp x tp composition): each group
+    # streams Megatron-sharded weights over its own sub-mesh — _place
+    # broadcasts the int8/bf16 host shard once per group placement.
     model_cfg = LlamaConfig.from_pretrained(cfg.model_path)
-    n = len(devices)
+    targets, n = _dp_targets(cfg, devices, model_cfg)
     ranges = split_prompts_dp(len(prompts), n)
     layer_names = checkpoint.layer_names_for(
         model_cfg.num_hidden_layers, tie_word_embeddings=False
@@ -207,7 +247,7 @@ def run_prompts(
         layer_names,
         plan.shards,
         np_dtype_for(cfg.dtype),
-        devices=[devices[r] for r in active],
+        devices=[targets[r] for r in active],
         prefetch_depth=cfg.effective_prefetch_depth(),
         tied_embeddings=model_cfg.tie_word_embeddings,
         rounds=cfg.num_batch,
@@ -220,7 +260,7 @@ def run_prompts(
         lo, hi = ranges[rank]
         ex = StreamingExecutor(
             cfg,
-            device=devices[rank],
+            device=targets[rank],
             plan=plan_shards_dp(
                 n_exec_layers,
                 cfg.layer_num_per_shard,
@@ -256,6 +296,8 @@ def run_decode(
     from flexible_llm_sharding_tpu.runtime.decode import DecodeGenerator
 
     prompts = list(prompts)
+    if not prompts:
+        return [], [], 0
     devices = devices if devices is not None else pick_devices(cfg)
 
     if cfg.long_context:
@@ -294,7 +336,7 @@ def run_decode(
                 l_tokens + r_tokens,
             )
 
-    if cfg.tensor_parallel > 1:
+    if cfg.tensor_parallel > 1 and not cfg.data_parallel:
         # TP decode: one generator whose streamed weights are Megatron-
         # sharded over the tp mesh; activations and parked KV stay
         # replicated (weights are the HBM/transfer term the split targets).
@@ -312,15 +354,18 @@ def run_decode(
         scores, updated = gen(prompts)
         return scores, updated, int(gen.stats.get("tokens_processed", 0))
 
-    if len(devices) <= 1 or len(prompts) <= 1:
+    dp_tp = cfg.tensor_parallel > 1 and cfg.data_parallel
+    if not dp_tp and (len(devices) <= 1 or len(prompts) <= 1):
         gen = DecodeGenerator(
             cfg, device=devices[0] if devices else None, tokenizer=tokenizer
         )
         scores, updated = gen(prompts)
         return scores, updated, int(gen.stats.get("tokens_processed", 0))
 
+    # DP decode (with tensor_parallel > 1: dp x tp — one TpPlacement per
+    # group of tp chips, Megatron-sharded weights broadcast once per group).
     model_cfg = LlamaConfig.from_pretrained(cfg.model_path)
-    n = len(devices)
+    targets, n = _dp_targets(cfg, devices, model_cfg)
     ranges = split_prompts_dp(len(prompts), n)
     layer_names = checkpoint.layer_names_for(
         model_cfg.num_hidden_layers, tie_word_embeddings=False
@@ -332,7 +377,7 @@ def run_decode(
         layer_names,
         plan.shards,
         np_dtype_for(cfg.dtype),
-        devices=[devices[r] for r in active],
+        devices=[targets[r] for r in active],
         prefetch_depth=cfg.effective_prefetch_depth(),
         tied_embeddings=model_cfg.tie_word_embeddings,
         rounds=cfg.num_gen_token,
@@ -345,7 +390,7 @@ def run_decode(
         lo, hi = ranges[rank]
         gen = DecodeGenerator(
             cfg,
-            device=devices[rank],
+            device=targets[rank],
             tokenizer=tokenizer,
             weight_source_factory=lambda: source.view(slot),
         )
