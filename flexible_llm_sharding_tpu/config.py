@@ -582,7 +582,8 @@ class FrameworkConfig:
     # Tensor parallelism for the streaming scorer: shard every streamed
     # layer's matmuls Megatron-style over this many chips (per-chip weight
     # HBM drops by the factor; XLA emits the ICI all-reduces). 1 = off.
-    # Mutually exclusive with data_parallel and the MP pipeline.
+    # Composes with data_parallel (dp groups of tp chips); supersedes the MP
+    # pipeline when set.
     tensor_parallel: int = 1
     verbose_metrics: bool = False  # one JSON line per structured event (stderr)
     profile_dir: str = ""  # jax.profiler trace output dir ("" = off)
