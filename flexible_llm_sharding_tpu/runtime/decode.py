@@ -241,20 +241,39 @@ class DecodeGenerator:
         )
         self.stats: dict[str, float] = {}
 
-    def _source(self):
+    def _open_streams(self, n_streams: int):
+        """(per-pass stream factory, closer) for ``n_streams`` full weight
+        passes — prefill + each decode step.
+
+        DP mode (weight_source_factory): the SHARED BroadcastShardSource was
+        built with rounds=num_gen_token, so its producer (and prefetch) runs
+        continuously across passes; each call hands out the next round's
+        view. Local mode: ONE ShardWeightSource over the shard list repeated
+        n_streams times — per-pass sources would cold-start the prefetch
+        pipeline at every decode step, leaving the chip idle for the first
+        shard(s) of every token."""
         if self.weight_source_factory is not None:
-            return self.weight_source_factory()
-        return ShardWeightSource(
+            return (lambda: iter(self.weight_source_factory())), None
+        source = ShardWeightSource(
             self.cfg.model_path,
             self.layer_names,
-            self.shards,
+            list(self.shards) * n_streams,
             np_dtype_for(self.cfg.dtype),
-            devices=self.shard_devices,
+            devices=list(self.shard_devices) * n_streams,
             prefetch_depth=self.cfg.effective_prefetch_depth(),
             tied_embeddings=self.model_cfg.tie_word_embeddings,
             layer_sliding=self.model_cfg.layer_sliding,
             layer_rope=self.model_cfg.layer_rope,
         )
+        it = iter(source)
+        n_shards = len(self.shards)
+
+        def one_pass():
+            from itertools import islice
+
+            return islice(it, n_shards)
+
+        return one_pass, source
 
     def __call__(self, prompts, num_gen_token: int | None = None):
         cfg = self.cfg
@@ -295,10 +314,10 @@ class DecodeGenerator:
         }
         pick = lambda dist, b: picker(dist, real=real_rows[b])  # noqa: E731
 
-        # --- prefill: one streaming pass, capturing KV -------------------
-        source = self._source()
+        one_pass, closer = self._open_streams(n_gen)
         try:
-            for shard_pos, (layer_idxs, segments) in enumerate(source):
+            # --- prefill: one streaming pass, capturing KV ---------------
+            for shard_pos, (layer_idxs, segments) in enumerate(one_pass()):
                 if not layer_idxs:  # MP round-up padding stage
                     continue
                 dev = self.shard_devices[shard_pos]
@@ -357,18 +376,14 @@ class DecodeGenerator:
                             tok_hist[b].append(pick(dist, b))
                     if layer_idxs[-1] != n_layers - 1:
                         kv_store.put(("h", b), (ph, sh))
-        finally:
-            source.close()
 
-        # --- decode steps: stream weights, one token per suffix ----------
-        for t in range(n_gen - 1):
-            source = self._source()
-            # model.norm always executes before lm_head; its params (set at
-            # the norm shard) are carried here across shard iterations when
-            # the two land in different shards (layer_num_per_shard=1).
-            norm_params = None
-            try:
-                for shard_pos, (layer_idxs, segments) in enumerate(source):
+            # --- decode steps: stream weights, one token per suffix ------
+            for t in range(n_gen - 1):
+                # model.norm always executes before lm_head; its params (set
+                # at the norm shard) are carried here across shard iterations
+                # when the two land in different shards (layer_num_per_shard=1).
+                norm_params = None
+                for shard_pos, (layer_idxs, segments) in enumerate(one_pass()):
                     if not layer_idxs:  # MP round-up padding stage
                         continue
                     dev = self.shard_devices[shard_pos]
@@ -415,8 +430,9 @@ class DecodeGenerator:
                                 tok_hist[b].append(pick(dist, b))
                         if layer_idxs[-1] != n_layers - 1:
                             kv_store.put(("x", b), x)
-            finally:
-                source.close()
+        finally:
+            if closer is not None:
+                closer.close()
 
         kv_store.clear()
         self.stats = {
