@@ -369,6 +369,30 @@ def test_int8_composes_with_tensor_parallel(dirs, tiny_cfg):
         np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5)
 
 
+def test_int8_dp_tp_composition(dirs):
+    """int8 x (dp x tp): the broadcast producer device_puts the SAME int8
+    host shard to each group's Megatron placement (payload takes the weight
+    sharding, scale the channel axis) and each group dequantizes on its own
+    sub-mesh. Must equal the single-device int8 run exactly."""
+    from flexible_llm_sharding_tpu.runtime.orchestration import run_prompts
+
+    _, q8, _ = dirs
+    fw = FrameworkConfig(
+        model_path=q8, dtype="float32", bucket_multiple=8, prefetch_depth=1
+    )
+    single = StreamingExecutor(fw, tokenizer=FakeTokenizer())(PROMPTS)
+    import dataclasses
+
+    both = run_prompts(
+        dataclasses.replace(fw, tensor_parallel=2, data_parallel=True),
+        PROMPTS,
+        tokenizer=FakeTokenizer(),
+        devices=jax.devices()[:4],
+    )
+    for a, b in zip(single, both):
+        np.testing.assert_allclose(b, a, rtol=1e-4, atol=1e-5)
+
+
 @pytest.mark.parametrize("mode", ["dp", "mp"])
 def test_int8_multichip(dirs, tiny_cfg, mode, tmp_path):
     """int8 checkpoints through the multi-chip orchestration: DP prompt
