@@ -25,6 +25,52 @@ Prompt = tuple[str, tuple[str, ...]]
 RunFn = Callable[[list[Prompt]], list[np.ndarray]]
 
 
+def sample_tokens(
+    dist: np.ndarray,
+    rng: np.random.Generator,
+    temperature: float,
+    top_k: int = 0,
+    top_p: float = 0.0,
+) -> np.ndarray:
+    """Draw one token per row of ``dist`` [N, V] with the standard decoding
+    controls: temperature reshaping ``p^(1/T)``, then top-k truncation
+    (exactly k survivors even under ties — stable argsort breaks them by
+    index, like torch.topk), renormalise, then nucleus (top-p) truncation
+    (HF convention: keep the smallest sorted prefix whose mass reaches p,
+    always including the most probable token). Fully vectorized — ONE
+    stable argsort per row instead of per-row Python work, and one uniform
+    draw per row mapped through the inverse CDF — so the host cost per
+    decode step is O(N·V·log V) numpy, not a Python loop."""
+    dist = np.asarray(dist, np.float64)
+    logits = np.log(np.maximum(dist, 1e-30)) / max(temperature, 1e-6)
+    p = np.exp(logits - logits.max(axis=-1, keepdims=True))
+    p /= p.sum(axis=-1, keepdims=True)
+    v = p.shape[-1]
+    if (top_k and top_k < v) or 0.0 < top_p < 1.0:
+        order = np.argsort(-p, axis=-1, kind="stable")  # [N, V]
+        ranks = np.empty_like(order)
+        np.put_along_axis(ranks, order, np.arange(v)[None, :], axis=-1)
+        if top_k and top_k < v:
+            p = np.where(ranks < top_k, p, 0.0)
+            # HF order: nucleus applies to the RENORMALIZED survivor mass.
+            p /= p.sum(axis=-1, keepdims=True)
+        if 0.0 < top_p < 1.0:
+            sorted_p = np.take_along_axis(p, order, axis=-1)
+            csum = np.cumsum(sorted_p, axis=-1)
+            # Keep ranks whose PRECEDING mass is < p (includes the token
+            # that crosses p; zeroed top-k rejects contribute no mass).
+            keep_sorted = (csum - sorted_p) < top_p
+            p = np.where(np.take_along_axis(keep_sorted, ranks, axis=-1), p, 0.0)
+        p /= p.sum(axis=-1, keepdims=True)
+    # Inverse-CDF draw: one uniform per row. Normalize the cdf itself (as
+    # rng.choice does) so float error can't leave csum[-1] = 1 - eps and a
+    # tail draw select a token the filters zeroed out.
+    u = rng.random(p.shape[0])
+    csum = np.cumsum(p, axis=-1)
+    csum /= csum[:, -1:]
+    return np.minimum((csum < u[:, None]).sum(axis=-1), v - 1).astype(np.int64)
+
+
 def sample_token(
     dist: np.ndarray,
     rng: np.random.Generator,
@@ -32,31 +78,8 @@ def sample_token(
     top_k: int = 0,
     top_p: float = 0.0,
 ) -> int:
-    """Draw one token from a next-token distribution with the standard
-    decoding controls: temperature reshaping ``p^(1/T)``, then top-k
-    truncation, then nucleus (top-p) truncation, renormalised. ``top_k=0`` /
-    ``top_p=0`` disable their filter (HF convention: top_p keeps the
-    smallest prefix of the sorted distribution whose mass reaches p,
-    always including the most probable token)."""
-    logits = np.log(np.maximum(dist, 1e-30)) / max(temperature, 1e-6)
-    p = np.exp(logits - logits.max())
-    p = p / p.sum()
-    if top_k and top_k < p.shape[-1]:
-        # Exactly k survivors even under ties (argsort breaks them by
-        # index, like torch.topk).
-        drop = np.argsort(-p, kind="stable")[top_k:]
-        p[drop] = 0.0
-        p = p / p.sum()  # HF order: nucleus applies to the RENORMALIZED mass
-    if 0.0 < top_p < 1.0:
-        order = np.argsort(-p, kind="stable")
-        csum = np.cumsum(p[order])
-        # Keep tokens up to AND INCLUDING the one that crosses p.
-        cut = int(np.searchsorted(csum, top_p)) + 1
-        keep = np.zeros_like(p, dtype=bool)
-        keep[order[:cut]] = True
-        p = np.where(keep, p, 0.0)
-    p = p / p.sum()
-    return int(rng.choice(dist.shape[-1], p=p))
+    """One-row convenience form of :func:`sample_tokens`."""
+    return int(sample_tokens(dist[None], rng, temperature, top_k, top_p)[0])
 
 
 def make_picker(cfg, rng: np.random.Generator | None = None):
@@ -75,19 +98,21 @@ def make_picker(cfg, rng: np.random.Generator | None = None):
     rng = rng if rng is not None else np.random.default_rng(cfg.seed)
 
     def pick(dist: np.ndarray, real=None) -> np.ndarray:
-        # argmax only where a padded row needs a placeholder; every real
-        # row's entry is overwritten by its draw.
-        out = (
-            np.empty(dist.shape[:-1], np.int64)
-            if real is None
-            else np.argmax(dist, axis=-1)
-        )
-        for idx in np.ndindex(*dist.shape[:-1]):
-            if real is None or real[idx]:
-                out[idx] = sample_token(
-                    dist[idx], rng, cfg.temperature, cfg.top_k, cfg.top_p
-                )
-        return out
+        lead = dist.shape[:-1]
+        flat = dist.reshape(-1, dist.shape[-1])
+        if real is None:
+            return sample_tokens(
+                flat, rng, cfg.temperature, cfg.top_k, cfg.top_p
+            ).reshape(lead)
+        # Sample only the real rows (padded rows keep an argmax placeholder
+        # and never advance the rng), in row-major order for determinism.
+        out = np.argmax(dist, axis=-1).reshape(-1)
+        mask = np.broadcast_to(np.asarray(real, bool), lead).reshape(-1)
+        if mask.any():
+            out[mask] = sample_tokens(
+                flat[mask], rng, cfg.temperature, cfg.top_k, cfg.top_p
+            )
+        return out.reshape(lead)
 
     return pick
 
@@ -160,4 +185,4 @@ def generation_loop(
     return output_scores, current
 
 
-__all__ = ["generation_loop", "sample_token"]
+__all__ = ["generation_loop", "sample_token", "sample_tokens", "make_picker"]
