@@ -70,6 +70,7 @@ HEADLINE_KEYS = (
     "pallas_speedup_4k",
     "pallas_decode_speedup",
     "decode_speedup_4tok",
+    "decode_score_maxerr",
     "mfu",
     "model_flops_per_token",
     "host_to_hbm_gbps",
@@ -334,16 +335,38 @@ def bench_decode(cfg_obj, prompts, tok, result: dict, n_tok: int = 4) -> None:
     kv_scores, _ = gen(prompts)
     t_kv = time.perf_counter() - t0
 
-    # Same greedy semantics -> same argmax tokens.
-    agree = all(
-        np.array_equal(np.argmax(a, axis=-1), np.argmax(b, axis=-1))
-        for a, b in zip(ref_scores, kv_scores)
-    )
+    # Same greedy semantics -> same argmax tokens, UP TO near-ties: the two
+    # paths order bf16 reductions differently (flash kernels vs fused XLA),
+    # and this synthetic random-weight model's softmax is nearly flat, so a
+    # sub-1e-4 probability margin can legitimately flip an argmax (measured
+    # on hardware: scores agree to 7e-6 while one argmax flips on a 6e-6
+    # margin). After a benign flip the two paths' contexts genuinely
+    # diverge (each greedy loop feeds back its own token), so comparison of
+    # that prompt stops there. A flip with a REAL margin, or a score error
+    # above tolerance before any flip, is still flagged as a mismatch.
+    tie_tol, err_tol = 1e-4, 1e-3
+    agree, maxerr = True, 0.0
+    for a, b in zip(ref_scores, kv_scores):
+        a, b = np.asarray(a, np.float32), np.asarray(b, np.float32)
+        am, bm = np.argmax(a, axis=-1), np.argmax(b, axis=-1)
+        for s in range(a.shape[0]):  # per suffix: steps are sequential
+            for t in range(a.shape[1]):
+                # Contexts are identical THROUGH step t (divergence starts
+                # at t+1), so the score error at the flip step still counts.
+                maxerr = max(maxerr, float(np.abs(a[s, t] - b[s, t]).max()))
+                if am[s, t] != bm[s, t]:
+                    margin = a[s, t, am[s, t]] - a[s, t, bm[s, t]]
+                    if margin > tie_tol:
+                        agree = False
+                    break  # contexts diverge from here; stop this suffix
+    if maxerr > err_tol:
+        agree = False
     log(
         f"generation {n_tok} tok: recompute={t_recompute:.2f}s "
-        f"kv_cache={t_kv:.2f}s argmax_agree={agree}"
+        f"kv_cache={t_kv:.2f}s agree={agree} score_maxerr={maxerr:.2e}"
     )
     result[f"decode_speedup_{n_tok}tok"] = round(t_recompute / t_kv, 3)
+    result["decode_score_maxerr"] = float(f"{maxerr:.3e}")
     if not agree:
         result["decode_argmax_mismatch"] = True
 
