@@ -71,6 +71,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--kv_cache", type=_str2bool, default=False,
                    help="fast generation: reuse per-layer KV across tokens "
                         "(token-id append semantics; greedy or sampled)")
+    p.add_argument("--decode_resident", type=str, default="auto",
+                   choices=("auto", "on", "off"),
+                   help="kv_cache mode: keep streamed weights on chip after "
+                        "prefill when they fit (auto = judge against the "
+                        "chip's HBM), so decode steps move zero weight bytes")
     # --- TPU-specific ---
     p.add_argument("--dtype", type=str, default="bfloat16",
                    choices=["bfloat16", "float16", "float32"])
@@ -130,6 +135,7 @@ def config_from_args(args: argparse.Namespace) -> FrameworkConfig:
         profile_dir=args.profile_dir,
         resume=args.resume,
         long_context=args.long_context,
+        decode_resident=args.decode_resident,
         temperature=args.temperature,
         top_k=args.top_k,
         top_p=args.top_p,
@@ -144,6 +150,10 @@ def main(argv: list[str] | None = None, tokenizer=None) -> None:
         # Friendly form of the FrameworkConfig validation: silent no-op
         # filters would masquerade as sampling.
         raise SystemExit("--top_k/--top_p require --temperature > 0")
+    if args.decode_resident == "on" and not args.kv_cache:
+        # Same silent-no-op defence: the flag only drives the KV-decode
+        # path; without --kv_cache weights would quietly re-stream.
+        raise SystemExit("--decode_resident on requires --kv_cache true")
     cfg = config_from_args(args)
 
     if args.coordinator_address is not None:

@@ -593,6 +593,16 @@ class FrameworkConfig:
     # the visible chips; cap becomes n_chips * max_token_len) instead of the
     # reference's silent truncation (/root/reference/utils.py:14,250,254).
     long_context: bool = False
+    # Weights-resident KV decode: when the model's device-materialised
+    # weights fit comfortably in HBM, keep every streamed shard on chip
+    # after the prefill pass and run decode steps with ZERO weight
+    # transfers (the reference re-streams the full model per token,
+    # /root/reference/main.py:65-76; plain KV decode still re-streams the
+    # weights each step). 'auto' = on iff total weight bytes (for the
+    # compute dtype, split over the tp/mp chips) fit within 45% of the
+    # chip's known HBM — leaving room for KV caches, activations, and the
+    # prefill-time prefetch queue; unknown HBM resolves to off.
+    decode_resident: str = "auto"  # 'auto' | 'on' | 'off'
     # Sampling controls (generation_loop.sample_token semantics): 0 = greedy
     # argmax (exact reference behaviour, /root/reference/main.py:47-48 left
     # the temperature flag commented out). Deterministic given seed.
@@ -613,8 +623,9 @@ class FrameworkConfig:
             raise ValueError("num_batch must be >= 1")
         if self.num_gen_token < 1:
             # 0 would deadlock DP decode: the broadcast source is built with
-            # rounds=num_gen_token, so its producer would push nothing while
-            # every consumer blocks on an empty queue.
+            # rounds=num_gen_token (1 in resident mode), so its producer
+            # would push nothing while every consumer blocks on an empty
+            # queue.
             raise ValueError("num_gen_token must be >= 1")
         if self.tensor_parallel < 1:
             raise ValueError("tensor_parallel must be >= 1")
@@ -628,6 +639,11 @@ class FrameworkConfig:
         if (self.top_k or self.top_p) and self.temperature <= 0:
             # Silent no-op filters would masquerade as sampling.
             raise ValueError("top_k/top_p require temperature > 0")
+        if self.decode_resident not in ("auto", "on", "off"):
+            raise ValueError(
+                "decode_resident must be auto|on|off, "
+                f"got {self.decode_resident!r}"
+            )
 
     def effective_prefetch_depth(self) -> int:
         """Resolve the tri-state ``prefetch_depth``: explicit value, or auto —
@@ -642,6 +658,36 @@ class FrameworkConfig:
             return 2 if jax.devices()[0].platform != "cpu" else 0
         except Exception:
             return 0
+
+    def decode_resident_enabled(
+        self, model_cfg, n_weight_chips: int = 1, device=None
+    ) -> bool:
+        """Resolve the tri-state ``decode_resident`` for a model.
+
+        ``n_weight_chips``: how many chips the streamed weights divide over
+        (tensor_parallel width, or the MP pipeline's stage count) — residency
+        is judged per chip. Auto requires a KNOWN HBM capacity; the CPU
+        backend (tests) and unrecognised devices resolve to off, so the
+        fast path is only ever taken where the budget is real.
+        """
+        if self.decode_resident == "on":
+            return True
+        if self.decode_resident == "off":
+            return False
+        from flexible_llm_sharding_tpu.utils.metrics import (
+            chip_hbm_gb,
+            param_count,
+        )
+
+        try:
+            hbm_gb = chip_hbm_gb(device)
+        except Exception:
+            return False
+        if not hbm_gb:
+            return False
+        bytes_per = {"float32": 4, "bfloat16": 2, "float16": 2}[self.dtype]
+        per_chip = param_count(model_cfg) * bytes_per / max(n_weight_chips, 1)
+        return per_chip <= 0.45 * hbm_gb * 1e9
 
     def pallas_enabled(self) -> bool:
         """Resolve the tri-state ``use_pallas``: explicit value, or auto —

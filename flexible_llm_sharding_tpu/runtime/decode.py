@@ -185,17 +185,28 @@ class DecodeGenerator:
         tokenizer=None,
         weight_source_factory=None,
         mp_devices=None,
+        resident: bool | None = None,
     ):
         # weight_source_factory: DP mode passes views of one shared
-        # BroadcastShardSource (rounds = num_gen_token: one per weight
-        # stream — prefill plus each decode step) so the checkpoint is read
-        # from disk once for all chips; see orchestration.run_decode.
+        # BroadcastShardSource (rounds = num_gen_token — one per weight
+        # stream, prefill plus each decode step — or 1 in resident mode) so
+        # the checkpoint is read from disk once for all chips; see
+        # orchestration.run_decode.
         # mp_devices: interleaved-pipeline decode — shard k's weights AND its
         # parked KV live on chip k % N (the reference's MP assignment,
         # /root/reference/utils.py:151-153); activations hop chip-to-chip
         # between stages. Mutually exclusive with weight_source_factory.
         if weight_source_factory is not None and mp_devices is not None:
             raise ValueError("mp_devices and weight_source_factory are exclusive")
+        if weight_source_factory is not None and resident is None:
+            # The caller built the shared source with a fixed round count;
+            # an auto decision here could desync from it (consume one round
+            # of many -> producer blocks; expect more rounds than built ->
+            # consumer blocks). Make the coupling structural.
+            raise ValueError(
+                "weight_source_factory requires an explicit resident= flag "
+                "matching the source's round count"
+            )
         self.weight_source_factory = weight_source_factory
         self.cfg = cfg
         self.model_cfg = LlamaConfig.from_pretrained(cfg.model_path)
@@ -239,6 +250,26 @@ class DecodeGenerator:
         self._tp_mesh = (
             self.device.mesh if hasattr(self.device, "segment_target") else None
         )
+        # Weights-resident decode: keep every placed shard on chip after
+        # prefill and run decode steps with zero weight transfers (plain KV
+        # decode re-streams the full model per step; the reference re-runs
+        # the full PROMPT per step on top of that). Sized per chip: the tp
+        # mesh splits each shard tp-ways, the MP pipeline spreads stages
+        # round-robin. DP passes the decision in (``resident=``) so all
+        # ranks agree with the shared broadcast source's round count.
+        if resident is not None:
+            self._resident = resident
+        else:
+            if self._tp_mesh is not None:
+                n_chips = self._tp_mesh.devices.size
+                probe_dev = next(iter(self._tp_mesh.devices.flat))
+            else:
+                distinct = {id(d) for d in self.shard_devices}
+                n_chips = max(len(distinct), 1)
+                probe_dev = self.shard_devices[0]
+            self._resident = cfg.decode_resident_enabled(
+                self.model_cfg, n_chips, probe_dev
+            )
         self.stats: dict[str, float] = {}
 
     def _open_streams(self, n_streams: int):
@@ -314,10 +345,15 @@ class DecodeGenerator:
         }
         pick = lambda dist, b: picker(dist, real=real_rows[b])  # noqa: E731
 
-        one_pass, closer = self._open_streams(n_gen)
+        one_pass, closer = self._open_streams(1 if self._resident else n_gen)
+        # Resident mode: shards placed during prefill stay referenced here,
+        # so every decode step walks them with zero host->HBM traffic.
+        kept: list[tuple[int, tuple]] = []
         try:
             # --- prefill: one streaming pass, capturing KV ---------------
             for shard_pos, (layer_idxs, segments) in enumerate(one_pass()):
+                if self._resident:
+                    kept.append((shard_pos, (layer_idxs, segments)))
                 if not layer_idxs:  # MP round-up padding stage
                     continue
                 dev = self.shard_devices[shard_pos]
@@ -383,7 +419,9 @@ class DecodeGenerator:
                 # at the norm shard) are carried here across shard iterations
                 # when the two land in different shards (layer_num_per_shard=1).
                 norm_params = None
-                for shard_pos, (layer_idxs, segments) in enumerate(one_pass()):
+                for shard_pos, (layer_idxs, segments) in (
+                    kept if self._resident else enumerate(one_pass())
+                ):
                     if not layer_idxs:  # MP round-up padding stage
                         continue
                     dev = self.shard_devices[shard_pos]
@@ -435,8 +473,10 @@ class DecodeGenerator:
                 closer.close()
 
         kv_store.clear()
+        kept.clear()  # release the resident weights
         self.stats = {
             "total_wall_s": time.perf_counter() - t_start,
+            "decode_resident": float(self._resident),
             # Prefill runs every real prompt token once; each decode step
             # then runs exactly one new token per true suffix.
             "tokens_processed": float(

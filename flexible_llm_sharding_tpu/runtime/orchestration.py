@@ -372,6 +372,17 @@ def run_decode(
     )
     plan = plan_shards_dp(len(layer_names), cfg.layer_num_per_shard)
     active = [rank for rank in range(n) if ranges[rank][0] < ranges[rank][1]]
+    # Weights-resident decode: one broadcast round (the prefill) instead of
+    # one per generated token — every rank keeps its placed shards on chip.
+    # Decided HERE so the shared source's round count and every generator's
+    # behaviour agree (a rank deciding differently would starve/overflow
+    # the broadcast queues).
+    t0 = targets[active[0]]
+    resident = cfg.decode_resident_enabled(
+        model_cfg,
+        t0.mesh.devices.size if hasattr(t0, "segment_target") else 1,
+        next(iter(t0.mesh.devices.flat)) if hasattr(t0, "mesh") else t0,
+    )
     source = BroadcastShardSource(
         cfg.model_path,
         layer_names,
@@ -380,7 +391,7 @@ def run_decode(
         devices=[targets[r] for r in active],
         prefetch_depth=cfg.effective_prefetch_depth(),
         tied_embeddings=model_cfg.tie_word_embeddings,
-        rounds=cfg.num_gen_token,
+        rounds=1 if resident else cfg.num_gen_token,
         layer_sliding=model_cfg.layer_sliding,
         layer_rope=model_cfg.layer_rope,
     )
@@ -393,6 +404,7 @@ def run_decode(
             device=targets[rank],
             tokenizer=tokenizer,
             weight_source_factory=lambda: source.view(slot),
+            resident=resident,
         )
         scores, updated = gen(prompts[lo:hi])
         return scores, updated, int(gen.stats.get("tokens_processed", 0))

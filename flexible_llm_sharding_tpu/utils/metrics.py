@@ -269,6 +269,29 @@ def progress_bar(total: int, desc: str, unit: str = "it", disable=None,
     return bar
 
 
+def _arch_walk(cfg):
+    """Shared per-layer structure walk for the analytic model-size helpers:
+    (attn projection params, per-layer moe flags, dense MLP intermediate).
+    ``model_flops_per_token`` and ``param_count`` both consume this so a new
+    model-family field (moe pattern, shared expert, …) is resolved in ONE
+    place — they differ only in counting ACTIVE vs ALL experts."""
+    h = cfg.hidden_size
+    hd = cfg.head_dim
+    q_dim = cfg.num_attention_heads * hd
+    kv_dim = cfg.num_key_value_heads * hd
+    attn_proj = h * q_dim + 2 * h * kv_dim + q_dim * h
+    n = cfg.num_hidden_layers
+    moe_pattern = cfg.moe_layer_pattern or (
+        ((True,) * n) if cfg.num_local_experts else ((False,) * n)
+    )
+    dense_inter = (
+        cfg.intermediate_size_mlp
+        if cfg.intermediate_size_mlp is not None
+        else cfg.intermediate_size
+    )
+    return attn_proj, moe_pattern, dense_inter
+
+
 def model_flops_per_token(cfg, context_len: int = 0) -> float:
     """Analytic forward FLOPs per processed token for a LlamaConfig.
 
@@ -281,21 +304,9 @@ def model_flops_per_token(cfg, context_len: int = 0) -> float:
     convention (no recompute, no masking discounts).
     """
     h = cfg.hidden_size
-    hd = cfg.head_dim
-    q_dim = cfg.num_attention_heads * hd
-    kv_dim = cfg.num_key_value_heads * hd
-    attn_proj = h * q_dim + 2 * h * kv_dim + q_dim * h
-    attn_scores = 2 * context_len * hd * cfg.num_attention_heads  # QK^T + AV MACs
+    attn_proj, moe_pattern, dense_inter = _arch_walk(cfg)
+    attn_scores = 2 * context_len * cfg.head_dim * cfg.num_attention_heads
 
-    n = cfg.num_hidden_layers
-    moe_pattern = cfg.moe_layer_pattern or (
-        ((True,) * n) if cfg.num_local_experts else ((False,) * n)
-    )
-    dense_inter = (
-        cfg.intermediate_size_mlp
-        if cfg.intermediate_size_mlp is not None
-        else cfg.intermediate_size
-    )
     total = 0.0
     for is_moe in moe_pattern:
         if is_moe:
@@ -346,16 +357,80 @@ def measure_host_to_hbm_gbps(device=None, mb: int = 256) -> float:
     return buf.nbytes / 1e9 / (time.perf_counter() - t0)
 
 
-def chip_peak_flops(device=None) -> float | None:
-    """Peak bf16 FLOP/s for one chip, or None when unknown (CPU, new kinds)."""
+def _kind_lookup(device, table) -> float | None:
+    """Resolve a per-chip spec from a (device_kind substring, value) table."""
     import jax
 
-    device = device or jax.local_devices()[0]  # addressable on every rank
+    device = device if device is not None else jax.local_devices()[0]
     kind = (getattr(device, "device_kind", "") or "").lower()
-    for token, peak in _PEAK_BF16_FLOPS:
+    for token, value in table:
         if token in kind:
-            return peak
+            return value
     return None
+
+
+def chip_peak_flops(device=None) -> float | None:
+    """Peak bf16 FLOP/s for one chip, or None when unknown (CPU, new kinds)."""
+    return _kind_lookup(device, _PEAK_BF16_FLOPS)
+
+
+# HBM per chip in GB, by device_kind substring (public TPU specs). Used by
+# the resident-decode auto gate when the allocator reports no bytes_limit
+# (devices behind the axon tunnel report no memory stats at all).
+_HBM_GB = (
+    ("v6e", 32.0),
+    ("v6", 32.0),
+    ("v5p", 95.0),
+    ("v5e", 16.0),
+    ("v5 lite", 16.0),
+    ("v5litepod", 16.0),
+    ("v4", 32.0),
+    ("v3", 16.0),
+    ("v2", 8.0),
+)
+
+
+def chip_hbm_gb(device=None) -> float | None:
+    """HBM capacity of one chip in GB: the allocator's ``bytes_limit`` when
+    it reports one, else the device-kind table, else None (unknown — e.g.
+    the CPU backend, where "device memory" is host RAM)."""
+    import jax
+
+    device = device if device is not None else jax.local_devices()[0]
+    try:
+        stats = device.memory_stats()
+        limit = (stats or {}).get("bytes_limit")
+        if limit:
+            return limit / 1e9
+    except Exception:
+        pass
+    return _kind_lookup(device, _HBM_GB)
+
+
+def param_count(cfg) -> int:
+    """Total parameter count for a LlamaConfig — ALL weights as materialised
+    on device at compute dtype (every expert, embeddings, untied head; int8
+    checkpoints dequantize on placement, executor._place), the
+    resident-decode sizing numerator. Shares ``_arch_walk`` with
+    ``model_flops_per_token`` but counts storage instead of active
+    compute."""
+    h = cfg.hidden_size
+    attn, moe_pattern, dense_inter = _arch_walk(cfg)
+    total = 0
+    for is_moe in moe_pattern:
+        if is_moe:
+            mlp = cfg.num_local_experts * 3 * h * cfg.intermediate_size
+            mlp += h * cfg.num_local_experts  # router
+            if cfg.model_type == "llama4_text":  # shared expert
+                mlp += 3 * h * cfg.intermediate_size
+        else:
+            mlp = 3 * h * dense_inter
+        total += attn + mlp + 2 * h  # + the two norm scale vectors
+    total += h * cfg.vocab_size  # embed
+    if not cfg.tie_word_embeddings:
+        total += h * cfg.vocab_size  # untied lm_head
+    total += h  # final norm
+    return int(total)
 
 
 def throughput(tokens: int, seconds: float, chips: int = 1) -> dict[str, float]:
