@@ -76,6 +76,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="kv_cache mode: keep streamed weights on chip after "
                         "prefill when they fit (auto = judge against the "
                         "chip's HBM), so decode steps move zero weight bytes")
+    p.add_argument("--decode_fused", type=str, default="auto",
+                   choices=("auto", "on", "off"),
+                   help="resident kv_cache mode: run ALL greedy decode steps "
+                        "as one compiled program per block (on-device argmax, "
+                        "zero per-token host round-trips); 'on' errors if the "
+                        "preconditions don't hold")
     # --- TPU-specific ---
     p.add_argument("--dtype", type=str, default="bfloat16",
                    choices=["bfloat16", "float16", "float32"])
@@ -136,6 +142,7 @@ def config_from_args(args: argparse.Namespace) -> FrameworkConfig:
         resume=args.resume,
         long_context=args.long_context,
         decode_resident=args.decode_resident,
+        decode_fused=args.decode_fused,
         temperature=args.temperature,
         top_k=args.top_k,
         top_p=args.top_p,

@@ -603,6 +603,15 @@ class FrameworkConfig:
     # chip's known HBM — leaving room for KV caches, activations, and the
     # prefill-time prefetch queue; unknown HBM resolves to off.
     decode_resident: str = "auto"  # 'auto' | 'on' | 'off'
+    # Fused decode: run ALL greedy decode steps as one jitted scan per block
+    # (runtime/decode._fused_decode_steps) instead of one dispatch per shard
+    # per step. 'auto' fuses whenever the preconditions hold (weights
+    # resident, greedy selection, one placement target); 'on' additionally
+    # raises if they don't (so a user asking for it learns why not); 'off'
+    # keeps the per-step loop (bitwise-stable vs the streamed path — fusing
+    # changes XLA fusion boundaries, so float results can differ in the
+    # last ulp).
+    decode_fused: str = "auto"  # 'auto' | 'on' | 'off'
     # Sampling controls (generation_loop.sample_token semantics): 0 = greedy
     # argmax (exact reference behaviour, /root/reference/main.py:47-48 left
     # the temperature flag commented out). Deterministic given seed.
@@ -644,6 +653,10 @@ class FrameworkConfig:
                 "decode_resident must be auto|on|off, "
                 f"got {self.decode_resident!r}"
             )
+        if self.decode_fused not in ("auto", "on", "off"):
+            raise ValueError(
+                f"decode_fused must be auto|on|off, got {self.decode_fused!r}"
+            )
 
     def effective_prefetch_depth(self) -> int:
         """Resolve the tri-state ``prefetch_depth``: explicit value, or auto —
@@ -676,7 +689,7 @@ class FrameworkConfig:
             return False
         from flexible_llm_sharding_tpu.utils.metrics import (
             chip_hbm_gb,
-            param_count,
+            weight_bytes_per_chip,
         )
 
         try:
@@ -685,8 +698,7 @@ class FrameworkConfig:
             return False
         if not hbm_gb:
             return False
-        bytes_per = {"float32": 4, "bfloat16": 2, "float16": 2}[self.dtype]
-        per_chip = param_count(model_cfg) * bytes_per / max(n_weight_chips, 1)
+        per_chip = weight_bytes_per_chip(model_cfg, self.dtype, n_weight_chips)
         return per_chip <= 0.45 * hbm_gb * 1e9
 
     def pallas_enabled(self) -> bool:

@@ -92,9 +92,17 @@ def _prefill_decoders(
     return prefix_h, suffix_h, kv
 
 
-@partial(jax.jit, static_argnums=(0, 1, 2), donate_argnums=(4, 5))
-def _decode_decoders(
-    cfg: LlamaConfig, use_pallas, tp_mesh, seg, kv, x, prefix_len, suffix_eos, t
+def _decode_decoders_impl(
+    cfg: LlamaConfig,
+    use_pallas,
+    tp_mesh,
+    seg,
+    kv,
+    x,
+    prefix_len,
+    suffix_eos,
+    t,
+    gen_only: bool = False,
 ):
     """Scan k layers' single-token decode over a block.
 
@@ -102,7 +110,9 @@ def _decode_decoders(
     "rope": bool [k] or None};
     kv: pytree with leaves [k, B, ...] (kg/vg slots < t filled); x [B, S, 1, D];
     prefix_len [B]; suffix_eos [B, S]; t scalar. Returns (x, kv updated at t).
-    kv and x are donated — each step reuses the previous buffers.
+    ``gen_only`` (static) returns only the mutated {'kg','vg'} leaves as the
+    scan's stacked output — the fused step path uses it so the read-only
+    prefix/suffix KV is never re-materialised by the layer scan.
     """
     stacked, flags, rflags = seg["layers"], seg["sliding"], seg.get("rope")
 
@@ -119,14 +129,22 @@ def _decode_decoders(
             in_axes=(None, None, 0, 0, 0, 0, None),
         )
         x, layer_kv = step(layer_params, cfg, x, layer_kv, prefix_len, suffix_eos, t)
+        if gen_only:
+            layer_kv = {"kg": layer_kv["kg"], "vg": layer_kv["vg"]}
         return x, layer_kv
 
     x, kv = jax.lax.scan(body, x, (stacked, flags, rflags, kv))
     return x, kv
 
 
-@partial(jax.jit, static_argnums=(0,))
-def _decode_norm_head(cfg: LlamaConfig, norm_params, head_params, x):
+# Per-step jitted form (the streaming / sampling decode loop): kv and x are
+# donated — each step reuses the previous buffers.
+_decode_decoders = jax.jit(
+    _decode_decoders_impl, static_argnums=(0, 1, 2), donate_argnums=(4, 5)
+)
+
+
+def _decode_norm_head_impl(cfg: LlamaConfig, norm_params, head_params, x):
     """x [B, S, 1, D] -> float32 next-token distributions [B, S, V]."""
     from flexible_llm_sharding_tpu.ops import rms_norm
 
@@ -137,14 +155,84 @@ def _decode_norm_head(cfg: LlamaConfig, norm_params, head_params, x):
     )(head_params, h)
 
 
+_decode_norm_head = jax.jit(_decode_norm_head_impl, static_argnums=(0,))
+
+
+@partial(jax.jit, static_argnums=(0, 1, 2, 3, 4), donate_argnums=(7,))
+def _fused_decode_steps(
+    cfg: LlamaConfig,
+    use_pallas,
+    tp_mesh,
+    n_steps: int,
+    dtype,
+    segs,
+    kv_static,
+    kv_gen,
+    embed_params,
+    norm_params,
+    head_params,
+    init_ids,
+    prefix_len,
+    suffix_eos,
+):
+    """ALL greedy decode steps for one block as ONE XLA program.
+
+    When the weights are resident (DecodeGenerator._resident) and selection
+    is greedy, the per-step Python loop — one jitted dispatch per shard per
+    step plus a host round-trip per token pick — is pure overhead: every
+    dispatch crosses the host->device link (an RPC through the axon tunnel),
+    and the KV pytrees bounce host<->HBM when the store is host-resident.
+    This fuses the whole generation into one ``lax.scan`` over steps: embed
+    the previous pick, run every decoder segment's layer scan (KV slot ``t``
+    updated in place via donation), norm+head, and pick the next token with
+    an ON-DEVICE argmax (bitwise the same winner as the host ``np.argmax``
+    both paths take on ties: first index of the float32 max).
+
+    The reference re-runs its entire sharded forward per token from Python
+    (``/root/reference/main.py:63-90``); this is the opposite end of the
+    design space — zero host involvement between tokens.
+
+    segs: tuple of decoder segments (each ``{"layers", "sliding", "rope"}``)
+    in layer order. The KV splits by mutability so the scan carries only
+    what changes: ``kv_static`` (per-segment {'kp','vp','ks','vs'}) is
+    closed over — one copy for the whole program — while ``kv_gen``
+    (per-segment {'kg','vg'}, donated) threads through the carry and is
+    updated at slot ``t`` each step. init_ids [B, S] = prefill's pick.
+    Returns (dists [n_steps, B, S, V] float32, toks [n_steps, B, S]).
+    """
+
+    def one_step(carry, t):
+        ids, gens = carry
+        x = llama.embed(embed_params, ids[..., None], dtype, cfg)
+        new_gens = []
+        for seg, stat, gen in zip(segs, kv_static, gens):
+            x, gen = _decode_decoders_impl(
+                cfg, use_pallas, tp_mesh, seg, {**stat, **gen}, x,
+                prefix_len, suffix_eos, t, gen_only=True,
+            )
+            new_gens.append(gen)
+        dist = _decode_norm_head_impl(cfg, norm_params, head_params, x)
+        ids_next = jnp.argmax(dist, axis=-1).astype(jnp.int32)
+        return (ids_next, tuple(new_gens)), (dist, ids_next)
+
+    (_, _), (dists, toks) = jax.lax.scan(
+        one_step,
+        (jnp.asarray(init_ids, jnp.int32), kv_gen),
+        jnp.arange(n_steps, dtype=jnp.int32),
+    )
+    return dists, toks
+
+
 # ---------------------------------------------------------------------------
 # KV parking between shards / steps
 # ---------------------------------------------------------------------------
 
 class KVStore:
-    """Per-(shard, block) KV pytrees: HBM-resident ('tpu') or host RAM ('cpu'
-    and 'disk' — decode-mode KV always parks in RAM; its per-step access
-    pattern would thrash a disk)."""
+    """Per-(shard, block) KV pytrees. ``on_device`` keeps them in HBM —
+    chosen for storage_location='tpu', and also for 'cpu'/'disk' when the
+    weights are resident and the KV fits beside them (_kv_fits_on_chip);
+    otherwise they park in host RAM (never on disk — the per-step access
+    pattern would thrash it)."""
 
     def __init__(self, on_device: bool):
         self.on_device = on_device
@@ -257,20 +345,103 @@ class DecodeGenerator:
         # mesh splits each shard tp-ways, the MP pipeline spreads stages
         # round-robin. DP passes the decision in (``resident=``) so all
         # ranks agree with the shared broadcast source's round count.
+        if self._tp_mesh is not None:
+            self._n_chips = self._tp_mesh.devices.size
+            self._probe_dev = next(iter(self._tp_mesh.devices.flat))
+        else:
+            distinct = {id(d) for d in self.shard_devices}
+            self._n_chips = max(len(distinct), 1)
+            self._probe_dev = self.shard_devices[0]
         if resident is not None:
             self._resident = resident
         else:
-            if self._tp_mesh is not None:
-                n_chips = self._tp_mesh.devices.size
-                probe_dev = next(iter(self._tp_mesh.devices.flat))
-            else:
-                distinct = {id(d) for d in self.shard_devices}
-                n_chips = max(len(distinct), 1)
-                probe_dev = self.shard_devices[0]
             self._resident = cfg.decode_resident_enabled(
-                self.model_cfg, n_chips, probe_dev
+                self.model_cfg, self._n_chips, self._probe_dev
             )
+        # One placement target for the whole model (single chip, or one tp
+        # mesh) — the precondition for fusing all decode steps into a single
+        # XLA program (the MP pipeline's stages live on different chips and
+        # keep the per-step loop).
+        self._single_placement = (
+            self._tp_mesh is not None
+            or len({id(d) for d in self.shard_devices}) <= 1
+        )
         self.stats: dict[str, float] = {}
+
+    def _hbm_gb(self) -> float | None:
+        from flexible_llm_sharding_tpu.utils.metrics import chip_hbm_gb
+
+        try:
+            return chip_hbm_gb(self._probe_dev)
+        except Exception:
+            return None
+
+    def _weight_bytes(self) -> float:
+        from flexible_llm_sharding_tpu.utils.metrics import (
+            weight_bytes_per_chip,
+        )
+
+        return weight_bytes_per_chip(
+            self.model_cfg, self.cfg.dtype, self._n_chips
+        )
+
+    def _block_kv_bytes(self, toks, idxs, n_gen: int) -> int:
+        """Decode KV bytes for one block (all layers, compute dtype)."""
+        mc = self.model_cfg
+        t0 = toks[idxs[0]]
+        s_b, ls = t0.suffix_ids.shape
+        lp = t0.prefix_ids.shape[-1]
+        per_layer = (
+            2  # k and v
+            * len(idxs)
+            * (lp + s_b * (ls + max(1, n_gen - 1)))
+            * mc.num_key_value_heads
+            * mc.head_dim
+        )
+        bpe = np.dtype(np_dtype_for(self.cfg.dtype)).itemsize
+        return per_layer * mc.num_hidden_layers * bpe
+
+    def _kv_fits_on_chip(self, toks, blocks, n_gen: int) -> bool:
+        """Whether every block's decode KV can stay in HBM alongside the
+        resident weights (known-HBM chips only: weights + KV within 80% of
+        the chip). A host-parked KV store costs a full KV round trip per
+        shard per decode step over the host->HBM link — on the axon tunnel
+        that dwarfs the decode math itself."""
+        hbm_gb = self._hbm_gb()
+        if not hbm_gb:
+            return False
+        kv_bytes = sum(self._block_kv_bytes(toks, i, n_gen) for i in blocks)
+        return self._weight_bytes() + kv_bytes <= 0.8 * hbm_gb * 1e9
+
+    def _fused_budget_ok(
+        self, toks, blocks, n_gen: int, kv_on_device: bool
+    ) -> bool:
+        """Whether the fused scan's on-chip footprint fits: resident weights
+        + KV (every block when the store is device-resident, else the
+        largest single block staged per dispatch) + the scan's accumulated
+        float32 dists stack [n_steps, B, S, V]. On the CPU backend "device
+        memory" is host RAM — always ok; an accelerator with UNKNOWN HBM
+        cannot be budgeted, so fusion stands down."""
+        dev = self._probe_dev
+        if dev is None:
+            dev = jax.local_devices()[0]
+        if getattr(dev, "platform", None) == "cpu":
+            return True
+        hbm_gb = self._hbm_gb()
+        if not hbm_gb:
+            return False
+        per_block_kv = [self._block_kv_bytes(toks, i, n_gen) for i in blocks]
+        kv_bytes = sum(per_block_kv) if kv_on_device else max(per_block_kv)
+        dists_bytes = max(
+            (n_gen - 1)
+            * len(idxs)
+            * toks[idxs[0]].suffix_ids.shape[0]
+            * self.model_cfg.vocab_size
+            * 4
+            for idxs in blocks
+        )
+        total = self._weight_bytes() + kv_bytes + dists_bytes
+        return total <= 0.8 * hbm_gb * 1e9
 
     def _open_streams(self, n_streams: int):
         """(per-pass stream factory, closer) for ``n_streams`` full weight
@@ -312,8 +483,38 @@ class DecodeGenerator:
         t_start = time.perf_counter()
         toks = [self.tokenizer(p, s) for p, s in prompts]
         blocks = make_blocks(toks, cfg.block_size)
-        kv_store = KVStore(on_device=cfg.storage_location == "tpu")
+        # KV follows the weights: once the model is resident there is HBM
+        # headroom, and host-parked KV would be re-uploaded per shard per
+        # step — the dominant cost of a resident decode step.
+        kv_on_device = cfg.storage_location == "tpu" or (
+            self._resident and self._kv_fits_on_chip(toks, blocks, n_gen)
+        )
+        kv_store = KVStore(on_device=kv_on_device)
         n_layers = len(self.layer_names)
+        # Greedy + resident + one placement: run every decode step inside a
+        # single jitted scan per block (_fused_decode_steps) instead of the
+        # per-shard dispatch loop. Sampling keeps the loop (the numpy rng
+        # stream is part of the documented determinism contract).
+        budget_ok = bool(blocks) and self._fused_budget_ok(
+            toks, blocks, n_gen, kv_on_device
+        )
+        fused = (
+            cfg.decode_fused != "off"
+            and self._resident
+            and self._single_placement
+            and cfg.temperature <= 0
+            and n_gen > 1
+            and budget_ok
+        )
+        if cfg.decode_fused == "on" and not fused and n_gen > 1 and blocks:
+            raise ValueError(
+                "decode_fused='on' needs resident weights, greedy selection, "
+                "a single placement target (no MP pipeline), and the fused "
+                "footprint (weights + KV + dists) within the chip's HBM; got "
+                f"resident={self._resident} temperature={cfg.temperature} "
+                f"single_placement={self._single_placement} "
+                f"hbm_budget_ok={budget_ok}"
+            )
 
         block_meta = {
             b: (
@@ -413,8 +614,65 @@ class DecodeGenerator:
                     if layer_idxs[-1] != n_layers - 1:
                         kv_store.put(("h", b), (ph, sh))
 
+            # --- decode steps ---------------------------------------------
+            if fused:
+                # Resident fused path: gather the kept segments once, then
+                # one dispatch per block runs ALL steps on device.
+                embed_p = norm_p = head_p = None
+                dec_keys: list[tuple[int, int]] = []
+                segs: list = []
+                for shard_pos, (layer_idxs, segments) in kept:
+                    di = 0
+                    for kind, params in segments:
+                        if kind == "embed":
+                            embed_p = params
+                        elif kind == "decoders":
+                            dec_keys.append((shard_pos, di))
+                            segs.append(params)
+                            di += 1
+                        elif kind == "norm":
+                            norm_p = params
+                        else:
+                            head_p = params
+                dev0 = self.shard_devices[0]
+                act_dev = getattr(dev0, "act", dev0)
+                for b, idxs in enumerate(blocks):
+                    _, _, prefix_len, suffix_eos = block_meta[b]
+                    kv_pairs = [
+                        kv_store.get(("kv", sp, di, b), act_dev)
+                        for sp, di in dec_keys
+                    ]
+                    kv_static = tuple(
+                        {k: v for k, v in kv.items() if k not in ("kg", "vg")}
+                        for kv in kv_pairs
+                    )
+                    kv_gen = tuple(
+                        {"kg": kv["kg"], "vg": kv["vg"]} for kv in kv_pairs
+                    )
+                    del kv_pairs
+                    dists, picks = _fused_decode_steps(
+                        self.model_cfg,
+                        self._use_pallas,
+                        self._tp_mesh,
+                        n_gen - 1,
+                        self.dtype,
+                        tuple(segs),
+                        kv_static,
+                        kv_gen,
+                        embed_p,
+                        norm_p,
+                        head_p,
+                        jnp.asarray(tok_hist[b][-1], jnp.int32),
+                        prefix_len,
+                        suffix_eos,
+                    )
+                    dists = np.asarray(jax.device_get(dists))
+                    picks = np.asarray(jax.device_get(picks))
+                    for s_i in range(n_gen - 1):
+                        all_scores[b].append(dists[s_i])
+                        tok_hist[b].append(picks[s_i])
             # --- decode steps: stream weights, one token per suffix ------
-            for t in range(n_gen - 1):
+            for t in ([] if fused else range(n_gen - 1)):
                 # model.norm always executes before lm_head; its params (set
                 # at the norm shard) are carried here across shard iterations
                 # when the two land in different shards (layer_num_per_shard=1).
@@ -477,6 +735,8 @@ class DecodeGenerator:
         self.stats = {
             "total_wall_s": time.perf_counter() - t_start,
             "decode_resident": float(self._resident),
+            "decode_fused": float(fused),
+            "decode_kv_on_device": float(kv_on_device),
             # Prefill runs every real prompt token once; each decode step
             # then runs exactly one new token per true suffix.
             "tokens_processed": float(

@@ -407,6 +407,18 @@ def chip_hbm_gb(device=None) -> float | None:
     return _kind_lookup(device, _HBM_GB)
 
 
+# Bytes per element at each supported compute dtype — the shared factor of
+# every HBM-budget gate (resident weights, kv-on-device, fused decode).
+_DTYPE_BYTES = {"float32": 4, "bfloat16": 2, "float16": 2}
+
+
+def weight_bytes_per_chip(cfg, dtype: str, n_chips: int = 1) -> float:
+    """Materialised parameter bytes per chip at compute dtype — the shared
+    numerator of the resident-decode (config.decode_resident_enabled) and
+    kv-on-device / fused-decode (runtime.decode) HBM gates."""
+    return param_count(cfg) * _DTYPE_BYTES[dtype] / max(n_chips, 1)
+
+
 def param_count(cfg) -> int:
     """Total parameter count for a LlamaConfig — ALL weights as materialised
     on device at compute dtype (every expert, embeddings, untied head; int8

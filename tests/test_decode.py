@@ -375,7 +375,9 @@ def test_decode_tensor_parallel_matches_oracle(tiny_cfg, model):
 def test_decode_resident_matches_streamed(tiny_cfg, model, storage, lnps):
     """decode_resident='on' keeps every placed shard on chip after prefill;
     decode steps then walk the retained segments. Same arrays, same jitted
-    programs -> scores must equal the re-streaming path bitwise."""
+    programs -> scores must equal the re-streaming path bitwise
+    (decode_fused='off' pins the per-step loop; the fused scan compiles a
+    different program and is covered by its own tests below)."""
     model_dir, _ = model
 
     def cfg(resident):
@@ -389,6 +391,7 @@ def test_decode_resident_matches_streamed(tiny_cfg, model, storage, lnps):
             prefetch_depth=0,
             num_gen_token=N_GEN,
             decode_resident=resident,
+            decode_fused="off",
         )
 
     want, _ = DecodeGenerator(cfg("off"), tokenizer=FakeTokenizer())(list(PROMPTS))
@@ -419,6 +422,7 @@ def test_decode_resident_dp(tiny_cfg, model):
             num_gen_token=N_GEN,
             data_parallel=True,
             decode_resident=resident,
+            decode_fused="off",
         )
 
     want, want_up, want_tok = run_decode(
@@ -488,3 +492,166 @@ def test_decode_resident_auto_gate(tiny_cfg):
     assert not FrameworkConfig(decode_resident="off").decode_resident_enabled(
         tiny_cfg, 1, FakeDev()
     )
+
+
+# ---------------------------------------------------------------------------
+# Fused resident decode (all steps as one jitted scan per block)
+# ---------------------------------------------------------------------------
+
+def test_decode_fused_matches_loop_and_oracle(tiny_cfg, model):
+    """decode_fused + resident + greedy runs every decode step inside ONE
+    jitted scan per block with an on-device argmax. Same math, different XLA
+    fusion boundaries -> allclose scores and identical greedy strings vs the
+    per-step loop, and oracle-level agreement with the monolithic forward."""
+    model_dir, params = model
+
+    def cfg(fused):
+        return FrameworkConfig(
+            model_path=model_dir,
+            layer_num_per_shard=2,
+            storage_location="cpu",
+            dtype="float32",
+            bucket_multiple=8,
+            block_size=2,
+            prefetch_depth=0,
+            num_gen_token=N_GEN,
+            decode_resident="on",
+            decode_fused=fused,
+        )
+
+    want, want_up = DecodeGenerator(cfg("off"), tokenizer=FakeTokenizer())(
+        list(PROMPTS)
+    )
+    gen = DecodeGenerator(cfg("on"), tokenizer=FakeTokenizer())
+    got, got_up = gen(list(PROMPTS))
+    assert gen.stats["decode_fused"] == 1.0
+    assert got_up == want_up
+    for g, w in zip(got, want):
+        np.testing.assert_allclose(g, w, rtol=1e-5, atol=1e-6)
+    tok = PromptTokenizer(FakeTokenizer(), bucket_multiple=8)
+    oracle_s, _ = _oracle(params, tiny_cfg, tok, PROMPTS, N_GEN)
+    for g, w in zip(got, oracle_s):
+        np.testing.assert_allclose(g, w, rtol=1e-4, atol=1e-5)
+
+
+def test_decode_fused_multi_segment(tmp_path_factory):
+    """A mixed dense/MoE stack (llama4-style) yields SEVERAL decoder
+    segments per shard, each with its own KV pytree; the fused program
+    chains their layer scans inside the one step body."""
+    from flexible_llm_sharding_tpu.config import LlamaConfig
+
+    cfg = LlamaConfig(
+        model_type="llama4_text",
+        vocab_size=288,
+        hidden_size=64,
+        intermediate_size=32,
+        intermediate_size_mlp=48,
+        num_hidden_layers=3,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        explicit_head_dim=16,
+        max_position_embeddings=512,
+        num_local_experts=2,
+        num_experts_per_tok=1,
+        moe_layer_pattern=(False, True, True),
+        layer_rope=(True, True, False),
+        rope_interleaved=True,
+        qk_l2_norm=True,
+        attn_temperature_tuning=True,
+        attn_floor_scale=4.0,
+        attn_scale_coef=0.1,
+        tie_word_embeddings=False,
+    )
+    params = llama.init_mixed_params(jax.random.PRNGKey(7), cfg)
+    d = tmp_path_factory.mktemp("fused_l4_model")
+    save_params(jax.tree.map(np.asarray, params), str(d), cfg)
+
+    def fw(fused):
+        return FrameworkConfig(
+            model_path=str(d),
+            layer_num_per_shard=3,
+            storage_location="cpu",
+            dtype="float32",
+            bucket_multiple=8,
+            block_size=2,
+            prefetch_depth=0,
+            num_gen_token=N_GEN,
+            decode_resident="on",
+            decode_fused=fused,
+        )
+
+    want, want_up = DecodeGenerator(fw("off"), tokenizer=FakeTokenizer())(
+        list(PROMPTS)
+    )
+    gen = DecodeGenerator(fw("auto"), tokenizer=FakeTokenizer())
+    got, got_up = gen(list(PROMPTS))
+    assert gen.stats["decode_fused"] == 1.0
+    assert got_up == want_up
+    for g, w in zip(got, want):
+        np.testing.assert_allclose(g, w, rtol=1e-5, atol=1e-6)
+
+
+def test_decode_fused_on_requires_preconditions(tiny_cfg, model):
+    """decode_fused='on' is loud about why fusion can't engage: sampling,
+    non-resident streaming, and the MP pipeline all keep the per-step loop."""
+    model_dir, _ = model
+    base = dict(
+        model_path=model_dir,
+        layer_num_per_shard=1,
+        storage_location="cpu",
+        dtype="float32",
+        bucket_multiple=8,
+        block_size=2,
+        prefetch_depth=0,
+        num_gen_token=N_GEN,
+    )
+    cfg = FrameworkConfig(
+        **base, decode_resident="on", decode_fused="on", temperature=0.7
+    )
+    with pytest.raises(ValueError, match="decode_fused"):
+        DecodeGenerator(cfg, tokenizer=FakeTokenizer())(list(PROMPTS))
+    cfg = FrameworkConfig(**base, decode_resident="off", decode_fused="on")
+    with pytest.raises(ValueError, match="decode_fused"):
+        DecodeGenerator(cfg, tokenizer=FakeTokenizer())(list(PROMPTS))
+    cfg = FrameworkConfig(**base, decode_resident="on", decode_fused="on")
+    with pytest.raises(ValueError, match="decode_fused"):
+        DecodeGenerator(
+            cfg, tokenizer=FakeTokenizer(), mp_devices=jax.devices()[:3]
+        )(list(PROMPTS))
+
+
+def test_decode_kv_on_device_gate(tiny_cfg, model):
+    """KV follows the weights onto the chip only where the HBM budget is
+    known: weights + every block's KV within 80%. The CPU backend (unknown
+    kind) stays host-parked."""
+    from flexible_llm_sharding_tpu.runtime.tokenization import make_blocks
+
+    model_dir, _ = model
+    cfg = FrameworkConfig(
+        model_path=model_dir,
+        num_gen_token=N_GEN,
+        bucket_multiple=8,
+        block_size=2,
+        dtype="float32",
+        decode_resident="on",
+    )
+    gen = DecodeGenerator(cfg, tokenizer=FakeTokenizer())
+    toks = [gen.tokenizer(p, s) for p, s in PROMPTS]
+    blocks = make_blocks(toks, 2)
+    assert not gen._kv_fits_on_chip(toks, blocks, N_GEN)  # unknown HBM
+
+    class FakeDev:
+        device_kind = "TPU v5 lite"
+
+        def memory_stats(self):
+            return None
+
+    gen._probe_dev = FakeDev()
+    assert gen._kv_fits_on_chip(toks, blocks, N_GEN)
+    # Fused budget: fits for the tiny workload on a known chip, refuses when
+    # the generated-KV + dists footprint outgrows the HBM, and is always ok
+    # on the CPU backend (device memory IS host RAM).
+    assert gen._fused_budget_ok(toks, blocks, N_GEN, kv_on_device=True)
+    assert not gen._fused_budget_ok(toks, blocks, 10**7, kv_on_device=True)
+    gen._probe_dev = None
+    assert gen._fused_budget_ok(toks, blocks, 10**7, kv_on_device=False)
