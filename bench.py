@@ -387,7 +387,35 @@ def bench_decode(cfg_obj, prompts, tok, result: dict, n_tok: int = 4) -> None:
         result["pallas_decode_speedup"] = round(t_xla_dec / t_kv, 3)
 
 
+def _set_throughput(result: dict, total_tokens: int, wall: float, dev) -> None:
+    """Headline throughput + derived MFU/TFLOPs from the best overlapped
+    wall — ONE derivation shared by the first-measure and post-pairs sites."""
+    tps = total_tokens / wall
+    result["value"] = round(tps, 2)
+    result["tokens_per_sec"] = round(tps, 2)
+    result["tokens_per_sec_per_chip"] = round(tps, 2)  # single-chip bench
+    fpt = result.get("model_flops_per_token")
+    if fpt:
+        from flexible_llm_sharding_tpu.utils.metrics import chip_peak_flops
+
+        result["model_tflops_per_sec"] = round(fpt * tps / 1e12, 4)
+        peak_fl = chip_peak_flops(dev)
+        if peak_fl:
+            result["mfu"] = round(fpt * tps / peak_fl, 6)
+
+
 def run_bench(result: dict) -> None:
+    t_bench0 = time.perf_counter()
+    deadline_s = float(os.environ.get("BENCH_DEADLINE_S", "2400"))
+
+    def budget_left() -> float:
+        """Fraction of the watchdog deadline still unspent — phase loops
+        stop repeating when the later phases (pallas, decode) would starve.
+        A non-positive deadline means 'no watchdog': never stop early."""
+        if deadline_s <= 0:
+            return 1.0
+        return 1.0 - (time.perf_counter() - t_bench0) / deadline_s
+
     jax, devs = _init_jax()
     try:
         # Persistent XLA compilation cache: a re-run (or a watchdog-killed
@@ -485,10 +513,6 @@ def run_bench(result: dict) -> None:
     _, wall2, _ = run_once(cfg_default, prompts, tok)
     wall_overlap = min(wall_overlap, wall2)
 
-    tps = total_tokens / wall_overlap
-    result["value"] = round(tps, 2)
-    result["tokens_per_sec"] = round(tps, 2)
-    result["tokens_per_sec_per_chip"] = round(tps, 2)  # single-chip bench
     peak = peak_hbm_gb()
     if peak is not None:
         result["peak_hbm_gb"] = round(peak, 3)
@@ -504,35 +528,55 @@ def run_bench(result: dict) -> None:
     try:
         from flexible_llm_sharding_tpu.config import LlamaConfig
         from flexible_llm_sharding_tpu.utils.metrics import (
-            chip_peak_flops,
             model_flops_per_token,
         )
 
         mean_ctx = int(np.mean([len(i) for i in ids]))
         fpt = model_flops_per_token(LlamaConfig(**cfg_kwargs), mean_ctx)
         result["model_flops_per_token"] = round(fpt)
-        result["model_tflops_per_sec"] = round(fpt * tps / 1e12, 4)
-        peak_fl = chip_peak_flops(devs[0])
-        if peak_fl:
-            result["mfu"] = round(fpt * tps / peak_fl, 6)
     except Exception:
         log("mfu accounting failed:\n" + traceback.format_exc())
+    _set_throughput(result, total_tokens, wall_overlap, devs[0])
 
-    log("serialized (prefetch=0, reference schedule) ...")
-    _, wall_serial, ex0 = run_once(fw(0), prompts, tok)
-    log(f"  wall={wall_serial:.2f}s stats={ex0.stats}")
-    _, wall_s2, _ = run_once(fw(0), prompts, tok)
-    wall_serial = min(wall_serial, wall_s2)
     if eff == 0:
         # The platform-tuned schedule IS the serialized reference schedule
         # here (no transfer link to hide) — identical configs, so the true
-        # ratio is 1 by construction; the measured ratio of the two
-        # identical runs is recorded for transparency.
+        # ratio is 1 by construction; the measured ratio of two identical
+        # runs is recorded for transparency.
+        log("serialized (prefetch=0) == platform schedule; one extra rep ...")
+        _, wall_serial, _ = run_once(fw(0), prompts, tok)
         result["vs_baseline"] = 1.0
         result["schedules_identical"] = True
         result["measured_ratio"] = round(wall_serial / wall_overlap, 3)
     else:
-        result["vs_baseline"] = round(wall_serial / wall_overlap, 3)
+        # PAIRED serialized-vs-overlapped reps. The axon tunnel's bandwidth
+        # swings ~10x minute-to-minute (observed 0.02-0.24 GB/s within one
+        # bench), so measuring all serialized reps then all overlapped reps
+        # compares two different links; back-to-back pairs see ~the same
+        # conditions, and the MEDIAN of per-pair ratios rejects the rep
+        # where the link flipped mid-pair. Time-bounded so a slow link
+        # still yields at least one pair inside the watchdog deadline.
+        log("serialized (prefetch=0, reference schedule), paired reps ...")
+        ratios = []
+        for i in range(3):
+            _, w_ser, _ = run_once(fw(0), prompts, tok)
+            _, w_ovl, _ = run_once(cfg_default, prompts, tok)
+            ratios.append(w_ser / w_ovl)
+            wall_overlap = min(wall_overlap, w_ovl)
+            log(f"  pair {i}: serial={w_ser:.2f}s overlap={w_ovl:.2f}s "
+                f"ratio={ratios[-1]:.3f}")
+            result["vs_baseline"] = round(float(np.median(ratios)), 3)
+            result["overlap_pair_ratios"] = [round(r, 3) for r in ratios]
+            if budget_left() < 0.6:
+                # Leave the majority of the deadline for the int8 pairs and
+                # the pallas/decode phases — a slow link must not starve
+                # them into carried_forward-only captures.
+                log("  schedule-pair budget exhausted; stopping reps")
+                break
+        # The pairs may have seen a faster link than the headline reps;
+        # keep throughput/MFU consistent with the best overlapped wall.
+        if total_tokens / wall_overlap > (result["value"] or 0):
+            _set_throughput(result, total_tokens, wall_overlap, devs[0])
 
     if not on_tpu:
         # int8 streaming compresses the host->HBM link; on the CPU backend
@@ -567,9 +611,22 @@ def run_bench(result: dict) -> None:
 
         q8_cfg = dataclasses.replace(fw(2), model_path=q8_path)
         run_once(q8_cfg, prompts, tok)  # warm/compile
-        _, wall_q8, _ = run_once(q8_cfg, prompts, tok)
-        log(f"int8 stream: wall={wall_q8:.2f}s (bf16 {wall_overlap:.2f}s)")
-        result["int8_speedup"] = round(wall_overlap / wall_q8, 3)
+        # Paired with fresh bf16 runs (same rationale as the schedule
+        # pairs: the tunnel's speed drifts too much to reuse an earlier
+        # bf16 wall measured minutes ago).
+        # 3 pairs so the median can actually REJECT a link-flip outlier
+        # (the median of 2 is their mean — no rejection at all).
+        i8_ratios = []
+        for i in range(3):
+            _, wall_q8, _ = run_once(q8_cfg, prompts, tok)
+            _, w_bf16, _ = run_once(cfg_default, prompts, tok)
+            i8_ratios.append(w_bf16 / wall_q8)
+            log(f"int8 pair {i}: q8={wall_q8:.2f}s bf16={w_bf16:.2f}s "
+                f"ratio={i8_ratios[-1]:.3f}")
+            result["int8_speedup"] = round(float(np.median(i8_ratios)), 3)
+            if budget_left() < 0.35:
+                log("int8 pair budget exhausted; stopping reps")
+                break
     except Exception:
         log("int8 bench failed:\n" + traceback.format_exc())
 
