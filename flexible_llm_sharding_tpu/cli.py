@@ -82,6 +82,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "as one compiled program per block (on-device argmax, "
                         "zero per-token host round-trips); 'on' errors if the "
                         "preconditions don't hold")
+    p.add_argument("--speculative_k", type=int, default=0,
+                   help="kv_cache mode: verify this many prompt-lookup "
+                        "drafted tokens per streamed pass (greedy-exact; "
+                        "divides weight streams per token by the acceptance "
+                        "factor when the model must re-stream); 0 = off")
     # --- TPU-specific ---
     p.add_argument("--dtype", type=str, default="bfloat16",
                    choices=["bfloat16", "float16", "float32"])
@@ -143,6 +148,7 @@ def config_from_args(args: argparse.Namespace) -> FrameworkConfig:
         long_context=args.long_context,
         decode_resident=args.decode_resident,
         decode_fused=args.decode_fused,
+        speculative_k=args.speculative_k,
         temperature=args.temperature,
         top_k=args.top_k,
         top_p=args.top_p,
@@ -161,6 +167,18 @@ def main(argv: list[str] | None = None, tokenizer=None) -> None:
         # Same silent-no-op defence: the flag only drives the KV-decode
         # path; without --kv_cache weights would quietly re-stream.
         raise SystemExit("--decode_resident on requires --kv_cache true")
+    if args.speculative_k:
+        if not args.kv_cache:
+            raise SystemExit("--speculative_k requires --kv_cache true")
+        if args.data_parallel:
+            raise SystemExit(
+                "--speculative_k does not compose with --data_parallel "
+                "(the broadcast source's round count is fixed up front)"
+            )
+        if args.long_context:
+            raise SystemExit(
+                "--speculative_k is not supported with --long_context yet"
+            )
     cfg = config_from_args(args)
 
     if args.coordinator_address is not None:

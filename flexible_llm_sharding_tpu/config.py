@@ -612,6 +612,15 @@ class FrameworkConfig:
     # changes XLA fusion boundaries, so float results can differ in the
     # last ulp).
     decode_fused: str = "auto"  # 'auto' | 'on' | 'off'
+    # Speculative decode (kv_cache mode): each streamed pass verifies
+    # `speculative_k` prompt-lookup-drafted tokens PLUS the next token in
+    # one K+1-position decode step, emitting 1..K+1 tokens per pass —
+    # dividing the number of full weight streams per generated token by the
+    # acceptance factor. Greedy-exact (verification accepts precisely the
+    # tokens sequential greedy would emit); 0 disables. Ignored when the
+    # fused resident path engages (resident steps don't re-stream weights,
+    # so there is nothing to amortise).
+    speculative_k: int = 0
     # Sampling controls (generation_loop.sample_token semantics): 0 = greedy
     # argmax (exact reference behaviour, /root/reference/main.py:47-48 left
     # the temperature flag commented out). Deterministic given seed.
@@ -657,6 +666,15 @@ class FrameworkConfig:
             raise ValueError(
                 f"decode_fused must be auto|on|off, got {self.decode_fused!r}"
             )
+        if not 0 <= self.speculative_k <= 64:
+            raise ValueError(
+                f"speculative_k must be in [0, 64], got {self.speculative_k}"
+            )
+        if self.speculative_k and self.temperature > 0:
+            # Greedy verification is exact; sampled verification would need
+            # rejection sampling to preserve the output distribution —
+            # loudly unsupported rather than silently wrong.
+            raise ValueError("speculative_k requires greedy (temperature=0)")
 
     def effective_prefetch_depth(self) -> int:
         """Resolve the tri-state ``prefetch_depth``: explicit value, or auto —

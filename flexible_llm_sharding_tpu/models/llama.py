@@ -592,33 +592,51 @@ def decode_step_layer(
     use_pallas: bool = False,
     tp_mesh=None,
 ) -> tuple[jax.Array, Params]:
-    """One decoder layer for ONE new token per suffix, against cached KV.
+    """One decoder layer for the K NEWEST tokens per suffix, against cached KV.
 
     The KV-cache decode path (no reference equivalent — its generation loop
-    re-streams the full prompt per token, SURVEY.md §3.5). x: [S, 1, D];
+    re-streams the full prompt per token, SURVEY.md §3.5). x: [S, K, D]
+    (K=1 for plain decode, K=draft+1 for the speculative verify step);
     kv: {'kp','vp' [Lp,n_kv,hd], 'ks','vs' [S,Ls,n_kv,hd],
     'kg','vg' [S,T,n_kv,hd]} with generated-token slots < t filled;
-    t: int32 scalar (this step's slot). The new token sits at rotary position
-    ``prefix_len + (suffix_eos[s]+1) + t``. Returns (x_out, kv with slot t
-    of kg/vg written). ``use_pallas`` (static) swaps the attention for the
-    flash decode kernel when the head shapes are eligible — unlike the XLA
-    op it skips prefix-KV blocks past the real prefix length. Under tensor
-    parallelism (``tp_mesh``) the kernel runs per head-shard via shard_map.
+    t: int32 scalar or per-suffix [S] vector — the fed tokens take slots
+    ``t..t+K-1`` and rotary positions ``prefix_len + (suffix_eos[s]+1) +
+    t(+j)``. Returns (x_out, kv with those slots of kg/vg written).
+    ``use_pallas`` (static) swaps the attention for the flash decode kernel
+    when eligible (single-token, shared slot) — unlike the XLA op it skips
+    prefix-KV blocks past the real prefix length. Under tensor parallelism
+    (``tp_mesh``) the kernel runs per head-shard via shard_map.
     """
     eps = cfg.rms_norm_eps
     rope_sliding = sliding
+    kq = x.shape[1]
+    base = jnp.asarray(t, jnp.int32)
     h = rms_norm(x, params["input_layernorm"]["scale"], eps, cfg.norm_unit_offset)
-    q, k_new, v_new = _qkv(params["attn"], cfg, h)  # [S, 1, n, hd]
-    pos = (prefix_len + suffix_eos + 1 + t)[:, None]  # [S, 1]
+    q, k_new, v_new = _qkv(params["attn"], cfg, h)  # [S, K, n, hd]
+    pos = (
+        prefix_len + suffix_eos + 1 + jnp.broadcast_to(base, suffix_eos.shape)
+    )[:, None] + jnp.arange(kq)[None, :]  # [S, K]
     q, k_new = position_qk(cfg, q, k_new, pos, rope_sliding, rope_on)
 
     kv = dict(kv)
-    kv["kg"] = jax.lax.dynamic_update_slice_in_dim(kv["kg"], k_new, t, axis=1)
-    kv["vg"] = jax.lax.dynamic_update_slice_in_dim(kv["vg"], v_new, t, axis=1)
+    if base.ndim == 0:
+        kv["kg"] = jax.lax.dynamic_update_slice_in_dim(kv["kg"], k_new, base, axis=1)
+        kv["vg"] = jax.lax.dynamic_update_slice_in_dim(kv["vg"], v_new, base, axis=1)
+    else:
+        # Speculative passes: each suffix writes its K slots at its OWN
+        # offset (suffixes accept different draft counts, so their slot
+        # clocks drift apart).
+        upd = jax.vmap(
+            lambda buf, new, off: jax.lax.dynamic_update_slice_in_dim(
+                buf, new, off, axis=0
+            )
+        )
+        kv["kg"] = upd(kv["kg"], k_new, base)
+        kv["vg"] = upd(kv["vg"], v_new, base)
 
     window, chunk, sliding = _effective_window(cfg, sliding)
     tp_size = tp_mesh.shape["tp"] if tp_mesh is not None else 1
-    if use_pallas and pallas_attention.supports_decode(
+    if use_pallas and kq == 1 and base.ndim == 0 and pallas_attention.supports_decode(
         cfg.num_attention_heads // tp_size,
         cfg.num_key_value_heads // tp_size,
         cfg.head_dim,
@@ -685,6 +703,18 @@ def select_eos_and_norm(
     return rms_norm(last, params["scale"], cfg.rms_norm_eps, cfg.norm_unit_offset)
 
 
+def lm_head_scores_multi(
+    params: Params, h: jax.Array, softcap: float | None = None
+) -> jax.Array:
+    """Next-token distributions for EVERY position: h [..., K, D] -> float32
+    scores [..., K, V]. The speculative verify step's head (lm_head_scores
+    keeps only position 0); same softcap-then-softmax semantics."""
+    logits = _mm(h, params["kernel"]).astype(jnp.float32)
+    if softcap is not None:
+        logits = jnp.tanh(logits / softcap) * softcap
+    return jax.nn.softmax(logits, axis=-1)
+
+
 def lm_head_scores(
     params: Params, suffix_h: jax.Array, softcap: float | None = None
 ) -> jax.Array:
@@ -692,12 +722,11 @@ def lm_head_scores(
     logits of the kept token, softmax -> next-token distribution.
 
     suffix_h: [S, 1, D] -> float32 scores [S, V]. ``softcap`` is Gemma2's
-    final-logit softcapping, applied before the softmax.
+    final-logit softcapping, applied before the softmax. One-position slice
+    of :func:`lm_head_scores_multi` (softmax is per-position, so slicing
+    before or after is equivalent — one head implementation to maintain).
     """
-    logits = _mm(suffix_h, params["kernel"])[:, 0].astype(jnp.float32)
-    if softcap is not None:
-        logits = jnp.tanh(logits / softcap) * softcap
-    return jax.nn.softmax(logits, axis=-1)
+    return lm_head_scores_multi(params, suffix_h, softcap)[:, 0]
 
 
 # ---------------------------------------------------------------------------

@@ -175,54 +175,66 @@ def decode_attention(
     sliding=None,
     chunk: int | None = None,
 ) -> jax.Array:
-    """Single-token decode attention over three cached KV regions.
+    """Decode attention over three cached KV regions, one joint softmax.
 
     The KV-cache decode mode's hot op (not in the reference — its generation
     loop re-runs the whole prompt per token, ``/root/reference/main.py:65-76``;
-    SURVEY.md §3.5 calls this the known scaling cliff). The query is ONE new
-    token per suffix; it attends jointly (one softmax) over:
+    SURVEY.md §3.5 calls this the known scaling cliff). The queries are the
+    K NEWEST tokens per suffix (K=1 for plain decode; K=draft+1 for the
+    speculative verify step), occupying generated-KV slots ``t .. t+K-1``.
+    Query j attends jointly (one softmax) over:
 
-    - the shared prefix KV  (keys j < prefix_len),
-    - its own suffix KV     (keys j <= suffix_eos[s]),
-    - previously generated tokens' KV incl. itself (keys j <= t).
+    - the shared prefix KV  (keys i < prefix_len),
+    - its own suffix KV     (keys i <= suffix_eos[s]),
+    - generated tokens' KV up to ITSELF (keys i <= t[s] + j — causal among
+      the K fed tokens, whose KV is already written at those slots).
 
-    q [S, 1, n_q, hd]; k/v_prefix [Lp, n_kv, hd]; k/v_suffix [S, Ls, n_kv, hd];
-    k/v_gen [S, T, n_kv, hd] (slot t already holds this step's KV);
-    prefix_len, t: int32 scalars; suffix_eos int32 [S]. Returns [S, 1, n_q, hd].
+    q [S, K, n_q, hd]; k/v_prefix [Lp, n_kv, hd]; k/v_suffix [S, Ls, n_kv, hd];
+    k/v_gen [S, T, n_kv, hd] (slots t..t+K-1 already hold this step's KV);
+    prefix_len int32 scalar; t: int32 scalar or per-suffix [S] (speculative
+    passes advance each suffix by its own accepted count); suffix_eos int32
+    [S]. Returns [S, K, n_q, hd].
     """
-    s, _, n_q, hd = q.shape
+    s, kq, n_q, hd = q.shape
     n_kv = k_prefix.shape[-2]
     if scale is None:
         scale = 1.0 / (hd**0.5)
     lp = k_prefix.shape[0]
     ls = k_suffix.shape[1]
     tmax = k_gen.shape[1]
+    base = jnp.broadcast_to(jnp.asarray(t, jnp.int32), (s,))  # [S]
+    jq = jnp.arange(kq)
 
-    qr = _grouped_q(q, n_kv)  # [S, 1, n_kv, g, hd]
+    qr = _grouped_q(q, n_kv)  # [S, K, n_kv, g, hd]
     sp = jnp.einsum("sqngh,knh->sngqk", qr, k_prefix, precision=_PRECISION)
     ss = jnp.einsum("sqngh,sknh->sngqk", qr, k_suffix, precision=_PRECISION)
     sg = jnp.einsum("sqngh,sknh->sngqk", qr, k_gen, precision=_PRECISION)
     scores = _softcap(
         jnp.concatenate([sp, ss, sg], axis=-1).astype(jnp.float32) * scale, softcap
-    )  # [S, n_kv, g, 1, Lp+Ls+T]
+    )  # [S, n_kv, g, K, Lp+Ls+T]
 
-    jp = jnp.arange(lp)[None, :] < prefix_len  # [1, Lp]
-    js = jnp.arange(ls)[None, :] <= suffix_eos[:, None]  # [S, Ls]
-    jg = jnp.arange(tmax)[None, :] <= t  # [1, T]
+    jp = jnp.arange(lp)[None, None, :] < prefix_len  # [1, 1, Lp]
+    js = jnp.arange(ls)[None, None, :] <= suffix_eos[:, None, None]  # [S,1,Ls]
+    jg = (
+        jnp.arange(tmax)[None, None, :]
+        <= base[:, None, None] + jq[None, :, None]
+    )  # [S, K, T]
     mask = jnp.concatenate(
         [
-            jnp.broadcast_to(jp, (s, lp)),
-            js,
-            jnp.broadcast_to(jg, (s, tmax)),
+            jnp.broadcast_to(jp, (s, kq, lp)),
+            jnp.broadcast_to(js, (s, kq, ls)),
+            jg,
         ],
         axis=-1,
-    )  # [S, Lp+Ls+T]
+    )  # [S, K, Lp+Ls+T]
     if window is not None or chunk is not None:
-        # Absolute positions: query at prefix_len + suffix_eos[s] + 1 + t;
-        # prefix key j at j, suffix key j at prefix_len + j, generated key j
-        # at prefix_len + suffix_eos[s] + 1 + j. Sliding window masks keys
-        # at distance >= window (HF convention).
-        q_pos = prefix_len + suffix_eos[:, None] + 1 + t  # [S, 1]
+        # Absolute positions: query j at prefix_len + suffix_eos[s] + 1 +
+        # t[s] + j; prefix key i at i, suffix key i at prefix_len + i,
+        # generated key i at prefix_len + suffix_eos[s] + 1 + i. Sliding
+        # window masks keys at distance >= window (HF convention).
+        q_pos = (
+            prefix_len + suffix_eos[:, None] + 1 + base[:, None] + jq[None, :]
+        )  # [S, K]
         abs_k = jnp.concatenate(
             [
                 jnp.broadcast_to(jnp.arange(lp)[None, :], (s, lp)),
@@ -234,8 +246,10 @@ def decode_attention(
             ],
             axis=-1,
         )  # [S, Lp+Ls+T]
-        mask = _local_clause(mask, q_pos, abs_k, window, sliding, chunk)
-    scores = jnp.where(mask[:, None, None, None, :], scores, _NEG_INF)
+        mask = _local_clause(
+            mask, q_pos[..., None], abs_k[:, None, :], window, sliding, chunk
+        )
+    scores = jnp.where(mask[:, None, None, :, :], scores, _NEG_INF)
 
     probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
     pp, ps, pg = (
@@ -246,7 +260,7 @@ def decode_attention(
     out = jnp.einsum("sngqk,knh->sqngh", pp, v_prefix, precision=_PRECISION)
     out = out + jnp.einsum("sngqk,sknh->sqngh", ps, v_suffix, precision=_PRECISION)
     out = out + jnp.einsum("sngqk,sknh->sqngh", pg, v_gen, precision=_PRECISION)
-    return out.reshape(s, 1, n_q, hd)
+    return out.reshape(s, kq, n_q, hd)
 
 
 def causal_mask(

@@ -223,6 +223,78 @@ def _fused_decode_steps(
     return dists, toks
 
 
+@partial(jax.jit, static_argnums=(0, 1), donate_argnums=(3, 4))
+def _spec_decoders(cfg: LlamaConfig, tp_mesh, seg, kv, x, prefix_len, suffix_eos, base):
+    """Scan k layers' K-token speculative verify step over a block.
+
+    x [B, S, K, D] — the last accepted token plus K-1 drafts per suffix;
+    base [B, S] — each suffix's own generated-KV slot offset (suffixes
+    accept different counts per pass, so their slot clocks drift apart).
+    Always the XLA decode op (the flash decode kernel is single-token).
+    """
+    stacked, flags, rflags = seg["layers"], seg["sliding"], seg.get("rope")
+
+    def body(x, layer):
+        layer_params, sliding, rope_on, layer_kv = layer
+        step = jax.vmap(
+            partial(
+                llama.decode_step_layer,
+                sliding=sliding,
+                rope_on=rope_on,
+                use_pallas=False,
+                tp_mesh=tp_mesh,
+            ),
+            in_axes=(None, None, 0, 0, 0, 0, 0),
+        )
+        x, layer_kv = step(layer_params, cfg, x, layer_kv, prefix_len, suffix_eos, base)
+        return x, layer_kv
+
+    x, kv = jax.lax.scan(body, x, (stacked, flags, rflags, kv))
+    return x, kv
+
+
+@partial(jax.jit, static_argnums=(0,))
+def _spec_norm_head(cfg: LlamaConfig, norm_params, head_params, x):
+    """x [B, S, K, D] -> float32 distributions [B, S, K, V] (every fed
+    position scored — position j's distribution verifies draft j+1)."""
+    from flexible_llm_sharding_tpu.ops import rms_norm
+
+    h = rms_norm(x, norm_params["scale"], cfg.rms_norm_eps, cfg.norm_unit_offset)
+    return llama.lm_head_scores_multi(
+        head_params, h, softcap=cfg.final_logit_softcap
+    )
+
+
+def propose_draft(context_ids, k: int, ngram: int = 2):
+    """Prompt-lookup drafting (public technique — Saxena's prompt lookup
+    decoding / HF assisted generation's n-gram candidate source): find the
+    LAST earlier occurrence of the context's final n-gram and propose the
+    tokens that followed it. No draft model, no extra memory — the draft
+    quality rides the input-grounded nature of the workload (the reference's
+    continuation-scoring prompts repeat prompt phrases constantly).
+
+    Returns EXACTLY ``k`` draft ids (the verify step needs static shapes);
+    when no match or continuation exists it pads by repeating the last
+    token — bad drafts cost nothing but rejected slots.
+    """
+    ids = np.asarray(context_ids, np.int64)
+    n = len(ids)
+    draft: list[int] = []
+    for g in range(min(ngram, n - 1), 0, -1):
+        tail = ids[n - g :]
+        win = np.lib.stride_tricks.sliding_window_view(ids[: n - 1], g)
+        hits = np.flatnonzero((win == tail[None, :]).all(axis=1))
+        if len(hits):
+            start = int(hits[-1])
+            cont = ids[start + g : start + g + k]
+            if len(cont):
+                draft = [int(c) for c in cont]
+                break
+    while len(draft) < k:
+        draft.append(int(draft[-1] if draft else ids[-1]))
+    return np.asarray(draft[:k], np.int64)
+
+
 # ---------------------------------------------------------------------------
 # KV parking between shards / steps
 # ---------------------------------------------------------------------------
@@ -294,6 +366,13 @@ class DecodeGenerator:
             raise ValueError(
                 "weight_source_factory requires an explicit resident= flag "
                 "matching the source's round count"
+            )
+        if weight_source_factory is not None and cfg.speculative_k:
+            # The DP broadcast source's round count is fixed when it is
+            # built; speculative passes are data-dependent (1..K+1 tokens
+            # per pass), so the rank streams would desync from the producer.
+            raise ValueError(
+                "speculative_k does not compose with data_parallel decode"
             )
         self.weight_source_factory = weight_source_factory
         self.cfg = cfg
@@ -385,7 +464,7 @@ class DecodeGenerator:
             self.model_cfg, self.cfg.dtype, self._n_chips
         )
 
-    def _block_kv_bytes(self, toks, idxs, n_gen: int) -> int:
+    def _block_kv_bytes(self, toks, idxs, gen_slots: int) -> int:
         """Decode KV bytes for one block (all layers, compute dtype)."""
         mc = self.model_cfg
         t0 = toks[idxs[0]]
@@ -394,14 +473,14 @@ class DecodeGenerator:
         per_layer = (
             2  # k and v
             * len(idxs)
-            * (lp + s_b * (ls + max(1, n_gen - 1)))
+            * (lp + s_b * (ls + gen_slots))
             * mc.num_key_value_heads
             * mc.head_dim
         )
         bpe = np.dtype(np_dtype_for(self.cfg.dtype)).itemsize
         return per_layer * mc.num_hidden_layers * bpe
 
-    def _kv_fits_on_chip(self, toks, blocks, n_gen: int) -> bool:
+    def _kv_fits_on_chip(self, toks, blocks, gen_slots: int) -> bool:
         """Whether every block's decode KV can stay in HBM alongside the
         resident weights (known-HBM chips only: weights + KV within 80% of
         the chip). A host-parked KV store costs a full KV round trip per
@@ -410,11 +489,11 @@ class DecodeGenerator:
         hbm_gb = self._hbm_gb()
         if not hbm_gb:
             return False
-        kv_bytes = sum(self._block_kv_bytes(toks, i, n_gen) for i in blocks)
+        kv_bytes = sum(self._block_kv_bytes(toks, i, gen_slots) for i in blocks)
         return self._weight_bytes() + kv_bytes <= 0.8 * hbm_gb * 1e9
 
     def _fused_budget_ok(
-        self, toks, blocks, n_gen: int, kv_on_device: bool
+        self, toks, blocks, n_gen: int, gen_slots: int, kv_on_device: bool
     ) -> bool:
         """Whether the fused scan's on-chip footprint fits: resident weights
         + KV (every block when the store is device-resident, else the
@@ -430,7 +509,9 @@ class DecodeGenerator:
         hbm_gb = self._hbm_gb()
         if not hbm_gb:
             return False
-        per_block_kv = [self._block_kv_bytes(toks, i, n_gen) for i in blocks]
+        per_block_kv = [
+            self._block_kv_bytes(toks, i, gen_slots) for i in blocks
+        ]
         kv_bytes = sum(per_block_kv) if kv_on_device else max(per_block_kv)
         dists_bytes = max(
             (n_gen - 1)
@@ -486,8 +567,9 @@ class DecodeGenerator:
         # KV follows the weights: once the model is resident there is HBM
         # headroom, and host-parked KV would be re-uploaded per shard per
         # step — the dominant cost of a resident decode step.
+        plain_slots = max(1, n_gen - 1)
         kv_on_device = cfg.storage_location == "tpu" or (
-            self._resident and self._kv_fits_on_chip(toks, blocks, n_gen)
+            self._resident and self._kv_fits_on_chip(toks, blocks, plain_slots)
         )
         kv_store = KVStore(on_device=kv_on_device)
         n_layers = len(self.layer_names)
@@ -496,7 +578,7 @@ class DecodeGenerator:
         # per-shard dispatch loop. Sampling keeps the loop (the numpy rng
         # stream is part of the documented determinism contract).
         budget_ok = bool(blocks) and self._fused_budget_ok(
-            toks, blocks, n_gen, kv_on_device
+            toks, blocks, n_gen, plain_slots, kv_on_device
         )
         fused = (
             cfg.decode_fused != "off"
@@ -515,6 +597,19 @@ class DecodeGenerator:
                 f"single_placement={self._single_placement} "
                 f"hbm_budget_ok={budget_ok}"
             )
+        # Speculative verify passes (fused preferred when both could run:
+        # resident steps move no weight bytes, so there is nothing for
+        # speculation to amortise). Greedy-only, enforced by config.
+        spec_k = cfg.speculative_k
+        speculative = spec_k > 0 and n_gen > 1 and not fused and bool(blocks)
+        # Generated-KV slots: plain decode fills one slot per step; a
+        # speculative pass writes K+1 slots at per-suffix offsets capped at
+        # n_gen-1, so the last write touches slot n_gen-1+K.
+        gen_slots = (n_gen + spec_k) if speculative else plain_slots
+        if speculative and kv_on_device and cfg.storage_location != "tpu":
+            # Re-judge the resident-KV decision at the larger footprint.
+            kv_on_device = self._kv_fits_on_chip(toks, blocks, gen_slots)
+            kv_store = KVStore(on_device=kv_on_device)
 
         block_meta = {
             b: (
@@ -584,10 +679,11 @@ class DecodeGenerator:
                             # decode scans can donate in place.
                             bsz, s_b = sh.shape[0], sh.shape[1]
                             k_l = jax.tree.leaves(kv)[0].shape[0]
-                            # One slot per decode step (n_gen-1 of them);
-                            # min 1 so shapes stay non-degenerate at n_gen=1.
+                            # gen_slots: one per decode step (min 1 so shapes
+                            # stay non-degenerate at n_gen=1), widened for
+                            # speculative passes' K+1-slot writes.
                             gen_shape = (
-                                k_l, bsz, s_b, max(1, n_gen - 1),
+                                k_l, bsz, s_b, gen_slots,
                                 self.model_cfg.num_key_value_heads,
                                 self.model_cfg.head_dim,
                             )
@@ -613,6 +709,67 @@ class DecodeGenerator:
                             tok_hist[b].append(pick(dist, b))
                     if layer_idxs[-1] != n_layers - 1:
                         kv_store.put(("h", b), (ph, sh))
+
+            def stream_pass(embed_ids, decoders_fn, head_fn, skip_block=None):
+                """One full-model walk (shards x blocks x segments) shared
+                by the per-step loop and the speculative verify pass:
+                kept-vs-streamed shard source, MP padding-stage skip,
+                ('x', b) activation parking between shards, and the MP
+                norm-hop (model.norm may live on an earlier stage's chip;
+                its scale vector rides to the head's chip here).
+
+                embed_ids(b) -> int token ids for block b;
+                decoders_fn(b, params, kv, x, prefix_len, suffix_eos);
+                head_fn(b, norm_params_on_chip, head_params, x);
+                skip_block(b) -> True to leave a block out of this pass
+                (speculative passes skip blocks whose rows all finished)."""
+                norm_params = None
+                for shard_pos, (layer_idxs, segments) in (
+                    kept if self._resident else enumerate(one_pass())
+                ):
+                    if not layer_idxs:  # MP round-up padding stage
+                        continue
+                    dev = self.shard_devices[shard_pos]
+                    act_dev = getattr(dev, "act", dev)
+                    for b in range(len(blocks)):
+                        if skip_block is not None and skip_block(b):
+                            continue
+                        _, _, prefix_len, suffix_eos = block_meta[b]
+                        x = (
+                            None
+                            if layer_idxs[0] == 0
+                            else kv_store.get(("x", b), act_dev)
+                        )
+                        di = 0
+                        for kind, params in segments:
+                            if kind == "embed":
+                                x = llama.embed(
+                                    params,
+                                    jnp.asarray(embed_ids(b), jnp.int32),
+                                    self.dtype,
+                                    self.model_cfg,
+                                )
+                            elif kind == "decoders":
+                                kv = kv_store.get(
+                                    ("kv", shard_pos, di, b), act_dev
+                                )
+                                x, kv = decoders_fn(
+                                    b, params, kv, x, prefix_len, suffix_eos
+                                )
+                                kv_store.put(("kv", shard_pos, di, b), kv)
+                                di += 1
+                            elif kind == "norm":
+                                norm_params = params  # applied in the head
+                            else:  # head
+                                assert norm_params is not None
+                                head_fn(
+                                    b,
+                                    jax.device_put(norm_params, act_dev),
+                                    params,
+                                    x,
+                                )
+                        if layer_idxs[-1] != n_layers - 1:
+                            kv_store.put(("x", b), x)
 
             # --- decode steps ---------------------------------------------
             if fused:
@@ -671,61 +828,186 @@ class DecodeGenerator:
                     for s_i in range(n_gen - 1):
                         all_scores[b].append(dists[s_i])
                         tok_hist[b].append(picks[s_i])
-            # --- decode steps: stream weights, one token per suffix ------
-            for t in ([] if fused else range(n_gen - 1)):
-                # model.norm always executes before lm_head; its params (set
-                # at the norm shard) are carried here across shard iterations
-                # when the two land in different shards (layer_num_per_shard=1).
-                norm_params = None
-                for shard_pos, (layer_idxs, segments) in (
-                    kept if self._resident else enumerate(one_pass())
+            elif speculative:
+                # --- speculative verify passes -----------------------------
+                # Each pass streams the weights ONCE and verifies spec_k
+                # prompt-lookup drafts plus the next token in a K+1-position
+                # decode step, emitting 1..K+1 tokens per suffix — the
+                # number of full weight streams per generated token drops by
+                # the acceptance factor. Greedy-exact: position j's argmax
+                # is precisely what sequential greedy would emit after the
+                # accepted prefix, so outputs equal plain KV decode.
+                k1 = spec_k + 1
+                g_state: dict[int, np.ndarray] = {}
+                hist_d: dict[int, list] = {}
+                hist_t: dict[int, list] = {}
+                ctx: dict[int, list] = {}
+                for b, idxs in enumerate(blocks):
+                    bsz = len(idxs)
+                    s_b = toks[idxs[0]].suffix_ids.shape[0]
+                    # One token per suffix already picked (prefill's).
+                    g_state[b] = np.ones((bsz, s_b), np.int64)
+                    d0, t0 = all_scores[b][0], tok_hist[b][0]
+                    hist_d[b] = [
+                        [[d0[r, s]] for s in range(s_b)] for r in range(bsz)
+                    ]
+                    hist_t[b] = [
+                        [[int(t0[r, s])] for s in range(s_b)]
+                        for r in range(bsz)
+                    ]
+                    # Draft context: real prefix + real suffix + history.
+                    ctx[b] = [
+                        [
+                            np.concatenate(
+                                [
+                                    toks[i].prefix_ids[: toks[i].prefix_len],
+                                    toks[i].suffix_ids[s][
+                                        : int(toks[i].suffix_eos[s]) + 1
+                                    ],
+                                    [int(t0[r, s])],
+                                ]
+                            )
+                            for s in range(s_b)
+                        ]
+                        for r, i in enumerate(idxs)
+                    ]
+                    # Bucket-padding rows: their text is discarded, so they
+                    # must neither gate the pass count nor pollute the
+                    # acceptance stats — frozen at done with a constant
+                    # history (their KV slot clock stays parked).
+                    for r, i in enumerate(idxs):
+                        for s in range(toks[i].num_suffixes, s_b):
+                            g_state[b][r, s] = n_gen
+                            hist_d[b][r][s] = [d0[r, s]] * n_gen
+                            hist_t[b][r][s] = [int(t0[r, s])] * n_gen
+                spec_passes = spec_drafted = spec_accepted = 0
+                while any(
+                    (g_state[b] < n_gen).any() for b in range(len(blocks))
                 ):
-                    if not layer_idxs:  # MP round-up padding stage
-                        continue
-                    dev = self.shard_devices[shard_pos]
-                    act_dev = getattr(dev, "act", dev)
-                    for b, idxs in enumerate(blocks):
-                        _, _, prefix_len, suffix_eos = block_meta[b]
-                        if layer_idxs[0] == 0:
-                            x = None
-                        else:
-                            x = kv_store.get(("x", b), act_dev)
-                        di = 0
-                        for kind, params in segments:
-                            if kind == "embed":
-                                ids = jnp.asarray(
-                                    tok_hist[b][-1][..., None], jnp.int32
-                                )
-                                x = llama.embed(params, ids, self.dtype, self.model_cfg)
-                            elif kind == "decoders":
-                                kv = kv_store.get(("kv", shard_pos, di, b), act_dev)
-                                x, kv = _decode_decoders(
-                                    self.model_cfg, self._use_pallas,
-                                    self._tp_mesh, params, kv, x, prefix_len,
-                                    suffix_eos, jnp.int32(t),
-                                )
-                                kv_store.put(("kv", shard_pos, di, b), kv)
-                                di += 1
-                            elif kind == "norm":
-                                norm_params = params  # applied inside the head
-                            else:  # head
-                                assert norm_params is not None
-                                # MP: model.norm may live on an earlier
-                                # stage's chip; its scale vector hops here.
-                                dist = np.asarray(
-                                    jax.device_get(
-                                        _decode_norm_head(
-                                            self.model_cfg,
-                                            jax.device_put(norm_params, act_dev),
-                                            params,
-                                            x,
-                                        )
+                    # Fed tokens/drafts are fixed per pass BEFORE streaming.
+                    fed, drafts, base = {}, {}, {}
+                    for b in range(len(blocks)):
+                        bsz, s_b = g_state[b].shape
+                        f = np.zeros((bsz, s_b, k1), np.int64)
+                        d = np.zeros((bsz, s_b, spec_k), np.int64)
+                        for r in range(bsz):
+                            for s in range(s_b):
+                                f[r, s, 0] = hist_t[b][r][s][-1]
+                                if g_state[b][r, s] < n_gen:
+                                    d[r, s] = propose_draft(
+                                        ctx[b][r][s], spec_k
                                     )
+                        f[:, :, 1:] = d
+                        fed[b], drafts[b] = f, d
+                        base[b] = (g_state[b] - 1).astype(np.int32)
+                    head_dists: dict[int, np.ndarray] = {}
+
+                    def spec_head(b, norm_p, head_p, x):
+                        head_dists[b] = np.asarray(
+                            jax.device_get(
+                                _spec_norm_head(
+                                    self.model_cfg, norm_p, head_p, x
                                 )
-                                all_scores[b].append(dist)
-                                tok_hist[b].append(pick(dist, b))
-                        if layer_idxs[-1] != n_layers - 1:
-                            kv_store.put(("x", b), x)
+                            )
+                        )
+
+                    stream_pass(
+                        lambda b: fed[b],
+                        lambda b, params, kv, x, pl, se: _spec_decoders(
+                            self.model_cfg, self._tp_mesh, params, kv, x,
+                            pl, se, jnp.asarray(base[b]),
+                        ),
+                        spec_head,
+                        # Blocks whose rows all finished sit the pass out
+                        # (their state is frozen; recomputing them would
+                        # only burn chip time and head transfers).
+                        skip_block=lambda b: bool(
+                            (g_state[b] >= n_gen).all()
+                        ),
+                    )
+                    # Accept: longest draft prefix matching the argmax chain.
+                    spec_passes += 1
+                    for b in range(len(blocks)):
+                        if b not in head_dists:  # block sat this pass out
+                            continue
+                        dist = head_dists[b]  # [B, S, K+1, V]
+                        picks = np.argmax(dist, axis=-1)  # [B, S, K+1]
+                        bsz, s_b = g_state[b].shape
+                        for r in range(bsz):
+                            for s in range(s_b):
+                                if g_state[b][r, s] >= n_gen:
+                                    continue
+                                a = 0
+                                while (
+                                    a < spec_k
+                                    and picks[r, s, a] == drafts[b][r, s, a]
+                                ):
+                                    a += 1
+                                spec_drafted += spec_k
+                                spec_accepted += a
+                                emit = int(
+                                    min(a + 1, n_gen - g_state[b][r, s])
+                                )
+                                for j in range(emit):
+                                    hist_d[b][r][s].append(dist[r, s, j])
+                                    hist_t[b][r][s].append(
+                                        int(picks[r, s, j])
+                                    )
+                                ctx[b][r][s] = np.concatenate(
+                                    [ctx[b][r][s], picks[r, s, :emit]]
+                                )
+                                g_state[b][r, s] = min(
+                                    g_state[b][r, s] + a + 1, n_gen
+                                )
+                # Re-shape the ragged per-suffix histories into the common
+                # step-major [B, S] layout the output assembly expects.
+                for b in range(len(blocks)):
+                    bsz, s_b = g_state[b].shape
+                    all_scores[b] = [
+                        np.stack(
+                            [
+                                [hist_d[b][r][s][i] for s in range(s_b)]
+                                for r in range(bsz)
+                            ]
+                        )
+                        for i in range(n_gen)
+                    ]
+                    tok_hist[b] = [
+                        np.array(
+                            [
+                                [hist_t[b][r][s][i] for s in range(s_b)]
+                                for r in range(bsz)
+                            ]
+                        )
+                        for i in range(n_gen)
+                    ]
+                spec_stats = {
+                    "spec_passes": float(spec_passes),
+                    "spec_drafted": float(spec_drafted),
+                    "spec_accepted": float(spec_accepted),
+                }
+            # --- decode steps: stream weights, one token per suffix ------
+            for t in ([] if fused or speculative else range(n_gen - 1)):
+
+                def plain_head(b, norm_p, head_p, x):
+                    dist = np.asarray(
+                        jax.device_get(
+                            _decode_norm_head(
+                                self.model_cfg, norm_p, head_p, x
+                            )
+                        )
+                    )
+                    all_scores[b].append(dist)
+                    tok_hist[b].append(pick(dist, b))
+
+                stream_pass(
+                    lambda b: tok_hist[b][-1][..., None],
+                    lambda b, params, kv, x, pl, se: _decode_decoders(
+                        self.model_cfg, self._use_pallas, self._tp_mesh,
+                        params, kv, x, pl, se, jnp.int32(t),
+                    ),
+                    plain_head,
+                )
         finally:
             if closer is not None:
                 closer.close()
@@ -736,6 +1018,7 @@ class DecodeGenerator:
             "total_wall_s": time.perf_counter() - t_start,
             "decode_resident": float(self._resident),
             "decode_fused": float(fused),
+            "decode_speculative": float(speculative),
             "decode_kv_on_device": float(kv_on_device),
             # Prefill runs every real prompt token once; each decode step
             # then runs exactly one new token per true suffix.
@@ -744,6 +1027,8 @@ class DecodeGenerator:
                 + sum(t.num_suffixes for t in toks) * max(n_gen - 1, 0)
             ),
         }
+        if speculative:
+            self.stats.update(spec_stats)
 
         # --- assemble outputs in prompt order ----------------------------
         scores_out: list[np.ndarray] = [None] * len(prompts)  # type: ignore

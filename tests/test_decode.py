@@ -638,7 +638,8 @@ def test_decode_kv_on_device_gate(tiny_cfg, model):
     gen = DecodeGenerator(cfg, tokenizer=FakeTokenizer())
     toks = [gen.tokenizer(p, s) for p, s in PROMPTS]
     blocks = make_blocks(toks, 2)
-    assert not gen._kv_fits_on_chip(toks, blocks, N_GEN)  # unknown HBM
+    slots = N_GEN - 1
+    assert not gen._kv_fits_on_chip(toks, blocks, slots)  # unknown HBM
 
     class FakeDev:
         device_kind = "TPU v5 lite"
@@ -647,11 +648,184 @@ def test_decode_kv_on_device_gate(tiny_cfg, model):
             return None
 
     gen._probe_dev = FakeDev()
-    assert gen._kv_fits_on_chip(toks, blocks, N_GEN)
+    assert gen._kv_fits_on_chip(toks, blocks, slots)
     # Fused budget: fits for the tiny workload on a known chip, refuses when
     # the generated-KV + dists footprint outgrows the HBM, and is always ok
     # on the CPU backend (device memory IS host RAM).
-    assert gen._fused_budget_ok(toks, blocks, N_GEN, kv_on_device=True)
-    assert not gen._fused_budget_ok(toks, blocks, 10**7, kv_on_device=True)
+    assert gen._fused_budget_ok(toks, blocks, N_GEN, slots, kv_on_device=True)
+    assert not gen._fused_budget_ok(
+        toks, blocks, 10**7, 10**7, kv_on_device=True
+    )
     gen._probe_dev = None
-    assert gen._fused_budget_ok(toks, blocks, 10**7, kv_on_device=False)
+    assert gen._fused_budget_ok(
+        toks, blocks, 10**7, 10**7, kv_on_device=False
+    )
+
+
+# ---------------------------------------------------------------------------
+# Speculative decode (prompt-lookup drafts verified per streamed pass)
+# ---------------------------------------------------------------------------
+
+# Repetition-heavy prompts: prompt-lookup drafting's home turf (the
+# reference's continuation-scoring workloads echo prompt phrases constantly).
+SPEC_PROMPTS = [
+    (
+        "the cat sat on the mat the cat sat on the mat",
+        (" the cat sat", " on the mat"),
+    ),
+    ("alpha beta gamma alpha beta gamma alpha", (" beta gamma alpha", " delta")),
+]
+
+
+def _spec_cfg(model_dir, k, n_gen=6, resident="off", **kw):
+    return FrameworkConfig(
+        model_path=model_dir,
+        layer_num_per_shard=1,
+        storage_location="cpu",
+        dtype="float32",
+        bucket_multiple=8,
+        block_size=2,
+        prefetch_depth=0,
+        num_gen_token=n_gen,
+        speculative_k=k,
+        decode_resident=resident,
+        decode_fused="off",
+        **kw,
+    )
+
+
+@pytest.mark.parametrize("resident", ["off", "on"])
+def test_decode_speculative_matches_plain(tiny_cfg, model, resident):
+    """Speculative verification is greedy-exact: tokens, strings and
+    per-step distributions equal plain KV decode (streamed or resident),
+    while the pass count drops below n_gen-1 on accepting prompts."""
+    model_dir, _ = model
+    want, want_up = DecodeGenerator(
+        _spec_cfg(model_dir, 0), tokenizer=FakeTokenizer()
+    )(list(SPEC_PROMPTS))
+    gen = DecodeGenerator(
+        _spec_cfg(model_dir, 4, resident=resident), tokenizer=FakeTokenizer()
+    )
+    got, got_up = gen(list(SPEC_PROMPTS))
+    assert gen.stats["decode_speculative"] == 1.0
+    assert gen.stats["spec_passes"] < 5  # n_gen-1 sequential steps beaten
+    assert gen.stats["spec_accepted"] > 0
+    assert got_up == want_up
+    for g, w in zip(got, want):
+        np.testing.assert_allclose(g, w, rtol=1e-5, atol=1e-6)
+
+
+def test_decode_speculative_hostile_prompts(tiny_cfg, model):
+    """Zero-repetition prompts reject (nearly) every draft — the mode must
+    still be exact, paying at worst one pass per token like plain decode."""
+    model_dir, _ = model
+    prompts = list(PROMPTS)  # the no-repetition standard set
+    want, want_up = DecodeGenerator(
+        _spec_cfg(model_dir, 0, n_gen=N_GEN), tokenizer=FakeTokenizer()
+    )(prompts)
+    gen = DecodeGenerator(
+        _spec_cfg(model_dir, 3, n_gen=N_GEN), tokenizer=FakeTokenizer()
+    )
+    got, got_up = gen(prompts)
+    assert gen.stats["spec_passes"] <= N_GEN - 1
+    assert got_up == want_up
+    for g, w in zip(got, want):
+        np.testing.assert_allclose(g, w, rtol=1e-5, atol=1e-6)
+
+
+def test_decode_speculative_k_exceeds_gen(tiny_cfg, model):
+    """spec_k larger than the remaining budget: emissions truncate at n_gen
+    and the gen-KV capacity covers the overshooting writes."""
+    model_dir, _ = model
+    want, want_up = DecodeGenerator(
+        _spec_cfg(model_dir, 0, n_gen=2), tokenizer=FakeTokenizer()
+    )(list(SPEC_PROMPTS))
+    gen = DecodeGenerator(
+        _spec_cfg(model_dir, 8, n_gen=2), tokenizer=FakeTokenizer()
+    )
+    got, got_up = gen(list(SPEC_PROMPTS))
+    assert gen.stats["spec_passes"] == 1.0
+    assert got_up == want_up
+    for g, w in zip(got, want):
+        np.testing.assert_allclose(g, w, rtol=1e-5, atol=1e-6)
+
+
+def test_decode_speculative_mp_pipeline(tiny_cfg, model):
+    """Speculative passes ride the interleaved MP pipeline the same way the
+    per-step loop does (per-stage KV, activation hops)."""
+    model_dir, _ = model
+    want, want_up = DecodeGenerator(
+        _spec_cfg(model_dir, 0), tokenizer=FakeTokenizer()
+    )(list(SPEC_PROMPTS))
+    gen = DecodeGenerator(
+        _spec_cfg(model_dir, 4),
+        tokenizer=FakeTokenizer(),
+        mp_devices=jax.devices()[:3],
+    )
+    got, got_up = gen(list(SPEC_PROMPTS))
+    assert gen.stats["decode_speculative"] == 1.0
+    assert got_up == want_up
+    for g, w in zip(got, want):
+        np.testing.assert_allclose(g, w, rtol=1e-4, atol=1e-5)
+
+
+def test_decode_speculative_guards(tiny_cfg, model):
+    """Loud rejects: sampling, DP broadcast source, bad k."""
+    model_dir, _ = model
+    with pytest.raises(ValueError, match="speculative_k requires greedy"):
+        FrameworkConfig(speculative_k=4, temperature=0.7)
+    with pytest.raises(ValueError, match="speculative_k must be"):
+        FrameworkConfig(speculative_k=-1)
+    with pytest.raises(ValueError, match="data_parallel"):
+        DecodeGenerator(
+            _spec_cfg(model_dir, 4),
+            tokenizer=FakeTokenizer(),
+            weight_source_factory=lambda: iter(()),
+            resident=False,
+        )
+
+
+def test_propose_draft():
+    """Prompt-lookup drafting: last-match continuation, exact-k padding."""
+    from flexible_llm_sharding_tpu.runtime.decode import propose_draft
+
+    ids = np.array([5, 6, 7, 8, 5, 6, 7, 9, 5, 6])
+    # Final bigram (5, 6): last earlier occurrence at index 4 -> continues
+    # with 7, 9, 5.
+    assert propose_draft(ids, 3).tolist() == [7, 9, 5]
+    # Continuation shorter than k: pads by repeating the last token.
+    assert propose_draft(np.array([1, 2, 3, 1, 2]), 4).tolist() == [3, 1, 2, 2]
+    # No match at all: falls back to repeating the final token.
+    assert propose_draft(np.array([1, 2, 3, 4]), 2).tolist() == [4, 4]
+    # Degenerate single-token context.
+    assert propose_draft(np.array([7]), 2).tolist() == [7, 7]
+
+
+def test_decode_speculative_cli(tiny_cfg, model, tmp_path):
+    """--speculative_k flows through the CLI into the decode path and the
+    output pickle keeps the exact plain-decode contract."""
+    import pickle
+
+    from flexible_llm_sharding_tpu.cli import main
+
+    model_dir, _ = model
+    ppkl, opkl = tmp_path / "p.pkl", tmp_path / "s.pkl"
+    with open(ppkl, "wb") as f:
+        pickle.dump(SPEC_PROMPTS[:1], f)
+    main(
+        [
+            "--model_path", model_dir,
+            "--prompt_pickle", str(ppkl),
+            "--output_file", str(opkl),
+            "--num_gen_token", "4",
+            "--dtype", "float32",
+            "--kv_cache", "true",
+            "--speculative_k", "3",
+            "--decode_resident", "off",
+            "--num_devices", "1",
+        ],
+        tokenizer=FakeTokenizer(),
+    )
+    with open(opkl, "rb") as f:
+        scores = pickle.load(f)
+    assert scores[0].shape == (2, 4, tiny_cfg.vocab_size)
