@@ -103,13 +103,16 @@ def _decode_decoders_impl(
     suffix_eos,
     t,
     gen_only: bool = False,
+    t_in_axis=None,
 ):
-    """Scan k layers' single-token decode over a block.
+    """Scan k layers' decode over a block (K newest tokens per suffix).
 
     seg: {"layers": [k, ...] pytree, "sliding": bool [k] or None,
     "rope": bool [k] or None};
-    kv: pytree with leaves [k, B, ...] (kg/vg slots < t filled); x [B, S, 1, D];
-    prefix_len [B]; suffix_eos [B, S]; t scalar. Returns (x, kv updated at t).
+    kv: pytree with leaves [k, B, ...] (kg/vg slots < t filled); x [B, S, K, D];
+    prefix_len [B]; suffix_eos [B, S]; t: scalar slot (plain decode,
+    ``t_in_axis=None``) or [B, S] per-suffix slot offsets (speculative
+    passes, ``t_in_axis=0``). Returns (x, kv with slots t..t+K-1 updated).
     ``gen_only`` (static) returns only the mutated {'kg','vg'} leaves as the
     scan's stacked output — the fused step path uses it so the read-only
     prefix/suffix KV is never re-materialised by the layer scan.
@@ -126,7 +129,7 @@ def _decode_decoders_impl(
                 use_pallas=use_pallas,
                 tp_mesh=tp_mesh,
             ),
-            in_axes=(None, None, 0, 0, 0, 0, None),
+            in_axes=(None, None, 0, 0, 0, 0, t_in_axis),
         )
         x, layer_kv = step(layer_params, cfg, x, layer_kv, prefix_len, suffix_eos, t)
         if gen_only:
@@ -230,27 +233,14 @@ def _spec_decoders(cfg: LlamaConfig, tp_mesh, seg, kv, x, prefix_len, suffix_eos
     x [B, S, K, D] — the last accepted token plus K-1 drafts per suffix;
     base [B, S] — each suffix's own generated-KV slot offset (suffixes
     accept different counts per pass, so their slot clocks drift apart).
-    Always the XLA decode op (the flash decode kernel is single-token).
+    Always the XLA decode op (the flash decode kernel is single-token);
+    same layer scan as the plain per-step path, with the slot arg vmapped
+    over the batch instead of broadcast.
     """
-    stacked, flags, rflags = seg["layers"], seg["sliding"], seg.get("rope")
-
-    def body(x, layer):
-        layer_params, sliding, rope_on, layer_kv = layer
-        step = jax.vmap(
-            partial(
-                llama.decode_step_layer,
-                sliding=sliding,
-                rope_on=rope_on,
-                use_pallas=False,
-                tp_mesh=tp_mesh,
-            ),
-            in_axes=(None, None, 0, 0, 0, 0, 0),
-        )
-        x, layer_kv = step(layer_params, cfg, x, layer_kv, prefix_len, suffix_eos, base)
-        return x, layer_kv
-
-    x, kv = jax.lax.scan(body, x, (stacked, flags, rflags, kv))
-    return x, kv
+    return _decode_decoders_impl(
+        cfg, False, tp_mesh, seg, kv, x, prefix_len, suffix_eos, base,
+        t_in_axis=0,
+    )
 
 
 @partial(jax.jit, static_argnums=(0,))
@@ -943,11 +933,16 @@ class DecodeGenerator:
                                     and picks[r, s, a] == drafts[b][r, s, a]
                                 ):
                                     a += 1
-                                spec_drafted += spec_k
-                                spec_accepted += a
-                                emit = int(
-                                    min(a + 1, n_gen - g_state[b][r, s])
-                                )
+                                # Stats count only USEFUL draft slots: with
+                                # `remaining` tokens of budget, at most
+                                # remaining-1 drafts can turn into emissions
+                                # — charging all spec_k would understate the
+                                # acceptance the perf case rests on.
+                                remaining = int(n_gen - g_state[b][r, s])
+                                useful_k = min(spec_k, remaining - 1)
+                                spec_drafted += useful_k
+                                spec_accepted += min(a, useful_k)
+                                emit = int(min(a + 1, remaining))
                                 for j in range(emit):
                                     hist_d[b][r][s].append(dist[r, s, j])
                                     hist_t[b][r][s].append(

@@ -154,7 +154,21 @@ def run_cli(argv: list[str], tag: str, kill_after_marker: str | None = None,
     With ``kill_after_marker``, SIGKILL the child once the resume progress
     marker reports >= kill_min_shards completed shards, and return
     ``{"killed": True, "completed_shards": n}`` instead.
+    ``kill_after_marker`` is a GLOB (runtime/resume.py marker_path names
+    markers progress-{signature}.json — the signature isn't known here).
     """
+    import glob as globmod
+
+    def marker_progress(pattern: str) -> int:
+        done = 0
+        for path in globmod.glob(pattern):
+            try:
+                with open(path) as f:
+                    done = max(done, json.load(f).get("completed_shards", 0))
+            except (OSError, ValueError):
+                pass
+        return done
+
     err_path = os.path.join(WORK, f"cli-{tag}.stderr")
     with open(err_path, "wb") as err:
         proc = subprocess.Popen(
@@ -172,11 +186,7 @@ def run_cli(argv: list[str], tag: str, kill_after_marker: str | None = None,
                 )
         else:
             while proc.poll() is None:
-                try:
-                    with open(kill_after_marker) as f:
-                        done = json.load(f).get("completed_shards", 0)
-                except (OSError, ValueError):
-                    done = 0
+                done = marker_progress(kill_after_marker)
                 if done >= kill_min_shards:
                     proc.send_signal(signal.SIGKILL)
                     proc.wait()
@@ -399,7 +409,7 @@ def main() -> None:
     if "disk" in configs:
         shutil.rmtree(DISK_DIR, ignore_errors=True)
         os.makedirs(DISK_DIR, exist_ok=True)
-        marker = os.path.join(DISK_DIR, "progress.json")
+        marker = os.path.join(DISK_DIR, "progress-*.json")
         log("CLI run: storage_location=disk (will be killed mid-stream) ...")
         kill_info = run_cli(
             cli_argv("disk"), "disk-killed",
