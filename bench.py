@@ -54,6 +54,14 @@ BENCH_DIR = os.path.join(ROOT, "bench_tmp")
 # artifact always carries hardware numbers once any TPU run has succeeded.
 TPU_CAPTURE_PATH = os.path.join(ROOT, "BENCH_TPU_latest.json")
 
+# The axon tunnel's bandwidth drifts ~10x minute-to-minute (observed
+# 0.008-0.24 GB/s); absolute throughput tracks the link, not the framework.
+# Alongside "latest" we keep the capture taken under the BEST measured link
+# (highest host_to_hbm_gbps) — both are real, timestamped runs with the
+# rig condition recorded, so a low-bandwidth re-capture can never erase the
+# strongest hardware evidence.
+BEST_CAPTURE_PATH = os.path.join(ROOT, "BENCH_TPU_best.json")
+
 # Keys worth persisting/carrying between TPU captures. Every bench run uses
 # the same synthetic model + prompt workload (seed-deterministic), so a key
 # measured by an earlier capture remains meaningful when a later partial run
@@ -75,7 +83,9 @@ HEADLINE_KEYS = (
     "model_flops_per_token",
     "host_to_hbm_gbps",
     "spec_decode_speedup",
+    "spec_mechanism_speedup",
     "spec_acceptance",
+    "spec_pairs",
     "device_kind",
 )
 
@@ -84,9 +94,9 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
-def load_tpu_capture() -> dict | None:
+def load_tpu_capture(path: str = TPU_CAPTURE_PATH) -> dict | None:
     try:
-        with open(TPU_CAPTURE_PATH) as f:
+        with open(path) as f:
             cap = json.load(f)
         return cap if cap.get("platform") == "tpu" else None
     except (OSError, ValueError):
@@ -118,6 +128,22 @@ def persist_tpu_capture(result: dict) -> None:
         log(f"persisted TPU capture -> {TPU_CAPTURE_PATH}")
     except OSError as e:  # pragma: no cover
         log(f"could not persist TPU capture: {e!r}")
+    # Promote to "best" only when this run's measured link is at least as
+    # good as the best capture's — a run that didn't measure bandwidth
+    # can't displace one that did (read from `result`, not `cap`: the
+    # carry-forward above may have inherited an older run's bandwidth).
+    bw = result.get("host_to_hbm_gbps")
+    best = load_tpu_capture(BEST_CAPTURE_PATH)
+    best_bw = (best or {}).get("host_to_hbm_gbps")
+    if best is None or (
+        bw is not None and (best_bw is None or bw >= best_bw)
+    ):
+        try:
+            with open(BEST_CAPTURE_PATH, "w") as f:
+                json.dump(cap, f, indent=1)
+            log(f"promoted to best TPU capture -> {BEST_CAPTURE_PATH}")
+        except OSError as e:  # pragma: no cover
+            log(f"could not persist best TPU capture: {e!r}")
 
 
 def _probe_backend_hung(timeout_s: float = 90.0) -> bool:
@@ -407,12 +433,30 @@ def _set_throughput(result: dict, total_tokens: int, wall: float, dev) -> None:
 
 
 def bench_spec(cfg_obj, tok, result: dict, budget_left, n_tok: int = 8, k: int = 8) -> None:
-    """Speculative streamed decode vs plain streamed decode on an
-    input-grounded (repetition-heavy) workload. decode_resident='off'
-    emulates the regime the mode exists for — a model too big for HBM,
-    where EVERY decode step re-streams the full weights — so the measured
-    ratio is the weight-stream amortisation from verifying k prompt-lookup
-    drafts per pass (runtime/decode.py propose_draft)."""
+    """Speculative streamed decode vs plain streamed decode.
+    decode_resident='off' emulates the regime the mode exists for — a model
+    too big for HBM, where EVERY decode step re-streams the full weights —
+    so the measured ratio is the weight-stream amortisation from verifying
+    k drafts per pass.
+
+    Two draft sources are measured, because draft QUALITY is a property of
+    the model+workload, not the mechanism:
+    - spec_decode_speedup / spec_acceptance: prompt-lookup drafting
+      (runtime/decode.py propose_draft) on a repetition-heavy workload.
+      The synthetic random-weight bench model need not follow its prompt's
+      n-grams, so acceptance here can be near zero — at which point the
+      true ratio is ~1 (same number of weight streams, K+1-wide verify
+      steps) and any larger reading is tunnel-bandwidth drift.
+    - spec_mechanism_speedup: a replay draft source (the plain run's own
+      greedy picks, injectable via DecodeGenerator(draft_fn=...)) forces
+      acceptance 1.0, isolating the verification mechanism's amortisation
+      upper bound from draft quality.
+
+    Drift defences: the measurement order within each triple rotates with
+    the pair index, so every generator occupies every slot across the reps
+    and a monotone link-speed trend can't systematically inflate one side;
+    acceptance aggregates over ALL pairs; per-pair raw seconds are
+    recorded under spec_pairs."""
     import dataclasses
 
     from flexible_llm_sharding_tpu.runtime.decode import DecodeGenerator
@@ -431,34 +475,72 @@ def bench_spec(cfg_obj, tok, result: dict, budget_left, n_tok: int = 8, k: int =
         decode_fused="off",
     )
     plain = DecodeGenerator(base, tokenizer=tok)
-    plain(prompts)  # warm/compile
-    spec = DecodeGenerator(
-        dataclasses.replace(base, speculative_k=k), tokenizer=tok
-    )
+    plain_scores, _ = plain(prompts)  # warm/compile
+    spec_cfg = dataclasses.replace(base, speculative_k=k)
+    spec = DecodeGenerator(spec_cfg, tokenizer=tok)
     spec(prompts)  # warm/compile
-    # Paired reps, median ratio — same tunnel-drift defence as the
-    # schedule and int8 phases.
-    ratios = []
-    for i in range(3):
+
+    # Replay draft source: every workload sequence is identical by
+    # construction, so the plain run's greedy chain (argmax over its score
+    # history for prompt 0 / suffix 0) IS the continuation every suffix
+    # will produce; drafting it verbatim makes acceptance exactly 1.0.
+    chain = [int(np.argmax(plain_scores[0][0, t])) for t in range(n_tok)]
+    base_ids = tok(prompts[0][0])["input_ids"] + tok(prompts[0][1][0])[
+        "input_ids"
+    ][1:]
+    base_len = len(base_ids)
+
+    def replay_draft(context_ids, kk):
+        done = len(context_ids) - base_len  # tokens generated so far
+        d = chain[done : done + kk]
+        while len(d) < kk:
+            d.append(d[-1] if d else chain[-1])
+        return np.asarray(d, np.int64)
+
+    mech = DecodeGenerator(spec_cfg, tokenizer=tok, draft_fn=replay_draft)
+    mech(prompts)  # warm/compile
+
+    def timed(gen):
         t0 = time.perf_counter()
-        plain(prompts)
-        t_plain = time.perf_counter() - t0
-        t0 = time.perf_counter()
-        spec(prompts)
-        t_spec = time.perf_counter() - t0
-        ratios.append(t_plain / t_spec)
+        gen(prompts)
+        return time.perf_counter() - t0
+
+    ratios, mech_ratios, pairs = [], [], []
+    acc_tot = drafted_tot = 0.0
+    gens = [("plain", plain), ("spec", spec), ("mech", mech)]
+    for i in range(4):
+        order = gens[i % 3 :] + gens[: i % 3]  # rotate the slot assignment
+        t = {name: timed(gen) for name, gen in order}
+        ratios.append(t["plain"] / t["spec"])
+        mech_ratios.append(t["plain"] / t["mech"])
         st = spec.stats
+        acc_tot += st.get("spec_accepted", 0.0)
+        drafted_tot += st.get("spec_drafted", 0.0)
+        mech_st = mech.stats
+        pairs.append(
+            {
+                "plain_s": round(t["plain"], 3),
+                "spec_s": round(t["spec"], 3),
+                "mech_s": round(t["mech"], 3),
+                "accepted": st.get("spec_accepted"),
+                "drafted": st.get("spec_drafted"),
+                "mech_accepted": mech_st.get("spec_accepted"),
+            }
+        )
         log(
-            f"spec pair {i}: plain={t_plain:.2f}s spec={t_spec:.2f}s "
-            f"ratio={ratios[-1]:.3f} passes={st.get('spec_passes')} "
-            f"accepted={st.get('spec_accepted')}/{st.get('spec_drafted')}"
+            f"spec pair {i}: plain={t['plain']:.2f}s spec={t['spec']:.2f}s "
+            f"mech={t['mech']:.2f}s ratio={ratios[-1]:.3f} "
+            f"mech_ratio={mech_ratios[-1]:.3f} "
+            f"accepted={st.get('spec_accepted')}/{st.get('spec_drafted')} "
+            f"mech_accepted={mech_st.get('spec_accepted')}/"
+            f"{mech_st.get('spec_drafted')}"
         )
         result["spec_decode_speedup"] = round(float(np.median(ratios)), 3)
-        result["spec_acceptance"] = round(
-            st.get("spec_accepted", 0.0)
-            / max(st.get("spec_drafted", 1.0), 1.0),
-            3,
+        result["spec_mechanism_speedup"] = round(
+            float(np.median(mech_ratios)), 3
         )
+        result["spec_acceptance"] = round(acc_tot / max(drafted_tot, 1.0), 3)
+        result["spec_pairs"] = pairs
         if budget_left() < 0.06:
             log("  spec pair budget exhausted; stopping reps")
             break
@@ -724,6 +806,11 @@ def main() -> None:
     capture = load_tpu_capture()
     if capture is not None:
         result["tpu_capture"] = capture
+    best = load_tpu_capture(BEST_CAPTURE_PATH)
+    if best is not None and best.get("captured_at") != (
+        (capture or {}).get("captured_at")
+    ):
+        result["tpu_best_capture"] = best
 
     # The axon tunnel can WEDGE (a device_get that never returns) rather than
     # fail — seen in practice mid-phase after all headline numbers were

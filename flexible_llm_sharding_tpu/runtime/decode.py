@@ -336,7 +336,13 @@ class DecodeGenerator:
         weight_source_factory=None,
         mp_devices=None,
         resident: bool | None = None,
+        draft_fn=None,
     ):
+        # draft_fn(context_ids, k) -> exactly-k int64 draft ids: a custom
+        # speculative draft source (HF assisted generation's pluggable
+        # candidate-generator idea); defaults to prompt-lookup
+        # (propose_draft). Verification is draft-agnostic — any source
+        # keeps greedy-exact output; quality only changes acceptance.
         # weight_source_factory: DP mode passes views of one shared
         # BroadcastShardSource (rounds = num_gen_token — one per weight
         # stream, prefill plus each decode step — or 1 in resident mode) so
@@ -365,6 +371,7 @@ class DecodeGenerator:
                 "speculative_k does not compose with data_parallel decode"
             )
         self.weight_source_factory = weight_source_factory
+        self._draft_fn = draft_fn if draft_fn is not None else propose_draft
         self.cfg = cfg
         self.model_cfg = LlamaConfig.from_pretrained(cfg.model_path)
         self.device = device
@@ -884,7 +891,7 @@ class DecodeGenerator:
                             for s in range(s_b):
                                 f[r, s, 0] = hist_t[b][r][s][-1]
                                 if g_state[b][r, s] < n_gen:
-                                    d[r, s] = propose_draft(
+                                    d[r, s] = self._draft_fn(
                                         ctx[b][r][s], spec_k
                                     )
                         f[:, :, 1:] = d
