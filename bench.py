@@ -80,6 +80,7 @@ HEADLINE_KEYS = (
     "decode_speedup_4tok",
     "decode_score_maxerr",
     "mfu",
+    "mfu_compute",
     "model_flops_per_token",
     "host_to_hbm_gbps",
     "spec_decode_speedup",
@@ -679,6 +680,20 @@ def run_bench(result: dict) -> None:
     except Exception:
         log("mfu accounting failed:\n" + traceback.format_exc())
     _set_throughput(result, total_tokens, wall_overlap, devs[0])
+    # Compute-window MFU: model FLOPs over the DEVICE-compute seconds of one
+    # measured pass (executor stats exclude weight-upload waits). On this
+    # rig the end-to-end mfu is pinned to the ~0.1 GB/s tunnel; this shows
+    # what fraction of chip peak the compute windows themselves hit.
+    try:
+        from flexible_llm_sharding_tpu.utils.metrics import chip_peak_flops
+
+        cw = ex1.stats.get("compute_wall_s")
+        fpt = result.get("model_flops_per_token")
+        peak_fl = chip_peak_flops(devs[0])
+        if cw and fpt and peak_fl:
+            result["mfu_compute"] = round(fpt * total_tokens / cw / peak_fl, 6)
+    except Exception:
+        log("compute-mfu accounting failed:\n" + traceback.format_exc())
 
     if eff == 0:
         # The platform-tuned schedule IS the serialized reference schedule

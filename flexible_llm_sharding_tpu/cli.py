@@ -289,6 +289,8 @@ def main(argv: list[str] | None = None, tokenizer=None) -> None:
                 tokens_processed += count_tokens(tokenizer, ps, count_cap)
                 return run_prompts(cfg, ps, tokenizer=tokenizer)
 
+            from flexible_llm_sharding_tpu.config import LlamaConfig
+
             output_scores, updated = generation_loop(
                 score_fn,
                 prompts,
@@ -298,6 +300,8 @@ def main(argv: list[str] | None = None, tokenizer=None) -> None:
                 seed=args.seed,
                 top_k=args.top_k,
                 top_p=args.top_p,
+                model_cfg=LlamaConfig.from_pretrained(cfg.model_path),
+                max_token_len=cfg.max_token_len,
             )
     wall = time.perf_counter() - t0
 

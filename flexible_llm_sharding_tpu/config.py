@@ -186,6 +186,12 @@ class LlamaConfig:
     rope_beta_slow: float = 1.0
     rope_attention_factor: float = 1.0
     rope_truncate: bool = True
+    # longrope-only (Phi-3 long-context): per-frequency-band extension
+    # factors, head_dim//2 entries each. The long/short choice is made at
+    # runtime from the sequence's real length vs rope_original_max_position
+    # (ops/rope.py rope_cos_sin).
+    rope_long_factor: tuple | None = None
+    rope_short_factor: tuple | None = None
 
     @property
     def head_dim(self) -> int:
@@ -209,6 +215,14 @@ class LlamaConfig:
                 self.rope_original_max_position,
                 self.rope_attention_factor,
                 self.rope_truncate,
+            )
+        if self.rope_scaling_kind == "longrope":
+            return (
+                "longrope",
+                self.rope_long_factor,
+                self.rope_short_factor,
+                self.rope_original_max_position,
+                self.rope_attention_factor,
             )
         return (
             "llama3",
@@ -461,7 +475,7 @@ class LlamaConfig:
             # mixtral's num_local_experts/num_experts_per_tok likewise.
             # phi3's fused qkv/gate_up projections are a CHECKPOINT layout
             # (split at conversion, utils/checkpoint.py), not a model delta;
-            # its longrope scaling is rejected by the generic rope parse.
+            # its longrope scaling parses via the generic rope branch below.
             if model_type == "mixtral" and not d.get("num_local_experts"):
                 raise ValueError("mixtral config without num_local_experts")
         else:
@@ -478,7 +492,13 @@ class LlamaConfig:
         if d.get("head_dim"):
             kwargs["explicit_head_dim"] = d["head_dim"]
         kwargs.setdefault("num_key_value_heads", d.get("num_attention_heads", 32))
-        for key in ("layer_sliding", "layer_rope", "moe_layer_pattern"):
+        for key in (
+            "layer_sliding",
+            "layer_rope",
+            "moe_layer_pattern",
+            "rope_long_factor",
+            "rope_short_factor",
+        ):
             if kwargs.get(key) is not None:
                 # json round-trips tuples as lists; fields must stay hashable.
                 kwargs[key] = tuple(kwargs[key])
@@ -496,7 +516,7 @@ class LlamaConfig:
         rs = d.get("rope_scaling") or {}
         if rs:
             kind = rs.get("rope_type", rs.get("type"))
-            if kind not in ("linear", "llama3", "yarn"):
+            if kind not in ("linear", "llama3", "yarn", "longrope"):
                 raise NotImplementedError(
                     f"rope_scaling type {kind!r} is not supported yet"
                 )
@@ -533,7 +553,55 @@ class LlamaConfig:
                         else get_mscale(factor)
                     )
                 kwargs["rope_attention_factor"] = float(af)
-        return cls(**kwargs)
+            elif kind == "longrope":
+                import math
+
+                # transformers _compute_longrope_parameters: Phi-3 carries
+                # original_max_position_embeddings at the config top level;
+                # when present, the effective factor is the max/original
+                # ratio (overriding any rope_scaling "factor" key). The
+                # attention factor (applied to cos/sin in both regimes)
+                # is sqrt(1 + ln(factor)/ln(original_max)) unless the
+                # config names one explicitly.
+                lf, sf = rs.get("long_factor"), rs.get("short_factor")
+                if not lf or not sf:
+                    raise ValueError(
+                        "longrope rope_scaling needs long_factor and "
+                        "short_factor lists"
+                    )
+                kwargs["rope_long_factor"] = tuple(float(x) for x in lf)
+                kwargs["rope_short_factor"] = tuple(float(x) for x in sf)
+                max_pos = int(d.get("max_position_embeddings", 2048))
+                orig = d.get("original_max_position_embeddings") or rs.get(
+                    "original_max_position_embeddings"
+                )
+                if orig:
+                    factor = max_pos / int(orig)
+                else:
+                    orig = max_pos
+                kwargs["rope_original_max_position"] = int(orig)
+                af = rs.get("attention_factor")
+                if af is None:
+                    af = (
+                        1.0
+                        if factor <= 1.0
+                        else math.sqrt(1 + math.log(factor) / math.log(int(orig)))
+                    )
+                kwargs["rope_attention_factor"] = float(af)
+                kwargs["rope_scaling_factor"] = float(factor)
+        cfg = cls(**kwargs)
+        if cfg.rope_scaling_kind == "longrope":
+            for nm, fac in (
+                ("long_factor", cfg.rope_long_factor),
+                ("short_factor", cfg.rope_short_factor),
+            ):
+                if fac is None or len(fac) != cfg.head_dim // 2:
+                    raise ValueError(
+                        f"longrope {nm} needs {cfg.head_dim // 2} entries "
+                        f"(head_dim {cfg.head_dim}), got "
+                        f"{None if fac is None else len(fac)}"
+                    )
+        return cfg
 
     @classmethod
     def from_pretrained(cls, model_path: str) -> "LlamaConfig":

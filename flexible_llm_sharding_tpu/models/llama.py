@@ -254,7 +254,7 @@ def _l2_norm(x: jax.Array, eps: float = 1e-6) -> jax.Array:
     return (x32 * jax.lax.rsqrt(jnp.mean(jnp.square(x32), -1, keepdims=True) + eps)).astype(x.dtype)
 
 
-def position_qk(cfg: LlamaConfig, q, k, positions, sliding, rope_on):
+def position_qk(cfg: LlamaConfig, q, k, positions, sliding, rope_on, total_len=None):
     """Apply the per-layer position treatment to fresh q/k heads.
 
     Standard families: rope at ``positions`` (per-layer base via ``sliding``,
@@ -263,9 +263,11 @@ def position_qk(cfg: LlamaConfig, q, k, positions, sliding, rope_on):
     (rope layers only), and temperature-tuned queries on NoPE layers
     (q *= log(floor((pos+1)/floor)+1)*coef + 1). ``rope_on`` follows the
     sliding convention: None = always on, python bool = static, traced
-    scalar = selected inside the scan program.
+    scalar = selected inside the scan program. ``total_len`` (longrope
+    only): real sequence length for the long/short table choice — see
+    ops/rope.py rope_cos_sin.
     """
-    cos, sin = rope_for_layer(cfg, positions, sliding)
+    cos, sin = rope_for_layer(cfg, positions, sliding, total_len)
     rot = apply_rope_interleaved if cfg.rope_interleaved else apply_rope
     q_r, k_r = rot(q, cos, sin), rot(k, cos, sin)
     if cfg.qk_l2_norm:
@@ -301,19 +303,22 @@ def layer_rope_pattern(cfg: LlamaConfig) -> tuple[bool, ...]:
     return (True,) * cfg.num_hidden_layers
 
 
-def rope_for_layer(cfg: LlamaConfig, positions: jax.Array, sliding):
+def rope_for_layer(cfg: LlamaConfig, positions: jax.Array, sliding, total_len=None):
     """cos/sin for one layer. Gemma3 gives sliding (local) layers their own
     UNSCALED rope base while full (global) layers use rope_theta +
     rope_scaling; other families have a single base. ``sliding`` follows the
     layer-fn convention: None = uniform per cfg, python bool = static
     per-layer choice, traced bool = select between the two static tables
-    (both tiny) inside the scan program."""
+    (both tiny) inside the scan program. ``total_len``: longrope's dynamic
+    long/short selector (only the scaled global table uses it)."""
     if cfg.rope_local_theta is None:
         return rope_cos_sin(
-            positions, cfg.head_dim, cfg.rope_theta, cfg.rope_scaling_spec
+            positions, cfg.head_dim, cfg.rope_theta, cfg.rope_scaling_spec,
+            total_len=total_len,
         )
     cos_g, sin_g = rope_cos_sin(
-        positions, cfg.head_dim, cfg.rope_theta, cfg.rope_scaling_spec
+        positions, cfg.head_dim, cfg.rope_theta, cfg.rope_scaling_spec,
+        total_len=total_len,
     )
     cos_l, sin_l = rope_cos_sin(positions, cfg.head_dim, cfg.rope_local_theta, None)
     if sliding is None:
@@ -368,13 +373,15 @@ def decoder_layer(
     mask: jax.Array | None,
     sliding=None,
     rope_on=None,
+    total_len=None,
 ) -> jax.Array:
     """Plain decoder layer. x: [..., L, D]; positions int [..., L] or [L];
     mask broadcastable to [..., L, L] (caller bakes any local mask in;
-    ``sliding``/``rope_on`` select the per-layer rope base / NoPE)."""
+    ``sliding``/``rope_on`` select the per-layer rope base / NoPE;
+    ``total_len`` is longrope's real-length selector)."""
     h = rms_norm(x, params["input_layernorm"]["scale"], cfg.rms_norm_eps, cfg.norm_unit_offset)
     q, k, v = _qkv(params["attn"], cfg, h)
-    q, k = position_qk(cfg, q, k, positions, sliding, rope_on)
+    q, k = position_qk(cfg, q, k, positions, sliding, rope_on, total_len)
     attn_out = attention(
         q, k, v, mask, scale=cfg.attn_scale, softcap=cfg.attn_logit_softcap
     )
@@ -455,6 +462,7 @@ def prefix_suffix_layer(
     sliding=None,
     rope_on=None,
     tp_mesh=None,
+    total_len=None,
 ) -> tuple[jax.Array, ...]:
     """One decoder layer over a (prefix, suffixes) prompt — the streaming hot op.
 
@@ -462,6 +470,12 @@ def prefix_suffix_layer(
         ``prefix_len`` positions are real.
     suffix_h: [S, Ls, D], right-padded suffix continuations.
     prefix_len: int32 scalar (dynamic value; shapes stay static).
+    total_len: longrope only — the prompt's real total length (prefix +
+        longest suffix), an int32 scalar selecting the long/short table
+        for BOTH the shared prefix KV and the suffixes. The executor
+        rejects prompts whose suffixes straddle the original_max boundary
+        (mixed regimes would need the shared prefix KV rotated per
+        suffix, defeating the prefix-sharing trick).
 
     Semantics match the reference exactly (``/root/reference/utils.py:270-279``):
     the prefix runs a causal self-attention once and its (post-RoPE) KV is
@@ -508,7 +522,7 @@ def prefix_suffix_layer(
     # --- prefix: causal self-attention, keep post-RoPE KV ---
     h = rms_norm(prefix_h, params["input_layernorm"]["scale"], eps, cfg.norm_unit_offset)
     q, k, v = _qkv(params["attn"], cfg, h)
-    q, k = position_qk(cfg, q, k, jnp.arange(lp), rope_sliding, rope_on)
+    q, k = position_qk(cfg, q, k, jnp.arange(lp), rope_sliding, rope_on, total_len)
     if flash:
         # Rows at i >= prefix_len are padding; the kernel's valid-len mask
         # additionally skips fully-masked KV blocks.
@@ -546,7 +560,7 @@ def prefix_suffix_layer(
     hs = rms_norm(suffix_h, params["input_layernorm"]["scale"], eps, cfg.norm_unit_offset)
     qs, ks, vs = _qkv(params["attn"], cfg, hs)
     pos_s = prefix_len + jnp.arange(ls)
-    qs, ks = position_qk(cfg, qs, ks, pos_s, rope_sliding, rope_on)
+    qs, ks = position_qk(cfg, qs, ks, pos_s, rope_sliding, rope_on, total_len)
 
     if flash:
         if tp_mesh is not None:
@@ -616,7 +630,12 @@ def decode_step_layer(
     pos = (
         prefix_len + suffix_eos + 1 + jnp.broadcast_to(base, suffix_eos.shape)
     )[:, None] + jnp.arange(kq)[None, :]  # [S, K]
-    q, k_new = position_qk(cfg, q, k_new, pos, rope_sliding, rope_on)
+    # longrope's per-suffix real length at this step (the fed tokens'
+    # last position + 1). DecodeGenerator rejects generations that CROSS
+    # the original_max boundary (parked KV would need re-rotation), so
+    # within one generation this always lands on one side.
+    total_len = pos[:, -1] + 1 if cfg.rope_scaling_kind == "longrope" else None
+    q, k_new = position_qk(cfg, q, k_new, pos, rope_sliding, rope_on, total_len)
 
     kv = dict(kv)
     if base.ndim == 0:
@@ -745,13 +764,19 @@ def forward_full(
     cfg: LlamaConfig,
     ids: jax.Array,
     dtype: jnp.dtype = jnp.float32,
+    total_len=None,
 ) -> jax.Array:
     """Monolithic causal forward: ids [B, L] -> logits [B, L, V] (float32).
 
     Used by tests as the reference invariant (sharded layerwise forward must
-    equal the monolithic forward) and by the training step.
+    equal the monolithic forward) and by the training step. ``total_len``
+    (longrope): defaults to L — HF's own batch forward selects the
+    long/short table from the padded batch length (max position id + 1),
+    so the default reproduces an HF forward on these exact ids.
     """
     b, l = ids.shape
+    if total_len is None and cfg.rope_scaling_kind == "longrope":
+        total_len = jnp.int32(l)
     x = embed(params["embed"], ids, dtype, cfg)
     positions = jnp.arange(l)
     full = causal_mask(l, l)
@@ -766,7 +791,7 @@ def forward_full(
             x = decoder_layer(
                 lp, cfg, x, positions,
                 banded if pattern[i] else full,
-                sliding=pattern[i], rope_on=rope_pat[i],
+                sliding=pattern[i], rope_on=rope_pat[i], total_len=total_len,
             )
     else:  # stacked pytree with leading layer axis -> scan (one compile)
         flags = jnp.asarray(pattern)
@@ -777,7 +802,8 @@ def forward_full(
             mask = jnp.where(sl, banded, full)
             return (
                 decoder_layer(
-                    layer_params, cfg, h, positions, mask, sliding=sl, rope_on=ro
+                    layer_params, cfg, h, positions, mask, sliding=sl,
+                    rope_on=ro, total_len=total_len,
                 ),
                 None,
             )

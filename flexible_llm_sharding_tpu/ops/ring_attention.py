@@ -156,6 +156,7 @@ def ring_decoder_layer(
     return_kv: bool = False,
     sliding: bool = False,
     rope_on: bool = True,
+    total_len=None,
 ) -> jax.Array:
     """A full decoder layer with sequence-parallel (ring) attention.
 
@@ -193,7 +194,9 @@ def ring_decoder_layer(
         h = rms_norm(x_blk, params["input_layernorm"]["scale"], eps, cfg.norm_unit_offset)
         q, k, v = llama._qkv(params["attn"], cfg, h)
         pos = idx * lq + jnp.arange(lq)
-        q, k = llama.position_qk(cfg, q, k, pos, sliding, rope_on)
+        # total_len (longrope's real-length selector, a replicated scalar)
+        # rides the closure like params do.
+        q, k = llama.position_qk(cfg, q, k, pos, sliding, rope_on, total_len)
         return x_blk, q, k, v
 
     qkv_specs = (spec, P(axis, None, None), P(axis, None, None), P(axis, None, None))

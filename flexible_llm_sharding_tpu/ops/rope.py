@@ -73,6 +73,22 @@ def _inv_freq(
             )
             extrap_factor = 1.0 - ramp
             freq = (freq / factor) * (1.0 - extrap_factor) + freq * extrap_factor
+        elif kind == "longrope_ext":
+            # One regime of transformers _compute_longrope_parameters
+            # (LongRoPE, arXiv:2402.13753): inv_freq = 1/(ext * base^(i/d)),
+            # i.e. the base frequencies divided elementwise by the
+            # per-band extension factors. Which regime (long vs short
+            # factors) applies is selected DYNAMICALLY in rope_cos_sin by
+            # the sequence's real total length; this cache entry holds one
+            # regime's static table.
+            (_, ext) = scaling
+            ext_arr = np.asarray(ext, dtype=np.float64)
+            if ext_arr.shape != freq.shape:
+                raise ValueError(
+                    f"longrope factor list has {ext_arr.shape[0]} entries "
+                    f"for head_dim {head_dim} (need {freq.shape[0]})"
+                )
+            freq = freq / ext_arr
         else:  # pragma: no cover — config parsing rejects unknown kinds
             raise NotImplementedError(f"rope scaling kind {kind!r}")
     return freq.astype(np.float32)
@@ -80,9 +96,12 @@ def _inv_freq(
 
 def rope_attention_scale(scaling: tuple | None) -> float:
     """Post-processing factor HF applies to the cos/sin tables (yarn's
-    attention/mscale factor; 1.0 for every other kind)."""
+    attention/mscale factor, longrope's attention factor; 1.0 for every
+    other kind)."""
     if scaling is not None and scaling[0] == "yarn":
         return float(scaling[5])
+    if scaling is not None and scaling[0] == "longrope":
+        return float(scaling[4])
     return 1.0
 
 
@@ -91,17 +110,46 @@ def rope_cos_sin(
     head_dim: int,
     theta: float,
     scaling: tuple | None = None,
+    total_len: jax.Array | None = None,
 ) -> tuple[jax.Array, jax.Array]:
     """cos/sin tables for given integer positions.
 
     positions: int array [..., L] -> (cos, sin) float32 [..., L, head_dim//2].
     scaling: hashable scaling spec from ``LlamaConfig.rope_scaling_spec``
-    (None, ("linear", factor), or ("llama3", factor, low, high, orig_max)).
+    (None, ("linear", factor), ("llama3", ...), ("yarn", ...) or
+    ("longrope", long_factors, short_factors, orig_max, att_factor)).
+
+    total_len: longrope only — the sequence's REAL total length (prefix +
+    suffix, unpadded; a dynamic value, scalar or broadcastable to the
+    leading dims of ``positions``). Selects between the long/short factor
+    tables the way transformers' longrope_frequency_update does
+    (seq_len > original_max_position_embeddings -> long), except the
+    length is the per-sequence real length rather than HF's batch-global
+    padded max — identical to HF on unpadded per-sequence calls, which is
+    what the scoring oracle computes. Required for longrope: the choice
+    changes logits, so an un-threaded caller must fail loudly rather than
+    silently pick one regime.
     """
-    freqs = jnp.asarray(_inv_freq(head_dim, theta, scaling))
+    if scaling is not None and scaling[0] == "longrope":
+        (_, long_f, short_f, orig_max, _af) = scaling
+        if total_len is None:
+            raise ValueError(
+                "longrope rope scaling requires total_len (the real "
+                "sequence length) to choose the long/short factor table"
+            )
+        f_long = jnp.asarray(_inv_freq(head_dim, theta, ("longrope_ext", long_f)))
+        f_short = jnp.asarray(_inv_freq(head_dim, theta, ("longrope_ext", short_f)))
+        is_long = jnp.asarray(total_len) > orig_max
+        # Align: freqs must broadcast against positions[..., None].
+        is_long = is_long.reshape(
+            is_long.shape + (1,) * (positions.ndim + 1 - is_long.ndim)
+        )
+        freqs = jnp.where(is_long, f_long, f_short)
+    else:
+        freqs = jnp.asarray(_inv_freq(head_dim, theta, scaling))
     angles = positions.astype(jnp.float32)[..., None] * freqs
     att = rope_attention_scale(scaling)
-    if att != 1.0:  # yarn: cos/sin scaled by the attention factor
+    if att != 1.0:  # yarn/longrope: cos/sin scaled by the attention factor
         return jnp.cos(angles) * att, jnp.sin(angles) * att
     return jnp.cos(angles), jnp.sin(angles)
 

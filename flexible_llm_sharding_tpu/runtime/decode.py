@@ -46,6 +46,8 @@ from flexible_llm_sharding_tpu.runtime.executor import (
 )
 from flexible_llm_sharding_tpu.runtime.tokenization import (
     PromptTokenizer,
+    check_longrope_regime,
+    longrope_total_len,
     make_blocks,
 )
 from flexible_llm_sharding_tpu.utils import checkpoint
@@ -59,31 +61,38 @@ Params = dict[str, Any]
 
 @partial(jax.jit, static_argnums=(0, 1, 2), donate_argnums=(4, 5))
 def _prefill_decoders(
-    cfg: LlamaConfig, use_pallas, tp_mesh, seg, prefix_h, suffix_h, prefix_len
+    cfg: LlamaConfig, use_pallas, tp_mesh, seg, prefix_h, suffix_h, prefix_len,
+    total_len=None,
 ):
     """Scan k layers over a block, emitting per-layer KV as scan outputs.
 
     seg: {"layers": [k, ...] pytree, "sliding": bool [k] or None,
     "rope": bool [k] or None (llama4 NoPE flags)}.
     Returns (prefix_h, suffix_h, kv) with kv leaves shaped [k, B, ...].
+    ``total_len`` int32 [B]: longrope's per-prompt real-length selector.
     """
     stacked, flags, rflags = seg["layers"], seg["sliding"], seg.get("rope")
 
     def body(carry, xs):
         layer_params, sliding, rope_on = xs
         p, s = carry
-        step = jax.vmap(
-            partial(
-                llama.prefix_suffix_layer,
+
+        def one_layer(lp_, c_, p_, s_, plen_, tlen_):
+            return llama.prefix_suffix_layer(
+                lp_, c_, p_, s_, plen_,
                 use_pallas=use_pallas,
                 return_kv=True,
                 sliding=sliding,
                 rope_on=rope_on,
                 tp_mesh=tp_mesh,
-            ),
-            in_axes=(None, None, 0, 0, 0),
+                total_len=tlen_,
+            )
+
+        step = jax.vmap(
+            one_layer,
+            in_axes=(None, None, 0, 0, 0, 0 if total_len is not None else None),
         )
-        p, s, kv = step(layer_params, cfg, p, s, prefix_len)
+        p, s, kv = step(layer_params, cfg, p, s, prefix_len, total_len)
         return (p, s), kv
 
     (prefix_h, suffix_h), kv = jax.lax.scan(
@@ -560,6 +569,17 @@ class DecodeGenerator:
         n_gen = num_gen_token or cfg.num_gen_token
         t_start = time.perf_counter()
         toks = [self.tokenizer(p, s) for p, s in prompts]
+        # KV decode parks rope-rotated KV at prefill: fed positions must
+        # not cross the longrope regime boundary (HF's dynamic table switch
+        # would require re-rotating the parked cache). Plain decode feeds
+        # tokens 1..n_gen-1; a speculative pass's fixed-width K+1 draft
+        # window can overshoot by spec_k more.
+        check_longrope_regime(
+            self.model_cfg,
+            toks,
+            extra_len=max(n_gen - 1, 0)
+            + (cfg.speculative_k if cfg.speculative_k else 0),
+        )
         blocks = make_blocks(toks, cfg.block_size)
         # KV follows the weights: once the model is resident there is HBM
         # headroom, and host-parked KV would be re-uploaded per shard per
@@ -668,9 +688,13 @@ class DecodeGenerator:
                                 self.model_cfg, self.dtype, params, prefix_ids, suffix_ids
                             )
                         elif kind == "decoders":
+                            total_len = longrope_total_len(
+                                self.model_cfg, prefix_len, suffix_eos
+                            )
                             ph, sh, kv = _prefill_decoders(
                                 self.model_cfg, self._use_pallas,
                                 self._tp_mesh, params, ph, sh, prefix_len,
+                                total_len,
                             )
                             # Pre-extend with empty generated-token slots so
                             # decode scans can donate in place.

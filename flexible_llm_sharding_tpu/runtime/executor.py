@@ -41,6 +41,8 @@ from flexible_llm_sharding_tpu.parallel.planner import ShardPlan, plan_shards_dp
 from flexible_llm_sharding_tpu.runtime.activations import ActivationStore
 from flexible_llm_sharding_tpu.runtime.tokenization import (
     PromptTokenizer,
+    check_longrope_regime,
+    longrope_total_len,
     TokenizedPrompt,
     make_blocks,
 )
@@ -79,7 +81,7 @@ def _embed_block(cfg: LlamaConfig, dtype, embed_params, prefix_ids, suffix_ids):
 @partial(jax.jit, static_argnums=(0, 5, 6), donate_argnums=(2, 3))
 def _decoder_block(
     cfg: LlamaConfig, seg, prefix_h, suffix_h, prefix_len, use_pallas=False,
-    tp_mesh=None,
+    tp_mesh=None, total_len=None,
 ):
     """Scan k stacked decoder layers over a block of prompts.
 
@@ -90,6 +92,8 @@ def _decoder_block(
     step's output reuses the input buffers. ``use_pallas`` (static) routes
     attention through the flash kernels; ``tp_mesh`` (static, hashable)
     makes them run per head-shard via shard_map under tensor parallelism.
+    ``total_len`` int32 [B] (longrope only): per-prompt real total length
+    for the long/short rope table choice.
     """
     stacked, flags = seg["layers"], seg["sliding"]
     rflags = seg.get("rope")
@@ -97,17 +101,22 @@ def _decoder_block(
     def body(carry, xs):
         layer_params, sliding, rope_on = xs
         p, s = carry
-        step = jax.vmap(
-            partial(
-                llama.prefix_suffix_layer,
+
+        def one_layer(lp_, c_, p_, s_, plen_, tlen_):
+            return llama.prefix_suffix_layer(
+                lp_, c_, p_, s_, plen_,
                 use_pallas=use_pallas,
                 sliding=sliding,
                 rope_on=rope_on,
                 tp_mesh=tp_mesh,
-            ),
-            in_axes=(None, None, 0, 0, 0),
+                total_len=tlen_,
+            )
+
+        step = jax.vmap(
+            one_layer,
+            in_axes=(None, None, 0, 0, 0, 0 if total_len is not None else None),
         )
-        p, s = step(layer_params, cfg, p, s, prefix_len)
+        p, s = step(layer_params, cfg, p, s, prefix_len, total_len)
         return (p, s), None
 
     # flags may be None: scan treats them as empty subtrees, and the body's
@@ -259,6 +268,10 @@ def apply_segments(
     pipeline runner.
     """
     block_scores = None
+    # longrope: per-prompt real total length (prefix + longest suffix)
+    # selects the long/short rope table; tokenization has already rejected
+    # prompts whose suffixes straddle the boundary (check_longrope_regime).
+    total_len = longrope_total_len(model_cfg, prefix_len, suffix_eos)
     for kind, params in segments:
         if kind == "embed":
             prefix_h, suffix_h = _embed_block(
@@ -267,7 +280,7 @@ def apply_segments(
         elif kind == "decoders":
             prefix_h, suffix_h = _decoder_block(
                 model_cfg, params, prefix_h, suffix_h, prefix_len, use_pallas,
-                tp_mesh,
+                tp_mesh, total_len,
             )
         elif kind == "norm":
             suffix_h = _norm_block(model_cfg, params, suffix_h, suffix_eos)
@@ -838,7 +851,12 @@ class StreamingExecutor:
         return np_dtype_for(self.cfg.dtype)
 
     def _tokenize(self, prompts) -> list[TokenizedPrompt]:
-        return [self.tokenizer(p, s) for p, s in prompts]
+        toks = [self.tokenizer(p, s) for p, s in prompts]
+        # Scoring is one full forward per pass, so only within-prompt
+        # regime uniformity matters (the slow generation loop re-chooses
+        # the table each pass, exactly like HF's full recompute).
+        check_longrope_regime(self.model_cfg, toks)
+        return toks
 
     # -- disk-mode crash resume (markers shared with the pipeline: see
     # runtime/resume.py for the signature/marker contract) -----------------

@@ -126,6 +126,8 @@ def generation_loop(
     seed: int = 0,
     top_k: int = 0,
     top_p: float = 0.0,
+    model_cfg=None,
+    max_token_len: int = 4096,
 ) -> tuple[list[np.ndarray], list[Prompt]]:
     """Run ``num_gen_token`` decode iterations (greedy by default).
 
@@ -141,6 +143,29 @@ def generation_loop(
     meaningful with temperature > 0). Sampling is deterministic given
     ``seed``.
     """
+    # longrope models (``model_cfg`` supplied): per-pass scoring re-checks
+    # regime uniformity, but a MULTI-suffix prompt near the boundary can
+    # pass early iterations and straddle only once the suffixes have grown
+    # — failing mid-run after whole weight streams were spent. Reject those
+    # prompts upfront when the growth window [shortest initial length,
+    # longest initial length + num_gen_token - 1] brackets the boundary.
+    # Single-suffix prompts are exempt: each pass is a full forward, so the
+    # per-pass table flip at the boundary is exactly HF's own recompute
+    # behaviour.
+    if (
+        model_cfg is not None
+        and model_cfg.rope_scaling_kind == "longrope"
+        and num_gen_token > 1
+    ):
+        from flexible_llm_sharding_tpu.runtime.tokenization import (
+            PromptTokenizer,
+            check_longrope_regime,
+        )
+
+        ptok = PromptTokenizer(tokenizer, max_token_len=max_token_len)
+        multi = [ptok(p, s) for p, s in prompts if len(s) > 1]
+        check_longrope_regime(model_cfg, multi, extra_len=num_gen_token - 1)
+
     original = list(prompts)
     current: list[Prompt] = copy.deepcopy(original)
     output_scores: list[np.ndarray] = []

@@ -43,7 +43,12 @@ from flexible_llm_sharding_tpu.runtime.executor import (
     _DTYPES,
     np_dtype_for,
 )
-from flexible_llm_sharding_tpu.runtime.tokenization import PromptTokenizer, bucket_len
+from flexible_llm_sharding_tpu.runtime.tokenization import (
+    PromptTokenizer,
+    bucket_len,
+    check_longrope_regime,
+    longrope_total_len,
+)
 from flexible_llm_sharding_tpu.utils import checkpoint
 
 Params = dict[str, Any]
@@ -89,6 +94,7 @@ def sharded_prefix_suffix_layer(
     sliding: bool = False,
     rope_on: bool = True,
     return_kv: bool = False,
+    total_len=None,
 ):
     """One decoder layer of the long-context scoring step.
 
@@ -112,14 +118,14 @@ def sharded_prefix_suffix_layer(
     # --- prefix: ring attention layer, keeping its post-rope KV ---
     prefix_out, k_all, v_all = ring_decoder_layer(
         params, cfg, prefix_x, mesh, axis=axis, return_kv=True,
-        sliding=sliding, rope_on=rope_on,
+        sliding=sliding, rope_on=rope_on, total_len=total_len,
     )
 
     # --- suffix q/k/v at global positions prefix_len + i ---
     hs = rms_norm(suffix_h, params["input_layernorm"]["scale"], eps, cfg.norm_unit_offset)
     qs, ks, vs = llama._qkv(params["attn"], cfg, hs)
     pos_s = prefix_len + jnp.arange(ls)
-    qs, ks = llama.position_qk(cfg, qs, ks, pos_s, sliding, rope_on)
+    qs, ks = llama.position_qk(cfg, qs, ks, pos_s, sliding, rope_on, total_len)
 
     n_kv = cfg.num_key_value_heads
     g = cfg.num_attention_heads // n_kv
@@ -221,7 +227,10 @@ def sharded_decode_layer(
     h = rms_norm(x, params["input_layernorm"]["scale"], eps, cfg.norm_unit_offset)
     q, k_new, v_new = llama._qkv(params["attn"], cfg, h)  # [S, 1, n, hd]
     pos = (prefix_len + suffix_eos + 1 + t)[:, None]  # [S, 1]
-    q, k_new = llama.position_qk(cfg, q, k_new, pos, sliding, rope_on)
+    # longrope: per-suffix real length at this step; the decode runner's
+    # check_longrope_regime guarantees the regime is constant per run.
+    tl = pos[:, -1] + 1 if cfg.rope_scaling_kind == "longrope" else None
+    q, k_new = llama.position_qk(cfg, q, k_new, pos, sliding, rope_on, tl)
 
     kv = dict(kv)
     kv["kg"] = jax.lax.dynamic_update_slice_in_dim(kv["kg"], k_new, t, axis=1)
@@ -323,10 +332,10 @@ class LongContextScorer:
         self._rep = NamedSharding(self.mesh, P())
         self._seq = NamedSharding(self.mesh, P("sp"))
         self._layer_fn = jax.jit(
-            lambda params, px, sh, plen, sliding, rope_on: (
+            lambda params, px, sh, plen, sliding, rope_on, total_len=None: (
                 sharded_prefix_suffix_layer(
                     params, self.model_cfg, self.mesh, "sp", px, sh, plen,
-                    sliding=sliding, rope_on=rope_on,
+                    sliding=sliding, rope_on=rope_on, total_len=total_len,
                 )
             ),
             # Static per-layer flags: at most four traces (local/global ×
@@ -382,6 +391,7 @@ class LongContextScorer:
 
     def _score_one(self, prefix: str, suffixes: tuple, stream) -> np.ndarray:
         t = self.tokenizer(prefix, suffixes)
+        check_longrope_regime(self.model_cfg, [t])
         # The prefix bucket must split evenly over the ring.
         lp = bucket_len(
             len(t.prefix_ids), self.cfg.bucket_multiple * self.sp, self.cap
@@ -392,6 +402,9 @@ class LongContextScorer:
         suffix_ids = jax.device_put(jnp.asarray(t.suffix_ids), self._rep)
         prefix_len = jnp.int32(t.prefix_len)
         suffix_eos = jax.device_put(jnp.asarray(t.suffix_eos), self._rep)
+        total_len = longrope_total_len(
+            self.model_cfg, t.prefix_len, t.suffix_eos[: t.num_suffixes]
+        )
 
         prefix_x = suffix_h = scores = None
         for _ in range(len(self.plan.shards)):
@@ -411,7 +424,7 @@ class LongContextScorer:
                         sliding, rope_on = self._layer_flags(params, i)
                         prefix_x, suffix_h = self._layer_fn(
                             layer, prefix_x, suffix_h, prefix_len, sliding,
-                            rope_on,
+                            rope_on, total_len,
                         )
                 elif kind == "norm":
                     suffix_h = llama.select_eos_and_norm(
@@ -452,10 +465,11 @@ class LongContextDecoder(LongContextScorer):
         super().__init__(cfg, devices=devices, tokenizer=tokenizer)
         self.raw_tokenizer = tokenizer
         self._prefill_fn = jax.jit(
-            lambda params, px, sh, plen, sliding, rope_on: (
+            lambda params, px, sh, plen, sliding, rope_on, total_len=None: (
                 sharded_prefix_suffix_layer(
                     params, self.model_cfg, self.mesh, "sp", px, sh, plen,
                     sliding=sliding, rope_on=rope_on, return_kv=True,
+                    total_len=total_len,
                 )
             ),
             static_argnums=(4, 5),
@@ -522,6 +536,10 @@ class LongContextDecoder(LongContextScorer):
         self, prefix: str, suffixes: tuple, stream, n_gen: int, pick
     ):
         t = self.tokenizer(prefix, suffixes)
+        # Fed positions must not cross the longrope boundary: parked
+        # (sp-sharded) prefix KV can't be re-rotated mid-generation. The
+        # last generated token is never fed back, hence n_gen - 1.
+        check_longrope_regime(self.model_cfg, [t], extra_len=max(n_gen - 1, 0))
         lp = bucket_len(
             len(t.prefix_ids), self.cfg.bucket_multiple * self.sp, self.cap
         )
@@ -531,6 +549,9 @@ class LongContextDecoder(LongContextScorer):
         suffix_ids = jax.device_put(jnp.asarray(t.suffix_ids), self._rep)
         prefix_len = jnp.int32(t.prefix_len)
         suffix_eos = jax.device_put(jnp.asarray(t.suffix_eos), self._rep)
+        total_len = longrope_total_len(
+            self.model_cfg, t.prefix_len, t.suffix_eos[: t.num_suffixes]
+        )
         s_cnt = t.suffix_ids.shape[0]
         n_kv, hd = self.model_cfg.num_key_value_heads, self.model_cfg.head_dim
 
@@ -553,7 +574,7 @@ class LongContextDecoder(LongContextScorer):
                         sliding, rope_on = self._layer_flags(params, i)
                         prefix_x, suffix_h, kv = self._prefill_fn(
                             layer, prefix_x, suffix_h, prefix_len, sliding,
-                            rope_on,
+                            rope_on, total_len,
                         )
                         gen_shape = (s_cnt, max(1, n_gen - 1), n_kv, hd)
                         kv_layers.append(

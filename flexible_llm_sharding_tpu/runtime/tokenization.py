@@ -131,6 +131,48 @@ class PromptTokenizer:
         )
 
 
+def longrope_total_len(model_cfg, prefix_len, suffix_eos):
+    """Per-prompt real total length for longrope's long/short table choice
+    (None for every other scaling kind). prefix_len: scalar or [B];
+    suffix_eos: [S] or [B, S] — padded suffix rows carry eos 0, so the max
+    over the last axis is the longest REAL suffix."""
+    if model_cfg.rope_scaling_kind != "longrope":
+        return None
+    import jax.numpy as jnp
+
+    return prefix_len + jnp.max(jnp.asarray(suffix_eos), axis=-1) + 1
+
+
+def check_longrope_regime(model_cfg, toks, extra_len: int = 0) -> None:
+    """Loud precondition for longrope models (Phi-3 long-context).
+
+    The long/short rope table is chosen per PROMPT by its real total
+    length (ops/rope.py), while the streaming executor shares one prefix
+    KV across all suffixes — so every (prefix + suffix) sequence of a
+    prompt must sit on the same side of original_max_position_embeddings.
+    ``extra_len`` is the maximum length growth the caller's decode steps
+    can FEED beyond the initial sequence (KV decode: n_gen - 1, the last
+    generated token is never fed back; speculative: plus spec_k for the
+    widest draft window) — the grown length must not CROSS the boundary:
+    KV parked under one regime cannot be re-rotated when HF's dynamic
+    update would switch tables mid-generation.
+    Raises ValueError naming the first offending prompt.
+    """
+    if model_cfg.rope_scaling_kind != "longrope":
+        return
+    orig = model_cfg.rope_original_max_position
+    for i, t in enumerate(toks):
+        lens = t.prefix_len + t.suffix_eos[: t.num_suffixes] + 1
+        lo, hi = int(lens.min()), int(lens.max()) + extra_len
+        if (lo <= orig) != (hi <= orig):
+            raise ValueError(
+                f"prompt {i}: longrope sequence lengths {lo}..{hi} straddle "
+                f"original_max_position_embeddings={orig}; the long/short "
+                "rope regime must be uniform per prompt (split the prompt, "
+                "shorten generation, or pad the prefix past the boundary)"
+            )
+
+
 def count_tokens(tokenizer, prompts, max_token_len: int = 4096) -> int:
     """Tokens one full scoring pass processes for ``prompts``, counted with
     the same semantics as PromptTokenizer (prefix truncated to

@@ -726,7 +726,9 @@ def test_phi3_split_and_executor(rng, tmp_path):
         want = torch.softmax(logits.float(), -1).numpy()
         np.testing.assert_allclose(got[0][s, 0], want, rtol=2e-4, atol=2e-5)
 
-    with pytest.raises(NotImplementedError):
+    # longrope is supported (test_rope_scaling.py covers it end-to-end);
+    # a config missing its factor lists still fails loudly.
+    with pytest.raises(ValueError, match="long_factor"):
         LlamaConfig.from_hf_config(
             {
                 "model_type": "phi3",

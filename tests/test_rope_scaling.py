@@ -13,7 +13,7 @@ from flexible_llm_sharding_tpu.ops.rope import _inv_freq
 from tests.test_numerics import _params_from_hf
 
 
-def _mk_hf(tiny_cfg, rope_scaling):
+def _mk_hf(tiny_cfg, rope_scaling, **extra):
     from transformers import LlamaConfig as HFConfig
     from transformers import LlamaForCausalLM
 
@@ -29,6 +29,7 @@ def _mk_hf(tiny_cfg, rope_scaling):
         max_position_embeddings=tiny_cfg.max_position_embeddings,
         rope_scaling=rope_scaling,
         attn_implementation="eager",
+        **extra,
     )
     return LlamaForCausalLM(hf_cfg).eval(), hf_cfg
 
@@ -61,7 +62,7 @@ def test_config_parses_llama3_scaling(tiny_cfg):
     )
     assert cfg2.rope_scaling_spec == ("linear", 2.0)
     with pytest.raises(NotImplementedError):
-        LlamaConfig.from_hf_config({"rope_scaling": {"rope_type": "longrope"}})
+        LlamaConfig.from_hf_config({"rope_scaling": {"rope_type": "dynamic"}})
 
 
 def test_config_parses_yarn_scaling():
@@ -156,6 +157,301 @@ def test_yarn_split_and_cli(tiny_cfg, tmp_path):
             ).numpy()
         np.testing.assert_allclose(got[0][s, 0], want, rtol=3e-4, atol=3e-5)
     assert os.path.exists(out / "config.json")
+
+
+# Phi-3 style longrope: per-band extension factors (head_dim 16 -> 8 bands),
+# original pretraining window carried at the config top level.
+LONGROPE_FACTORS = {
+    "rope_type": "longrope",
+    "long_factor": [1.5 + 0.25 * i for i in range(8)],
+    "short_factor": [1.0 + 0.05 * i for i in range(8)],
+}
+LONGROPE_ORIG_MAX = 64
+
+
+def _longrope_hf_cfg_dict(tiny_cfg):
+    return {
+        "hidden_size": tiny_cfg.hidden_size,
+        "num_attention_heads": tiny_cfg.num_attention_heads,
+        "max_position_embeddings": tiny_cfg.max_position_embeddings,
+        "original_max_position_embeddings": LONGROPE_ORIG_MAX,
+        "rope_scaling": LONGROPE_FACTORS,
+    }
+
+
+def test_config_parses_longrope_scaling(tiny_cfg):
+    import math
+
+    cfg = LlamaConfig.from_hf_config(_longrope_hf_cfg_dict(tiny_cfg))
+    kind, long_f, short_f, orig, af = cfg.rope_scaling_spec
+    assert kind == "longrope"
+    assert long_f == tuple(LONGROPE_FACTORS["long_factor"])
+    assert short_f == tuple(LONGROPE_FACTORS["short_factor"])
+    assert orig == LONGROPE_ORIG_MAX
+    factor = tiny_cfg.max_position_embeddings / LONGROPE_ORIG_MAX
+    assert af == pytest.approx(
+        math.sqrt(1 + math.log(factor) / math.log(LONGROPE_ORIG_MAX))
+    )
+    # Explicit attention_factor wins (HF _compute_longrope_parameters).
+    d2 = _longrope_hf_cfg_dict(tiny_cfg)
+    d2["rope_scaling"] = dict(LONGROPE_FACTORS, attention_factor=1.5)
+    assert LlamaConfig.from_hf_config(d2).rope_attention_factor == 1.5
+    # Missing factor lists and wrong lengths fail loudly.
+    with pytest.raises(ValueError, match="long_factor"):
+        LlamaConfig.from_hf_config(
+            dict(_longrope_hf_cfg_dict(tiny_cfg), rope_scaling={"rope_type": "longrope"})
+        )
+    bad = dict(LONGROPE_FACTORS, long_factor=[1.0, 2.0])
+    with pytest.raises(ValueError, match="entries"):
+        LlamaConfig.from_hf_config(
+            dict(_longrope_hf_cfg_dict(tiny_cfg), rope_scaling=bad)
+        )
+
+
+def test_longrope_tables_match_hf_both_regimes(tiny_cfg):
+    """Long/short inv_freq + attention factor vs HF, and rope_cos_sin's
+    dynamic table choice at the boundary."""
+    from transformers.modeling_rope_utils import ROPE_INIT_FUNCTIONS
+
+    from flexible_llm_sharding_tpu.ops.rope import (
+        rope_attention_scale,
+        rope_cos_sin,
+    )
+
+    _, hf_cfg = _mk_hf(
+        tiny_cfg,
+        LONGROPE_FACTORS,
+        original_max_position_embeddings=LONGROPE_ORIG_MAX,
+    )
+    cfg = LlamaConfig.from_hf_config(hf_cfg.to_dict())
+    spec = cfg.rope_scaling_spec
+    hd = tiny_cfg.hidden_size // tiny_cfg.num_attention_heads
+    for seq_len, sub in (
+        (LONGROPE_ORIG_MAX, ("longrope_ext", spec[2])),  # short regime
+        (LONGROPE_ORIG_MAX + 1, ("longrope_ext", spec[1])),  # long regime
+    ):
+        want, want_af = ROPE_INIT_FUNCTIONS["longrope"](
+            hf_cfg, device="cpu", seq_len=seq_len
+        )
+        got = _inv_freq(hd, 500000.0, sub)
+        np.testing.assert_allclose(got, want.numpy(), rtol=1e-6, atol=0)
+        assert rope_attention_scale(spec) == pytest.approx(want_af)
+        # The dynamic selector picks the same table.
+        pos = jnp.arange(7)
+        cos, _ = rope_cos_sin(pos, hd, 500000.0, spec, total_len=jnp.int32(seq_len))
+        want_cos = np.cos(np.arange(7)[:, None] * got) * want_af
+        np.testing.assert_allclose(np.asarray(cos), want_cos, rtol=1e-5, atol=1e-6)
+    with pytest.raises(ValueError, match="total_len"):
+        rope_cos_sin(jnp.arange(4), hd, 500000.0, spec)
+
+
+def test_longrope_forward_matches_hf_both_regimes(tiny_cfg, rng):
+    model, hf_cfg = _mk_hf(
+        tiny_cfg,
+        LONGROPE_FACTORS,
+        original_max_position_embeddings=LONGROPE_ORIG_MAX,
+    )
+    cfg = LlamaConfig.from_hf_config(hf_cfg.to_dict())
+    params = _params_from_hf(model, cfg)
+    for length in (33, LONGROPE_ORIG_MAX + 16):  # short + long regimes
+        ids = rng.integers(0, cfg.vocab_size, size=(2, length))
+        with torch.no_grad():
+            hf_logits = model(torch.tensor(ids)).logits.numpy()
+        ours = np.asarray(llama.forward_full(params, cfg, jnp.asarray(ids)))
+        np.testing.assert_allclose(ours, hf_logits, rtol=2e-4, atol=2e-4)
+
+
+def test_longrope_regime_guard(tiny_cfg):
+    from flexible_llm_sharding_tpu.runtime.tokenization import (
+        PromptTokenizer,
+        check_longrope_regime,
+    )
+
+    from tests.fake_tokenizer import FakeTokenizer
+
+    cfg = LlamaConfig.from_hf_config(_longrope_hf_cfg_dict(tiny_cfg))
+    tok = PromptTokenizer(FakeTokenizer(), bucket_multiple=8)
+    # FakeTokenizer is byte-level (1 id/char + BOS): lengths are exact.
+    # 71 + 2 and 71 + 2 tokens, both past orig_max 64: uniform long.
+    long_prompt = tok("x" * 70, ("ab", "cd"))
+    check_longrope_regime(cfg, [long_prompt])
+    # 56 + 2 = 58 (short) next to 56 + 20 = 76 (long): straddles.
+    straddle = tok("x" * 55, ("ab", "y" * 20))
+    with pytest.raises(ValueError, match="straddle"):
+        check_longrope_regime(cfg, [straddle])
+    # Short prompt is fine alone, but feeding tokens across the boundary is
+    # not (extra_len = n_gen - 1 for plain KV decode, + spec_k speculative).
+    short_prompt = tok("x" * 55, ("ab",))  # length 58
+    check_longrope_regime(cfg, [short_prompt])
+    check_longrope_regime(cfg, [short_prompt], extra_len=6)  # 64: exact fit
+    with pytest.raises(ValueError, match="straddle"):
+        check_longrope_regime(cfg, [short_prompt], extra_len=7)  # 65: crosses
+
+
+def test_longrope_phi3_split_and_cli(tmp_path):
+    """Phi-3 longrope checkpoint end-to-end: HF save_pretrained (fused
+    qkv/gate_up + longrope config) -> splitter -> streaming CLI scores vs
+    the HF oracle, one prompt per regime."""
+    import pickle
+
+    from transformers import Phi3Config, Phi3ForCausalLM
+
+    from flexible_llm_sharding_tpu import cli
+    from flexible_llm_sharding_tpu.runtime.tokenization import PromptTokenizer
+    from flexible_llm_sharding_tpu.utils import checkpoint as ckpt
+
+    from tests.fake_tokenizer import FakeTokenizer
+
+    torch.manual_seed(3)
+    hf_cfg = Phi3Config(
+        vocab_size=300,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        max_position_embeddings=4096,
+        original_max_position_embeddings=LONGROPE_ORIG_MAX,
+        pad_token_id=2,  # Phi3Config's default (32000) exceeds the tiny vocab
+        rope_theta=10000.0,
+        # Phi3Config validates rope_scaling has EXACTLY these three keys.
+        rope_scaling={
+            "type": "longrope",
+            "long_factor": LONGROPE_FACTORS["long_factor"],
+            "short_factor": LONGROPE_FACTORS["short_factor"],
+        },
+        sliding_window=None,
+        attn_implementation="eager",
+    )
+    model = Phi3ForCausalLM(hf_cfg).eval()
+    src = tmp_path / "hf"
+    model.save_pretrained(str(src))
+    out = tmp_path / "native"
+    ckpt.split_into_layers(str(src), str(out))
+
+    prompts = [
+        ("short prefix here", (" one two", " three four")),  # short regime
+        (" ".join(f"w{i}" for i in range(70)), (" one two",)),  # long regime
+    ]
+    ppkl, opkl = tmp_path / "p.pkl", tmp_path / "s.pkl"
+    with open(ppkl, "wb") as f:
+        pickle.dump(prompts, f)
+    cli.main(
+        ["--model_path", str(out), "--prompt_pickle", str(ppkl),
+         "--output_file", str(opkl), "--dtype", "float32",
+         "--num_gen_token", "1"],
+        tokenizer=FakeTokenizer(),
+    )
+    with open(opkl, "rb") as f:
+        got = pickle.load(f)
+
+    tok = PromptTokenizer(FakeTokenizer(), bucket_multiple=64)
+    for p_i, prompt in enumerate(prompts):
+        t = tok(*prompt)
+        for s in range(t.num_suffixes):
+            full = np.concatenate(
+                [
+                    t.prefix_ids[: t.prefix_len],
+                    t.suffix_ids[s, : int(t.suffix_eos[s]) + 1],
+                ]
+            ).astype(np.int64)
+            with torch.no_grad():
+                want = torch.softmax(
+                    model(torch.tensor(full[None])).logits[0, -1].float(), -1
+                ).numpy()
+            np.testing.assert_allclose(
+                got[p_i][s, 0], want, rtol=3e-4, atol=3e-5
+            )
+
+    # KV-cache decode under longrope: neither prompt's generation crosses
+    # the boundary (short stays short, long starts long), so the parked-KV
+    # fast path must reproduce the token-level HF recompute oracle (append
+    # the argmax ID, rerun the full forward — the reference's generation
+    # algorithm at id granularity).
+    okv = tmp_path / "kv.pkl"
+    cli.main(
+        ["--model_path", str(out), "--prompt_pickle", str(ppkl),
+         "--output_file", str(okv), "--dtype", "float32",
+         "--num_gen_token", "3", "--kv_cache", "true"],
+        tokenizer=FakeTokenizer(),
+    )
+    with open(okv, "rb") as f:
+        kv = pickle.load(f)
+    for p_i, prompt in enumerate(prompts):
+        t = tok(*prompt)
+        for s in range(t.num_suffixes):
+            full = np.concatenate(
+                [
+                    t.prefix_ids[: t.prefix_len],
+                    t.suffix_ids[s, : int(t.suffix_eos[s]) + 1],
+                ]
+            ).astype(np.int64)
+            for step in range(3):
+                with torch.no_grad():
+                    want = torch.softmax(
+                        model(torch.tensor(full[None])).logits[0, -1].float(),
+                        -1,
+                    ).numpy()
+                np.testing.assert_allclose(
+                    kv[p_i][s, step], want, rtol=3e-4, atol=3e-5
+                )
+                full = np.append(full, int(np.argmax(want)))
+
+    # A generation that would feed tokens across orig_max rejects loudly:
+    # prefix 60 bytes + suffix 2 + BOS = 63 <= 64, 63 + (8-1) fed crosses.
+    cross = tmp_path / "cross.pkl"
+    with open(cross, "wb") as f:
+        pickle.dump([("x" * 60, ("ab",))], f)
+    with pytest.raises(ValueError, match="straddle"):
+        cli.main(
+            ["--model_path", str(out), "--prompt_pickle", str(cross),
+             "--output_file", str(tmp_path / "c.out"), "--dtype", "float32",
+             "--num_gen_token", "8", "--kv_cache", "true"],
+            tokenizer=FakeTokenizer(),
+        )
+    # Speculative drafts widen the fed window by spec_k: a generation that
+    # plain decode could run rejects when the K+1-wide verify pass would
+    # feed past the boundary.
+    near = tmp_path / "near.pkl"
+    with open(near, "wb") as f:
+        pickle.dump([("x" * 57, ("ab",))], f)  # length 60; 60+2 fed fits
+    cli.main(
+        ["--model_path", str(out), "--prompt_pickle", str(near),
+         "--output_file", str(tmp_path / "n.out"), "--dtype", "float32",
+         "--num_gen_token", "3", "--kv_cache", "true"],
+        tokenizer=FakeTokenizer(),
+    )
+    with pytest.raises(ValueError, match="straddle"):
+        cli.main(
+            ["--model_path", str(out), "--prompt_pickle", str(near),
+             "--output_file", str(tmp_path / "n2.out"), "--dtype", "float32",
+             "--num_gen_token", "3", "--kv_cache", "true",
+             "--speculative_k", "4"],
+            tokenizer=FakeTokenizer(),
+        )
+    # The slow (full-recompute) loop rejects multi-suffix prompts whose
+    # growth window brackets the boundary UPFRONT (a mid-run straddle would
+    # waste whole weight streams); single-suffix prompts cross freely (the
+    # per-pass table flip is exactly HF's recompute behaviour).
+    multi = tmp_path / "multi.pkl"
+    with open(multi, "wb") as f:
+        pickle.dump([("x" * 55, ("ab", "cdef"))], f)  # 58 and 60; +7 crosses
+    with pytest.raises(ValueError, match="straddle"):
+        cli.main(
+            ["--model_path", str(out), "--prompt_pickle", str(multi),
+             "--output_file", str(tmp_path / "m.out"), "--dtype", "float32",
+             "--num_gen_token", "8"],
+            tokenizer=FakeTokenizer(),
+        )
+    single = tmp_path / "single.pkl"
+    with open(single, "wb") as f:
+        pickle.dump([("x" * 55, ("ab",))], f)
+    cli.main(
+        ["--model_path", str(out), "--prompt_pickle", str(single),
+         "--output_file", str(tmp_path / "s1.out"), "--dtype", "float32",
+         "--num_gen_token", "8"],
+        tokenizer=FakeTokenizer(),
+    )
 
 
 @pytest.mark.parametrize(
