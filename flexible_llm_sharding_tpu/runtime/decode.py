@@ -675,6 +675,9 @@ class DecodeGenerator:
                 act_dev = getattr(dev, "act", dev)
                 for b, idxs in enumerate(blocks):
                     prefix_ids, suffix_ids, prefix_len, suffix_eos = block_meta[b]
+                    total_len = longrope_total_len(
+                        self.model_cfg, prefix_len, suffix_eos
+                    )
                     if layer_idxs[0] == 0:
                         ph, sh = None, None
                     else:
@@ -688,9 +691,6 @@ class DecodeGenerator:
                                 self.model_cfg, self.dtype, params, prefix_ids, suffix_ids
                             )
                         elif kind == "decoders":
-                            total_len = longrope_total_len(
-                                self.model_cfg, prefix_len, suffix_eos
-                            )
                             ph, sh, kv = _prefill_decoders(
                                 self.model_cfg, self._use_pallas,
                                 self._tp_mesh, params, ph, sh, prefix_len,

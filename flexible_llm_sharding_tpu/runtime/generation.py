@@ -144,14 +144,17 @@ def generation_loop(
     ``seed``.
     """
     # longrope models (``model_cfg`` supplied): per-pass scoring re-checks
-    # regime uniformity, but a MULTI-suffix prompt near the boundary can
-    # pass early iterations and straddle only once the suffixes have grown
-    # — failing mid-run after whole weight streams were spent. Reject those
-    # prompts upfront when the growth window [shortest initial length,
-    # longest initial length + num_gen_token - 1] brackets the boundary.
-    # Single-suffix prompts are exempt: each pass is a full forward, so the
-    # per-pass table flip at the boundary is exactly HF's own recompute
-    # behaviour.
+    # regime uniformity, but a multi-suffix prompt whose suffix lengths
+    # DIFFER near the boundary can pass early iterations and straddle only
+    # once the suffixes have grown — failing mid-run after whole weight
+    # streams were spent. Reject those upfront when the growth window
+    # [shortest initial length, longest initial length + num_gen_token - 1]
+    # brackets the boundary. Exempt: single-suffix prompts and equal-length
+    # suffix sets — each pass is a full forward, so a UNIFORM per-pass
+    # table flip at the boundary is exactly HF's own recompute behaviour
+    # (equal-length suffixes normally grow in lockstep; if re-tokenization
+    # ever drifts them apart, the executor's per-pass check still backstops
+    # with the same error).
     if (
         model_cfg is not None
         and model_cfg.rope_scaling_kind == "longrope"
@@ -163,8 +166,17 @@ def generation_loop(
         )
 
         ptok = PromptTokenizer(tokenizer, max_token_len=max_token_len)
-        multi = [ptok(p, s) for p, s in prompts if len(s) > 1]
-        check_longrope_regime(model_cfg, multi, extra_len=num_gen_token - 1)
+        multi, labels = [], []
+        for i, (p, s) in enumerate(prompts):
+            if len(s) > 1:
+                t = ptok(p, s)
+                lens = t.suffix_eos[: t.num_suffixes]
+                if int(lens.min()) != int(lens.max()):
+                    multi.append(t)
+                    labels.append(i)
+        check_longrope_regime(
+            model_cfg, multi, extra_len=num_gen_token - 1, labels=labels
+        )
 
     original = list(prompts)
     current: list[Prompt] = copy.deepcopy(original)

@@ -143,7 +143,7 @@ def longrope_total_len(model_cfg, prefix_len, suffix_eos):
     return prefix_len + jnp.max(jnp.asarray(suffix_eos), axis=-1) + 1
 
 
-def check_longrope_regime(model_cfg, toks, extra_len: int = 0) -> None:
+def check_longrope_regime(model_cfg, toks, extra_len: int = 0, labels=None) -> None:
     """Loud precondition for longrope models (Phi-3 long-context).
 
     The long/short rope table is chosen per PROMPT by its real total
@@ -156,7 +156,9 @@ def check_longrope_regime(model_cfg, toks, extra_len: int = 0) -> None:
     widest draft window) — the grown length must not CROSS the boundary:
     KV parked under one regime cannot be re-rotated when HF's dynamic
     update would switch tables mid-generation.
-    Raises ValueError naming the first offending prompt.
+    Raises ValueError naming the first offending prompt; ``labels`` maps
+    positions in ``toks`` back to the caller's own prompt indices (for
+    callers checking a filtered subset).
     """
     if model_cfg.rope_scaling_kind != "longrope":
         return
@@ -165,8 +167,9 @@ def check_longrope_regime(model_cfg, toks, extra_len: int = 0) -> None:
         lens = t.prefix_len + t.suffix_eos[: t.num_suffixes] + 1
         lo, hi = int(lens.min()), int(lens.max()) + extra_len
         if (lo <= orig) != (hi <= orig):
+            label = labels[i] if labels is not None else i
             raise ValueError(
-                f"prompt {i}: longrope sequence lengths {lo}..{hi} straddle "
+                f"prompt {label}: longrope sequence lengths {lo}..{hi} straddle "
                 f"original_max_position_embeddings={orig}; the long/short "
                 "rope regime must be uniform per prompt (split the prompt, "
                 "shorten generation, or pad the prefix past the boundary)"

@@ -53,8 +53,13 @@ def _fwd_layer(cfg: LlamaConfig, params, x, sliding: bool, rope_on: bool):
         window=cfg.sliding_window if sliding else None,
         chunk=cfg.attention_chunk_size if sliding else None,
     )
+    # longrope: the batch's padded length selects the long/short table —
+    # the same default as forward_full, i.e. HF's own batch semantics, so
+    # streamed training equals monolithic make_train_step on these models.
+    tl = jnp.int32(l) if cfg.rope_scaling_kind == "longrope" else None
     return llama.decoder_layer(
-        params, cfg, x, jnp.arange(l), mask, sliding=sliding, rope_on=rope_on
+        params, cfg, x, jnp.arange(l), mask, sliding=sliding, rope_on=rope_on,
+        total_len=tl,
     )
 
 
@@ -112,8 +117,14 @@ class StreamedTrainer:
     ``grad_clip``/AdamW hyperparameters mirror :func:`training.make_optimizer`
     (global-norm clip -> AdamW); ``lr`` may be an optax schedule.
 
-    Tied embeddings are rejected loudly: the tied head's gradient would have
-    to merge into the embedding update across two streaming positions.
+    Tied embeddings (``cfg.tie_word_embeddings`` / no ``lm_head`` entry,
+    ``/root/reference/utils.py:113``): the head kernel IS ``embedding.T``,
+    so the tail stage receives the transpose and the head kernel's
+    cotangent transpose-adds into the embedding gradient — both gradients
+    are host-resident when they meet, so the two streaming positions the
+    tie spans never need to coexist in HBM. The embedding then updates
+    once (one AdamW segment, one weight-decay application — the same
+    semantics as ``training.make_train_step`` on a tied param tree).
     """
 
     def __init__(
@@ -128,11 +139,7 @@ class StreamedTrainer:
         dtype=jnp.float32,
         pad_id: int | None = None,
     ):
-        if cfg.tie_word_embeddings or "lm_head" not in params:
-            raise NotImplementedError(
-                "StreamedTrainer requires untied embeddings (tied head "
-                "gradients would span two streaming positions)"
-            )
+        self._tied = cfg.tie_word_embeddings or "lm_head" not in params
         self.cfg = cfg
         self.params = _host(params)
         self.dtype = dtype
@@ -149,15 +156,19 @@ class StreamedTrainer:
 
         self._upd = jax.jit(upd)
         # Per-segment optimizer moments, host-resident: one segment's moments
-        # are in HBM only during its own update.
+        # are in HBM only during its own update. Tied models have no lm_head
+        # segment — the embedding carries both roles.
         self.opt_state = {
             "embed": _host(self._adamw.init(self.params["embed"])),
             "layers": [
                 _host(self._adamw.init(lp)) for lp in self.params["layers"]
             ],
             "norm": _host(self._adamw.init(self.params["norm"])),
-            "lm_head": _host(self._adamw.init(self.params["lm_head"])),
         }
+        if not self._tied:
+            self.opt_state["lm_head"] = _host(
+                self._adamw.init(self.params["lm_head"])
+            )
 
     # -- one optimizer step over [accum, B, L+1] or [B, L+1] tokens ---------
     def step(self, tokens) -> float:
@@ -190,13 +201,25 @@ class StreamedTrainer:
                     cfg, self.params["layers"][i], x, pattern[i], rope_pat[i]
                 )
 
+            head_p = (
+                {"kernel": jnp.asarray(self.params["embed"]["embedding"]).T}
+                if self._tied
+                else self.params["lm_head"]
+            )
             loss, d_norm, d_head, dx = _tail_loss_vjp(
-                cfg, self.params["norm"], self.params["lm_head"], x, targets,
+                cfg, self.params["norm"], head_p, x, targets,
                 self.pad_id,
             )
             loss_sum += float(loss)
             g_norm = acc(g_norm, d_norm)
-            g_head = acc(g_head, d_head)
+            if self._tied:
+                # Chain rule through kernel = embedding.T: the kernel
+                # cotangent [D, V] transposes into the embedding grad [V, D].
+                g_embed = acc(
+                    g_embed, {"embedding": np.asarray(d_head["kernel"]).T}
+                )
+            else:
+                g_head = acc(g_head, d_head)
 
             # Backward stream: layers in reverse, rematerialised from the
             # cached inputs; dx chains downward.
@@ -216,8 +239,9 @@ class StreamedTrainer:
             "embed": g_embed,
             "layers": g_layers,
             "norm": g_norm,
-            "lm_head": g_head,
         }
+        if not self._tied:
+            grads["lm_head"] = g_head
         if n_micro > 1:
             grads = jax.tree.map(lambda g: g / n_micro, grads)
 
@@ -238,7 +262,8 @@ class StreamedTrainer:
                 grads = jax.tree.map(lambda g: (g * scale).astype(g.dtype), grads)
 
         # Update stream: one segment at a time through the chip.
-        for key in ("embed", "norm", "lm_head"):
+        seg_keys = ("embed", "norm") if self._tied else ("embed", "norm", "lm_head")
+        for key in seg_keys:
             p, s = self._upd(self.params[key], grads[key], self.opt_state[key])
             self.params[key] = _host(p)
             self.opt_state[key] = _host(s)
@@ -324,7 +349,8 @@ class StreamedTrainer:
 
         dump("embed", self.opt_state["embed"])
         dump("norm", self.opt_state["norm"])
-        dump("lm_head", self.opt_state["lm_head"])
+        if not self._tied:
+            dump("lm_head", self.opt_state["lm_head"])
         for i, s in enumerate(self.opt_state["layers"]):
             dump(f"layer{i}", s)
         with open(os.path.join(tmp, "train_state.json"), "w") as f:
@@ -358,7 +384,8 @@ class StreamedTrainer:
 
         self.params["embed"] = checkpoint.load_layer(ckpt_dir, "model.embed_tokens")
         self.params["norm"] = checkpoint.load_layer(ckpt_dir, "model.norm")
-        self.params["lm_head"] = checkpoint.load_layer(ckpt_dir, "lm_head")
+        if not self._tied:
+            self.params["lm_head"] = checkpoint.load_layer(ckpt_dir, "lm_head")
         for i in range(self.cfg.num_hidden_layers):
             self.params["layers"][i] = checkpoint.load_layer(
                 ckpt_dir, f"model.layers.{i}"
@@ -389,7 +416,8 @@ class StreamedTrainer:
 
         self.opt_state["embed"] = load("embed", self.opt_state["embed"])
         self.opt_state["norm"] = load("norm", self.opt_state["norm"])
-        self.opt_state["lm_head"] = load("lm_head", self.opt_state["lm_head"])
+        if not self._tied:
+            self.opt_state["lm_head"] = load("lm_head", self.opt_state["lm_head"])
         for i in range(self.cfg.num_hidden_layers):
             self.opt_state["layers"][i] = load(
                 f"layer{i}", self.opt_state["layers"][i]

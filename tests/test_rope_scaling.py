@@ -452,6 +452,18 @@ def test_longrope_phi3_split_and_cli(tmp_path):
          "--num_gen_token", "8"],
         tokenizer=FakeTokenizer(),
     )
+    # EQUAL-length multi-suffix sets are exempt from the upfront reject:
+    # they grow in lockstep, so every pass stays regime-uniform (and the
+    # executor's per-pass check backstops any re-tokenization drift).
+    equal = tmp_path / "equal.pkl"
+    with open(equal, "wb") as f:
+        pickle.dump([("x" * 55, ("ab", "cd"))], f)
+    cli.main(
+        ["--model_path", str(out), "--prompt_pickle", str(equal),
+         "--output_file", str(tmp_path / "eq.out"), "--dtype", "float32",
+         "--num_gen_token", "8"],
+        tokenizer=FakeTokenizer(),
+    )
 
 
 @pytest.mark.parametrize(

@@ -219,8 +219,75 @@ def test_streamed_from_int8_checkpoint(tiny_cfg, rng, tmp_path):
     assert np.isfinite([l0, l1]).all() and l1 < l0
 
 
-def test_streamed_rejects_tied(tiny_cfg):
+def test_streamed_longrope_matches_monolithic(tiny_cfg, rng):
+    """longrope models train streamed: the padded batch length selects the
+    rope table (forward_full's default = HF batch semantics), so one
+    streamed step equals one monolithic step. Length 33 > orig_max 16
+    exercises the LONG regime end to end."""
+    cfg = dataclasses.replace(
+        tiny_cfg,
+        rope_scaling_kind="longrope",
+        rope_long_factor=tuple(1.5 + 0.25 * i for i in range(8)),
+        rope_short_factor=tuple(1.0 + 0.05 * i for i in range(8)),
+        rope_original_max_position=16,
+        rope_attention_factor=1.1,
+    )
+    params = jax.tree.map(
+        np.asarray, llama.init_params(jax.random.PRNGKey(6), cfg)
+    )
+    tokens = rng.integers(1, cfg.vocab_size, size=(2, 33)).astype(np.int32)
+
+    want_loss, want_params = _monolithic_step(cfg, params, tokens)
+    tr = StreamedTrainer(cfg, params, lr=LR, grad_clip=CLIP, weight_decay=WD)
+    got_loss = tr.step(tokens)
+
+    np.testing.assert_allclose(got_loss, want_loss, rtol=1e-6)
+    _assert_params_close(tr.params, want_params)
+
+
+def test_streamed_tied_matches_monolithic(tiny_cfg, rng):
+    """Tied embeddings: the head kernel is embedding.T, its cotangent
+    transpose-adds into the embedding grad, and the embedding updates once
+    — exactly make_train_step's autodiff through the tied tree."""
     cfg = dataclasses.replace(tiny_cfg, tie_word_embeddings=True)
-    params = llama.init_params(jax.random.PRNGKey(4), cfg)
-    with pytest.raises(NotImplementedError, match="untied"):
-        StreamedTrainer(cfg, params)
+    params = jax.tree.map(
+        np.asarray, llama.init_params(jax.random.PRNGKey(4), cfg)
+    )
+    assert "lm_head" not in params
+    tokens = rng.integers(1, cfg.vocab_size, size=(2, 17)).astype(np.int32)
+
+    want_loss, want_params = _monolithic_step(cfg, params, tokens)
+    tr = StreamedTrainer(cfg, params, lr=LR, grad_clip=CLIP, weight_decay=WD)
+    got_loss = tr.step(tokens)
+
+    np.testing.assert_allclose(got_loss, want_loss, rtol=1e-6)
+    _assert_params_close(tr.params, want_params)
+
+
+def test_streamed_tied_state_checkpoint(tiny_cfg, rng, tmp_path):
+    """Tied save_state/restore_state round-trips without an lm_head segment;
+    a resumed run equals an uninterrupted one."""
+    cfg = dataclasses.replace(tiny_cfg, tie_word_embeddings=True)
+    params = jax.tree.map(
+        np.asarray, llama.init_params(jax.random.PRNGKey(5), cfg)
+    )
+    tokens = rng.integers(1, cfg.vocab_size, size=(4, 2, 17)).astype(np.int32)
+
+    ref = StreamedTrainer(cfg, params, lr=LR, grad_clip=CLIP, weight_decay=WD)
+    for mb in tokens:
+        ref.step(mb)
+
+    tr = StreamedTrainer(cfg, params, lr=LR, grad_clip=CLIP, weight_decay=WD)
+    for mb in tokens[:2]:
+        tr.step(mb)
+    ck = str(tmp_path / "state")
+    tr.save_state(ck)
+    import os
+
+    assert not os.path.exists(os.path.join(ck, "opt-lm_head.npz"))
+    resumed = StreamedTrainer(cfg, params, lr=LR, grad_clip=CLIP, weight_decay=WD)
+    resumed.restore_state(ck)
+    assert resumed.step_count == 2
+    for mb in tokens[2:]:
+        resumed.step(mb)
+    _assert_params_close(resumed.params, ref.params)
