@@ -139,7 +139,8 @@ class StreamedTrainer:
         dtype=jnp.float32,
         pad_id: int | None = None,
     ):
-        self._tied = cfg.tie_word_embeddings or "lm_head" not in params
+        # Same tied rule as llama.head_params (absent OR empty lm_head).
+        self._tied = cfg.tie_word_embeddings or not params.get("lm_head")
         self.cfg = cfg
         self.params = _host(params)
         self.dtype = dtype
@@ -201,11 +202,9 @@ class StreamedTrainer:
                     cfg, self.params["layers"][i], x, pattern[i], rope_pat[i]
                 )
 
-            head_p = (
-                {"kernel": jnp.asarray(self.params["embed"]["embedding"]).T}
-                if self._tied
-                else self.params["lm_head"]
-            )
+            # llama.head_params resolves the tied case to embedding.T — one
+            # source of truth for the tie rule.
+            head_p = llama.head_params(self.params)
             loss, d_norm, d_head, dx = _tail_loss_vjp(
                 cfg, self.params["norm"], head_p, x, targets,
                 self.pad_id,

@@ -15,9 +15,12 @@ import threading
 from concurrent.futures import ThreadPoolExecutor
 
 _ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-_SRC = os.path.join(_ROOT, "native", "fileprefetch.cpp")
+_SRCS = [
+    os.path.join(_ROOT, "native", "fileprefetch.cpp"),
+    os.path.join(_ROOT, "native", "convert.cpp"),
+]
 _BUILD_DIR = os.path.join(_ROOT, "native", "build")
-_SO = os.path.join(_BUILD_DIR, "fileprefetch.so")
+_SO = os.path.join(_BUILD_DIR, "fls_native.so")
 
 _lib_lock = threading.Lock()
 _lib: ctypes.CDLL | None = None
@@ -31,14 +34,20 @@ def _load_lib() -> ctypes.CDLL | None:
         if _lib is not None or _lib_failed:
             return _lib
         try:
-            if not os.path.exists(_SO) or os.path.getmtime(_SO) < os.path.getmtime(
-                _SRC
-            ):
+            # Missing sources must not take down an already-built library
+            # (the prefetch fast path would silently degrade); rebuild only
+            # when every source is present and one is newer than the .so.
+            srcs = [s for s in _SRCS if os.path.exists(s)]
+            want_build = len(srcs) == len(_SRCS) and (
+                not os.path.exists(_SO)
+                or os.path.getmtime(_SO) < max(os.path.getmtime(s) for s in srcs)
+            )
+            if want_build:
                 os.makedirs(_BUILD_DIR, exist_ok=True)
                 subprocess.run(
                     [
                         "g++", "-O3", "-shared", "-fPIC", "-std=c++17",
-                        "-o", _SO, _SRC, "-lpthread",
+                        "-o", _SO, *_SRCS, "-lpthread",
                     ],
                     check=True,
                     capture_output=True,
@@ -54,6 +63,15 @@ def _load_lib() -> ctypes.CDLL | None:
                 ctypes.c_char_p,
                 ctypes.c_void_p,
                 ctypes.c_long,
+            ]
+            lib.cv_convert.restype = ctypes.c_long
+            lib.cv_convert.argtypes = [
+                ctypes.c_void_p,
+                ctypes.c_void_p,
+                ctypes.c_long,
+                ctypes.c_int,
+                ctypes.c_int,
+                ctypes.c_int,
             ]
             _lib = lib
         except Exception:
@@ -121,6 +139,50 @@ class FilePrefetcher:
             pass
 
 
+# dtype kind codes shared with native/convert.cpp.
+_CV_KINDS = {"float32": 0, "float16": 1, "bfloat16": 2}
+
+# Below this element count numpy's single-threaded astype wins (thread
+# spawn + two ctypes calls cost more than the conversion itself).
+_CV_MIN_SIZE = 1 << 18
+
+
+def convert_array(a, np_dtype, threads: int | None = None):
+    """Parallel float dtype conversion (native C++ workers, numpy-bit-exact
+    round-to-nearest-even) — the host-side cast of the weight-streaming
+    path. Returns the converted array, or None when the native library is
+    unavailable, the pair isn't a float16/bfloat16/float32 conversion, the
+    array is too small to beat ``astype``, or the host has no spare cores
+    (at 1 thread numpy's astype is at least as fast — the native path's
+    win is the parallel slicing). Callers fall back to numpy.
+    """
+    import numpy as np
+
+    np_dtype = np.dtype(np_dtype)
+    sk = _CV_KINDS.get(a.dtype.name)
+    dk = _CV_KINDS.get(np_dtype.name)
+    if (
+        sk is None
+        or dk is None
+        or sk == dk
+        or a.size < _CV_MIN_SIZE
+    ):
+        return None
+    if threads is None:
+        threads = min(8, os.cpu_count() or 1)
+        if threads <= 1:
+            return None
+    lib = _load_lib()
+    if lib is None:
+        return None
+    src = np.ascontiguousarray(a)
+    dst = np.empty(src.shape, np_dtype)
+    rc = lib.cv_convert(
+        src.ctypes.data, dst.ctypes.data, src.size, sk, dk, threads
+    )
+    return dst if rc == 0 else None
+
+
 def read_file_native(path: str) -> bytes | None:
     """Whole-file read through the native pread loop (None if no native lib
     or on IO error) — exercised by tests; a pinned-buffer IO building block."""
@@ -135,4 +197,4 @@ def read_file_native(path: str) -> bytes | None:
     return buf.raw[:n]
 
 
-__all__ = ["FilePrefetcher", "read_file_native"]
+__all__ = ["FilePrefetcher", "convert_array", "read_file_native"]

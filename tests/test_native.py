@@ -1,7 +1,9 @@
-"""Native C++ file-prefetch library: build, correctness, and fallback."""
+"""Native C++ runtime library (file prefetch + parallel dtype convert):
+build, correctness, and fallback."""
 
 import os
 
+import numpy as np
 import pytest
 
 from flexible_llm_sharding_tpu.utils import native
@@ -51,3 +53,66 @@ def test_prefetcher_python_fallback(payload, monkeypatch):
 def test_read_file_native_missing():
     with pytest.raises(OSError):
         native.read_file_native("/nonexistent/file")
+
+
+def test_convert_array_bit_exact_all_pairs():
+    """Native parallel dtype conversion equals numpy's astype BIT-exactly
+    for every float16/bfloat16/float32 pair — including subnormals,
+    overflow-to-inf, rounding ties, and signed zeros. threads=4 on purpose
+    (even on a 1-core host) so the slice-boundary math is exercised."""
+    import ml_dtypes
+
+    rng = np.random.default_rng(0)
+    bf16 = np.dtype(ml_dtypes.bfloat16)
+    edge = np.array(
+        [0.0, -0.0, 1e-40, -1e-40, 65504.0, 65520.0, 70000.0,
+         3.3895314e38, 1.0000001, 0.99999994, 6.1035156e-05,
+         5.960464e-08, 2.0**-126, -(2.0**-126), 1.5, -1.5,
+         np.inf, -np.inf, np.nan],
+        np.float32,
+    )
+    # NaN payload variants (signaling, tiny payloads, negative): numpy
+    # truncates payloads into f16 (forcing the low bit if they vanish),
+    # ml_dtypes canonicalizes into bf16/f16 — all pinned bit-exactly.
+    nan_bits = np.array(
+        [0x7F802000, 0x7F800001, 0x7FC00000, 0xFFC00001, 0x7F801FFF],
+        np.uint32,
+    )
+    edge = np.concatenate([edge, nan_bits.view(np.float32)])
+    with np.errstate(over="ignore", invalid="ignore"):
+        base = np.concatenate(
+            [rng.standard_normal(1 << 19).astype(np.float32) * 100,
+             np.tile(edge, 64)]
+        )
+        arrays = {
+            "float32": base,
+            "float16": base.astype(np.float16),
+            "bfloat16": base.astype(bf16),
+        }
+        dtypes = {"float32": np.float32, "float16": np.float16, "bfloat16": bf16}
+        for sname, a in arrays.items():
+            for dname, dt in dtypes.items():
+                if sname == dname:
+                    continue
+                got = native.convert_array(a, dt, threads=4)
+                if got is None:
+                    pytest.skip("native lib unavailable")
+                want = a.astype(dt)
+                width = np.uint16 if np.dtype(dt).itemsize == 2 else np.uint32
+                np.testing.assert_array_equal(
+                    got.view(width), want.view(width),
+                    err_msg=f"{sname}->{dname}",
+                )
+
+
+def test_convert_array_gates():
+    """Small arrays, same-dtype, non-float pairs, and 1-core hosts fall
+    back to numpy (None)."""
+    import ml_dtypes
+
+    bf16 = np.dtype(ml_dtypes.bfloat16)
+    small = np.ones(16, np.float16)
+    assert native.convert_array(small, bf16, threads=4) is None  # too small
+    big = np.ones(1 << 18, np.float16)
+    assert native.convert_array(big, np.float16, threads=4) is None  # same
+    assert native.convert_array(big.astype(np.int32), bf16, threads=4) is None
