@@ -22,6 +22,31 @@ DEFAULT_MAX_TOKEN_LEN = 4096
 # stays in sync with this set).
 SUPPORTED_ACTIVATIONS = frozenset({"silu", "gelu", "gelu_pytorch_tanh"})
 
+# Multimodal wrapper model types -> their language-model type. Published
+# Gemma-3 / Llama-4 checkpoints are vision+text bundles whose config nests
+# the text model under "text_config"; both the config parse and the
+# checkpoint splitter derive the text model through extract_text_config —
+# ONE rule, so the two can't drift.
+MULTIMODAL_TEXT_TYPES = {"gemma3": "gemma3_text", "llama4": "llama4_text"}
+
+
+def extract_text_config(d: dict) -> dict | None:
+    """The normalized language-model config dict of a multimodal wrapper
+    config, or None when ``d`` is not a wrapper. Raises ValueError for a
+    wrapper with no text_config."""
+    text_type = MULTIMODAL_TEXT_TYPES.get(d.get("model_type"))
+    if text_type is None:
+        return None
+    tc = d.get("text_config")
+    if not tc:
+        raise ValueError(
+            f"{d.get('model_type')} config without text_config — cannot "
+            "derive the language model"
+        )
+    tc = dict(tc)
+    tc.setdefault("model_type", text_type)
+    return tc
+
 # Fields copied by name from ANY foreign HF config.json — they mean the same
 # thing across the supported families. Everything else is family-gated below
 # (see from_hf_config's stray-key defence).
@@ -411,10 +436,9 @@ class LlamaConfig:
                 kwargs, d, "gemma3", lambda i, n: (i + 1) % 6 != 0, 4096
             )
         elif model_type == "gemma3":
-            raise NotImplementedError(
-                "gemma3 multimodal checkpoints are not supported; use the "
-                "text model (model_type 'gemma3_text')"
-            )
+            # Multimodal wrapper config: the language model is the nested
+            # text_config (the splitter extracts its weights the same way).
+            return cls.from_hf_config(extract_text_config(d))
         elif model_type == "llama4_text":
             kwargs.setdefault("explicit_head_dim", 128)  # Llama4 class default
             kwargs.setdefault("rope_interleaved", True)
@@ -466,10 +490,7 @@ class LlamaConfig:
                 kwargs["num_local_experts"] = 0
             kwargs.setdefault("intermediate_size_mlp", d.get("intermediate_size_mlp"))
         elif model_type == "llama4":
-            raise NotImplementedError(
-                "llama4 multimodal checkpoints are not supported; use the "
-                "text model (model_type 'llama4_text')"
-            )
+            return cls.from_hf_config(extract_text_config(d))
         elif model_type in ("mistral", "mixtral", "phi3"):
             # sliding_window flows through by field name (may be null);
             # mixtral's num_local_experts/num_experts_per_tok likewise.

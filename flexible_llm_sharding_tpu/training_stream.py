@@ -139,8 +139,20 @@ class StreamedTrainer:
         dtype=jnp.float32,
         pad_id: int | None = None,
     ):
-        # Same tied rule as llama.head_params (absent OR empty lm_head).
-        self._tied = cfg.tie_word_embeddings or not params.get("lm_head")
+        # The tie rule must be the ONE llama.head_params applies in the
+        # forward (absent/empty lm_head -> embedding.T), or the gradient
+        # routing below would silently diverge from the head actually used.
+        self._tied = not params.get("lm_head")
+        if cfg.tie_word_embeddings and not self._tied:
+            # HF load semantics make an explicit lm_head tensor dead weight
+            # under tie_word_embeddings; training it here while the config
+            # claims a tie would mis-optimize silently. Make the caller say
+            # which they mean.
+            raise ValueError(
+                "cfg.tie_word_embeddings=True but params carry a nonempty "
+                "lm_head — drop the lm_head entry (tied) or clear the flag "
+                "(untied)"
+            )
         self.cfg = cfg
         self.params = _host(params)
         self.dtype = dtype

@@ -171,13 +171,26 @@ def _hf_weight_map(src_dir: str) -> tuple[dict[str, str], str]:
     raise FileNotFoundError(f"No HF checkpoint found under {src_dir}")
 
 
-def _load_shard(path: str, kind: str) -> dict[str, np.ndarray]:
+def _load_shard(path: str, kind: str, want=None) -> dict[str, np.ndarray]:
+    """Load one HF shard; ``want`` (key -> bool) selects keys. With
+    safetensors unwanted tensors are never READ (multi-GB vision towers of
+    a multimodal bundle never touch RAM); the torch format can only filter
+    after a full load."""
     if kind == "safetensors":
-        return st_load_file(path)
+        if want is None:
+            return st_load_file(path)
+        out = {}
+        with safe_open(path, framework="numpy") as f:
+            for k in f.keys():
+                if want(k):
+                    out[k] = f.get_tensor(k)
+        return out
     import torch
 
     out = {}
     for k, t in torch.load(path, map_location="cpu", weights_only=True).items():
+        if want is not None and not want(k):
+            continue
         if t.dtype == torch.bfloat16:
             out[k] = t.view(torch.uint16).numpy().view(_BFLOAT16)
         else:
@@ -391,6 +404,55 @@ def _is_native(sd_keys) -> bool:
 # The offline splitter (prepare_weights equivalent)
 # ---------------------------------------------------------------------------
 
+# Multimodal wrapper checkpoints (Gemma-3, Llama-4): the published weights
+# are usually the vision+text bundle; scoring wants the text tower. The
+# splitter extracts it: language-model keys remap to the plain text layout,
+# vision/projector keys drop, and the emitted config.json is the nested
+# text_config (so the split dir IS a text checkpoint). The wrapper->text
+# config rule itself lives in config.extract_text_config, shared with
+# LlamaConfig.from_hf_config.
+_MM_DROP_PREFIXES = (
+    "model.vision_tower.",
+    "model.multi_modal_projector.",
+    "model.vision_model.",
+    "vision_tower.",
+    "vision_model.",
+    "multi_modal_projector.",
+)
+
+
+def _multimodal_remap(src_dir: str):
+    """(remap_fn, text_config dict) for a multimodal wrapper checkpoint, or
+    (None, None) for plain text checkpoints. remap_fn: original HF key ->
+    text-model key, or None for dropped (vision/projector) keys."""
+    from flexible_llm_sharding_tpu.config import extract_text_config
+
+    cfg_path = os.path.join(src_dir, "config.json")
+    if not os.path.exists(cfg_path):
+        return None, None
+    with open(cfg_path) as f:
+        d = json.load(f)
+    tc = extract_text_config(d)
+    if tc is None:
+        return None, None
+
+    def remap(k: str):
+        for p in _MM_DROP_PREFIXES:
+            if k.startswith(p):
+                return None
+        # transformers >= 4.52 nests the tower as model.language_model.*;
+        # older exports used language_model.model.* (+ language_model.lm_head).
+        if k.startswith("model.language_model."):
+            return "model." + k[len("model.language_model."):]
+        if k.startswith("language_model.model."):
+            return "model." + k[len("language_model.model."):]
+        if k.startswith("language_model.lm_head"):
+            return k[len("language_model."):]
+        return k  # lm_head.* and any already-plain keys
+
+    return remap, tc
+
+
 def split_into_layers(
     src_dir: str,
     out_dir: str,
@@ -424,6 +486,21 @@ def split_into_layers(
 
     weight_map, kind = _hf_weight_map(src_dir)
 
+    remap, text_cfg = _multimodal_remap(src_dir)
+    if remap is not None:
+        # Extracting the text tower from a vision+text bundle: drop the
+        # vision/projector keys, rename language-model keys to the plain
+        # text layout, and emit the nested text_config as the config.
+        renamed: dict[str, str] = {}
+        for k in list(weight_map):
+            nk = remap(k)
+            if nk is None:
+                del weight_map[k]
+            elif nk != k:
+                renamed[k] = nk
+        weight_map = {renamed.get(k, k): v for k, v in weight_map.items()}
+        with open(os.path.join(out_dir, "config.json"), "w") as f:
+            json.dump(text_cfg, f, indent=1)
     layer2keys: dict[str, set[str]] = {}
     for k in weight_map:
         layer2keys.setdefault(key_to_layer(k), set()).add(k)
@@ -454,7 +531,17 @@ def split_into_layers(
     for layer in layer_list:
         for shard in layer2shards[layer] - loaded:
             loaded.add(shard)
-            state.update(_load_shard(os.path.join(src_dir, shard), kind))
+            # Selective read: dropped (vision/projector) keys are skipped at
+            # the safetensors layer, so a bundle's vision tower never
+            # materialises in RAM.
+            want = (
+                (lambda k: remap(k) is not None) if remap is not None else None
+            )
+            for k, v in _load_shard(
+                os.path.join(src_dir, shard), kind, want=want
+            ).items():
+                nk = remap(k) if remap is not None else k
+                state[nk] = v
         missing = layer2keys[layer] - state.keys()
         if missing:
             raise KeyError(

@@ -352,8 +352,11 @@ def test_from_hf_gemma3_text():
     assert cfg.sliding_window == 4096 and cfg.head_dim == 256
     # HF 5:1 derivation: every 6th layer full.
     assert cfg.layer_sliding == (True,) * 5 + (False,) + (True,) * 5 + (False,)
-    with pytest.raises(NotImplementedError):
-        LlamaConfig.from_hf_config({"model_type": "gemma3"})  # multimodal
+    # Multimodal wrapper without a text_config still fails loudly; with
+    # one it recurses into the language model (full coverage in
+    # test_multimodal_wrapper_config / test_gemma3_multimodal_split).
+    with pytest.raises(ValueError, match="text_config"):
+        LlamaConfig.from_hf_config({"model_type": "gemma3"})
 
 
 def _hf_qwen2(cfg: LlamaConfig):
@@ -593,7 +596,7 @@ def test_from_hf_gemma():
     # default here must be True or the executor asks for a lm_head file
     # that tied checkpoints never contain.
     assert cfg.tie_word_embeddings
-    with pytest.raises(NotImplementedError):
+    with pytest.raises(ValueError, match="text_config"):
         LlamaConfig.from_hf_config({"model_type": "gemma3"})
     # head_dim omitted (equals GemmaConfig's 256 class default) -> 256.
     cfg = LlamaConfig.from_hf_config(
@@ -1442,5 +1445,106 @@ def test_qwen3_moe_split_and_executor(rng, tmp_path):
         ).astype(np.int64)
         with torch.no_grad():
             logits = model(torch.tensor(full[None])).logits[0, -1]
+        want = torch.softmax(logits.float(), -1).numpy()
+        np.testing.assert_allclose(got[0][s, 0], want, rtol=2e-4, atol=2e-5)
+
+
+def test_multimodal_wrapper_config():
+    """Gemma-3 / Llama-4 vision+text wrapper configs recurse into their
+    nested language-model config (the published bundles' config shape)."""
+    cfg = LlamaConfig.from_hf_config(
+        {
+            "model_type": "gemma3",
+            "text_config": {
+                "hidden_size": 64,
+                "num_attention_heads": 4,
+                "num_key_value_heads": 2,
+                "num_hidden_layers": 2,
+                "head_dim": 16,
+            },
+            "vision_config": {"hidden_size": 32},
+        }
+    )
+    assert cfg.model_type == "gemma3_text" and cfg.head_dim == 16
+    cfg = LlamaConfig.from_hf_config(
+        {
+            "model_type": "llama4",
+            "text_config": {
+                "hidden_size": 64,
+                "num_attention_heads": 4,
+                "num_hidden_layers": 2,
+                "num_local_experts": 2,
+                "intermediate_size_mlp": 96,
+            },
+        }
+    )
+    assert cfg.model_type == "llama4_text" and cfg.num_local_experts == 2
+    with pytest.raises(ValueError, match="text_config"):
+        LlamaConfig.from_hf_config({"model_type": "llama4"})
+
+
+def test_gemma3_multimodal_split_and_executor(tmp_path):
+    """A Gemma-3 vision+text bundle (the published checkpoint shape) splits
+    into a plain text checkpoint: vision/projector weights dropped,
+    model.language_model.* remapped, text_config emitted — and the split
+    dir scores identically to the bundle's own language model."""
+    from transformers import Gemma3Config, Gemma3ForConditionalGeneration
+
+    torch.manual_seed(2)
+    wrapper = Gemma3ForConditionalGeneration(
+        Gemma3Config(
+            text_config=dict(
+                vocab_size=300,
+                hidden_size=64,
+                intermediate_size=128,
+                num_hidden_layers=2,
+                num_attention_heads=4,
+                num_key_value_heads=2,
+                head_dim=16,
+                rope_theta=1_000_000.0,
+                rope_local_base_freq=10_000.0,
+                sliding_window=16,
+                max_position_embeddings=4096,
+                layer_types=["sliding_attention", "full_attention"],
+                attn_implementation="eager",
+            ),
+            vision_config=dict(
+                hidden_size=32,
+                intermediate_size=48,
+                num_hidden_layers=1,
+                num_attention_heads=2,
+                image_size=28,
+                patch_size=14,
+            ),
+            image_token_index=299,
+            boi_token_index=297,
+            eoi_token_index=298,
+        )
+    ).eval()
+    src = tmp_path / "hf"
+    wrapper.save_pretrained(str(src))
+    out = tmp_path / "native"
+    layers = ckpt.split_into_layers(str(src), str(out))
+    assert "model.layers.1" in layers
+    assert not any("vision" in l or "projector" in l for l in layers)
+    cfg = LlamaConfig.from_pretrained(str(out))
+    assert cfg.model_type == "gemma3_text" and cfg.rope_local_theta == 10_000.0
+
+    prompts = [("the quick brown fox", (" jumps", " sleeps"))]
+    fw = FrameworkConfig(
+        model_path=str(out), dtype="float32", bucket_multiple=8,
+        prefetch_depth=0,
+    )
+    got = StreamingExecutor(fw, tokenizer=FakeTokenizer())(prompts)
+    tok = PromptTokenizer(FakeTokenizer(), bucket_multiple=8)
+    t = tok(*prompts[0])
+    lm = wrapper.model.language_model  # the bundle's own text tower
+    for s in range(t.num_suffixes):
+        full = np.concatenate(
+            [t.prefix_ids[: t.prefix_len], t.suffix_ids[s, : int(t.suffix_eos[s]) + 1]]
+        ).astype(np.int64)
+        with torch.no_grad():
+            h = lm(torch.tensor(full[None])).last_hidden_state
+            logits = wrapper.lm_head(h)[0, -1]
         want = torch.softmax(logits.float(), -1).numpy()
         np.testing.assert_allclose(got[0][s, 0], want, rtol=2e-4, atol=2e-5)
