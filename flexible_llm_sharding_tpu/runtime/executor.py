@@ -308,7 +308,7 @@ class _HostShardLoader:
 
     def __init__(self, model_path: str, layer_names: Sequence[str], np_dtype,
                  tied_embeddings: bool = False, layer_sliding=None,
-                 layer_rope=None):
+                 layer_rope=None, readahead: str = "auto"):
         self.model_path = model_path
         self.layer_names = list(layer_names)
         self.np_dtype = np_dtype
@@ -320,13 +320,25 @@ class _HostShardLoader:
         # /root/reference/utils.py:223,304)
         from flexible_llm_sharding_tpu.utils.native import FilePrefetcher
 
-        self._prefetcher = FilePrefetcher(threads=2)
+        # readahead 'auto': readahead worker threads only help when a spare
+        # core can absorb their page-cache copies; on a 1-core host they
+        # contend with the cast/stack work (measured 0.87x in bench.py's
+        # host-stream phase). 'on'/'off' force (the bench measures both).
+        from flexible_llm_sharding_tpu.utils.native import available_cpus
+
+        if readahead == "off" or (readahead == "auto" and available_cpus() <= 1):
+            self._prefetcher = None
+        else:
+            self._prefetcher = FilePrefetcher(threads=2)
 
     def close(self) -> None:
-        self._prefetcher.close()
+        if self._prefetcher is not None:
+            self._prefetcher.close()
 
     def warm(self, layer_idxs: tuple[int, ...]) -> None:
         """Queue a shard's files for page-cache readahead (non-blocking)."""
+        if self._prefetcher is None:
+            return
         self._prefetcher.prefetch(
             *(
                 os.path.join(

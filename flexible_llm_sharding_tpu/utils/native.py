@@ -64,15 +64,30 @@ def _load_lib() -> ctypes.CDLL | None:
                 ctypes.c_void_p,
                 ctypes.c_long,
             ]
-            lib.cv_convert.restype = ctypes.c_long
-            lib.cv_convert.argtypes = [
-                ctypes.c_void_p,
-                ctypes.c_void_p,
-                ctypes.c_long,
-                ctypes.c_int,
-                ctypes.c_int,
-                ctypes.c_int,
-            ]
+            # Newer entry points bind individually: a prebuilt .so from an
+            # older source set keeps its working symbols instead of taking
+            # down the whole native path.
+            for sym, restype, argtypes in (
+                ("fp_drop_cache", ctypes.c_long, [ctypes.c_char_p]),
+                (
+                    "cv_convert",
+                    ctypes.c_long,
+                    [
+                        ctypes.c_void_p,
+                        ctypes.c_void_p,
+                        ctypes.c_long,
+                        ctypes.c_int,
+                        ctypes.c_int,
+                        ctypes.c_int,
+                    ],
+                ),
+            ):
+                try:
+                    fn = getattr(lib, sym)
+                    fn.restype = restype
+                    fn.argtypes = argtypes
+                except AttributeError:
+                    pass  # callers probe with getattr and fall back
             _lib = lib
         except Exception:
             _lib_failed = True
@@ -139,6 +154,17 @@ class FilePrefetcher:
             pass
 
 
+def available_cpus() -> int:
+    """Cores this PROCESS can actually run on — affinity/cgroup aware
+    (os.cpu_count reports the machine, which overcounts in containers
+    pinned to a subset; the 1-core-contention guards need the real
+    number)."""
+    try:
+        return len(os.sched_getaffinity(0)) or 1
+    except (AttributeError, OSError):  # non-Linux
+        return os.cpu_count() or 1
+
+
 # dtype kind codes shared with native/convert.cpp.
 _CV_KINDS = {"float32": 0, "float16": 1, "bfloat16": 2}
 
@@ -169,11 +195,11 @@ def convert_array(a, np_dtype, threads: int | None = None):
     ):
         return None
     if threads is None:
-        threads = min(8, os.cpu_count() or 1)
+        threads = min(8, available_cpus())
         if threads <= 1:
             return None
     lib = _load_lib()
-    if lib is None:
+    if lib is None or getattr(lib, "cv_convert", None) is None:
         return None
     src = np.ascontiguousarray(a)
     dst = np.empty(src.shape, np_dtype)
@@ -181,6 +207,19 @@ def convert_array(a, np_dtype, threads: int | None = None):
         src.ctypes.data, dst.ctypes.data, src.size, sk, dk, threads
     )
     return dst if rc == 0 else None
+
+
+def drop_file_cache(*paths: str) -> bool:
+    """Best-effort eviction of files from the OS page cache (native
+    FADV_DONTNEED). Returns True if the native lib handled every path —
+    the cold-cache loader benchmark is only meaningful when it did."""
+    lib = _load_lib()
+    if lib is None or getattr(lib, "fp_drop_cache", None) is None:
+        return False
+    ok = True
+    for p in paths:
+        ok = lib.fp_drop_cache(p.encode()) == 0 and ok
+    return ok
 
 
 def read_file_native(path: str) -> bytes | None:
@@ -197,4 +236,10 @@ def read_file_native(path: str) -> bytes | None:
     return buf.raw[:n]
 
 
-__all__ = ["FilePrefetcher", "convert_array", "read_file_native"]
+__all__ = [
+    "FilePrefetcher",
+    "available_cpus",
+    "convert_array",
+    "drop_file_cache",
+    "read_file_native",
+]

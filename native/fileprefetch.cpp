@@ -121,6 +121,26 @@ void fp_wait_all(void* handle) { static_cast<Pool*>(handle)->wait_all(); }
 
 void fp_destroy(void* handle) { delete static_cast<Pool*>(handle); }
 
+// Evict a file's pages from the OS page cache (fsync + FADV_DONTNEED).
+// Returns 0 on success, -1 if the file can't be opened. Used by the host
+// weight-stream benchmark to measure COLD-cache loader throughput — a
+// warm second pass reads from RAM and says nothing about the disk path.
+long fp_drop_cache(const char* path) {
+  int fd = open(path, O_RDONLY);
+  if (fd < 0) return -1;
+#ifdef POSIX_FADV_DONTNEED
+  fdatasync(fd);
+  int rc = posix_fadvise(fd, 0, 0, POSIX_FADV_DONTNEED);
+  close(fd);
+  return rc == 0 ? 0 : -1;
+#else
+  // No eviction happened: claiming success would let the benchmark label
+  // warm-cache readings as "cold".
+  close(fd);
+  return -1;
+#endif
+}
+
 // Direct bulk read into a caller buffer (ctypes-owned); returns bytes read
 // or -1. Used for tests and as a building block for future pinned-buffer IO.
 long fp_read_file(const char* path, void* out, long cap) {
