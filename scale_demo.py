@@ -139,16 +139,26 @@ def build_hf_checkpoint(cfg: dict) -> int:
 # ---------------------------------------------------------------------------
 
 def child_main(argv_json: str) -> None:
-    """``python scale_demo.py --child '<json argv>'`` — run the framework CLI
-    with the bench tokenizer (no tokenizer assets in a synthetic checkpoint;
-    ``cli.main`` takes the tokenizer as its documented programmatic hook)."""
+    """``python scale_demo.py --child '<json payload>'`` — run the framework
+    CLI with the bench tokenizer (no tokenizer assets in a synthetic
+    checkpoint; ``cli.main`` takes the tokenizer as its documented
+    programmatic hook). Payload: the CLI argv list, or {"argv": [...],
+    "backend": "cpu"} — the cpu backend must be pinned IN-PROCESS
+    (jax.config), because the axon sitecustomize overrides the
+    JAX_PLATFORMS env var at interpreter start."""
+    payload = json.loads(argv_json)
+    argv = payload["argv"] if isinstance(payload, dict) else payload
+    if isinstance(payload, dict) and payload.get("backend") == "cpu":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
     from flexible_llm_sharding_tpu import cli
 
-    cli.main(json.loads(argv_json), tokenizer=BenchTokenizer())
+    cli.main(argv, tokenizer=BenchTokenizer())
 
 
 def run_cli(argv: list[str], tag: str, kill_after_marker: str | None = None,
-            kill_min_shards: int = 4) -> dict:
+            kill_min_shards: int = 4, backend: str = "auto") -> dict:
     """Run the CLI as a subprocess; parse its final JSON stats line.
 
     With ``kill_after_marker``, SIGKILL the child once the resume progress
@@ -171,8 +181,11 @@ def run_cli(argv: list[str], tag: str, kill_after_marker: str | None = None,
 
     err_path = os.path.join(WORK, f"cli-{tag}.stderr")
     with open(err_path, "wb") as err:
+        payload = (
+            {"argv": argv, "backend": backend} if backend != "auto" else argv
+        )
         proc = subprocess.Popen(
-            [sys.executable, os.path.abspath(__file__), "--child", json.dumps(argv)],
+            [sys.executable, os.path.abspath(__file__), "--child", json.dumps(payload)],
             stderr=err,
             stdout=subprocess.DEVNULL,
             cwd=ROOT,
@@ -222,6 +235,14 @@ def main() -> None:
     p.add_argument("--prefix_words", type=int, default=700)
     p.add_argument("--keep", action="store_true")
     p.add_argument("--skip_disk", action="store_true")
+    p.add_argument(
+        "--backend", default="auto", choices=["auto", "cpu"],
+        help="cpu: pin every CLI child to the XLA:CPU backend (in-process — "
+             "the axon sitecustomize overrides JAX_PLATFORMS) and mark the "
+             "artifact platform accordingly. The fallback for a wedged "
+             "tunnel: a smaller-model CPU capture beats an absent artifact, "
+             "and a later on-TPU run overwrites it.",
+    )
     p.add_argument(
         "--configs", default="cpu,tpu,disk",
         help="comma list of runs: cpu (BASELINE cfg 1: lnps=1 acts in RAM), "
@@ -278,6 +299,10 @@ def main() -> None:
             "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ"),
         }
     )
+    # Platform marking happens AFTER the bandwidth probe below, keyed on the
+    # device the run actually resolves to (an --backend auto run can still
+    # land on XLA:CPU when the tunnel is down — it must not masquerade as
+    # hardware evidence).
 
     total_bytes = build_hf_checkpoint(cfg)
     result["model_gb"] = round(total_bytes / 1e9, 2)
@@ -292,9 +317,14 @@ def main() -> None:
     try:
         # Hard timeout: a wedged tunnel otherwise hangs the probe child
         # forever and the demo never reaches the actual runs.
+        pin = (
+            "jax.config.update('jax_platforms','cpu');"
+            if args.backend == "cpu"
+            else ""
+        )
         probe = subprocess.run(
             [sys.executable, "-c",
-             "import jax;"
+             "import jax;" + pin +
              "from flexible_llm_sharding_tpu.utils.metrics import"
              " measure_host_to_hbm_gbps;"
              "d=jax.devices()[0];"
@@ -311,6 +341,20 @@ def main() -> None:
         log("bandwidth probe timed out (wedged tunnel?) — continuing")
     except (ValueError, IndexError):
         log("bandwidth probe failed: " + probe.stderr[-200:])
+    # Honest platform marking, keyed on the device the run ACTUALLY uses:
+    # forced --backend cpu, or an auto run whose probe resolved to CPU.
+    # The memory-ratio claim is about the streaming STRUCTURE and holds on
+    # any backend; throughput from a CPU capture is not a TPU number, and
+    # the hardware-evidence watcher keeps retrying until a real one exists.
+    if args.backend == "cpu" or "cpu" in (result.get("device_kind") or "").lower():
+        result["platform"] = "cpu"
+        result["platform_note"] = (
+            "captured on the XLA:CPU backend (TPU tunnel unavailable); "
+            "a later on-TPU scale_demo run replaces this artifact"
+        )
+    else:
+        result.pop("platform", None)
+        result.pop("platform_note", None)
 
     # Analytic model FLOPs/token (MFU numerator) for the built config; each
     # run's mfu is derived from its tokens_per_sec in the post-pass below.
@@ -378,7 +422,7 @@ def main() -> None:
             scores = None
     if "cpu" in configs:
         log("CLI run: storage_location=cpu, layer_num_per_shard=1 ...")
-        stats_cpu = run_cli(cli_argv("cpu"), "cpu")
+        stats_cpu = run_cli(cli_argv("cpu"), "cpu", backend=args.backend)
         log(f"cpu stats: {stats_cpu}")
         result["cpu"] = stats_cpu
 
@@ -392,7 +436,8 @@ def main() -> None:
         # lnps=8 -> 8-layer (~3.4 GB) shard programs; prefetch 1 keeps
         # weights-in-flight to ~2 shards so the whole run fits 16 GB HBM.
         log("CLI run: storage_location=tpu, layer_num_per_shard=8 ...")
-        stats_tpu = run_cli(cli_argv("tpu", lnps=8, prefetch=1), "tpu")
+        stats_tpu = run_cli(cli_argv("tpu", lnps=8, prefetch=1), "tpu",
+                            backend=args.backend)
         log(f"tpu stats: {stats_tpu}")
         result["tpu"] = stats_tpu
         if scores is not None:
@@ -415,10 +460,12 @@ def main() -> None:
             cli_argv("disk"), "disk-killed",
             kill_after_marker=marker,
             kill_min_shards=max(4, args.layers // 4),
+            backend=args.backend,
         )
         log("CLI run: --resume true ...")
         t0 = time.perf_counter()
-        stats_disk = run_cli(cli_argv("disk", resume=True), "disk-resumed")
+        stats_disk = run_cli(cli_argv("disk", resume=True), "disk-resumed",
+                             backend=args.backend)
         stats_disk["resumed_after_shards"] = kill_info["completed_shards"]
         stats_disk["resume_wall_s"] = round(time.perf_counter() - t0, 3)
         log(f"disk stats: {stats_disk}")

@@ -26,7 +26,9 @@ while true; do
       echo "$(date -u +%H:%M:%S) scale_demo rc=$rc artifact: $(ls -la SCALE_r03.json 2>/dev/null)" >> /tmp/hw_watcher.log
       # Only stop once the artifacts actually exist — a tunnel drop mid-run
       # (the very failure mode this watcher exists for) must keep retrying.
-      if [ -f SCALE_r03.json ] && python -c "import json,sys; sys.exit(0 if json.load(open('BENCH_TPU_latest.json')).get('platform')=='tpu' else 1)" 2>/dev/null; then
+      # A CPU-fallback SCALE capture (scale_demo --backend cpu, marked
+      # platform=cpu) does NOT satisfy the hardware-evidence goal.
+      if [ -f SCALE_r03.json ] && python -c "import json,sys; sys.exit(0 if json.load(open('SCALE_r03.json')).get('platform') != 'cpu' else 1)" 2>/dev/null && python -c "import json,sys; sys.exit(0 if json.load(open('BENCH_TPU_latest.json')).get('platform')=='tpu' else 1)" 2>/dev/null; then
         echo "$(date -u +%H:%M:%S) all hardware evidence captured" >> /tmp/hw_watcher.log
         exit 0
       fi
