@@ -84,6 +84,16 @@ _FAMILY_HF_FIELDS: dict[str, frozenset[str]] = {
             "attn_temperature_tuning",
         }
     ),
+    "deepseek_v3": frozenset(
+        {
+            "kv_lora_rank",
+            "q_lora_rank",
+            "qk_nope_head_dim",
+            "qk_rope_head_dim",
+            "v_head_dim",
+            "num_experts_per_tok",
+        }
+    ),
 }
 
 
@@ -217,12 +227,40 @@ class LlamaConfig:
     # (ops/rope.py rope_cos_sin).
     rope_long_factor: tuple | None = None
     rope_short_factor: tuple | None = None
+    # Multi-head latent attention (DeepSeek-V2/V3, model_type deepseek_v3).
+    # kv_lora_rank > 0 switches the q/k/v assembly (models/llama.py
+    # _qkv_mla): queries optionally LoRA'd (q_lora_rank; None = dense
+    # q_proj), KV compressed to kv_lora_rank + one SHARED qk_rope_head_dim
+    # rope key, decompressed per head to qk_nope_head_dim keys and
+    # v_head_dim values. head_dim (qk) = qk_nope + qk_rope; values keep
+    # their own v_head_dim.
+    kv_lora_rank: int = 0
+    q_lora_rank: int | None = None
+    qk_nope_head_dim: int = 128
+    qk_rope_head_dim: int = 64
+    v_head_dim: int | None = None
+    # DeepSeek MoE routing deltas vs Mixtral (models/llama.py
+    # _deepseek_moe_mlp): sigmoid scores, selection biased by a trained
+    # correction buffer (weights stay unbiased), group-limited top-k
+    # (n_group groups scored by their top-2 sum, best topk_group groups
+    # kept), x routed_scaling_factor, plus a shared expert of
+    # n_shared_experts x the routed width.
+    moe_n_group: int = 1
+    moe_topk_group: int = 1
+    moe_routed_scaling_factor: float = 1.0
 
     @property
     def head_dim(self) -> int:
+        if self.kv_lora_rank:  # MLA: the qk head dim
+            return self.qk_nope_head_dim + self.qk_rope_head_dim
         if self.explicit_head_dim is not None:
             return self.explicit_head_dim
         return self.hidden_size // self.num_attention_heads
+
+    @property
+    def v_dim(self) -> int:
+        """Value head dim — equals head_dim except under MLA."""
+        return self.v_head_dim if self.v_head_dim is not None else self.head_dim
 
     @property
     def rope_scaling_spec(self) -> tuple | None:
@@ -491,6 +529,59 @@ class LlamaConfig:
             kwargs.setdefault("intermediate_size_mlp", d.get("intermediate_size_mlp"))
         elif model_type == "llama4":
             return cls.from_hf_config(extract_text_config(d))
+        elif model_type == "deepseek_v3":
+            # Multi-head latent attention + DeepSeek MoE. Width convention
+            # follows the llama4 branch so ONE rule serves both mixed
+            # dense/MoE families: intermediate_size = the EXPERT width
+            # (HF moe_intermediate_size), intermediate_size_mlp = the dense
+            # layers' width (HF intermediate_size).
+            kwargs["kv_lora_rank"] = int(d.get("kv_lora_rank", 512))
+            qlr = d.get("q_lora_rank")
+            kwargs["q_lora_rank"] = int(qlr) if qlr else None
+            kwargs["qk_nope_head_dim"] = int(d.get("qk_nope_head_dim", 128))
+            kwargs["qk_rope_head_dim"] = int(d.get("qk_rope_head_dim", 64))
+            kwargs["v_head_dim"] = int(d.get("v_head_dim", 128))
+            # HF's head_dim here is the ROTARY dim (= qk_rope_head_dim),
+            # not a projection width — the MLA head_dim property derives
+            # qk_nope + qk_rope instead.
+            kwargs["explicit_head_dim"] = None
+            kwargs["rope_interleaved"] = bool(d.get("rope_interleave", True))
+            n_routed = int(d.get("n_routed_experts") or 0)
+            kwargs["num_local_experts"] = n_routed
+            if n_routed:
+                kwargs["intermediate_size_mlp"] = int(
+                    d.get("intermediate_size", 11008)
+                )
+                kwargs["intermediate_size"] = int(
+                    d.get("moe_intermediate_size", 2048)
+                )
+                kwargs["num_experts_per_tok"] = int(
+                    d.get("num_experts_per_tok", 8)
+                )
+                kwargs["moe_norm_topk_prob"] = bool(d.get("norm_topk_prob", True))
+                kwargs["moe_n_group"] = int(d.get("n_group", 1))
+                kwargs["moe_topk_group"] = int(d.get("topk_group", 1))
+                kwargs["moe_routed_scaling_factor"] = float(
+                    d.get("routed_scaling_factor", 1.0)
+                )
+                first_dense = int(d.get("first_k_dense_replace", 0))
+                n = d.get("num_hidden_layers", 32)
+                pattern = tuple(i >= first_dense for i in range(n))
+                if not all(pattern):
+                    kwargs["moe_layer_pattern"] = pattern
+            # Attention scale: qk_head_dim^-0.5 x mscale(factor,
+            # mscale_all_dim)^2 under yarn (DeepseekV3Attention.__init__);
+            # expressed through query_pre_attn_scalar (scale = qps^-0.5).
+            qk_hd = kwargs["qk_nope_head_dim"] + kwargs["qk_rope_head_dim"]
+            rs_d = d.get("rope_scaling") or {}
+            mad = rs_d.get("mscale_all_dim")
+            if mad and float(rs_d.get("factor", 1.0)) > 1.0:
+                import math
+
+                m = 0.1 * float(mad) * math.log(float(rs_d["factor"])) + 1.0
+                kwargs["query_pre_attn_scalar"] = qk_hd / m**4
+            else:
+                kwargs["query_pre_attn_scalar"] = float(qk_hd)
         elif model_type in ("mistral", "mixtral", "phi3"):
             # sliding_window flows through by field name (may be null);
             # mixtral's num_local_experts/num_experts_per_tok likewise.
@@ -503,9 +594,9 @@ class LlamaConfig:
             raise NotImplementedError(
                 f"model_type {model_type!r} is not supported "
                 "(llama, mistral, phi3, qwen2, qwen3, qwen3_moe, mixtral, gemma, "
-                "gemma2, gemma3_text, llama4_text are)"
+                "gemma2, gemma3_text, llama4_text, deepseek_v3 are)"
             )
-        if model_type not in ("mixtral", "llama4_text", "qwen3_moe"):
+        if model_type not in ("mixtral", "llama4_text", "qwen3_moe", "deepseek_v3"):
             # A stray num_local_experts key in a dense export must not flip
             # the model into MoE mode (same stray-key defence as
             # sliding_window above).

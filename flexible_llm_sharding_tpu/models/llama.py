@@ -105,6 +105,72 @@ def _out_proj(attn: Params, o: jax.Array) -> jax.Array:
     return _lin(o.reshape(*o.shape[:-2], -1), attn, "wo", "bo")
 
 
+def _qkv_mla(attn: Params, cfg: LlamaConfig, x: jax.Array, positions, total_len=None):
+    """Multi-head latent attention q/k/v assembly (DeepSeek-V2/V3,
+    DeepseekV3Attention): queries optionally LoRA'd (q_a -> norm -> q_b),
+    KV compressed to ``kv_lora_rank`` channels plus ONE shared
+    ``qk_rope_head_dim`` rope key, decompressed per head (kv_b) into
+    ``qk_nope_head_dim`` keys and ``v_head_dim`` values. Rope applies only
+    to the rot slices (interleaved complex-pair convention when
+    ``cfg.rope_interleaved``); the shared rope key broadcasts across heads.
+    Returns q/k [..., L, H, qk_nope+qk_rope], v [..., L, H, v_head_dim] —
+    the downstream attention ops are head-dim-agnostic, so the usual GQA
+    machinery runs unchanged with n_kv == n_heads.
+    """
+    if cfg.rope_local_theta is not None or cfg.layer_rope is not None:
+        # No named family composes MLA with per-layer rope bases or NoPE
+        # patterns; silently applying one global base would drop declared
+        # numerics — fail loudly instead.
+        raise NotImplementedError(
+            "MLA does not compose with rope_local_theta / layer_rope"
+        )
+    nh = cfg.num_attention_heads
+    dn, dr = cfg.qk_nope_head_dim, cfg.qk_rope_head_dim
+    dv = cfg.v_dim
+    eps = cfg.rms_norm_eps
+    if "q_a" in attn:
+        q = _mm(
+            rms_norm(_lin(x, attn, "q_a", "bq_a"), attn["q_a_norm"], eps, False),
+            attn["q_b"],
+        )
+    else:
+        q = _lin(x, attn, "wq", "bq")  # bias only if the checkpoint has one
+    q = q.reshape(*x.shape[:-1], nh, dn + dr)
+    ckv = _lin(x, attn, "kv_a", "bkv_a")  # [..., L, kv_lora + dr]
+    c_kv, k_rot = ckv[..., : cfg.kv_lora_rank], ckv[..., cfg.kv_lora_rank :]
+    kv = _mm(
+        rms_norm(c_kv, attn["kv_a_norm"], eps, False), attn["kv_b"]
+    ).reshape(*x.shape[:-1], nh, dn + dv)
+    k_nope, v = kv[..., :dn], kv[..., dn:]
+
+    cos, sin = rope_cos_sin(
+        positions, dr, cfg.rope_theta, cfg.rope_scaling_spec, total_len=total_len
+    )
+    rot = apply_rope_interleaved if cfg.rope_interleaved else apply_rope
+    q_rot = rot(q[..., dn:], cos, sin)
+    k_rot = rot(k_rot[..., None, :], cos, sin)  # [..., L, 1, dr] shared head
+    q = jnp.concatenate([q[..., :dn], q_rot], axis=-1)
+    k = jnp.concatenate(
+        [k_nope, jnp.broadcast_to(k_rot, (*k_nope.shape[:-1], dr))], axis=-1
+    )
+    return q, k, v
+
+
+def positioned_qkv(
+    params: Params, cfg: LlamaConfig, h: jax.Array, positions, sliding,
+    rope_on, total_len=None,
+):
+    """Post-rope q/k/v for one layer — the single integration point the
+    layer fns share: standard families run _qkv + position_qk; MLA
+    (``cfg.kv_lora_rank``) runs its own assembly (partial rope, shared
+    rope key, distinct value dim)."""
+    if cfg.kv_lora_rank:
+        return _qkv_mla(params["attn"], cfg, h, positions, total_len)
+    q, k, v = _qkv(params["attn"], cfg, h)
+    q, k = position_qk(cfg, q, k, positions, sliding, rope_on, total_len)
+    return q, k, v
+
+
 # MLP gate activations by config.hidden_act; HF's 'gelu' is the exact erf
 # form, 'gelu_pytorch_tanh' (gemma) the tanh approximation.
 _ACT = {
@@ -192,7 +258,65 @@ def _llama4_moe_mlp(mlp: Params, cfg: LlamaConfig, x: jax.Array) -> jax.Array:
     return shared + routed
 
 
+def _deepseek_moe_mlp(mlp: Params, cfg: LlamaConfig, x: jax.Array) -> jax.Array:
+    """DeepSeek-V3 MoE (DeepseekV3MoE/TopkRouter): fp32 sigmoid scores;
+    SELECTION adds a trained correction bias and is group-limited (experts
+    partition into n_group groups, each scored by its top-2 sum, only the
+    best topk_group groups stay eligible) — the combine WEIGHTS come from
+    the unbiased scores, renormalised (+1e-20) iff norm_topk_prob and
+    scaled by routed_scaling_factor. A shared expert
+    (n_shared_experts x the routed width) adds unconditionally. Same
+    compute-all stacked-einsum layout as the Mixtral path."""
+    e, k = cfg.num_local_experts, cfg.num_experts_per_tok
+    g = cfg.moe_n_group
+    logits = jnp.einsum(
+        "...ld,de->...le",
+        x.astype(jnp.float32),
+        mlp["router"].astype(jnp.float32),
+        precision=_PRECISION,
+    )  # HF routes in float32 end to end
+    scores = jax.nn.sigmoid(logits)  # [..., L, E]
+    choice = scores + mlp["correction_bias"].astype(jnp.float32)
+    if g > 1:
+        grouped = choice.reshape(*choice.shape[:-1], g, e // g)
+        top2, _ = jax.lax.top_k(grouped, 2)
+        group_scores = top2.sum(axis=-1)  # [..., L, G]
+        _, gidx = jax.lax.top_k(group_scores, cfg.moe_topk_group)
+        gmask = jnp.sum(
+            jax.nn.one_hot(gidx, g, dtype=choice.dtype), axis=-2
+        )  # [..., L, G]
+        choice = jnp.where(
+            jnp.repeat(gmask, e // g, axis=-1) > 0, choice, 0.0
+        )
+    _, top_idx = jax.lax.top_k(choice, k)
+    top_w = jnp.take_along_axis(scores, top_idx, axis=-1)
+    if cfg.moe_norm_topk_prob:
+        top_w = top_w / (jnp.sum(top_w, axis=-1, keepdims=True) + 1e-20)
+    top_w = top_w * cfg.moe_routed_scaling_factor
+    combine = jnp.sum(
+        jax.nn.one_hot(top_idx, e, dtype=jnp.float32) * top_w[..., None],
+        axis=-2,
+    ).astype(x.dtype)  # [..., L, E]
+    act = _ACT[cfg.hidden_act]
+    h = act(
+        jnp.einsum("...ld,edf->...lef", x, mlp["gate"].astype(x.dtype), precision=_PRECISION)
+    ) * jnp.einsum("...ld,edf->...lef", x, mlp["up"].astype(x.dtype), precision=_PRECISION)
+    c = combine[..., None]
+    h = jnp.where(c != 0, h * c, jnp.zeros_like(h))
+    routed = jnp.einsum(
+        "...lef,efd->...ld", h, mlp["down"].astype(x.dtype), precision=_PRECISION
+    )
+    shared = _mm(
+        act(_mm(x, mlp["shared_gate"])) * _mm(x, mlp["shared_up"]),
+        mlp["shared_down"],
+    )
+    return routed + shared
+
+
 def _mlp(mlp: Params, x: jax.Array, cfg: LlamaConfig | None = None) -> jax.Array:
+    if "correction_bias" in mlp:
+        assert cfg is not None and cfg.num_local_experts > 0
+        return _deepseek_moe_mlp(mlp, cfg, x)
     if "shared_gate" in mlp:
         assert cfg is not None and cfg.num_local_experts > 0
         return _llama4_moe_mlp(mlp, cfg, x)
@@ -380,8 +504,7 @@ def decoder_layer(
     ``sliding``/``rope_on`` select the per-layer rope base / NoPE;
     ``total_len`` is longrope's real-length selector)."""
     h = rms_norm(x, params["input_layernorm"]["scale"], cfg.rms_norm_eps, cfg.norm_unit_offset)
-    q, k, v = _qkv(params["attn"], cfg, h)
-    q, k = position_qk(cfg, q, k, positions, sliding, rope_on, total_len)
+    q, k, v = positioned_qkv(params, cfg, h, positions, sliding, rope_on, total_len)
     attn_out = attention(
         q, k, v, mask, scale=cfg.attn_scale, softcap=cfg.attn_logit_softcap
     )
@@ -511,7 +634,9 @@ def prefix_suffix_layer(
     # Under tensor parallelism (``tp_mesh``) the kernels run per head-shard
     # via shard_map, so eligibility is checked on PER-SHARD head counts.
     tp_size = tp_mesh.shape["tp"] if tp_mesh is not None else 1
-    flash = use_pallas and pallas_attention.supports(
+    # MLA (kv_lora_rank): distinct q/k vs v head dims — the flash kernels
+    # assume one head dim, so MLA always takes the XLA ops.
+    flash = use_pallas and not cfg.kv_lora_rank and pallas_attention.supports(
         cfg.num_attention_heads // tp_size,
         cfg.num_key_value_heads // tp_size,
         cfg.head_dim,
@@ -521,8 +646,9 @@ def prefix_suffix_layer(
 
     # --- prefix: causal self-attention, keep post-RoPE KV ---
     h = rms_norm(prefix_h, params["input_layernorm"]["scale"], eps, cfg.norm_unit_offset)
-    q, k, v = _qkv(params["attn"], cfg, h)
-    q, k = position_qk(cfg, q, k, jnp.arange(lp), rope_sliding, rope_on, total_len)
+    q, k, v = positioned_qkv(
+        params, cfg, h, jnp.arange(lp), rope_sliding, rope_on, total_len
+    )
     if flash:
         # Rows at i >= prefix_len are padding; the kernel's valid-len mask
         # additionally skips fully-masked KV blocks.
@@ -558,9 +684,10 @@ def prefix_suffix_layer(
     # --- suffixes: batched attention over [shared prefix KV ; own causal KV],
     # prefix KV never expanded across suffixes (ops.prefix_shared_attention) ---
     hs = rms_norm(suffix_h, params["input_layernorm"]["scale"], eps, cfg.norm_unit_offset)
-    qs, ks, vs = _qkv(params["attn"], cfg, hs)
     pos_s = prefix_len + jnp.arange(ls)
-    qs, ks = position_qk(cfg, qs, ks, pos_s, rope_sliding, rope_on, total_len)
+    qs, ks, vs = positioned_qkv(
+        params, cfg, hs, pos_s, rope_sliding, rope_on, total_len
+    )
 
     if flash:
         if tp_mesh is not None:
@@ -626,7 +753,6 @@ def decode_step_layer(
     kq = x.shape[1]
     base = jnp.asarray(t, jnp.int32)
     h = rms_norm(x, params["input_layernorm"]["scale"], eps, cfg.norm_unit_offset)
-    q, k_new, v_new = _qkv(params["attn"], cfg, h)  # [S, K, n, hd]
     pos = (
         prefix_len + suffix_eos + 1 + jnp.broadcast_to(base, suffix_eos.shape)
     )[:, None] + jnp.arange(kq)[None, :]  # [S, K]
@@ -635,7 +761,9 @@ def decode_step_layer(
     # the original_max boundary (parked KV would need re-rotation), so
     # within one generation this always lands on one side.
     total_len = pos[:, -1] + 1 if cfg.rope_scaling_kind == "longrope" else None
-    q, k_new = position_qk(cfg, q, k_new, pos, rope_sliding, rope_on, total_len)
+    q, k_new, v_new = positioned_qkv(
+        params, cfg, h, pos, rope_sliding, rope_on, total_len
+    )  # [S, K, n, hd]
 
     kv = dict(kv)
     if base.ndim == 0:
@@ -655,7 +783,7 @@ def decode_step_layer(
 
     window, chunk, sliding = _effective_window(cfg, sliding)
     tp_size = tp_mesh.shape["tp"] if tp_mesh is not None else 1
-    if use_pallas and kq == 1 and base.ndim == 0 and pallas_attention.supports_decode(
+    if use_pallas and not cfg.kv_lora_rank and kq == 1 and base.ndim == 0 and pallas_attention.supports_decode(
         cfg.num_attention_heads // tp_size,
         cfg.num_key_value_heads // tp_size,
         cfg.head_dim,
@@ -832,12 +960,33 @@ def init_layer_params(rng: jax.Array, cfg: LlamaConfig, dtype=jnp.float32) -> Pa
     def bias(key, n):
         return (jax.random.normal(key, (n,)) * 0.02).astype(dtype)
 
-    attn = {
-        "wq": lin(ks[0], d, nq * hd),
-        "wk": lin(ks[1], d, nkv * hd),
-        "wv": lin(ks[2], d, nkv * hd),
-        "wo": lin(ks[3], nq * hd, d),
-    }
+    if cfg.kv_lora_rank:
+        # MLA (DeepSeek): LoRA'd q when q_lora_rank is set, compressed KV
+        # always; wo reads the heads' v_head_dim-wide outputs.
+        mks = jax.random.split(ks[0], 6)
+        attn = {
+            "kv_a": lin(mks[0], d, cfg.kv_lora_rank + cfg.qk_rope_head_dim),
+            "kv_a_norm": jnp.ones((cfg.kv_lora_rank,), dtype),
+            "kv_b": lin(
+                mks[1], cfg.kv_lora_rank, nq * (cfg.qk_nope_head_dim + cfg.v_dim)
+            ),
+            "wo": lin(ks[3], nq * cfg.v_dim, d),
+        }
+        if cfg.q_lora_rank:
+            attn |= {
+                "q_a": lin(mks[2], d, cfg.q_lora_rank),
+                "q_a_norm": jnp.ones((cfg.q_lora_rank,), dtype),
+                "q_b": lin(mks[3], cfg.q_lora_rank, nq * hd),
+            }
+        else:
+            attn["wq"] = lin(mks[4], d, nq * hd)
+    else:
+        attn = {
+            "wq": lin(ks[0], d, nq * hd),
+            "wk": lin(ks[1], d, nkv * hd),
+            "wv": lin(ks[2], d, nkv * hd),
+            "wo": lin(ks[3], nq * hd, d),
+        }
     if cfg.attention_in_bias:
         attn |= {
             "bq": bias(ks[7], nq * hd),

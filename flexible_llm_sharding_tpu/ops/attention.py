@@ -94,7 +94,8 @@ def attention(
         scores = jnp.where(mask[..., None, None, :, :], scores, _NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
     out = jnp.einsum("...ngqk,...knh->...qngh", probs, v, precision=_PRECISION)
-    return out.reshape(q.shape)
+    # V's own head dim (MLA: v_head_dim != qk head dim).
+    return out.reshape(*q.shape[:-1], v.shape[-1])
 
 
 def prefix_shared_attention(
@@ -155,7 +156,7 @@ def prefix_shared_attention(
     out = out + jnp.einsum(
         "sngqk,sknh->sqngh", probs_s, v_suffix, precision=_PRECISION
     )
-    return out.reshape(s, ls, n_q, hd)
+    return out.reshape(s, ls, n_q, v_prefix.shape[-1])
 
 
 def decode_attention(
@@ -260,7 +261,7 @@ def decode_attention(
     out = jnp.einsum("sngqk,knh->sqngh", pp, v_prefix, precision=_PRECISION)
     out = out + jnp.einsum("sngqk,sknh->sqngh", ps, v_suffix, precision=_PRECISION)
     out = out + jnp.einsum("sngqk,sknh->sqngh", pg, v_gen, precision=_PRECISION)
-    return out.reshape(s, kq, n_q, hd)
+    return out.reshape(s, kq, n_q, v_prefix.shape[-1])
 
 
 def causal_mask(

@@ -221,6 +221,11 @@ class TpPlacement:
     def __init__(self, devices: Sequence, cfg: LlamaConfig | None = None):
         if len(devices) < 2:
             raise ValueError("TpPlacement needs >= 2 devices")
+        if cfg is not None and cfg.kv_lora_rank:
+            raise NotImplementedError(
+                "tensor_parallel does not support MLA (deepseek_v3) yet: "
+                "the LoRA'd projections need their own sharding specs"
+            )
         self.mesh = make_mesh({"tp": len(devices)}, list(devices))
         self.act = NamedSharding(self.mesh, P())
 

@@ -703,11 +703,15 @@ class DecodeGenerator:
                             # gen_slots: one per decode step (min 1 so shapes
                             # stay non-degenerate at n_gen=1), widened for
                             # speculative passes' K+1-slot writes.
-                            gen_shape = (
-                                k_l, bsz, s_b, gen_slots,
-                                self.model_cfg.num_key_value_heads,
-                                self.model_cfg.head_dim,
-                            )
+                            # Generated-KV head count/dims come from the
+                            # PREFILL's own parked KV leaves, so MLA shapes
+                            # (n_kv == n_heads; v_head_dim != qk head dim)
+                            # allocate correctly without per-family math.
+                            def _gen_shape(like):
+                                return (
+                                    k_l, bsz, s_b, gen_slots,
+                                    like.shape[-2], like.shape[-1],
+                                )
                             # Two distinct buffers: kg/vg are donated by the
                             # decode scan and must not alias. Allocated
                             # directly under the stage's chip (MP) / the tp
@@ -716,8 +720,14 @@ class DecodeGenerator:
                             # stage's gen-KV there during prefill.
                             kv = {
                                 **kv,
-                                "kg": jnp.zeros(gen_shape, self.dtype, device=act_dev),
-                                "vg": jnp.zeros(gen_shape, self.dtype, device=act_dev),
+                                "kg": jnp.zeros(
+                                    _gen_shape(kv["ks"]), self.dtype,
+                                    device=act_dev,
+                                ),
+                                "vg": jnp.zeros(
+                                    _gen_shape(kv["vs"]), self.dtype,
+                                    device=act_dev,
+                                ),
                             }
                             kv_store.put(("kv", shard_pos, di, b), kv)
                             di += 1

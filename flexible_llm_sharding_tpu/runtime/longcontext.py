@@ -309,6 +309,11 @@ class LongContextScorer:
     def __init__(self, cfg: FrameworkConfig, devices=None, tokenizer=None):
         self.cfg = cfg
         self.model_cfg = LlamaConfig.from_pretrained(cfg.model_path)
+        if self.model_cfg.kv_lora_rank:
+            raise NotImplementedError(
+                "long_context does not support MLA (deepseek_v3) yet: the "
+                "sp-mesh layer assembles q/k/v with the standard projections"
+            )
         devices = list(devices) if devices else None
         self.mesh = make_mesh(
             {"sp": len(devices)} if devices else None, devices=devices

@@ -273,10 +273,11 @@ def hf_layer_to_native(layer_name: str, sd: dict[str, np.ndarray]) -> dict[str, 
     if layer_name == "lm_head":
         return {"kernel": np.ascontiguousarray(sd["lm_head.weight"].T)}
     moe = any(".block_sparse_moe." in k for k in sd)
-    qmoe = f"{layer_name}.mlp.experts.0.gate_proj.weight" in sd  # qwen3_moe
+    qmoe = f"{layer_name}.mlp.experts.0.gate_proj.weight" in sd  # qwen3_moe / deepseek
     fused = f"{layer_name}.self_attn.qkv_proj.weight" in sd  # phi3 layout
     ff = any(".feed_forward." in k for k in sd)  # llama4 naming
     ff_moe = f"{layer_name}.feed_forward.router.weight" in sd
+    mla = f"{layer_name}.self_attn.kv_a_proj_with_mqa.weight" in sd  # deepseek
     out = {}
     consumed = set()
     for native_key, hf_sub, transpose in _LAYER_MAP:
@@ -286,10 +287,38 @@ def hf_layer_to_native(layer_name: str, sd: dict[str, np.ndarray]) -> dict[str, 
             "attn.wq", "attn.wk", "attn.wv", "mlp.gate", "mlp.up"
         ):
             continue  # carried fused; split below
+        if mla and native_key in ("attn.wq", "attn.wk", "attn.wv"):
+            continue  # MLA projections mapped below (wq only when dense q)
         key = f"{layer_name}.{hf_sub}"
         w = sd[key]
         consumed.add(key)
         out[native_key] = np.ascontiguousarray(w.T) if transpose else w
+    if mla:
+        # DeepSeek multi-head latent attention (DeepseekV3Attention):
+        # q either dense (q_proj) or LoRA (q_a -> norm -> q_b); KV always
+        # compressed (kv_a_proj_with_mqa -> norm -> kv_b). Kernels store
+        # [in, out] like every other native projection.
+        def take(native_key, hf_sub, transpose=True, optional=False):
+            key = f"{layer_name}.self_attn.{hf_sub}"
+            if key not in sd:
+                if optional:
+                    return
+                raise KeyError(f"{layer_name}: missing MLA tensor {key}")
+            w = sd[key]
+            consumed.add(key)
+            out[native_key] = np.ascontiguousarray(w.T) if transpose else w
+
+        if f"{layer_name}.self_attn.q_proj.weight" in sd:
+            take("attn.wq", "q_proj.weight")
+        else:
+            take("attn.q_a", "q_a_proj.weight")
+            take("attn.q_a_norm", "q_a_layernorm.weight", transpose=False)
+            take("attn.q_b", "q_b_proj.weight")
+            take("attn.bq_a", "q_a_proj.bias", transpose=False, optional=True)
+        take("attn.kv_a", "kv_a_proj_with_mqa.weight")
+        take("attn.kv_a_norm", "kv_a_layernorm.weight", transpose=False)
+        take("attn.kv_b", "kv_b_proj.weight")
+        take("attn.bkv_a", "kv_a_proj_with_mqa.bias", transpose=False, optional=True)
     if fused:
         # Phi3 fuses q/k/v into qkv_proj [(nq+2*nkv)*hd, D] and gate/up into
         # gate_up_proj [2F, D]. The split needs no config: o_proj's input
@@ -350,9 +379,10 @@ def hf_layer_to_native(layer_name: str, sd: dict[str, np.ndarray]) -> dict[str, 
             out[native_key] = np.ascontiguousarray(sd[key].T)
             consumed.add(key)
     if qmoe:
-        # Qwen3-MoE: router at mlp.gate [E, D] -> [D, E]; per-expert
-        # gate/up/down Linears stack into the same [E, D, F] / [E, F, D]
-        # native arrays as Mixtral.
+        # Qwen3-MoE / DeepSeek: router at mlp.gate [E, D] -> [D, E];
+        # per-expert gate/up/down Linears stack into the same
+        # [E, D, F] / [E, F, D] native arrays as Mixtral. DeepSeek adds a
+        # routing correction-bias buffer and a shared expert.
         rk = f"{layer_name}.mlp.gate.weight"
         out["mlp.router"] = np.ascontiguousarray(sd[rk].T)
         consumed.add(rk)
@@ -361,6 +391,19 @@ def hf_layer_to_native(layer_name: str, sd: dict[str, np.ndarray]) -> dict[str, 
             (("mlp.gate", "gate_proj"), ("mlp.up", "up_proj"), ("mlp.down", "down_proj")),
             sd, out, consumed,
         )
+        bk = f"{layer_name}.mlp.gate.e_score_correction_bias"
+        if bk in sd:
+            out["mlp.correction_bias"] = sd[bk]
+            consumed.add(bk)
+        for native_key, sub in (
+            ("mlp.shared_gate", "gate_proj"),
+            ("mlp.shared_up", "up_proj"),
+            ("mlp.shared_down", "down_proj"),
+        ):
+            key = f"{layer_name}.mlp.shared_experts.{sub}.weight"
+            if key in sd:
+                out[native_key] = np.ascontiguousarray(sd[key].T)
+                consumed.add(key)
     if moe:
         # Mixtral MoE: router [E, D] -> [D, E]; per-expert w1 (gate) / w3
         # (up) [F, D] and w2 (down) [D, F] stack into [E, D, F] / [E, F, D]

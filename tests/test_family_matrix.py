@@ -70,6 +70,25 @@ COMBOS = {
         norm_unit_offset=True,
         embed_scale=True,
     ),
+    "mla+window": dict(  # MLA under a sliding window no named family has
+        kv_lora_rank=16,
+        q_lora_rank=16,
+        qk_nope_head_dim=8,
+        qk_rope_head_dim=4,
+        v_head_dim=8,
+        sliding_window=5,
+        rope_interleaved=True,
+    ),
+    "mla+mixtral_moe+tied": dict(  # MLA x softmax-MoE x tied head
+        kv_lora_rank=16,
+        q_lora_rank=None,
+        qk_nope_head_dim=8,
+        qk_rope_head_dim=4,
+        v_head_dim=8,
+        num_local_experts=4,
+        num_experts_per_tok=2,
+        tie_word_embeddings=True,
+    ),
     "ropelocal+qknorm+tied": dict(
         rope_local_theta=10_000.0,
         rope_theta=500_000.0,
@@ -112,8 +131,10 @@ def test_streaming_and_decode_invariants(combo):
         ph, sh, kv = llama.prefix_suffix_layer(
             layer, cfg, ph, sh, plen, return_kv=True, sliding=sliding, rope_on=rope_on
         )
-        kv["kg"] = jnp.zeros((1, tmax, cfg.num_key_value_heads, cfg.head_dim))
-        kv["vg"] = jnp.zeros((1, tmax, cfg.num_key_value_heads, cfg.head_dim))
+        # Head count/dims from the layer's own parked KV (MLA: n_kv ==
+        # n_heads and v_head_dim != qk head dim).
+        kv["kg"] = jnp.zeros((1, tmax, *kv["ks"].shape[-2:]))
+        kv["vg"] = jnp.zeros((1, tmax, *kv["vs"].shape[-2:]))
         kvs.append(kv)
     normed = llama.select_eos_and_norm(params["norm"], cfg, sh, suffix_eos)
     scores = np.asarray(
