@@ -530,58 +530,62 @@ class LlamaConfig:
         elif model_type == "llama4":
             return cls.from_hf_config(extract_text_config(d))
         elif model_type == "deepseek_v3":
-            # Multi-head latent attention + DeepSeek MoE. Width convention
-            # follows the llama4 branch so ONE rule serves both mixed
-            # dense/MoE families: intermediate_size = the EXPERT width
-            # (HF moe_intermediate_size), intermediate_size_mlp = the dense
-            # layers' width (HF intermediate_size).
-            kwargs["kv_lora_rank"] = int(d.get("kv_lora_rank", 512))
-            qlr = d.get("q_lora_rank")
-            kwargs["q_lora_rank"] = int(qlr) if qlr else None
-            kwargs["qk_nope_head_dim"] = int(d.get("qk_nope_head_dim", 128))
-            kwargs["qk_rope_head_dim"] = int(d.get("qk_rope_head_dim", 64))
-            kwargs["v_head_dim"] = int(d.get("v_head_dim", 128))
-            # HF's head_dim here is the ROTARY dim (= qk_rope_head_dim),
-            # not a projection width — the MLA head_dim property derives
-            # qk_nope + qk_rope instead.
-            kwargs["explicit_head_dim"] = None
-            kwargs["rope_interleaved"] = bool(d.get("rope_interleave", True))
-            n_routed = int(d.get("n_routed_experts") or 0)
-            kwargs["num_local_experts"] = n_routed
-            if n_routed:
-                kwargs["intermediate_size_mlp"] = int(
-                    d.get("intermediate_size", 11008)
-                )
-                kwargs["intermediate_size"] = int(
-                    d.get("moe_intermediate_size", 2048)
-                )
-                kwargs["num_experts_per_tok"] = int(
-                    d.get("num_experts_per_tok", 8)
-                )
-                kwargs["moe_norm_topk_prob"] = bool(d.get("norm_topk_prob", True))
-                kwargs["moe_n_group"] = int(d.get("n_group", 1))
-                kwargs["moe_topk_group"] = int(d.get("topk_group", 1))
-                kwargs["moe_routed_scaling_factor"] = float(
-                    d.get("routed_scaling_factor", 1.0)
-                )
-                first_dense = int(d.get("first_k_dense_replace", 0))
-                n = d.get("num_hidden_layers", 32)
-                pattern = tuple(i >= first_dense for i in range(n))
-                if not all(pattern):
-                    kwargs["moe_layer_pattern"] = pattern
-            # Attention scale: qk_head_dim^-0.5 x mscale(factor,
-            # mscale_all_dim)^2 under yarn (DeepseekV3Attention.__init__);
-            # expressed through query_pre_attn_scalar (scale = qps^-0.5).
-            qk_hd = kwargs["qk_nope_head_dim"] + kwargs["qk_rope_head_dim"]
-            rs_d = d.get("rope_scaling") or {}
-            mad = rs_d.get("mscale_all_dim")
-            if mad and float(rs_d.get("factor", 1.0)) > 1.0:
-                import math
+            if not native:
+                # Multi-head latent attention + DeepSeek MoE. Width convention
+                # follows the llama4 branch so ONE rule serves both mixed
+                # dense/MoE families: intermediate_size = the EXPERT width
+                # (HF moe_intermediate_size), intermediate_size_mlp = the dense
+                # layers' width (HF intermediate_size). Configs this framework
+                # saved itself skip the derivation entirely — their native
+                # field names round-tripped above, and re-deriving from HF
+                # names would corrupt them (the width swap in particular).
+                kwargs["kv_lora_rank"] = int(d.get("kv_lora_rank", 512))
+                qlr = d.get("q_lora_rank")
+                kwargs["q_lora_rank"] = int(qlr) if qlr else None
+                kwargs["qk_nope_head_dim"] = int(d.get("qk_nope_head_dim", 128))
+                kwargs["qk_rope_head_dim"] = int(d.get("qk_rope_head_dim", 64))
+                kwargs["v_head_dim"] = int(d.get("v_head_dim", 128))
+                # HF's head_dim here is the ROTARY dim (= qk_rope_head_dim),
+                # not a projection width — the MLA head_dim property derives
+                # qk_nope + qk_rope instead.
+                kwargs["explicit_head_dim"] = None
+                kwargs["rope_interleaved"] = bool(d.get("rope_interleave", True))
+                n_routed = int(d.get("n_routed_experts") or 0)
+                kwargs["num_local_experts"] = n_routed
+                if n_routed:
+                    kwargs["intermediate_size_mlp"] = int(
+                        d.get("intermediate_size", 11008)
+                    )
+                    kwargs["intermediate_size"] = int(
+                        d.get("moe_intermediate_size", 2048)
+                    )
+                    kwargs["num_experts_per_tok"] = int(
+                        d.get("num_experts_per_tok", 8)
+                    )
+                    kwargs["moe_norm_topk_prob"] = bool(d.get("norm_topk_prob", True))
+                    kwargs["moe_n_group"] = int(d.get("n_group", 1))
+                    kwargs["moe_topk_group"] = int(d.get("topk_group", 1))
+                    kwargs["moe_routed_scaling_factor"] = float(
+                        d.get("routed_scaling_factor", 1.0)
+                    )
+                    first_dense = int(d.get("first_k_dense_replace", 0))
+                    n = d.get("num_hidden_layers", 32)
+                    pattern = tuple(i >= first_dense for i in range(n))
+                    if not all(pattern):
+                        kwargs["moe_layer_pattern"] = pattern
+                # Attention scale: qk_head_dim^-0.5 x mscale(factor,
+                # mscale_all_dim)^2 under yarn (DeepseekV3Attention.__init__);
+                # expressed through query_pre_attn_scalar (scale = qps^-0.5).
+                qk_hd = kwargs["qk_nope_head_dim"] + kwargs["qk_rope_head_dim"]
+                rs_d = d.get("rope_scaling") or {}
+                mad = rs_d.get("mscale_all_dim")
+                if mad and float(rs_d.get("factor", 1.0)) > 1.0:
+                    import math
 
-                m = 0.1 * float(mad) * math.log(float(rs_d["factor"])) + 1.0
-                kwargs["query_pre_attn_scalar"] = qk_hd / m**4
-            else:
-                kwargs["query_pre_attn_scalar"] = float(qk_hd)
+                    m = 0.1 * float(mad) * math.log(float(rs_d["factor"])) + 1.0
+                    kwargs["query_pre_attn_scalar"] = qk_hd / m**4
+                else:
+                    kwargs["query_pre_attn_scalar"] = float(qk_hd)
         elif model_type in ("mistral", "mixtral", "phi3"):
             # sliding_window flows through by field name (may be null);
             # mixtral's num_local_experts/num_experts_per_tok likewise.
@@ -601,7 +605,10 @@ class LlamaConfig:
             # the model into MoE mode (same stray-key defence as
             # sliding_window above).
             kwargs["num_local_experts"] = 0
-        if d.get("head_dim"):
+        if d.get("head_dim") and model_type != "deepseek_v3":
+            # deepseek's top-level head_dim is the ROTARY dim, not a
+            # projection width; the MLA head_dim property derives
+            # qk_nope + qk_rope itself.
             kwargs["explicit_head_dim"] = d["head_dim"]
         kwargs.setdefault("num_key_value_heads", d.get("num_attention_heads", 32))
         for key in (

@@ -481,7 +481,7 @@ class DecodeGenerator:
             * len(idxs)
             * (lp + s_b * (ls + gen_slots))
             * mc.num_key_value_heads
-            * mc.head_dim
+            * (mc.head_dim + mc.v_dim) / 2  # K and V dims differ under MLA
         )
         bpe = np.dtype(np_dtype_for(self.cfg.dtype)).itemsize
         return per_layer * mc.num_hidden_layers * bpe

@@ -755,62 +755,20 @@ def save_params(params: dict[str, Any], out_dir: str, cfg: LlamaConfig) -> None:
     st_save_file(dict(flatten(params["norm"])), os.path.join(out_dir, "model.norm.safetensors"))
     if "lm_head" in params and params["lm_head"]:
         st_save_file(dict(flatten(params["lm_head"])), os.path.join(out_dir, "lm_head.safetensors"))
+    import dataclasses as _dc
+
+    # EVERY dataclass field serializes by name (tuples become json lists;
+    # from_hf_config's native path coerces the known tuple fields back).
+    # A hand-maintained field list here silently dropped newly-added fields
+    # (an MLA config round-tripped to the 128/64 head-dim defaults) — the
+    # asdict dump cannot drift.
     hf_cfg = {
         # Marks a config this framework wrote itself: every native field is
         # explicit and from_hf_config round-trips them all by name. Foreign
         # configs (no marker) get the per-family stray-key defence instead.
         "fls_native": True,
-        "model_type": cfg.model_type,
-        "vocab_size": cfg.vocab_size,
-        "hidden_size": cfg.hidden_size,
-        "intermediate_size": cfg.intermediate_size,
-        "num_hidden_layers": cfg.num_hidden_layers,
-        "num_attention_heads": cfg.num_attention_heads,
-        "num_key_value_heads": cfg.num_key_value_heads,
-        "rms_norm_eps": cfg.rms_norm_eps,
-        "rope_theta": cfg.rope_theta,
-        "max_position_embeddings": cfg.max_position_embeddings,
-        "tie_word_embeddings": cfg.tie_word_embeddings,
-        # Native field names round-trip directly through from_hf_config
-        # (explicit values win over the family defaults there).
-        "attention_in_bias": cfg.attention_in_bias,
-        "attention_out_bias": cfg.attention_out_bias,
-        "mlp_bias": cfg.mlp_bias,
-        "sliding_window": cfg.sliding_window,
         "use_sliding_window": cfg.sliding_window is not None,  # qwen2 gate
-        "num_local_experts": cfg.num_local_experts,
-        "num_experts_per_tok": cfg.num_experts_per_tok,
-        "moe_norm_topk_prob": cfg.moe_norm_topk_prob,
-        "qk_norm": cfg.qk_norm,
-        "hidden_act": cfg.hidden_act,
-        "norm_unit_offset": cfg.norm_unit_offset,
-        "embed_scale": cfg.embed_scale,
-        "ffw_sandwich_norms": cfg.ffw_sandwich_norms,
-        "attn_logit_softcap": cfg.attn_logit_softcap,
-        "final_logit_softcap": cfg.final_logit_softcap,
-        "query_pre_attn_scalar": cfg.query_pre_attn_scalar,
-        "layer_sliding": list(cfg.layer_sliding) if cfg.layer_sliding else None,
-        "rope_local_theta": cfg.rope_local_theta,
-        "attention_chunk_size": cfg.attention_chunk_size,
-        "rope_interleaved": cfg.rope_interleaved,
-        "layer_rope": list(cfg.layer_rope) if cfg.layer_rope else None,
-        "qk_l2_norm": cfg.qk_l2_norm,
-        "attn_temperature_tuning": cfg.attn_temperature_tuning,
-        "attn_floor_scale": cfg.attn_floor_scale,
-        "attn_scale_coef": cfg.attn_scale_coef,
-        "moe_layer_pattern": list(cfg.moe_layer_pattern) if cfg.moe_layer_pattern else None,
-        "intermediate_size_mlp": cfg.intermediate_size_mlp,
-        "rope_scaling_kind": cfg.rope_scaling_kind,
-        "rope_scaling_factor": cfg.rope_scaling_factor,
-        "rope_low_freq_factor": cfg.rope_low_freq_factor,
-        "rope_high_freq_factor": cfg.rope_high_freq_factor,
-        "rope_original_max_position": cfg.rope_original_max_position,
-        "rope_beta_fast": cfg.rope_beta_fast,
-        "rope_beta_slow": cfg.rope_beta_slow,
-        "rope_attention_factor": cfg.rope_attention_factor,
-        "rope_truncate": cfg.rope_truncate,
+        **_dc.asdict(cfg),
     }
-    if cfg.explicit_head_dim is not None:
-        hf_cfg["head_dim"] = cfg.explicit_head_dim
     with open(os.path.join(out_dir, "config.json"), "w") as f:
         json.dump(hf_cfg, f)
