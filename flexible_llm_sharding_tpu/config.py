@@ -550,6 +550,10 @@ class LlamaConfig:
                 # qk_nope + qk_rope instead.
                 kwargs["explicit_head_dim"] = None
                 kwargs["rope_interleaved"] = bool(d.get("rope_interleave", True))
+                if d.get("attention_bias"):
+                    # HF: bias on q_a/q_proj, kv_a_proj_with_mqa, o_proj.
+                    kwargs.setdefault("attention_in_bias", True)
+                    kwargs.setdefault("attention_out_bias", True)
                 n_routed = int(d.get("n_routed_experts") or 0)
                 kwargs["num_local_experts"] = n_routed
                 if n_routed:

@@ -980,6 +980,13 @@ def init_layer_params(rng: jax.Array, cfg: LlamaConfig, dtype=jnp.float32) -> Pa
             }
         else:
             attn["wq"] = lin(mks[4], d, nq * hd)
+        if cfg.attention_in_bias:
+            # HF deepseek attention_bias: on the down-projections only.
+            attn["bkv_a"] = bias(ks[8], cfg.kv_lora_rank + cfg.qk_rope_head_dim)
+            if cfg.q_lora_rank:
+                attn["bq_a"] = bias(ks[7], cfg.q_lora_rank)
+            else:
+                attn["bq"] = bias(ks[7], nq * hd)
     else:
         attn = {
             "wq": lin(ks[0], d, nq * hd),
@@ -987,7 +994,7 @@ def init_layer_params(rng: jax.Array, cfg: LlamaConfig, dtype=jnp.float32) -> Pa
             "wv": lin(ks[2], d, nkv * hd),
             "wo": lin(ks[3], nq * hd, d),
         }
-    if cfg.attention_in_bias:
+    if cfg.attention_in_bias and not cfg.kv_lora_rank:
         attn |= {
             "bq": bias(ks[7], nq * hd),
             "bk": bias(ks[8], nkv * hd),
@@ -1052,9 +1059,9 @@ def init_mixed_params(rng: jax.Array, cfg: LlamaConfig, dtype=jnp.float32) -> Pa
     layers = []
     for i, is_moe in enumerate(cfg.moe_layer_pattern):
         lp = init_layer_params(keys[i], moe_cfg if is_moe else dense_cfg, dtype)
-        if is_moe and cfg.model_type == "llama4_text":
+        if is_moe and cfg.model_type in ("llama4_text", "deepseek_v3"):
             d, f = cfg.hidden_size, cfg.intermediate_size
-            ks = jax.random.split(jax.random.fold_in(keys[i], 99), 3)
+            ks = jax.random.split(jax.random.fold_in(keys[i], 99), 4)
 
             def lin(key, fan_in, fan_out):
                 scale = (2.0 / (fan_in + fan_out)) ** 0.5
@@ -1065,6 +1072,10 @@ def init_mixed_params(rng: jax.Array, cfg: LlamaConfig, dtype=jnp.float32) -> Pa
                 "shared_up": lin(ks[1], d, f),
                 "shared_down": lin(ks[2], f, d),
             }
+            if cfg.model_type == "deepseek_v3":
+                lp["mlp"]["correction_bias"] = (
+                    jax.random.normal(ks[3], (cfg.num_local_experts,)) * 0.1
+                ).astype(jnp.float32)
         layers.append(lp)
     # embed/norm/lm_head only — a 0-layer view skips building (and then
     # discarding) a full dense layer stack.

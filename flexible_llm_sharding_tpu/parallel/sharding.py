@@ -127,7 +127,29 @@ def layer_specs(
     row = P(tp, None)  # [in, out] sharded on in
     rep = P(None)
     bcol = P(tp)  # bias of a column-parallel projection
-    attn: Params = {"wq": col, "wk": col, "wv": col, "wo": row}
+    if cfg is not None and cfg.kv_lora_rank:
+        # MLA (deepseek_v3): the LoRA down-projections (q_a, kv_a) and
+        # their norms are replicated — kv_a's output carries the shared
+        # rope key every head needs, and both are tiny (rank x D). The
+        # per-head up-projections (q_b / kv_b / dense wq) column-shard by
+        # head like Megatron q/k/v; wo row-shards over the heads' values.
+        attn: Params = {
+            "kv_a": rep, "kv_a_norm": rep, "kv_b": col, "wo": row,
+        }
+        if cfg.q_lora_rank:
+            attn |= {"q_a": rep, "q_a_norm": rep, "q_b": col}
+        else:
+            attn["wq"] = col
+        if cfg.attention_in_bias:
+            # Biases on the down-projections act on replicated outputs;
+            # a dense-q bias shards with its column-parallel projection.
+            attn["bkv_a"] = rep
+            if cfg.q_lora_rank:
+                attn["bq_a"] = rep
+            else:
+                attn["bq"] = bcol
+    else:
+        attn = {"wq": col, "wk": col, "wv": col, "wo": row}
     if mlp_kind is None:
         mlp_kind = "moe" if (cfg is not None and cfg.num_local_experts) else "dense"
     if mlp_kind == "moe":
@@ -137,15 +159,17 @@ def layer_specs(
         # _moe_mlp). Router stays replicated (it is [D, E], tiny).
         exp = P(tp, None, None)
         mlp: Params = {"router": rep, "gate": exp, "up": exp, "down": exp}
-        if cfg is not None and cfg.model_type == "llama4_text":
-            # Llama4's always-on shared expert is a plain Megatron MLP
-            # alongside the expert-sharded routed stack (_llama4_moe_mlp);
-            # its row-parallel down-projection folds into the same psum.
+        if cfg is not None and cfg.model_type in ("llama4_text", "deepseek_v3"):
+            # The always-on shared expert (llama4 / deepseek) is a plain
+            # Megatron MLP alongside the expert-sharded routed stack; its
+            # row-parallel down-projection folds into the same psum.
             mlp |= {"shared_gate": col, "shared_up": col, "shared_down": row}
+        if cfg is not None and cfg.model_type == "deepseek_v3":
+            mlp["correction_bias"] = rep  # [E] routing buffer, tiny
     else:
         mlp = {"gate": col, "up": col, "down": row}
     if cfg is not None:
-        if cfg.attention_in_bias:
+        if cfg.attention_in_bias and not cfg.kv_lora_rank:
             attn |= {"bq": bcol, "bk": bcol, "bv": bcol}
         if cfg.attention_out_bias:
             attn["bo"] = rep
@@ -221,11 +245,6 @@ class TpPlacement:
     def __init__(self, devices: Sequence, cfg: LlamaConfig | None = None):
         if len(devices) < 2:
             raise ValueError("TpPlacement needs >= 2 devices")
-        if cfg is not None and cfg.kv_lora_rank:
-            raise NotImplementedError(
-                "tensor_parallel does not support MLA (deepseek_v3) yet: "
-                "the LoRA'd projections need their own sharding specs"
-            )
         self.mesh = make_mesh({"tp": len(devices)}, list(devices))
         self.act = NamedSharding(self.mesh, P())
 
@@ -304,7 +323,10 @@ def check_tp_divisibility(cfg: LlamaConfig, tp_size: int) -> None:
         dense_f = cfg.intermediate_size_mlp or (
             cfg.intermediate_size if cfg.moe_layer_pattern else None
         )
-        if cfg.model_type == "llama4_text" and cfg.intermediate_size % tp_size:
+        if (
+            cfg.model_type in ("llama4_text", "deepseek_v3")
+            and cfg.intermediate_size % tp_size
+        ):
             raise ValueError(
                 f"shared-expert intermediate_size={cfg.intermediate_size} "
                 f"not divisible by tp={tp_size}"

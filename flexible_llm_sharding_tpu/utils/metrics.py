@@ -279,7 +279,22 @@ def _arch_walk(cfg):
     hd = cfg.head_dim
     q_dim = cfg.num_attention_heads * hd
     kv_dim = cfg.num_key_value_heads * hd
-    attn_proj = h * q_dim + 2 * h * kv_dim + q_dim * h
+    if cfg.kv_lora_rank:
+        # MLA (deepseek): LoRA'd q (or dense wq), compressed kv_a, per-head
+        # kv_b decompression, wo over the heads' v_head_dim outputs.
+        q_p = (
+            h * cfg.q_lora_rank + cfg.q_lora_rank * q_dim
+            if cfg.q_lora_rank
+            else h * q_dim
+        )
+        kv_p = h * (cfg.kv_lora_rank + cfg.qk_rope_head_dim) + (
+            cfg.kv_lora_rank
+            * cfg.num_attention_heads
+            * (cfg.qk_nope_head_dim + cfg.v_dim)
+        )
+        attn_proj = q_p + kv_p + cfg.num_attention_heads * cfg.v_dim * h
+    else:
+        attn_proj = h * q_dim + 2 * h * kv_dim + q_dim * h
     n = cfg.num_hidden_layers
     moe_pattern = cfg.moe_layer_pattern or (
         ((True,) * n) if cfg.num_local_experts else ((False,) * n)
@@ -311,7 +326,8 @@ def model_flops_per_token(cfg, context_len: int = 0) -> float:
     for is_moe in moe_pattern:
         if is_moe:
             active = cfg.num_experts_per_tok + (
-                1 if cfg.model_type == "llama4_text" else 0  # shared expert
+                # always-on shared expert
+                1 if cfg.model_type in ("llama4_text", "deepseek_v3") else 0
             )
             mlp = active * 3 * h * cfg.intermediate_size + h * cfg.num_local_experts
         else:
@@ -433,7 +449,7 @@ def param_count(cfg) -> int:
         if is_moe:
             mlp = cfg.num_local_experts * 3 * h * cfg.intermediate_size
             mlp += h * cfg.num_local_experts  # router
-            if cfg.model_type == "llama4_text":  # shared expert
+            if cfg.model_type in ("llama4_text", "deepseek_v3"):  # shared
                 mlp += 3 * h * cfg.intermediate_size
         else:
             mlp = 3 * h * dense_inter

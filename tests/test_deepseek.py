@@ -162,15 +162,12 @@ def test_deepseek_split_and_cli(tmp_path):
 
 
 def test_deepseek_loud_rejects(tmp_path):
-    """MLA under tensor_parallel / long_context fails loudly (no specs for
-    the LoRA'd projections / sp-mesh assembly yet)."""
-    from flexible_llm_sharding_tpu.parallel.sharding import TpPlacement
+    """MLA under long_context fails loudly (the sp-mesh layer assembles
+    q/k/v with the standard projections). TP is supported —
+    test_tp.py::test_tp_deepseek_mla pins parity."""
     from flexible_llm_sharding_tpu.runtime.longcontext import LongContextScorer
 
     model = _hf_deepseek()
-    cfg = LlamaConfig.from_hf_config(model.config.to_dict())
-    with pytest.raises(NotImplementedError, match="MLA"):
-        TpPlacement(jax.devices()[:2], cfg)
     src = tmp_path / "hf"
     model.save_pretrained(str(src))
     out = tmp_path / "native"

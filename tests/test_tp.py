@@ -328,3 +328,39 @@ def test_tp_placement_specs():
     assert pl.segment_target("head")["kernel"].spec == jax.sharding.PartitionSpec(
         None, "tp"
     )
+
+
+def test_tp_deepseek_mla(tmp_path_factory):
+    """DeepSeek-V3 under TP: the LoRA down-projections (q_a/kv_a — kv_a's
+    output carries the shared rope key every head needs) stay replicated
+    while the per-head up-projections column-shard by head and wo
+    row-shards; the MoE runs take expert-axis specs with the replicated
+    correction bias and a Megatron-sharded shared expert."""
+    from flexible_llm_sharding_tpu.config import LlamaConfig
+
+    cfg = LlamaConfig(
+        model_type="deepseek_v3",
+        vocab_size=288,
+        hidden_size=64,
+        intermediate_size=32,  # expert + shared width
+        intermediate_size_mlp=48,  # dense layers' width
+        num_hidden_layers=3,
+        num_attention_heads=4,
+        num_key_value_heads=4,
+        kv_lora_rank=32,
+        q_lora_rank=32,
+        qk_nope_head_dim=16,
+        qk_rope_head_dim=8,
+        v_head_dim=16,
+        num_local_experts=2,
+        num_experts_per_tok=1,
+        moe_n_group=1,
+        moe_topk_group=1,
+        moe_routed_scaling_factor=1.5,
+        moe_layer_pattern=(False, True, True),
+        rope_interleaved=True,
+        query_pre_attn_scalar=24.0,
+        max_position_embeddings=512,
+    )
+    d = _mixed_moe_model(tmp_path_factory, "ds_tp_model", cfg)
+    _tp_vs_single(d, layer_num_per_shard=3)
