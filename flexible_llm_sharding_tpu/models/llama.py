@@ -981,12 +981,11 @@ def init_layer_params(rng: jax.Array, cfg: LlamaConfig, dtype=jnp.float32) -> Pa
         else:
             attn["wq"] = lin(mks[4], d, nq * hd)
         if cfg.attention_in_bias:
-            # HF deepseek attention_bias: on the down-projections only.
+            # HF deepseek attention_bias: q_a_proj and kv_a_proj_with_mqa
+            # only — the dense q_proj is bias=False unconditionally.
             attn["bkv_a"] = bias(ks[8], cfg.kv_lora_rank + cfg.qk_rope_head_dim)
             if cfg.q_lora_rank:
                 attn["bq_a"] = bias(ks[7], cfg.q_lora_rank)
-            else:
-                attn["bq"] = bias(ks[7], nq * hd)
     else:
         attn = {
             "wq": lin(ks[0], d, nq * hd),

@@ -76,11 +76,11 @@ def _ring_local(
     n_kv = k_blk.shape[1]
     qr = _grouped(q_blk, n_kv)  # [Lq, n_kv, g, hd]
     g = qr.shape[2]
-    hd = qr.shape[-1]
+    hd_v = v_blk.shape[-1]  # V's own head dim (MLA: != the qk head dim)
 
     m = jnp.full((n_kv, g, lq, 1), _NEG_INF, jnp.float32)
     l = jnp.zeros((n_kv, g, lq, 1), jnp.float32)
-    acc = jnp.zeros((n_kv, g, lq, hd), jnp.float32)
+    acc = jnp.zeros((n_kv, g, lq, hd_v), jnp.float32)
 
     qi = idx * lq + jnp.arange(lq)[:, None]  # global query positions
     perm = [(j, (j + 1) % n) for j in range(n)]
@@ -103,8 +103,8 @@ def _ring_local(
             v_cur = jax.lax.ppermute(v_cur, axis, perm)
 
     out = jnp.where(l > 0, acc / jnp.maximum(l, 1e-30), 0.0)
-    # [n_kv, g, Lq, hd] -> [Lq, n_q, hd]
-    return out.transpose(2, 0, 1, 3).reshape(lq, n_kv * g, hd).astype(q_blk.dtype)
+    # [n_kv, g, Lq, hd_v] -> [Lq, n_q, hd_v]
+    return out.transpose(2, 0, 1, 3).reshape(lq, n_kv * g, hd_v).astype(q_blk.dtype)
 
 
 def ring_self_attention(
@@ -192,11 +192,14 @@ def ring_decoder_layer(
         idx = jax.lax.axis_index(axis)
         lq = x_blk.shape[0]
         h = rms_norm(x_blk, params["input_layernorm"]["scale"], eps, cfg.norm_unit_offset)
-        q, k, v = llama._qkv(params["attn"], cfg, h)
         pos = idx * lq + jnp.arange(lq)
-        # total_len (longrope's real-length selector, a replicated scalar)
-        # rides the closure like params do.
-        q, k = llama.position_qk(cfg, q, k, pos, sliding, rope_on, total_len)
+        # positioned_qkv: standard families rope whole heads; MLA assembles
+        # its LoRA'd projections with the shared rope key per chunk (the
+        # global positions make each chip's rotations line up). total_len
+        # (longrope's real-length selector) rides the closure like params.
+        q, k, v = llama.positioned_qkv(
+            params, cfg, h, pos, sliding, rope_on, total_len
+        )
         return x_blk, q, k, v
 
     qkv_specs = (spec, P(axis, None, None), P(axis, None, None), P(axis, None, None))

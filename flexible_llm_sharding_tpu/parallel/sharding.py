@@ -141,13 +141,12 @@ def layer_specs(
         else:
             attn["wq"] = col
         if cfg.attention_in_bias:
-            # Biases on the down-projections act on replicated outputs;
-            # a dense-q bias shards with its column-parallel projection.
+            # Biases exist on the LoRA down-projections only (HF's dense
+            # q_proj is bias=False unconditionally); they act on
+            # replicated outputs.
             attn["bkv_a"] = rep
             if cfg.q_lora_rank:
                 attn["bq_a"] = rep
-            else:
-                attn["bq"] = bcol
     else:
         attn = {"wq": col, "wk": col, "wv": col, "wo": row}
     if mlp_kind is None:

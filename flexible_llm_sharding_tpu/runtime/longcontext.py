@@ -123,9 +123,10 @@ def sharded_prefix_suffix_layer(
 
     # --- suffix q/k/v at global positions prefix_len + i ---
     hs = rms_norm(suffix_h, params["input_layernorm"]["scale"], eps, cfg.norm_unit_offset)
-    qs, ks, vs = llama._qkv(params["attn"], cfg, hs)
     pos_s = prefix_len + jnp.arange(ls)
-    qs, ks = llama.position_qk(cfg, qs, ks, pos_s, sliding, rope_on, total_len)
+    qs, ks, vs = llama.positioned_qkv(
+        params, cfg, hs, pos_s, sliding, rope_on, total_len
+    )
 
     n_kv = cfg.num_key_value_heads
     g = cfg.num_attention_heads // n_kv
@@ -175,10 +176,10 @@ def sharded_prefix_suffix_layer(
     cp, cs = jnp.exp(m_p - m), jnp.exp(m_s - m)
     l = l_p * cp + l_s * cs
     out = (acc_p * cp + acc_s * cs) / jnp.maximum(l, 1e-30)
-    # [S, n_kv, g, Ls, hd] -> [S, Ls, n_q, hd]
+    # [S, n_kv, g, Ls, hd_v] -> [S, Ls, n_q, hd_v] (V's own dim under MLA)
     attn_s = (
         out.transpose(0, 3, 1, 2, 4)
-        .reshape(s_cnt, ls, n_kv * g, cfg.head_dim)
+        .reshape(s_cnt, ls, n_kv * g, cfg.v_dim)
         .astype(suffix_h.dtype)
     )
 
@@ -225,12 +226,13 @@ def sharded_decode_layer(
     chunk = cfg.attention_chunk_size if sliding else None
 
     h = rms_norm(x, params["input_layernorm"]["scale"], eps, cfg.norm_unit_offset)
-    q, k_new, v_new = llama._qkv(params["attn"], cfg, h)  # [S, 1, n, hd]
     pos = (prefix_len + suffix_eos + 1 + t)[:, None]  # [S, 1]
     # longrope: per-suffix real length at this step; the decode runner's
     # check_longrope_regime guarantees the regime is constant per run.
     tl = pos[:, -1] + 1 if cfg.rope_scaling_kind == "longrope" else None
-    q, k_new = llama.position_qk(cfg, q, k_new, pos, sliding, rope_on, tl)
+    q, k_new, v_new = llama.positioned_qkv(
+        params, cfg, h, pos, sliding, rope_on, tl
+    )  # [S, 1, n, qk_hd] / v_new [S, 1, n, v_dim] (distinct under MLA)
 
     kv = dict(kv)
     kv["kg"] = jax.lax.dynamic_update_slice_in_dim(kv["kg"], k_new, t, axis=1)
@@ -286,10 +288,10 @@ def sharded_decode_layer(
     cp, cs, cg = jnp.exp(m_p - m), jnp.exp(m_s - m), jnp.exp(m_g3 - m)
     l = l_p * cp + l_s * cs + l_g3 * cg
     out = (acc_p * cp + acc_s * cs + acc_g3 * cg) / jnp.maximum(l, 1e-30)
-    # [S, n_kv, g, 1, hd] -> [S, 1, n_q, hd]
+    # [S, n_kv, g, 1, hd_v] -> [S, 1, n_q, hd_v] (V's own dim under MLA)
     attn = (
         out.transpose(0, 3, 1, 2, 4)
-        .reshape(s_cnt, 1, n_kv * g, cfg.head_dim)
+        .reshape(s_cnt, 1, n_kv * g, cfg.v_dim)
         .astype(x.dtype)
     )
     mid = llama._residual_attn(params, cfg, x, attn)
@@ -309,11 +311,6 @@ class LongContextScorer:
     def __init__(self, cfg: FrameworkConfig, devices=None, tokenizer=None):
         self.cfg = cfg
         self.model_cfg = LlamaConfig.from_pretrained(cfg.model_path)
-        if self.model_cfg.kv_lora_rank:
-            raise NotImplementedError(
-                "long_context does not support MLA (deepseek_v3) yet: the "
-                "sp-mesh layer assembles q/k/v with the standard projections"
-            )
         devices = list(devices) if devices else None
         self.mesh = make_mesh(
             {"sp": len(devices)} if devices else None, devices=devices
@@ -558,7 +555,6 @@ class LongContextDecoder(LongContextScorer):
             self.model_cfg, t.prefix_len, t.suffix_eos[: t.num_suffixes]
         )
         s_cnt = t.suffix_ids.shape[0]
-        n_kv, hd = self.model_cfg.num_key_value_heads, self.model_cfg.head_dim
 
         kv_layers: list[Params] = []
         dists: list[np.ndarray] = []  # per-step [S_true, V]
@@ -581,15 +577,25 @@ class LongContextDecoder(LongContextScorer):
                             layer, prefix_x, suffix_h, prefix_len, sliding,
                             rope_on, total_len,
                         )
-                        gen_shape = (s_cnt, max(1, n_gen - 1), n_kv, hd)
+                        # Head count/dims from the layer's own parked KV
+                        # (MLA: n_kv == n_heads, v_head_dim != qk dim).
+                        slots = max(1, n_gen - 1)
                         kv_layers.append(
                             kv
                             | {
                                 "kg": jax.device_put(
-                                    jnp.zeros(gen_shape, self.dtype), self._rep
+                                    jnp.zeros(
+                                        (s_cnt, slots, *kv["ks"].shape[-2:]),
+                                        self.dtype,
+                                    ),
+                                    self._rep,
                                 ),
                                 "vg": jax.device_put(
-                                    jnp.zeros(gen_shape, self.dtype), self._rep
+                                    jnp.zeros(
+                                        (s_cnt, slots, *kv["vs"].shape[-2:]),
+                                        self.dtype,
+                                    ),
+                                    self._rep,
                                 ),
                             }
                         )

@@ -320,7 +320,10 @@ def model_flops_per_token(cfg, context_len: int = 0) -> float:
     """
     h = cfg.hidden_size
     attn_proj, moe_pattern, dense_inter = _arch_walk(cfg)
-    attn_scores = 2 * context_len * cfg.head_dim * cfg.num_attention_heads
+    # QK uses the (qk) head_dim, PV uses V's own dim (MLA: 192 vs 128).
+    attn_scores = (
+        context_len * (cfg.head_dim + cfg.v_dim) * cfg.num_attention_heads
+    )
 
     total = 0.0
     for is_moe in moe_pattern:

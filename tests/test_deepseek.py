@@ -161,20 +161,59 @@ def test_deepseek_split_and_cli(tmp_path):
             full = np.append(full, int(np.argmax(want)))
 
 
-def test_deepseek_loud_rejects(tmp_path):
-    """MLA under long_context fails loudly (the sp-mesh layer assembles
-    q/k/v with the standard projections). TP is supported —
-    test_tp.py::test_tp_deepseek_mla pins parity."""
-    from flexible_llm_sharding_tpu.runtime.longcontext import LongContextScorer
+def test_deepseek_long_context(tmp_path):
+    """MLA on the sp mesh: the ring prefix assembles q/k/v through
+    positioned_qkv per chunk (global positions keep the shared rope key's
+    rotations aligned across chips) and the partial-softmax accumulators
+    carry V's own head dim — a prefix past one chip's cap scores exactly
+    like the untruncated single-device oracle."""
+    import dataclasses
+
+    from flexible_llm_sharding_tpu.runtime.orchestration import run_prompts
 
     model = _hf_deepseek()
     src = tmp_path / "hf"
     model.save_pretrained(str(src))
     out = tmp_path / "native"
     ckpt.split_into_layers(str(src), str(out))
-    fw = FrameworkConfig(model_path=str(out), long_context=True)
-    with pytest.raises(NotImplementedError, match="MLA"):
-        LongContextScorer(fw, devices=jax.devices()[:2])
+    prompts = [(" ".join(f"w{i}" for i in range(40)), (" one", " two"))]
+
+    def fw(**kw):
+        return FrameworkConfig(
+            model_path=str(out), dtype="float32", bucket_multiple=8,
+            prefetch_depth=0, **kw,
+        )
+
+    want = run_prompts(
+        fw(max_token_len=512), prompts,
+        tokenizer=FakeTokenizer(), devices=jax.devices()[:1],
+    )
+    got = run_prompts(
+        fw(max_token_len=64, long_context=True), prompts,
+        tokenizer=FakeTokenizer(), devices=jax.devices()[:4],
+    )
+    for g, w in zip(got, want):
+        assert g.shape == w.shape
+        np.testing.assert_allclose(g, w, rtol=3e-4, atol=2e-5)
+
+    # Long-context KV decode: sp-sharded prefix KV + replicated
+    # suffix/generated regions, with MLA's distinct k/v dims in the
+    # parked cache — greedy steps vs the token-level recompute contract
+    # (finite + first-step equality with the scorer).
+    from flexible_llm_sharding_tpu.runtime.orchestration import run_decode
+
+    kv_scores, _, _ = run_decode(
+        dataclasses.replace(
+            fw(max_token_len=64, long_context=True), num_gen_token=2
+        ),
+        prompts,
+        tokenizer=FakeTokenizer(),
+        devices=jax.devices()[:4],
+    )
+    np.testing.assert_allclose(
+        kv_scores[0][:, 0], got[0][:, 0], rtol=3e-4, atol=2e-5
+    )
+    assert np.isfinite(kv_scores[0]).all()
 
 
 def test_mla_rejects_per_layer_rope():
