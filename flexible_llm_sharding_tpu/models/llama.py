@@ -134,7 +134,7 @@ def _qkv_mla(attn: Params, cfg: LlamaConfig, x: jax.Array, positions, total_len=
             attn["q_b"],
         )
     else:
-        q = _lin(x, attn, "wq", "bq")  # bias only if the checkpoint has one
+        q = _mm(x, attn["wq"])  # HF's dense q_proj is bias-free
     q = q.reshape(*x.shape[:-1], nh, dn + dr)
     ckv = _lin(x, attn, "kv_a", "bkv_a")  # [..., L, kv_lora + dr]
     c_kv, k_rot = ckv[..., : cfg.kv_lora_rank], ckv[..., cfg.kv_lora_rank :]

@@ -341,6 +341,8 @@ def hf_layer_to_native(layer_name: str, sd: dict[str, np.ndarray]) -> dict[str, 
         out["mlp.gate"] = np.ascontiguousarray(gu[:f_dim].T)
         out["mlp.up"] = np.ascontiguousarray(gu[f_dim:].T)
     for native_key, hf_sub in _LAYER_MAP_OPTIONAL:
+        if mla and native_key in ("attn.bq", "attn.bk", "attn.bv"):
+            continue  # HF MLA projections are bias-free (q_a/kv_a aside)
         key = f"{layer_name}.{hf_sub}"
         if key in sd:
             consumed.add(key)

@@ -312,8 +312,9 @@ def model_flops_per_token(cfg, context_len: int = 0) -> float:
 
     2 FLOPs per matmul MAC over every parameter that participates in a
     matmul (projections, MLP, lm_head — embeddings are a gather, not FLOPs),
-    plus the attention score/value terms (4*ctx*head_dim per query head per
-    token at mean context ``context_len``). MoE layers count only the
+    plus the attention score/value terms (2*ctx*(qk head_dim + v_dim) per
+    query head per token at mean context ``context_len`` — the dims differ
+    under MLA, equal everywhere else). MoE layers count only the
     ACTIVE experts per token (top-k, + llama4's shared expert) plus the
     router. This is the numerator of MFU — the standard "model FLOPs"
     convention (no recompute, no masking discounts).
