@@ -57,7 +57,7 @@ def log(msg: str) -> None:
 # 1. Synthetic HF checkpoint (sharded safetensors + index), GB scale
 # ---------------------------------------------------------------------------
 
-def build_hf_checkpoint(cfg: dict) -> int:
+def build_hf_checkpoint(cfg: dict, hf_dir: str = HF_DIR) -> int:
     """Write a sharded HF-safetensors checkpoint; returns total weight bytes.
 
     One shard file per decoder layer (embed rides with layer 0, norm+head
@@ -68,13 +68,13 @@ def build_hf_checkpoint(cfg: dict) -> int:
     import ml_dtypes
     from safetensors.numpy import save_file
 
-    if os.path.exists(os.path.join(HF_DIR, "model.safetensors.index.json")):
+    if os.path.exists(os.path.join(hf_dir, "model.safetensors.index.json")):
         return sum(
-            os.path.getsize(os.path.join(HF_DIR, f))
-            for f in os.listdir(HF_DIR)
+            os.path.getsize(os.path.join(hf_dir, f))
+            for f in os.listdir(hf_dir)
             if f.endswith(".safetensors")
         )
-    os.makedirs(HF_DIR, exist_ok=True)
+    os.makedirs(hf_dir, exist_ok=True)
     rng = np.random.default_rng(0)
     bf16 = np.dtype(ml_dtypes.bfloat16)
     h, inter, v = cfg["hidden_size"], cfg["intermediate_size"], cfg["vocab_size"]
@@ -118,8 +118,8 @@ def build_hf_checkpoint(cfg: dict) -> int:
         for k in sd:
             weight_map[k] = fn
         total += sum(a.nbytes for a in sd.values())
-        save_file(sd, os.path.join(HF_DIR, fn))
-    with open(os.path.join(HF_DIR, "model.safetensors.index.json"), "w") as f:
+        save_file(sd, os.path.join(hf_dir, fn))
+    with open(os.path.join(hf_dir, "model.safetensors.index.json"), "w") as f:
         json.dump({"metadata": {"total_size": total}, "weight_map": weight_map}, f)
     hf_cfg = {
         "model_type": "llama",
@@ -128,7 +128,7 @@ def build_hf_checkpoint(cfg: dict) -> int:
         "tie_word_embeddings": False,
         **cfg,
     }
-    with open(os.path.join(HF_DIR, "config.json"), "w") as f:
+    with open(os.path.join(hf_dir, "config.json"), "w") as f:
         json.dump(hf_cfg, f)
     log(f"HF checkpoint: {total / 1e9:.2f} GB in {time.perf_counter() - t0:.1f}s")
     return total
@@ -143,11 +143,20 @@ def child_main(argv_json: str) -> None:
     CLI with the bench tokenizer (no tokenizer assets in a synthetic
     checkpoint; ``cli.main`` takes the tokenizer as its documented
     programmatic hook). Payload: the CLI argv list, or {"argv": [...],
-    "backend": "cpu"} — the cpu backend must be pinned IN-PROCESS
-    (jax.config), because the axon sitecustomize overrides the
-    JAX_PLATFORMS env var at interpreter start."""
+    "backend": "cpu", "virtual_devices": N} — the cpu backend must be pinned
+    IN-PROCESS (jax.config), because the axon sitecustomize overrides the
+    JAX_PLATFORMS env var at interpreter start; ``virtual_devices`` adds the
+    ``--xla_force_host_platform_device_count`` flag (the dp8/mp8 mesh legs'
+    8-virtual-CPU-device harness, same as tests/conftest.py)."""
     payload = json.loads(argv_json)
     argv = payload["argv"] if isinstance(payload, dict) else payload
+    if isinstance(payload, dict) and payload.get("virtual_devices"):
+        n = int(payload["virtual_devices"])
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count={n}"
+            ).strip()
     if isinstance(payload, dict) and payload.get("backend") == "cpu":
         import jax
 
@@ -158,7 +167,8 @@ def child_main(argv_json: str) -> None:
 
 
 def run_cli(argv: list[str], tag: str, kill_after_marker: str | None = None,
-            kill_min_shards: int = 4, backend: str = "auto") -> dict:
+            kill_min_shards: int = 4, backend: str = "auto",
+            virtual_devices: int = 0) -> dict:
     """Run the CLI as a subprocess; parse its final JSON stats line.
 
     With ``kill_after_marker``, SIGKILL the child once the resume progress
@@ -174,16 +184,26 @@ def run_cli(argv: list[str], tag: str, kill_after_marker: str | None = None,
         for path in globmod.glob(pattern):
             try:
                 with open(path) as f:
-                    done = max(done, json.load(f).get("completed_shards", 0))
+                    d = json.load(f)
+                # Single-device/DP executors mark completed_shards (per
+                # rank); the MP pipeline marks completed_stages (global
+                # stage order). Either counts as progress for the kill.
+                done = max(
+                    done,
+                    int(d.get("completed_shards") or 0),
+                    int(d.get("completed_stages") or 0),
+                )
             except (OSError, ValueError):
                 pass
         return done
 
     err_path = os.path.join(WORK, f"cli-{tag}.stderr")
     with open(err_path, "wb") as err:
-        payload = (
-            {"argv": argv, "backend": backend} if backend != "auto" else argv
-        )
+        payload: object = argv
+        if backend != "auto" or virtual_devices:
+            payload = {"argv": argv, "backend": backend}
+            if virtual_devices:
+                payload["virtual_devices"] = virtual_devices
         proc = subprocess.Popen(
             [sys.executable, os.path.abspath(__file__), "--child", json.dumps(payload)],
             stderr=err,
@@ -244,11 +264,18 @@ def main() -> None:
              "and a later on-TPU run overwrites it.",
     )
     p.add_argument(
-        "--configs", default="cpu,tpu,disk",
+        "--configs", default="cpu,tpu,disk,dp8,mp8",
         help="comma list of runs: cpu (BASELINE cfg 1: lnps=1 acts in RAM), "
              "disk (BASELINE cfg 3: lnps=1 acts on disk + kill/resume), "
-             "tpu (BASELINE cfg 2: lnps=8 acts in HBM). Results merge into "
-             "an existing SCALE_r03.json",
+             "tpu (BASELINE cfg 2: lnps=8 acts in HBM), dp8/mp8 (BASELINE "
+             "cfgs 5/4 on an 8-virtual-CPU-device mesh: per-rank memory, "
+             "score parity vs single-device, SIGKILL+resume). Results merge "
+             "into an existing artifact (--out)",
+    )
+    p.add_argument(
+        "--out", default=os.path.join(ROOT, "SCALE_r04.json"),
+        help="artifact path (merged across invocations for the same model "
+             "and workload)",
     )
     args = p.parse_args()
     if args.child:
@@ -256,7 +283,7 @@ def main() -> None:
         return
 
     configs = set(args.configs.split(","))
-    unknown = configs - {"cpu", "disk", "tpu"}
+    unknown = configs - {"cpu", "disk", "tpu", "dp8", "mp8"}
     if unknown:
         raise SystemExit(f"unknown --configs entries: {sorted(unknown)}")
     if args.skip_disk:
@@ -277,7 +304,7 @@ def main() -> None:
         "suffix_words": 24,
         "n_suffix": 4,
     }
-    out = os.path.join(ROOT, "SCALE_r03.json")
+    out = args.out
     result: dict = {}
     merged_prior = False
     if os.path.exists(out):
@@ -304,8 +331,15 @@ def main() -> None:
     # land on XLA:CPU when the tunnel is down — it must not masquerade as
     # hardware evidence).
 
-    total_bytes = build_hf_checkpoint(cfg)
-    result["model_gb"] = round(total_bytes / 1e9, 2)
+    # The GB-scale model (and the accelerator probe) only matter for the
+    # single-chip legs; a mesh-only invocation (--configs dp8,mp8 — always
+    # the virtual CPU mesh) skips the multi-GB build/split and the
+    # tunnel-touching probe entirely.
+    big = bool(configs & {"cpu", "disk", "tpu"})
+
+    total_bytes = build_hf_checkpoint(cfg) if big else 0
+    if big:
+        result["model_gb"] = round(total_bytes / 1e9, 2)
 
     # Host->HBM link bandwidth: the streaming design's wall-clock is bounded
     # by model_gb / link_bw per full pass; recording it makes the throughput
@@ -314,84 +348,88 @@ def main() -> None:
     # Subprocess: the parent must not initialise the accelerator backend
     # (the CLI children own it); the probe itself is the shared helper so
     # BENCH and SCALE artifacts report comparable numbers.
-    try:
-        # Hard timeout: a wedged tunnel otherwise hangs the probe child
-        # forever and the demo never reaches the actual runs.
-        pin = (
-            "jax.config.update('jax_platforms','cpu');"
-            if args.backend == "cpu"
-            else ""
-        )
-        probe = subprocess.run(
-            [sys.executable, "-c",
-             "import jax;" + pin +
-             "from flexible_llm_sharding_tpu.utils.metrics import"
-             " measure_host_to_hbm_gbps;"
-             "d=jax.devices()[0];"
-             "print(measure_host_to_hbm_gbps(d));"
-             "print(getattr(d,'device_kind',d.platform))"],
-            capture_output=True, text=True, cwd=ROOT, timeout=300,
-        )
-        lines = probe.stdout.strip().splitlines()
-        result["host_to_hbm_gbps"] = round(float(lines[-2]), 3)
-        result["device_kind"] = lines[-1]
-        log(f"host->HBM link: {result['host_to_hbm_gbps']} GB/s "
-            f"({result['device_kind']})")
-    except subprocess.TimeoutExpired:
-        log("bandwidth probe timed out (wedged tunnel?) — continuing")
-    except (ValueError, IndexError):
-        log("bandwidth probe failed: " + probe.stderr[-200:])
-    # Honest platform marking, keyed on the device the run ACTUALLY uses:
-    # forced --backend cpu, or an auto run whose probe resolved to CPU.
-    # The memory-ratio claim is about the streaming STRUCTURE and holds on
-    # any backend; throughput from a CPU capture is not a TPU number, and
-    # the hardware-evidence watcher keeps retrying until a real one exists.
-    if args.backend == "cpu" or "cpu" in (result.get("device_kind") or "").lower():
-        result["platform"] = "cpu"
-        result["platform_note"] = (
-            "captured on the XLA:CPU backend (TPU tunnel unavailable); "
-            "a later on-TPU scale_demo run replaces this artifact"
-        )
-    else:
-        result.pop("platform", None)
-        result.pop("platform_note", None)
+    peak_flops = None
+    if big:
+        try:
+            # Hard timeout: a wedged tunnel otherwise hangs the probe child
+            # forever and the demo never reaches the actual runs.
+            pin = (
+                "jax.config.update('jax_platforms','cpu');"
+                if args.backend == "cpu"
+                else ""
+            )
+            probe = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax;" + pin +
+                 "from flexible_llm_sharding_tpu.utils.metrics import"
+                 " measure_host_to_hbm_gbps;"
+                 "d=jax.devices()[0];"
+                 "print(measure_host_to_hbm_gbps(d));"
+                 "print(getattr(d,'device_kind',d.platform))"],
+                capture_output=True, text=True, cwd=ROOT, timeout=300,
+            )
+            lines = probe.stdout.strip().splitlines()
+            result["host_to_hbm_gbps"] = round(float(lines[-2]), 3)
+            result["device_kind"] = lines[-1]
+            log(f"host->HBM link: {result['host_to_hbm_gbps']} GB/s "
+                f"({result['device_kind']})")
+        except subprocess.TimeoutExpired:
+            log("bandwidth probe timed out (wedged tunnel?) — continuing")
+        except (ValueError, IndexError):
+            log("bandwidth probe failed: " + probe.stderr[-200:])
+        # Honest platform marking, keyed on the device the run ACTUALLY
+        # uses: forced --backend cpu, or an auto run whose probe resolved to
+        # CPU. The memory-ratio claim is about the streaming STRUCTURE and
+        # holds on any backend; throughput from a CPU capture is not a TPU
+        # number, and the hardware-evidence watcher keeps retrying until a
+        # real one exists.
+        if args.backend == "cpu" or "cpu" in (
+            result.get("device_kind") or ""
+        ).lower():
+            result["platform"] = "cpu"
+            result["platform_note"] = (
+                "captured on the XLA:CPU backend (TPU tunnel unavailable); "
+                "a later on-TPU scale_demo run replaces this artifact"
+            )
+        else:
+            result.pop("platform", None)
+            result.pop("platform_note", None)
 
-    # Analytic model FLOPs/token (MFU numerator) for the built config; each
-    # run's mfu is derived from its tokens_per_sec in the post-pass below.
-    from flexible_llm_sharding_tpu.config import LlamaConfig
-    from flexible_llm_sharding_tpu.utils.metrics import (
-        _PEAK_BF16_FLOPS,
-        model_flops_per_token,
-    )
-
-    fpt = model_flops_per_token(
-        LlamaConfig(**cfg), args.prefix_words
-    )
-    result["model_flops_per_token"] = round(fpt)
-    kind = (result.get("device_kind") or "").lower()
-    peak_flops = next(
-        (p for token, p in _PEAK_BF16_FLOPS if token in kind), None
-    )
-
-    # Offline split through the real CLI (reference step 1).
-    if not os.path.exists(os.path.join(NATIVE_DIR, "fls_tpu_layout.json")):
-        log("splitting with prepare_weights.py ...")
-        t0 = time.perf_counter()
-        subprocess.run(
-            [sys.executable, os.path.join(ROOT, "prepare_weights.py"),
-             HF_DIR, NATIVE_DIR, "--dtype", "bfloat16"],
-            check=True,
-            cwd=ROOT,
+        # Analytic model FLOPs/token (MFU numerator) for the built config;
+        # each run's mfu derives from its tokens_per_sec in the post-pass.
+        from flexible_llm_sharding_tpu.config import LlamaConfig
+        from flexible_llm_sharding_tpu.utils.metrics import (
+            _PEAK_BF16_FLOPS,
+            model_flops_per_token,
         )
-        result["split_s"] = round(time.perf_counter() - t0, 1)
-        log(f"split done in {result['split_s']}s")
 
-    prompts = make_prompts(
-        n=args.prompts, prefix_words=args.prefix_words, suffix_words=24, n_suffix=4
-    )
-    prompt_pkl = os.path.join(WORK, "prompts.pkl")
-    with open(prompt_pkl, "wb") as f:
-        pickle.dump(prompts, f)
+        fpt = model_flops_per_token(LlamaConfig(**cfg), args.prefix_words)
+        result["model_flops_per_token"] = round(fpt)
+        kind = (result.get("device_kind") or "").lower()
+        peak_flops = next(
+            (p for token, p in _PEAK_BF16_FLOPS if token in kind), None
+        )
+
+        # Offline split through the real CLI (reference step 1).
+        if not os.path.exists(os.path.join(NATIVE_DIR, "fls_tpu_layout.json")):
+            log("splitting with prepare_weights.py ...")
+            t0 = time.perf_counter()
+            subprocess.run(
+                [sys.executable, os.path.join(ROOT, "prepare_weights.py"),
+                 HF_DIR, NATIVE_DIR, "--dtype", "bfloat16"],
+                check=True,
+                cwd=ROOT,
+            )
+            result["split_s"] = round(time.perf_counter() - t0, 1)
+            log(f"split done in {result['split_s']}s")
+
+        prompts = make_prompts(
+            n=args.prompts, prefix_words=args.prefix_words,
+            suffix_words=24, n_suffix=4,
+        )
+        prompt_pkl = os.path.join(WORK, "prompts.pkl")
+        with open(prompt_pkl, "wb") as f:
+            pickle.dump(prompts, f)
 
     def cli_argv(storage: str, resume: bool = False, lnps: int = 1,
                  prefetch: int = 2) -> list[str]:
@@ -482,6 +520,107 @@ def main() -> None:
                     np.allclose(a, b, rtol=2e-2, atol=2e-2)
                     for a, b in zip(scores, dscores)
                 )
+            )
+
+    # --- dp8 / mp8 (BASELINE configs 5 / 4) on the 8-virtual-device mesh ----
+    # Real multi-chip hardware isn't reachable from this rig (one tunneled
+    # chip); the virtual CPU mesh is the same harness the test suite and the
+    # driver's dryrun use (tests/conftest.py). A smaller model keeps XLA:CPU
+    # wall times sane on this 1-core host — these legs evidence the STRUCTURE
+    # of BASELINE configs 4/5 (per-rank memory, score parity with the
+    # single-device run, SIGKILL+resume under a mesh); configs 1-3 above
+    # cover GB scale.
+    if configs & {"dp8", "mp8"}:
+        mesh_cfg = dict(
+            vocab_size=32000,
+            hidden_size=1024,
+            intermediate_size=2816,
+            num_hidden_layers=16,
+            num_attention_heads=16,
+            num_key_value_heads=16,
+            max_position_embeddings=4096,
+        )
+        mesh_hf = os.path.join(WORK, "mesh_hf_checkpoint")
+        mesh_native = os.path.join(WORK, "mesh_native_checkpoint")
+        mesh_bytes = build_hf_checkpoint(mesh_cfg, mesh_hf)
+        result["mesh_model_gb"] = round(mesh_bytes / 1e9, 3)
+        result["mesh_config"] = mesh_cfg
+        result["mesh_platform"] = "cpu_virtual_8dev"
+        if not os.path.exists(os.path.join(mesh_native, "fls_tpu_layout.json")):
+            log("splitting mesh checkpoint ...")
+            subprocess.run(
+                [sys.executable, os.path.join(ROOT, "prepare_weights.py"),
+                 mesh_hf, mesh_native, "--dtype", "bfloat16"],
+                check=True, cwd=ROOT,
+            )
+        mesh_prompts = make_prompts(
+            n=8, prefix_words=200, suffix_words=24, n_suffix=2
+        )
+        mesh_pkl = os.path.join(WORK, "mesh_prompts.pkl")
+        with open(mesh_pkl, "wb") as f:
+            pickle.dump(mesh_prompts, f)
+
+        def mesh_argv(tag: str, storage: str, extra: list[str],
+                      resume: bool = False) -> list[str]:
+            return [
+                "--model_path", mesh_native,
+                "--prompt_pickle", mesh_pkl,
+                "--output_file", os.path.join(WORK, f"scores-{tag}.pkl"),
+                "--layer_num_per_shard", "1",
+                "--storage_location", storage,
+                "--disk_folder", DISK_DIR,
+                "--prefetch_depth", "0",
+                "--block_size", "8",
+                "--num_gen_token", "1",
+                "--resume", "true" if resume else "false",
+            ] + extra
+
+        def mesh_scores(tag: str):
+            with open(os.path.join(WORK, f"scores-{tag}.pkl"), "rb") as f:
+                return pickle.load(f)
+
+        log("mesh leg: single-device baseline ...")
+        result["mesh_single"] = run_cli(
+            mesh_argv("mesh-single", "cpu", ["--num_devices", "1"]),
+            "mesh-single", backend="cpu", virtual_devices=8,
+        )
+        base_scores = mesh_scores("mesh-single")
+
+        for leg, extra in (
+            ("dp8", ["--data_parallel", "true", "--num_devices", "8"]),
+            ("mp8", ["--data_parallel", "false", "--num_devices", "8"]),
+        ):
+            if leg not in configs:
+                continue
+            shutil.rmtree(DISK_DIR, ignore_errors=True)
+            os.makedirs(DISK_DIR, exist_ok=True)
+            marker = os.path.join(DISK_DIR, "progress-*.json")
+            log(f"mesh leg: {leg} storage=disk (killed mid-stream) ...")
+            kill_info = run_cli(
+                mesh_argv(leg, "disk", extra), f"{leg}-killed",
+                kill_after_marker=marker, kill_min_shards=4,
+                backend="cpu", virtual_devices=8,
+            )
+            log(f"mesh leg: {leg} --resume true ...")
+            t0 = time.perf_counter()
+            stats = run_cli(
+                mesh_argv(leg, "disk", extra, resume=True), f"{leg}-resumed",
+                backend="cpu", virtual_devices=8,
+            )
+            stats["resumed_after_shards"] = kill_info["completed_shards"]
+            stats["resume_wall_s"] = round(time.perf_counter() - t0, 3)
+            result[leg] = stats
+            leg_scores = mesh_scores(leg)
+            result[f"{leg}_matches_single"] = bool(
+                len(leg_scores) == len(base_scores)
+                and all(
+                    np.allclose(a, b, rtol=2e-2, atol=2e-2)
+                    for a, b in zip(base_scores, leg_scores)
+                )
+            )
+            log(
+                f"{leg}: matches_single={result[f'{leg}_matches_single']} "
+                f"stats={stats}"
             )
 
     # Per-config MFU (transfer-bound by design — read against the link

@@ -1,9 +1,31 @@
 #!/bin/bash
-# Waits for the TPU tunnel to recover, then captures the hardware evidence
-# artifacts in sequence: bench.py (which persists BENCH_TPU_latest.json on
-# any successful on-TPU run) and scale_demo.py (SCALE_r03.json). Probes in
-# a subprocess so a wedged tunnel can't hang the watcher itself.
+# Waits for the TPU tunnel to recover, then captures the round-4 hardware
+# evidence in sequence: bench.py (persists BENCH_TPU_latest/best.json on any
+# successful on-TPU run) and scale_demo.py (SCALE_r04.json, single-chip
+# configs — the dp8/mp8 mesh legs are tunnel-independent and run separately).
+# Probes in a subprocess so a wedged tunnel can't hang the watcher itself.
+# Every captured artifact is COMMITTED immediately (round 3's scale artifact
+# was lost to an always-down tunnel + no auto-commit).
 cd /root/repo
+
+ARTIFACTS="BENCH_TPU_latest.json BENCH_TPU_best.json SCALE_r04.json"
+
+commit_artifacts() {
+  # Stage each file individually: `git add a b c` is atomic on pathspec
+  # errors, so one missing artifact (SCALE before its first capture) would
+  # silently stage NOTHING. The commit is pathspec-limited so unrelated
+  # operator-staged changes never ride along.
+  for f in $ARTIFACTS; do
+    [ -f "$f" ] && git add "$f" 2>/dev/null
+  done
+  if ! git diff --cached --quiet -- $ARTIFACTS 2>/dev/null; then
+    git commit -q -m "Hardware evidence: $1" \
+      -m "Auto-committed by scripts/hw_evidence_watcher.sh the moment the capture landed (the tunnel's uptime windows are unpredictable)." \
+      -- $ARTIFACTS \
+      && echo "$(date -u +%H:%M:%S) committed: $1" >> /tmp/hw_watcher.log
+  fi
+}
+
 while true; do
   # -k: a wedged tunnel probe can ignore SIGTERM for many minutes; escalate
   # to SIGKILL so one stuck probe can't stall the whole retry loop.
@@ -12,6 +34,7 @@ while true; do
     BENCH_DEADLINE_S=2400 timeout -k 10 2700 python bench.py > /tmp/bench_hw.json 2> /tmp/bench_hw.log
     rc=$?  # save BEFORE the $(date)/$(cat) substitutions reset $?
     echo "$(date -u +%H:%M:%S) bench rc=$rc $(cat /tmp/bench_hw.json)" >> /tmp/hw_watcher.log
+    commit_artifacts "TPU bench capture"
     # Only spend scale-demo time if bench really ran on TPU *and produced a
     # number*: a deadline-partial emission carries platform=tpu with null
     # values when the tunnel wedged mid-run — following it with a 2h
@@ -21,14 +44,15 @@ while true; do
     # fold into their JSON.
     if python -c "import json,sys; d=json.load(open('/tmp/bench_hw.json')); sys.exit(0 if d.get('platform')=='tpu' and d.get('value') is not None else 1)" 2>/dev/null; then
       echo "$(date -u +%H:%M:%S) running scale_demo" >> /tmp/hw_watcher.log
-      timeout -k 10 7200 python scale_demo.py > /tmp/scale_hw.log 2>&1
+      timeout -k 10 7200 python scale_demo.py --configs cpu,tpu,disk > /tmp/scale_hw.log 2>&1
       rc=$?
-      echo "$(date -u +%H:%M:%S) scale_demo rc=$rc artifact: $(ls -la SCALE_r03.json 2>/dev/null)" >> /tmp/hw_watcher.log
+      echo "$(date -u +%H:%M:%S) scale_demo rc=$rc artifact: $(ls -la SCALE_r04.json 2>/dev/null)" >> /tmp/hw_watcher.log
+      commit_artifacts "GB-scale streaming demo (SCALE_r04)"
       # Only stop once the artifacts actually exist — a tunnel drop mid-run
       # (the very failure mode this watcher exists for) must keep retrying.
       # A CPU-fallback SCALE capture (scale_demo --backend cpu, marked
       # platform=cpu) does NOT satisfy the hardware-evidence goal.
-      if [ -f SCALE_r03.json ] && python -c "import json,sys; sys.exit(0 if json.load(open('SCALE_r03.json')).get('platform') != 'cpu' else 1)" 2>/dev/null && python -c "import json,sys; sys.exit(0 if json.load(open('BENCH_TPU_latest.json')).get('platform')=='tpu' else 1)" 2>/dev/null; then
+      if [ -f SCALE_r04.json ] && python -c "import json,sys; sys.exit(0 if json.load(open('SCALE_r04.json')).get('platform') != 'cpu' else 1)" 2>/dev/null && python -c "import json,sys; sys.exit(0 if json.load(open('BENCH_TPU_latest.json')).get('platform')=='tpu' else 1)" 2>/dev/null; then
         echo "$(date -u +%H:%M:%S) all hardware evidence captured" >> /tmp/hw_watcher.log
         exit 0
       fi
