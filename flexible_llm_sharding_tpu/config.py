@@ -248,6 +248,12 @@ class LlamaConfig:
     moe_n_group: int = 1
     moe_topk_group: int = 1
     moe_routed_scaling_factor: float = 1.0
+    # DeepSeek shared-expert width multiplier: the shared expert is ONE MLP
+    # of n_shared_experts x the routed width (V3: 1; V2/V2-Lite: 2). Forward
+    # passes take the width from the checkpoint's own shapes; this field
+    # keeps the analytic param/FLOPs accounting (utils/metrics.py) and
+    # init_mixed_params consistent with it.
+    n_shared_experts: int = 1
 
     @property
     def head_dim(self) -> int:
@@ -571,6 +577,12 @@ class LlamaConfig:
                     kwargs["moe_topk_group"] = int(d.get("topk_group", 1))
                     kwargs["moe_routed_scaling_factor"] = float(
                         d.get("routed_scaling_factor", 1.0)
+                    )
+                    nse = d.get("n_shared_experts")
+                    # Preserve an explicit 0 (shared-expert-ablated
+                    # checkpoint); only absent/None defaults to 1.
+                    kwargs["n_shared_experts"] = (
+                        1 if nse is None else int(nse)
                     )
                     first_dense = int(d.get("first_k_dense_replace", 0))
                     n = d.get("num_hidden_layers", 32)

@@ -142,6 +142,16 @@ def generation_loop(
     behaviour. ``top_k``/``top_p`` truncate the sampling distribution (only
     meaningful with temperature > 0). Sampling is deterministic given
     ``seed``.
+
+    ``model_cfg``/``max_token_len``: REQUIRED for longrope models (Phi-3
+    long-context) when callers want the upfront regime check below — pass
+    the model's ``LlamaConfig`` and the SAME ``max_token_len`` the scoring
+    executor tokenizes with (``cli.main`` does; the check re-tokenizes with
+    a fresh ``PromptTokenizer(max_token_len)``, so a mismatched cap would
+    check different lengths than the executor scores). Callers that omit
+    ``model_cfg`` still fail loudly — the executor's per-pass
+    ``check_longrope_regime`` backstops — but only mid-run, after weight
+    streams were already spent on the completed iterations.
     """
     # longrope models (``model_cfg`` supplied): per-pass scoring re-checks
     # regime uniformity, but a multi-suffix prompt whose suffix lengths

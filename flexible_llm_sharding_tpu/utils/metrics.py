@@ -307,6 +307,19 @@ def _arch_walk(cfg):
     return attn_proj, moe_pattern, dense_inter
 
 
+def _shared_expert_mult(cfg) -> int:
+    """Width of the always-on shared expert in units of the routed expert
+    width: 0 (no shared expert), 1 (llama4), or ``cfg.n_shared_experts``
+    (deepseek — ONE fused MLP of n_shared x the routed width, V2 uses 2)."""
+    if cfg.model_type == "llama4_text":
+        return 1
+    if cfg.model_type == "deepseek_v3":
+        # An explicit 0 (ablated shared expert) must stay 0.
+        v = getattr(cfg, "n_shared_experts", 1)
+        return 1 if v is None else int(v)
+    return 0
+
+
 def model_flops_per_token(cfg, context_len: int = 0) -> float:
     """Analytic forward FLOPs per processed token for a LlamaConfig.
 
@@ -329,10 +342,9 @@ def model_flops_per_token(cfg, context_len: int = 0) -> float:
     total = 0.0
     for is_moe in moe_pattern:
         if is_moe:
-            active = cfg.num_experts_per_tok + (
-                # always-on shared expert
-                1 if cfg.model_type in ("llama4_text", "deepseek_v3") else 0
-            )
+            # Always-on shared expert: width 1x for llama4, n_shared_experts x
+            # the routed width for deepseek (V2 checkpoints use 2).
+            active = cfg.num_experts_per_tok + _shared_expert_mult(cfg)
             mlp = active * 3 * h * cfg.intermediate_size + h * cfg.num_local_experts
         else:
             mlp = 3 * h * dense_inter
@@ -453,8 +465,8 @@ def param_count(cfg) -> int:
         if is_moe:
             mlp = cfg.num_local_experts * 3 * h * cfg.intermediate_size
             mlp += h * cfg.num_local_experts  # router
-            if cfg.model_type in ("llama4_text", "deepseek_v3"):  # shared
-                mlp += 3 * h * cfg.intermediate_size
+            # shared expert (llama4: 1x routed width; deepseek: n_shared x)
+            mlp += _shared_expert_mult(cfg) * 3 * h * cfg.intermediate_size
         else:
             mlp = 3 * h * dense_inter
         total += attn + mlp + 2 * h  # + the two norm scale vectors

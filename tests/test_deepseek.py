@@ -97,6 +97,44 @@ def test_deepseek_yarn_scale():
     assert cfg.attn_scale == pytest.approx(24**-0.5 * m * m)
 
 
+def test_deepseek_n_shared_experts():
+    """V2-style checkpoints (n_shared_experts=2, ADVICE r3): the field
+    parses, the analytic param/FLOPs accounting scales its shared-expert
+    term, init_mixed_params builds the wider fused shared MLP, and the
+    forward still matches HF (whose shared expert is one MLP of
+    n_shared x moe_intermediate_size)."""
+    from flexible_llm_sharding_tpu.utils.metrics import (
+        model_flops_per_token,
+        param_count,
+    )
+
+    model = _hf_deepseek(n_shared_experts=2)
+    cfg = LlamaConfig.from_hf_config(model.config.to_dict())
+    assert cfg.n_shared_experts == 2
+    cfg1 = LlamaConfig.from_hf_config(_hf_deepseek().config.to_dict())
+    n_moe = sum(cfg.moe_layer_pattern)
+    extra = 3 * cfg.hidden_size * cfg.intermediate_size * n_moe
+    assert param_count(cfg) - param_count(cfg1) == extra
+    assert model_flops_per_token(cfg) - model_flops_per_token(cfg1) == 2 * (
+        extra / n_moe
+    ) * n_moe
+
+    params = llama.init_mixed_params(jax.random.PRNGKey(0), cfg)
+    moe_layer = params["layers"][1]  # first MoE layer (pattern F,T,T)
+    assert moe_layer["mlp"]["shared_gate"].shape == (
+        cfg.hidden_size,
+        2 * cfg.intermediate_size,
+    )
+
+    hf_params = _params_from_hf(model, cfg)
+    rng = np.random.default_rng(13)
+    ids = rng.integers(0, cfg.vocab_size, size=(2, 17))
+    with torch.no_grad():
+        want = model(torch.tensor(ids)).logits.numpy()
+    got = np.asarray(llama.forward_full(hf_params, cfg, jnp.asarray(ids)))
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
 @pytest.mark.parametrize("q_lora", [32, None])
 def test_deepseek_forward_matches_hf(q_lora):
     """Monolithic forward vs HF: MLA assembly (LoRA'd and dense q),
