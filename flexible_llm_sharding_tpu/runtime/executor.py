@@ -302,9 +302,10 @@ class _HostShardLoader:
     """Host side of weight streaming: disk -> numpy segments, cast to the
     compute dtype, contiguous decoder runs pre-stacked [k, ...] for scan.
 
-    A native readahead pool (utils/native.py, C++ worker threads) warms the
-    NEXT shard's layer files into the page cache while this shard is being
-    cast/stacked, so cold-cache disk latency overlaps host compute."""
+    A native readahead pool (utils/native.py, posix_fadvise(WILLNEED) — the
+    kernel reads ahead asynchronously, ~zero CPU) warms the NEXT shard's
+    layer files into the page cache while this shard is being cast/stacked,
+    so cold-cache disk latency overlaps host compute without stealing it."""
 
     def __init__(self, model_path: str, layer_names: Sequence[str], np_dtype,
                  tied_embeddings: bool = False, layer_sliding=None,
@@ -320,13 +321,13 @@ class _HostShardLoader:
         # /root/reference/utils.py:223,304)
         from flexible_llm_sharding_tpu.utils.native import FilePrefetcher
 
-        # readahead 'auto': readahead worker threads only help when a spare
-        # core can absorb their page-cache copies; on a 1-core host they
-        # contend with the cast/stack work (measured 0.87x in bench.py's
-        # host-stream phase). 'on'/'off' force (the bench measures both).
-        from flexible_llm_sharding_tpu.utils.native import available_cpus
-
-        if readahead == "off" or (readahead == "auto" and available_cpus() <= 1):
+        # readahead warms via posix_fadvise(WILLNEED) only — async kernel
+        # readahead, ~zero CPU — so 'auto' enables it on ANY core count
+        # (the old pread-based warm stole the caster's core on 1-core
+        # hosts, measured 0.66-0.88x; fadvise-only measures 1.05x there,
+        # scripts/readahead_experiment.py). 'off' still disables for the
+        # bench's baseline arm.
+        if readahead == "off":
             self._prefetcher = None
         else:
             self._prefetcher = FilePrefetcher(threads=2)

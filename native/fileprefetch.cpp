@@ -6,8 +6,13 @@
 // Python-side prefetch thread overlaps *device* upload with compute, but the
 // cold-cache disk read itself still serialises with the numpy cast/stack
 // work on that thread. This pool warms upcoming files into the page cache
-// from native worker threads (posix_fadvise(WILLNEED) + streaming pread),
-// so by the time safetensors opens a file it reads from RAM.
+// via posix_fadvise(WILLNEED): the KERNEL schedules the readahead (DMA into
+// the page cache) asynchronously, so warming costs ~zero CPU and cannot
+// contend with the cast/stack work — measured on a 1-core host, a
+// fadvise-only warm is 1.05x on the cold cast stream where the previous
+// full-pread warm was 0.66-0.88x (it stole the caster's only core; see
+// scripts/readahead_experiment.py for the rotated-order methodology).
+// Filesystems that ignore fadvise degrade to a no-op, never to contention.
 //
 // Exposed as a plain C ABI for ctypes (no pybind11 in this environment);
 // see flexible_llm_sharding_tpu/utils/native.py for the Python wrapper and
@@ -25,8 +30,6 @@
 #include <vector>
 
 namespace {
-
-constexpr size_t kChunk = 4 << 20;  // 4 MiB streaming reads
 
 struct Pool {
   std::vector<std::thread> workers;
@@ -67,7 +70,6 @@ struct Pool {
   }
 
   void run() {
-    std::vector<char> buf(kChunk);
     for (;;) {
       std::string path;
       {
@@ -77,7 +79,7 @@ struct Pool {
         path = std::move(jobs.front());
         jobs.pop();
       }
-      warm(path.c_str(), buf.data());
+      warm(path.c_str());
       {
         std::lock_guard<std::mutex> lock(mu);
         --inflight;
@@ -86,20 +88,18 @@ struct Pool {
     }
   }
 
-  static void warm(const char* path, char* buf) {
+  static void warm(const char* path) {
     int fd = open(path, O_RDONLY);
     if (fd < 0) return;  // missing file: loader will raise a real error later
 #ifdef POSIX_FADV_WILLNEED
+    // Async kernel readahead only — NO userspace read loop. A streaming
+    // pread forces residency even where fadvise is ignored, but it copies
+    // every byte through this thread and was measured SLOWING the cold
+    // cast stream 0.66-0.88x on a 1-core host (the caster's core is the
+    // one doing the copying). fadvise costs microseconds and overlaps via
+    // DMA; where it's a no-op the loader just pays the cold read itself.
     posix_fadvise(fd, 0, 0, POSIX_FADV_WILLNEED);
 #endif
-    // Streaming read forces the pages resident even on filesystems that
-    // ignore fadvise; data is discarded (we only want the page cache warm).
-    off_t off = 0;
-    for (;;) {
-      ssize_t n = pread(fd, buf, kChunk, off);
-      if (n <= 0) break;
-      off += n;
-    }
     close(fd);
   }
 };
