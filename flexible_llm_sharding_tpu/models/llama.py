@@ -1062,10 +1062,9 @@ def init_mixed_params(rng: jax.Array, cfg: LlamaConfig, dtype=jnp.float32) -> Pa
             d, f = cfg.hidden_size, cfg.intermediate_size
             if cfg.model_type == "deepseek_v3":
                 # DeepSeek's shared expert is ONE MLP of n_shared_experts x
-                # the routed width (V2 checkpoints: 2x; explicit 0 builds
-                # zero-width weights that contribute nothing).
-                v = getattr(cfg, "n_shared_experts", 1)
-                f *= 1 if v is None else int(v)
+                # the routed width (V2 checkpoints: 2x; 0 builds zero-width
+                # weights that contribute nothing).
+                f *= cfg.n_shared_experts
             ks = jax.random.split(jax.random.fold_in(keys[i], 99), 4)
 
             def lin(key, fan_in, fan_out):

@@ -314,9 +314,9 @@ def _shared_expert_mult(cfg) -> int:
     if cfg.model_type == "llama4_text":
         return 1
     if cfg.model_type == "deepseek_v3":
-        # An explicit 0 (ablated shared expert) must stay 0.
-        v = getattr(cfg, "n_shared_experts", 1)
-        return 1 if v is None else int(v)
+        # Parse already normalized (explicit 0 preserved, absent -> 1);
+        # getattr only tolerates duck-typed test configs.
+        return int(getattr(cfg, "n_shared_experts", 1))
     return 0
 
 
