@@ -106,8 +106,9 @@ def test_convert_array_bit_exact_all_pairs():
 
 
 def test_convert_array_gates():
-    """Small arrays, same-dtype, non-float pairs, and 1-core hosts fall
-    back to numpy (None)."""
+    """Small arrays, same-dtype, and non-float pairs fall back to numpy
+    (None). No core-count gate: single-threaded native beats astype on
+    every pair (utils/native.py convert_array)."""
     import ml_dtypes
 
     bf16 = np.dtype(ml_dtypes.bfloat16)
