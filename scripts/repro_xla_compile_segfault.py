@@ -32,8 +32,12 @@ this host's cpu_aot_loader machine-feature-mismatch warnings point at the
 compile/load path, not execution). Diagnosis of record: a cumulative
 compile-path resource, not a countable executable limit; the conftest
 per-module ``jax.clear_caches()`` bounds that resource and remains the
-mitigation. Removing the fixture still reproduces at ~94% of the full
-suite — that IS the minimal known repro.
+mitigation. Confirmed fresh on this tree (2026-07-31):
+``FLS_NO_CLEAR_CACHES=1 python -m pytest tests/ -q`` → SIGSEGV (rc 139)
+at ~92% with the faulting thread inside
+``jax/_src/compiler.py:362 backend_compile_and_load`` during a pjit
+compile, while the same tree with the mitigation passes 342/342. That
+one-liner IS the minimal known repro.
 
 Usage: python scripts/repro_xla_compile_segfault.py [keep|drop|clear|suite]
            [--n 800] [--clear-every 60]
