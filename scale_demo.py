@@ -646,7 +646,10 @@ def main() -> None:
     log(f"wrote {out}")
     print(json.dumps(result))
 
-    if not args.keep:
+    if big and not args.keep:
+        # Only a run that OWNS the big-model legs may clean the big HF dir:
+        # a mesh-only invocation deleting it would force the next single-chip
+        # capture to rebuild 13+ GB from scratch.
         shutil.rmtree(HF_DIR, ignore_errors=True)
 
 
