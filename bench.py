@@ -1085,23 +1085,26 @@ def run_bench(result: dict) -> None:
     except Exception:
         log("compute-mfu accounting failed:\n" + traceback.format_exc())
 
-    # Overlap efficiency: what fraction of weight-load time was hidden under
-    # compute in the measured overlapped run (VERDICT r3 weak #1: the bench
-    # never quantified this). From the executor's own accounting:
-    # load L happens in the producer thread; the driver's stall is bounded by
-    # total_wall - compute_wall (which also contains tokenize/drain overheads,
-    # so this is a LOWER bound on the true efficiency). Serialized schedule
-    # -> stall ≈ L -> efficiency ≈ 0; perfect overlap -> stall ≈ first-shard
-    # load only -> efficiency -> 1.
+    # Overlap efficiency: what fraction of weight-produce time was hidden
+    # under compute in the measured overlapped run (VERDICT r3 weak #1: the
+    # bench never quantified this). Both terms come from the executor's own
+    # direct timers, in the same units: produce_wall_s is the producer's
+    # whole per-shard wall (host load + device placement dispatch) and
+    # source_wait_s is the driver time blocked on the producer — the part
+    # prefetch did NOT hide. Serialized schedule -> wait ≈ all of produce
+    # -> efficiency ≈ 0; perfect overlap -> wait ≈ the first shard only ->
+    # efficiency -> 1 - 1/n_shards.
     st = ex1.stats
-    L = st.get("load_weights_time_s")
-    if L:
-        stall = max(st["total_wall_s"] - st["compute_wall_s"], 0.0)
+    prod = st.get("produce_wall_s")
+    if prod:
+        stall = st["source_wait_s"]
         result["overlap_efficiency"] = round(
-            max(0.0, min(1.0, (L - stall) / L)), 3
+            max(0.0, min(1.0, (prod - stall) / prod)), 3
         )
         result["stream_seconds"] = {
-            "load_weights_s": round(L, 3),
+            "produce_wall_s": round(prod, 3),
+            "load_weights_s": round(st["load_weights_time_s"], 3),
+            "source_wait_s": round(stall, 3),
             "compute_wall_s": round(st["compute_wall_s"], 3),
             "total_wall_s": round(st["total_wall_s"], 3),
         }

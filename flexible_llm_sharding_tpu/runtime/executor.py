@@ -562,6 +562,7 @@ class ShardWeightSource:
             model_path, layer_names, np_dtype, tied_embeddings, layer_sliding,
             layer_rope,
         )
+        self.produce_time = 0.0  # set BEFORE the producer thread starts
         self._q: Queue = Queue(maxsize=max(1, prefetch_depth))
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
@@ -597,11 +598,20 @@ class ShardWeightSource:
     def _build_shard(
         self, layer_idxs: tuple[int, ...], device
     ) -> list[tuple[str, Any]]:
-        return _place(
+        # produce_time covers the producer's WHOLE per-shard wall — host
+        # file->numpy load (load_time counts just that part) plus the
+        # device placement dispatch — the denominator of bench.py's
+        # overlap_efficiency (source_wait_s over produce_wall_s compares
+        # like with like; load_time alone under-counts what overlap must
+        # hide on a slow host->HBM link).
+        t0 = time.perf_counter()
+        out = _place(
             self._loader.build_host_shard(layer_idxs),
             device,
             np_dtype=self._loader.np_dtype,
         )
+        self.produce_time += time.perf_counter() - t0
+        return out
 
     # -- prefetch thread ---------------------------------------------------
     def _put(self, item) -> bool:
@@ -972,9 +982,9 @@ class StreamingExecutor:
                     store.flush()
                     self._mark_progress(store, sig, done)
 
-        compute_time = 0.0
+        compute_time = source_wait = 0.0
         try:
-            compute_time = self._stream(
+            compute_time, source_wait = self._stream(
                 source,
                 store,
                 toks,
@@ -1005,6 +1015,19 @@ class StreamingExecutor:
         self.stats = {
             "load_weights_time_s": source.load_time,
             "compute_wall_s": compute_time,
+            # Driver time blocked waiting on the weight source: the produce
+            # time prefetch did NOT hide (serialized schedule -> ~all of
+            # produce_wall_s; perfect overlap -> the first shard only).
+            "source_wait_s": source_wait,
+            # The producer's whole per-shard wall (host load + device
+            # placement dispatch) — overlap_efficiency's denominator.
+            # Absent on shared (broadcast) sources, whose producer serves
+            # every rank at once.
+            **(
+                {"produce_wall_s": source.produce_time}
+                if getattr(source, "produce_time", None) is not None
+                else {}
+            ),
             "total_wall_s": time.perf_counter() - t_start,
             "num_layers_streamed": float(self.plan.num_local_layers),
             "tokens_processed": float(sum(t.tokens_processed for t in toks)),
@@ -1038,18 +1061,30 @@ class StreamingExecutor:
         n_shards: int | None = None,
         skip: int = 0,
         start_shard: int = 0,
-    ) -> float:
+    ) -> tuple[float, float]:
         n_layers = len(self.layer_names)
         compute_time = 0.0
+        source_wait = 0.0  # driver time blocked on the weight source — the
+        # exact NOT-hidden load time (prefetch hides the rest); the
+        # numerator of bench.py's overlap_efficiency
         total = (n_shards or len(self.plan.shards)) * max(len(blocks), 1)
         bar = metrics.progress_bar(total, desc="stream", unit="blk")
+        it = enumerate(source)
         try:
-            for shard_i, (layer_idxs, segments) in enumerate(source):
+            while True:
+                t_wait = time.perf_counter()
+                try:
+                    shard_i, (layer_idxs, segments) = next(it)
+                except StopIteration:
+                    break
                 if shard_i < skip:
                     # Resume over a shared source: this shard already ran in
                     # the crashed attempt; drop its broadcast weights unused.
+                    # Its wait is NOT counted against overlap efficiency —
+                    # skipped shards run no compute that could hide it.
                     del segments
                     continue
+                source_wait += time.perf_counter() - t_wait
                 # Global shard index: shared sources yield every shard from
                 # 0 (skip consumed the resumed prefix); an own source yields
                 # only the resumed tail.
@@ -1088,7 +1123,7 @@ class StreamingExecutor:
                     on_shard_done(shard_i)
         finally:
             bar.close()
-        return compute_time
+        return compute_time, source_wait
 
 
 __all__ = [
