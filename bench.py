@@ -76,6 +76,7 @@ HEADLINE_KEYS = (
     "vs_baseline_inconclusive",
     "overlap_pair_ratios",
     "overlap_efficiency",
+    "overlap_efficiency_forced",
     "stream_seconds",
     "vs_reference_schedule",
     "vs_reference_schedule_spread",
@@ -533,6 +534,15 @@ def bench_host_stream(result: dict, model_path: str, budget_left) -> None:
         )
     except Exception:
         log("host stream bench failed:\n" + traceback.format_exc())
+
+
+def _overlap_efficiency(stats: dict) -> float | None:
+    """1 - source_wait/produce from an executor's stats — the fraction of
+    weight-produce time hidden under compute (None without the timers)."""
+    prod = stats.get("produce_wall_s")
+    if not prod:
+        return None
+    return max(0.0, min(1.0, (prod - stats["source_wait_s"]) / prod))
 
 
 def _ratio_stats(result: dict, key: str, ratios) -> None:
@@ -1095,16 +1105,13 @@ def run_bench(result: dict) -> None:
     # -> efficiency ≈ 0; perfect overlap -> wait ≈ the first shard only ->
     # efficiency -> 1 - 1/n_shards.
     st = ex1.stats
-    prod = st.get("produce_wall_s")
-    if prod:
-        stall = st["source_wait_s"]
-        result["overlap_efficiency"] = round(
-            max(0.0, min(1.0, (prod - stall) / prod)), 3
-        )
+    eff = _overlap_efficiency(st)
+    if eff is not None:
+        result["overlap_efficiency"] = round(eff, 3)
         result["stream_seconds"] = {
-            "produce_wall_s": round(prod, 3),
+            "produce_wall_s": round(st["produce_wall_s"], 3),
             "load_weights_s": round(st["load_weights_time_s"], 3),
-            "source_wait_s": round(stall, 3),
+            "source_wait_s": round(st["source_wait_s"], 3),
             "compute_wall_s": round(st["compute_wall_s"], 3),
             "total_wall_s": round(st["total_wall_s"], 3),
         }
@@ -1130,6 +1137,23 @@ def run_bench(result: dict) -> None:
         result["vs_baseline"] = 1.0
         result["schedules_identical"] = True
         _ratio_stats(result, "measured_ratio", nf_ratios)
+        # Even where the platform schedule is serialized (no transfer link
+        # to hide, so auto prefetch = 0), one FORCED-prefetch rep records
+        # the overlap machinery's own efficiency — the driver is ~never
+        # blocked on the producer regardless of platform (measured 0.91-0.95
+        # here vs 0.000 serialized). Budget-gated like every optional phase.
+        if budget_left() > 0.5:
+            try:
+                _, _, ex_f = run_once(fw(2), prompts, tok)
+                eff_f = _overlap_efficiency(ex_f.stats)
+                if eff_f is not None:
+                    result["overlap_efficiency_forced"] = round(eff_f, 3)
+                    log(
+                        "forced-prefetch overlap efficiency: "
+                        f"{result['overlap_efficiency_forced']}"
+                    )
+            except Exception:
+                log("forced-prefetch rep failed:\n" + traceback.format_exc())
     else:
         # PAIRED serialized-vs-overlapped reps. The axon tunnel's bandwidth
         # swings ~10x minute-to-minute (observed 0.02-0.24 GB/s within one
