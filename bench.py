@@ -731,7 +731,9 @@ def bench_reference_schedule(
             break
 
 
-def bench_resident_mfu(jax, result: dict, budget_left) -> None:
+def bench_resident_mfu(
+    jax, result: dict, budget_left, cfg=None, B=4, T=2048, iters=8
+) -> None:
     """Compute-bound MFU with HBM-resident weights (VERDICT r3 weak #2:
     every earlier TPU capture measured the tunnel link, not the chip —
     mfu 0.000348 said nothing about kernel/compiler quality).
@@ -764,16 +766,16 @@ def bench_resident_mfu(jax, result: dict, budget_left) -> None:
     if peak is None:
         log("resident MFU: unknown chip peak FLOP/s; skipping")
         return
-    cfg = LlamaConfig(
-        vocab_size=32000,
-        hidden_size=4096,
-        intermediate_size=11008,
-        num_hidden_layers=4,
-        num_attention_heads=32,
-        num_key_value_heads=32,
-        max_position_embeddings=4096,
-    )
-    B, T, iters = 4, 2048, 8
+    if cfg is None:  # the production shape; tests pass a tiny one
+        cfg = LlamaConfig(
+            vocab_size=32000,
+            hidden_size=4096,
+            intermediate_size=11008,
+            num_hidden_layers=4,
+            num_attention_heads=32,
+            num_key_value_heads=32,
+            max_position_embeddings=4096,
+        )
     params = llama.init_params(jax.random.PRNGKey(7), cfg, dtype=jnp.bfloat16)
     ids = jax.device_put(
         np.asarray(

@@ -59,6 +59,38 @@ def bench_model(tmp_path, monkeypatch):
     return bench.make_model(jax, cfg_kwargs)
 
 
+def test_resident_mfu_phase(monkeypatch):
+    """The resident-MFU phase is TPU-gated in production (chip_peak_flops
+    is None on CPU) and so would otherwise first EXECUTE on a rare real
+    capture window — where an exception is logged-and-lost. Run its whole
+    machinery here with a faked chip peak and a tiny model."""
+    import jax
+
+    from flexible_llm_sharding_tpu import config as cfg_mod
+    from flexible_llm_sharding_tpu.utils import metrics
+
+    # bench_resident_mfu binds chip_peak_flops at call time via a local
+    # from-import, so patching the metrics module attribute takes effect.
+    monkeypatch.setattr(metrics, "chip_peak_flops", lambda dev=None: 1e12)
+    tiny = cfg_mod.LlamaConfig(
+        vocab_size=256,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=4,
+        max_position_embeddings=512,
+    )
+    result = {}
+    bench.bench_resident_mfu(
+        jax, result, lambda: 1.0, cfg=tiny, B=2, T=64, iters=2
+    )
+    assert result["mfu_resident"] > 0
+    assert result["resident_tokens_per_sec"] > 0
+    assert result["resident_pass_s"] > 0
+    assert result["resident_model_flops_per_token"] > 0
+
+
 def test_reference_schedule_matches_executor(bench_model):
     """The reference-schedule emulation (per-tensor sync uploads, no scan,
     per-prompt loop, host activation round-trips) must produce the SAME
