@@ -634,14 +634,22 @@ def prefix_suffix_layer(
     # Under tensor parallelism (``tp_mesh``) the kernels run per head-shard
     # via shard_map, so eligibility is checked on PER-SHARD head counts.
     tp_size = tp_mesh.shape["tp"] if tp_mesh is not None else 1
-    # MLA (kv_lora_rank): distinct q/k vs v head dims — the flash kernels
-    # assume one head dim, so MLA always takes the XLA ops.
-    flash = use_pallas and not cfg.kv_lora_rank and pallas_attention.supports(
+    # MLA (kv_lora_rank) rides the flash path too: the scoring kernels
+    # carry q/k's head dim and V's own dim independently (QK^T over
+    # head_dim, PV over v_dim) — positioned_qkv hands them per-head
+    # decompressed K (nope + shared rope key) and V, so the EFFECTIVE kv
+    # head count is the attention head count (GQA ratio 1), whatever the
+    # config's num_key_value_heads field says.
+    n_kv_eff = (
+        cfg.num_attention_heads if cfg.kv_lora_rank else cfg.num_key_value_heads
+    )
+    flash = use_pallas and pallas_attention.supports(
         cfg.num_attention_heads // tp_size,
-        cfg.num_key_value_heads // tp_size,
+        n_kv_eff // tp_size,
         cfg.head_dim,
         ls,
         lp,
+        v_dim=cfg.v_dim,
     )
 
     # --- prefix: causal self-attention, keep post-RoPE KV ---

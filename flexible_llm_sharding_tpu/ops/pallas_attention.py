@@ -66,14 +66,26 @@ def _block(n: int, cap: int) -> int:
     return n
 
 
-def supports(n_q: int, n_kv: int, head_dim: int, lq: int, lk: int) -> bool:
+def supports(
+    n_q: int, n_kv: int, head_dim: int, lq: int, lk: int, v_dim: int | None = None
+) -> bool:
     """Kernel eligibility: whole query groups and bucketed q/k lengths.
     Ragged head dims >= 64 (phi3's 96) are zero-padded to the lane multiple
     inside the wrappers — exact, since zero channels contribute nothing to
     QK^T and the padded V channels are sliced off, and the pad costs at most
     2x lanes. Tinier head dims fall back to XLA (an 8x pad would waste more
-    MXU/bandwidth than the kernel saves)."""
-    return n_q % n_kv == 0 and lq % 64 == 0 and lk % 64 == 0 and head_dim >= 64
+    MXU/bandwidth than the kernel saves). ``v_dim``: V's own head dim where
+    it differs from q/k's (MLA: qk 192 vs v 128) — the scoring kernels carry
+    the two dims independently (QK^T over head_dim, PV over v_dim)."""
+    if v_dim is None:
+        v_dim = head_dim
+    return (
+        n_q % n_kv == 0
+        and lq % 64 == 0
+        and lk % 64 == 0
+        and head_dim >= 64
+        and v_dim >= 64
+    )
 
 
 def _pad_head_dim(*arrays):
@@ -150,11 +162,13 @@ def _causal_kernel(
     flags_ref, q_ref, k_ref, v_ref, o_ref, *, scale, lk, bk, window, chunk,
     softcap,
 ):
-    # Head-major blocks: q_ref [1, bq, hd]; k_ref/v_ref [1, lk, hd]. The TPU
-    # lowering constrains only the last two block dims, so the head axis must
-    # lead with block size 1.
+    # Head-major blocks: q_ref [1, bq, hd]; k_ref [1, lk, hd]; v_ref
+    # [1, lk, dv] (dv == hd except MLA, where V has its own head dim). The
+    # TPU lowering constrains only the last two block dims, so the head axis
+    # must lead with block size 1.
     qb = pl.program_id(1)
-    _, bq, hd = q_ref.shape
+    _, bq, _ = q_ref.shape
+    dv = v_ref.shape[-1]
     q = q_ref[0]
     plen = flags_ref[0]
     local_on = flags_ref[1] != 0
@@ -162,7 +176,7 @@ def _causal_kernel(
 
     m = jnp.full((bq, 1), _NEG_INF, jnp.float32)
     l = jnp.zeros((bq, 1), jnp.float32)
-    acc = jnp.zeros((bq, hd), jnp.float32)
+    acc = jnp.zeros((bq, dv), jnp.float32)
 
     def body(blk, carry):
         m, l, acc = carry
@@ -206,8 +220,9 @@ def flash_causal_attention(
     q, k, v, valid_len, scale=None, window=None, chunk=None, softcap=None,
     local_on=None, interpret=None,
 ):
-    """q [L, n_q, hd], k/v [L, n_kv, hd], valid_len int32 scalar ->
-    [L, n_q, hd]. Query i attends keys j with j <= i and j < valid_len,
+    """q [L, n_q, hd], k [L, n_kv, hd], v [L, n_kv, dv], valid_len int32
+    scalar -> [L, n_q, dv] (dv == hd everywhere but MLA, whose V has its
+    own head dim). Query i attends keys j with j <= i and j < valid_len,
     optionally restricted to a sliding ``window`` / position ``chunk``
     (``local_on``: traced per-layer toggle, None = on)."""
     if interpret is None:
@@ -218,8 +233,10 @@ def flash_causal_attention(
     lk, n_kv, _ = k.shape
     if scale is None:
         scale = 1.0 / (hd**0.5)
-    (q, k, v), hd_true = _pad_head_dim(q, k, v)
-    hd = q.shape[-1]
+    # q/k pad together (QK^T dim); v pads on its OWN dim (MLA: 192 vs 128).
+    (q, k), _ = _pad_head_dim(q, k)
+    (v,), dv_true = _pad_head_dim(v)
+    hd, dv = q.shape[-1], v.shape[-1]
     bq = _block(lq, _MAX_BLOCK_Q)
     bk = _block(lk, _MAX_BLOCK_K)
     grid = (n_q, lq // bq)
@@ -237,11 +254,11 @@ def flash_causal_attention(
             in_specs=[
                 pl.BlockSpec((1, bq, hd), lambda h, qb, flags: (h, qb, 0)),
                 pl.BlockSpec((1, lk, hd), kv_head),
-                pl.BlockSpec((1, lk, hd), kv_head),
+                pl.BlockSpec((1, lk, dv), kv_head),
             ],
-            out_specs=pl.BlockSpec((1, bq, hd), lambda h, qb, flags: (h, qb, 0)),
+            out_specs=pl.BlockSpec((1, bq, dv), lambda h, qb, flags: (h, qb, 0)),
         ),
-        out_shape=jax.ShapeDtypeStruct((n_q, lq, hd), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((n_q, lq, dv), q.dtype),
         interpret=interpret,
     )(
         _flags(valid_len, local_on),
@@ -249,7 +266,7 @@ def flash_causal_attention(
         k.transpose(1, 0, 2),
         v.transpose(1, 0, 2),
     )
-    return out.transpose(1, 0, 2)[..., :hd_true]
+    return out.transpose(1, 0, 2)[..., :dv_true]
 
 
 # ---------------------------------------------------------------------------
@@ -260,10 +277,12 @@ def _prefix_shared_kernel(
     flags_ref, q_ref, kp_ref, vp_ref, ks_ref, vs_ref, o_ref, *, scale, lp,
     bkp, window, chunk, softcap,
 ):
-    # Head-major blocks: q_ref [1, 1, bq, hd]; kp_ref/vp_ref [1, lp, hd];
-    # ks_ref/vs_ref [1, 1, ls, hd].
+    # Head-major blocks: q_ref [1, 1, bq, hd]; kp_ref [1, lp, hd]; vp_ref
+    # [1, lp, dv]; ks_ref [1, 1, ls, hd]; vs_ref [1, 1, ls, dv] (dv == hd
+    # except MLA, where V has its own head dim).
     qb = pl.program_id(2)
-    _, _, bq, hd = q_ref.shape
+    _, _, bq, _ = q_ref.shape
+    dv = vp_ref.shape[-1]
     q = q_ref[0, 0]
     plen = flags_ref[0]
     local_on = flags_ref[1] != 0
@@ -274,7 +293,7 @@ def _prefix_shared_kernel(
 
     m = jnp.full((bq, 1), _NEG_INF, jnp.float32)
     l = jnp.zeros((bq, 1), jnp.float32)
-    acc = jnp.zeros((bq, hd), jnp.float32)
+    acc = jnp.zeros((bq, dv), jnp.float32)
 
     # Prefix KV: visible iff the key is real (j < plen); no causality.
     def p_body(blk, carry):
@@ -321,11 +340,13 @@ def flash_prefix_shared_attention(
 ):
     """Kernel form of ``ops.attention.prefix_shared_attention``.
 
-    q [S, Ls, n_q, hd]; k_prefix/v_prefix [Lp, n_kv, hd] (SHARED across all
-    suffixes); k_suffix/v_suffix [S, Ls, n_kv, hd]; prefix_len int32 scalar.
+    q [S, Ls, n_q, hd]; k_prefix [Lp, n_kv, hd] / v_prefix [Lp, n_kv, dv]
+    (SHARED across all suffixes); k_suffix [S, Ls, n_kv, hd] / v_suffix
+    [S, Ls, n_kv, dv]; prefix_len int32 scalar. dv == hd everywhere but
+    MLA, whose V has its own head dim.
     ``window``/``chunk``/``softcap``/``scale`` mirror the XLA op;
     ``local_on`` is the traced per-layer local toggle (None = on).
-    Returns [S, Ls, n_q, hd].
+    Returns [S, Ls, n_q, dv].
     """
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
@@ -333,10 +354,10 @@ def flash_prefix_shared_attention(
     lp, n_kv, _ = k_prefix.shape
     if scale is None:
         scale = 1.0 / (hd**0.5)
-    (q, k_prefix, v_prefix, k_suffix, v_suffix), hd_true = _pad_head_dim(
-        q, k_prefix, v_prefix, k_suffix, v_suffix
-    )
-    hd = q.shape[-1]
+    # q/k pad together (QK^T dim); v pads on its OWN dim (MLA: 192 vs 128).
+    (q, k_prefix, k_suffix), _ = _pad_head_dim(q, k_prefix, k_suffix)
+    (v_prefix, v_suffix), dv_true = _pad_head_dim(v_prefix, v_suffix)
+    hd, dv = q.shape[-1], v_prefix.shape[-1]
     bq = _block(ls, _MAX_BLOCK_Q)
     bkp = _block(lp, _MAX_BLOCK_K)
     grid = (s, n_q, ls // bq)
@@ -356,13 +377,13 @@ def flash_prefix_shared_attention(
             in_specs=[
                 pl.BlockSpec((1, 1, bq, hd), q_map),
                 pl.BlockSpec((1, lp, hd), kv_head),
-                pl.BlockSpec((1, lp, hd), kv_head),
+                pl.BlockSpec((1, lp, dv), kv_head),
                 pl.BlockSpec((1, 1, ls, hd), skv_head),
-                pl.BlockSpec((1, 1, ls, hd), skv_head),
+                pl.BlockSpec((1, 1, ls, dv), skv_head),
             ],
-            out_specs=pl.BlockSpec((1, 1, bq, hd), q_map),
+            out_specs=pl.BlockSpec((1, 1, bq, dv), q_map),
         ),
-        out_shape=jax.ShapeDtypeStruct((s, n_q, ls, hd), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((s, n_q, ls, dv), q.dtype),
         interpret=interpret,
     )(
         _flags(prefix_len, local_on),
@@ -372,7 +393,7 @@ def flash_prefix_shared_attention(
         k_suffix.transpose(0, 2, 1, 3),
         v_suffix.transpose(0, 2, 1, 3),
     )
-    return out.transpose(0, 2, 1, 3)[..., :hd_true]
+    return out.transpose(0, 2, 1, 3)[..., :dv_true]
 
 
 # ---------------------------------------------------------------------------

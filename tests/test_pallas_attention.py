@@ -81,6 +81,90 @@ def test_flash_ragged_head_dim(hd):
     np.testing.assert_allclose(np.asarray(got_d), np.asarray(want_d), rtol=2e-5, atol=2e-5)
 
 
+def test_flash_distinct_v_dim():
+    """MLA shapes: q/k at one head dim, V at its own — the scoring kernels
+    carry the two dims independently (QK^T over hd, PV over dv), so
+    DeepSeek's 192-qk/128-v heads ride the flash path."""
+    rng = np.random.default_rng(12)
+    s, ls, n_q, n_kv, lp, plen = 2, 64, 4, 4, 128, 90
+    hd, dv = 96, 64
+
+    q = _rand(rng, s, ls, n_q, hd)
+    kp = _rand(rng, lp, n_kv, hd)
+    vp = _rand(rng, lp, n_kv, dv)
+    ks = _rand(rng, s, ls, n_kv, hd)
+    vs = _rand(rng, s, ls, n_kv, dv)
+    got = flash_prefix_shared_attention(q, kp, vp, ks, vs, plen, interpret=True)
+    assert got.shape == (s, ls, n_q, dv)
+    want = prefix_shared_attention(q, kp, vp, ks, vs, jnp.int32(plen))
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5
+    )
+
+    qc = _rand(rng, lp, n_q, hd)
+    got_c = flash_causal_attention(qc, kp, vp, plen, interpret=True)
+    assert got_c.shape == (lp, n_q, dv)
+    kj = jnp.arange(lp)[None, :]
+    want_c = attention(qc, kp, vp, causal_mask(lp, lp) & (kj < plen))
+    np.testing.assert_allclose(
+        np.asarray(got_c)[:plen], np.asarray(want_c)[:plen],
+        rtol=2e-5, atol=2e-5,
+    )
+
+
+def test_flash_mla_layer_parity():
+    """End-to-end: a DeepSeek-style MLA decoder layer under use_pallas
+    equals the XLA path — the flash eligibility gate now admits distinct
+    qk/v head dims (per-head decompressed K carries the shared rope key,
+    GQA ratio 1)."""
+    from flexible_llm_sharding_tpu.config import LlamaConfig
+    from flexible_llm_sharding_tpu.models import llama
+
+    cfg = LlamaConfig(
+        model_type="deepseek_v3",
+        vocab_size=256,
+        hidden_size=128,
+        intermediate_size=128,
+        num_hidden_layers=1,
+        num_attention_heads=4,
+        num_key_value_heads=4,
+        kv_lora_rank=32,
+        q_lora_rank=32,
+        qk_nope_head_dim=64,
+        qk_rope_head_dim=32,  # qk head_dim 96, v 64 — both flash-eligible
+        v_head_dim=64,
+        rope_interleaved=True,
+        query_pre_attn_scalar=96.0,
+        max_position_embeddings=512,
+    )
+    params = llama.init_layer_params(jax.random.PRNGKey(0), cfg)
+    lp, s, ls = 128, 2, 64
+    rng = np.random.default_rng(3)
+    ph = jnp.asarray(rng.standard_normal((lp, cfg.hidden_size)), jnp.float32)
+    sh = jnp.asarray(
+        rng.standard_normal((s, ls, cfg.hidden_size)), jnp.float32
+    )
+    plen = 100
+    want = llama.prefix_suffix_layer(
+        params, cfg, ph, sh, jnp.int32(plen), use_pallas=False
+    )
+    got = llama.prefix_suffix_layer(
+        params, cfg, ph, sh, jnp.int32(plen), use_pallas=True
+    )
+    # Prefix PADDING rows (i >= plen) legitimately differ: the kernel clamps
+    # keys at plen where the XLA prefix pass doesn't mask padding queries —
+    # their values are never consumed downstream (next layer's KV at those
+    # positions is masked by kj < plen). Same comparison rule as
+    # test_flash_causal_matches_xla. Suffix rows compare in full.
+    np.testing.assert_allclose(
+        np.asarray(got[0])[:plen], np.asarray(want[0])[:plen],
+        rtol=2e-5, atol=2e-5,
+    )
+    np.testing.assert_allclose(
+        np.asarray(got[1]), np.asarray(want[1]), rtol=2e-5, atol=2e-5
+    )
+
+
 @pytest.mark.parametrize("n_q,n_kv", [(4, 4), (8, 2)])
 @pytest.mark.parametrize("valid", [192, 64, 1])
 def test_flash_causal_matches_xla(n_q, n_kv, valid):
