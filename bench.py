@@ -89,6 +89,7 @@ HEADLINE_KEYS = (
     "int8_speedup_spread",
     "int8_speedup_inconclusive",
     "pallas_speedup_4k",
+    "pallas_mla_speedup_4k",
     "pallas_decode_speedup",
     "decode_speedup_4tok",
     "decode_score_maxerr",
@@ -357,6 +358,29 @@ def bench_pallas(jax, result: dict) -> None:
     )
     log(f"attention 4k: xla={t_xla*1e3:.2f}ms flash={t_flash*1e3:.2f}ms")
     result["pallas_speedup_4k"] = round(t_xla / t_flash, 3)
+
+    # MLA shapes (DeepSeek-V3: qk 192, v 128 — distinct dims ride the flash
+    # path since r4): the kernel pays a 256-lane pad on QK^T but never
+    # materialises the [Lq, Lk] scores the XLA op spills at 4k.
+    hd_qk, hd_v = 192, 128
+    if supports(n_q, n_kv, hd_qk, ls, lp, v_dim=hd_v):
+        ks2 = jax.random.split(jax.random.PRNGKey(1), 5)
+        qm = jax.random.normal(ks2[0], (s, ls, n_q, hd_qk), jnp.bfloat16)
+        kpm = jax.random.normal(ks2[1], (lp, n_kv, hd_qk), jnp.bfloat16)
+        vpm = jax.random.normal(ks2[2], (lp, n_kv, hd_v), jnp.bfloat16)
+        ksm = jax.random.normal(ks2[3], (s, ls, n_kv, hd_qk), jnp.bfloat16)
+        vsm = jax.random.normal(ks2[4], (s, ls, n_kv, hd_v), jnp.bfloat16)
+        t_xla_m = timed(
+            lambda: prefix_shared_attention(qm, kpm, vpm, ksm, vsm, plen)
+        )
+        t_flash_m = timed(
+            lambda: flash_prefix_shared_attention(qm, kpm, vpm, ksm, vsm, plen)
+        )
+        log(
+            f"MLA attention 4k: xla={t_xla_m*1e3:.2f}ms "
+            f"flash={t_flash_m*1e3:.2f}ms"
+        )
+        result["pallas_mla_speedup_4k"] = round(t_xla_m / t_flash_m, 3)
 
 
 def bench_decode(cfg_obj, prompts, tok, result: dict, n_tok: int = 4) -> None:

@@ -187,6 +187,59 @@ def test_tp_pallas_flash(tmp_path_factory):
         np.testing.assert_allclose(c, a, rtol=2e-5, atol=2e-6)
 
 
+def test_tp_pallas_flash_mla(tmp_path_factory):
+    """MLA under the TP flash path: since the kernels carry distinct qk/v
+    head dims (r4), a DeepSeek-style config is flash-eligible and the
+    shard_map wrappers run it per head-shard with dv != hd — the
+    combination no other test reaches (test_tp_deepseek_mla's qk dim 24
+    falls back to XLA). Must match the XLA path and single-device flash."""
+    from flexible_llm_sharding_tpu.config import LlamaConfig
+
+    cfg = LlamaConfig(
+        model_type="deepseek_v3",
+        vocab_size=128,
+        hidden_size=128,
+        intermediate_size=192,
+        num_hidden_layers=2,
+        num_attention_heads=2,
+        num_key_value_heads=2,
+        kv_lora_rank=32,
+        q_lora_rank=32,
+        qk_nope_head_dim=64,
+        qk_rope_head_dim=32,  # qk 96, v 64: flash-eligible, distinct dims
+        v_head_dim=64,
+        rope_interleaved=True,
+        query_pre_attn_scalar=96.0,
+        max_position_embeddings=512,
+    )
+    params = llama.init_params(jax.random.PRNGKey(2), cfg)
+    d = tmp_path_factory.mktemp("pallas_tp_mla_model")
+    save_params(jax.tree.map(np.asarray, params), str(d), cfg)
+
+    def run(**kw):
+        c = FrameworkConfig(
+            model_path=str(d),
+            layer_num_per_shard=2,
+            storage_location="cpu",
+            dtype="float32",
+            bucket_multiple=64,
+            block_size=2,
+            prefetch_depth=0,
+            **kw,
+        )
+        n = kw.get("tensor_parallel", 1)
+        return run_prompts(
+            c, PROMPTS[:2], tokenizer=FakeTokenizer(), devices=jax.devices()[:n]
+        )
+
+    want = run(use_pallas=False)
+    got_flash = run(use_pallas=True)
+    got_tp = run(use_pallas=True, tensor_parallel=2)
+    for a, b, c in zip(want, got_flash, got_tp):
+        np.testing.assert_allclose(b, a, rtol=2e-5, atol=2e-6)
+        np.testing.assert_allclose(c, a, rtol=2e-5, atol=2e-6)
+
+
 def _mixed_moe_model(tmp_path_factory, name: str, cfg):
     """Build + save a mixed dense/MoE native checkpoint (the structure
     llama4 / qwen3_moe's dense interleave produce from real weights)."""
