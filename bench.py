@@ -88,6 +88,9 @@ HEADLINE_KEYS = (
     "int8_speedup",
     "int8_speedup_spread",
     "int8_speedup_inconclusive",
+    "int4_speedup",
+    "int4_speedup_spread",
+    "int4_speedup_inconclusive",
     "pallas_speedup_4k",
     "pallas_mla_speedup_4k",
     "pallas_decode_speedup",
@@ -1232,48 +1235,59 @@ def run_bench(result: dict) -> None:
         return
 
     try:
-        # int8 weight streaming: same workload, half the bytes over the
-        # host->HBM link (the binding constraint of this design) with
-        # on-device dequant. The ratio quantifies the opt-in
-        # transfer-compression mode. TPU-only (the early return above):
-        # on CPU the number arrives via the embedded tpu_capture instead.
+        # int8/int4 weight streaming: same workload, half / a quarter of
+        # the bytes over the host->HBM link (the binding constraint of this
+        # design) with on-device dequant. The ratios quantify the opt-in
+        # transfer-compression modes. TPU-only (the early return above):
+        # on CPU the numbers arrive via the embedded tpu_capture instead.
         from flexible_llm_sharding_tpu.utils.checkpoint import (
             NATIVE_LAYOUT_MARKER,
             requantize_native,
         )
 
-        q8_path = model_path + "-int8"
-        # The layout marker is written LAST by requantize_native, so a
-        # killed/partial conversion never looks complete; rebuild from
-        # scratch in that case rather than streaming a broken dir.
-        marker = os.path.join(q8_path, NATIVE_LAYOUT_MARKER)
-        if not os.path.exists(marker):
-            import shutil
-
-            shutil.rmtree(q8_path, ignore_errors=True)
-            requantize_native(model_path, q8_path)
         import dataclasses
+        import shutil
 
-        q8_cfg = dataclasses.replace(fw(2), model_path=q8_path)
-        run_once(q8_cfg, prompts, tok)  # warm/compile
+        def quant_cfg(qdtype: str):
+            qpath = f"{model_path}-{qdtype}"
+            # The layout marker is written LAST by requantize_native, so a
+            # killed/partial conversion never looks complete; rebuild from
+            # scratch in that case rather than streaming a broken dir.
+            if not os.path.exists(os.path.join(qpath, NATIVE_LAYOUT_MARKER)):
+                shutil.rmtree(qpath, ignore_errors=True)
+                requantize_native(model_path, qpath, dtype=qdtype)
+            return dataclasses.replace(fw(2), model_path=qpath)
+
         # Paired with fresh bf16 runs (same rationale as the schedule
         # pairs: the tunnel's speed drifts too much to reuse an earlier
         # bf16 wall measured minutes ago).
         # 3 pairs so the median can actually REJECT a link-flip outlier
         # (the median of 2 is their mean — no rejection at all).
-        i8_ratios = []
-        for i in range(3):
-            _, wall_q8, _ = run_once(q8_cfg, prompts, tok)
-            _, w_bf16, _ = run_once(cfg_default, prompts, tok)
-            i8_ratios.append(w_bf16 / wall_q8)
-            log(f"int8 pair {i}: q8={wall_q8:.2f}s bf16={w_bf16:.2f}s "
-                f"ratio={i8_ratios[-1]:.3f}")
-            _ratio_stats(result, "int8_speedup", i8_ratios)
-            if budget_left() < 0.35:
-                log("int8 pair budget exhausted; stopping reps")
-                break
+        for qdtype, key, floor in (
+            ("int8", "int8_speedup", 0.35),
+            ("int4", "int4_speedup", 0.28),
+        ):
+            if budget_left() < floor:
+                log(f"skipping {qdtype} bench (deadline budget exhausted)")
+                continue
+            try:  # per-dtype isolation: an int8 failure must not kill int4
+                qc = quant_cfg(qdtype)
+                run_once(qc, prompts, tok)  # warm/compile
+                ratios = []
+                for i in range(3):
+                    _, wall_q, _ = run_once(qc, prompts, tok)
+                    _, w_bf16, _ = run_once(cfg_default, prompts, tok)
+                    ratios.append(w_bf16 / wall_q)
+                    log(f"{qdtype} pair {i}: q={wall_q:.2f}s "
+                        f"bf16={w_bf16:.2f}s ratio={ratios[-1]:.3f}")
+                    _ratio_stats(result, key, ratios)
+                    if budget_left() < floor:
+                        log(f"{qdtype} pair budget exhausted; stopping reps")
+                        break
+            except Exception:
+                log(f"{qdtype} bench failed:\n" + traceback.format_exc())
     except Exception:
-        log("int8 bench failed:\n" + traceback.format_exc())
+        log("quantized bench setup failed:\n" + traceback.format_exc())
 
     if on_tpu:
         try:

@@ -357,18 +357,24 @@ class _HostShardLoader:
             emb = checkpoint.load_layer(self.model_path, "model.embed_tokens")
             e = emb["embedding"]
             if checkpoint.is_quantized_leaf(e):
-                # int8 checkpoints carry per-D scales on [V, D]; the head
-                # kernel [D, V] needs per-V channels, so requantize the
-                # transpose to keep the transfer int8 (second quantization
-                # of already-quantized values — error stays at the int8
-                # level). Cached: weights are immutable for the loader's
-                # lifetime, and the decode loop re-streams lm_head every
-                # token — a dequant+transpose+requant of [V, D] per token
-                # would land on the hot path.
-                q, s = checkpoint._quantize_int8(
-                    np.ascontiguousarray(checkpoint.dequantize_np(e).T)
-                )
-                self._tied_head = {"kernel": {"q8": q, "s": s}}
+                # Quantized checkpoints carry scales laid out for [V, D];
+                # the head kernel [D, V] needs the transposed layout, so
+                # requantize the transpose to keep the transfer narrow
+                # (second quantization of already-quantized values — error
+                # stays at the quantization level). Cached: weights are
+                # immutable for the loader's lifetime, and the decode loop
+                # re-streams lm_head every token — a dequant+transpose+
+                # requant of [V, D] per token would land on the hot path.
+                deq = np.ascontiguousarray(checkpoint.dequantize_np(e).T)
+                if (
+                    checkpoint.quant_kind(e) == "q4"
+                    and deq.shape[-2] % checkpoint.INT4_GROUP == 0
+                ):
+                    q, s = checkpoint._quantize_int4(deq)
+                    self._tied_head = {"kernel": {"q4": q, "s": s}}
+                else:
+                    q, s = checkpoint._quantize_int8(deq)
+                    self._tied_head = {"kernel": {"q8": q, "s": s}}
             else:
                 self._tied_head = {"kernel": np.ascontiguousarray(e.T)}
             return self._tied_head
@@ -448,16 +454,31 @@ class _HostShardLoader:
 
 @partial(jax.jit, static_argnums=(1,))
 def _dequant_tree(tree, np_dtype_name: str):
-    """On-device dequantize of every {"q8","s"} leaf-group: int8 crossed the
-    host->HBM link (half the bf16 bytes — the transfer is the streaming
-    bottleneck); one fused kernel expands to the compute dtype in HBM. (No
-    donation: int8 buffers cannot alias the wider outputs anyway; they free
-    as soon as the caller drops the pre-dequant reference.)"""
+    """On-device dequantize of every quantized leaf-group: the int8/int4
+    bytes crossed the host->HBM link (half / a quarter of the bf16 bytes —
+    the transfer is the streaming bottleneck); one fused kernel expands to
+    the compute dtype in HBM. (No donation: the narrow buffers cannot alias
+    the wider outputs anyway; they free as soon as the caller drops the
+    pre-dequant reference.)"""
     target = jnp.dtype(np_dtype_name)
 
     def one(n):
         if not checkpoint.is_quantized_leaf(n):
             return n
+        if checkpoint.quant_kind(n) == "q4":
+            # Packed nibbles along the IN axis (low nibble = even index),
+            # offset-binary (nib = q + 8), group-wise scales [.., in/g, out].
+            b, sc = n["q4"], n["s"]
+            lo = (b & 0xF).astype(jnp.float32) - 8.0
+            hi = (b >> 4).astype(jnp.float32) - 8.0
+            q = jnp.stack([lo, hi], axis=-2)  # [.., in/2, 2, out]
+            *lead, half, _, out = q.shape
+            q = q.reshape(*lead, half * 2, out)
+            n_groups = sc.shape[-2]
+            qg = q.reshape(*lead, n_groups, q.shape[-2] // n_groups, out)
+            return (qg * sc[..., None, :]).reshape(
+                *lead, half * 2, out
+            ).astype(target)
         q, sc = n["q8"], n["s"]
         # Scale keeps the payload's leading (stack/expert) axes + trailing
         # channel axis; reduced middle axes broadcast. Covers stored [out],
@@ -489,6 +510,14 @@ def _quantized_target(host, target):
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     if checkpoint.is_quantized_leaf(host):
+        if checkpoint.quant_kind(host) == "q4":
+            # int4's packed in-axis (in/2) and group-scale axis (in/g)
+            # don't survive a Megatron row shard; column shards would work
+            # but a half-supported matrix is worse than a clear error.
+            raise NotImplementedError(
+                "int4 weight streaming does not compose with "
+                "--tensor_parallel yet; use int8 for TP runs"
+            )
         q_ndim = np.ndim(host["q8"])
         s_ndim = np.ndim(host["s"])
         # Pad the (possibly truncated) spec to the payload's rank, then give

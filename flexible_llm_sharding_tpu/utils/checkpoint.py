@@ -59,6 +59,18 @@ NATIVE_LAYOUT_MARKER = "fls_tpu_layout.json"
 # round-to-nearest), and self-describing via the layout marker.
 QUANT_SCALE_SUFFIX = "::scale"
 
+# int4: two values pack per byte along the IN axis, with GROUP-WISE scales
+# along that axis (per-output-channel alone is too coarse at 4 bits; the
+# group bounds each weight's error by its neighbours' amax, the standard
+# int4 recipe). A quantized tensor stores `{key}` (packed uint8, in/2) +
+# `{key}::scale4` (fp32 [.., in/group, out]) and reaches the device as a
+# {"q4","s"} leaf-group — HALF of int8's bytes over the host->HBM link,
+# the binding constraint of the streaming regime. Tensors whose in-dim
+# doesn't divide the group fall back to per-output-channel int8 (the
+# ordinary _quantize_int8 layout); the leaves self-describe either way.
+QUANT4_SCALE_SUFFIX = "::scale4"
+INT4_GROUP = 64
+
 
 def _quantize_int8(w: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
     """Symmetric per-output-channel int8: returns (q [same shape], scale).
@@ -88,31 +100,79 @@ def _scale_expand(scale: np.ndarray, q_ndim: int):
     return scale.shape[:-1] + (1,) * (q_ndim - scale.ndim) + scale.shape[-1:]
 
 
-def _quantize_flat(sd: dict[str, np.ndarray]) -> dict[str, np.ndarray]:
-    """int8-encode one flat native state dict: matmul kernels (>= 2-D
-    floats) quantize per output channel and gain a ::scale twin; 1-D
-    tensors (norm scales, biases) are tiny and stay exact in float32.
-    The single rule shared by split_into_layers and requantize_native."""
+def _quantize_int4(
+    w: np.ndarray, group: int = INT4_GROUP
+) -> tuple[np.ndarray, np.ndarray]:
+    """Symmetric group-wise int4 along the IN axis (axis -2): values in
+    [-7, 7] stored offset-binary (nibble = q + 8), packed two per byte along
+    the in axis (low nibble = even index). Returns (packed uint8
+    [.., in/2, out], scale fp32 [.., in/group, out]). Callers guarantee
+    in % group == 0 (``_quantize_flat`` falls back to int8 otherwise)."""
+    w32 = np.asarray(w, np.float32)
+    *lead, n_in, n_out = w32.shape
+    wg = w32.reshape(*lead, n_in // group, group, n_out)
+    amax = np.max(np.abs(wg), axis=-2)
+    scale = (np.maximum(amax, 1e-12) / 7.0).astype(np.float32)
+    q = np.clip(np.rint(wg / scale[..., None, :]), -7, 7).astype(np.int8)
+    q = q.reshape(*lead, n_in, n_out)
+    nib = (q + 8).astype(np.uint8)
+    return nib[..., 0::2, :] | (nib[..., 1::2, :] << 4), scale
+
+
+def _quantize_flat(
+    sd: dict[str, np.ndarray], dtype: str = "int8"
+) -> dict[str, np.ndarray]:
+    """Quantize one flat native state dict: matmul kernels (>= 2-D floats)
+    quantize and gain a scale twin; 1-D tensors (norm scales, biases) are
+    tiny and stay exact in float32. ``dtype`` 'int8' (per-output-channel)
+    or 'int4' (group-wise + packed; kernels whose in-dim doesn't fit the
+    group fall back to per-output-channel int8 for that tensor — leaves
+    self-describe). The single rule shared by split_into_layers and
+    requantize_native."""
     qd: dict[str, np.ndarray] = {}
     for k, v in sd.items():
         v = np.asarray(v)
         if v.ndim >= 2 and (
             np.issubdtype(v.dtype, np.floating) or v.dtype == _BFLOAT16
         ):
-            q, sc = _quantize_int8(v)
-            qd[k] = q
-            qd[k + QUANT_SCALE_SUFFIX] = sc
+            if dtype == "int4" and v.shape[-2] % INT4_GROUP == 0:
+                q, sc = _quantize_int4(v)
+                qd[k] = q
+                qd[k + QUANT4_SCALE_SUFFIX] = sc
+            else:
+                q, sc = _quantize_int8(v)
+                qd[k] = q
+                qd[k + QUANT_SCALE_SUFFIX] = sc
         else:
             qd[k] = np.asarray(v, np.float32) if v.dtype == _BFLOAT16 else v
     return qd
 
 
 def is_quantized_leaf(node) -> bool:
-    return isinstance(node, dict) and set(node) == {"q8", "s"}
+    """True for BOTH quantized leaf-groups: int8 {"q8","s"} and int4
+    {"q4","s"} — detection sites (loader cast, placement probe) treat them
+    alike; kind-specific handling branches on :func:`quant_kind`."""
+    return isinstance(node, dict) and set(node) in ({"q8", "s"}, {"q4", "s"})
+
+
+def quant_kind(node) -> str:
+    """'q8' or 'q4' for a quantized leaf-group."""
+    return "q8" if "q8" in node else "q4"
 
 
 def dequantize_np(node: dict[str, np.ndarray]) -> np.ndarray:
-    """Host-side dequantize of one {"q8","s"} leaf-group (float32)."""
+    """Host-side dequantize of one quantized leaf-group (float32)."""
+    if quant_kind(node) == "q4":
+        b = np.asarray(node["q4"], np.uint8)
+        s = np.asarray(node["s"], np.float32)
+        lo = (b & 0xF).astype(np.float32) - 8.0
+        hi = (b >> 4).astype(np.float32) - 8.0
+        q = np.stack([lo, hi], axis=-2)  # [.., in/2, 2, out]
+        *lead, half, _, out = q.shape
+        q = q.reshape(*lead, half * 2, out)
+        g = q.shape[-2] // s.shape[-2]
+        qg = q.reshape(*lead, s.shape[-2], g, out)
+        return (qg * s[..., None, :]).reshape(*lead, half * 2, out)
     q = np.asarray(node["q8"], np.float32)
     s = np.asarray(node["s"])
     return q * s.reshape(_scale_expand(s, q.ndim))
@@ -559,9 +619,9 @@ def split_into_layers(
         key=lambda l: (min(shard_ids[s] for s in layer2shards[l]), len(layer2shards[l])),
     )
 
-    quantize = dtype == "int8"
+    quantize = dtype in ("int8", "int4")
     if quantize and layout != "native":
-        raise ValueError("dtype='int8' requires layout='native'")
+        raise ValueError(f"dtype='{dtype}' requires layout='native'")
     if dtype == "bfloat16":
         if _BFLOAT16 is None:
             raise ImportError("dtype='bfloat16' requires ml_dtypes")
@@ -599,7 +659,7 @@ def split_into_layers(
         if layout == "native":
             sd = hf_layer_to_native(layer, sd)
         if quantize:
-            sd = _quantize_flat(sd)
+            sd = _quantize_flat(sd, dtype)
         st_save_file(
             {k: np.ascontiguousarray(v) for k, v in sd.items()},
             os.path.join(out_dir, f"{layer}{LAYER_FILE_SUFFIX}"),
@@ -697,22 +757,32 @@ def load_layer(model_path: str, layer_name: str) -> dict[str, Any]:
     )
     if not _is_native(flat.keys()):
         flat = hf_layer_to_native(layer_name, flat)
-    if any(k.endswith(QUANT_SCALE_SUFFIX) for k in flat):
+    if any(k.endswith((QUANT_SCALE_SUFFIX, QUANT4_SCALE_SUFFIX)) for k in flat):
         grouped: dict[str, Any] = {}
         for k, v in flat.items():
-            if k.endswith(QUANT_SCALE_SUFFIX):
+            if k.endswith((QUANT_SCALE_SUFFIX, QUANT4_SCALE_SUFFIX)):
                 continue
-            sk = k + QUANT_SCALE_SUFFIX
-            grouped[k] = {"q8": v, "s": flat[sk]} if sk in flat else v
+            s8, s4 = k + QUANT_SCALE_SUFFIX, k + QUANT4_SCALE_SUFFIX
+            if s4 in flat:
+                grouped[k] = {"q4": v, "s": flat[s4]}
+            elif s8 in flat:
+                grouped[k] = {"q8": v, "s": flat[s8]}
+            else:
+                grouped[k] = v
         flat = grouped
     return native_to_pytree(layer_name, flat)
 
 
-def requantize_native(src_dir: str, out_dir: str) -> list[str]:
+def requantize_native(
+    src_dir: str, out_dir: str, dtype: str = "int8"
+) -> list[str]:
     """Re-encode an existing NATIVE per-layer checkpoint dir as int8
-    (per-output-channel, same convention as ``split_into_layers(dtype='int8')``)
-    without going back through the HF source. Copies aux files (config.json,
-    tokenizer) alongside. Returns the layer names converted."""
+    (per-output-channel) or int4 (group-wise packed) — same conventions as
+    ``split_into_layers(dtype=...)`` — without going back through the HF
+    source. Copies aux files (config.json, tokenizer) alongside. Returns
+    the layer names converted."""
+    if dtype not in ("int8", "int4"):
+        raise ValueError(f"requantize_native: unsupported dtype {dtype!r}")
     os.makedirs(out_dir, exist_ok=True)
     done = []
     for fn in sorted(os.listdir(src_dir)):
@@ -724,14 +794,14 @@ def requantize_native(src_dir: str, out_dir: str) -> list[str]:
         flat = _mmap_safetensors(src)
         if not _is_native(flat.keys()):
             raise ValueError(f"{fn}: not native layout (run split_into_layers)")
-        qd = _quantize_flat(flat)
+        qd = _quantize_flat(flat, dtype)
         st_save_file(
             {k: np.ascontiguousarray(v) for k, v in qd.items()},
             os.path.join(out_dir, fn),
         )
         done.append(fn[: -len(LAYER_FILE_SUFFIX)])
     with open(os.path.join(out_dir, NATIVE_LAYOUT_MARKER), "w") as f:
-        json.dump({"layout": "native", "dtype": "int8", "layers": done}, f)
+        json.dump({"layout": "native", "dtype": dtype, "layers": done}, f)
     return done
 
 

@@ -19,9 +19,10 @@ def main(argv=None) -> None:
     p.add_argument(
         "--dtype",
         default=None,
-        choices=[None, "bfloat16", "float16", "float32", "int8"],
+        choices=[None, "bfloat16", "float16", "float32", "int8", "int4"],
         help="cast at split time; int8 = per-output-channel weight "
-        "compression (halves the host->HBM bytes; dequantized on device)",
+        "compression (halves the host->HBM bytes; dequantized on device); "
+        "int4 = group-wise packed nibbles (a quarter of the bf16 bytes)",
     )
     p.add_argument("--layout", default="native", choices=["native", "hf"])
     args = p.parse_args(argv)

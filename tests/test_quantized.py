@@ -524,3 +524,224 @@ def test_int8_deepseek_mla(tmp_path):
         logits = llama.forward_full(params_deq, cfg, jnp.asarray(full))
         want = np.asarray(jax.nn.softmax(logits[0, -1]))
         np.testing.assert_allclose(got[0][s, 0], want, rtol=2e-4, atol=2e-5)
+
+
+# ---------------------------------------------------------------------------
+# int4 (group-wise packed nibbles — a QUARTER of the bf16 link bytes)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def dirs4(tiny_cfg, tmp_path_factory):
+    """(fp32_native_dir, int4_dir)."""
+    params = llama.init_params(jax.random.PRNGKey(0), tiny_cfg)
+    base = tmp_path_factory.mktemp("q4")
+    f32 = base / "f32"
+    save_params(jax.tree.map(np.asarray, params), str(f32), tiny_cfg)
+    hf = base / "hf"
+    _write_hf_checkpoint(params, tiny_cfg, str(hf))
+    q4 = base / "q4"
+    ckpt.split_into_layers(str(hf), str(q4), dtype="int4")
+    return str(f32), str(q4)
+
+
+def test_int4_quantize_roundtrip_bound():
+    """Per-weight error is bounded by half the GROUP's scale (symmetric
+    round-to-nearest over [-7, 7]); packing/unpacking is lossless on the
+    quantized integers."""
+    rng = np.random.default_rng(0)
+    w = rng.standard_normal((128, 96)).astype(np.float32)
+    q, s = ckpt._quantize_int4(w)
+    assert q.dtype == np.uint8 and q.shape == (64, 96)
+    assert s.shape == (128 // ckpt.INT4_GROUP, 96)
+    deq = ckpt.dequantize_np({"q4": q, "s": s})
+    err = np.abs(deq - w).reshape(s.shape[0], ckpt.INT4_GROUP, 96)
+    # Rounding: <= scale/2 everywhere (the group amax maps to exactly 7).
+    assert np.all(err <= s[:, None, :] / 2 + 1e-6)
+    # The group's own amax element is exactly representable.
+    assert np.all(np.abs(deq).reshape(err.shape).max(axis=1) <= s * 7 + 1e-6)
+
+
+def test_int4_files_quarter_the_bytes(dirs4, tiny_cfg):
+    f32, q4 = dirs4
+    name = "model.layers.0.safetensors"
+    a = os.path.getsize(os.path.join(f32, name))
+    b = os.path.getsize(os.path.join(q4, name))
+    assert b < 0.20 * a  # packed nibbles + fp32 group scales vs fp32
+    layer = ckpt.load_layer(q4, "model.layers.0")
+    leaf = layer["attn"]["wq"]
+    assert ckpt.is_quantized_leaf(leaf) and ckpt.quant_kind(leaf) == "q4"
+    assert leaf["q4"].dtype == np.uint8
+    d = tiny_cfg.hidden_size
+    assert leaf["q4"].shape == (d // 2, d)
+    assert leaf["s"].shape == (d // ckpt.INT4_GROUP, d)
+    # 1-D tensors stay exact.
+    assert not ckpt.is_quantized_leaf(layer["input_layernorm"]["scale"])
+
+
+def _oracle_check(q_dir, cfg, got, prompts):
+    """Shared exact-machinery assertion: streamed scores == monolithic
+    forward of the host-dequantized network."""
+    params_deq = _dequantized_params(q_dir, cfg)
+    tok = PromptTokenizer(FakeTokenizer(), bucket_multiple=8)
+    for (prefix, suffixes), sc in zip(prompts, got):
+        t = tok(prefix, suffixes)
+        for s in range(t.num_suffixes):
+            n_real = int(t.suffix_eos[s]) + 1
+            full = np.concatenate(
+                [t.prefix_ids[: t.prefix_len], t.suffix_ids[s, :n_real]]
+            )[None, :]
+            logits = llama.forward_full(params_deq, cfg, jnp.asarray(full))
+            want = np.asarray(jax.nn.softmax(logits[0, -1]))
+            np.testing.assert_allclose(sc[s, 0], want, rtol=2e-4, atol=2e-5)
+
+
+def test_int4_streaming_matches_dequantized_oracle(dirs4, tiny_cfg):
+    """The machinery invariant, EXACT: streaming the int4 checkpoint
+    (packed nibbles over the link, on-device unpack + group dequant) must
+    equal the monolithic forward of the same network dequantized on host."""
+    _, q4 = dirs4
+    fw = FrameworkConfig(
+        model_path=q4,
+        dtype="float32",
+        bucket_multiple=8,
+        layer_num_per_shard=1,
+        prefetch_depth=1,
+    )
+    got = StreamingExecutor(fw, tokenizer=FakeTokenizer())(PROMPTS)
+    _oracle_check(q4, tiny_cfg, got, PROMPTS)
+
+
+def test_int4_close_to_fp32(dirs4):
+    """Quality smoke: group-wise int4 stays in the fp32 scores'
+    neighbourhood on the tiny model (looser than int8's 0.05 — 4 bits)."""
+    f32, q4 = dirs4
+
+    def run(path):
+        fw = FrameworkConfig(
+            model_path=path, dtype="float32", bucket_multiple=8, prefetch_depth=0
+        )
+        return StreamingExecutor(fw, tokenizer=FakeTokenizer())(PROMPTS)
+
+    a, b = run(f32), run(q4)
+    for x, y in zip(a, b):
+        assert float(np.abs(x - y).max()) < 0.15
+
+
+def test_int4_stacked_shards_and_moe(tiny_cfg, tmp_path):
+    """Stacked q4 leaves ([k, in/2, out] with scales [k, in/g, out]) under
+    layer_num_per_shard=2, plus Mixtral's 3-D expert kernels, plus a MIXED
+    checkpoint: intermediate 96 gives mlp.down an in-dim off the group, so
+    that tensor falls back to per-output-channel int8 INSIDE the int4
+    checkpoint (leaves self-describe) — asserted, not assumed."""
+    import dataclasses
+
+    from tests.test_model_families import MIXTRAL_CFG
+
+    mixed_cfg = dataclasses.replace(tiny_cfg, intermediate_size=96)
+    for cfg, seed in ((tiny_cfg, 2), (MIXTRAL_CFG, 3), (mixed_cfg, 5)):
+        params = llama.init_params(jax.random.PRNGKey(seed), cfg)
+        f32 = tmp_path / f"f32-{cfg.model_type}-{seed}"
+        save_params(jax.tree.map(np.asarray, params), str(f32), cfg)
+        q4 = tmp_path / f"q4-{cfg.model_type}-{seed}"
+        ckpt.requantize_native(str(f32), str(q4), dtype="int4")
+
+        fw = FrameworkConfig(
+            model_path=str(q4),
+            dtype="float32",
+            bucket_multiple=8,
+            layer_num_per_shard=2,
+            prefetch_depth=0,
+        )
+        got = StreamingExecutor(fw, tokenizer=FakeTokenizer())(PROMPTS[:1])
+        _oracle_check(str(q4), cfg, got, PROMPTS[:1])
+        if cfg is mixed_cfg:
+            layer = ckpt.load_layer(str(q4), "model.layers.0")
+            assert ckpt.quant_kind(layer["mlp"]["down"]) == "q8"  # fallback
+            assert ckpt.quant_kind(layer["mlp"]["gate"]) == "q4"
+
+
+def test_int4_kv_cache_decode(dirs4, tiny_cfg):
+    """DecodeGenerator over an int4 checkpoint: greedy tokens match the
+    host-dequantized oracle across decode steps."""
+    from flexible_llm_sharding_tpu.runtime.decode import DecodeGenerator
+
+    _, q4 = dirs4
+    n_gen = 2
+    fw = FrameworkConfig(
+        model_path=q4,
+        dtype="float32",
+        bucket_multiple=8,
+        prefetch_depth=0,
+        num_gen_token=n_gen,
+    )
+    scores, _ = DecodeGenerator(fw, tokenizer=FakeTokenizer())(PROMPTS[:1])
+
+    params_deq = _dequantized_params(q4, tiny_cfg)
+    tok = PromptTokenizer(FakeTokenizer(), bucket_multiple=8)
+    t = tok(*PROMPTS[0])
+    for s in range(t.num_suffixes):
+        ids = np.concatenate(
+            [t.prefix_ids[: t.prefix_len], t.suffix_ids[s, : int(t.suffix_eos[s]) + 1]]
+        )
+        for g in range(n_gen):
+            logits = llama.forward_full(params_deq, tiny_cfg, jnp.asarray(ids[None]))
+            want = np.asarray(jax.nn.softmax(logits[0, -1]))
+            np.testing.assert_allclose(scores[0][s, g], want, rtol=2e-4, atol=1e-5)
+            ids = np.concatenate([ids, [int(want.argmax())]])
+
+
+def test_int4_tied_embeddings(tiny_cfg, tmp_path):
+    """Tied models requantize the transposed embedding for the head AT INT4
+    (the hidden dim fits the group) — streamed scores match the oracle
+    built from the SAME double-quantized head."""
+    import dataclasses
+
+    cfg = dataclasses.replace(tiny_cfg, tie_word_embeddings=True)
+    params = llama.init_params(jax.random.PRNGKey(1), cfg)
+    hf = tmp_path / "hf"
+    _write_hf_checkpoint(params, cfg, str(hf))
+    q4 = tmp_path / "q4"
+    ckpt.split_into_layers(str(hf), str(q4), dtype="int4")
+
+    fw = FrameworkConfig(
+        model_path=str(q4), dtype="float32", bucket_multiple=8, prefetch_depth=0
+    )
+    got = StreamingExecutor(fw, tokenizer=FakeTokenizer())(PROMPTS[:1])
+
+    params_deq = _dequantized_params(str(q4), cfg)
+    emb_q = ckpt.load_layer(str(q4), "model.embed_tokens")["embedding"]
+    assert ckpt.quant_kind(emb_q) == "q4"
+    kq, ks = ckpt._quantize_int4(
+        np.ascontiguousarray(ckpt.dequantize_np(emb_q).T)
+    )
+    params_deq = dict(params_deq)
+    params_deq["lm_head"] = {
+        "kernel": jnp.asarray(ckpt.dequantize_np({"q4": kq, "s": ks}))
+    }
+
+    tok = PromptTokenizer(FakeTokenizer(), bucket_multiple=8)
+    prefix, suffixes = PROMPTS[0]
+    t = tok(prefix, suffixes)
+    for s in range(t.num_suffixes):
+        n_real = int(t.suffix_eos[s]) + 1
+        full = np.concatenate(
+            [t.prefix_ids[: t.prefix_len], t.suffix_ids[s, :n_real]]
+        )[None, :]
+        logits = llama.forward_full(params_deq, cfg, jnp.asarray(full))
+        want = np.asarray(jax.nn.softmax(logits[0, -1]))
+        np.testing.assert_allclose(got[0][s, 0], want, rtol=2e-4, atol=2e-5)
+
+
+def test_int4_tensor_parallel_rejects(dirs4, tiny_cfg):
+    """int4 + TP is a LOUD NotImplementedError (the packed in-axis and
+    group-scale axis don't survive a Megatron row shard), never a silent
+    mis-shard."""
+    from flexible_llm_sharding_tpu.parallel.sharding import TpPlacement
+
+    _, q4 = dirs4
+    fw = FrameworkConfig(
+        model_path=q4, dtype="float32", bucket_multiple=8, prefetch_depth=0
+    )
+    pl = TpPlacement(jax.devices()[:2], tiny_cfg)
+    with pytest.raises(NotImplementedError, match="int4"):
+        StreamingExecutor(fw, device=pl, tokenizer=FakeTokenizer())(PROMPTS[:1])
