@@ -466,19 +466,12 @@ def _dequant_tree(tree, np_dtype_name: str):
         if not checkpoint.is_quantized_leaf(n):
             return n
         if checkpoint.quant_kind(n) == "q4":
-            # Packed nibbles along the IN axis (low nibble = even index),
-            # offset-binary (nib = q + 8), group-wise scales [.., in/g, out].
-            b, sc = n["q4"], n["s"]
-            lo = (b & 0xF).astype(jnp.float32) - 8.0
-            hi = (b >> 4).astype(jnp.float32) - 8.0
-            q = jnp.stack([lo, hi], axis=-2)  # [.., in/2, 2, out]
-            *lead, half, _, out = q.shape
-            q = q.reshape(*lead, half * 2, out)
-            n_groups = sc.shape[-2]
-            qg = q.reshape(*lead, n_groups, q.shape[-2] // n_groups, out)
-            return (qg * sc[..., None, :]).reshape(
-                *lead, half * 2, out
-            ).astype(target)
+            # One shared implementation with the host oracle
+            # (checkpoint.dequant4_math) so the packing convention cannot
+            # desync between the stream and the tests that pin it.
+            return checkpoint.dequant4_math(n["q4"], n["s"], jnp).astype(
+                target
+            )
         q, sc = n["q8"], n["s"]
         # Scale keeps the payload's leading (stack/expert) axes + trailing
         # channel axis; reduced middle axes broadcast. Covers stored [out],

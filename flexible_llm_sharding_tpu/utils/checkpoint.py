@@ -160,19 +160,29 @@ def quant_kind(node) -> str:
     return "q8" if "q8" in node else "q4"
 
 
+def dequant4_math(b, s, xp):
+    """int4 unpack + group dequant, parameterized on the array module
+    (numpy for host oracles, jax.numpy for the on-device kernel) — the
+    SINGLE source of truth for the packing convention: low nibble = even
+    in-index, offset-binary (nibble = q + 8), scales [.., in/g, out]."""
+    lo = (b & 0xF).astype(xp.float32) - 8.0
+    hi = (b >> 4).astype(xp.float32) - 8.0
+    q = xp.stack([lo, hi], axis=-2)  # [.., in/2, 2, out]
+    *lead, half, _, out = q.shape
+    q = q.reshape(*lead, half * 2, out)
+    n_groups = s.shape[-2]
+    qg = q.reshape(*lead, n_groups, (half * 2) // n_groups, out)
+    return (qg * s[..., None, :]).reshape(*lead, half * 2, out)
+
+
 def dequantize_np(node: dict[str, np.ndarray]) -> np.ndarray:
     """Host-side dequantize of one quantized leaf-group (float32)."""
     if quant_kind(node) == "q4":
-        b = np.asarray(node["q4"], np.uint8)
-        s = np.asarray(node["s"], np.float32)
-        lo = (b & 0xF).astype(np.float32) - 8.0
-        hi = (b >> 4).astype(np.float32) - 8.0
-        q = np.stack([lo, hi], axis=-2)  # [.., in/2, 2, out]
-        *lead, half, _, out = q.shape
-        q = q.reshape(*lead, half * 2, out)
-        g = q.shape[-2] // s.shape[-2]
-        qg = q.reshape(*lead, s.shape[-2], g, out)
-        return (qg * s[..., None, :]).reshape(*lead, half * 2, out)
+        return dequant4_math(
+            np.asarray(node["q4"], np.uint8),
+            np.asarray(node["s"], np.float32),
+            np,
+        )
     q = np.asarray(node["q8"], np.float32)
     s = np.asarray(node["s"])
     return q * s.reshape(_scale_expand(s, q.ndim))
@@ -794,6 +804,16 @@ def requantize_native(
         flat = _mmap_safetensors(src)
         if not _is_native(flat.keys()):
             raise ValueError(f"{fn}: not native layout (run split_into_layers)")
+        if any(
+            k.endswith((QUANT_SCALE_SUFFIX, QUANT4_SCALE_SUFFIX)) for k in flat
+        ):
+            # Re-quantizing a quantized dir would treat the 2-D fp32 scale
+            # tensors as kernels (int4's ::scale4 in particular) and emit
+            # silently-corrupt files; demand the original float checkpoint.
+            raise ValueError(
+                f"{fn}: source is already quantized; requantize from the "
+                "original float checkpoint"
+            )
         qd = _quantize_flat(flat, dtype)
         st_save_file(
             {k: np.ascontiguousarray(v) for k, v in qd.items()},

@@ -230,6 +230,30 @@ def test_long_context_int8_stream(model_dir, tmp_path):
     assert float(np.abs(got[0] - want[0]).max()) < 0.05  # int8 quality bar
 
 
+def test_long_context_int4_stream(model_dir, tmp_path):
+    """int4 weight streaming composes with the sp mesh the same way int8
+    does: packed nibbles + group scales ride the replicated device_put and
+    the on-device unpack/dequant runs replicated (looser quality bar —
+    4 bits)."""
+    from flexible_llm_sharding_tpu.utils.checkpoint import requantize_native
+
+    q4 = tmp_path / "q4"
+    requantize_native(model_dir, str(q4), dtype="int4")
+
+    kw = dict(max_token_len=64, long_context=True)
+    want = run_prompts(
+        _cfg(model_dir, **kw), PROMPTS[:1],
+        tokenizer=FakeTokenizer(), devices=jax.devices()[:4],
+    )
+    got = run_prompts(
+        _cfg(str(q4), **kw), PROMPTS[:1],
+        tokenizer=FakeTokenizer(), devices=jax.devices()[:4],
+    )
+    assert got[0].shape == want[0].shape
+    assert np.isfinite(got[0]).all()
+    assert float(np.abs(got[0] - want[0]).max()) < 0.15
+
+
 def _assert_decode_matches_oracle(
     scores_p, params, model_cfg, prompt, n_gen, rtol=2e-4, atol=1e-5
 ):

@@ -745,3 +745,11 @@ def test_int4_tensor_parallel_rejects(dirs4, tiny_cfg):
     pl = TpPlacement(jax.devices()[:2], tiny_cfg)
     with pytest.raises(NotImplementedError, match="int4"):
         StreamingExecutor(fw, device=pl, tokenizer=FakeTokenizer())(PROMPTS[:1])
+
+
+def test_requantize_rejects_quantized_source(dirs4, tmp_path):
+    """Re-quantizing an already-quantized dir would treat the 2-D fp32
+    scale tensors as kernels (silent corruption) — it must raise instead."""
+    _, q4 = dirs4
+    with pytest.raises(ValueError, match="already quantized"):
+        ckpt.requantize_native(q4, str(tmp_path / "bad"), dtype="int8")
