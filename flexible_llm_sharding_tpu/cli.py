@@ -260,6 +260,19 @@ def main(argv: list[str] | None = None, tokenizer=None) -> None:
     # work by orders of magnitude for scoring workloads).
     tokens_processed = 0
 
+    from flexible_llm_sharding_tpu.runtime.executor import (
+        process_streamed_bytes,
+        reset_process_streamed_bytes,
+    )
+    from flexible_llm_sharding_tpu.runtime.orchestration import (
+        LAST_DP_RANK_STATS,
+    )
+
+    # Fresh per-run accumulators (a library caller may run cli.main twice
+    # in one process).
+    LAST_DP_RANK_STATS.clear()
+    reset_process_streamed_bytes()
+
     t0 = time.perf_counter()
     # The sampler is the peak-HBM fallback for devices whose memory_stats()
     # is unavailable (e.g. TPU through the axon tunnel).
@@ -324,6 +337,7 @@ def main(argv: list[str] | None = None, tokenizer=None) -> None:
     peak = peak_hbm_gb()
     if peak is not None:
         stats["peak_hbm_gb"] = round(peak, 3)
+        stats["peak_hbm_source"] = "allocator"  # device.memory_stats() peak
     elif hbm_sampler.peak_bytes:
         stats["peak_hbm_gb"] = round(hbm_sampler.peak_gb, 3)
         stats["peak_hbm_source"] = "live_arrays"  # excludes XLA scratch
@@ -331,6 +345,44 @@ def main(argv: list[str] | None = None, tokenizer=None) -> None:
             # live_arrays sums across every local chip; on multi-chip runs
             # this is the process-wide total, not the per-chip peak.
             stats["peak_hbm_scope"] = "process"
+    # Total host shard bytes built for upload this process — for a
+    # single-chip stream this is the model bytes that crossed the host->HBM
+    # link (x num_batch passes), the scale artifact's "the whole model
+    # really streamed through" witness.
+    sb = process_streamed_bytes()
+    if sb:
+        stats["streamed_bytes"] = sb
+        # These are HOST shard builds. Single chip: equals host->HBM link
+        # traffic. DP broadcast: each host build uploads to every active
+        # rank, so link traffic is ~n_ranks x this (the read-once design's
+        # point); scope the number so artifacts can't misstate it.
+        stats["streamed_bytes_scope"] = "host_loads"
+        if cfg.data_parallel and len(pick_devices(cfg)) > 1:
+            stats["streamed_bytes_note"] = (
+                "broadcast: link traffic ~= n_ranks x host_loads"
+            )
+    # Host memory: VmHWM (peak RSS — an UPPER bound that includes mmapped
+    # checkpoint pages the loader faulted in, so it can approach model size
+    # on an unpressured host) plus the sampled peak ANON RSS, the process's
+    # own buffers — the honest witness of the streaming host-memory bound.
+    from flexible_llm_sharding_tpu.utils.metrics import host_rss_gb
+
+    rss = host_rss_gb()
+    if "peak" in rss:
+        stats["peak_host_rss_gb"] = round(rss["peak"], 3)
+        stats["peak_host_rss_note"] = "includes mmapped checkpoint pages"
+    if hbm_sampler.peak_anon_bytes:
+        stats["peak_host_anon_gb"] = round(
+            hbm_sampler.peak_anon_bytes / 1e9, 3
+        )
+    if LAST_DP_RANK_STATS:
+        stats["dp_ranks"] = {
+            str(r): {
+                k: int(v) if k == "prompts" else round(v, 3)
+                for k, v in s.items()
+            }
+            for r, s in sorted(LAST_DP_RANK_STATS.items())
+        }
     print(json.dumps(stats), file=sys.stderr)
 
 

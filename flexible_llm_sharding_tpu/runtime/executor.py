@@ -298,6 +298,26 @@ def _is_floating(a: np.ndarray) -> bool:
     return np.issubdtype(a.dtype, np.floating) or a.dtype.name == "bfloat16"
 
 
+# Process-wide total of host shard bytes built for upload, across every
+# loader this process creates (DP/MP producer threads share it, hence the
+# lock — += is not atomic under the GIL). The CLI reports it as
+# ``streamed_bytes`` so a scale artifact can show the full model crossed
+# the stream (e.g. 13.5 GB through a chip holding a fraction of that).
+_PROCESS_STREAM_BYTES = [0]
+_PROCESS_STREAM_LOCK = threading.Lock()
+
+
+def process_streamed_bytes() -> int:
+    return _PROCESS_STREAM_BYTES[0]
+
+
+def reset_process_streamed_bytes() -> None:
+    """Zero the counter — the CLI calls this at run start so a second
+    cli.main() in one process doesn't report the first run's bytes."""
+    with _PROCESS_STREAM_LOCK:
+        _PROCESS_STREAM_BYTES[0] = 0
+
+
 class _HostShardLoader:
     """Host side of weight streaming: disk -> numpy segments, cast to the
     compute dtype, contiguous decoder runs pre-stacked [k, ...] for scan.
@@ -319,6 +339,9 @@ class _HostShardLoader:
         self._tied_head: Params | None = None
         self.load_time = 0.0  # file->numpy wall time (cf. load_weights_time,
         # /root/reference/utils.py:223,304)
+        self.bytes_loaded = 0  # post-cast host bytes built for upload; for a
+        # single-chip stream this IS the host->HBM link traffic (quantized
+        # leaves travel packed, so int8/int4 count their narrow bytes)
         from flexible_llm_sharding_tpu.utils.native import FilePrefetcher
 
         # readahead warms via posix_fadvise(WILLNEED) only — async kernel
@@ -449,6 +472,12 @@ class _HostShardLoader:
                 segments.append((kind, params))
         flush()
         self.load_time += time.perf_counter() - t0
+        shard_bytes = sum(
+            a.nbytes for _, seg in segments for a in jax.tree.leaves(seg)
+        )
+        self.bytes_loaded += shard_bytes
+        with _PROCESS_STREAM_LOCK:
+            _PROCESS_STREAM_BYTES[0] += shard_bytes
         return segments
 
 
@@ -616,6 +645,10 @@ class ShardWeightSource:
     @property
     def load_time(self) -> float:
         return self._loader.load_time
+
+    @property
+    def bytes_loaded(self) -> int:
+        return self._loader.bytes_loaded
 
     def _build_shard(
         self, layer_idxs: tuple[int, ...], device
@@ -797,6 +830,11 @@ class _BroadcastView:
 
     load_time_shared = True
 
+    @property
+    def bytes_loaded(self) -> int:
+        """Shared loader total (one disk read serves every DP chip)."""
+        return self._parent._loader.bytes_loaded
+
     def __iter__(self):
         from queue import Empty
 
@@ -885,6 +923,10 @@ class StreamingExecutor:
                 "pipeline runner for interleaved stage plans"
             )
         self.stats: dict[str, float] = {}
+        # One stats dict per executor call, in call order — callers that run
+        # several batches (or DP ranks) aggregate from here rather than from
+        # the last-call-wins ``self.stats``.
+        self.stats_history: list[dict[str, float]] = []
         # Pallas kernels can't be auto-partitioned by GSPMD (pallas_call has
         # no sharding rule), so under TpPlacement the flash calls run inside
         # a shard_map over the heads axis (llama._flash_tp_*); the placement's
@@ -979,6 +1021,10 @@ class StreamingExecutor:
                 layer_rope=self.model_cfg.layer_rope,
             )
             skip = 0
+        # Baseline for the per-call streamed_bytes delta: a fresh
+        # ShardWeightSource starts at 0, but a broadcast view shares its
+        # parent's cumulative loader counter across calls and ranks.
+        bytes_before = getattr(source, "bytes_loaded", None)
 
         scores: dict[int, np.ndarray] = ScoreSink()
         # Per-block device-resident metadata, uploaded once.
@@ -1058,9 +1104,20 @@ class StreamingExecutor:
             # DP broadcast: the disk is read once for all chips; this stat is
             # the shared total, not this chip's own.
             self.stats["load_time_shared"] = 1.0
+        if bytes_before is not None:
+            # Delta over this call's window. On a shared (broadcast) source
+            # the loader serves every rank at once, so the delta is the
+            # SHARED bytes loaded during this rank's window, not this
+            # chip's own traffic — flagged like load_time_shared.
+            self.stats["streamed_bytes"] = float(
+                source.bytes_loaded - bytes_before
+            )
+            if getattr(source, "load_time_shared", False):
+                self.stats["streamed_bytes_shared"] = 1.0
         peak = metrics.peak_hbm_gb(self.device)
         if peak is not None:
             self.stats["peak_hbm_gb"] = peak
+        self.stats_history.append(dict(self.stats))
         if self.recorder is not None:
             self.recorder.record(
                 "executor_call",

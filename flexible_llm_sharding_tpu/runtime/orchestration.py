@@ -32,6 +32,17 @@ from flexible_llm_sharding_tpu.runtime.generation import Prompt
 from flexible_llm_sharding_tpu.utils import checkpoint
 
 
+# Per-rank stats ACCUMULATED across every DP run_prompts fan-out since the
+# last clear: {rank: {prompts, total_wall_s, compute_wall_s,
+# source_wait_s}}. Multi-pass runs (generation_loop calls run_prompts once
+# per generated token) sum into the same ranks, so the decomposition covers
+# the whole run. The CLI clears it at run start and attaches it to the
+# final stats line, showing WHERE each rank's wall went (broadcast-queue
+# starvation vs compute). Library callers mixing DP and non-DP runs in one
+# process should clear between runs.
+LAST_DP_RANK_STATS: dict[int, dict[str, float]] = {}
+
+
 def pick_devices(cfg: FrameworkConfig) -> list:
     # local_devices, not devices: the streaming executors device_put host
     # arrays, which only works on THIS process's addressable chips. On a
@@ -270,7 +281,22 @@ def run_prompts(
             tokenizer=tokenizer,
             weight_source_factory=lambda: source.view(slot),
         )
-        return _run_batched(ex, prompts[lo:hi], cfg.num_batch)
+        try:
+            return _run_batched(ex, prompts[lo:hi], cfg.num_batch)
+        finally:
+            # Per-rank wall/wait/compute decomposition for the run's stats
+            # line: distinguishes "ranks starved on the shared broadcast
+            # queue" (source_wait dominates) from "ranks compute-bound"
+            # (e.g. N virtual devices oversubscribing one CPU core).
+            agg = LAST_DP_RANK_STATS.setdefault(
+                rank, {"prompts": float(hi - lo)}
+            )
+            for call in ex.stats_history:
+                for key in (
+                    "total_wall_s", "compute_wall_s", "source_wait_s"
+                ):
+                    if key in call:
+                        agg[key] = agg.get(key, 0.0) + call[key]
 
     pool = ThreadPoolExecutor(max_workers=len(active))
     futures = [pool.submit(run_one, slot) for slot in range(len(active))]

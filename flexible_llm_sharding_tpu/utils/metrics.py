@@ -43,6 +43,33 @@ def peak_hbm_gb(device=None) -> float | None:
     return s["peak_bytes_in_use"] / 1e9 if "peak_bytes_in_use" in s else None
 
 
+def _host_rss_bytes() -> dict[str, int]:
+    """``{"peak": VmHWM, "anon": RssAnon}`` in bytes from
+    ``/proc/self/status``; empty off-Linux. Early-exits once both keys are
+    parsed (RssAnon follows VmHWM) — this runs on every sampler tick."""
+    out: dict[str, int] = {}
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmHWM:"):
+                    out["peak"] = int(line.split()[1]) * 1024
+                elif line.startswith("RssAnon:"):
+                    out["anon"] = int(line.split()[1]) * 1024
+                    break
+    except OSError:
+        pass
+    return out
+
+
+def host_rss_gb() -> dict[str, float]:
+    """Host memory (GB): ``peak`` (VmHWM — peak RSS, which INCLUDES
+    file-backed pages the mmap checkpoint loader faulted in, so on an
+    unpressured host it can approach the full model size) and ``anon``
+    (RssAnon — the process's own private buffers, the number that witnesses
+    the streaming design's host-memory bound). Empty off-Linux."""
+    return {k: v / 1e9 for k, v in _host_rss_bytes().items()}
+
+
 class LiveArrayPeakSampler:
     """Peak device-resident bytes, sampled from ``jax.live_arrays()``.
 
@@ -58,6 +85,10 @@ class LiveArrayPeakSampler:
     def __init__(self, interval_s: float = 0.05):
         self.interval_s = interval_s
         self.peak_bytes = 0
+        # Peak ANON host RSS sampled alongside: VmHWM counts mmapped
+        # checkpoint pages, RssAnon is the process's own buffers — but
+        # RssAnon has no kernel-tracked high-water mark, so sample it.
+        self.peak_anon_bytes = 0
         self._stop = None
         self._thread = None
 
@@ -80,6 +111,12 @@ class LiveArrayPeakSampler:
             except Exception:
                 return a.nbytes
 
+        # Host sample first: it has no JAX dependency and must not be
+        # skipped when live-array enumeration fails (backend not up yet,
+        # tunnel hiccup).
+        anon = _host_rss_bytes().get("anon")
+        if anon is not None:
+            self.peak_anon_bytes = max(self.peak_anon_bytes, anon)
         try:
             total = sum(device_bytes(a) for a in jax.live_arrays())
         except Exception:
