@@ -74,6 +74,7 @@ HEADLINE_KEYS = (
     "vs_baseline",
     "vs_baseline_spread",
     "vs_baseline_inconclusive",
+    "vs_baseline_n",
     "overlap_pair_ratios",
     "overlap_efficiency",
     "overlap_efficiency_forced",
@@ -81,6 +82,7 @@ HEADLINE_KEYS = (
     "vs_reference_schedule",
     "vs_reference_schedule_spread",
     "vs_reference_schedule_inconclusive",
+    "vs_reference_schedule_n",
     "ref_schedule_load_s",
     "ref_schedule_score_maxerr",
     "peak_hbm_gb",
@@ -88,9 +90,11 @@ HEADLINE_KEYS = (
     "int8_speedup",
     "int8_speedup_spread",
     "int8_speedup_inconclusive",
+    "int8_speedup_n",
     "int4_speedup",
     "int4_speedup_spread",
     "int4_speedup_inconclusive",
+    "int4_speedup_n",
     "pallas_speedup_4k",
     "pallas_mla_speedup_4k",
     "pallas_decode_speedup",
@@ -107,9 +111,11 @@ HEADLINE_KEYS = (
     "spec_decode_speedup",
     "spec_decode_speedup_spread",
     "spec_decode_speedup_inconclusive",
+    "spec_decode_speedup_n",
     "spec_mechanism_speedup",
     "spec_mechanism_speedup_spread",
     "spec_mechanism_speedup_inconclusive",
+    "spec_mechanism_speedup_n",
     "spec_acceptance",
     "spec_pairs",
     "host_stream_zero_copy_warm_gbps",
@@ -585,10 +591,15 @@ def _ratio_stats(result: dict, key: str, ratios) -> None:
     )
     result[key] = round(med, 3)
     result[key + "_spread"] = [round(lo, 3), round(med, 3), round(hi, 3)]
+    result[key + "_n"] = len(ratios)
     # Always written (never popped): the capture carry-forward copies keys
     # independently, and an absent flag next to a carried True would pair a
-    # fresh conclusive median with a stale inconclusive verdict.
-    result[key + "_inconclusive"] = bool(len(ratios) >= 2 and lo < 1.0 < hi)
+    # fresh conclusive median with a stale inconclusive verdict. A single
+    # rep (budget-truncated pair loop) is ALWAYS inconclusive — one noisy
+    # ratio cannot establish a win or a loss (ADVICE r4).
+    result[key + "_inconclusive"] = bool(
+        len(ratios) < 2 or lo < 1.0 < hi
+    )
 
 
 def _ref_layer_fn():
@@ -1070,13 +1081,16 @@ def run_bench(result: dict) -> None:
     # CPU backend auto resolves to 0 — there is no host->device link to
     # overlap, and a prefetch thread only contends with XLA:CPU compute).
     cfg_default = fw(None)
-    eff = cfg_default.effective_prefetch_depth()
-    log(f"framework schedule: effective prefetch depth {eff}")
+    # depth is the configured schedule (branches below key off it); eff is
+    # measurement-only — ADVICE r4: branching on the measured efficiency
+    # relied on the prefetch-0 path clamping to exactly 0.0.
+    depth = cfg_default.effective_prefetch_depth()
+    log(f"framework schedule: effective prefetch depth {depth}")
     # Warmup (compile), then measure the framework schedule FIRST so a later
     # failure still leaves a throughput number in the emitted JSON.
     log("warmup/compile ...")
     run_once(cfg_default, prompts, tok)
-    log(f"framework schedule (prefetch={eff}) ...")
+    log(f"framework schedule (prefetch={depth}) ...")
     with LiveArrayPeakSampler() as sampler:
         scores, wall_overlap, ex1 = run_once(cfg_default, prompts, tok)
     log(f"  wall={wall_overlap:.2f}s stats={ex1.stats}")
@@ -1145,7 +1159,7 @@ def run_bench(result: dict) -> None:
             "total_wall_s": round(st["total_wall_s"], 3),
         }
 
-    if eff == 0:
+    if depth == 0:
         # The platform-tuned schedule IS the serialized reference schedule
         # here (no transfer link to hide) — identical configs, so the true
         # ratio is 1 by construction. The measured ratio of IDENTICAL

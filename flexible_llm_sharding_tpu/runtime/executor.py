@@ -382,22 +382,19 @@ class _HostShardLoader:
             if checkpoint.is_quantized_leaf(e):
                 # Quantized checkpoints carry scales laid out for [V, D];
                 # the head kernel [D, V] needs the transposed layout, so
-                # requantize the transpose to keep the transfer narrow
-                # (second quantization of already-quantized values — error
-                # stays at the quantization level). Cached: weights are
-                # immutable for the loader's lifetime, and the decode loop
-                # re-streams lm_head every token — a dequant+transpose+
+                # requantize the transpose to keep the transfer narrow.
+                # ALWAYS to int8 — even from an int4 source: two independent
+                # group-wise roundings compound, and at 4 bits the second
+                # rounding can double the error on the most quality-
+                # sensitive matrix (ADVICE r4). Requantizing to int8 keeps
+                # the second-rounding error negligible for one matrix's
+                # worth of extra link bytes per decode step. Cached: weights
+                # are immutable for the loader's lifetime, and the decode
+                # loop re-streams lm_head every token — a dequant+transpose+
                 # requant of [V, D] per token would land on the hot path.
                 deq = np.ascontiguousarray(checkpoint.dequantize_np(e).T)
-                if (
-                    checkpoint.quant_kind(e) == "q4"
-                    and deq.shape[-2] % checkpoint.INT4_GROUP == 0
-                ):
-                    q, s = checkpoint._quantize_int4(deq)
-                    self._tied_head = {"kernel": {"q4": q, "s": s}}
-                else:
-                    q, s = checkpoint._quantize_int8(deq)
-                    self._tied_head = {"kernel": {"q8": q, "s": s}}
+                q, s = checkpoint._quantize_int8(deq)
+                self._tied_head = {"kernel": {"q8": q, "s": s}}
             else:
                 self._tied_head = {"kernel": np.ascontiguousarray(e.T)}
             return self._tied_head
@@ -533,13 +530,33 @@ def _quantized_target(host, target):
 
     if checkpoint.is_quantized_leaf(host):
         if checkpoint.quant_kind(host) == "q4":
-            # int4's packed in-axis (in/2) and group-scale axis (in/g)
-            # don't survive a Megatron row shard; column shards would work
-            # but a half-supported matrix is worse than a clear error.
-            raise NotImplementedError(
-                "int4 weight streaming does not compose with "
-                "--tensor_parallel yet; use int8 for TP runs"
-            )
+            # int4 payload [.., in/2, out] and group scale [.., in/g, out]
+            # have the SAME rank as the unquantized kernel [.., in, out],
+            # axis-for-axis: out/expert/stack shards apply verbatim. A
+            # Megatron ROW shard (in axis, spec[-2]) slices the packed
+            # bytes and the scale rows — exact iff every device's slice is
+            # whole groups (in/tp a multiple of INT4_GROUP, which also
+            # makes in/2 and in/g divide by tp); anything else would split
+            # a quant group across chips, so fail loudly instead.
+            q4_ndim = np.ndim(host["q4"])
+            spec = tuple(target.spec)
+            spec = spec + (None,) * (q4_ndim - len(spec))
+            in_ax = spec[-2] if q4_ndim >= 2 else None
+            if in_ax is not None:
+                axes = (in_ax,) if isinstance(in_ax, str) else tuple(in_ax)
+                tp_size = int(
+                    np.prod([target.mesh.shape[a] for a in axes])
+                )
+                n_groups = host["s"].shape[-2]
+                if n_groups % tp_size:
+                    raise NotImplementedError(
+                        "int4 row shard would split a quantization group "
+                        f"across chips: {n_groups} groups of "
+                        f"{checkpoint.INT4_GROUP} over tp={tp_size}; pad "
+                        "the in dim or use int8 for this kernel"
+                    )
+            same = NamedSharding(target.mesh, P(*spec))
+            return {"q4": same, "s": same}
         q_ndim = np.ndim(host["q8"])
         s_ndim = np.ndim(host["s"])
         # Pad the (possibly truncated) spec to the payload's rank, then give
@@ -1008,6 +1025,10 @@ class StreamingExecutor:
             # store's rank tag), so ranks may resume from different shards.
             source = self.weight_source_factory()
             skip = start_shard
+            # Shared source: its producer thread has been running since
+            # orchestration built it, so the delta below is this call's
+            # WINDOW of the shared stream (flagged streamed_bytes_shared).
+            bytes_before = getattr(source, "bytes_loaded", None)
         else:
             source = ShardWeightSource(
                 self.cfg.model_path,
@@ -1021,10 +1042,10 @@ class StreamingExecutor:
                 layer_rope=self.model_cfg.layer_rope,
             )
             skip = 0
-        # Baseline for the per-call streamed_bytes delta: a fresh
-        # ShardWeightSource starts at 0, but a broadcast view shares its
-        # parent's cumulative loader counter across calls and ranks.
-        bytes_before = getattr(source, "bytes_loaded", None)
+            # Baseline taken BEFORE the source's prefetch producer starts
+            # (it launches in the constructor and can finish shard 0 before
+            # any post-construction read) — a fresh loader starts at 0.
+            bytes_before = 0
 
         scores: dict[int, np.ndarray] = ScoreSink()
         # Per-block device-resident metadata, uploaded once.

@@ -22,15 +22,18 @@ def test_ratio_stats_contract():
     bench._ratio_stats(r, "x", [1.2, 1.1, 1.3])
     assert r["x"] == 1.2
     assert r["x_spread"] == [1.1, 1.2, 1.3]
+    assert r["x_n"] == 3
     assert r["x_inconclusive"] is False
 
     bench._ratio_stats(r, "x", [0.9, 1.05, 1.2])
     assert r["x_inconclusive"] is True  # spread straddles 1.0
 
-    # A single rep can never be conclusive-about-noise, but it also cannot
-    # straddle 1.0 — flag stays False and the median is the value itself.
+    # A single rep (budget-truncated pair loop) is ALWAYS inconclusive —
+    # one noisy ratio cannot establish a win or a loss (ADVICE r4), and
+    # the rep count distinguishes it in the artifact.
     bench._ratio_stats(r, "y", [0.8])
-    assert r["y"] == 0.8 and r["y_inconclusive"] is False
+    assert r["y"] == 0.8 and r["y_n"] == 1
+    assert r["y_inconclusive"] is True
 
     # Conclusive again: the flag must be OVERWRITTEN (not popped) so a
     # carried-forward capture can't pair a stale True with a fresh median.
