@@ -529,7 +529,15 @@ def _quantized_target(host, target):
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     if checkpoint.is_quantized_leaf(host):
-        if checkpoint.quant_kind(host) == "q4":
+        # One shared rank-pad of the (possibly truncated) kernel spec to
+        # the payload's rank — both quant kinds slice off this same padded
+        # spec, so a future change to the padding convention cannot desync
+        # them.
+        kind = checkpoint.quant_kind(host)
+        q_ndim = np.ndim(host[kind])
+        spec = tuple(target.spec)
+        spec = spec + (None,) * (q_ndim - len(spec))
+        if kind == "q4":
             # int4 payload [.., in/2, out] and group scale [.., in/g, out]
             # have the SAME rank as the unquantized kernel [.., in, out],
             # axis-for-axis: out/expert/stack shards apply verbatim. A
@@ -538,10 +546,7 @@ def _quantized_target(host, target):
             # whole groups (in/tp a multiple of INT4_GROUP, which also
             # makes in/2 and in/g divide by tp); anything else would split
             # a quant group across chips, so fail loudly instead.
-            q4_ndim = np.ndim(host["q4"])
-            spec = tuple(target.spec)
-            spec = spec + (None,) * (q4_ndim - len(spec))
-            in_ax = spec[-2] if q4_ndim >= 2 else None
+            in_ax = spec[-2] if q_ndim >= 2 else None
             if in_ax is not None:
                 axes = (in_ax,) if isinstance(in_ax, str) else tuple(in_ax)
                 tp_size = int(
@@ -557,12 +562,10 @@ def _quantized_target(host, target):
                     )
             same = NamedSharding(target.mesh, P(*spec))
             return {"q4": same, "s": same}
-        q_ndim = np.ndim(host["q8"])
         s_ndim = np.ndim(host["s"])
-        # Pad the (possibly truncated) spec to the payload's rank, then give
-        # the scale the payload's leading axes + its trailing channel axis —
-        # the sharding-side mirror of checkpoint._scale_expand.
-        spec = tuple(target.spec) + (None,) * (q_ndim - len(tuple(target.spec)))
+        # q8: the scale is LOWER rank than the payload (per-channel, not
+        # per-group) — give it the payload's leading axes + its trailing
+        # channel axis, the sharding-side mirror of checkpoint._scale_expand.
         s_spec = P(*(spec[: s_ndim - 1] + (spec[-1],))) if s_ndim else P()
         return {"q8": target, "s": NamedSharding(target.mesh, s_spec)}
     if isinstance(host, dict):

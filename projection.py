@@ -1,0 +1,234 @@
+"""The >=2x BASELINE case as arithmetic, not prose (VERDICT r4 item 3).
+
+BASELINE.md's throughput target — >= 2x tokens/sec vs the reference's
+CUDA-offload schedule (`/root/reference/utils.py:228-233`) on comparable
+hardware — cannot be measured on this rig (one v5e chip behind a ~0.1 GB/s
+tunnel; the reference needs an A100 host). What CAN be done honestly is a
+projection where every input is either (a) measured on this rig and cited
+to a committed artifact, or (b) a public hardware spec, clearly marked —
+with the ratio computed in ONE place and an explicit statement of what must
+be true on real hardware for the target to hold.
+
+Model (one full streamed scoring pass of T tokens through a model of W
+link-bytes):
+
+  stream_s  = W / link                      (host->HBM is the binding lane)
+  compute_s = T * flops_per_token / (chip_peak * mfu_c)
+
+  framework wall (overlapped, measured efficiency e):
+      wall_fw  = max(C, S) + (1 - e) * min(C, S)
+      e=1 -> perfect overlap (max), e=0 -> fully serialized (C + S).
+  reference wall (its own schedule, emulated + measured in bench.py
+  `_reference_schedule_run`):
+      wall_ref = beta * C_ref + sigma * S_ref
+      - serialized load-then-compute (utils.py:228-233) -> the plain sum;
+      - beta >= 1: the schedule's compute-side inefficiency (no stacked
+        scan, per-PROMPT python loop, utils.py:236-239), measured HERE as
+        `vs_reference_schedule` on a linkless backend = 1.139
+        (BENCH_r04.json; CPU, so it UNDERSTATES the batching win on a
+        real MXU — conservative);
+      - sigma >= 1: per-tensor synchronous upload overhead vs one
+        contiguous stacked transfer (utils.py:126-130). Projected at 1.0
+        (most conservative possible choice).
+
+Inputs of record (see INPUTS below for citations):
+  - overlap efficiency e = 0.947  — measured, BENCH_r04.json
+    `overlap_efficiency_forced` (0.953 on a second run; min taken).
+  - int8 / int4 link-byte factors 0.502 / 0.281 — measured file-size
+    ratios of requantized GB-scale checkpoints (tests
+    test_int4_files_quarter_the_bytes; int4 = packed nibbles + fp32 group
+    scales; int8 = payload + per-channel scales). The reference is
+    fp16-only (utils.py:80) — quantized streaming has no reference
+    counterpart, so those rows are framework-only wins.
+  - links: v5e host PCIe Gen3 x16 ~= 15.8 GB/s spec, A100 PCIe Gen4 x16
+    ~= 31.5 GB/s spec; both derated x0.8 for achievable DMA. NOTE the
+    REFERENCE side gets the 2x faster link — the projection's hardware
+    assumptions favor the reference throughout.
+  - chip peaks: v5e 197 TFLOP/s bf16, A100 312 TFLOP/s fp16 (public
+    specs, utils/metrics.py:_PEAK_BF16_FLOPS for the TPU side).
+  - mfu_c (MFU inside the compute windows, both sides equal): parameter
+    swept over {0.2, 0.3, 0.4} — streamed-layer matmuls at batch ~6k
+    tokens; equal on both sides so it mostly cancels (the reference's
+    per-prompt loop penalty is carried by beta, not by mfu).
+
+Run: ``python projection.py`` -> one JSON line + PROJECTION.json.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+ROOT = os.path.dirname(os.path.abspath(__file__))
+
+# --- Inputs of record (value, citation) ------------------------------------
+INPUTS = {
+    "overlap_efficiency": (
+        0.947,
+        "BENCH_r04.json overlap_efficiency_forced (second run 0.953; "
+        "min taken); executor's own produce/wait timers",
+    ),
+    "beta_ref_compute_factor": (
+        1.139,
+        "BENCH_r04.json vs_reference_schedule on the linkless CPU backend "
+        "(spread [1.111, 1.151], conclusive): pure schedule effect — "
+        "understates the MXU batching win, so conservative",
+    ),
+    "sigma_ref_upload_factor": (
+        1.0,
+        "most conservative choice; the reference's per-tensor sync uploads "
+        "(utils.py:126-130) are >= one stacked transfer",
+    ),
+    "bytes_factor": (
+        {"bf16": 1.0, "int8": 0.502, "int4": 0.281},
+        "measured requantized-checkpoint size ratios "
+        "(tests/test_quantized.py::test_int4_files_quarter_the_bytes; "
+        "int4 = nibbles + fp32 group scales). Reference is fp16-only "
+        "(utils.py:80)",
+    ),
+    "link_fw_gbps": (
+        15.8 * 0.8,
+        "v5e host PCIe Gen3 x16 spec 15.8 GB/s x0.8 achievable — SPEC, "
+        "not measured here (the 0.092 GB/s axon tunnel is a dev harness, "
+        "BENCH_TPU_best.json host_to_hbm_gbps, and is NOT used)",
+    ),
+    "link_ref_gbps": (
+        31.5 * 0.8,
+        "A100 PCIe Gen4 x16 spec 31.5 GB/s x0.8 — the reference side gets "
+        "the 2x FASTER link",
+    ),
+    "chip_peak_fw": (197e12, "v5e bf16 peak, utils/metrics.py:_PEAK_BF16_FLOPS"),
+    "chip_peak_ref": (312e12, "A100 fp16 dense peak, public spec"),
+    "model_bytes_fp16": (
+        140e9,
+        "Llama-2-70B fp16 ~140 GB (/root/reference/README.md:4; BASELINE "
+        "configs 3-5). The 7B-class row scales by the measured "
+        "streamed_bytes 13.48 GB (SCALE_r05.json cpu.streamed_bytes)",
+    ),
+    "tokens_per_pass": (
+        6376,
+        "the scale workload's measured tokens_processed per full-model "
+        "pass (SCALE_r05.json cpu.tokens_processed: 8 prompts x ~700-word "
+        "prefix + 4 suffixes)",
+    ),
+    "flops_per_token_70b": (
+        2 * 70e9,
+        "2*P matmul FLOPs/token, P=70e9 (utils/metrics.py "
+        "model_flops_per_token's leading term; attention terms omitted "
+        "equally on both sides)",
+    ),
+}
+
+
+def walls(model_bytes: float, dtype_factor: float, tokens: float,
+          flops_per_token: float, *, link_fw: float, link_ref: float,
+          peak_fw: float, peak_ref: float, mfu_c: float, e: float,
+          beta: float, sigma: float, n_chips_fw: int = 1) -> dict:
+    """The ONE place the ratio is computed. Returns seconds + the ratio.
+
+    ``n_chips_fw`` models the BASELINE hardware (v5e-8): the interleaved
+    MP pipeline (runtime/pipeline.py, shards[k::N]) sends each weight byte
+    over the host link ONCE (to its stage's chip) while all N chips
+    compute concurrently in steady state — stream_s unchanged, compute_s
+    divided by N (pipeline fill/drain bubbles are bounded by one shard and
+    amortize over the prompt batch; overlap is data-dependency driven,
+    tests/test_pipeline_overlap.py). DP would instead broadcast N copies
+    over the shared host link — N x the stream bytes — so a link-bound
+    70B stream picks MP; that choice is the framework's, not the
+    projection's."""
+    s_fw = model_bytes * dtype_factor / (link_fw * 1e9)
+    c_fw = tokens * flops_per_token / (peak_fw * mfu_c) / n_chips_fw
+    wall_fw = max(c_fw, s_fw) + (1.0 - e) * min(c_fw, s_fw)
+    # Reference: always fp16 bytes (no quantized streaming), serialized.
+    s_ref = model_bytes / (link_ref * 1e9)
+    c_ref = tokens * flops_per_token / (peak_ref * mfu_c)
+    wall_ref = beta * c_ref + sigma * s_ref
+    return {
+        "stream_s_fw": round(s_fw, 2),
+        "compute_s_fw": round(c_fw, 2),
+        "wall_s_fw": round(wall_fw, 2),
+        "stream_s_ref": round(s_ref, 2),
+        "compute_s_ref": round(c_ref, 2),
+        "wall_s_ref": round(wall_ref, 2),
+        "tokens_per_sec_fw": round(tokens / wall_fw, 1),
+        "tokens_per_sec_ref": round(tokens / wall_ref, 1),
+        "projected_ratio": round(wall_ref / wall_fw, 3),
+    }
+
+
+def main(out: str | None = None) -> None:
+    v = {k: val for k, (val, _) in INPUTS.items()}
+    scenarios = {}
+    for n_chips in (1, 8):
+        for mfu_c in (0.2, 0.3, 0.4):
+            for dtype, f in v["bytes_factor"].items():
+                scenarios[f"70b_{dtype}_mfu{mfu_c}_x{n_chips}"] = walls(
+                    v["model_bytes_fp16"], f, v["tokens_per_pass"],
+                    v["flops_per_token_70b"],
+                    link_fw=v["link_fw_gbps"], link_ref=v["link_ref_gbps"],
+                    peak_fw=v["chip_peak_fw"], peak_ref=v["chip_peak_ref"],
+                    mfu_c=mfu_c, e=v["overlap_efficiency"],
+                    beta=v["beta_ref_compute_factor"],
+                    sigma=v["sigma_ref_upload_factor"],
+                    n_chips_fw=n_chips,
+                )
+    result = {
+        "inputs": {k: {"value": val, "cite": cite}
+                   for k, (val, cite) in INPUTS.items()},
+        "scenarios": scenarios,
+        "headline": {
+            # BASELINE.md's target row: v5e-8 (MP pipeline) vs one A100,
+            # mid MFU. bf16 carries the reference's own byte count
+            # (like-for-like); int8/int4 are the framework's quantized
+            # streaming, which the fp16-only reference cannot do.
+            "x8_bf16_like_for_like": scenarios["70b_bf16_mfu0.3_x8"][
+                "projected_ratio"
+            ],
+            "x8_int8": scenarios["70b_int8_mfu0.3_x8"]["projected_ratio"],
+            "x8_int4": scenarios["70b_int4_mfu0.3_x8"]["projected_ratio"],
+            # Single chip vs the A100, for scale: the overlap win alone
+            # roughly cancels the A100's faster link + higher peak.
+            "x1_bf16": scenarios["70b_bf16_mfu0.3_x1"]["projected_ratio"],
+        },
+        "verdict_on_2x": (
+            "the >=2x BASELINE target holds on v5e-8 WITH quantized "
+            "streaming (int8 projects 2.4-3.8x, int4 4.3-6.7x across the "
+            "mfu sweep); at bf16 like-for-like bytes it projects "
+            "1.2-1.9x — link-bound at the reference's own byte count. "
+            "The honest claim is: parity-plus single-chip, >=2x at the "
+            "BASELINE's v5e-8 via MP + int8/int4 (capabilities the "
+            "reference lacks)."
+        ),
+        "what_must_be_true": [
+            "overlap efficiency >= ~0.9 holds at GB scale on a real host "
+            "link (measured 0.947-0.953 on this rig's host path, "
+            "BENCH_r04.json; not yet measured on an unthrottled "
+            "host->HBM link)",
+            "the v5e host sustains >= ~12.6 GB/s host->HBM DMA "
+            "(PCIe Gen3 x16 x0.8 spec derate; the rig tunnel is 100x "
+            "slower and says nothing about this)",
+            "the MP pipeline keeps 8 chips concurrently busy in steady "
+            "state (data-dependency overlap, tests/test_pipeline_overlap; "
+            "measured on the virtual mesh, not yet on 8 real chips)",
+            "the reference's compute-side schedule factor (beta 1.139, "
+            "measured on CPU) does not shrink below ~1 on an A100 — it "
+            "cannot: per-prompt serial scoring only loses more at high "
+            "arithmetic intensity",
+            "compute-window MFU is comparable on both sides (the x8 int8 "
+            "ratio stays >= 2.4 across the whole mfu 0.2-0.4 sweep — the "
+            "target never depends on a favourable MFU guess)",
+        ],
+    }
+    out = out or os.path.join(ROOT, "PROJECTION.json")
+    with open(out, "w") as f:
+        json.dump(result, f, indent=1)
+    print(json.dumps({
+        "projected_vs_reference": result["headline"],
+        "detail": out,
+    }))
+
+
+if __name__ == "__main__":
+    import sys
+
+    main(sys.argv[1] if len(sys.argv) > 1 else None)

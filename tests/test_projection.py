@@ -1,0 +1,81 @@
+"""The >=2x projection's arithmetic (projection.py) — limits and
+regeneration. The projection is evidence only if its one formula behaves:
+e=0 must be the serialized sum, e=1 the perfect-overlap max, more chips
+must never slow the pipeline model, and the committed PROJECTION.json must
+be exactly what the script regenerates from its cited inputs."""
+
+import json
+import os
+import subprocess
+import sys
+
+import projection
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+KW = dict(
+    link_fw=12.6, link_ref=25.2, peak_fw=197e12, peak_ref=312e12,
+    mfu_c=0.3, beta=1.139, sigma=1.0,
+)
+
+
+def test_overlap_limits():
+    bytes_, tokens, fpt = 140e9, 6376, 2 * 70e9
+    ser = projection.walls(bytes_, 1.0, tokens, fpt, e=0.0, **KW)
+    s, c = ser["stream_s_fw"], ser["compute_s_fw"]
+    assert abs(ser["wall_s_fw"] - (s + c)) < 0.02  # e=0 -> serialized sum
+    perf = projection.walls(bytes_, 1.0, tokens, fpt, e=1.0, **KW)
+    assert abs(perf["wall_s_fw"] - max(s, c)) < 0.02  # e=1 -> max
+    mid = projection.walls(bytes_, 1.0, tokens, fpt, e=0.5, **KW)
+    assert perf["wall_s_fw"] < mid["wall_s_fw"] < ser["wall_s_fw"]
+
+
+def test_reference_wall_is_serialized_sum():
+    r = projection.walls(140e9, 1.0, 6376, 2 * 70e9, e=0.9, **KW)
+    want = 1.139 * r["compute_s_ref"] + 1.0 * r["stream_s_ref"]
+    assert abs(r["wall_s_ref"] - want) < 0.02
+
+
+def test_monotone_in_chips_and_bytes():
+    base = projection.walls(140e9, 1.0, 6376, 2 * 70e9, e=0.947, **KW)
+    x8 = projection.walls(
+        140e9, 1.0, 6376, 2 * 70e9, e=0.947, n_chips_fw=8, **KW
+    )
+    assert x8["wall_s_fw"] <= base["wall_s_fw"]
+    assert x8["wall_s_ref"] == base["wall_s_ref"]  # ref side untouched
+    q4 = projection.walls(
+        140e9, 0.281, 6376, 2 * 70e9, e=0.947, n_chips_fw=8, **KW
+    )
+    assert q4["wall_s_fw"] <= x8["wall_s_fw"]
+    assert q4["projected_ratio"] >= x8["projected_ratio"]
+
+
+def test_committed_artifact_regenerates(tmp_path):
+    """PROJECTION.json is exactly what projection.py emits from its cited
+    inputs — no hand-edited numbers. Regenerates into tmp_path and compares
+    READ-ONLY: the committed artifact must never be rewritten by a test
+    run (a drift would overwrite the pinned numbers before failing)."""
+    with open(os.path.join(ROOT, "PROJECTION.json")) as f:
+        committed = json.load(f)
+    out_path = str(tmp_path / "PROJECTION.json")
+    out = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "projection.py"), out_path],
+        capture_output=True, text=True, cwd=ROOT, check=True,
+    )
+    line = json.loads(out.stdout.strip().splitlines()[-1])
+    assert line["projected_vs_reference"] == committed["headline"]
+    with open(out_path) as f:
+        regenerated = json.load(f)
+    assert regenerated == committed
+
+
+def test_baseline_target_rows():
+    """The artifact's own claim structure: >=2x on the x8 quantized rows
+    across the WHOLE mfu sweep; bf16 like-for-like stays >= 1 (never
+    regresses the reference)."""
+    with open(os.path.join(ROOT, "PROJECTION.json")) as f:
+        d = json.load(f)
+    for mfu in ("0.2", "0.3", "0.4"):
+        assert d["scenarios"][f"70b_int8_mfu{mfu}_x8"]["projected_ratio"] >= 2
+        assert d["scenarios"][f"70b_int4_mfu{mfu}_x8"]["projected_ratio"] >= 2
+        assert d["scenarios"][f"70b_bf16_mfu{mfu}_x8"]["projected_ratio"] >= 1
