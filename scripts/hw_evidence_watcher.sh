@@ -1,14 +1,15 @@
 #!/bin/bash
-# Waits for the TPU tunnel to recover, then captures the round-4 hardware
+# Waits for the TPU tunnel to recover, then captures the round-5 hardware
 # evidence in sequence: bench.py (persists BENCH_TPU_latest/best.json on any
-# successful on-TPU run) and scale_demo.py (SCALE_r04.json, single-chip
-# configs — the dp8/mp8 mesh legs are tunnel-independent and run separately).
-# Probes in a subprocess so a wedged tunnel can't hang the watcher itself.
-# Every captured artifact is COMMITTED immediately (round 3's scale artifact
-# was lost to an always-down tunnel + no auto-commit).
+# successful on-TPU run), the GB-scale bench (BENCH_GB_r05.json against the
+# pre-split 13.5 GB checkpoint), and scale_demo.py (SCALE_r05.json,
+# single-chip configs — the dp8/mp8 mesh legs are tunnel-independent and run
+# separately). Probes in a subprocess so a wedged tunnel can't hang the
+# watcher itself. Every captured artifact is COMMITTED immediately (round
+# 3's scale artifact was lost to an always-down tunnel + no auto-commit).
 cd /root/repo
 
-ARTIFACTS="BENCH_TPU_latest.json BENCH_TPU_best.json SCALE_r04.json"
+ARTIFACTS="BENCH_TPU_latest.json BENCH_TPU_best.json SCALE_r05.json BENCH_GB_r05.json"
 
 commit_artifacts() {
   # Stage each file individually: `git add a b c` is atomic on pathspec
@@ -35,24 +36,49 @@ while true; do
     rc=$?  # save BEFORE the $(date)/$(cat) substitutions reset $?
     echo "$(date -u +%H:%M:%S) bench rc=$rc $(cat /tmp/bench_hw.json)" >> /tmp/hw_watcher.log
     commit_artifacts "TPU bench capture"
-    # Only spend scale-demo time if bench really ran on TPU *and produced a
+    # Only spend GB/scale time if bench really ran on TPU *and produced a
     # number*: a deadline-partial emission carries platform=tpu with null
-    # values when the tunnel wedged mid-run — following it with a 2h
-    # scale_demo on the same wedged link wastes the whole retry cycle.
+    # values when the tunnel wedged mid-run — following it with hours of
+    # GB passes on the same wedged link wastes the whole retry cycle.
     # Check the TOP-LEVEL platform key: a substring grep would
     # false-positive on the embedded tpu_capture that CPU-fallback runs
     # fold into their JSON.
+    # Per-artifact completeness gates, shared by the phase guards (skip
+    # already-captured phases — a retry cycle is hours, so re-running a
+    # captured phase multiplies tunnel exposure for nothing) and the exit
+    # check. A partial/crashed GB emission (bench.py's gb_watchdog writes
+    # {"partial": true, ...}) must NOT count as captured.
+    scale_ok() { python -c "import json,sys; sys.exit(0 if json.load(open('SCALE_r05.json')).get('platform') != 'cpu' else 1)" 2>/dev/null; }
+    gb_ok() { python -c "import json,sys; d=json.load(open('BENCH_GB_r05.json')); sys.exit(0 if d.get('platform')=='tpu' and not d.get('partial') and d.get('gb_tokens_per_sec') else 1)" 2>/dev/null; }
     if python -c "import json,sys; d=json.load(open('/tmp/bench_hw.json')); sys.exit(0 if d.get('platform')=='tpu' and d.get('value') is not None else 1)" 2>/dev/null; then
-      echo "$(date -u +%H:%M:%S) running scale_demo" >> /tmp/hw_watcher.log
-      timeout -k 10 7200 python scale_demo.py --configs cpu,tpu,disk > /tmp/scale_hw.log 2>&1
-      rc=$?
-      echo "$(date -u +%H:%M:%S) scale_demo rc=$rc artifact: $(ls -la SCALE_r04.json 2>/dev/null)" >> /tmp/hw_watcher.log
-      commit_artifacts "GB-scale streaming demo (SCALE_r04)"
-      # Only stop once the artifacts actually exist — a tunnel drop mid-run
-      # (the very failure mode this watcher exists for) must keep retrying.
-      # A CPU-fallback SCALE capture (scale_demo --backend cpu, marked
-      # platform=cpu) does NOT satisfy the hardware-evidence goal.
-      if [ -f SCALE_r04.json ] && python -c "import json,sys; sys.exit(0 if json.load(open('SCALE_r04.json')).get('platform') != 'cpu' else 1)" 2>/dev/null && python -c "import json,sys; sys.exit(0 if json.load(open('BENCH_TPU_latest.json')).get('platform')=='tpu' else 1)" 2>/dev/null; then
+      # scale_demo FIRST: with --keep it builds + splits the GB checkpoint
+      # the GB bench then reuses (a fresh tree would otherwise skip the GB
+      # bench this cycle and burn a whole extra multi-hour retry).
+      if ! scale_ok; then
+        echo "$(date -u +%H:%M:%S) running scale_demo" >> /tmp/hw_watcher.log
+        timeout -k 10 7200 python scale_demo.py --configs cpu,tpu,disk \
+          --out SCALE_r05.json --keep > /tmp/scale_hw.log 2>&1
+        rc=$?
+        echo "$(date -u +%H:%M:%S) scale_demo rc=$rc artifact: $(ls -la SCALE_r05.json 2>/dev/null)" >> /tmp/hw_watcher.log
+        commit_artifacts "GB-scale streaming demo (SCALE_r05)"
+      fi
+      if [ -d scale_tmp/native_checkpoint ] && ! gb_ok; then
+        echo "$(date -u +%H:%M:%S) running GB bench" >> /tmp/hw_watcher.log
+        BENCH_GB_DEADLINE_S=5400 timeout -k 10 6000 python bench.py \
+          --model_path scale_tmp/native_checkpoint --prompts 2 \
+          --out BENCH_GB_r05.json > /tmp/bench_gb_hw.log 2>&1
+        rc=$?
+        echo "$(date -u +%H:%M:%S) GB bench rc=$rc" >> /tmp/hw_watcher.log
+        commit_artifacts "GB-scale bench capture"
+      fi
+      # Only stop once every artifact is genuinely captured — a tunnel drop
+      # mid-run (the very failure mode this watcher exists for) must keep
+      # retrying. A CPU-fallback SCALE capture (platform=cpu) does NOT
+      # satisfy the goal; the GB artifact is required only where the
+      # checkpoint it benches exists.
+      if scale_ok \
+        && { [ ! -d scale_tmp/native_checkpoint ] || gb_ok; } \
+        && python -c "import json,sys; sys.exit(0 if json.load(open('BENCH_TPU_latest.json')).get('platform')=='tpu' else 1)" 2>/dev/null; then
         echo "$(date -u +%H:%M:%S) all hardware evidence captured" >> /tmp/hw_watcher.log
         exit 0
       fi

@@ -44,7 +44,13 @@ def _clear_jax_caches_between_modules():
 
     ``FLS_NO_CLEAR_CACHES=1 python -m pytest tests/ -q`` disables the
     mitigation — the full-suite segfault repro as a one-liner (expect
-    SIGSEGV near the end of the run)."""
+    SIGSEGV near the end of the run).
+
+    Upstream filing: the complete ready-to-file jax-ml/jax issue (title,
+    body, environment, isolation results) is
+    ``scripts/xla_cpu_segfault_issue.md`` — this rig has no network
+    egress, so that file IS the tracking record until an egress-capable
+    environment files it and replaces this citation with the issue URL."""
     yield
     # Value-checked ("1"/"true"), not presence-checked: =0 must keep the
     # mitigation ON (skipping it segfaults the suite with no hint why).
