@@ -1108,6 +1108,7 @@ def run_bench(result: dict) -> None:
     peak = peak_hbm_gb()
     if peak is not None:
         result["peak_hbm_gb"] = round(peak, 3)
+        result["peak_hbm_source"] = "allocator"  # device memory_stats peak
     elif sampler.peak_bytes:
         # Devices behind the axon tunnel report no allocator stats; the
         # live-array peak (weights + activations + prefetch queue, minus XLA
