@@ -1404,6 +1404,47 @@ def run_gb_bench(
     total_tokens = _count_pass_tokens(tok, prompts)
     result["tokens_per_pass"] = total_tokens
 
+    # Rep accumulation across invocations: a GB quant pair costs ~3 passes
+    # (~minutes each), so one run records a single flagged-inconclusive
+    # ratio; a LATER run against the same model/workload/platform merges
+    # its fresh pair with the prior run's raw ratios (persisted as
+    # gb_*_ratios) and the median/spread/n upgrade honestly instead of
+    # resetting to n=1 forever.
+    prior_ratios: dict[str, list] = {}
+    if out and os.path.exists(out):
+        try:
+            with open(out) as f:
+                prior = json.load(f)
+            if (
+                prior.get("model_path") == model_path
+                and prior.get("tokens_per_pass") == total_tokens
+                and prior.get("platform") == result["platform"]
+                and not prior.get("partial")
+            ):
+                for q in ("int8", "int4"):
+                    if isinstance(prior.get(f"gb_{q}_ratios"), list):
+                        prior_ratios[q] = list(prior[f"gb_{q}_ratios"])
+                        # Seed the result with the prior reps UP FRONT: if
+                        # this run's quant phase is budget-skipped or
+                        # fails, the finally-emit must carry the prior
+                        # measurement forward, not destroy it (the merge
+                        # site overwrites these when it actually runs, and
+                        # only then claims merged_reps_from).
+                        result[f"gb_{q}_ratios"] = prior_ratios[q]
+                        _ratio_stats(
+                            result, f"gb_{q}_speedup", prior_ratios[q]
+                        )
+                if prior_ratios:
+                    result["gb_reps_carried_from"] = prior.get(
+                        "captured_at", "prior run"
+                    )
+                    prior_ratios["_from"] = result["gb_reps_carried_from"]
+        except (OSError, ValueError):
+            pass
+    result["captured_at"] = time.strftime(
+        "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
+    )
+
     # GB passes cost minutes-to-hours; a tunnel wedge or a phase crash must
     # never lose what WAS measured (same rationale as main()'s watchdog,
     # which the --model_path branch bypasses). emit() is idempotent-ish:
@@ -1447,7 +1488,7 @@ def run_gb_bench(
     try:
         _run_gb_phases(
             jax, devs, result, cfg_default, fw, prompts, tok, total_tokens,
-            model_path, quant, budget_left,
+            model_path, quant, budget_left, prior_ratios,
         )
     finally:
         result["gb_wall_total_s"] = round(time.perf_counter() - t0_all, 1)
@@ -1457,7 +1498,7 @@ def run_gb_bench(
 
 def _run_gb_phases(
     jax, devs, result, cfg_default, fw, prompts, tok, total_tokens,
-    model_path, quant, budget_left,
+    model_path, quant, budget_left, prior_ratios=None,
 ) -> None:
     from flexible_llm_sharding_tpu.utils import checkpoint as ckpt_mod
     from flexible_llm_sharding_tpu.utils.metrics import peak_hbm_gb
@@ -1560,7 +1601,14 @@ def _run_gb_phases(
                 _, wq1, _ = run_once(qc, prompts, tok)  # compile rep
                 _, wq, exq = run_once(qc, prompts, tok)
                 _, wb, _ = run_once(cfg_default, prompts, tok)  # fresh pair
-                _ratio_stats(result, key, [wb / wq])
+                ratios = (prior_ratios or {}).get(qdtype, []) + [wb / wq]
+                result[f"gb_{qdtype}_ratios"] = [
+                    round(r, 4) for r in ratios
+                ]
+                _ratio_stats(result, key, ratios)
+                if (prior_ratios or {}).get(qdtype):
+                    # Claimed only where the merge actually happened.
+                    result["merged_reps_from"] = prior_ratios["_from"]
                 if exq.stats.get("streamed_bytes"):
                     result[f"gb_{qdtype}_streamed_bytes"] = int(
                         exq.stats["streamed_bytes"]

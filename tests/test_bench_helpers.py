@@ -157,3 +157,18 @@ def test_gb_bench_mode(bench_model, tmp_path):
     assert _os.path.exists(out)
     with open(out) as f:
         assert _json.load(f)["metric"] == "gb_streamed_scoring"
+    # The persisted raw ratio must be the value the median was computed
+    # from (4-decimal raw vs 3-decimal median).
+    assert len(result["gb_int8_ratios"]) == 1
+    assert result["gb_int8_ratios"][0] == pytest.approx(
+        result["gb_int8_speedup"], abs=1e-3
+    )
+
+    # Second invocation against the same out merges the prior run's raw
+    # quant ratios: n upgrades to 2 instead of resetting to a fresh
+    # flagged single rep forever.
+    result2 = bench.run_gb_bench(bench_model, n_prompts=1, out=out)
+    assert result2["gb_int8_speedup_n"] == 2
+    assert result2["gb_int4_speedup_n"] == 2
+    assert len(result2["gb_int8_ratios"]) == 2
+    assert result2["merged_reps_from"]
