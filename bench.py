@@ -183,6 +183,46 @@ def persist_tpu_capture(result: dict) -> None:
             log(f"could not persist best TPU capture: {e!r}")
 
 
+# Phase -> the headline key whose presence in the persisted TPU capture
+# means the phase has already produced hardware evidence. Used by
+# BENCH_SKIP_CAPTURED (below) so a wedge-prone tunnel window is spent on
+# the phases that are still MISSING instead of re-measuring captured ones:
+# the 2026-08-01 window wedged during int8 pair 2 and starved int4,
+# resident-MFU and spec for the whole 2400 s deadline.
+PHASE_EVIDENCE_KEY = {
+    "host_stream": "host_readahead_speedup",
+    "pairs": "vs_baseline",
+    "refsched": "vs_reference_schedule",
+    "int8": "int8_speedup",
+    "int4": "int4_speedup",
+    "pallas": "pallas_speedup_4k",
+    "decode": "decode_speedup_4tok",
+    "resident_mfu": "mfu_resident",
+    "spec": "spec_mechanism_speedup",
+}
+
+
+def _phases_to_skip() -> set[str]:
+    """With BENCH_SKIP_CAPTURED=1 (set by the hardware-evidence watcher),
+    skip every phase whose headline metric is already in the persisted TPU
+    capture — including values the capture carried forward from an earlier
+    window, which is exactly the "we already have this on hardware" signal.
+    persist_tpu_capture's carry-forward keeps the skipped phases' numbers in
+    the artifact. Off by default: a plain `python bench.py` (the driver's
+    round-end run) always measures everything fresh."""
+    if os.environ.get("BENCH_SKIP_CAPTURED", "").lower() in (
+        "", "0", "false", "no",
+    ):
+        return set()
+    cap = load_tpu_capture(TPU_CAPTURE_PATH) or {}
+    skip = {
+        ph for ph, k in PHASE_EVIDENCE_KEY.items() if cap.get(k) is not None
+    }
+    if skip:
+        log(f"BENCH_SKIP_CAPTURED: skipping already-captured phases {sorted(skip)}")
+    return skip
+
+
 def _probe_backend_hung(timeout_s: float = 90.0) -> bool:
     """Detect a WEDGED accelerator backend via a subprocess probe.
 
@@ -1021,6 +1061,9 @@ def run_bench(result: dict) -> None:
     log(f"devices: {devs}")
     on_tpu = devs[0].platform != "cpu"
     result["platform"] = devs[0].platform
+    # Skip-captured only applies where the capture it reads is meaningful
+    # (a TPU run persisting to the TPU capture file).
+    skip = _phases_to_skip() if on_tpu else set()
 
     from flexible_llm_sharding_tpu.config import FrameworkConfig
     from flexible_llm_sharding_tpu.utils.metrics import (
@@ -1051,7 +1094,10 @@ def run_bench(result: dict) -> None:
 
     # Host-side pipeline first: accelerator-independent, so even a wedged
     # tunnel run still captures the host half of the weight stream.
-    bench_host_stream(result, model_path, budget_left)
+    if "host_stream" in skip:
+        log("skipping host-stream bench (already captured)")
+    else:
+        bench_host_stream(result, model_path, budget_left)
 
     def fw(prefetch: int | None) -> FrameworkConfig:
         return FrameworkConfig(
@@ -1214,23 +1260,26 @@ def run_bench(result: dict) -> None:
         # conditions, and the MEDIAN of per-pair ratios rejects the rep
         # where the link flipped mid-pair. Time-bounded so a slow link
         # still yields at least one pair inside the watchdog deadline.
-        log("serialized (prefetch=0, reference schedule), paired reps ...")
-        ratios = []
-        for i in range(3):
-            _, w_ser, _ = run_once(fw(0), prompts, tok)
-            _, w_ovl, _ = run_once(cfg_default, prompts, tok)
-            ratios.append(w_ser / w_ovl)
-            wall_overlap = min(wall_overlap, w_ovl)
-            log(f"  pair {i}: serial={w_ser:.2f}s overlap={w_ovl:.2f}s "
-                f"ratio={ratios[-1]:.3f}")
-            _ratio_stats(result, "vs_baseline", ratios)
-            result["overlap_pair_ratios"] = [round(r, 3) for r in ratios]
-            if budget_left() < 0.6:
-                # Leave the majority of the deadline for the int8 pairs and
-                # the pallas/decode phases — a slow link must not starve
-                # them into carried_forward-only captures.
-                log("  schedule-pair budget exhausted; stopping reps")
-                break
+        if "pairs" in skip:
+            log("skipping schedule pairs (already captured)")
+        else:
+            log("serialized (prefetch=0, reference schedule), paired reps ...")
+            ratios = []
+            for i in range(3):
+                _, w_ser, _ = run_once(fw(0), prompts, tok)
+                _, w_ovl, _ = run_once(cfg_default, prompts, tok)
+                ratios.append(w_ser / w_ovl)
+                wall_overlap = min(wall_overlap, w_ovl)
+                log(f"  pair {i}: serial={w_ser:.2f}s overlap={w_ovl:.2f}s "
+                    f"ratio={ratios[-1]:.3f}")
+                _ratio_stats(result, "vs_baseline", ratios)
+                result["overlap_pair_ratios"] = [round(r, 3) for r in ratios]
+                if budget_left() < 0.6:
+                    # Leave the majority of the deadline for the int8 pairs
+                    # and the pallas/decode phases — a slow link must not
+                    # starve them into carried_forward-only captures.
+                    log("  schedule-pair budget exhausted; stopping reps")
+                    break
         # The pairs may have seen a faster link than the headline reps;
         # keep throughput/MFU consistent with the best overlapped wall.
         if total_tokens / wall_overlap > (result["value"] or 0):
@@ -1239,7 +1288,9 @@ def run_bench(result: dict) -> None:
     # The reference's ACTUAL schedule (per-tensor sync uploads, no scan,
     # per-prompt loop) — measured on both platforms: on CPU the schedule
     # differences (batching, scan, stacked uploads) exist without a link.
-    if budget_left() > 0.42:
+    if "refsched" in skip:
+        log("skipping reference-schedule bench (already captured)")
+    elif budget_left() > 0.42:
         try:
             bench_reference_schedule(
                 jax, cfg_default, prompts, tok, result, budget_left
@@ -1302,6 +1353,9 @@ def run_bench(result: dict) -> None:
             ("int8", "int8_speedup", 0.35),
             ("int4", "int4_speedup", 0.28),
         ):
+            if qdtype in skip:
+                log(f"skipping {qdtype} bench (already captured)")
+                continue
             if budget_left() < floor:
                 log(f"skipping {qdtype} bench (deadline budget exhausted)")
                 continue
@@ -1325,24 +1379,34 @@ def run_bench(result: dict) -> None:
         log("quantized bench setup failed:\n" + traceback.format_exc())
 
     if on_tpu:
-        try:
-            bench_pallas(jax, result)
-        except Exception:
-            log("pallas bench failed:\n" + traceback.format_exc())
-        try:
-            # Small prompt set: the recompute baseline costs n_tok full
-            # streaming passes, twice (warmup + measure).
-            bench_decode(fw(2), prompts[:2], tok, result)
-        except Exception:
-            log("decode bench failed:\n" + traceback.format_exc())
-        if budget_left() > 0.15:
+        if "pallas" in skip:
+            log("skipping pallas bench (already captured)")
+        else:
+            try:
+                bench_pallas(jax, result)
+            except Exception:
+                log("pallas bench failed:\n" + traceback.format_exc())
+        if "decode" in skip:
+            log("skipping decode bench (already captured)")
+        else:
+            try:
+                # Small prompt set: the recompute baseline costs n_tok full
+                # streaming passes, twice (warmup + measure).
+                bench_decode(fw(2), prompts[:2], tok, result)
+            except Exception:
+                log("decode bench failed:\n" + traceback.format_exc())
+        if "resident_mfu" in skip:
+            log("skipping resident MFU bench (already captured)")
+        elif budget_left() > 0.15:
             try:
                 bench_resident_mfu(jax, result, budget_left)
             except Exception:
                 log("resident MFU bench failed:\n" + traceback.format_exc())
         else:
             log("skipping resident MFU bench (deadline budget exhausted)")
-        if budget_left() > 0.12:
+        if "spec" in skip:
+            log("skipping spec bench (already captured)")
+        elif budget_left() > 0.12:
             try:
                 bench_spec(fw(2), tok, result, budget_left)
             except Exception:

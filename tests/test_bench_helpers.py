@@ -41,6 +41,38 @@ def test_ratio_stats_contract():
     assert r["x_inconclusive"] is False
 
 
+def test_skip_captured_phases(tmp_path, monkeypatch):
+    """BENCH_SKIP_CAPTURED skips exactly the phases whose headline metric is
+    already in the persisted TPU capture (including carried-forward values),
+    so a wedge-prone tunnel window is spent on the MISSING phases. Off by
+    default — the driver's round-end `python bench.py` measures fresh."""
+    cap = tmp_path / "BENCH_TPU_latest.json"
+    monkeypatch.setattr(bench, "TPU_CAPTURE_PATH", str(cap))
+
+    # Default off: even with a full capture present, nothing is skipped.
+    cap.write_text(
+        '{"platform": "tpu", "vs_baseline": 1.2, "int8_speedup": 1.5}'
+    )
+    monkeypatch.delenv("BENCH_SKIP_CAPTURED", raising=False)
+    assert bench._phases_to_skip() == set()
+    # "=0"/"false" must also mean off (an operator forcing a fresh run).
+    monkeypatch.setenv("BENCH_SKIP_CAPTURED", "0")
+    assert bench._phases_to_skip() == set()
+
+    monkeypatch.setenv("BENCH_SKIP_CAPTURED", "1")
+    assert bench._phases_to_skip() == {"pairs", "int8"}
+
+    # Every phase name maps to a key the persist path can actually carry.
+    assert set(bench.PHASE_EVIDENCE_KEY.values()) <= set(bench.HEADLINE_KEYS)
+
+    # A CPU capture (or none) never suppresses phases: load_tpu_capture
+    # only returns platform=tpu captures.
+    cap.write_text('{"platform": "cpu", "vs_baseline": 1.2}')
+    assert bench._phases_to_skip() == set()
+    cap.unlink()
+    assert bench._phases_to_skip() == set()
+
+
 @pytest.fixture
 def bench_model(tmp_path, monkeypatch):
     """The bench's own synthetic checkpoint, built under a tmp dir.
