@@ -317,6 +317,16 @@ def main() -> None:
             if prior.get("config") == cfg and prior.get("workload") == workload:
                 result = prior
                 merged_prior = True
+                # Provenance for merged big legs: a cpu-era artifact's legs
+                # must keep platform=cpu even after a later on-TPU
+                # invocation pops the TOP-LEVEL cpu marking — otherwise the
+                # merge silently relabels CPU captures as hardware evidence.
+                prior_leg_platform = (
+                    "cpu" if prior.get("platform") == "cpu" else "tpu"
+                )
+                for leg in ("cpu", "tpu", "disk_resume"):
+                    if isinstance(result.get(leg), dict):
+                        result[leg].setdefault("platform", prior_leg_platform)
         except ValueError:
             pass
     result.update(
@@ -349,6 +359,7 @@ def main() -> None:
     # (the CLI children own it); the probe itself is the shared helper so
     # BENCH and SCALE artifacts report comparable numbers.
     peak_flops = None
+    probed_kind = None  # set ONLY by a successful probe THIS invocation
     if big:
         try:
             # Hard timeout: a wedged tunnel otherwise hangs the probe child
@@ -371,6 +382,7 @@ def main() -> None:
             lines = probe.stdout.strip().splitlines()
             result["host_to_hbm_gbps"] = round(float(lines[-2]), 3)
             result["device_kind"] = lines[-1]
+            probed_kind = lines[-1]
             log(f"host->HBM link: {result['host_to_hbm_gbps']} GB/s "
                 f"({result['device_kind']})")
         except subprocess.TimeoutExpired:
@@ -383,17 +395,20 @@ def main() -> None:
         # holds on any backend; throughput from a CPU capture is not a TPU
         # number, and the hardware-evidence watcher keeps retrying until a
         # real one exists.
-        if args.backend == "cpu" or "cpu" in (
-            result.get("device_kind") or ""
-        ).lower():
-            result["platform"] = "cpu"
-            result["platform_note"] = (
-                "captured on the XLA:CPU backend (TPU tunnel unavailable); "
-                "a later on-TPU scale_demo run replaces this artifact"
-            )
-        else:
-            result.pop("platform", None)
-            result.pop("platform_note", None)
+        # FAIL CLOSED: legs are tagged tpu only when the probe POSITIVELY
+        # identified a non-CPU device this invocation (a stale merged
+        # device_kind or a timed-out probe must not stamp unverified runs
+        # as hardware evidence). The TOP-LEVEL platform marking is
+        # recomputed from the per-leg tags after the legs run, so one
+        # CPU-fallback leg can't downgrade an artifact that already holds
+        # hardware legs, and vice versa.
+        leg_platform = (
+            "tpu"
+            if args.backend != "cpu"
+            and probed_kind is not None
+            and "cpu" not in probed_kind.lower()
+            else "cpu"
+        )
 
         # Analytic model FLOPs/token (MFU numerator) for the built config;
         # each run's mfu derives from its tokens_per_sec in the post-pass.
@@ -461,6 +476,7 @@ def main() -> None:
     if "cpu" in configs:
         log("CLI run: storage_location=cpu, layer_num_per_shard=1 ...")
         stats_cpu = run_cli(cli_argv("cpu"), "cpu", backend=args.backend)
+        stats_cpu["platform"] = leg_platform
         log(f"cpu stats: {stats_cpu}")
         result["cpu"] = stats_cpu
 
@@ -476,6 +492,7 @@ def main() -> None:
         log("CLI run: storage_location=tpu, layer_num_per_shard=8 ...")
         stats_tpu = run_cli(cli_argv("tpu", lnps=8, prefetch=1), "tpu",
                             backend=args.backend)
+        stats_tpu["platform"] = leg_platform
         log(f"tpu stats: {stats_tpu}")
         result["tpu"] = stats_tpu
         if scores is not None:
@@ -504,6 +521,7 @@ def main() -> None:
         t0 = time.perf_counter()
         stats_disk = run_cli(cli_argv("disk", resume=True), "disk-resumed",
                              backend=args.backend)
+        stats_disk["platform"] = leg_platform
         stats_disk["resumed"] = True
         stats_disk["resumed_after_shards"] = kill_info["completed_shards"]
         stats_disk["resume_wall_s"] = round(time.perf_counter() - t0, 3)
@@ -521,6 +539,26 @@ def main() -> None:
                     np.allclose(a, b, rtol=2e-2, atol=2e-2)
                     for a, b in zip(scores, dscores)
                 )
+            )
+
+    # Top-level platform marking, recomputed from per-leg provenance: the
+    # artifact is hardware evidence iff at least one big leg ran on a
+    # positively-probed TPU. Mesh-only invocations (big=False) leave the
+    # marking untouched.
+    if big:
+        has_hw_leg = any(
+            isinstance(result.get(leg), dict)
+            and result[leg].get("platform") == "tpu"
+            for leg in ("cpu", "tpu", "disk_resume")
+        )
+        if has_hw_leg:
+            result.pop("platform", None)
+            result.pop("platform_note", None)
+        else:
+            result["platform"] = "cpu"
+            result["platform_note"] = (
+                "captured on the XLA:CPU backend (TPU tunnel unavailable); "
+                "a later on-TPU scale_demo run replaces this artifact"
             )
 
     # --- dp8 / mp8 (BASELINE configs 5 / 4) on the 8-virtual-device mesh ----
@@ -651,7 +689,7 @@ def main() -> None:
                     fpt * stats["tokens_per_sec"] / peak_flops, 6
                 )
 
-    peak = result.get("cpu", {}).get("peak_hbm_gb")
+    peak = (result.get("cpu") or {}).get("peak_hbm_gb")
     if peak is not None:
         result["peak_hbm_frac_of_model"] = round(peak / result["model_gb"], 4)
         # BASELINE.md's ≤16GB-for-70B(140GB) target is peak/model ≈ 0.11/chip

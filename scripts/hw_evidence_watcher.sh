@@ -53,7 +53,26 @@ while true; do
     # captured phase multiplies tunnel exposure for nothing) and the exit
     # check. A partial/crashed GB emission (bench.py's gb_watchdog writes
     # {"partial": true, ...}) must NOT count as captured.
-    scale_ok() { python -c "import json,sys; sys.exit(0 if json.load(open('SCALE_r05.json')).get('platform') != 'cpu' else 1)" 2>/dev/null; }
+    # scale_ok: hardware provenance AND all three big legs documented —
+    # top-level platform alone would pass a fresh tpu-only artifact that
+    # lost the cpu/disk legs (e.g. the merge was skipped on a config
+    # mismatch).
+    scale_ok() { python -c "
+import json, sys
+d = json.load(open('SCALE_r05.json'))
+ok = d.get('platform') != 'cpu' and all(
+    isinstance(d.get(k), dict) for k in ('cpu', 'tpu', 'disk_resume'))
+sys.exit(0 if ok else 1)" 2>/dev/null; }
+    # Prior cpu-era legs present -> only the cheap tpu leg is needed (it
+    # merges in); otherwise run the full set so the artifact stays complete.
+    scale_configs() { python -c "
+import json
+try:
+    d = json.load(open('SCALE_r05.json'))
+    legs = all(isinstance(d.get(k), dict) for k in ('cpu', 'disk_resume'))
+except Exception:
+    legs = False
+print('tpu' if legs else 'cpu,tpu,disk')"; }
     # Bench is complete only when EVERY phase's headline metric is on
     # hardware (possibly via carry-forward across windows) — the single
     # platform=tpu check let the watcher exit with int4/resident-MFU/spec
@@ -71,9 +90,17 @@ sys.exit(0 if d and not missing else 1)
       # scale_demo FIRST: with --keep it builds + splits the GB checkpoint
       # the GB bench then reuses (a fresh tree would otherwise skip the GB
       # bench this cycle and burn a whole extra multi-hour retry).
+      # Only the tpu-storage leg: it merges into the committed cpu-era
+      # artifact (config+workload match) and is the cheapest hardware
+      # upgrade. The cpu-storage leg is NOT re-run on TPU — each leg
+      # streams the full 13.5 GB over a link that wedges after ~20-40 min,
+      # and the GB bench below already streams storage=cpu on hardware.
+      # Per-leg `platform` tags keep the merged artifact's provenance
+      # honest (cpu-era legs stay marked cpu).
       if ! scale_ok; then
-        echo "$(date -u +%H:%M:%S) running scale_demo" >> /tmp/hw_watcher.log
-        timeout -k 10 7200 python scale_demo.py --configs cpu,tpu,disk \
+        CFG=$(scale_configs)
+        echo "$(date -u +%H:%M:%S) running scale_demo (configs $CFG)" >> /tmp/hw_watcher.log
+        timeout -k 10 3600 python scale_demo.py --configs "$CFG" \
           --out SCALE_r05.json --keep > /tmp/scale_hw.log 2>&1
         rc=$?
         echo "$(date -u +%H:%M:%S) scale_demo rc=$rc artifact: $(ls -la SCALE_r05.json 2>/dev/null)" >> /tmp/hw_watcher.log
@@ -88,6 +115,23 @@ sys.exit(0 if d and not missing else 1)
         echo "$(date -u +%H:%M:%S) GB bench rc=$rc" >> /tmp/hw_watcher.log
         commit_artifacts "GB-scale bench capture"
       fi
+      # Everything else captured? Upgrade the disk-mode SIGKILL+resume leg
+      # to hardware — optional (the cpu-era capture already documents the
+      # capability), so it gets at most 2 attempts and then stops gating
+      # the exit below. Per-leg platform tags in the artifact keep the
+      # provenance honest whatever backend the attempt lands on.
+      disk_leg_ok() { python -c "import json,sys; d=json.load(open('SCALE_r05.json')); sys.exit(0 if (d.get('disk_resume') or {}).get('platform')=='tpu' else 1)" 2>/dev/null; }
+      disk_attempts() { cat /tmp/disk_leg_attempts 2>/dev/null || echo 0; }
+      if scale_ok && gb_ok && bench_complete && ! disk_leg_ok \
+        && [ "$(disk_attempts)" -lt 2 ]; then
+        echo "$(($(disk_attempts) + 1))" > /tmp/disk_leg_attempts
+        echo "$(date -u +%H:%M:%S) running scale_demo (disk leg, attempt $(disk_attempts))" >> /tmp/hw_watcher.log
+        timeout -k 10 3600 python scale_demo.py --configs disk \
+          --out SCALE_r05.json --keep > /tmp/scale_hw.log 2>&1
+        rc=$?
+        echo "$(date -u +%H:%M:%S) scale_demo disk rc=$rc" >> /tmp/hw_watcher.log
+        commit_artifacts "GB-scale disk-mode SIGKILL+resume leg (SCALE_r05)"
+      fi
       # Only stop once every artifact is genuinely captured — a tunnel drop
       # mid-run (the very failure mode this watcher exists for) must keep
       # retrying. A CPU-fallback SCALE capture (platform=cpu) does NOT
@@ -95,7 +139,8 @@ sys.exit(0 if d and not missing else 1)
       # checkpoint it benches exists.
       if scale_ok \
         && { [ ! -d scale_tmp/native_checkpoint ] || gb_ok; } \
-        && bench_complete; then
+        && bench_complete \
+        && { disk_leg_ok || [ "$(disk_attempts)" -ge 2 ]; }; then
         echo "$(date -u +%H:%M:%S) all hardware evidence captured" >> /tmp/hw_watcher.log
         exit 0
       fi
