@@ -66,13 +66,19 @@ INPUTS = {
     "overlap_efficiency": (
         0.947,
         "BENCH_r04.json overlap_efficiency_forced (second run 0.953; "
-        "min taken); executor's own produce/wait timers",
+        "min taken); executor's own produce/wait timers. The 2026-08-01 "
+        "hardware capture measured 0.986 on the real tunnel link "
+        "(BENCH_TPU_latest.json overlap_efficiency) — the smaller "
+        "CPU-forced value is kept as the input (conservative)",
     ),
     "beta_ref_compute_factor": (
         1.139,
         "BENCH_r04.json vs_reference_schedule on the linkless CPU backend "
         "(spread [1.111, 1.151], conclusive): pure schedule effect — "
-        "understates the MXU batching win, so conservative",
+        "understates the MXU batching win, so conservative. The 2026-08-01 "
+        "hardware capture's median 1.346 (BENCH_TPU_latest.json, flagged "
+        "inconclusive: the tunnel flipped speed mid-pair) is consistent "
+        "with, and not smaller than, this input",
     ),
     "sigma_ref_upload_factor": (
         1.0,
