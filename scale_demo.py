@@ -53,6 +53,53 @@ def log(msg: str) -> None:
     print(f"[scale_demo] {msg}", file=sys.stderr, flush=True)
 
 
+# --- Platform provenance (unit-tested in tests/test_scale_demo_marking.py) --
+
+BIG_LEGS = ("cpu", "tpu", "disk_resume")
+
+
+def resolve_leg_platform(backend: str, probed_kind: str | None) -> str:
+    """FAIL CLOSED: a leg is hardware evidence only when the bandwidth
+    probe POSITIVELY identified a non-CPU device in the same invocation —
+    a stale merged device_kind or a timed-out probe must not stamp
+    unverified runs as tpu."""
+    if backend != "cpu" and probed_kind and "cpu" not in probed_kind.lower():
+        return "tpu"
+    return "cpu"
+
+
+def tag_prior_legs(result: dict, prior_platform: str | None) -> None:
+    """Provenance for big legs inherited from a merged artifact: a cpu-era
+    artifact's legs must keep platform=cpu even after a later on-TPU
+    invocation pops the TOP-LEVEL cpu marking — otherwise the merge
+    silently relabels CPU captures as hardware evidence."""
+    leg_platform = "cpu" if prior_platform == "cpu" else "tpu"
+    for leg in BIG_LEGS:
+        if isinstance(result.get(leg), dict):
+            result[leg].setdefault("platform", leg_platform)
+
+
+def recompute_platform_marking(result: dict) -> None:
+    """Top-level platform from per-leg provenance: the artifact is hardware
+    evidence iff at least one big leg ran on a positively-probed TPU. One
+    CPU-fallback leg can't downgrade an artifact holding hardware legs,
+    and vice versa."""
+    has_hw_leg = any(
+        isinstance(result.get(leg), dict)
+        and result[leg].get("platform") == "tpu"
+        for leg in BIG_LEGS
+    )
+    if has_hw_leg:
+        result.pop("platform", None)
+        result.pop("platform_note", None)
+    else:
+        result["platform"] = "cpu"
+        result["platform_note"] = (
+            "captured on the XLA:CPU backend (TPU tunnel unavailable); "
+            "a later on-TPU scale_demo run replaces this artifact"
+        )
+
+
 # ---------------------------------------------------------------------------
 # 1. Synthetic HF checkpoint (sharded safetensors + index), GB scale
 # ---------------------------------------------------------------------------
@@ -317,16 +364,7 @@ def main() -> None:
             if prior.get("config") == cfg and prior.get("workload") == workload:
                 result = prior
                 merged_prior = True
-                # Provenance for merged big legs: a cpu-era artifact's legs
-                # must keep platform=cpu even after a later on-TPU
-                # invocation pops the TOP-LEVEL cpu marking — otherwise the
-                # merge silently relabels CPU captures as hardware evidence.
-                prior_leg_platform = (
-                    "cpu" if prior.get("platform") == "cpu" else "tpu"
-                )
-                for leg in ("cpu", "tpu", "disk_resume"):
-                    if isinstance(result.get(leg), dict):
-                        result[leg].setdefault("platform", prior_leg_platform)
+                tag_prior_legs(result, prior.get("platform"))
         except ValueError:
             pass
     result.update(
@@ -395,20 +433,7 @@ def main() -> None:
         # holds on any backend; throughput from a CPU capture is not a TPU
         # number, and the hardware-evidence watcher keeps retrying until a
         # real one exists.
-        # FAIL CLOSED: legs are tagged tpu only when the probe POSITIVELY
-        # identified a non-CPU device this invocation (a stale merged
-        # device_kind or a timed-out probe must not stamp unverified runs
-        # as hardware evidence). The TOP-LEVEL platform marking is
-        # recomputed from the per-leg tags after the legs run, so one
-        # CPU-fallback leg can't downgrade an artifact that already holds
-        # hardware legs, and vice versa.
-        leg_platform = (
-            "tpu"
-            if args.backend != "cpu"
-            and probed_kind is not None
-            and "cpu" not in probed_kind.lower()
-            else "cpu"
-        )
+        leg_platform = resolve_leg_platform(args.backend, probed_kind)
 
         # Analytic model FLOPs/token (MFU numerator) for the built config;
         # each run's mfu derives from its tokens_per_sec in the post-pass.
@@ -541,25 +566,9 @@ def main() -> None:
                 )
             )
 
-    # Top-level platform marking, recomputed from per-leg provenance: the
-    # artifact is hardware evidence iff at least one big leg ran on a
-    # positively-probed TPU. Mesh-only invocations (big=False) leave the
-    # marking untouched.
+    # Mesh-only invocations (big=False) leave the marking untouched.
     if big:
-        has_hw_leg = any(
-            isinstance(result.get(leg), dict)
-            and result[leg].get("platform") == "tpu"
-            for leg in ("cpu", "tpu", "disk_resume")
-        )
-        if has_hw_leg:
-            result.pop("platform", None)
-            result.pop("platform_note", None)
-        else:
-            result["platform"] = "cpu"
-            result["platform_note"] = (
-                "captured on the XLA:CPU backend (TPU tunnel unavailable); "
-                "a later on-TPU scale_demo run replaces this artifact"
-            )
+        recompute_platform_marking(result)
 
     # --- dp8 / mp8 (BASELINE configs 5 / 4) on the 8-virtual-device mesh ----
     # Real multi-chip hardware isn't reachable from this rig (one tunneled

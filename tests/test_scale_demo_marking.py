@@ -1,0 +1,75 @@
+"""Platform-provenance contract for scale_demo's merged artifacts.
+
+The hardware-evidence watcher captures GB-scale legs one at a time across
+unpredictable tunnel windows and merges them into one artifact
+(SCALE_r05.json); these rules are what keep that merge honest:
+
+- a leg is tagged tpu only when the bandwidth probe POSITIVELY identified
+  a non-CPU device in the same invocation (fail closed);
+- legs inherited from a merged cpu-era artifact keep platform=cpu;
+- the top-level cpu marking reflects per-leg provenance, so a later
+  CPU-fallback leg can't downgrade an artifact holding hardware legs and
+  a hardware leg can't relabel cpu-era legs.
+
+The end-to-end paths (tiny-model cpu/disk run, dp8 merge into a copy of
+the real artifact) were driven live; these tests pin the pure logic.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from scale_demo import (  # noqa: E402
+    recompute_platform_marking,
+    resolve_leg_platform,
+    tag_prior_legs,
+)
+
+
+def test_leg_platform_fails_closed():
+    assert resolve_leg_platform("auto", "TPU v5 lite") == "tpu"
+    # Forced cpu backend: never hardware, whatever the probe said.
+    assert resolve_leg_platform("cpu", "TPU v5 lite") == "cpu"
+    # Probe timed out / failed to parse -> no positive identification.
+    assert resolve_leg_platform("auto", None) == "cpu"
+    assert resolve_leg_platform("auto", "") == "cpu"
+    # Probe resolved to the XLA:CPU fallback.
+    assert resolve_leg_platform("auto", "cpu") == "cpu"
+
+
+def test_prior_legs_keep_cpu_provenance():
+    result = {"cpu": {"wall_s": 1.0}, "disk_resume": {"wall_s": 2.0},
+              "tpu": None, "platform": "cpu"}
+    tag_prior_legs(result, "cpu")
+    assert result["cpu"]["platform"] == "cpu"
+    assert result["disk_resume"]["platform"] == "cpu"
+    assert result["tpu"] is None  # null legs untouched
+
+    # A tpu-era prior (no top-level cpu marking) tags its legs tpu.
+    hw = {"cpu": {"wall_s": 1.0}}
+    tag_prior_legs(hw, None)
+    assert hw["cpu"]["platform"] == "tpu"
+
+    # Already-tagged legs are never overwritten.
+    mixed = {"cpu": {"platform": "tpu"}}
+    tag_prior_legs(mixed, "cpu")
+    assert mixed["cpu"]["platform"] == "tpu"
+
+
+def test_top_level_marking_follows_leg_evidence():
+    # All-cpu legs -> the artifact is marked cpu.
+    r = {"cpu": {"platform": "cpu"}, "disk_resume": {"platform": "cpu"}}
+    recompute_platform_marking(r)
+    assert r["platform"] == "cpu" and "platform_note" in r
+
+    # One hardware leg lifts the marking...
+    r["tpu"] = {"platform": "tpu"}
+    recompute_platform_marking(r)
+    assert "platform" not in r and "platform_note" not in r
+
+    # ...and a later CPU-fallback leg cannot put it back (downgrade
+    # protection): the hardware leg still wins.
+    r["disk_resume"] = {"platform": "cpu"}
+    recompute_platform_marking(r)
+    assert "platform" not in r
