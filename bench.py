@@ -1320,81 +1320,93 @@ def run_bench(result: dict) -> None:
             log("skipping spec bench (deadline budget exhausted)")
         return
 
-    try:
-        # int8/int4 weight streaming: same workload, half / a quarter of
-        # the bytes over the host->HBM link (the binding constraint of this
-        # design) with on-device dequant. The ratios quantify the opt-in
-        # transfer-compression modes. TPU-only (the early return above):
-        # on CPU the numbers arrive via the embedded tpu_capture instead.
-        from flexible_llm_sharding_tpu.utils.checkpoint import (
-            NATIVE_LAYOUT_MARKER,
-            requantize_native,
-        )
+    # TPU-only phases from here (the early return above handled CPU), as
+    # closures so capture windows can reorder them (below).
 
-        import dataclasses
-        import shutil
+    def quant_phase() -> None:
+        try:
+            # int8/int4 weight streaming: same workload, half / a quarter
+            # of the bytes over the host->HBM link (the binding constraint
+            # of this design) with on-device dequant. The ratios quantify
+            # the opt-in transfer-compression modes. TPU-only: on CPU the
+            # numbers arrive via the embedded tpu_capture instead.
+            from flexible_llm_sharding_tpu.utils.checkpoint import (
+                NATIVE_LAYOUT_MARKER,
+                requantize_native,
+            )
 
-        def quant_cfg(qdtype: str):
-            qpath = f"{model_path}-{qdtype}"
-            # The layout marker is written LAST by requantize_native, so a
-            # killed/partial conversion never looks complete; rebuild from
-            # scratch in that case rather than streaming a broken dir.
-            if not os.path.exists(os.path.join(qpath, NATIVE_LAYOUT_MARKER)):
-                shutil.rmtree(qpath, ignore_errors=True)
-                requantize_native(model_path, qpath, dtype=qdtype)
-            return dataclasses.replace(fw(2), model_path=qpath)
+            import dataclasses
+            import shutil
 
-        # Paired with fresh bf16 runs (same rationale as the schedule
-        # pairs: the tunnel's speed drifts too much to reuse an earlier
-        # bf16 wall measured minutes ago).
-        # 3 pairs so the median can actually REJECT a link-flip outlier
-        # (the median of 2 is their mean — no rejection at all).
-        for qdtype, key, floor in (
-            ("int8", "int8_speedup", 0.35),
-            ("int4", "int4_speedup", 0.28),
-        ):
-            if qdtype in skip:
-                log(f"skipping {qdtype} bench (already captured)")
-                continue
-            if budget_left() < floor:
-                log(f"skipping {qdtype} bench (deadline budget exhausted)")
-                continue
-            try:  # per-dtype isolation: an int8 failure must not kill int4
-                qc = quant_cfg(qdtype)
-                run_once(qc, prompts, tok)  # warm/compile
-                ratios = []
-                for i in range(3):
-                    _, wall_q, _ = run_once(qc, prompts, tok)
-                    _, w_bf16, _ = run_once(cfg_default, prompts, tok)
-                    ratios.append(w_bf16 / wall_q)
-                    log(f"{qdtype} pair {i}: q={wall_q:.2f}s "
-                        f"bf16={w_bf16:.2f}s ratio={ratios[-1]:.3f}")
-                    _ratio_stats(result, key, ratios)
-                    if budget_left() < floor:
-                        log(f"{qdtype} pair budget exhausted; stopping reps")
-                        break
-            except Exception:
-                log(f"{qdtype} bench failed:\n" + traceback.format_exc())
-    except Exception:
-        log("quantized bench setup failed:\n" + traceback.format_exc())
+            def quant_cfg(qdtype: str):
+                qpath = f"{model_path}-{qdtype}"
+                # The layout marker is written LAST by requantize_native,
+                # so a killed/partial conversion never looks complete;
+                # rebuild from scratch in that case rather than streaming
+                # a broken dir.
+                if not os.path.exists(
+                    os.path.join(qpath, NATIVE_LAYOUT_MARKER)
+                ):
+                    shutil.rmtree(qpath, ignore_errors=True)
+                    requantize_native(model_path, qpath, dtype=qdtype)
+                return dataclasses.replace(fw(2), model_path=qpath)
 
-    if on_tpu:
+            # Paired with fresh bf16 runs (same rationale as the schedule
+            # pairs: the tunnel's speed drifts too much to reuse an
+            # earlier bf16 wall measured minutes ago).
+            # 3 pairs so the median can actually REJECT a link-flip
+            # outlier (the median of 2 is their mean — no rejection).
+            for qdtype, key, floor in (
+                ("int8", "int8_speedup", 0.35),
+                ("int4", "int4_speedup", 0.28),
+            ):
+                if qdtype in skip:
+                    log(f"skipping {qdtype} bench (already captured)")
+                    continue
+                if budget_left() < floor:
+                    log(f"skipping {qdtype} bench (deadline budget exhausted)")
+                    continue
+                try:  # per-dtype isolation: int8 failure must not kill int4
+                    qc = quant_cfg(qdtype)
+                    run_once(qc, prompts, tok)  # warm/compile
+                    ratios = []
+                    for i in range(3):
+                        _, wall_q, _ = run_once(qc, prompts, tok)
+                        _, w_bf16, _ = run_once(cfg_default, prompts, tok)
+                        ratios.append(w_bf16 / wall_q)
+                        log(f"{qdtype} pair {i}: q={wall_q:.2f}s "
+                            f"bf16={w_bf16:.2f}s ratio={ratios[-1]:.3f}")
+                        _ratio_stats(result, key, ratios)
+                        if budget_left() < floor:
+                            log(f"{qdtype} pair budget exhausted; "
+                                "stopping reps")
+                            break
+                except Exception:
+                    log(f"{qdtype} bench failed:\n" + traceback.format_exc())
+        except Exception:
+            log("quantized bench setup failed:\n" + traceback.format_exc())
+
+    def pallas_phase() -> None:
         if "pallas" in skip:
             log("skipping pallas bench (already captured)")
-        else:
-            try:
-                bench_pallas(jax, result)
-            except Exception:
-                log("pallas bench failed:\n" + traceback.format_exc())
+            return
+        try:
+            bench_pallas(jax, result)
+        except Exception:
+            log("pallas bench failed:\n" + traceback.format_exc())
+
+    def decode_phase() -> None:
         if "decode" in skip:
             log("skipping decode bench (already captured)")
-        else:
-            try:
-                # Small prompt set: the recompute baseline costs n_tok full
-                # streaming passes, twice (warmup + measure).
-                bench_decode(fw(2), prompts[:2], tok, result)
-            except Exception:
-                log("decode bench failed:\n" + traceback.format_exc())
+            return
+        try:
+            # Small prompt set: the recompute baseline costs n_tok full
+            # streaming passes, twice (warmup + measure).
+            bench_decode(fw(2), prompts[:2], tok, result)
+        except Exception:
+            log("decode bench failed:\n" + traceback.format_exc())
+
+    def resident_phase() -> None:
         if "resident_mfu" in skip:
             log("skipping resident MFU bench (already captured)")
         elif budget_left() > 0.15:
@@ -1404,6 +1416,8 @@ def run_bench(result: dict) -> None:
                 log("resident MFU bench failed:\n" + traceback.format_exc())
         else:
             log("skipping resident MFU bench (deadline budget exhausted)")
+
+    def spec_phase() -> None:
         if "spec" in skip:
             log("skipping spec bench (already captured)")
         elif budget_left() > 0.12:
@@ -1413,6 +1427,29 @@ def run_bench(result: dict) -> None:
                 log("spec bench failed:\n" + traceback.format_exc())
         else:
             log("skipping spec bench (deadline budget exhausted)")
+
+    phases = [
+        ("quant", quant_phase),
+        ("pallas", pallas_phase),
+        ("decode", decode_phase),
+        ("resident_mfu", resident_phase),
+        ("spec", spec_phase),
+    ]
+    if skip:
+        # Capture-window mode (BENCH_SKIP_CAPTURED): the tunnel tends to
+        # wedge after ~20-40 min of transfer traffic, so run the missing
+        # phases with the LEAST link traffic first — resident-MFU and spec
+        # barely touch the link; the quantized pairs re-stream the model
+        # up to 14 times. A wedge then costs the heaviest phase, not all
+        # of them.
+        light_first = {
+            "resident_mfu": 0, "spec": 1, "pallas": 2, "decode": 3,
+            "quant": 4,
+        }
+        phases.sort(key=lambda p: light_first[p[0]])
+        log("capture-window phase order: " + ", ".join(n for n, _ in phases))
+    for _, phase_fn in phases:
+        phase_fn()
 
 
 def run_gb_bench(
