@@ -181,6 +181,97 @@ def persist_tpu_capture(result: dict) -> None:
             log(f"promoted to best TPU capture -> {BEST_CAPTURE_PATH}")
         except OSError as e:  # pragma: no cover
             log(f"could not persist best TPU capture: {e!r}")
+    else:
+        # Worse link: no wholesale promotion, but link-NORMALIZED metrics
+        # (paired ratios / overlap efficiency / resident phase) still
+        # upgrade best when the new evidence is stronger or fills a gap.
+        merged, upgraded = _merge_best(best, cap)
+        if upgraded:
+            merged["upgraded_keys"] = sorted(
+                set(upgraded) | set(best.get("upgraded_keys") or [])
+            )
+            merged["upgraded_from"] = cap["captured_at"]
+            try:
+                with open(BEST_CAPTURE_PATH, "w") as f:
+                    json.dump(merged, f, indent=1)
+                log(
+                    "upgraded best TPU capture's link-normalized keys: "
+                    + ", ".join(upgraded)
+                )
+            except OSError as e:  # pragma: no cover
+                log(f"could not upgrade best TPU capture: {e!r}")
+
+
+# Link-NORMALIZED metrics: paired ratios (each pair sees ~the same link,
+# so the ratio cancels it), overlap efficiency (a fraction of the run's own
+# produce time), and the resident phase (no link traffic in the measured
+# window). These may upgrade the BEST capture even when the new run's link
+# is worse — unlike throughput/mfu/peak keys, which stay keyed to the best
+# link. Grouped so a median never travels without its spread/n/flags.
+RATIO_BASES = (
+    "vs_baseline",
+    "vs_reference_schedule",
+    "int8_speedup",
+    "int4_speedup",
+    "spec_decode_speedup",
+    "spec_mechanism_speedup",
+)
+RATIO_GROUP_EXTRAS = {
+    "vs_baseline": ("overlap_pair_ratios",),
+    "vs_reference_schedule": ("ref_schedule_score_maxerr",),
+    "spec_mechanism_speedup": ("spec_acceptance", "spec_pairs"),
+}
+# Fill-only: copied into best when absent there, never overwritten (no
+# conclusiveness metadata to arbitrate with).
+RATIO_SINGLETONS = (
+    "overlap_efficiency",
+    "overlap_efficiency_forced",
+    "pallas_speedup_4k",
+    "pallas_mla_speedup_4k",
+    "pallas_decode_speedup",
+    "decode_speedup_4tok",
+    "decode_score_maxerr",
+    "mfu_resident",
+    "resident_tokens_per_sec",
+    "resident_pass_s",
+    "resident_model_flops_per_token",
+    "host_readahead_speedup",
+)
+
+
+def _merge_best(best: dict, new: dict) -> tuple[dict, list[str]]:
+    """Upgrade the best capture's link-normalized metrics from a newer
+    capture measured on a worse link. A ratio group is taken when best
+    lacks it, when the new one is conclusive and best's isn't, or when
+    both are equally conclusive and the new one has more reps. Singleton
+    metrics only fill gaps. Returns (merged, upgraded keys)."""
+    merged = dict(best)
+    upgraded: list[str] = []
+    for base in RATIO_BASES:
+        if new.get(base) is None:
+            continue
+        take = merged.get(base) is None
+        if not take:
+            new_conc = not new.get(f"{base}_inconclusive", False)
+            cur_conc = not merged.get(f"{base}_inconclusive", False)
+            if new_conc != cur_conc:
+                take = new_conc
+            elif (new.get(f"{base}_n") or 1) > (merged.get(f"{base}_n") or 1):
+                take = True
+        if take:
+            keys = [base + s for s in ("", "_spread", "_inconclusive", "_n")]
+            keys += RATIO_GROUP_EXTRAS.get(base, ())
+            for k in keys:
+                if new.get(k) is not None:
+                    merged[k] = new[k]
+                else:
+                    merged.pop(k, None)
+            upgraded.append(base)
+    for k in RATIO_SINGLETONS:
+        if new.get(k) is not None and merged.get(k) is None:
+            merged[k] = new[k]
+            upgraded.append(k)
+    return merged, upgraded
 
 
 # Phase -> the headline key whose presence in the persisted TPU capture

@@ -73,6 +73,57 @@ def test_skip_captured_phases(tmp_path, monkeypatch):
     assert bench._phases_to_skip() == set()
 
 
+def test_merge_best_link_normalized_upgrades():
+    """Link-normalized ratio metrics upgrade the best capture from a
+    worse-link window; link-bound keys (value, mfu, host_to_hbm_gbps) are
+    never touched; a group always travels with its spread/n/flags."""
+    best = {
+        "value": 140.5, "host_to_hbm_gbps": 0.092, "mfu": 0.000348,
+        "vs_baseline": 1.043, "vs_baseline_n": 1,
+        "vs_baseline_inconclusive": True,
+        "int8_speedup": 1.684, "int8_speedup_n": 3,
+        "int8_speedup_inconclusive": False,
+    }
+    new = {
+        "value": 123.0, "host_to_hbm_gbps": 0.03,
+        "vs_baseline": 1.183, "vs_baseline_n": 3,
+        "vs_baseline_inconclusive": False,
+        "vs_baseline_spread": [1.036, 1.183, 1.318],
+        "overlap_pair_ratios": [1.183, 1.318, 1.036],
+        # worse evidence than best's conclusive n=3: must NOT take over
+        "int8_speedup": 1.533, "int8_speedup_n": 2,
+        "int8_speedup_inconclusive": False,
+        # gap-filling singleton
+        "overlap_efficiency": 0.986,
+        # gap-filling group (absent in best entirely)
+        "spec_mechanism_speedup": 2.1, "spec_mechanism_speedup_n": 4,
+        "spec_mechanism_speedup_inconclusive": False,
+    }
+    merged, upgraded = bench._merge_best(best, new)
+    # conclusive n=3 beats inconclusive n=1, and the group moved whole
+    assert merged["vs_baseline"] == 1.183
+    assert merged["vs_baseline_spread"] == [1.036, 1.183, 1.318]
+    assert merged["overlap_pair_ratios"] == [1.183, 1.318, 1.036]
+    assert merged["vs_baseline_inconclusive"] is False
+    # equal conclusiveness, fewer reps: best's int8 stays
+    assert merged["int8_speedup"] == 1.684 and merged["int8_speedup_n"] == 3
+    # link-bound keys untouched
+    assert merged["value"] == 140.5
+    assert merged["host_to_hbm_gbps"] == 0.092
+    assert merged["mfu"] == 0.000348
+    # gap fills
+    assert merged["overlap_efficiency"] == 0.986
+    assert merged["spec_mechanism_speedup"] == 2.1
+    assert set(upgraded) == {
+        "vs_baseline", "overlap_efficiency", "spec_mechanism_speedup",
+    }
+    # every merge-managed key is a headline key the persist path carries
+    group_keys = set(bench.RATIO_BASES) | set(bench.RATIO_SINGLETONS)
+    for extras in bench.RATIO_GROUP_EXTRAS.values():
+        group_keys |= set(extras)
+    assert group_keys <= set(bench.HEADLINE_KEYS)
+
+
 @pytest.fixture
 def bench_model(tmp_path, monkeypatch):
     """The bench's own synthetic checkpoint, built under a tmp dir.
