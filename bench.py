@@ -1854,10 +1854,44 @@ def main() -> None:
     import threading
 
     deadline = float(os.environ.get("BENCH_DEADLINE_S", "2400"))
+    # Capture-window escalation (set by the hardware-evidence watcher):
+    # when the result dict gains no new measurement for this long on TPU,
+    # assume the tunnel wedged and emit NOW instead of idling out the rest
+    # of the deadline (the 08:29Z window wasted ~17 min that way). A
+    # premature exit is cheap — the watcher retries in 5 min and
+    # BENCH_SKIP_CAPTURED skips everything already measured. Off (0) by
+    # default: a fresh full run keeps the plain deadline semantics.
+    stall_exit = float(os.environ.get("BENCH_STALL_EXIT_S", "0"))
 
     def watchdog():
-        time.sleep(deadline)
-        log(f"watchdog: {deadline:.0f}s deadline hit; emitting partial result")
+        t0 = time.monotonic()
+        last_snap = None
+        last_change = time.monotonic()
+        while True:
+            remaining = deadline - (time.monotonic() - t0)
+            if remaining <= 0:
+                reason = f"{deadline:.0f}s deadline hit"
+                break
+            time.sleep(min(30.0, remaining))
+            if not stall_exit:
+                continue
+            try:
+                snap_s = json.dumps(result, sort_keys=True, default=str)
+            except RuntimeError:  # mid-iteration mutation; try next tick
+                continue
+            if snap_s != last_snap:
+                last_snap = snap_s
+                last_change = time.monotonic()
+            elif (
+                result.get("platform") == "tpu"
+                and time.monotonic() - last_change >= stall_exit
+            ):
+                reason = (
+                    f"no new measurement for {stall_exit:.0f}s "
+                    "(wedged tunnel?)"
+                )
+                break
+        log(f"watchdog: {reason}; emitting partial result")
         # Snapshot: the main thread may still be inserting keys; a straight
         # dumps(result) could raise mid-iteration and kill this thread —
         # losing the partial emission this watchdog exists for.

@@ -37,7 +37,10 @@ while true; do
     # wedged mid-int8 and starved int4/resident-MFU/spec for the whole
     # deadline); already-captured numbers are carried forward by
     # persist_tpu_capture, so nothing is lost by skipping.
-    BENCH_SKIP_CAPTURED=1 BENCH_DEADLINE_S=2400 timeout -k 10 2700 python bench.py > /tmp/bench_hw.json 2> /tmp/bench_hw.log
+    # BENCH_STALL_EXIT_S: a wedge emits the partial capture after 15 min
+    # of no new measurements instead of idling out the deadline; the next
+    # 5-min retry skips everything already captured.
+    BENCH_SKIP_CAPTURED=1 BENCH_STALL_EXIT_S=900 BENCH_DEADLINE_S=2400 timeout -k 10 2700 python bench.py > /tmp/bench_hw.json 2> /tmp/bench_hw.log
     rc=$?  # save BEFORE the $(date)/$(cat) substitutions reset $?
     echo "$(date -u +%H:%M:%S) bench rc=$rc $(cat /tmp/bench_hw.json)" >> /tmp/hw_watcher.log
     commit_artifacts "TPU bench capture"
