@@ -213,9 +213,54 @@ def child_main(argv_json: str) -> None:
     cli.main(argv, tokenizer=BenchTokenizer())
 
 
+def _wait_with_stall_kill(proc, err_path: str, tag: str,
+                          stall_kill_min: float, poll_s: float = 30.0) -> int:
+    """Wait on a CLI child, killing it if the executor's own stall watchdog
+    (utils/metrics.py _WatchdogBar — '[stall] ... no progress for N min',
+    repeated every ~10 min while wedged) reports >= stall_kill_min minutes.
+    Only RECENT stall lines count (a recovered child goes silent, leaving
+    stale lines as the tail; while truly wedged a new line lands every
+    warning interval), so the kill fires ~one interval after the threshold
+    instead of waiting out the watcher's whole outer timeout."""
+    import re
+
+    stall_re = re.compile(r"no progress for (\d+(?:\.\d+)?) min")
+    seen = 0
+    last_stall: tuple[float, float] | None = None  # (monotonic ts, minutes)
+    while True:
+        try:
+            return proc.wait(timeout=poll_s)
+        except subprocess.TimeoutExpired:
+            pass
+        try:
+            size = os.path.getsize(err_path)
+            if size > seen:
+                with open(err_path, "rb") as ef:
+                    ef.seek(seen)
+                    new = ef.read().decode(errors="replace")
+                seen = size
+                hits = [float(m.group(1)) for m in stall_re.finditer(new)]
+                if hits:
+                    last_stall = (time.monotonic(), max(hits))
+        except OSError:
+            continue
+        if (
+            last_stall is not None
+            and last_stall[1] >= stall_kill_min
+            and time.monotonic() - last_stall[0] < 700
+        ):
+            proc.kill()
+            proc.wait()
+            raise RuntimeError(
+                f"CLI run '{tag}' stalled {last_stall[1]:.0f} min "
+                "(wedged tunnel?); killed so the watcher can retry"
+            )
+
+
 def run_cli(argv: list[str], tag: str, kill_after_marker: str | None = None,
             kill_min_shards: int = 4, backend: str = "auto",
-            virtual_devices: int = 0) -> dict:
+            virtual_devices: int = 0,
+            stall_kill_min: float | None = None) -> dict:
     """Run the CLI as a subprocess; parse its final JSON stats line.
 
     With ``kill_after_marker``, SIGKILL the child once the resume progress
@@ -258,7 +303,10 @@ def run_cli(argv: list[str], tag: str, kill_after_marker: str | None = None,
             cwd=ROOT,
         )
         if kill_after_marker is None:
-            rc = proc.wait()
+            if stall_kill_min is not None:
+                rc = _wait_with_stall_kill(proc, err_path, tag, stall_kill_min)
+            else:
+                rc = proc.wait()
             if rc != 0:
                 raise RuntimeError(
                     f"CLI run '{tag}' failed rc={rc}; tail:\n"
@@ -500,7 +548,8 @@ def main() -> None:
             scores = None
     if "cpu" in configs:
         log("CLI run: storage_location=cpu, layer_num_per_shard=1 ...")
-        stats_cpu = run_cli(cli_argv("cpu"), "cpu", backend=args.backend)
+        stats_cpu = run_cli(cli_argv("cpu"), "cpu", backend=args.backend,
+                            stall_kill_min=15)
         stats_cpu["platform"] = leg_platform
         log(f"cpu stats: {stats_cpu}")
         result["cpu"] = stats_cpu
@@ -516,7 +565,7 @@ def main() -> None:
         # weights-in-flight to ~2 shards so the whole run fits 16 GB HBM.
         log("CLI run: storage_location=tpu, layer_num_per_shard=8 ...")
         stats_tpu = run_cli(cli_argv("tpu", lnps=8, prefetch=1), "tpu",
-                            backend=args.backend)
+                            backend=args.backend, stall_kill_min=15)
         stats_tpu["platform"] = leg_platform
         log(f"tpu stats: {stats_tpu}")
         result["tpu"] = stats_tpu
@@ -545,7 +594,7 @@ def main() -> None:
         log("CLI run: --resume true ...")
         t0 = time.perf_counter()
         stats_disk = run_cli(cli_argv("disk", resume=True), "disk-resumed",
-                             backend=args.backend)
+                             backend=args.backend, stall_kill_min=15)
         stats_disk["platform"] = leg_platform
         stats_disk["resumed"] = True
         stats_disk["resumed_after_shards"] = kill_info["completed_shards"]

@@ -21,6 +21,7 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from scale_demo import (  # noqa: E402
+    _wait_with_stall_kill,
     recompute_platform_marking,
     resolve_leg_platform,
     tag_prior_legs,
@@ -55,6 +56,36 @@ def test_prior_legs_keep_cpu_provenance():
     mixed = {"cpu": {"platform": "tpu"}}
     tag_prior_legs(mixed, "cpu")
     assert mixed["cpu"]["platform"] == "tpu"
+
+
+def test_stall_kill_on_fresh_stall_lines(tmp_path):
+    """A CLI child whose stderr reports a >=threshold '[stall] ... no
+    progress for N min' line (the executor's own watchdog, repeated while
+    wedged) is killed and surfaced as a RuntimeError; a healthy child's
+    exit code passes through untouched."""
+    import subprocess
+    import sys
+
+    import pytest
+
+    err = tmp_path / "cli-x.stderr"
+    # Healthy child: below-threshold stall lines never kill.
+    err.write_text("[stall] 'stream' has made no progress for 10.3 min\n")
+    proc = subprocess.Popen([sys.executable, "-c", "import time; time.sleep(1)"])
+    assert _wait_with_stall_kill(
+        proc, str(err), "x", stall_kill_min=15, poll_s=0.2
+    ) == 0
+
+    # Wedged child: a fresh >=15-min line kills it.
+    err.write_text("")
+    proc = subprocess.Popen([sys.executable, "-c", "import time; time.sleep(60)"])
+    err.write_text(
+        "[stall] 'stream' has made no progress for 20.3 min — wedged\n"
+    )
+    with pytest.raises(RuntimeError, match="stalled 20 min"):
+        _wait_with_stall_kill(proc, str(err), "x", stall_kill_min=15,
+                              poll_s=0.2)
+    assert proc.poll() is not None  # really dead
 
 
 def test_top_level_marking_follows_leg_evidence():
