@@ -1659,8 +1659,39 @@ def run_gb_bench(
         print(json.dumps(snap), flush=True)
 
     def gb_watchdog():
-        time.sleep(deadline_s if deadline_s > 0 else 86400)
-        log("GB watchdog: deadline hit; emitting partial result")
+        # Same stall escalation as main()'s watchdog (BENCH_STALL_EXIT_S),
+        # with a GB-scale default of 0 (off) and the watcher setting
+        # BENCH_GB_STALL_EXIT_S=1800: honest GB passes are long and silent
+        # (a 13.5 GB pass at tunnel speed is ~8-15 min between result-dict
+        # writes), so the threshold sits well above a pass but far below
+        # the 90-min deadline a wedge would otherwise idle out.
+        stall_exit = float(os.environ.get("BENCH_GB_STALL_EXIT_S", "0"))
+        t0 = time.monotonic()
+        total = deadline_s if deadline_s > 0 else 86400
+        last_snap = None
+        last_change = time.monotonic()
+        while True:
+            remaining = total - (time.monotonic() - t0)
+            if remaining <= 0:
+                reason = "deadline hit"
+                break
+            time.sleep(min(30.0, remaining))
+            if not stall_exit:
+                continue
+            try:
+                snap_s = json.dumps(result, sort_keys=True, default=str)
+            except RuntimeError:
+                continue
+            if snap_s != last_snap:
+                last_snap = snap_s
+                last_change = time.monotonic()
+            elif time.monotonic() - last_change >= stall_exit:
+                reason = (
+                    f"no new measurement for {stall_exit:.0f}s "
+                    "(wedged tunnel?)"
+                )
+                break
+        log(f"GB watchdog: {reason}; emitting partial result")
         emit(partial=True)
         os._exit(1)
 

@@ -111,7 +111,7 @@ sys.exit(0 if d and not missing else 1)
       fi
       if [ -d scale_tmp/native_checkpoint ] && ! gb_ok; then
         echo "$(date -u +%H:%M:%S) running GB bench" >> /tmp/hw_watcher.log
-        BENCH_GB_DEADLINE_S=5400 timeout -k 10 6000 python bench.py \
+        BENCH_GB_STALL_EXIT_S=1800 BENCH_GB_DEADLINE_S=5400 timeout -k 10 6000 python bench.py \
           --model_path scale_tmp/native_checkpoint --prompts 2 \
           --out BENCH_GB_r05.json > /tmp/bench_gb_hw.log 2>&1
         rc=$?
