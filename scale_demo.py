@@ -534,6 +534,20 @@ def main() -> None:
             "--resume", "true" if resume else "false",
         ]
 
+    def snapshot() -> None:
+        """Crash-durable incremental write after each completed big leg: a
+        stall-killed LATER leg must not lose this invocation's finished
+        legs (the artifact was previously written only at the very end).
+        The merge-prior read picks the snapshot up on the watcher's retry;
+        the final write below overwrites it with the post-pass fields."""
+        if big:
+            recompute_platform_marking(result)
+        try:
+            with open(out, "w") as f:
+                json.dump(result, f, indent=1)
+        except OSError as e:
+            log(f"snapshot write failed: {e!r}")
+
     # --- cpu mode (BASELINE config 1) -------------------------------------
     # A prior invocation's scores serve as the comparison baseline when cpu
     # isn't in this run's configs — but only when that invocation provably
@@ -558,6 +572,7 @@ def main() -> None:
             scores = pickle.load(f)
         result["scores_finite"] = bool(all(np.isfinite(s).all() for s in scores))
         result["scores_shape"] = list(scores[0].shape)
+        snapshot()
 
     # --- tpu mode (BASELINE config 2: activations stay in HBM) ------------
     if "tpu" in configs:
@@ -578,6 +593,7 @@ def main() -> None:
                     for a, b in zip(scores, tscores)
                 )
             )
+        snapshot()
 
     # --- disk mode + crash resume (BASELINE config 3) ---------------------
     if "disk" in configs:
@@ -614,6 +630,7 @@ def main() -> None:
                     for a, b in zip(scores, dscores)
                 )
             )
+        snapshot()
 
     # Mesh-only invocations (big=False) leave the marking untouched.
     if big:
