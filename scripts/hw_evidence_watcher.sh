@@ -151,5 +151,8 @@ sys.exit(0 if d and not missing else 1)
   else
     echo "$(date -u +%H:%M:%S) tunnel still down" >> /tmp/hw_watcher.log
   fi
-  sleep 300
+  # 90s, not 300: windows can be as short as ~35 min (2026-08-01 saw one),
+  # so detection latency is capture time lost; the probe subprocess costs
+  # ~15s of an otherwise idle core.
+  sleep 90
 done
