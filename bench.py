@@ -1619,6 +1619,15 @@ def run_gb_bench(
                 for q in ("int8", "int4"):
                     if isinstance(prior.get(f"gb_{q}_ratios"), list):
                         prior_ratios[q] = list(prior[f"gb_{q}_ratios"])
+                    elif (
+                        prior.get(f"gb_{q}_speedup") is not None
+                        and prior.get(f"gb_{q}_speedup_n") == 1
+                    ):
+                        # Pre-ratios-list artifact: a single-rep median IS
+                        # the raw ratio, so accumulation still works
+                        # against captures made before the lists existed.
+                        prior_ratios[q] = [prior[f"gb_{q}_speedup"]]
+                    if q in prior_ratios:
                         # Seed the result with the prior reps UP FRONT: if
                         # this run's quant phase is budget-skipped or
                         # fails, the finally-emit must carry the prior
@@ -1650,12 +1659,26 @@ def run_gb_bench(
         snap = dict(result)
         if partial:
             snap["partial"] = True
-        if out:
+        target = out
+        if partial and out and os.path.exists(out):
+            # A deadline-partial must never DEGRADE the artifact of
+            # record: if a complete capture already sits at `out`, the
+            # partial goes to a sidecar instead (the 16:42Z partial
+            # overwrote a complete committed capture before this guard).
             try:
-                with open(out, "w") as f:
+                with open(out) as f:
+                    if not json.load(f).get("partial"):
+                        target = out + ".partial"
+                        log(f"complete artifact at {out} preserved; "
+                            f"partial emission -> {target}")
+            except (OSError, ValueError):
+                pass
+        if target:
+            try:
+                with open(target, "w") as f:
                     json.dump(snap, f, indent=1)
             except OSError as e:
-                log(f"could not write {out}: {e!r}")
+                log(f"could not write {target}: {e!r}")
         print(json.dumps(snap), flush=True)
 
     def gb_watchdog():
