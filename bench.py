@@ -150,7 +150,9 @@ def persist_tpu_capture(result: dict) -> None:
     cap = {k: result[k] for k in HEADLINE_KEYS if result.get(k) is not None}
     cap["platform"] = "tpu"
     cap["captured_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
-    old = load_tpu_capture() or {}
+    # Explicit path (not the def-time default) so a monkeypatched
+    # TPU_CAPTURE_PATH is honoured — the module global resolves at call time.
+    old = load_tpu_capture(TPU_CAPTURE_PATH) or {}
     carried = [
         k for k in HEADLINE_KEYS if k not in cap and old.get(k) is not None
     ]
@@ -175,9 +177,27 @@ def persist_tpu_capture(result: dict) -> None:
     if best is None or (
         bw is not None and (best_bw is None or bw >= best_bw)
     ):
+        promoted = cap
+        if best is not None:
+            # Group-level arbitration, roles swapped vs the demotion
+            # branch: the NEW capture is the base — link-BOUND keys
+            # (value/mfu/peak/bandwidth) always follow the better link —
+            # but each RATIO_BASES group keeps the prior best's evidence
+            # when it is stronger (conclusive beats inconclusive, more
+            # reps beat fewer), and singletons only fill gaps. A wholesale
+            # overwrite here used to let a 1-rep inconclusive ratio on a
+            # marginally better link erase a conclusive n=3 measurement.
+            promoted, kept = _merge_best(cap, best)
+            if kept:
+                # Exactly the keys whose promoted values came from the
+                # prior best THIS time — a group the new run re-measured
+                # (and won) is its own evidence and must not stay listed
+                # as inherited.
+                promoted["kept_keys"] = sorted(kept)
+                promoted["kept_from"] = best.get("captured_at")
         try:
             with open(BEST_CAPTURE_PATH, "w") as f:
-                json.dump(cap, f, indent=1)
+                json.dump(promoted, f, indent=1)
             log(f"promoted to best TPU capture -> {BEST_CAPTURE_PATH}")
         except OSError as e:  # pragma: no cover
             log(f"could not persist best TPU capture: {e!r}")
@@ -296,22 +316,34 @@ PHASE_EVIDENCE_KEY = {
 }
 
 
+def phase_captured(cap: dict, phase: str) -> bool:
+    """A phase counts as captured only when its headline key is present AND
+    not flagged ``*_inconclusive`` — an inconclusive median (spread
+    straddling 1.0, or a single budget-truncated rep) is a number without a
+    verdict, so skip-mode windows must RE-measure it instead of parking it
+    forever. Singleton keys carry no flag and gate on presence alone.
+    Shared with the hardware-evidence watcher's ``bench_complete`` gate so
+    the two cannot disagree about what "done" means."""
+    k = PHASE_EVIDENCE_KEY[phase]
+    return cap.get(k) is not None and not cap.get(f"{k}_inconclusive", False)
+
+
 def _phases_to_skip() -> set[str]:
     """With BENCH_SKIP_CAPTURED=1 (set by the hardware-evidence watcher),
-    skip every phase whose headline metric is already in the persisted TPU
-    capture — including values the capture carried forward from an earlier
-    window, which is exactly the "we already have this on hardware" signal.
-    persist_tpu_capture's carry-forward keeps the skipped phases' numbers in
-    the artifact. Off by default: a plain `python bench.py` (the driver's
-    round-end run) always measures everything fresh."""
+    skip every phase whose headline metric is already CONCLUSIVELY in the
+    persisted TPU capture — including values the capture carried forward
+    from an earlier window, which is exactly the "we already have this on
+    hardware" signal; a value flagged inconclusive is re-measured
+    (phase_captured). persist_tpu_capture's carry-forward keeps the skipped
+    phases' numbers in the artifact. Off by default: a plain
+    `python bench.py` (the driver's round-end run) always measures
+    everything fresh."""
     if os.environ.get("BENCH_SKIP_CAPTURED", "").lower() in (
         "", "0", "false", "no",
     ):
         return set()
     cap = load_tpu_capture(TPU_CAPTURE_PATH) or {}
-    skip = {
-        ph for ph, k in PHASE_EVIDENCE_KEY.items() if cap.get(k) is not None
-    }
+    skip = {ph for ph in PHASE_EVIDENCE_KEY if phase_captured(cap, ph)}
     if skip:
         log(f"BENCH_SKIP_CAPTURED: skipping already-captured phases {sorted(skip)}")
     return skip

@@ -156,7 +156,218 @@ def config_from_args(args: argparse.Namespace) -> FrameworkConfig:
     )
 
 
+def _updated_path(p: str, rank: int | None = None) -> str:
+    # Robust form of the reference's .replace('.pkl', '_updated.pkl')
+    # contract (/root/reference/main.py:92-94): only the extension is
+    # rewritten, so an input without '.pkl' is never silently clobbered.
+    root, ext = os.path.splitext(p)
+    tag = "_updated" if rank is None else f"_updated.rank{rank}"
+    return f"{root}{tag}{ext or '.pkl'}"
+
+
+def build_serve_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="flexible-llm-sharding-tpu serve",
+        description="Online serving: shard-aware continuous batching over "
+        "the streaming runtime. Requests join at shard-0 boundaries of the "
+        "decode sweep; in-flight requests are never re-prefilled.",
+    )
+    p.add_argument("--model_path", type=str, default="./")
+    # Runtime knobs shared with the offline CLI.
+    p.add_argument("--dtype", type=str, default="bfloat16",
+                   choices=["bfloat16", "float16", "float32"])
+    p.add_argument("--layer_num_per_shard", type=int, default=1)
+    p.add_argument("--storage_location", type=str, default="cpu",
+                   help="'tpu' parks per-wave KV in HBM; 'cpu' in host RAM")
+    p.add_argument("--block_size", type=int, default=8)
+    p.add_argument("--bucket_multiple", type=int, default=64)
+    p.add_argument("--prefetch_depth", type=int, default=None)
+    p.add_argument("--max_token_len", type=int, default=DEFAULT_MAX_TOKEN_LEN)
+    p.add_argument("--use_pallas", type=_str2bool_or_auto, default=None)
+    p.add_argument("--decode_resident", type=str, default="auto",
+                   choices=("auto", "on", "off"),
+                   help="keep the model on chip across sweeps when it fits "
+                        "(auto judges against the chip's HBM); off "
+                        "re-streams the weights every sweep (the large-"
+                        "model regime)")
+    # Serving knobs (ServeConfig).
+    p.add_argument("--queue_capacity", type=int, default=64,
+                   help="admission queue bound; submissions beyond it are "
+                        "rejected with a reason (backpressure)")
+    p.add_argument("--max_wave_requests", type=int, default=8,
+                   help="requests coalesced into one wave at a shard-0 "
+                        "boundary (the prefill batch size)")
+    p.add_argument("--max_active_requests", type=int, default=32,
+                   help="total in-flight requests across all waves")
+    p.add_argument("--max_new_tokens", type=int, default=16,
+                   help="per-request generation budget (requests may "
+                        "carry their own in jsonl mode)")
+    p.add_argument("--deadline_s", type=float, default=0.0,
+                   help="queue-wait deadline: a request not admitted "
+                        "within this many seconds is evicted as expired "
+                        "(0 = none)")
+    p.add_argument("--stats_interval_s", type=float, default=10.0,
+                   help="periodic structured serve-stats JSON line on "
+                        "stderr (0 = off)")
+    # Demo driver: submit a prompt pickle at staggered times, write the
+    # offline-contract outputs. Without it, requests are read as JSON lines
+    # from stdin: {"prefix": ..., "suffixes": [...], "max_new_tokens": N}.
+    p.add_argument("--prompt_pickle", type=str, default=None,
+                   help="demo mode: submit this offline prompt pickle's "
+                        "entries as staggered online requests, then write "
+                        "--output_file like the batch path")
+    p.add_argument("--output_file", type=str, default=None)
+    p.add_argument("--stagger_ms", type=float, default=0.0,
+                   help="demo mode: delay between submissions, so late "
+                        "arrivals exercise mid-stream wave admission")
+    return p
+
+
+def serve_main(argv: list[str] | None = None, tokenizer=None) -> None:
+    args = build_serve_parser().parse_args(argv)
+    print(args, file=sys.stderr)
+    if args.prompt_pickle and not args.output_file:
+        raise SystemExit("--prompt_pickle (demo mode) requires --output_file")
+    from flexible_llm_sharding_tpu.config import ServeConfig
+
+    cfg = FrameworkConfig(
+        model_path=args.model_path,
+        layer_num_per_shard=args.layer_num_per_shard,
+        storage_location=args.storage_location,
+        dtype=args.dtype,
+        block_size=args.block_size,
+        bucket_multiple=args.bucket_multiple,
+        prefetch_depth=args.prefetch_depth,
+        max_token_len=args.max_token_len,
+        use_pallas=args.use_pallas,
+        decode_resident=args.decode_resident,
+    )
+    serve_cfg = ServeConfig(
+        queue_capacity=args.queue_capacity,
+        max_wave_requests=args.max_wave_requests,
+        max_active_requests=args.max_active_requests,
+        default_max_new_tokens=args.max_new_tokens,
+        default_deadline_s=args.deadline_s,
+        stats_interval_s=args.stats_interval_s,
+    )
+    if tokenizer is None:
+        from transformers import AutoTokenizer
+
+        tokenizer = AutoTokenizer.from_pretrained(cfg.model_path)
+        tokenizer.pad_token = tokenizer.eos_token
+
+    import time
+
+    from flexible_llm_sharding_tpu.serve import ServeEngine
+
+    from flexible_llm_sharding_tpu.serve.request import RequestStatus
+
+    engine = ServeEngine(cfg, serve_cfg, tokenizer=tokenizer)
+    try:
+        if args.prompt_pickle:
+            with open(args.prompt_pickle, "rb") as f:
+                prompts = pickle.load(f)
+            requests = []
+            for prefix, suffixes in prompts:
+                # The offline contract is one score per prompt, so the demo
+                # submitter BLOCKS on backpressure (retry until a queue
+                # slot frees) instead of dropping rejected prompts — a
+                # pickle larger than --queue_capacity must still fully
+                # serve. An engine-fatal error breaks the retry loop; the
+                # root cause surfaces at the gather below.
+                while True:
+                    req = engine.submit(prefix, tuple(suffixes))
+                    if (
+                        req.status is not RequestStatus.REJECTED
+                        or engine.error is not None
+                    ):
+                        break
+                    time.sleep(0.05)
+                requests.append(req)
+                if args.stagger_ms:
+                    time.sleep(args.stagger_ms / 1000.0)
+            results = [r.future.result() for r in requests]
+            with open(args.output_file, "wb") as f:
+                pickle.dump([r.scores for r in results], f)
+            with open(_updated_path(args.prompt_pickle), "wb") as f:
+                pickle.dump([r.updated for r in results], f)
+        else:
+            # JSONL request stream on stdin; one JSON response line per
+            # completion on stdout (scores stay server-side — tokens and
+            # text travel).
+            import threading
+
+            out_lock = threading.Lock()
+
+            def reply(req) -> None:
+                try:
+                    res = req.future.result(timeout=0)
+                    line = {
+                        "id": req.request_id,
+                        "status": req.status.value,
+                        "updated_suffixes": list(res.updated[1]),
+                        "tokens": res.tokens.tolist(),
+                        "ttft_s": round(res.ttft_s, 4),
+                        "latency_s": round(res.latency_s, 4),
+                    }
+                except Exception as e:  # rejected/expired/failed
+                    line = {
+                        "id": req.request_id,
+                        "status": req.status.value,
+                        "error": str(e),
+                    }
+                with out_lock:
+                    print(json.dumps(line), flush=True)
+
+            for line_no, raw in enumerate(sys.stdin, 1):
+                raw = raw.strip()
+                if not raw:
+                    continue
+                try:
+                    d = json.loads(raw)
+                    engine.submit(
+                        d["prefix"],
+                        tuple(d.get("suffixes") or ("",)),
+                        max_new_tokens=d.get("max_new_tokens"),
+                        deadline_s=d.get("deadline_s"),
+                        callback=reply,
+                    )
+                except Exception as e:
+                    # One malformed line must not take the server down for
+                    # every other client: reject-with-reason, keep serving
+                    # (backpressure/deadline rejects already flow through
+                    # the callback; this covers parse/validation errors).
+                    with out_lock:
+                        print(
+                            json.dumps(
+                                {
+                                    "line": line_no,
+                                    "status": "rejected",
+                                    "error": f"bad request line: {e!r}",
+                                }
+                            ),
+                            flush=True,
+                        )
+    except BaseException as e:
+        if engine.error is not None and not isinstance(e, SystemExit):
+            # A fatal engine error cancels queued requests, so the gather
+            # raises the secondary ServeClosed — name the ROOT cause
+            # instead of the symptom.
+            raise SystemExit(
+                f"serve engine failed: {engine.error!r}"
+            ) from e
+        raise
+    finally:
+        engine.shutdown(drain=True)
+    if engine.error is not None:
+        raise SystemExit(f"serve engine failed: {engine.error!r}")
+    print(json.dumps(engine.stats()), file=sys.stderr)
+
+
 def main(argv: list[str] | None = None, tokenizer=None) -> None:
+    argv = sys.argv[1:] if argv is None else list(argv)
+    if argv and argv[0] == "serve":
+        return serve_main(argv[1:], tokenizer=tokenizer)
     args = build_parser().parse_args(argv)
     print(args, file=sys.stderr)
     if (args.top_k or args.top_p) and args.temperature <= 0:
@@ -202,14 +413,6 @@ def main(argv: list[str] | None = None, tokenizer=None) -> None:
         prompts = pickle.load(f)
 
     import jax
-
-    def _updated_path(p: str, rank: int | None = None) -> str:
-        # Robust form of the reference's .replace('.pkl', '_updated.pkl')
-        # contract (/root/reference/main.py:92-94): only the extension is
-        # rewritten, so an input without '.pkl' is never silently clobbered.
-        root, ext = os.path.splitext(p)
-        tag = "_updated" if rank is None else f"_updated.rank{rank}"
-        return f"{root}{tag}{ext or '.pkl'}"
 
     if jax.process_count() > 1:
         # Multi-host: each process scores its own contiguous prompt slice

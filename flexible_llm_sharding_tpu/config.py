@@ -934,3 +934,59 @@ class FrameworkConfig:
             return jax.devices()[0].platform == "tpu"
         except Exception:
             return False
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeConfig:
+    """Online-serving knobs (the ``serve`` CLI subcommand / serve.engine).
+
+    The offline flags (FrameworkConfig) describe ONE batch run; these
+    describe the server wrapped around the same runtime: how many requests
+    may wait (admission queue), how many join per wave at a shard-0
+    boundary, and how long a request may sit queued before it is evicted.
+    """
+
+    # Admission queue capacity: submissions beyond this are rejected
+    # immediately with a reason (backpressure) instead of queueing unbounded.
+    queue_capacity: int = 64
+    # Most requests coalesced into ONE wave at a shard-0 boundary — the
+    # prefill batch size. A wave's blocks ride every subsequent sweep, so
+    # the knob bounds per-wave prefill latency AND per-sweep KV footprint.
+    max_wave_requests: int = 8
+    # Total in-flight requests across all active waves; the batcher stops
+    # admitting (requests keep queueing) until completions free slots.
+    max_active_requests: int = 32
+    # Per-request generation budget when the request doesn't name one.
+    default_max_new_tokens: int = 16
+    # Queue-wait deadline (seconds) applied to requests that don't carry
+    # their own: a request not ADMITTED within this window is evicted with
+    # status 'expired' (0 = no deadline). Time-to-first-token is the online
+    # contract; serving a long-expired request wastes sweeps the live ones
+    # need.
+    default_deadline_s: float = 0.0
+    # Engine idle poll (seconds) while no wave is active and the queue is
+    # empty. Admission itself is boundary-driven, not polled: with waves in
+    # flight the queue is re-checked at every shard-0 boundary.
+    idle_poll_s: float = 0.01
+    # Periodic structured stats line (JSON to stderr) every this many
+    # seconds; 0 = off. Snapshot of queue depth, active requests, TTFT and
+    # per-token latency summaries, admitted/rejected/expired counters.
+    stats_interval_s: float = 0.0
+
+    def __post_init__(self) -> None:
+        if self.queue_capacity < 1:
+            raise ValueError("queue_capacity must be >= 1")
+        if self.max_wave_requests < 1:
+            raise ValueError("max_wave_requests must be >= 1")
+        if self.max_active_requests < self.max_wave_requests:
+            raise ValueError(
+                "max_active_requests must be >= max_wave_requests"
+            )
+        if self.default_max_new_tokens < 1:
+            raise ValueError("default_max_new_tokens must be >= 1")
+        if self.default_deadline_s < 0:
+            raise ValueError("default_deadline_s must be >= 0")
+        if self.idle_poll_s <= 0:
+            raise ValueError("idle_poll_s must be > 0")
+        if self.stats_interval_s < 0:
+            raise ValueError("stats_interval_s must be >= 0")

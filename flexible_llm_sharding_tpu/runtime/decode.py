@@ -298,6 +298,75 @@ def propose_draft(context_ids, k: int, ngram: int = 2):
 # KV parking between shards / steps
 # ---------------------------------------------------------------------------
 
+def block_kv_bytes(model_cfg, dtype_name: str, toks, idxs, gen_slots: int):
+    """Decode KV bytes for one block (all layers, compute dtype). Shared by
+    the offline DecodeGenerator and the serving engine so the two KV
+    placement decisions use ONE formula."""
+    t0 = toks[idxs[0]]
+    s_b, ls = t0.suffix_ids.shape
+    lp = t0.prefix_ids.shape[-1]
+    per_layer = (
+        2  # k and v
+        * len(idxs)
+        * (lp + s_b * (ls + gen_slots))
+        * model_cfg.num_key_value_heads
+        * (model_cfg.head_dim + model_cfg.v_dim) / 2  # K/V dims differ (MLA)
+    )
+    bpe = np.dtype(np_dtype_for(dtype_name)).itemsize
+    return per_layer * model_cfg.num_hidden_layers * bpe
+
+
+def kv_fits_on_chip(
+    model_cfg, dtype_name: str, toks, blocks, gen_slots: int,
+    device=None, n_chips: int = 1,
+) -> bool:
+    """Whether every block's decode KV can stay in HBM alongside the
+    resident weights (known-HBM chips only: weights + KV within 80% of the
+    chip). A host-parked KV store costs a full KV round trip per shard per
+    decode step over the host->HBM link — on the axon tunnel that dwarfs
+    the decode math itself."""
+    from flexible_llm_sharding_tpu.utils.metrics import (
+        chip_hbm_gb,
+        weight_bytes_per_chip,
+    )
+
+    try:
+        hbm_gb = chip_hbm_gb(device)
+    except Exception:
+        return False
+    if not hbm_gb:
+        return False
+    kv_bytes = sum(
+        block_kv_bytes(model_cfg, dtype_name, toks, i, gen_slots)
+        for i in blocks
+    )
+    weights = weight_bytes_per_chip(model_cfg, dtype_name, n_chips)
+    return weights + kv_bytes <= 0.8 * hbm_gb * 1e9
+
+
+def extend_gen_kv(kv, gen_slots: int, dtype, device=None):
+    """Pre-extend a prefill-parked KV pytree with ``gen_slots`` empty
+    generated-token slots (``kg``/``vg``) so decode scans can donate in
+    place. Head count/dims come from the prefill's own parked leaves, so
+    MLA shapes (n_kv == n_heads; v_head_dim != qk head dim) allocate
+    correctly without per-family math. Two distinct buffers: kg/vg are
+    donated by the decode scan and must not alias. Allocated directly under
+    ``device`` (the stage's chip / the tp mesh's replicated sharding):
+    uncommitted zeros would all land on chip 0, concentrating every
+    stage's gen-KV there during prefill. Shared by the offline prefill
+    (DecodeGenerator) and the serving prefill (serve/engine.py)."""
+    k_l, bsz, s_b = kv["ks"].shape[:3]
+
+    def _gen_shape(like):
+        return (k_l, bsz, s_b, gen_slots, like.shape[-2], like.shape[-1])
+
+    return {
+        **kv,
+        "kg": jnp.zeros(_gen_shape(kv["ks"]), dtype, device=device),
+        "vg": jnp.zeros(_gen_shape(kv["vs"]), dtype, device=device),
+    }
+
+
 class KVStore:
     """Per-(shard, block) KV pytrees. ``on_device`` keeps them in HBM —
     chosen for storage_location='tpu', and also for 'cpu'/'disk' when the
@@ -471,32 +540,19 @@ class DecodeGenerator:
         )
 
     def _block_kv_bytes(self, toks, idxs, gen_slots: int) -> int:
-        """Decode KV bytes for one block (all layers, compute dtype)."""
-        mc = self.model_cfg
-        t0 = toks[idxs[0]]
-        s_b, ls = t0.suffix_ids.shape
-        lp = t0.prefix_ids.shape[-1]
-        per_layer = (
-            2  # k and v
-            * len(idxs)
-            * (lp + s_b * (ls + gen_slots))
-            * mc.num_key_value_heads
-            * (mc.head_dim + mc.v_dim) / 2  # K and V dims differ under MLA
+        """Decode KV bytes for one block (module fn block_kv_bytes)."""
+        return block_kv_bytes(
+            self.model_cfg, self.cfg.dtype, toks, idxs, gen_slots
         )
-        bpe = np.dtype(np_dtype_for(self.cfg.dtype)).itemsize
-        return per_layer * mc.num_hidden_layers * bpe
 
     def _kv_fits_on_chip(self, toks, blocks, gen_slots: int) -> bool:
-        """Whether every block's decode KV can stay in HBM alongside the
-        resident weights (known-HBM chips only: weights + KV within 80% of
-        the chip). A host-parked KV store costs a full KV round trip per
-        shard per decode step over the host->HBM link — on the axon tunnel
-        that dwarfs the decode math itself."""
-        hbm_gb = self._hbm_gb()
-        if not hbm_gb:
-            return False
-        kv_bytes = sum(self._block_kv_bytes(toks, i, gen_slots) for i in blocks)
-        return self._weight_bytes() + kv_bytes <= 0.8 * hbm_gb * 1e9
+        """Module fn kv_fits_on_chip at this generator's device/chip count
+        (shared with the serving engine so the placement rule can't
+        drift)."""
+        return kv_fits_on_chip(
+            self.model_cfg, self.cfg.dtype, toks, blocks, gen_slots,
+            device=self._probe_dev, n_chips=self._n_chips,
+        )
 
     def _fused_budget_ok(
         self, toks, blocks, n_gen: int, gen_slots: int, kv_on_device: bool
@@ -696,39 +752,12 @@ class DecodeGenerator:
                                 self._tp_mesh, params, ph, sh, prefix_len,
                                 total_len,
                             )
-                            # Pre-extend with empty generated-token slots so
-                            # decode scans can donate in place.
-                            bsz, s_b = sh.shape[0], sh.shape[1]
-                            k_l = jax.tree.leaves(kv)[0].shape[0]
                             # gen_slots: one per decode step (min 1 so shapes
                             # stay non-degenerate at n_gen=1), widened for
                             # speculative passes' K+1-slot writes.
-                            # Generated-KV head count/dims come from the
-                            # PREFILL's own parked KV leaves, so MLA shapes
-                            # (n_kv == n_heads; v_head_dim != qk head dim)
-                            # allocate correctly without per-family math.
-                            def _gen_shape(like):
-                                return (
-                                    k_l, bsz, s_b, gen_slots,
-                                    like.shape[-2], like.shape[-1],
-                                )
-                            # Two distinct buffers: kg/vg are donated by the
-                            # decode scan and must not alias. Allocated
-                            # directly under the stage's chip (MP) / the tp
-                            # mesh's replicated sharding: uncommitted zeros
-                            # would all land on chip 0, concentrating every
-                            # stage's gen-KV there during prefill.
-                            kv = {
-                                **kv,
-                                "kg": jnp.zeros(
-                                    _gen_shape(kv["ks"]), self.dtype,
-                                    device=act_dev,
-                                ),
-                                "vg": jnp.zeros(
-                                    _gen_shape(kv["vs"]), self.dtype,
-                                    device=act_dev,
-                                ),
-                            }
+                            kv = extend_gen_kv(
+                                kv, gen_slots, self.dtype, device=act_dev
+                            )
                             kv_store.put(("kv", shard_pos, di, b), kv)
                             di += 1
                         elif kind == "norm":
@@ -1086,4 +1115,10 @@ class DecodeGenerator:
         return scores_out, updated
 
 
-__all__ = ["DecodeGenerator", "KVStore"]
+__all__ = [
+    "DecodeGenerator",
+    "KVStore",
+    "block_kv_bytes",
+    "extend_gen_kv",
+    "kv_fits_on_chip",
+]

@@ -604,6 +604,13 @@ class ShardWeightSource:
     compute, so the host->HBM transfer of shard t+1 overlaps the device
     compute of shard t (the reference serializes these,
     ``/root/reference/utils.py:228-233``).
+
+    ``cycle=True`` loops the shard list endlessly instead of stopping after
+    one pass — the online serving loop's weight stream, where the number of
+    full-model sweeps is open-ended (requests keep arriving) and a
+    per-sweep source would cold-start the prefetch pipeline at every
+    shard-0 boundary. The consumer takes exactly ``len(shards)`` items per
+    sweep and MUST ``close()`` the source to end the stream.
     """
 
     def __init__(
@@ -618,6 +625,7 @@ class ShardWeightSource:
         devices: Sequence | None = None,
         layer_sliding=None,
         layer_rope=None,
+        cycle: bool = False,
     ):
         self.shards = list(shards)
         # Either one device for every shard, or (pipeline mode) one target
@@ -629,6 +637,7 @@ class ShardWeightSource:
             self.shard_devices = list(devices)
         else:
             self.shard_devices = [device] * len(self.shards)
+        self.cycle = cycle
         self._loader = _HostShardLoader(
             model_path, layer_names, np_dtype, tied_embeddings, layer_sliding,
             layer_rope,
@@ -701,31 +710,51 @@ class ShardWeightSource:
         return False
 
     def _producer(self):
-        for i, (idxs, dev) in enumerate(zip(self.shards, self.shard_devices)):
-            if self._stop.is_set():
-                return
-            try:
-                if i + 1 < len(self.shards):  # readahead next shard's files
-                    self._loader.warm(self.shards[i + 1])
-                item = self._build_shard(idxs, dev)
-            except Exception as e:  # surfaced on the consumer side
-                self._put(e)
-                return
-            if not self._put(item):
+        while True:
+            for i, (idxs, dev) in enumerate(
+                zip(self.shards, self.shard_devices)
+            ):
+                if self._stop.is_set():
+                    return
+                try:
+                    # Readahead the next shard's files; in cycle mode the
+                    # sweep wraps, so the last shard warms shard 0 again.
+                    nxt = i + 1
+                    if nxt < len(self.shards):
+                        self._loader.warm(self.shards[nxt])
+                    elif self.cycle:
+                        self._loader.warm(self.shards[0])
+                    item = self._build_shard(idxs, dev)
+                except Exception as e:  # surfaced on the consumer side
+                    self._put(e)
+                    return
+                if not self._put(item):
+                    return
+            if not self.cycle:
                 return
 
     def __iter__(self):
         if self._thread is None:
-            for i, (idxs, dev) in enumerate(zip(self.shards, self.shard_devices)):
-                if i + 1 < len(self.shards):
-                    self._loader.warm(self.shards[i + 1])
-                yield idxs, self._build_shard(idxs, dev)
+            while True:
+                for i, (idxs, dev) in enumerate(
+                    zip(self.shards, self.shard_devices)
+                ):
+                    if self._stop.is_set():
+                        return
+                    if i + 1 < len(self.shards):
+                        self._loader.warm(self.shards[i + 1])
+                    yield idxs, self._build_shard(idxs, dev)
+                if not self.cycle:
+                    return
         else:
-            for idxs in self.shards:
-                item = self._q.get()
-                if isinstance(item, Exception):
-                    raise item
-                yield idxs, item
+            while True:
+                for idxs in self.shards:
+                    item = self._q.get()
+                    if isinstance(item, Exception):
+                        raise item
+                    yield idxs, item
+                if not self.cycle:
+                    return
 
 
 class BroadcastShardSource:

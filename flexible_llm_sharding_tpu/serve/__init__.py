@@ -1,0 +1,42 @@
+"""Online serving subsystem: shard-aware continuous batching over the
+streaming decode runtime.
+
+Every offline entry point (cli scoring, bench, scale_demo) is a batch run
+over a fixed prompt set; this package turns the same runtime into a server:
+
+- ``request``  — request/response dataclasses + per-request state machine.
+- ``queue``    — thread-safe admission queue: capacity backpressure
+  (reject-with-reason), deadline eviction, drain-on-shutdown.
+- ``batcher``  — shard-aware continuous batcher: coalesces queued requests
+  into waves, admitting new waves only at shard-0 boundaries of the decode
+  sweep so mid-stream joins never re-trigger prefill for in-flight
+  requests (the Orca iteration-level-scheduling idea mapped onto the
+  weight-sweep boundary this design naturally has).
+- ``engine``   — the serving loop: drives prefill/decode via the existing
+  jitted runtime blocks, supports graceful drain and shutdown, resolves
+  per-request futures/callbacks, and feeds utils.metrics.ServingMetrics.
+"""
+
+from flexible_llm_sharding_tpu.serve.request import (  # noqa: F401
+    DeadlineExceeded,
+    QueueFull,
+    Request,
+    RequestResult,
+    RequestStatus,
+    ServeFuture,
+)
+from flexible_llm_sharding_tpu.serve.queue import AdmissionQueue  # noqa: F401
+from flexible_llm_sharding_tpu.serve.batcher import ShardAwareBatcher  # noqa: F401
+from flexible_llm_sharding_tpu.serve.engine import ServeEngine  # noqa: F401
+
+__all__ = [
+    "AdmissionQueue",
+    "DeadlineExceeded",
+    "QueueFull",
+    "Request",
+    "RequestResult",
+    "RequestStatus",
+    "ServeEngine",
+    "ServeFuture",
+    "ShardAwareBatcher",
+]

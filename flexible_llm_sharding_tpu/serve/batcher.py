@@ -1,0 +1,134 @@
+"""Shard-aware continuous batching: waves admitted at shard-0 boundaries.
+
+Iteration-level scheduling (Orca, OSDI '22) admits new requests between
+decode *iterations* instead of between batches. This runtime's natural
+iteration boundary is the **shard-0 boundary of the weight sweep**: every
+decode step streams (or walks, when resident) the model's shards in order,
+and only at the instant the sweep is about to re-enter shard 0 is there no
+in-flight activation anywhere — so a new group of requests can join and run
+its PREFILL segments on the very same sweep whose later shards are still
+serving the in-flight waves' decode segments. Mid-stream joins therefore
+never re-trigger prefill for in-flight requests, and a late arrival waits
+at most one sweep for its first token.
+
+The batcher owns wave formation and the active-request budget; the engine
+calls ``admit_at_boundary()`` exactly at each shard-0 boundary and drives
+the waves the batcher tracks.
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+from typing import Any
+
+from flexible_llm_sharding_tpu.serve.queue import AdmissionQueue
+from flexible_llm_sharding_tpu.serve.request import Request, RequestStatus
+
+_WAVE_IDS = itertools.count()
+
+
+@dataclass
+class Wave:
+    """One prefill cohort: requests admitted together at a shard-0 boundary.
+
+    The wave's first sweep runs its prefill segments (capturing KV and the
+    first token); every later sweep runs one decode step against that KV.
+    The engine owns the compute state (``state``); the batcher owns
+    membership and retirement."""
+
+    requests: list[Request]
+    wave_id: int = field(default_factory=lambda: next(_WAVE_IDS))
+    steps: int = 0  # tokens picked per suffix so far (1 after prefill)
+    state: Any = None  # engine-private compute state (_WaveState)
+
+    @property
+    def max_steps(self) -> int:
+        return max(r.max_new_tokens for r in self.requests)
+
+    @property
+    def done(self) -> bool:
+        return all(r.status.terminal for r in self.requests)
+
+
+class ShardAwareBatcher:
+    def __init__(
+        self,
+        queue: AdmissionQueue,
+        max_wave_requests: int,
+        max_active_requests: int,
+        metrics=None,
+    ):
+        self.queue = queue
+        self.max_wave_requests = max_wave_requests
+        self.max_active_requests = max_active_requests
+        self._metrics = metrics
+        self.waves: list[Wave] = []
+
+    @property
+    def active_requests(self) -> int:
+        return sum(
+            1
+            for w in self.waves
+            for r in w.requests
+            if not r.status.terminal
+        )
+
+    def admit_at_boundary(self) -> Wave | None:
+        """Form at most ONE new wave from the queue — called by the engine
+        exactly at a shard-0 boundary. Respects the active-request budget;
+        returns the new wave (already tracked) or None."""
+        import time
+
+        budget = self.max_active_requests - self.active_requests
+        if budget <= 0:
+            # No admission this boundary, but deadline eviction must not
+            # stall behind a saturated active set: a zero-size pop still
+            # sweeps expired waiters out of the queue (their futures
+            # resolve DeadlineExceeded promptly, not after the long-running
+            # wave finally finishes).
+            self.queue.pop_wave(0)
+            return None
+        reqs = self.queue.pop_wave(min(self.max_wave_requests, budget))
+        if not reqs:
+            return None
+        now = time.monotonic()
+        for r in reqs:
+            r.status = RequestStatus.ACTIVE
+            r.admitted_at = now
+        wave = Wave(requests=reqs)
+        self.waves.append(wave)
+        if self._metrics is not None:
+            self._metrics.count("admitted", len(reqs))
+            self._update_gauges()
+        return wave
+
+    def retire_done(self) -> list[Wave]:
+        """Drop waves whose every request reached a terminal state; returns
+        the retired waves (the engine releases their KV)."""
+        done = [w for w in self.waves if w.done]
+        if done:
+            self.waves = [w for w in self.waves if not w.done]
+        if self._metrics is not None:
+            self._update_gauges()
+        return done
+
+    def fail_all_active(self, error: BaseException) -> None:
+        """Engine-fatal path: every in-flight request fails with the root
+        cause (its future re-raises it) and all waves drop."""
+        for w in self.waves:
+            for r in w.requests:
+                if not r.status.terminal:
+                    r.fail(error, RequestStatus.FAILED)
+                    if self._metrics is not None:
+                        self._metrics.count("failed")
+        self.waves = []
+        if self._metrics is not None:
+            self._update_gauges()
+
+    def _update_gauges(self) -> None:
+        self._metrics.gauge("active_requests", self.active_requests)
+        self._metrics.gauge("active_waves", len(self.waves))
+
+
+__all__ = ["ShardAwareBatcher", "Wave"]

@@ -1,0 +1,550 @@
+"""The online serving loop: continuous batching over the streaming runtime.
+
+One worker thread drives an endless sequence of **weight sweeps**. Each
+sweep walks the model's shards in order (resident on chip, or re-streamed
+through the cycling ``ShardWeightSource``); at every shard, every active
+wave advances one shard's worth of work — a freshly admitted wave runs its
+PREFILL segments (capturing per-layer KV, ``runtime/decode`` machinery),
+in-flight waves run one DECODE step against their cached KV. New waves are
+admitted only at the shard-0 boundary (``ShardAwareBatcher``), so a
+mid-stream join never re-triggers prefill for in-flight requests: the
+late wave's prefill and the old waves' decode ride the *same* sweep.
+
+Per-request results resolve through futures/callbacks the moment the
+request's own token budget is reached — requests with different budgets
+coexist in one wave. Graceful drain (serve out queued + in-flight, refuse
+new) and hard shutdown (cancel queued, finish in-flight) are first-class.
+
+Serving scope (v1, loud rejects): single placement target, greedy
+selection (per-request rng streams under sampling are future work), no
+speculative passes, no long-context routing.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from itertools import islice
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from flexible_llm_sharding_tpu.config import (
+    FrameworkConfig,
+    LlamaConfig,
+    ServeConfig,
+)
+from flexible_llm_sharding_tpu.models import llama
+from flexible_llm_sharding_tpu.parallel.planner import plan_shards_dp
+from flexible_llm_sharding_tpu.runtime.decode import (
+    KVStore,
+    _decode_decoders,
+    _decode_norm_head,
+    _prefill_decoders,
+    extend_gen_kv,
+    kv_fits_on_chip,
+)
+from flexible_llm_sharding_tpu.runtime.executor import (
+    ShardWeightSource,
+    _DTYPES,
+    _embed_block,
+    _head_block,
+    _norm_block,
+    np_dtype_for,
+)
+from flexible_llm_sharding_tpu.runtime.tokenization import (
+    PromptTokenizer,
+    check_longrope_regime,
+    longrope_total_len,
+    make_blocks,
+)
+from flexible_llm_sharding_tpu.serve.batcher import ShardAwareBatcher, Wave
+from flexible_llm_sharding_tpu.serve.queue import AdmissionQueue
+from flexible_llm_sharding_tpu.serve.request import Request, RequestStatus
+from flexible_llm_sharding_tpu.utils import checkpoint
+from flexible_llm_sharding_tpu.utils.metrics import ServingMetrics
+
+
+@dataclasses.dataclass
+class _WaveState:
+    """Engine-private compute state for one wave (same structures as the
+    offline DecodeGenerator run, scoped to the wave's requests)."""
+
+    toks: list
+    blocks: list[list[int]]
+    meta: dict[int, tuple]
+    kv_store: KVStore
+    scores: dict[int, list[np.ndarray]]
+    tok_hist: dict[int, list[np.ndarray]]
+    loc: dict[int, tuple[int, int]]  # request pos in wave -> (block, row)
+    slots: int
+    norm_p: Any = None  # per-sweep: norm params ride shard->head shard
+
+
+class ServeEngine:
+    """Continuous-batching server over the streaming decode runtime.
+
+    ``submit()`` is thread-safe and non-blocking (backpressure raises
+    through the returned request's future); results resolve via
+    ``Request.future`` and the optional per-request callback.
+    """
+
+    def __init__(
+        self,
+        cfg: FrameworkConfig,
+        serve_cfg: ServeConfig | None = None,
+        tokenizer=None,
+        device=None,
+        start: bool = True,
+    ):
+        if cfg.temperature > 0:
+            raise ValueError(
+                "serving is greedy-only for now (per-request rng streams "
+                "under sampling are future work); set temperature=0"
+            )
+        if cfg.speculative_k:
+            raise ValueError("speculative_k does not compose with serving")
+        if cfg.long_context:
+            raise ValueError("long_context serving is not supported yet")
+        if cfg.data_parallel or cfg.tensor_parallel > 1:
+            raise ValueError(
+                "serving v1 drives a single placement target; drop "
+                "data_parallel/tensor_parallel"
+            )
+        self.cfg = cfg
+        self.serve_cfg = serve_cfg or ServeConfig()
+        self.device = device
+        self.model_cfg = LlamaConfig.from_pretrained(cfg.model_path)
+        self.dtype = _DTYPES[cfg.dtype]
+        if tokenizer is None:
+            from transformers import AutoTokenizer
+
+            tokenizer = AutoTokenizer.from_pretrained(cfg.model_path)
+        self.raw_tokenizer = tokenizer
+        self.tokenizer = PromptTokenizer(
+            tokenizer,
+            max_token_len=cfg.max_token_len,
+            bucket_multiple=cfg.bucket_multiple,
+        )
+        self.layer_names = checkpoint.layer_names_for(
+            self.model_cfg.num_hidden_layers, tie_word_embeddings=False
+        )
+        self.shards = list(
+            plan_shards_dp(
+                len(self.layer_names), cfg.layer_num_per_shard
+            ).shards
+        )
+        self._n_layers = len(self.layer_names)
+        self._use_pallas = cfg.pallas_enabled()
+        self._resident = cfg.decode_resident_enabled(
+            self.model_cfg, 1, device
+        )
+        self.metrics = ServingMetrics()
+        self.queue = AdmissionQueue(
+            self.serve_cfg.queue_capacity, metrics=self.metrics
+        )
+        self.batcher = ShardAwareBatcher(
+            self.queue,
+            self.serve_cfg.max_wave_requests,
+            self.serve_cfg.max_active_requests,
+            metrics=self.metrics,
+        )
+        self._kept: list | None = None  # resident: placed shards
+        self._source: ShardWeightSource | None = None  # streamed: cycling
+        self._src_iter = None
+        self._error: BaseException | None = None
+        self._thread: threading.Thread | None = None
+        if start:
+            self.start()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "ServeEngine":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._run, name="serve-engine", daemon=True
+            )
+            self._thread.start()
+        return self
+
+    def __enter__(self) -> "ServeEngine":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.shutdown(drain=exc == (None, None, None))
+
+    def submit(
+        self,
+        prefix: str,
+        suffixes: tuple[str, ...] | list[str],
+        max_new_tokens: int | None = None,
+        deadline_s: float | None = None,
+        callback: Callable[[Request], Any] | None = None,
+    ) -> Request:
+        """Enqueue one request (any thread). Backpressure/closed/deadline
+        outcomes surface through the returned request's future."""
+        if deadline_s is None and self.serve_cfg.default_deadline_s > 0:
+            deadline_s = self.serve_cfg.default_deadline_s
+        req = Request(
+            prefix=prefix,
+            suffixes=tuple(suffixes),
+            max_new_tokens=(
+                max_new_tokens
+                if max_new_tokens is not None
+                else self.serve_cfg.default_max_new_tokens
+            ),
+            deadline=(
+                time.monotonic() + deadline_s
+                if deadline_s is not None and deadline_s > 0
+                else None
+            ),
+            callback=callback,
+        )
+        if req.max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        return self.queue.submit(req)
+
+    def drain(self, timeout: float | None = None) -> bool:
+        """Graceful shutdown: refuse new submissions, serve out everything
+        queued and in flight, then stop. Returns whether the loop exited
+        within ``timeout``."""
+        return self.shutdown(drain=True, timeout=timeout)
+
+    def shutdown(self, drain: bool = True, timeout: float | None = None) -> bool:
+        self.queue.close(drain=drain)
+        if self._thread is not None:
+            self._thread.join(timeout)
+            return not self._thread.is_alive()
+        return True
+
+    @property
+    def error(self) -> BaseException | None:
+        return self._error
+
+    def stats(self) -> dict:
+        return self.metrics.snapshot()
+
+    # -- the serving loop --------------------------------------------------
+
+    def _run(self) -> None:
+        try:
+            self._acquire_weights()
+        except BaseException as e:  # noqa: BLE001 — surfaced via futures
+            self._fatal(e)
+            return
+        try:
+            while True:
+                # ---- shard-0 boundary: the admission point ----------------
+                wave = self.batcher.admit_at_boundary()
+                if wave is not None and not self._init_wave(wave):
+                    continue  # wave failed at tokenization; re-check queue
+                if not self.batcher.waves:
+                    if self.queue.closed and len(self.queue) == 0:
+                        break
+                    # The stats heartbeat must keep beating while IDLE too —
+                    # monitoring that watches for the periodic line would
+                    # otherwise read quiet traffic as a wedged server.
+                    self.metrics.maybe_emit(self.serve_cfg.stats_interval_s)
+                    if len(self.queue) == 0:
+                        time.sleep(self.serve_cfg.idle_poll_s)
+                    continue
+                t0 = time.perf_counter()
+                self._sweep()
+                self._post_sweep(time.perf_counter() - t0)
+                self.metrics.maybe_emit(self.serve_cfg.stats_interval_s)
+        except BaseException as e:  # noqa: BLE001
+            self._fatal(e)
+        finally:
+            self._release_weights()
+
+    def _fatal(self, error: BaseException) -> None:
+        """Engine-fatal: every in-flight AND queued request fails with the
+        root cause; the loop stops; later submits see ServeClosed."""
+        self._error = error
+        self.batcher.fail_all_active(error)
+        self.queue.close(drain=False)  # cancels queued; futures resolve
+        self._release_weights()
+
+    # -- weights -----------------------------------------------------------
+
+    def _mk_source(self, cycle: bool) -> ShardWeightSource:
+        return ShardWeightSource(
+            self.cfg.model_path,
+            self.layer_names,
+            self.shards,
+            np_dtype_for(self.cfg.dtype),
+            device=self.device,
+            prefetch_depth=self.cfg.effective_prefetch_depth(),
+            tied_embeddings=self.model_cfg.tie_word_embeddings,
+            layer_sliding=self.model_cfg.layer_sliding,
+            layer_rope=self.model_cfg.layer_rope,
+            cycle=cycle,
+        )
+
+    def _acquire_weights(self) -> None:
+        if self._resident:
+            # One pass places every shard; references kept for the engine's
+            # lifetime, so sweeps move zero weight bytes.
+            src = self._mk_source(cycle=False)
+            try:
+                self._kept = list(enumerate(src))
+            finally:
+                src.close()
+        else:
+            # Cycling stream: the producer wraps from the last shard back
+            # to shard 0, so the prefetch pipeline never cold-starts at a
+            # sweep boundary.
+            self._source = self._mk_source(cycle=True)
+            self._src_iter = iter(self._source)
+
+    def _release_weights(self) -> None:
+        self._kept = None
+        if self._source is not None:
+            self._source.close()
+            self._source = None
+            self._src_iter = None
+
+    def _sweep_shards(self):
+        if self._resident:
+            return iter(self._kept)
+        return enumerate(islice(self._src_iter, len(self.shards)))
+
+    # -- wave setup --------------------------------------------------------
+
+    def _init_wave(self, wave: Wave) -> bool:
+        """Tokenize/bucket the admitted requests and allocate wave state.
+        A bad workload (e.g. a longrope regime straddle) fails ONLY this
+        wave's requests; the engine keeps serving."""
+        try:
+            toks = [self.tokenizer(r.prefix, r.suffixes) for r in wave.requests]
+            check_longrope_regime(
+                self.model_cfg, toks, extra_len=max(wave.max_steps - 1, 0)
+            )
+            blocks = make_blocks(toks, self.cfg.block_size)
+            meta = {
+                b: (
+                    jnp.asarray(np.stack([toks[i].prefix_ids for i in idxs])),
+                    jnp.asarray(np.stack([toks[i].suffix_ids for i in idxs])),
+                    jnp.asarray(
+                        np.array(
+                            [toks[i].prefix_len for i in idxs], np.int32
+                        )
+                    ),
+                    jnp.asarray(np.stack([toks[i].suffix_eos for i in idxs])),
+                )
+                for b, idxs in enumerate(blocks)
+            }
+            loc = {
+                i: (b, row)
+                for b, idxs in enumerate(blocks)
+                for row, i in enumerate(idxs)
+            }
+            slots = max(1, wave.max_steps - 1)
+            # Same KV placement rule as the offline path: KV follows the
+            # weights onto the chip when they are resident and the wave's
+            # KV fits beside them — host-parked KV costs a full round trip
+            # per shard per decode step. The fit check is per WAVE; with
+            # several concurrent waves the 80% headroom in kv_fits_on_chip
+            # absorbs the others (waves are bounded by max_active_requests).
+            kv_on_device = self.cfg.storage_location == "tpu" or (
+                self._resident
+                and kv_fits_on_chip(
+                    self.model_cfg, self.cfg.dtype, toks, blocks, slots,
+                    device=self.device,
+                )
+            )
+            wave.state = _WaveState(
+                toks=toks,
+                blocks=blocks,
+                meta=meta,
+                kv_store=KVStore(on_device=kv_on_device),
+                scores={b: [] for b in range(len(blocks))},
+                tok_hist={b: [] for b in range(len(blocks))},
+                loc=loc,
+                slots=slots,
+            )
+            return True
+        except Exception as e:
+            for r in wave.requests:
+                if not r.status.terminal:
+                    r.fail(e, RequestStatus.FAILED)
+                    self.metrics.count("failed")
+            self.batcher.waves.remove(wave)
+            return False
+
+    # -- per-shard compute -------------------------------------------------
+
+    def _act_dev(self):
+        return getattr(self.device, "act", self.device)
+
+    def _sweep(self) -> None:
+        """One full weight pass: prefill segments for waves at step 0,
+        one decode step for everyone else."""
+        for shard_pos, (layer_idxs, segments) in self._sweep_shards():
+            if not layer_idxs:
+                continue
+            for wave in self.batcher.waves:
+                if wave.steps == 0:
+                    self._prefill_shard(wave, shard_pos, layer_idxs, segments)
+                else:
+                    self._decode_shard(wave, shard_pos, layer_idxs, segments)
+
+    def _prefill_shard(self, wave, shard_pos, layer_idxs, segments) -> None:
+        st: _WaveState = wave.state
+        act_dev = self._act_dev()
+        for b in range(len(st.blocks)):
+            prefix_ids, suffix_ids, prefix_len, suffix_eos = st.meta[b]
+            total_len = longrope_total_len(
+                self.model_cfg, prefix_len, suffix_eos
+            )
+            if layer_idxs[0] == 0:
+                ph, sh = None, None
+            else:
+                ph, sh = st.kv_store.get(("h", b), act_dev)
+            di = 0
+            for kind, params in segments:
+                if kind == "embed":
+                    ph, sh = _embed_block(
+                        self.model_cfg, self.dtype, params,
+                        prefix_ids, suffix_ids,
+                    )
+                elif kind == "decoders":
+                    ph, sh, kv = _prefill_decoders(
+                        self.model_cfg, self._use_pallas, None, params,
+                        ph, sh, prefix_len, total_len,
+                    )
+                    kv = extend_gen_kv(
+                        kv, st.slots, self.dtype, device=act_dev
+                    )
+                    st.kv_store.put(("kv", shard_pos, di, b), kv)
+                    di += 1
+                elif kind == "norm":
+                    sh = _norm_block(
+                        self.model_cfg, params, sh, suffix_eos
+                    )
+                    ph = None
+                else:  # head
+                    dist = np.asarray(
+                        jax.device_get(
+                            _head_block(self.model_cfg, params, sh)
+                        )
+                    )
+                    st.scores[b].append(dist)
+                    st.tok_hist[b].append(np.argmax(dist, axis=-1))
+            if layer_idxs[-1] != self._n_layers - 1:
+                st.kv_store.put(("h", b), (ph, sh))
+
+    def _decode_shard(self, wave, shard_pos, layer_idxs, segments) -> None:
+        st: _WaveState = wave.state
+        act_dev = self._act_dev()
+        t = jnp.int32(wave.steps - 1)  # this step's generated-KV slot
+        for b in range(len(st.blocks)):
+            # Blocks whose every request already resolved sit the sweep out
+            # (statuses only change in _post_sweep, so liveness is stable
+            # within a sweep): a mixed-budget wave must not keep paying
+            # full decode + head + host transfer for finished rows until
+            # its slowest request completes.
+            if all(
+                wave.requests[i].status.terminal for i in st.blocks[b]
+            ):
+                continue
+            _, _, prefix_len, suffix_eos = st.meta[b]
+            x = (
+                None
+                if layer_idxs[0] == 0
+                else st.kv_store.get(("x", b), act_dev)
+            )
+            di = 0
+            for kind, params in segments:
+                if kind == "embed":
+                    x = llama.embed(
+                        params,
+                        jnp.asarray(
+                            st.tok_hist[b][-1][..., None], jnp.int32
+                        ),
+                        self.dtype,
+                        self.model_cfg,
+                    )
+                elif kind == "decoders":
+                    kv = st.kv_store.get(("kv", shard_pos, di, b), act_dev)
+                    x, kv = _decode_decoders(
+                        self.model_cfg, self._use_pallas, None, params,
+                        kv, x, prefix_len, suffix_eos, t,
+                    )
+                    st.kv_store.put(("kv", shard_pos, di, b), kv)
+                    di += 1
+                elif kind == "norm":
+                    st.norm_p = params  # applied in the head shard
+                else:  # head
+                    assert st.norm_p is not None
+                    dist = np.asarray(
+                        jax.device_get(
+                            _decode_norm_head(
+                                self.model_cfg,
+                                jax.device_put(st.norm_p, act_dev),
+                                params,
+                                x,
+                            )
+                        )
+                    )
+                    st.scores[b].append(dist)
+                    st.tok_hist[b].append(np.argmax(dist, axis=-1))
+            if layer_idxs[-1] != self._n_layers - 1:
+                st.kv_store.put(("x", b), x)
+
+    # -- post-sweep bookkeeping --------------------------------------------
+
+    def _post_sweep(self, sweep_wall_s: float) -> None:
+        now = time.monotonic()
+        emitted = 0
+        for wave in self.batcher.waves:
+            prefilled = wave.steps == 0
+            wave.steps += 1
+            if prefilled:
+                self.metrics.count("prefills")
+            for r in wave.requests:
+                if r.status.terminal:
+                    continue
+                if prefilled and r.first_token_at is None:
+                    r.first_token_at = now
+                    self.metrics.observe_ttft(now - r.arrival)
+                if r.tokens_emitted < r.max_new_tokens:
+                    r.tokens_emitted += 1
+                    emitted += 1
+                if r.tokens_emitted >= r.max_new_tokens:
+                    self._resolve(wave, r)
+        self.metrics.count("sweeps")
+        if emitted:
+            self.metrics.count("tokens_emitted", emitted)
+            self.metrics.observe_token_latency(sweep_wall_s)
+        for w in self.batcher.retire_done():
+            if w.state is not None:
+                w.state.kv_store.clear()
+
+    def _resolve(self, wave: Wave, r: Request) -> None:
+        st: _WaveState = wave.state
+        i = wave.requests.index(r)
+        b, row = st.loc[i]
+        s_true = st.toks[i].num_suffixes
+        n = r.max_new_tokens
+        scores = np.stack(
+            [st.scores[b][t][row, :s_true] for t in range(n)], axis=1
+        )
+        tokens = np.stack(
+            [st.tok_hist[b][t][row, :s_true] for t in range(n)], axis=1
+        )
+        updated = (
+            r.prefix,
+            tuple(
+                s + self.raw_tokenizer.decode(tokens[s_i])
+                for s_i, s in enumerate(r.suffixes)
+            ),
+        )
+        r.resolve(scores, updated, tokens)
+        self.metrics.count("completed")
+
+
+__all__ = ["ServeEngine"]

@@ -1,0 +1,155 @@
+"""Thread-safe admission queue with backpressure and deadline eviction.
+
+The online front door: submitters (any thread) push requests; the serving
+loop pops batches at shard-0 boundaries. Three contracts, each loud:
+
+- **Backpressure**: a submit against a full queue raises ``QueueFull`` with
+  the reason (capacity and current depth) — bounded memory under overload,
+  and the caller learns WHY instead of blocking or silently dropping.
+- **Deadline eviction**: a request whose admission deadline passes while
+  queued is evicted with status ``expired`` and its future raises
+  ``DeadlineExceeded`` — serving a request whose time-to-first-token
+  contract is already lost wastes sweeps the live requests need. Eviction
+  happens lazily at pop/submit time (no timer thread to leak).
+- **Drain-on-shutdown**: ``close(drain=True)`` refuses new submissions but
+  lets the engine serve out everything already queued; ``drain=False``
+  additionally cancels the queued requests (futures raise ``ServeClosed``).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+
+from flexible_llm_sharding_tpu.serve.request import (
+    DeadlineExceeded,
+    QueueFull,
+    Request,
+    RequestStatus,
+    ServeClosed,
+)
+
+
+class AdmissionQueue:
+    def __init__(self, capacity: int, metrics=None):
+        if capacity < 1:
+            raise ValueError("capacity must be >= 1")
+        self.capacity = capacity
+        self._metrics = metrics  # utils.metrics.ServingMetrics or None
+        self._lock = threading.Lock()
+        self._items: deque[Request] = deque()
+        self._closed = False
+
+    # -- submit side -------------------------------------------------------
+
+    def submit(self, request: Request) -> Request:
+        """Enqueue, or raise QueueFull/ServeClosed. Terminal transitions
+        happen OUTSIDE the lock (callbacks may be arbitrarily slow)."""
+        evicted: list[Request] = []
+        with self._lock:
+            if self._closed:
+                reject: BaseException = ServeClosed("serve queue is closed")
+                status = RequestStatus.CANCELLED
+            else:
+                # Expired waiters free their slots before the capacity
+                # check, and their futures resolve below (outside the lock)
+                # — an eviction must never be a silent drop.
+                evicted = self._evict_expired_locked()
+                if len(self._items) >= self.capacity:
+                    reject = QueueFull(
+                        f"admission queue full (capacity {self.capacity}, "
+                        f"depth {len(self._items)}); retry with backoff or "
+                        "raise queue_capacity"
+                    )
+                    status = RequestStatus.REJECTED
+                else:
+                    self._items.append(request)
+                    reject = None  # type: ignore[assignment]
+                    depth = len(self._items)
+        self._finish_expired(evicted)
+        if reject is not None:
+            request.fail(reject, status)
+            if self._metrics is not None:
+                if status is RequestStatus.REJECTED:
+                    self._metrics.count("rejected")
+                else:
+                    self._metrics.count("cancelled")
+            return request
+        if self._metrics is not None:
+            self._metrics.gauge("queue_depth", depth)
+        return request
+
+    # -- pop side (the batcher, at shard-0 boundaries) ---------------------
+
+    def pop_wave(self, max_requests: int) -> list[Request]:
+        """Up to ``max_requests`` non-expired requests in arrival order;
+        expired ones encountered on the way are evicted."""
+        with self._lock:
+            evicted = self._evict_expired_locked()
+            out: list[Request] = []
+            while self._items and len(out) < max_requests:
+                out.append(self._items.popleft())
+            depth = len(self._items)
+        self._finish_expired(evicted)
+        if self._metrics is not None:
+            self._metrics.gauge("queue_depth", depth)
+        return out
+
+    def _evict_expired_locked(self) -> list[Request]:
+        now = time.monotonic()
+        live: deque[Request] = deque()
+        evicted: list[Request] = []
+        while self._items:
+            r = self._items.popleft()
+            (evicted if r.expired(now) else live).append(r)
+        self._items = live
+        return evicted
+
+    def _finish_expired(self, evicted: list[Request]) -> None:
+        for r in evicted:
+            waited = time.monotonic() - r.arrival
+            r.fail(
+                DeadlineExceeded(
+                    f"request {r.request_id} waited {waited:.3f}s in the "
+                    "admission queue, past its deadline"
+                ),
+                RequestStatus.EXPIRED,
+            )
+            if self._metrics is not None:
+                self._metrics.count("expired")
+
+    # -- introspection / shutdown ------------------------------------------
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._items)
+
+    @property
+    def closed(self) -> bool:
+        with self._lock:
+            return self._closed
+
+    def close(self, drain: bool = True) -> list[Request]:
+        """Refuse further submissions. ``drain=True`` leaves queued requests
+        for the engine to serve out; ``drain=False`` cancels them (futures
+        raise ServeClosed). Returns the requests cancelled (empty when
+        draining). Idempotent."""
+        with self._lock:
+            self._closed = True
+            cancelled = [] if drain else list(self._items)
+            if not drain:
+                self._items.clear()
+        for r in cancelled:
+            r.fail(
+                ServeClosed("serve queue shut down before admission"),
+                RequestStatus.CANCELLED,
+            )
+            if self._metrics is not None:
+                self._metrics.count("cancelled")
+        if self._metrics is not None:
+            self._metrics.gauge("queue_depth", len(self))
+        return cancelled
+
+
+__all__ = ["AdmissionQueue"]

@@ -1,0 +1,189 @@
+"""Request/response objects for the online serving subsystem.
+
+A request is the online unit of work: one (prefix, suffixes) prompt — the
+same shape the offline pickle contract uses — plus a generation budget and
+an optional queue-wait deadline. Its lifecycle is tracked explicitly
+(QUEUED -> ACTIVE -> DONE, or the terminal rejection/eviction/failure
+states) so the queue, batcher and engine can each assert the transitions
+they own instead of guessing from side effects.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import itertools
+import threading
+import time
+from typing import Any, Callable
+
+import numpy as np
+
+Prompt = tuple[str, tuple[str, ...]]
+
+_REQUEST_IDS = itertools.count()
+
+
+class RequestStatus(enum.Enum):
+    QUEUED = "queued"      # accepted by the admission queue, waiting
+    ACTIVE = "active"      # admitted into a wave (prefill or decode)
+    DONE = "done"          # all tokens emitted; result resolved
+    REJECTED = "rejected"  # backpressure: queue full at submit time
+    EXPIRED = "expired"    # deadline passed before admission
+    FAILED = "failed"      # engine error while the request was in flight
+    CANCELLED = "cancelled"  # shutdown without drain while still queued
+
+    @property
+    def terminal(self) -> bool:
+        return self not in (RequestStatus.QUEUED, RequestStatus.ACTIVE)
+
+
+class QueueFull(RuntimeError):
+    """Backpressure rejection: the admission queue was at capacity. The
+    message carries the reason (capacity, depth) so callers can surface it
+    verbatim — the contract is reject-with-reason, never silent drops."""
+
+
+class DeadlineExceeded(RuntimeError):
+    """The request's queue-wait deadline passed before a wave admitted it."""
+
+
+class ServeClosed(RuntimeError):
+    """Submit after shutdown (or eviction of still-queued requests by a
+    no-drain shutdown)."""
+
+
+@dataclasses.dataclass
+class RequestResult:
+    """The served completion: the same per-prompt contract as the offline
+    batch path (``scores`` [n_suffixes, n_tokens, vocab] float32; ``updated``
+    is the prompt with generated text appended to each suffix) plus serving
+    timings."""
+
+    request_id: int
+    scores: np.ndarray
+    updated: Prompt
+    tokens: np.ndarray  # [n_suffixes, n_tokens] emitted token ids
+    ttft_s: float       # submit -> first token wall
+    latency_s: float    # submit -> completion wall
+    queue_wait_s: float  # submit -> wave admission wall
+
+
+class ServeFuture:
+    """Minimal future the engine resolves per request.
+
+    ``result(timeout)`` blocks for the RequestResult or re-raises the
+    request's terminal error (QueueFull / DeadlineExceeded / ServeClosed /
+    the engine failure). An optional ``callback(request)`` fires exactly
+    once on ANY terminal transition, from the resolving thread.
+    """
+
+    def __init__(self) -> None:
+        self._event = threading.Event()
+        self._result: RequestResult | None = None
+        self._error: BaseException | None = None
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def set_result(self, result: RequestResult) -> None:
+        self._result = result
+        self._event.set()
+
+    def set_error(self, error: BaseException) -> None:
+        self._error = error
+        self._event.set()
+
+    def result(self, timeout: float | None = None) -> RequestResult:
+        if not self._event.wait(timeout):
+            raise TimeoutError("request not finished")
+        if self._error is not None:
+            raise self._error
+        assert self._result is not None
+        return self._result
+
+    def exception(self, timeout: float | None = None) -> BaseException | None:
+        if not self._event.wait(timeout):
+            raise TimeoutError("request not finished")
+        return self._error
+
+
+@dataclasses.dataclass
+class Request:
+    """One online request plus its mutable serving state."""
+
+    prefix: str
+    suffixes: tuple[str, ...]
+    max_new_tokens: int
+    # Absolute monotonic deadline for ADMISSION (None = none): a request
+    # still queued past this instant is evicted, because its
+    # time-to-first-token contract is already lost.
+    deadline: float | None = None
+    callback: Callable[["Request"], Any] | None = None
+    request_id: int = dataclasses.field(
+        default_factory=lambda: next(_REQUEST_IDS)
+    )
+    # -- serving state (owned by queue/batcher/engine) --------------------
+    status: RequestStatus = RequestStatus.QUEUED
+    arrival: float = dataclasses.field(default_factory=time.monotonic)
+    admitted_at: float | None = None
+    first_token_at: float | None = None
+    finished_at: float | None = None
+    tokens_emitted: int = 0
+    future: ServeFuture = dataclasses.field(default_factory=ServeFuture)
+
+    @property
+    def prompt(self) -> Prompt:
+        return (self.prefix, self.suffixes)
+
+    def expired(self, now: float | None = None) -> bool:
+        return self.deadline is not None and (
+            now if now is not None else time.monotonic()
+        ) >= self.deadline
+
+    # -- terminal transitions (each fires the callback exactly once) ------
+    # Ordering contract: status/finished_at are assigned BEFORE the future
+    # resolves (a waiter woken by future.result() must never observe a
+    # stale non-terminal status), and the callback fires last (it may call
+    # future.result() itself).
+
+    def _fire_callback(self) -> None:
+        if self.callback is not None:
+            try:
+                self.callback(self)
+            except Exception:
+                pass  # a callback bug must not take down the serving loop
+
+    def resolve(self, scores: np.ndarray, updated: Prompt,
+                tokens: np.ndarray) -> None:
+        result = RequestResult(
+            request_id=self.request_id,
+            scores=scores,
+            updated=updated,
+            tokens=tokens,
+            ttft_s=(self.first_token_at or time.monotonic()) - self.arrival,
+            latency_s=time.monotonic() - self.arrival,
+            queue_wait_s=(self.admitted_at or self.arrival) - self.arrival,
+        )
+        self.status = RequestStatus.DONE
+        self.finished_at = time.monotonic()
+        self.future.set_result(result)
+        self._fire_callback()
+
+    def fail(self, error: BaseException, status: RequestStatus) -> None:
+        self.status = status
+        self.finished_at = time.monotonic()
+        self.future.set_error(error)
+        self._fire_callback()
+
+
+__all__ = [
+    "DeadlineExceeded",
+    "Prompt",
+    "QueueFull",
+    "Request",
+    "RequestResult",
+    "RequestStatus",
+    "ServeClosed",
+    "ServeFuture",
+]

@@ -209,6 +209,96 @@ class Recorder:
         return agg
 
 
+def _latency_summary(samples: list[float]) -> dict[str, float]:
+    """{count, mean, p50, p95, max} in seconds for a latency sample list."""
+    if not samples:
+        return {"count": 0}
+    import numpy as np
+
+    arr = np.asarray(samples, np.float64)
+    return {
+        "count": int(arr.size),
+        "mean": round(float(arr.mean()), 4),
+        "p50": round(float(np.percentile(arr, 50)), 4),
+        "p95": round(float(np.percentile(arr, 95)), 4),
+        "max": round(float(arr.max()), 4),
+    }
+
+
+class ServingMetrics:
+    """Counters/gauges/latency samples for the online serving subsystem.
+
+    Thread-safe (submitters, the serving loop, and callbacks all touch it).
+    Counters: admitted / rejected / expired / cancelled / completed /
+    failed / prefills / sweeps / tokens_emitted. Gauges: queue_depth /
+    active_requests / active_waves. Latency samples: ttft_s (submit ->
+    first token) and token_s (per-token decode latency) — kept in a
+    BOUNDED window (``sample_window`` newest samples) so a long-running
+    server neither grows memory with uptime nor recomputes percentiles
+    over its whole history inside the lock; the summaries are therefore
+    recent-window statistics, while the counters remain all-time totals.
+    ``snapshot()`` returns one JSON-able dict — the periodic structured
+    stats line — and ``maybe_emit(interval)`` prints it to stderr at most
+    once per interval (0 disables)."""
+
+    def __init__(self, sample_window: int = 4096) -> None:
+        import threading
+        from collections import deque
+
+        self._lock = threading.Lock()
+        self._counters: dict[str, int] = {}
+        self._gauges: dict[str, float] = {}
+        self._ttft: deque[float] = deque(maxlen=sample_window)
+        self._token_lat: deque[float] = deque(maxlen=sample_window)
+        self._last_emit = 0.0
+
+    def count(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + n
+
+    def gauge(self, name: str, value: float) -> None:
+        with self._lock:
+            self._gauges[name] = value
+
+    def observe_ttft(self, seconds: float) -> None:
+        with self._lock:
+            self._ttft.append(seconds)
+
+    def observe_token_latency(self, seconds: float) -> None:
+        with self._lock:
+            self._token_lat.append(seconds)
+
+    def counter(self, name: str) -> int:
+        with self._lock:
+            return self._counters.get(name, 0)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "event": "serve_stats",
+                **{k: v for k, v in sorted(self._counters.items())},
+                **{k: v for k, v in sorted(self._gauges.items())},
+                "ttft_s": _latency_summary(list(self._ttft)),
+                "token_latency_s": _latency_summary(list(self._token_lat)),
+            }
+
+    def emit(self) -> None:
+        print(json.dumps(self.snapshot()), file=sys.stderr, flush=True)
+
+    def maybe_emit(self, interval_s: float) -> bool:
+        """Emit the stats line if ``interval_s`` has passed since the last
+        emission (0 = off). Returns whether a line was printed."""
+        if not interval_s:
+            return False
+        now = time.monotonic()
+        with self._lock:
+            if now - self._last_emit < interval_s:
+                return False
+            self._last_emit = now
+        self.emit()
+        return True
+
+
 @contextlib.contextmanager
 def profiler_trace(log_dir: str | None):
     """``jax.profiler`` trace scope (Perfetto/XProf) when a directory is
@@ -526,6 +616,7 @@ def throughput(tokens: int, seconds: float, chips: int = 1) -> dict[str, float]:
 __all__ = [
     "LiveArrayPeakSampler",
     "Recorder",
+    "ServingMetrics",
     "chip_peak_flops",
     "model_flops_per_token",
     "compiled_memory_analysis",

@@ -79,6 +79,64 @@ def tag_prior_legs(result: dict, prior_platform: str | None) -> None:
             result[leg].setdefault("platform", leg_platform)
 
 
+def resolve_artifact_out(out: str, cfg: dict, workload: dict):
+    """Decide where this invocation's results go: ``(prior_result,
+    merged_prior, out_path)``.
+
+    A matching existing artifact (same config AND workload) merges —
+    results accumulate across invocations, the watcher's whole capture
+    strategy. An existing artifact that does NOT match (different model
+    size, different prompt workload, or unparseable) is **never
+    overwritten**: the run writes a ``<out>.mismatch<ext>`` sidecar
+    instead, so a misconfigured invocation can't silently drop the
+    committed cpu/disk legs from the artifact of record."""
+    if not os.path.exists(out):
+        return {}, False, out
+    prior = None
+    try:
+        with open(out) as f:
+            prior = json.load(f)
+    except ValueError:
+        pass
+    if (
+        isinstance(prior, dict)
+        and prior.get("config") == cfg
+        and prior.get("workload") == workload
+    ):
+        return prior, True, out
+    # Sidecars follow the SAME merge-or-step-aside rule as the artifact of
+    # record: a matching sidecar merges, a mismatched one is preserved and
+    # the next numbered name is tried — otherwise every later mismatched
+    # run would wholesale-overwrite the first sidecar, recreating exactly
+    # the data loss this path guards against.
+    root, ext = os.path.splitext(out)
+    for n in range(1, 100):
+        side = f"{root}.mismatch{'' if n == 1 else f'-{n}'}{ext or '.json'}"
+        if not os.path.exists(side):
+            log(
+                f"existing {out} holds a different config/workload — "
+                f"refusing to overwrite it; this run's results go to the "
+                f"sidecar {side}"
+            )
+            return {}, False, side
+        try:
+            with open(side) as f:
+                sp = json.load(f)
+        except ValueError:
+            continue
+        if (
+            isinstance(sp, dict)
+            and sp.get("config") == cfg
+            and sp.get("workload") == workload
+        ):
+            log(f"merging into existing matching sidecar {side}")
+            return sp, True, side
+    raise SystemExit(
+        f"{root}.mismatch* sidecar namespace exhausted — clean up stale "
+        "sidecars"
+    )
+
+
 def recompute_platform_marking(result: dict) -> None:
     """Top-level platform from per-leg provenance: the artifact is hardware
     evidence iff at least one big leg ran on a positively-probed TPU. One
@@ -399,22 +457,13 @@ def main() -> None:
         "suffix_words": 24,
         "n_suffix": 4,
     }
-    out = args.out
-    result: dict = {}
-    merged_prior = False
-    if os.path.exists(out):
-        # Merge runs across invocations — only for the SAME model AND the
-        # same prompt workload (stats/flags from a different workload would
-        # masquerade as one coherent result).
-        try:
-            with open(out) as f:
-                prior = json.load(f)
-            if prior.get("config") == cfg and prior.get("workload") == workload:
-                result = prior
-                merged_prior = True
-                tag_prior_legs(result, prior.get("platform"))
-        except ValueError:
-            pass
+    # Merge runs across invocations — only for the SAME model AND the same
+    # prompt workload (stats/flags from a different workload would
+    # masquerade as one coherent result); a mismatched existing artifact is
+    # preserved and this run's results land in a sidecar instead.
+    result, merged_prior, out = resolve_artifact_out(args.out, cfg, workload)
+    if merged_prior:
+        tag_prior_legs(result, result.get("platform"))
     result.update(
         {
             "config": cfg,

@@ -79,13 +79,15 @@ print('tpu' if legs else 'cpu,tpu,disk')"; }
     # Bench is complete only when EVERY phase's headline metric is on
     # hardware (possibly via carry-forward across windows) — the single
     # platform=tpu check let the watcher exit with int4/resident-MFU/spec
-    # still missing.
+    # still missing. phase_captured additionally treats *_inconclusive
+    # values as NOT captured, so a window whose ratio came back without a
+    # verdict keeps the watcher re-measuring instead of exiting on it.
     bench_complete() { python -c "
 import sys
 sys.path.insert(0, '.')
-from bench import PHASE_EVIDENCE_KEY, load_tpu_capture
+from bench import PHASE_EVIDENCE_KEY, load_tpu_capture, phase_captured
 d = load_tpu_capture() or {}
-missing = [k for k in PHASE_EVIDENCE_KEY.values() if d.get(k) is None]
+missing = [p for p in PHASE_EVIDENCE_KEY if not phase_captured(d, p)]
 sys.exit(0 if d and not missing else 1)
 " 2>/dev/null; }
     gb_ok() { python -c "import json,sys; d=json.load(open('BENCH_GB_r05.json')); sys.exit(0 if d.get('platform')=='tpu' and not d.get('partial') and d.get('gb_tokens_per_sec') else 1)" 2>/dev/null; }

@@ -62,6 +62,20 @@ def test_skip_captured_phases(tmp_path, monkeypatch):
     monkeypatch.setenv("BENCH_SKIP_CAPTURED", "1")
     assert bench._phases_to_skip() == {"pairs", "int8"}
 
+    # An INCONCLUSIVE headline value does not count as captured: the whole
+    # point of a skip-mode window is to spend it on what's missing, and a
+    # verdict-less median is still missing (the watcher's bench_complete
+    # gate shares phase_captured, so it keeps retrying too).
+    cap.write_text(
+        '{"platform": "tpu", "vs_baseline": 1.2,'
+        ' "vs_baseline_inconclusive": true, "int8_speedup": 1.5}'
+    )
+    assert bench._phases_to_skip() == {"int8"}
+    assert not bench.phase_captured(
+        {"vs_baseline": 1.2, "vs_baseline_inconclusive": True}, "pairs"
+    )
+    assert bench.phase_captured({"vs_baseline": 1.2}, "pairs")
+
     # Every phase name maps to a key the persist path can actually carry.
     assert set(bench.PHASE_EVIDENCE_KEY.values()) <= set(bench.HEADLINE_KEYS)
 
@@ -122,6 +136,73 @@ def test_merge_best_link_normalized_upgrades():
     for extras in bench.RATIO_GROUP_EXTRAS.values():
         group_keys |= set(extras)
     assert group_keys <= set(bench.HEADLINE_KEYS)
+
+
+def test_promotion_keeps_stronger_ratio_groups(tmp_path, monkeypatch):
+    """A better-link run PROMOTES to best, but group-level conclusive/n
+    arbitration (the same _merge_best rules, roles swapped) keeps the prior
+    best's stronger RATIO_BASES evidence instead of wholesale-overwriting
+    it; link-bound keys (value, host_to_hbm_gbps) follow the better link."""
+    import json
+
+    latest = tmp_path / "latest.json"
+    best = tmp_path / "best.json"
+    monkeypatch.setattr(bench, "TPU_CAPTURE_PATH", str(latest))
+    monkeypatch.setattr(bench, "BEST_CAPTURE_PATH", str(best))
+    best.write_text(json.dumps({
+        "platform": "tpu", "captured_at": "old",
+        "value": 100.0, "host_to_hbm_gbps": 0.03,
+        "vs_baseline": 1.183, "vs_baseline_n": 3,
+        "vs_baseline_inconclusive": False,
+        "vs_baseline_spread": [1.0, 1.2, 1.3],
+        # present only in best: must survive promotion as a gap-fill
+        "int8_speedup": 1.533, "int8_speedup_n": 2,
+        "int8_speedup_inconclusive": False,
+        "overlap_efficiency": 0.986,
+    }))
+    result = {
+        "platform": "tpu",
+        "value": 150.0, "host_to_hbm_gbps": 0.05,  # better link
+        # weaker evidence than best's conclusive n=3: must NOT take over
+        "vs_baseline": 0.9, "vs_baseline_n": 1,
+        "vs_baseline_inconclusive": True,
+        "vs_baseline_spread": [0.9, 0.9, 0.9],
+    }
+    bench.persist_tpu_capture(result)
+    promoted = json.loads(best.read_text())
+    # link-bound keys follow the better link...
+    assert promoted["value"] == 150.0
+    assert promoted["host_to_hbm_gbps"] == 0.05
+    # ...but the conclusive n=3 ratio group survives, whole
+    assert promoted["vs_baseline"] == 1.183
+    assert promoted["vs_baseline_n"] == 3
+    assert promoted["vs_baseline_inconclusive"] is False
+    assert promoted["vs_baseline_spread"] == [1.0, 1.2, 1.3]
+    # groups/singletons absent from the new run fill from the prior best
+    assert promoted["int8_speedup"] == 1.533
+    assert promoted["overlap_efficiency"] == 0.986
+    assert set(promoted["kept_keys"]) == {
+        "vs_baseline", "int8_speedup", "overlap_efficiency",
+    }
+    assert promoted["kept_from"] == "old"
+
+    # STRONGER new evidence on a better link does take the group over.
+    result2 = {
+        "platform": "tpu",
+        "value": 160.0, "host_to_hbm_gbps": 0.06,
+        "vs_baseline": 1.25, "vs_baseline_n": 5,
+        "vs_baseline_inconclusive": False,
+    }
+    bench.persist_tpu_capture(result2)
+    promoted2 = json.loads(best.read_text())
+    assert promoted2["vs_baseline"] == 1.25
+    assert promoted2["vs_baseline_n"] == 5
+    # groups the new run didn't measure still gap-fill from the prior best
+    assert promoted2["int8_speedup"] == 1.533
+    # provenance: vs_baseline is now THIS run's own measurement, so it must
+    # not stay listed as inherited; int8 (gap-filled) is.
+    assert "vs_baseline" not in promoted2["kept_keys"]
+    assert "int8_speedup" in promoted2["kept_keys"]
 
 
 @pytest.fixture

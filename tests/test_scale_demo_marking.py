@@ -23,6 +23,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from scale_demo import (  # noqa: E402
     _wait_with_stall_kill,
     recompute_platform_marking,
+    resolve_artifact_out,
     resolve_leg_platform,
     tag_prior_legs,
 )
@@ -86,6 +87,56 @@ def test_stall_kill_on_fresh_stall_lines(tmp_path):
         _wait_with_stall_kill(proc, str(err), "x", stall_kill_min=15,
                               poll_s=0.2)
     assert proc.poll() is not None  # really dead
+
+
+def test_mismatched_artifact_goes_to_sidecar(tmp_path):
+    """An existing --out whose config/workload does not merge is never
+    overwritten: the run is redirected to a '<out>.mismatch.json' sidecar,
+    so a misconfigured invocation can't silently drop committed cpu/disk
+    legs from the artifact of record."""
+    import json
+
+    cfg = {"hidden_size": 4096}
+    wl = {"prompts": 8}
+    out = str(tmp_path / "SCALE.json")
+
+    # No artifact yet: write in place, nothing merged.
+    assert resolve_artifact_out(out, cfg, wl) == ({}, False, out)
+
+    # Matching artifact: merged, same path.
+    prior = {"config": cfg, "workload": wl, "cpu": {"wall_s": 1.0}}
+    with open(out, "w") as f:
+        json.dump(prior, f)
+    result, merged, path = resolve_artifact_out(out, cfg, wl)
+    assert merged and path == out and result["cpu"] == {"wall_s": 1.0}
+
+    # Mismatched config: artifact untouched, sidecar path returned.
+    result, merged, path = resolve_artifact_out(
+        out, {"hidden_size": 1024}, wl
+    )
+    assert not merged and result == {}
+    assert path == str(tmp_path / "SCALE.mismatch.json")
+    with open(out) as f:
+        assert json.load(f) == prior  # the committed legs survive
+
+    # Mismatched workload and unparseable artifacts behave the same.
+    assert resolve_artifact_out(out, cfg, {"prompts": 2})[2] == path
+
+    # The sidecar itself follows the same rule: a matching sidecar MERGES,
+    # a mismatched one is preserved and the next numbered name is used —
+    # later mismatched runs must not clobber the first sidecar either.
+    side_cfg = {"hidden_size": 1024}
+    with open(path, "w") as f:
+        json.dump({"config": side_cfg, "workload": wl, "tpu": {"x": 1}}, f)
+    result, merged, p2 = resolve_artifact_out(out, side_cfg, wl)
+    assert merged and p2 == path and result["tpu"] == {"x": 1}
+    result, merged, p3 = resolve_artifact_out(out, {"hidden_size": 99}, wl)
+    assert not merged
+    assert p3 == str(tmp_path / "SCALE.mismatch-2.json")
+
+    with open(out, "w") as f:
+        f.write("{corrupt")
+    assert resolve_artifact_out(out, side_cfg, wl)[1:] == (True, path)
 
 
 def test_top_level_marking_follows_leg_evidence():
