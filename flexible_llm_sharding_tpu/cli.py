@@ -23,7 +23,12 @@ import os
 import pickle
 import sys
 
-from flexible_llm_sharding_tpu.config import DEFAULT_MAX_TOKEN_LEN, FrameworkConfig
+from flexible_llm_sharding_tpu.config import (
+    DEFAULT_MAX_TOKEN_LEN,
+    FAULT_SITES,
+    FaultConfig,
+    FrameworkConfig,
+)
 
 
 def _str2bool(v: str) -> bool:
@@ -38,6 +43,48 @@ def _str2bool_or_auto(v: str) -> bool | None:
     if v.lower() == "auto":
         return None
     return _str2bool(v)
+
+
+def _add_robustness_flags(p: argparse.ArgumentParser) -> None:
+    """Shared by the batch and serve parsers: transient-I/O retry knobs and
+    the deterministic chaos (fault-injection) switch."""
+    p.add_argument("--io_retry_attempts", type=int, default=4,
+                   help="attempts per weight-stream I/O call (layer read, "
+                        "host->device put) before a typed ShardLoadError "
+                        "surfaces; 1 disables retrying")
+    p.add_argument("--io_retry_base_s", type=float, default=0.05,
+                   help="first retry backoff; doubles per attempt (jittered)")
+    p.add_argument("--io_retry_deadline_s", type=float, default=60.0,
+                   help="overall wall cap per retried call (0 = none)")
+    p.add_argument("--chaos", action="store_true",
+                   help="enable deterministic fault injection at the named "
+                        "sites (faults/inject.py) — proves the retry/degrade "
+                        "layer on real workloads without waiting for real "
+                        "outages; off = zero overhead")
+    p.add_argument("--chaos_seed", type=int, default=0,
+                   help="injection schedule seed (same seed = same faults)")
+    p.add_argument("--chaos_error_rate", type=float, default=0.1,
+                   help="probability of an injected IOError per site fire")
+    p.add_argument("--chaos_truncate_rate", type=float, default=0.0,
+                   help="probability of an injected truncated read")
+    p.add_argument("--chaos_latency_rate", type=float, default=0.0,
+                   help="probability of an injected latency spike")
+    p.add_argument("--chaos_sites", type=str, default="",
+                   help=f"comma list of sites to inject at (default all): "
+                        f"{','.join(FAULT_SITES)}")
+
+
+def _fault_config_from_args(args: argparse.Namespace) -> FaultConfig:
+    if not args.chaos:
+        return FaultConfig()
+    return FaultConfig(
+        enabled=True,
+        seed=args.chaos_seed,
+        error_rate=args.chaos_error_rate,
+        truncate_rate=args.chaos_truncate_rate,
+        latency_rate=args.chaos_latency_rate,
+        sites=tuple(s for s in args.chaos_sites.split(",") if s),
+    )
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -122,6 +169,7 @@ def build_parser() -> argparse.ArgumentParser:
                         "omit for single-host")
     p.add_argument("--num_processes", type=int, default=None)
     p.add_argument("--process_id", type=int, default=None)
+    _add_robustness_flags(p)
     return p
 
 
@@ -153,6 +201,10 @@ def config_from_args(args: argparse.Namespace) -> FrameworkConfig:
         top_k=args.top_k,
         top_p=args.top_p,
         seed=args.seed,
+        io_retry_attempts=args.io_retry_attempts,
+        io_retry_base_s=args.io_retry_base_s,
+        io_retry_deadline_s=args.io_retry_deadline_s,
+        faults=_fault_config_from_args(args),
     )
 
 
@@ -209,6 +261,12 @@ def build_serve_parser() -> argparse.ArgumentParser:
     p.add_argument("--stats_interval_s", type=float, default=10.0,
                    help="periodic structured serve-stats JSON line on "
                         "stderr (0 = off)")
+    p.add_argument("--watchdog_abort_s", type=float, default=600.0,
+                   help="streamed-weights mode: abort and recover a sweep "
+                        "that makes no shard progress for this long — the "
+                        "stalled wave's requests fail with a structured "
+                        "error instead of hanging forever (0 = off)")
+    _add_robustness_flags(p)
     # Demo driver: submit a prompt pickle at staggered times, write the
     # offline-contract outputs. Without it, requests are read as JSON lines
     # from stdin: {"prefix": ..., "suffixes": [...], "max_new_tokens": N}.
@@ -241,6 +299,10 @@ def serve_main(argv: list[str] | None = None, tokenizer=None) -> None:
         max_token_len=args.max_token_len,
         use_pallas=args.use_pallas,
         decode_resident=args.decode_resident,
+        io_retry_attempts=args.io_retry_attempts,
+        io_retry_base_s=args.io_retry_base_s,
+        io_retry_deadline_s=args.io_retry_deadline_s,
+        faults=_fault_config_from_args(args),
     )
     serve_cfg = ServeConfig(
         queue_capacity=args.queue_capacity,
@@ -249,6 +311,7 @@ def serve_main(argv: list[str] | None = None, tokenizer=None) -> None:
         default_max_new_tokens=args.max_new_tokens,
         default_deadline_s=args.deadline_s,
         stats_interval_s=args.stats_interval_s,
+        watchdog_abort_s=args.watchdog_abort_s,
     )
     if tokenizer is None:
         from transformers import AutoTokenizer

@@ -22,6 +22,55 @@ DEFAULT_MAX_TOKEN_LEN = 4096
 # stays in sync with this set).
 SUPPORTED_ACTIVATIONS = frozenset({"silu", "gelu", "gelu_pytorch_tanh"})
 
+# Named fault-injection sites (faults/inject.py fires these; config
+# validation and the --chaos CLI flag key off this tuple so a typo'd site
+# fails loudly instead of silently injecting nothing).
+FAULT_SITES = ("shard_read", "device_put", "engine_step", "queue_admission")
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultConfig:
+    """Deterministic fault injection (faults/inject.py). Off by default;
+    enabled by the chaos tests and the ``--chaos`` CLI flag.
+
+    Rates partition one uniform draw per site fire: with probability
+    ``error_rate`` an IOError is raised, ``truncate_rate`` a simulated
+    truncated read, ``latency_rate`` a ``latency_s`` sleep; otherwise the
+    fire is clean. The schedule is a pure function of ``(seed, site,
+    per-site call count)`` — reproducible across runs, platforms, and
+    thread interleavings."""
+
+    enabled: bool = False
+    seed: int = 0
+    error_rate: float = 0.0
+    truncate_rate: float = 0.0
+    latency_rate: float = 0.0
+    latency_s: float = 0.01
+    sites: tuple[str, ...] = ()  # () = every site
+    # Total faults injected before the schedule goes permanently clean
+    # (-1 = unlimited). Models a transient outage that ENDS — lets a test
+    # force exactly one retry-exhaustion and then assert clean recovery.
+    max_faults: int = -1
+
+    def __post_init__(self) -> None:
+        for name in ("error_rate", "truncate_rate", "latency_rate"):
+            v = getattr(self, name)
+            if not 0.0 <= v <= 1.0:
+                raise ValueError(f"{name} must be in [0, 1], got {v}")
+        # 1e-9 slack: a legal decimal partition like 0.1+0.2+0.7 sums to
+        # 1.0000000000000002 in IEEE-754 and must not be rejected.
+        if self.error_rate + self.truncate_rate + self.latency_rate > 1.0 + 1e-9:
+            raise ValueError("fault rates must sum to <= 1")
+        if self.latency_s < 0:
+            raise ValueError("latency_s must be >= 0")
+        unknown = set(self.sites) - set(FAULT_SITES)
+        if unknown:
+            raise ValueError(
+                f"unknown fault sites {sorted(unknown)} (one of {FAULT_SITES})"
+            )
+        object.__setattr__(self, "sites", tuple(self.sites))
+
+
 # Multimodal wrapper model types -> their language-model type. Published
 # Gemma-3 / Llama-4 checkpoints are vision+text bundles whose config nests
 # the text model under "text_config"; both the config parse and the
@@ -831,6 +880,16 @@ class FrameworkConfig:
     top_k: int = 0
     top_p: float = 0.0
     seed: int = 0
+    # Transient-I/O retry knobs (faults/retry.py RetryPolicy; the weight
+    # stream's disk reads and host->device puts retry under this before a
+    # typed ShardLoadError surfaces). attempts=1 disables retrying.
+    io_retry_attempts: int = 4
+    io_retry_base_s: float = 0.05  # first backoff; doubles per attempt
+    io_retry_deadline_s: float = 60.0  # overall wall cap per call; 0 = none
+    # Deterministic fault injection (off by default; the --chaos CLI flag
+    # and the chaos tests enable it). Frozen sub-config keeps this config
+    # hashable.
+    faults: FaultConfig = dataclasses.field(default_factory=FaultConfig)
 
     def __post_init__(self) -> None:
         loc = self.storage_location
@@ -878,6 +937,21 @@ class FrameworkConfig:
             # rejection sampling to preserve the output distribution —
             # loudly unsupported rather than silently wrong.
             raise ValueError("speculative_k requires greedy (temperature=0)")
+        if self.io_retry_attempts < 1:
+            raise ValueError("io_retry_attempts must be >= 1")
+        if self.io_retry_base_s < 0 or self.io_retry_deadline_s < 0:
+            raise ValueError("io_retry_base_s/io_retry_deadline_s must be >= 0")
+
+    def retry_policy(self):
+        """The transient-I/O RetryPolicy for this run's weight stream
+        (imported lazily: faults/inject.py imports this module)."""
+        from flexible_llm_sharding_tpu.faults.retry import RetryPolicy
+
+        return RetryPolicy(
+            max_attempts=self.io_retry_attempts,
+            base_delay_s=self.io_retry_base_s,
+            deadline_s=self.io_retry_deadline_s or None,
+        )
 
     def effective_prefetch_depth(self) -> int:
         """Resolve the tri-state ``prefetch_depth``: explicit value, or auto —
@@ -972,6 +1046,12 @@ class ServeConfig:
     # seconds; 0 = off. Snapshot of queue depth, active requests, TTFT and
     # per-token latency summaries, admitted/rejected/expired counters.
     stats_interval_s: float = 0.0
+    # Step-progress watchdog (streamed-weights mode): if a sweep makes no
+    # shard progress for this many seconds, the engine aborts the weight
+    # source, fails ONLY the in-flight waves (their futures resolve with a
+    # structured WaveAborted instead of hanging forever), restarts the
+    # source, and keeps serving. 0 = off.
+    watchdog_abort_s: float = 0.0
 
     def __post_init__(self) -> None:
         if self.queue_capacity < 1:
@@ -990,3 +1070,5 @@ class ServeConfig:
             raise ValueError("idle_poll_s must be > 0")
         if self.stats_interval_s < 0:
             raise ValueError("stats_interval_s must be >= 0")
+        if self.watchdog_abort_s < 0:
+            raise ValueError("watchdog_abort_s must be >= 0")

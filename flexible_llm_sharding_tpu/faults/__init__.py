@@ -1,0 +1,43 @@
+"""Fault injection and retry/degrade machinery for the streaming runtime.
+
+The whole design sweeps hundreds of GB of weights through the chip from
+host RAM/disk every iteration — and the serving engine runs that sweep
+forever. A single transient I/O error (NFS/GCS-FUSE blip, truncated read,
+page-cache race) used to kill the producer thread permanently and fail
+every queued request with it. This package makes those faults survivable
+AND provable:
+
+- ``inject``  — a deterministic, seeded ``FaultInjector`` with named sites
+  (shard file read, host->device put, engine step, queue admission) that
+  can raise IOErrors, simulate truncated reads, or add latency spikes on a
+  seeded schedule. Off by default; enabled by tests and the ``--chaos``
+  CLI flag. CI can therefore prove recovery semantics without hardware.
+- ``retry``   — ``RetryPolicy`` (max attempts, exponential backoff with
+  deterministic jitter, overall deadline) and ``retry_call``; exhaustion
+  surfaces as a typed ``ShardLoadError`` at the streaming call sites.
+
+Degrade semantics live at the call sites: ``runtime/executor.py`` retries
+the host load / device put and keeps the producer thread alive across
+per-shard failures; ``serve/engine.py`` fails only the in-flight wave on
+an exhausted shard load, restarts the weight source, and keeps serving.
+"""
+
+from flexible_llm_sharding_tpu.faults.inject import (  # noqa: F401
+    FaultInjector,
+    InjectedFault,
+    TruncatedRead,
+)
+from flexible_llm_sharding_tpu.faults.retry import (  # noqa: F401
+    RetryPolicy,
+    ShardLoadError,
+    retry_call,
+)
+
+__all__ = [
+    "FaultInjector",
+    "InjectedFault",
+    "RetryPolicy",
+    "ShardLoadError",
+    "TruncatedRead",
+    "retry_call",
+]

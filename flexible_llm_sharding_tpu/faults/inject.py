@@ -1,0 +1,118 @@
+"""Deterministic, seeded fault injection for the I/O and serving layers.
+
+A ``FaultInjector`` is threaded (explicitly — no global registry) into the
+hot paths, which call ``fire(site)`` at each named fault site:
+
+- ``shard_read``      — one layer file read in ``_HostShardLoader``
+- ``device_put``      — one shard's host->HBM placement
+- ``engine_step``     — one shard step of a serving sweep
+- ``queue_admission`` — one ``AdmissionQueue.submit``
+
+The schedule is a pure function of ``(seed, site, per-site call count)``
+via SHA-256 — NOT Python's ``hash`` (randomized per process) and NOT a
+shared RNG stream (call interleaving across threads would perturb it) —
+so a chaos test replays the exact same fault sequence on every run and on
+every platform, and two sites never steal draws from each other.
+
+Disabled injection costs one ``is None`` check at each site: call sites
+hold ``None`` instead of an injector when ``FaultConfig.enabled`` is off,
+so the sweep hot path pays nothing.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from flexible_llm_sharding_tpu.config import FAULT_SITES, FaultConfig
+from flexible_llm_sharding_tpu.faults.retry import hash_unit
+
+
+class InjectedFault(IOError):
+    """A fault raised by the injector (an ``IOError``, so the retry layer
+    treats it exactly like the real transient I/O errors it stands in for)."""
+
+
+class TruncatedRead(InjectedFault):
+    """Simulated short read: the bytes came back, but fewer than the layer
+    file holds — what an NFS blip or a read racing a writer looks like once
+    the safetensors header/byte-count validation catches it."""
+
+
+class FaultInjector:
+    """Seeded fault schedule over named sites (see module docstring).
+
+    ``fire(site)`` draws the site's next deterministic uniform and, per the
+    configured rates, raises ``InjectedFault``/``TruncatedRead`` or sleeps a
+    latency spike. Every injected fault is appended to ``events`` as
+    ``(site, kind, n)`` so tests can assert the schedule actually fired.
+    ``max_faults >= 0`` caps the total injected (the budget models a
+    transient outage that ends — after it, every fire is clean), letting a
+    test force exactly one retry-exhaustion then a clean recovery.
+
+    Determinism scope: each SITE's fault schedule is fully deterministic
+    (a pure function of seed + that site's call count). A shared
+    ``max_faults`` budget contended by sites firing on DIFFERENT threads
+    is consumed in arrival order, which interleaving can vary — budgeted
+    chaos configs that need exact replay should restrict ``sites`` to one
+    thread's site (as the tests do).
+    """
+
+    def __init__(self, config: FaultConfig):
+        self.config = config
+        self._lock = threading.Lock()
+        self._counts: dict[str, int] = {}
+        self._budget = config.max_faults if config.max_faults >= 0 else None
+        self.events: list[tuple[str, str, int]] = []
+
+    @classmethod
+    def from_config(cls, config: FaultConfig | None) -> "FaultInjector | None":
+        """None when injection is off — the hot-path contract is that call
+        sites hold None and skip the fire() call entirely."""
+        if config is None or not config.enabled:
+            return None
+        return cls(config)
+
+    def count(self, site: str | None = None) -> int:
+        """Injected-fault count, for one site or in total."""
+        with self._lock:
+            if site is None:
+                return len(self.events)
+            return sum(1 for s, _, _ in self.events if s == site)
+
+    def fire(self, site: str, detail: str = "") -> None:
+        if site not in FAULT_SITES:
+            raise ValueError(f"unknown fault site {site!r} (one of {FAULT_SITES})")
+        cfg = self.config
+        if cfg.sites and site not in cfg.sites:
+            return
+        # ONE critical section from count draw to budget consumption: a
+        # second fire racing in between could otherwise steal the budget
+        # unit this fire's schedule already committed to.
+        with self._lock:
+            n = self._counts.get(site, 0)
+            self._counts[site] = n + 1
+            u = hash_unit(f"{cfg.seed}:{site}:{n}")
+            if u < cfg.error_rate:
+                kind = "error"
+            elif u < cfg.error_rate + cfg.truncate_rate:
+                kind = "truncated"
+            elif u < cfg.error_rate + cfg.truncate_rate + cfg.latency_rate:
+                kind = "latency"
+            else:
+                return
+            if self._budget is not None:
+                if self._budget == 0:
+                    return  # outage over: remaining fires are clean
+                self._budget -= 1
+            self.events.append((site, kind, n))
+        at = f"{site} #{n}" + (f" ({detail})" if detail else "")
+        if kind == "latency":
+            time.sleep(cfg.latency_s)
+        elif kind == "truncated":
+            raise TruncatedRead(f"injected truncated read at {at}")
+        else:
+            raise InjectedFault(f"injected I/O error at {at}")
+
+
+__all__ = ["FaultInjector", "InjectedFault", "TruncatedRead"]

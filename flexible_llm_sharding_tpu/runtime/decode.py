@@ -599,6 +599,8 @@ class DecodeGenerator:
         shard(s) of every token."""
         if self.weight_source_factory is not None:
             return (lambda: iter(self.weight_source_factory())), None
+        from flexible_llm_sharding_tpu.faults.inject import FaultInjector
+
         source = ShardWeightSource(
             self.cfg.model_path,
             self.layer_names,
@@ -609,6 +611,8 @@ class DecodeGenerator:
             tied_embeddings=self.model_cfg.tie_word_embeddings,
             layer_sliding=self.model_cfg.layer_sliding,
             layer_rope=self.model_cfg.layer_rope,
+            retry_policy=self.cfg.retry_policy(),
+            injector=FaultInjector.from_config(self.cfg.faults),
         )
         it = iter(source)
         n_shards = len(self.shards)

@@ -36,6 +36,12 @@ import jax.numpy as jnp
 import numpy as np
 
 from flexible_llm_sharding_tpu.config import FrameworkConfig, LlamaConfig
+from flexible_llm_sharding_tpu.faults.inject import FaultInjector
+from flexible_llm_sharding_tpu.faults.retry import (
+    RetryPolicy,
+    ShardLoadError,
+    retry_call,
+)
 from flexible_llm_sharding_tpu.models import llama
 from flexible_llm_sharding_tpu.parallel.planner import ShardPlan, plan_shards_dp
 from flexible_llm_sharding_tpu.runtime.activations import ActivationStore
@@ -329,8 +335,22 @@ class _HostShardLoader:
 
     def __init__(self, model_path: str, layer_names: Sequence[str], np_dtype,
                  tied_embeddings: bool = False, layer_sliding=None,
-                 layer_rope=None, readahead: str = "auto"):
+                 layer_rope=None, readahead: str = "auto",
+                 retry_policy: RetryPolicy | None = None,
+                 injector: FaultInjector | None = None,
+                 retry_recorder=None, retry_abort=None):
         self.model_path = model_path
+        # Transient-I/O hardening: every layer-file read retries under the
+        # policy (faults/retry.py) and raises a typed ShardLoadError only on
+        # exhaustion; the (test/chaos-only) injector fires the 'shard_read'
+        # site inside the retried region so injected faults are absorbed
+        # exactly like real ones. retry_abort (callable -> bool): the owning
+        # source's stop flag — a closing source must not wait out backoff
+        # sleeps before its producer thread can exit.
+        self._retry = retry_policy or RetryPolicy()
+        self._injector = injector
+        self._recorder = retry_recorder
+        self._retry_abort = retry_abort
         self.layer_names = list(layer_names)
         self.np_dtype = np_dtype
         self.tied = tied_embeddings
@@ -374,6 +394,21 @@ class _HostShardLoader:
         )
 
     def _load_one(self, name: str) -> Params:
+        def attempt() -> Params:
+            if self._injector is not None:
+                self._injector.fire("shard_read", detail=name)
+            return self._load_one_raw(name)
+
+        return retry_call(
+            attempt,
+            policy=self._retry,
+            label="shard_read",
+            recorder=self._recorder,
+            wrap=ShardLoadError,
+            abort=self._retry_abort,
+        )
+
+    def _load_one_raw(self, name: str) -> Params:
         if name == "lm_head" and self.tied:
             if self._tied_head is not None:
                 return self._tied_head
@@ -476,6 +511,35 @@ class _HostShardLoader:
         with _PROCESS_STREAM_LOCK:
             _PROCESS_STREAM_BYTES[0] += shard_bytes
         return segments
+
+
+class _ShardFault:
+    """Queue envelope for a producer-side failure: distinguishes "this item
+    IS an error" from any conceivable payload, and keeps the original
+    exception (with its producer-thread traceback) for chained re-raise on
+    the consumer side."""
+
+    __slots__ = ("error",)
+
+    def __init__(self, error: BaseException):
+        self.error = error
+
+
+def _reraise_from_producer(exc: BaseException) -> None:
+    """Re-raise a producer-thread exception on the consumer thread as a
+    FRESH exception of the same type, chained (``raise ... from``) to the
+    original so both threads' tracebacks survive in the report — re-raising
+    the stored object itself would splice the consumer's frames onto the
+    producer's traceback in place (and mutate it again on every re-raise).
+    Exception types whose constructors don't round-trip ``args`` fall back
+    to raising the original object."""
+    try:
+        clone = type(exc)(*exc.args)
+    except Exception:
+        clone = None
+    if clone is None or type(clone) is not type(exc):
+        raise exc
+    raise clone from exc
 
 
 @partial(jax.jit, static_argnums=(1,))
@@ -626,6 +690,9 @@ class ShardWeightSource:
         layer_sliding=None,
         layer_rope=None,
         cycle: bool = False,
+        retry_policy: RetryPolicy | None = None,
+        injector: FaultInjector | None = None,
+        retry_recorder=None,
     ):
         self.shards = list(shards)
         # Either one device for every shard, or (pipeline mode) one target
@@ -638,38 +705,71 @@ class ShardWeightSource:
         else:
             self.shard_devices = [device] * len(self.shards)
         self.cycle = cycle
+        self._retry = retry_policy or RetryPolicy()
+        self._injector = injector
+        self._recorder = retry_recorder
+        self._stop = threading.Event()
         self._loader = _HostShardLoader(
             model_path, layer_names, np_dtype, tied_embeddings, layer_sliding,
-            layer_rope,
+            layer_rope, retry_policy=self._retry, injector=injector,
+            retry_recorder=retry_recorder, retry_abort=self._stop.is_set,
         )
         self.produce_time = 0.0  # set BEFORE the producer thread starts
         self._q: Queue = Queue(maxsize=max(1, prefetch_depth))
-        self._stop = threading.Event()
+        self._close_lock = threading.Lock()  # close() may race abort()/close()
         self._thread: threading.Thread | None = None
         if prefetch_depth >= 1:
             self._thread = threading.Thread(target=self._producer, daemon=True)
             self._thread.start()
 
-    def close(self) -> None:
-        """Unblock and retire the prefetch thread; drop any queued shards so
-        their HBM buffers are released even if iteration was abandoned."""
+    def abort(self) -> None:
+        """Non-blocking close for the recovery paths (the serving engine's
+        stall watchdog fires this from ITS thread): set stop and drain the
+        queue so both the producer's pending put and the consumer's pending
+        get unblock promptly — without joining the (possibly wedged)
+        producer thread here. The owner still calls close() afterwards."""
         self._stop.set()
-        if self._thread is not None:
-            while self._thread.is_alive():
-                try:
-                    self._q.get_nowait()
-                except Exception:
-                    self._thread.join(timeout=0.1)
-            self._thread = None
-        while not self._q.empty():
+        while True:
             try:
                 self._q.get_nowait()
             except Exception:
                 break
-        # Retire the loader's native readahead pool promptly — a source is
-        # created per executor call and sits in a reference cycle (producer
-        # thread target holds self), so GC alone would strand thread pools.
-        self._loader.close()
+
+    def close(self, join_timeout_s: float = 10.0) -> None:
+        """Unblock and retire the prefetch thread; drop any queued shards so
+        their HBM buffers are released even if iteration was abandoned.
+        Idempotent and thread-safe (recovery may close concurrently with
+        the watchdog's abort).
+
+        The join is BOUNDED: a producer wedged in an uninterruptible I/O
+        syscall (hung NFS hard mount) can never be joined, and the serving
+        engine's recovery path runs through here — blocking forever would
+        hang exactly the futures the watchdog exists to unhang. Past the
+        bound the daemon thread is abandoned: _put discards everything once
+        stop is set and retries abort on the stop flag, so it exits on its
+        own the moment the syscall returns (or dies with the process)."""
+        self._stop.set()
+        with self._close_lock:
+            if self._thread is not None:
+                deadline = time.monotonic() + join_timeout_s
+                while self._thread.is_alive():
+                    if time.monotonic() >= deadline:
+                        break  # abandoned, self-terminates via _stop
+                    try:
+                        self._q.get_nowait()
+                    except Exception:
+                        self._thread.join(timeout=0.1)
+                self._thread = None
+            while not self._q.empty():
+                try:
+                    self._q.get_nowait()
+                except Exception:
+                    break
+            # Retire the loader's native readahead pool promptly — a source
+            # is created per executor call and sits in a reference cycle
+            # (producer thread target holds self), so GC alone would strand
+            # thread pools.
+            self._loader.close()
 
     @property
     def load_time(self) -> float:
@@ -689,10 +789,24 @@ class ShardWeightSource:
         # like with like; load_time alone under-counts what overlap must
         # hide on a slow host->HBM link).
         t0 = time.perf_counter()
-        out = _place(
-            self._loader.build_host_shard(layer_idxs),
-            device,
-            np_dtype=self._loader.np_dtype,
+        host = self._loader.build_host_shard(layer_idxs)
+
+        # The host->device put retries under the same policy as the reads:
+        # through a wedged accelerator tunnel the transfer surfaces
+        # OSError/TimeoutError just like a flaky filesystem does. The
+        # 'device_put' fault site sits inside the retried region.
+        def put():
+            if self._injector is not None:
+                self._injector.fire("device_put", detail=str(layer_idxs))
+            return _place(host, device, np_dtype=self._loader.np_dtype)
+
+        out = retry_call(
+            put,
+            policy=self._retry,
+            label="device_put",
+            recorder=self._recorder,
+            wrap=ShardLoadError,
+            abort=self._stop.is_set,
         )
         self.produce_time += time.perf_counter() - t0
         return out
@@ -701,13 +815,19 @@ class ShardWeightSource:
     def _put(self, item) -> bool:
         from queue import Full
 
-        while not self._stop.is_set():
+        while True:
+            # Stop is re-checked BEFORE every put attempt, including the
+            # first: close()/abort() may fire between building the item and
+            # queueing it, and a put landing in the just-drained queue would
+            # strand a shard's HBM buffers (or an error nobody consumes)
+            # while close() joins this thread.
+            if self._stop.is_set():
+                return False
             try:
                 self._q.put(item, timeout=0.2)
                 return True
             except Full:
                 continue
-        return False
 
     def _producer(self):
         while True:
@@ -725,13 +845,36 @@ class ShardWeightSource:
                     elif self.cycle:
                         self._loader.warm(self.shards[0])
                     item = self._build_shard(idxs, dev)
-                except Exception as e:  # surfaced on the consumer side
-                    self._put(e)
-                    return
+                except Exception as e:
+                    # Surface to the consumer at this shard's position, but
+                    # keep the thread ALIVE: retries are already exhausted
+                    # inside _build_shard, yet one persistently bad shard
+                    # must not end the stream for good — the serving engine
+                    # fails only the in-flight wave and keeps consuming
+                    # (offline consumers raise and close(), which stops this
+                    # loop via _stop on the next iteration).
+                    if not self._put(_ShardFault(e)):
+                        return
+                    continue
                 if not self._put(item):
                     return
             if not self.cycle:
                 return
+
+    def _get(self):
+        """Queue get that close()/abort() can unblock: a consumer must never
+        hang forever on a queue whose producer died or whose source a
+        watchdog aborted."""
+        from queue import Empty
+
+        while True:
+            try:
+                return self._q.get(timeout=0.2)
+            except Empty:
+                if self._stop.is_set():
+                    raise SourceClosed(
+                        "ShardWeightSource closed while streaming"
+                    ) from None
 
     def __iter__(self):
         if self._thread is None:
@@ -749,9 +892,9 @@ class ShardWeightSource:
         else:
             while True:
                 for idxs in self.shards:
-                    item = self._q.get()
-                    if isinstance(item, Exception):
-                        raise item
+                    item = self._get()
+                    if isinstance(item, _ShardFault):
+                        _reraise_from_producer(item.error)
                     yield idxs, item
                 if not self.cycle:
                     return
@@ -784,17 +927,21 @@ class BroadcastShardSource:
         rounds: int = 1,
         layer_sliding=None,
         layer_rope=None,
+        retry_policy: RetryPolicy | None = None,
+        injector: FaultInjector | None = None,
+        retry_recorder=None,
     ):
         self.shards = list(shards)
         self.devices = list(devices)
         self.rounds = rounds
+        self._stop = threading.Event()
         self._loader = _HostShardLoader(
             model_path, layer_names, np_dtype, tied_embeddings, layer_sliding,
-            layer_rope,
+            layer_rope, retry_policy=retry_policy, injector=injector,
+            retry_recorder=retry_recorder, retry_abort=self._stop.is_set,
         )
         depth = max(1, prefetch_depth)
         self._queues = [Queue(maxsize=depth) for _ in self.devices]
-        self._stop = threading.Event()
         self._thread = threading.Thread(target=self._producer, daemon=True)
         self._thread.start()
 
@@ -823,8 +970,12 @@ class BroadcastShardSource:
                         self._loader.warm(self.shards[i + 1])
                     host = self._loader.build_host_shard(idxs)
                 except Exception as e:
+                    # Broadcast streams are offline (one DP run): every rank
+                    # sees the failure and the run fails, so no per-shard
+                    # survival here — but the envelope keeps the typed
+                    # re-raise contract uniform with ShardWeightSource.
                     for rank in range(len(self.devices)):
-                        self._put(rank, e)
+                        self._put(rank, _ShardFault(e))
                     return
                 for rank, dev in enumerate(self.devices):
                     # device_put is async — the transfers to the N chips
@@ -899,8 +1050,8 @@ class _BroadcastView:
                             "BroadcastShardSource closed while streaming "
                             "(another DP worker failed?)"
                         ) from None
-            if isinstance(item, Exception):
-                raise item
+            if isinstance(item, _ShardFault):
+                _reraise_from_producer(item.error)
             yield idxs, item
 
     def close(self) -> None:
@@ -936,6 +1087,13 @@ class StreamingExecutor:
         self.recorder: metrics.Recorder | None = (
             metrics.Recorder(verbose=True) if cfg.verbose_metrics else None
         )
+        # Transient-I/O hardening for the weight stream: retries under the
+        # config's policy, per-run retry accounting, and the (off-by-
+        # default) chaos injector — None when disabled, so the hot path
+        # pays one is-None check.
+        self._retry_policy = cfg.retry_policy()
+        self._retry_recorder = metrics.RetryRecorder()
+        self._injector = FaultInjector.from_config(cfg.faults)
         self.cfg = cfg
         self.model_cfg = LlamaConfig.from_pretrained(cfg.model_path)
         self.device = device
@@ -1072,6 +1230,9 @@ class StreamingExecutor:
                 tied_embeddings=self.model_cfg.tie_word_embeddings,
                 layer_sliding=self.model_cfg.layer_sliding,
                 layer_rope=self.model_cfg.layer_rope,
+                retry_policy=self._retry_policy,
+                injector=self._injector,
+                retry_recorder=self._retry_recorder,
             )
             skip = 0
             # Baseline taken BEFORE the source's prefetch producer starts
@@ -1170,6 +1331,12 @@ class StreamingExecutor:
         peak = metrics.peak_hbm_gb(self.device)
         if peak is not None:
             self.stats["peak_hbm_gb"] = peak
+        io_retries = self._retry_recorder.total("retries")
+        if io_retries:
+            # Transient I/O faults absorbed by the retry layer this run —
+            # non-zero means the stream RECOVERED from real (or injected)
+            # blips; absent means the run was clean.
+            self.stats["io_retries"] = float(io_retries)
         self.stats_history.append(dict(self.stats))
         if self.recorder is not None:
             self.recorder.record(
@@ -1262,6 +1429,7 @@ __all__ = [
     "StreamingExecutor",
     "ShardWeightSource",
     "BroadcastShardSource",
+    "ShardLoadError",
     "apply_segments",
     "process_block",
     "finalize_scores",

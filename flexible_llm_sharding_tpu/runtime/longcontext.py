@@ -364,6 +364,8 @@ class LongContextScorer:
         """ONE weight source for a whole batch (shard list repeated
         ``repeats`` times): a cold source per pass would re-read the
         checkpoint with no prefetch overlap between passes."""
+        from flexible_llm_sharding_tpu.faults.inject import FaultInjector
+
         return ShardWeightSource(
             self.cfg.model_path,
             self.layer_names,
@@ -374,6 +376,8 @@ class LongContextScorer:
             tied_embeddings=self.model_cfg.tie_word_embeddings,
             layer_sliding=self.model_cfg.layer_sliding,
             layer_rope=self.model_cfg.layer_rope,
+            retry_policy=self.cfg.retry_policy(),
+            injector=FaultInjector.from_config(self.cfg.faults),
         )
 
     def __call__(self, prompts) -> list[np.ndarray]:

@@ -17,6 +17,7 @@ import jax
 import numpy as np
 
 from flexible_llm_sharding_tpu.config import FrameworkConfig, LlamaConfig
+from flexible_llm_sharding_tpu.faults.inject import FaultInjector
 from flexible_llm_sharding_tpu.parallel.planner import (
     batch_ranges,
     plan_shards_dp,
@@ -264,6 +265,8 @@ def run_prompts(
         rounds=cfg.num_batch,
         layer_sliding=model_cfg.layer_sliding,
         layer_rope=model_cfg.layer_rope,
+        retry_policy=cfg.retry_policy(),
+        injector=FaultInjector.from_config(cfg.faults),
     )
 
     def run_one(slot: int) -> list[np.ndarray]:
@@ -420,6 +423,8 @@ def run_decode(
         rounds=1 if resident else cfg.num_gen_token,
         layer_sliding=model_cfg.layer_sliding,
         layer_rope=model_cfg.layer_rope,
+        retry_policy=cfg.retry_policy(),
+        injector=FaultInjector.from_config(cfg.faults),
     )
 
     def run_one(slot: int):

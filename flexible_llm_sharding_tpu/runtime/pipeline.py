@@ -145,6 +145,8 @@ class PipelineRunner:
         )
         stage_shards = [s for (_, _, s) in self.stages[start_stage:]]
         stage_devs = [self.devices[r] for (_, r, _) in self.stages[start_stage:]]
+        from flexible_llm_sharding_tpu.faults.inject import FaultInjector
+
         source = ShardWeightSource(
             self.cfg.model_path,
             self.layer_names,
@@ -155,6 +157,8 @@ class PipelineRunner:
             tied_embeddings=self.model_cfg.tie_word_embeddings,
             layer_sliding=self.model_cfg.layer_sliding,
             layer_rope=self.model_cfg.layer_rope,
+            retry_policy=self.cfg.retry_policy(),
+            injector=FaultInjector.from_config(self.cfg.faults),
         )
 
         n_layers = len(self.layer_names)

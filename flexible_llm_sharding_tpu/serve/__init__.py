@@ -24,6 +24,7 @@ from flexible_llm_sharding_tpu.serve.request import (  # noqa: F401
     RequestResult,
     RequestStatus,
     ServeFuture,
+    WaveAborted,
 )
 from flexible_llm_sharding_tpu.serve.queue import AdmissionQueue  # noqa: F401
 from flexible_llm_sharding_tpu.serve.batcher import ShardAwareBatcher  # noqa: F401
@@ -39,4 +40,5 @@ __all__ = [
     "ServeEngine",
     "ServeFuture",
     "ShardAwareBatcher",
+    "WaveAborted",
 ]

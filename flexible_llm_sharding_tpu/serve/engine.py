@@ -15,6 +15,14 @@ request's own token budget is reached — requests with different budgets
 coexist in one wave. Graceful drain (serve out queued + in-flight, refuse
 new) and hard shutdown (cancel queued, finish in-flight) are first-class.
 
+Degrade, don't die (docs/faults.md): an exhausted shard load
+(ShardLoadError), a watchdog-aborted stall, or a stray transient OSError
+mid-sweep fails ONLY the in-flight waves — each request's future resolves
+with a structured WaveAborted carrying the root cause — then the cycling
+weight source restarts and the loop keeps serving the queue. Anything
+else stays engine-fatal (every future resolves with the root cause and
+the loop stops).
+
 Serving scope (v1, loud rejects): single placement target, greedy
 selection (per-request rng streams under sampling are future work), no
 speculative passes, no long-context routing.
@@ -47,8 +55,11 @@ from flexible_llm_sharding_tpu.runtime.decode import (
     extend_gen_kv,
     kv_fits_on_chip,
 )
+from flexible_llm_sharding_tpu.faults.inject import FaultInjector
 from flexible_llm_sharding_tpu.runtime.executor import (
+    ShardLoadError,
     ShardWeightSource,
+    SourceClosed,
     _DTYPES,
     _embed_block,
     _head_block,
@@ -63,9 +74,13 @@ from flexible_llm_sharding_tpu.runtime.tokenization import (
 )
 from flexible_llm_sharding_tpu.serve.batcher import ShardAwareBatcher, Wave
 from flexible_llm_sharding_tpu.serve.queue import AdmissionQueue
-from flexible_llm_sharding_tpu.serve.request import Request, RequestStatus
+from flexible_llm_sharding_tpu.serve.request import (
+    Request,
+    RequestStatus,
+    WaveAborted,
+)
 from flexible_llm_sharding_tpu.utils import checkpoint
-from flexible_llm_sharding_tpu.utils.metrics import ServingMetrics
+from flexible_llm_sharding_tpu.utils.metrics import ServingMetrics, StepWatchdog
 
 
 @dataclasses.dataclass
@@ -143,8 +158,14 @@ class ServeEngine:
             self.model_cfg, 1, device
         )
         self.metrics = ServingMetrics()
+        # Chaos injector (None unless cfg.faults.enabled) and the weight
+        # stream's retry policy — threaded into the admission queue and
+        # every source this engine builds.
+        self._injector = FaultInjector.from_config(cfg.faults)
+        self._retry_policy = cfg.retry_policy()
         self.queue = AdmissionQueue(
-            self.serve_cfg.queue_capacity, metrics=self.metrics
+            self.serve_cfg.queue_capacity, metrics=self.metrics,
+            injector=self._injector,
         )
         self.batcher = ShardAwareBatcher(
             self.queue,
@@ -155,6 +176,7 @@ class ServeEngine:
         self._kept: list | None = None  # resident: placed shards
         self._source: ShardWeightSource | None = None  # streamed: cycling
         self._src_iter = None
+        self._watchdog: StepWatchdog | None = None
         self._error: BaseException | None = None
         self._thread: threading.Thread | None = None
         if start:
@@ -235,6 +257,19 @@ class ServeEngine:
         except BaseException as e:  # noqa: BLE001 — surfaced via futures
             self._fatal(e)
             return
+        wd = None
+        if self.serve_cfg.watchdog_abort_s > 0 and not self._resident:
+            # Step-progress watchdog over the streamed sweep: if no shard
+            # lands for watchdog_abort_s, abort the source (non-blocking,
+            # from the watchdog thread) — the consumer get below then
+            # raises SourceClosed, which the recovery path turns into a
+            # failed wave + source restart instead of futures hanging
+            # forever. Resident sweeps move no weight bytes; a stall there
+            # is a compute wedge the source can't unwedge, so no watchdog.
+            wd = StepWatchdog(
+                "serve-sweep", self.serve_cfg.watchdog_abort_s, self._on_stall
+            )
+        self._watchdog = wd
         try:
             while True:
                 # ---- shard-0 boundary: the admission point ----------------
@@ -252,12 +287,31 @@ class ServeEngine:
                         time.sleep(self.serve_cfg.idle_poll_s)
                     continue
                 t0 = time.perf_counter()
-                self._sweep()
+                try:
+                    if wd is not None:
+                        # The armed period guards THIS source: the token
+                        # rides inside the watchdog, so a stall callback
+                        # delayed across a recovery can never abort the
+                        # fresh replacement.
+                        wd.arm(token=self._source)
+                    self._sweep()
+                except (ShardLoadError, SourceClosed, OSError) as e:
+                    # Degrade, don't die: an exhausted shard load, a
+                    # watchdog-aborted stall, or a transient I/O error that
+                    # escaped the retry layer fails ONLY the in-flight
+                    # waves; queued and future requests keep being served.
+                    self._recover(e)
+                    continue
+                finally:
+                    if wd is not None:
+                        wd.disarm()
                 self._post_sweep(time.perf_counter() - t0)
                 self.metrics.maybe_emit(self.serve_cfg.stats_interval_s)
         except BaseException as e:  # noqa: BLE001
             self._fatal(e)
         finally:
+            if wd is not None:
+                wd.close()
             self._release_weights()
 
     def _fatal(self, error: BaseException) -> None:
@@ -267,6 +321,52 @@ class ServeEngine:
         self.batcher.fail_all_active(error)
         self.queue.close(drain=False)  # cancels queued; futures resolve
         self._release_weights()
+
+    def _recover(self, root: BaseException) -> None:
+        """Recoverable mid-sweep fault. The sweep died partway, so every
+        in-flight wave's compute state (KV, partial scores) is unusable:
+        fail exactly those requests with a structured WaveAborted carrying
+        the root cause, drop their KV, restart the weight source, and keep
+        serving — the admission queue and later submissions are untouched."""
+        if self._watchdog is not None:
+            # Recovery itself can block (joining a wedged producer); an
+            # armed watchdog firing mid-recovery would abort the FRESH
+            # source built below. The sweep loop re-arms on its next pass.
+            self._watchdog.disarm()
+        n_waves = len(self.batcher.waves)
+        for w in self.batcher.waves:
+            if w.state is not None:
+                w.state.kv_store.clear()
+        err = WaveAborted(
+            f"in-flight wave aborted by a recoverable engine fault "
+            f"({type(root).__name__}: {root}); the engine recovered and "
+            "keeps serving — resubmit"
+        )
+        err.__cause__ = root
+        self.batcher.fail_all_active(err)
+        self.metrics.count("engine_recoveries")
+        if n_waves:
+            self.metrics.count("waves_aborted", n_waves)
+        if not self._resident:
+            # Fresh source + iterator: the old producer may be dead, mid-
+            # fault, or aborted by the watchdog; a cycling stream restarts
+            # cleanly at shard 0, which is exactly the next admission
+            # boundary.
+            self._release_weights()
+            self._acquire_weights()
+            self.metrics.count("source_restarts")
+
+    def _on_stall(self, idle_s: float, token) -> None:
+        """Watchdog thread: non-blocking abort of the wedged source; the
+        engine thread's pending queue get raises SourceClosed and the
+        recovery path above takes over. ``token`` is the source the firing
+        armed period captured — only IT is ever aborted, and only while it
+        is still the live source (if recovery already replaced it, the
+        stalled-on source is gone and the replacement must not be touched)."""
+        if token is None or token is not self._source:
+            return
+        self.metrics.count("watchdog_stalls")
+        token.abort()
 
     # -- weights -----------------------------------------------------------
 
@@ -282,6 +382,9 @@ class ServeEngine:
             layer_sliding=self.model_cfg.layer_sliding,
             layer_rope=self.model_cfg.layer_rope,
             cycle=cycle,
+            retry_policy=self._retry_policy,
+            injector=self._injector,
+            retry_recorder=self.metrics.retries,
         )
 
     def _acquire_weights(self) -> None:
@@ -383,7 +486,12 @@ class ServeEngine:
     def _sweep(self) -> None:
         """One full weight pass: prefill segments for waves at step 0,
         one decode step for everyone else."""
+        wd = self._watchdog
         for shard_pos, (layer_idxs, segments) in self._sweep_shards():
+            if wd is not None:
+                wd.tick()
+            if self._injector is not None:
+                self._injector.fire("engine_step", detail=f"shard{shard_pos}")
             if not layer_idxs:
                 continue
             for wave in self.batcher.waves:

@@ -32,11 +32,12 @@ from flexible_llm_sharding_tpu.serve.request import (
 
 
 class AdmissionQueue:
-    def __init__(self, capacity: int, metrics=None):
+    def __init__(self, capacity: int, metrics=None, injector=None):
         if capacity < 1:
             raise ValueError("capacity must be >= 1")
         self.capacity = capacity
         self._metrics = metrics  # utils.metrics.ServingMetrics or None
+        self._injector = injector  # faults.inject.FaultInjector or None
         self._lock = threading.Lock()
         self._items: deque[Request] = deque()
         self._closed = False
@@ -46,6 +47,18 @@ class AdmissionQueue:
     def submit(self, request: Request) -> Request:
         """Enqueue, or raise QueueFull/ServeClosed. Terminal transitions
         happen OUTSIDE the lock (callbacks may be arbitrarily slow)."""
+        if self._injector is not None:
+            # Chaos site: a flaky front door. An injected error resolves
+            # the request as a reasoned rejection (the same reject-with-
+            # reason contract as backpressure), never an unhandled raise
+            # into the submitter; a latency fault just delays admission.
+            try:
+                self._injector.fire("queue_admission")
+            except Exception as e:
+                request.fail(e, RequestStatus.REJECTED)
+                if self._metrics is not None:
+                    self._metrics.count("rejected")
+                return request
         evicted: list[Request] = []
         with self._lock:
             if self._closed:
@@ -134,12 +147,20 @@ class AdmissionQueue:
         """Refuse further submissions. ``drain=True`` leaves queued requests
         for the engine to serve out; ``drain=False`` cancels them (futures
         raise ServeClosed). Returns the requests cancelled (empty when
-        draining). Idempotent."""
+        draining). Idempotent.
+
+        Either way, requests whose deadline already passed but that lazy
+        eviction hasn't reached yet resolve as EXPIRED (DeadlineExceeded) —
+        their time-to-first-token contract was lost BEFORE the shutdown, so
+        folding them into the shutdown's CANCELLED/served-out outcome would
+        misreport why they failed."""
         with self._lock:
             self._closed = True
+            evicted = self._evict_expired_locked()
             cancelled = [] if drain else list(self._items)
             if not drain:
                 self._items.clear()
+        self._finish_expired(evicted)
         for r in cancelled:
             r.fail(
                 ServeClosed("serve queue shut down before admission"),

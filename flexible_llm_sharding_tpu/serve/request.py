@@ -53,6 +53,15 @@ class ServeClosed(RuntimeError):
     no-drain shutdown)."""
 
 
+class WaveAborted(RuntimeError):
+    """The request's in-flight wave was aborted by a RECOVERABLE engine
+    fault (an exhausted shard load, a watchdog-detected stall): only this
+    wave's requests fail — ``__cause__`` carries the root fault — while the
+    engine restarts its weight source and keeps serving. Distinct from an
+    engine-fatal failure, whose root cause resolves every future directly:
+    a WaveAborted request can simply be resubmitted."""
+
+
 @dataclasses.dataclass
 class RequestResult:
     """The served completion: the same per-prompt contract as the offline
@@ -186,4 +195,5 @@ __all__ = [
     "RequestStatus",
     "ServeClosed",
     "ServeFuture",
+    "WaveAborted",
 ]

@@ -225,6 +225,55 @@ def _latency_summary(samples: list[float]) -> dict[str, float]:
     }
 
 
+class RetryRecorder:
+    """Thread-safe transient-I/O retry accounting (fed by faults/retry.py's
+    ``retry_call``). Keyed by call-site label (``shard_read``,
+    ``device_put``, ...); per label: ``retries`` (backoff sleeps taken),
+    ``recovered`` (calls that succeeded after >= 1 retry), ``exhausted``
+    (calls that gave up — the typed ShardLoadError path), ``backoff_s``
+    (total sleep). One recorder per executor/engine, so runs don't bleed
+    into each other's counts."""
+
+    def __init__(self) -> None:
+        import threading
+
+        self._lock = threading.Lock()
+        self._by_label: dict[str, dict[str, float]] = {}
+
+    def record(
+        self,
+        label: str,
+        *,
+        retries: int = 0,
+        recovered: int = 0,
+        exhausted: int = 0,
+        backoff_s: float = 0.0,
+    ) -> None:
+        with self._lock:
+            d = self._by_label.setdefault(
+                label or "call",
+                {"retries": 0, "recovered": 0, "exhausted": 0, "backoff_s": 0.0},
+            )
+            d["retries"] += retries
+            d["recovered"] += recovered
+            d["exhausted"] += exhausted
+            d["backoff_s"] += backoff_s
+
+    def total(self, key: str = "retries") -> float:
+        with self._lock:
+            return sum(d[key] for d in self._by_label.values())
+
+    def snapshot(self) -> dict[str, dict[str, float]]:
+        with self._lock:
+            return {
+                k: {
+                    kk: round(vv, 4) if kk == "backoff_s" else int(vv)
+                    for kk, vv in d.items()
+                }
+                for k, d in sorted(self._by_label.items())
+            }
+
+
 class ServingMetrics:
     """Counters/gauges/latency samples for the online serving subsystem.
 
@@ -251,6 +300,9 @@ class ServingMetrics:
         self._ttft: deque[float] = deque(maxlen=sample_window)
         self._token_lat: deque[float] = deque(maxlen=sample_window)
         self._last_emit = 0.0
+        # Transient-I/O retry accounting for this engine's weight stream
+        # (the engine threads it into its sources' loaders).
+        self.retries = RetryRecorder()
 
     def count(self, name: str, n: int = 1) -> None:
         with self._lock:
@@ -273,14 +325,18 @@ class ServingMetrics:
             return self._counters.get(name, 0)
 
     def snapshot(self) -> dict:
+        retries = self.retries.snapshot()
         with self._lock:
-            return {
+            out = {
                 "event": "serve_stats",
                 **{k: v for k, v in sorted(self._counters.items())},
                 **{k: v for k, v in sorted(self._gauges.items())},
                 "ttft_s": _latency_summary(list(self._ttft)),
                 "token_latency_s": _latency_summary(list(self._token_lat)),
             }
+        if retries:
+            out["io_retries"] = retries
+        return out
 
     def emit(self) -> None:
         print(json.dumps(self.snapshot()), file=sys.stderr, flush=True)
@@ -375,6 +431,79 @@ class _WatchdogBar:
         self._stop.set()
         self._thread.join(timeout=2.0)
         self._bar.close()
+
+
+class StepWatchdog:
+    """Step-progress watchdog with an ABORT action — ``_WatchdogBar``'s
+    stall detection generalized from warn-only to recovery.
+
+    ``arm(token)`` before a monitored phase, ``tick()`` on every unit of
+    progress, ``disarm()`` when the phase completes. If an armed phase
+    goes ``abort_s`` with no tick, ``on_stall(idle_s, token)`` fires ONCE
+    from the watchdog thread and the phase self-disarms (the owner re-arms
+    on its next phase). ``token`` identifies WHAT the armed period guards
+    (the serving engine passes its current weight source): the callback is
+    handed the token its own armed period captured, so a callback delayed
+    across a recovery cannot be tricked into aborting the healthy
+    replacement by re-reading mutable owner state at fire time.
+    ``on_stall`` runs on the watchdog thread: it must be non-blocking
+    (set a flag, close a queue), never join the stalled work itself."""
+
+    def __init__(self, desc: str, abort_s: float, on_stall, poll_s=None):
+        import threading
+
+        if abort_s <= 0:
+            raise ValueError("abort_s must be > 0")
+        self._desc = desc
+        self._abort_s = abort_s
+        self._on_stall = on_stall
+        self._poll_s = poll_s if poll_s is not None else max(abort_s / 4, 0.01)
+        self._armed = False
+        self._token = None
+        self._last = time.monotonic()
+        self.stalls = 0
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._watch, daemon=True)
+        self._thread.start()
+
+    def _watch(self) -> None:
+        while not self._stop.wait(self._poll_s):
+            if not self._armed:
+                continue
+            idle = time.monotonic() - self._last
+            if idle < self._abort_s:
+                continue
+            # Capture the armed period's token BEFORE anything that can
+            # block (the print below can): the callback must act on what
+            # stalled, not on whatever the owner armed next.
+            token = self._token
+            self._armed = False
+            self.stalls += 1
+            print(
+                f"[stall] '{self._desc}' made no progress for {idle:.1f}s "
+                "— aborting for recovery",
+                file=sys.stderr,
+                flush=True,
+            )
+            try:
+                self._on_stall(idle, token)
+            except Exception:
+                pass  # recovery is best-effort; the watchdog must survive
+
+    def arm(self, token=None) -> None:
+        self._token = token
+        self._last = time.monotonic()
+        self._armed = True
+
+    def tick(self) -> None:
+        self._last = time.monotonic()
+
+    def disarm(self) -> None:
+        self._armed = False
+
+    def close(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=2.0)
 
 
 def progress_bar(total: int, desc: str, unit: str = "it", disable=None,
@@ -616,7 +745,9 @@ def throughput(tokens: int, seconds: float, chips: int = 1) -> dict[str, float]:
 __all__ = [
     "LiveArrayPeakSampler",
     "Recorder",
+    "RetryRecorder",
     "ServingMetrics",
+    "StepWatchdog",
     "chip_peak_flops",
     "model_flops_per_token",
     "compiled_memory_analysis",

@@ -1,0 +1,509 @@
+"""Chaos suite: the fault-injection harness and the retry/backoff/degrade
+layer it exists to prove.
+
+The design sweeps the full model through the chip from host storage every
+iteration, forever (serving). These tests inject deterministic, seeded
+faults at the named sites (shard read, host->device put, engine step,
+queue admission) and assert the contract: transient faults are absorbed by
+the retry layer with outputs TOKEN-IDENTICAL to a fault-free run;
+persistent faults degrade (one wave fails with a structured error, the
+engine restarts its weight source and keeps serving) instead of killing
+the producer thread and every queued request with it.
+
+The injector seed is pinned (overridable via FLS_CHAOS_SEED — the CI chaos
+job fixes it) so a failure replays exactly.
+"""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from flexible_llm_sharding_tpu.config import (
+    FaultConfig,
+    FrameworkConfig,
+    ServeConfig,
+)
+from flexible_llm_sharding_tpu.faults import (
+    FaultInjector,
+    InjectedFault,
+    RetryPolicy,
+    ShardLoadError,
+    TruncatedRead,
+    retry_call,
+)
+from flexible_llm_sharding_tpu.models import llama
+from flexible_llm_sharding_tpu.parallel.planner import plan_shards_dp
+from flexible_llm_sharding_tpu.runtime.decode import DecodeGenerator
+from flexible_llm_sharding_tpu.runtime.executor import (
+    ShardWeightSource,
+    StreamingExecutor,
+    _HostShardLoader,
+)
+from flexible_llm_sharding_tpu.serve import ServeEngine
+from flexible_llm_sharding_tpu.serve.queue import AdmissionQueue
+from flexible_llm_sharding_tpu.serve.request import (
+    Request,
+    RequestStatus,
+    WaveAborted,
+)
+from flexible_llm_sharding_tpu.utils.checkpoint import layer_names_for, save_params
+from flexible_llm_sharding_tpu.utils.metrics import RetryRecorder, StepWatchdog
+
+from tests.fake_tokenizer import FakeTokenizer
+
+# Pinned by the CI chaos job; the suite must pass for ANY seed (rates are
+# low enough and retries deep enough that exhaustion is ~impossible), the
+# pin just makes a failure replayable.
+CHAOS_SEED = int(os.environ.get("FLS_CHAOS_SEED", "1234"))
+
+N_GEN = 2
+
+# Uniform 2-suffix prompts: one (B, S, L) shape family = one jit compile
+# set for the whole module (XLA:CPU compile wall dominates otherwise).
+PROMPTS = [
+    ("The capital of France", (" is Paris", " is Rome")),
+    ("Two plus two equals", (" four", " five")),
+    ("The sky is", (" blue", " green")),
+    ("Hello world", (" again", " anew")),
+]
+
+
+@pytest.fixture(scope="module")
+def model_dir(tiny_cfg, tmp_path_factory):
+    params = llama.init_params(jax.random.PRNGKey(0), tiny_cfg)
+    d = tmp_path_factory.mktemp("tiny_model_faults")
+    save_params(jax.tree.map(np.asarray, params), str(d), tiny_cfg)
+    return str(d)
+
+
+def _chaos(**kw) -> FaultConfig:
+    base = dict(enabled=True, seed=CHAOS_SEED)
+    base.update(kw)
+    return FaultConfig(**base)
+
+
+def _fw(model_dir, **kw) -> FrameworkConfig:
+    base = dict(
+        model_path=model_dir,
+        layer_num_per_shard=1,
+        storage_location="cpu",
+        dtype="float32",
+        bucket_multiple=8,
+        block_size=2,
+        prefetch_depth=0,
+        num_gen_token=N_GEN,
+        # Deep + fast retries: at error_rate 0.25 the chance a single call
+        # exhausts 8 attempts is 0.25^8 ~ 1.5e-5 — the token-identical
+        # assertions hold for any seed.
+        io_retry_attempts=8,
+        io_retry_base_s=0.001,
+    )
+    base.update(kw)
+    return FrameworkConfig(**base)
+
+
+@pytest.fixture(scope="module")
+def offline_oracle(model_dir):
+    """Fault-free DecodeGenerator outputs for PROMPTS — the parity target
+    shared by the chaos runs below."""
+    cfg = _fw(model_dir)
+    return DecodeGenerator(cfg, tokenizer=FakeTokenizer())(list(PROMPTS))
+
+
+# ---------------------------------------------------------------------------
+# Injector + policy units
+# ---------------------------------------------------------------------------
+
+def test_fault_config_validation():
+    with pytest.raises(ValueError, match="error_rate"):
+        FaultConfig(error_rate=1.5)
+    with pytest.raises(ValueError, match="sum"):
+        FaultConfig(error_rate=0.6, truncate_rate=0.6)
+    with pytest.raises(ValueError, match="unknown fault sites"):
+        FaultConfig(sites=("shard_red",))
+    with pytest.raises(ValueError):
+        FrameworkConfig(io_retry_attempts=0)
+
+
+def test_injector_deterministic_schedule_and_kinds():
+    def run(seed):
+        inj = FaultInjector.from_config(
+            _chaos(
+                seed=seed, error_rate=0.2, truncate_rate=0.2,
+                latency_rate=0.2, latency_s=0.0,
+            )
+        )
+        for _ in range(200):
+            try:
+                inj.fire("shard_read")
+            except InjectedFault:
+                pass
+        return inj.events
+
+    a, b = run(7), run(7)
+    assert a == b and len(a) > 0  # same seed -> identical schedule
+    assert run(8) != a  # different seed -> different schedule
+    kinds = {k for _, k, _ in a}
+    assert kinds == {"error", "truncated", "latency"}
+    # TruncatedRead is an InjectedFault is an IOError — the retry layer's
+    # default retryable set covers all injected error kinds.
+    assert issubclass(TruncatedRead, InjectedFault)
+    assert issubclass(InjectedFault, IOError)
+
+
+def test_injector_sites_filter_and_budget():
+    inj = FaultInjector.from_config(
+        _chaos(error_rate=1.0, sites=("device_put",), max_faults=2)
+    )
+    inj.fire("shard_read")  # filtered: never raises
+    for _ in range(5):
+        try:
+            inj.fire("device_put")
+        except InjectedFault:
+            pass
+    assert inj.count() == 2  # budget: the outage ends after max_faults
+    inj.fire("device_put")  # now permanently clean
+    with pytest.raises(ValueError, match="unknown fault site"):
+        inj.fire("nonsense")
+    # Disabled config -> None: the hot paths hold None and skip the call
+    # entirely, which is the "no overhead when off" contract.
+    assert FaultInjector.from_config(FaultConfig()) is None
+    assert FaultInjector.from_config(None) is None
+
+
+def test_retry_call_recovers_and_records():
+    rec = RetryRecorder()
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise IOError("blip")
+        return "ok"
+
+    policy = RetryPolicy(max_attempts=5, base_delay_s=0.001)
+    assert retry_call(flaky, policy=policy, label="x", recorder=rec) == "ok"
+    snap = rec.snapshot()["x"]
+    assert snap["retries"] == 2 and snap["recovered"] == 1
+    assert snap["exhausted"] == 0
+
+
+def test_retry_call_exhaustion_is_typed_and_chained():
+    rec = RetryRecorder()
+
+    def always():
+        raise IOError("persistent")
+
+    with pytest.raises(ShardLoadError, match="giving up after 3"):
+        retry_call(
+            always,
+            policy=RetryPolicy(max_attempts=3, base_delay_s=0.001),
+            label="x",
+            recorder=rec,
+            wrap=ShardLoadError,
+        )
+    try:
+        retry_call(
+            always,
+            policy=RetryPolicy(max_attempts=2, base_delay_s=0.001),
+            wrap=ShardLoadError,
+        )
+    except ShardLoadError as e:
+        assert isinstance(e.__cause__, IOError)  # raise ... from
+    assert rec.snapshot()["x"]["exhausted"] == 1
+    # ShardLoadError is NOT retryable: a nested retry_call must not
+    # re-retry an already-exhausted inner call.
+    assert not isinstance(ShardLoadError("x"), OSError)
+
+
+def test_retry_call_non_retryable_fails_fast():
+    calls = {"n": 0}
+
+    def bug():
+        calls["n"] += 1
+        raise ValueError("deterministic bug")
+
+    with pytest.raises(ValueError):
+        retry_call(bug, policy=RetryPolicy(max_attempts=5, base_delay_s=0.001))
+    assert calls["n"] == 1  # retrying a real bug just triples its latency
+
+
+def test_retry_call_deadline_caps_attempts():
+    t0 = time.monotonic()
+    with pytest.raises(ShardLoadError, match="deadline passed"):
+        retry_call(
+            lambda: (_ for _ in ()).throw(IOError("x")),
+            policy=RetryPolicy(
+                max_attempts=10_000, base_delay_s=0.02, deadline_s=0.1
+            ),
+            wrap=ShardLoadError,
+        )
+    assert time.monotonic() - t0 < 2.0
+
+
+def test_step_watchdog_fires_once_and_respects_ticks():
+    fired = []
+    wd = StepWatchdog(
+        "t", abort_s=0.15,
+        on_stall=lambda idle, token: fired.append((idle, token)),
+        poll_s=0.02,
+    )
+    try:
+        wd.arm(token="phase-1")
+        for _ in range(8):  # ticking phase: never fires
+            time.sleep(0.04)
+            wd.tick()
+        assert fired == []
+        time.sleep(0.4)  # armed + idle: fires exactly once, self-disarms
+        assert len(fired) == 1
+        idle, token = fired[0]
+        # The callback gets the ARMED PERIOD's token — what stalled, not
+        # whatever the owner armed next.
+        assert idle >= 0.15 and token == "phase-1"
+        time.sleep(0.3)
+        assert len(fired) == 1
+        wd.disarm()
+        time.sleep(0.3)
+        assert len(fired) == 1
+    finally:
+        wd.close()
+
+
+# ---------------------------------------------------------------------------
+# Weight-source hardening
+# ---------------------------------------------------------------------------
+
+def _mk_source(model_dir, injector, attempts=2, prefetch=1):
+    names = layer_names_for(4, tie_word_embeddings=False)
+    return ShardWeightSource(
+        model_dir,
+        names,
+        plan_shards_dp(len(names), 1).shards,
+        np.float32,
+        prefetch_depth=prefetch,
+        retry_policy=RetryPolicy(max_attempts=attempts, base_delay_s=0.001),
+        injector=injector,
+    )
+
+
+def test_producer_survives_per_shard_failure(model_dir):
+    """Retry exhaustion on shard 0 surfaces a typed, chained ShardLoadError
+    at the consumer — and the producer thread keeps loading the NEXT shards
+    instead of dying on the first exception (the old behavior, which took
+    the serving engine down with it)."""
+    inj = FaultInjector.from_config(
+        _chaos(error_rate=1.0, sites=("shard_read",), max_faults=2)
+    )
+    src = _mk_source(model_dir, inj, attempts=2)
+    try:
+        with pytest.raises(ShardLoadError) as ei:
+            next(iter(src))
+        # Consumer-side re-raise is a FRESH exception chained to the
+        # producer's original (whose own cause is the injected IOError).
+        assert isinstance(ei.value.__cause__, ShardLoadError)
+        assert isinstance(ei.value.__cause__.__cause__, InjectedFault)
+        assert src._thread is not None and src._thread.is_alive()
+        # Budget exhausted -> the producer's NEXT shard builds cleanly.
+        item = src._q.get(timeout=30)
+        assert isinstance(item, list) and item  # [(kind, params), ...]
+    finally:
+        src.close()
+    assert src._thread is None
+
+
+def test_loader_absorbs_transient_faults(model_dir):
+    """Flaky reads under the policy produce the same host shard as a clean
+    loader (bit-identical leaves), with the retries recorded."""
+    rec = RetryRecorder()
+    names = layer_names_for(4, tie_word_embeddings=False)
+    flaky = _HostShardLoader(
+        model_dir, names, np.dtype(np.float32),
+        retry_policy=RetryPolicy(max_attempts=10, base_delay_s=0.0),
+        injector=FaultInjector.from_config(
+            _chaos(error_rate=0.4, truncate_rate=0.1, sites=("shard_read",))
+        ),
+        retry_recorder=rec,
+    )
+    clean = _HostShardLoader(model_dir, names, np.dtype(np.float32))
+    idxs = tuple(range(len(names)))
+    got, want = flaky.build_host_shard(idxs), clean.build_host_shard(idxs)
+    assert [k for k, _ in got] == [k for k, _ in want]
+    for (_, g), (_, w) in zip(got, want):
+        for ga, wa in zip(jax.tree.leaves(g), jax.tree.leaves(w)):
+            np.testing.assert_array_equal(np.asarray(ga), np.asarray(wa))
+    assert rec.snapshot()["shard_read"]["retries"] > 0
+    flaky.close()
+    clean.close()
+
+
+# ---------------------------------------------------------------------------
+# Offline batch path under chaos (acceptance: token-identical)
+# ---------------------------------------------------------------------------
+
+def test_offline_batch_token_identical_under_faults(model_dir):
+    clean = StreamingExecutor(_fw(model_dir), tokenizer=FakeTokenizer())(
+        list(PROMPTS)
+    )
+    cfg = _fw(
+        model_dir,
+        prefetch_depth=1,  # exercise the producer-thread path
+        faults=_chaos(
+            error_rate=0.2,
+            truncate_rate=0.05,
+            latency_rate=0.05,
+            latency_s=0.001,
+            sites=("shard_read", "device_put"),
+        ),
+    )
+    ex = StreamingExecutor(cfg, tokenizer=FakeTokenizer())
+    got = ex(list(PROMPTS))
+    assert ex._injector.count() > 0, "the schedule never fired"
+    assert ex.stats.get("io_retries", 0) > 0  # absorbed, and visible
+    for g, w in zip(got, clean):
+        np.testing.assert_array_equal(g, w)  # token- AND bit-identical
+
+
+# ---------------------------------------------------------------------------
+# Serving engine under chaos
+# ---------------------------------------------------------------------------
+
+def test_serve_chaos_token_identical(model_dir, offline_oracle):
+    """The acceptance bar: faults at the shard-read site (rate <= 25%,
+    seeded) while the engine serves — every request completes, outputs
+    token-identical to the fault-free offline run, and ServingMetrics
+    reports the absorbed retries."""
+    off_scores, off_updated = offline_oracle
+    cfg = _fw(
+        model_dir,
+        prefetch_depth=1,
+        faults=_chaos(error_rate=0.2, sites=("shard_read",)),
+    )
+    engine = ServeEngine(
+        cfg,
+        ServeConfig(max_wave_requests=2, default_max_new_tokens=N_GEN),
+        tokenizer=FakeTokenizer(),
+    )
+    try:
+        reqs = [engine.submit(p, s) for p, s in PROMPTS]
+        results = [r.future.result(timeout=300) for r in reqs]
+    finally:
+        engine.shutdown(drain=True)
+    assert engine.error is None
+    for res, want, upd in zip(results, off_scores, off_updated):
+        # Token-identical (ids AND text); scores to the serve-vs-offline
+        # tolerance test_serve.py pins.
+        assert (res.scores.argmax(-1) == want.argmax(-1)).all()
+        np.testing.assert_allclose(res.scores, want, rtol=1e-5, atol=1e-6)
+        assert res.updated == upd
+    stats = engine.stats()
+    assert stats["completed"] == len(PROMPTS)
+    assert stats["io_retries"]["shard_read"]["retries"] > 0
+    assert stats.get("engine_recoveries", 0) == 0  # absorbed below degrade
+
+
+def test_serve_wave_recovery_and_source_restart(model_dir, offline_oracle):
+    """PERSISTENT fault (retries exhaust): only the in-flight wave fails —
+    with a structured WaveAborted chained to the ShardLoadError — the
+    engine restarts its weight source and the next request serves
+    correctly. The old behavior was a dead producer thread and every
+    future hanging/failing."""
+    off_scores, _ = offline_oracle
+    cfg = _fw(
+        model_dir,
+        prefetch_depth=1,
+        io_retry_attempts=2,
+        faults=_chaos(error_rate=1.0, sites=("shard_read",), max_faults=2),
+    )
+    engine = ServeEngine(
+        cfg,
+        ServeConfig(default_max_new_tokens=N_GEN),
+        tokenizer=FakeTokenizer(),
+    )
+    try:
+        doomed = engine.submit(*PROMPTS[0])
+        with pytest.raises(WaveAborted) as ei:
+            doomed.future.result(timeout=300)
+        assert isinstance(ei.value.__cause__, ShardLoadError)
+        assert doomed.status is RequestStatus.FAILED
+        assert engine.error is None  # degraded, not dead
+        # Outage over (budget spent): the restarted source serves cleanly.
+        ok = engine.submit(*PROMPTS[1])
+        res = ok.future.result(timeout=300)
+        assert (res.scores.argmax(-1) == off_scores[1].argmax(-1)).all()
+        np.testing.assert_allclose(
+            res.scores, off_scores[1], rtol=1e-5, atol=1e-6
+        )
+    finally:
+        engine.shutdown(drain=True)
+    stats = engine.stats()
+    assert stats["engine_recoveries"] >= 1
+    assert stats["source_restarts"] >= 1
+    assert stats["waves_aborted"] >= 1
+    assert stats["failed"] == 1 and stats["completed"] == 1
+
+
+def test_serve_watchdog_recovers_stalled_sweep(model_dir, offline_oracle, monkeypatch):
+    """A wedged weight source (producer hangs mid-build) stalls the sweep;
+    the step-progress watchdog aborts it: the in-flight wave fails with a
+    structured error instead of its future hanging forever, the source
+    restarts, and the engine keeps serving."""
+    off_scores, _ = offline_oracle
+    stall = {"calls": 0, "lock": threading.Lock()}
+    release = threading.Event()  # lets the test unwedge the producer
+    orig = _HostShardLoader.build_host_shard
+
+    def wedged(self, layer_idxs):
+        with stall["lock"]:
+            stall["calls"] += 1
+            n = stall["calls"]
+        if n == 2:  # the first source's second shard hangs
+            release.wait(timeout=30)
+        return orig(self, layer_idxs)
+
+    monkeypatch.setattr(_HostShardLoader, "build_host_shard", wedged)
+    engine = ServeEngine(
+        _fw(model_dir, prefetch_depth=1),
+        ServeConfig(default_max_new_tokens=N_GEN, watchdog_abort_s=0.5),
+        tokenizer=FakeTokenizer(),
+    )
+    try:
+        doomed = engine.submit(*PROMPTS[0])
+        # The wave fails (structured, promptly) BEFORE the engine joins the
+        # wedged producer — a hung source must not hold the futures hostage.
+        with pytest.raises(WaveAborted):
+            doomed.future.result(timeout=300)
+        release.set()  # unwedge so the restart's close() can join
+        assert engine.error is None
+        ok = engine.submit(*PROMPTS[1])
+        res = ok.future.result(timeout=300)
+        assert (res.scores.argmax(-1) == off_scores[1].argmax(-1)).all()
+        np.testing.assert_allclose(
+            res.scores, off_scores[1], rtol=1e-5, atol=1e-6
+        )
+    finally:
+        release.set()
+        engine.shutdown(drain=True)
+    stats = engine.stats()
+    assert stats["watchdog_stalls"] >= 1
+    assert stats["source_restarts"] >= 1 and stats["completed"] == 1
+
+
+def test_queue_admission_site_rejects_with_reason():
+    """An injected front-door fault resolves the request as a reasoned
+    rejection (same contract as backpressure), never an unhandled raise
+    into the submitter — and the next submit is clean."""
+    inj = FaultInjector.from_config(
+        _chaos(error_rate=1.0, sites=("queue_admission",), max_faults=1)
+    )
+    q = AdmissionQueue(capacity=4, injector=inj)
+    bad = q.submit(Request(prefix="p", suffixes=("s",), max_new_tokens=1))
+    assert bad.status is RequestStatus.REJECTED
+    with pytest.raises(InjectedFault):
+        bad.future.result(timeout=1)
+    good = q.submit(Request(prefix="p", suffixes=("s",), max_new_tokens=1))
+    assert good.status is RequestStatus.QUEUED and len(q) == 1

@@ -230,6 +230,85 @@ def test_no_resume_flag_ignores_marker(tiny_cfg, model_dir, tmp_path):
         np.testing.assert_allclose(g, w, rtol=1e-5, atol=1e-6)
 
 
+# -- resume.py unit contracts (marker atomicity + signature coverage) -------
+
+
+def _toks():
+    from flexible_llm_sharding_tpu.runtime.tokenization import PromptTokenizer
+
+    tok = PromptTokenizer(FakeTokenizer(), max_token_len=64, bucket_multiple=8)
+    return [tok(p, s) for p, s in PROMPTS]
+
+
+def test_signature_covers_plan_dtype_and_block_size(model_dir):
+    """A marker written under one (plan, dtype, block_size) must not resume
+    a run whose activations were laid out under another: every one of those
+    knobs must flip the workload signature, silently restarting from zero."""
+    from flexible_llm_sharding_tpu.runtime import resume
+
+    toks = _toks()
+    base = dict(
+        plan_repr=[(0, 1), (2, 3)], model_path=model_dir,
+        dtype="float32", block_size=8,
+    )
+
+    def sig(**kw):
+        d = dict(base)
+        d.update(kw)
+        return resume.workload_signature(toks, **d)
+
+    assert sig() == sig()  # stable
+    assert sig(plan_repr=[(0,), (1,), (2, 3)]) != sig()
+    assert sig(dtype="bfloat16") != sig()
+    assert sig(block_size=4) != sig()
+    assert sig(model_path=model_dir + "/.") == sig()  # abspath-normalized
+    # A foreign-signature marker reads as {} -> _resume_start returns 0.
+    path = resume.marker_path(str(model_dir), sig())
+    resume.write_marker(path, sig(), completed_shards=5)
+    assert resume.read_marker(path, sig())["completed_shards"] == 5
+    assert resume.read_marker(path, sig(dtype="bfloat16")) == {}
+    resume.remove_marker(path)
+
+
+def test_marker_write_survives_crash_mid_write(tmp_path):
+    """Atomic-write contract: a crash BETWEEN writing the tmp file and the
+    rename must leave the old marker intact (a resumed run re-does work,
+    never consumes a torn marker) — the tmp file may remain, and a later
+    successful write must still land."""
+    import unittest.mock as mock
+
+    from flexible_llm_sharding_tpu.runtime import resume
+
+    path = str(tmp_path / "progress-test.json")
+    resume.write_marker(path, "sig", completed_shards=3)
+
+    orig_replace = os.replace
+    with mock.patch.object(
+        resume.os, "replace", side_effect=OSError("crash before rename")
+    ):
+        with pytest.raises(OSError):
+            resume.write_marker(path, "sig", completed_shards=5)
+    # The torn attempt left its tmp file, and the OLD marker is intact.
+    assert os.path.exists(path + ".tmp")
+    assert resume.read_marker(path, "sig")["completed_shards"] == 3
+    assert orig_replace is os.replace  # patch scope didn't leak
+    # Recovery: the next clean write replaces marker AND stale tmp content.
+    resume.write_marker(path, "sig", completed_shards=6)
+    assert resume.read_marker(path, "sig")["completed_shards"] == 6
+
+
+def test_marker_corrupt_or_absent_reads_empty(tmp_path):
+    from flexible_llm_sharding_tpu.runtime import resume
+
+    path = str(tmp_path / "progress-x.json")
+    assert resume.read_marker(path, "sig") == {}  # absent
+    with open(path, "w") as f:
+        f.write("{torn json")  # a torn/corrupt marker must read as absent
+    assert resume.read_marker(path, "sig") == {}
+    resume.remove_marker(path)
+    resume.remove_marker(path)  # idempotent on a missing file
+
+
 # -- MP pipeline resume (VERDICT r1 weak #6: "MP has no resume at all") -----
 
 def test_pipeline_resume_after_crash(tiny_cfg, model_dir, tmp_path):

@@ -158,6 +158,33 @@ def test_queue_drain_and_no_drain_shutdown():
             r.future.result(timeout=1)
 
 
+def test_queue_shutdown_resolves_expired_as_expired():
+    """Regression: a request whose deadline already passed but that lazy
+    eviction hasn't reached yet must resolve as EXPIRED (DeadlineExceeded)
+    on shutdown — under BOTH drain modes — not be folded into the
+    shutdown's cancelled/served-out outcome (its contract was lost before
+    the shutdown, and the terminal status must say why)."""
+    for drain in (True, False):
+        metrics = ServingMetrics()
+        q = AdmissionQueue(capacity=8, metrics=metrics)
+        live = _req(deadline=time.monotonic() + 60)
+        stale = _req(deadline=time.monotonic() - 0.01)  # expired, unevicted
+        q.submit(live)
+        q.submit(stale)
+        cancelled = q.close(drain=drain)
+        assert stale.status is RequestStatus.EXPIRED, f"drain={drain}"
+        with pytest.raises(DeadlineExceeded):
+            stale.future.result(timeout=1)
+        assert stale not in cancelled
+        assert metrics.counter("expired") == 1
+        if drain:
+            # The live request stays for the engine to serve out.
+            assert cancelled == [] and q.pop_wave(8) == [live]
+        else:
+            assert cancelled == [live]
+            assert live.status is RequestStatus.CANCELLED
+
+
 def test_batcher_evicts_expired_while_saturated():
     """Deadline eviction must not stall behind a saturated active set: a
     boundary with zero admission budget still sweeps expired waiters out of
