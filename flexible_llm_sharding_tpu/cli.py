@@ -72,6 +72,12 @@ def _add_robustness_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--chaos_sites", type=str, default="",
                    help=f"comma list of sites to inject at (default all): "
                         f"{','.join(FAULT_SITES)}")
+    p.add_argument("--verify_weights", type=_str2bool, default=True,
+                   help="checksum-verify every streamed layer against the "
+                        "model dir's integrity.json (mismatches re-read to "
+                        "heal page-cache corruption; persistent corruption "
+                        "raises a typed ShardCorruptError). false skips the "
+                        "crc pass on a trusted medium")
 
 
 def _fault_config_from_args(args: argparse.Namespace) -> FaultConfig:
@@ -204,6 +210,7 @@ def config_from_args(args: argparse.Namespace) -> FrameworkConfig:
         io_retry_attempts=args.io_retry_attempts,
         io_retry_base_s=args.io_retry_base_s,
         io_retry_deadline_s=args.io_retry_deadline_s,
+        verify_weights=args.verify_weights,
         faults=_fault_config_from_args(args),
     )
 
@@ -302,6 +309,7 @@ def serve_main(argv: list[str] | None = None, tokenizer=None) -> None:
         io_retry_attempts=args.io_retry_attempts,
         io_retry_base_s=args.io_retry_base_s,
         io_retry_deadline_s=args.io_retry_deadline_s,
+        verify_weights=args.verify_weights,
         faults=_fault_config_from_args(args),
     )
     serve_cfg = ServeConfig(
@@ -427,10 +435,56 @@ def serve_main(argv: list[str] | None = None, tokenizer=None) -> None:
     print(json.dumps(engine.stats()), file=sys.stderr)
 
 
+def build_verify_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="flexible-llm-sharding-tpu verify",
+        description="Offline integrity audit: recompute every checksum in "
+        "a prepared model dir (against integrity.json) and/or a spill dir "
+        "(against the per-.npy sidecars). Prints a per-file report and "
+        "exits nonzero on any problem — including manifest/dir structural "
+        "drift, which the tolerant load path deliberately does not fail on.",
+    )
+    p.add_argument("--model_path", type=str, default=None,
+                   help="prepared per-layer checkpoint dir to audit")
+    p.add_argument("--spill_dir", type=str, default=None,
+                   help="activation spill dir (--disk_folder of a run) to "
+                        "audit")
+    p.add_argument("--json", action="store_true",
+                   help="emit the full structured report as one JSON object "
+                        "on stdout instead of human-readable lines")
+    return p
+
+
+def verify_main(argv: list[str] | None = None) -> None:
+    args = build_verify_parser().parse_args(argv)
+    if not args.model_path and not args.spill_dir:
+        raise SystemExit("verify: give --model_path and/or --spill_dir")
+    from flexible_llm_sharding_tpu.integrity.verify import (
+        format_report,
+        verify_model_dir,
+        verify_spill_dir,
+    )
+
+    reports = []
+    if args.model_path:
+        reports.append(verify_model_dir(args.model_path))
+    if args.spill_dir:
+        reports.append(verify_spill_dir(args.spill_dir))
+    if args.json:
+        print(json.dumps({"reports": reports}))
+    else:
+        for r in reports:
+            print(format_report(r))
+    if not all(r["ok"] for r in reports):
+        raise SystemExit(2)
+
+
 def main(argv: list[str] | None = None, tokenizer=None) -> None:
     argv = sys.argv[1:] if argv is None else list(argv)
     if argv and argv[0] == "serve":
         return serve_main(argv[1:], tokenizer=tokenizer)
+    if argv and argv[0] == "verify":
+        return verify_main(argv[1:])
     args = build_parser().parse_args(argv)
     print(args, file=sys.stderr)
     if (args.top_k or args.top_p) and args.temperature <= 0:

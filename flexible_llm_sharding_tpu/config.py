@@ -24,8 +24,19 @@ SUPPORTED_ACTIVATIONS = frozenset({"silu", "gelu", "gelu_pytorch_tanh"})
 
 # Named fault-injection sites (faults/inject.py fires these; config
 # validation and the --chaos CLI flag key off this tuple so a typo'd site
-# fails loudly instead of silently injecting nothing).
-FAULT_SITES = ("shard_read", "device_put", "engine_step", "queue_admission")
+# fails loudly instead of silently injecting nothing). The corrupt_* sites
+# are SILENT-corruption sites: instead of raising, they bit-flip (or
+# truncate) the bytes mid-flight — what the integrity layer's checksums
+# exist to catch (corrupt_shard: one layer file's loaded tensors;
+# corrupt_activation: one .npy spill read).
+FAULT_SITES = (
+    "shard_read",
+    "device_put",
+    "engine_step",
+    "queue_admission",
+    "corrupt_shard",
+    "corrupt_activation",
+)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -886,6 +897,14 @@ class FrameworkConfig:
     io_retry_attempts: int = 4
     io_retry_base_s: float = 0.05  # first backoff; doubles per attempt
     io_retry_deadline_s: float = 60.0  # overall wall cap per call; 0 = none
+    # Weight-stream integrity verification (integrity/manifest.py): every
+    # layer load checksums its tensors against the model dir's
+    # integrity.json; a mismatch retries (re-read heals page-cache/NFS
+    # corruption) and only persistent corruption raises a typed
+    # ShardCorruptError. Costs one crc pass over the streamed bytes —
+    # disable on a trusted medium when the stream is host-CPU-bound.
+    # Dirs with no manifest load unverified with a one-time warning.
+    verify_weights: bool = True
     # Deterministic fault injection (off by default; the --chaos CLI flag
     # and the chaos tests enable it). Frozen sub-config keeps this config
     # hashable.

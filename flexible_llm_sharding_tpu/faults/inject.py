@@ -3,10 +3,14 @@
 A ``FaultInjector`` is threaded (explicitly — no global registry) into the
 hot paths, which call ``fire(site)`` at each named fault site:
 
-- ``shard_read``      — one layer file read in ``_HostShardLoader``
-- ``device_put``      — one shard's host->HBM placement
-- ``engine_step``     — one shard step of a serving sweep
-- ``queue_admission`` — one ``AdmissionQueue.submit``
+- ``shard_read``         — one layer file read in ``_HostShardLoader``
+- ``device_put``         — one shard's host->HBM placement
+- ``engine_step``        — one shard step of a serving sweep
+- ``queue_admission``    — one ``AdmissionQueue.submit``
+- ``corrupt_shard``      — SILENT corruption of one layer file's loaded
+  tensors (``corrupt_flat``: deterministic one-bit flip / truncate)
+- ``corrupt_activation`` — silent corruption of one ``.npy`` spill read
+  (``corrupt_array``)
 
 The schedule is a pure function of ``(seed, site, per-site call count)``
 via SHA-256 — NOT Python's ``hash`` (randomized per process) and NOT a
@@ -80,12 +84,19 @@ class FaultInjector:
                 return len(self.events)
             return sum(1 for s, _, _ in self.events if s == site)
 
-    def fire(self, site: str, detail: str = "") -> None:
+    def _draw(
+        self, site: str, kinds: tuple[str, str, str]
+    ) -> tuple[str | None, int]:
+        """One schedule unit for ``site``: advances the per-site count and
+        returns ``(kind, n)`` — kind None for a clean draw. ``kinds`` names
+        the (error, truncated, latency) outcomes, so the corruption sites
+        can relabel the error slot as 'bitflip' while sharing the same
+        rates, budget, and determinism contract."""
         if site not in FAULT_SITES:
             raise ValueError(f"unknown fault site {site!r} (one of {FAULT_SITES})")
         cfg = self.config
         if cfg.sites and site not in cfg.sites:
-            return
+            return None, -1
         # ONE critical section from count draw to budget consumption: a
         # second fire racing in between could otherwise steal the budget
         # unit this fire's schedule already committed to.
@@ -94,25 +105,91 @@ class FaultInjector:
             self._counts[site] = n + 1
             u = hash_unit(f"{cfg.seed}:{site}:{n}")
             if u < cfg.error_rate:
-                kind = "error"
+                kind = kinds[0]
             elif u < cfg.error_rate + cfg.truncate_rate:
-                kind = "truncated"
+                kind = kinds[1]
             elif u < cfg.error_rate + cfg.truncate_rate + cfg.latency_rate:
-                kind = "latency"
+                kind = kinds[2]
             else:
-                return
+                return None, n
             if self._budget is not None:
                 if self._budget == 0:
-                    return  # outage over: remaining fires are clean
+                    return None, n  # outage over: remaining fires are clean
                 self._budget -= 1
             self.events.append((site, kind, n))
+        return kind, n
+
+    def fire(self, site: str, detail: str = "") -> None:
+        kind, n = self._draw(site, ("error", "truncated", "latency"))
+        if kind is None:
+            return
         at = f"{site} #{n}" + (f" ({detail})" if detail else "")
         if kind == "latency":
-            time.sleep(cfg.latency_s)
+            time.sleep(self.config.latency_s)
         elif kind == "truncated":
             raise TruncatedRead(f"injected truncated read at {at}")
         else:
             raise InjectedFault(f"injected I/O error at {at}")
+
+    # -- silent-corruption sites -------------------------------------------
+    # fire() models faults that ANNOUNCE themselves (an exception, a
+    # stall). The corrupt_* sites model the opposite: bytes that come back
+    # wrong with no error at all — the integrity layer's checksums are the
+    # only thing standing between them and silently wrong tokens. The
+    # error slot of the shared draw becomes a deterministic one-bit flip
+    # (position hashed from the same seed/site/count triple, so a chaos
+    # run corrupts the exact same bit every replay); the truncated slot
+    # still raises (a short read IS announced once length validation sees
+    # it); latency still sleeps.
+
+    def _flip_bit(self, arr, key: str):
+        import numpy as np
+
+        a = np.ascontiguousarray(arr)
+        if a.nbytes == 0:
+            return a
+        buf = a.reshape(-1).view(np.uint8).copy()
+        pos = int(hash_unit(key + ":pos") * buf.size)
+        buf[pos] ^= np.uint8(1 << int(hash_unit(key + ":bit") * 8))
+        return buf.view(a.dtype).reshape(a.shape)
+
+    def corrupt_flat(self, site: str, flat: dict, detail: str = "") -> dict:
+        """One draw for a whole layer file's flat tensor dict: on a
+        'bitflip' draw, returns a new dict with ONE deterministically
+        chosen tensor's copy one bit off; 'truncated' raises
+        ``TruncatedRead``; 'latency' sleeps; clean returns ``flat``
+        unchanged (no copies on the hot path)."""
+        kind, n = self._draw(site, ("bitflip", "truncated", "latency"))
+        if kind is None or not flat:
+            return flat
+        at = f"{site} #{n}" + (f" ({detail})" if detail else "")
+        if kind == "latency":
+            time.sleep(self.config.latency_s)
+            return flat
+        if kind == "truncated":
+            raise TruncatedRead(f"injected truncated read at {at}")
+        keys = sorted(flat)
+        key = keys[int(hash_unit(f"{self.config.seed}:{site}:key:{n}") * len(keys))]
+        out = dict(flat)
+        out[key] = self._flip_bit(
+            flat[key], f"{self.config.seed}:{site}:{n}:{key}"
+        )
+        return out
+
+    def corrupt_array(self, site: str, arr, detail: str = ""):
+        """Single-array form of :meth:`corrupt_flat` (activation spill
+        reads): returns ``arr`` or a one-bit-flipped copy; 'truncated'
+        raises ``TruncatedRead``."""
+        kind, n = self._draw(site, ("bitflip", "truncated", "latency"))
+        if kind is None:
+            return arr
+        at = f"{site} #{n}" + (f" ({detail})" if detail else "")
+        if kind == "latency":
+            time.sleep(self.config.latency_s)
+            return arr
+        if kind == "truncated":
+            raise TruncatedRead(f"injected truncated read at {at}")
+        return self._flip_bit(arr, f"{self.config.seed}:{site}:{n}")
 
 
 __all__ = ["FaultInjector", "InjectedFault", "TruncatedRead"]

@@ -1,0 +1,61 @@
+"""End-to-end integrity for the streamed-bytes path.
+
+The framework's whole design pushes ~the full model through the chip from
+host storage every sweep and spills activations to RAM/disk between
+shards — and until this package every byte on that path was trusted
+blindly. PR 3 (``faults/``) made *transient* I/O errors survivable; this
+package catches and heals *silent corruption*: a bit-flip in a prepared
+``.safetensors`` shard, a truncated ``.npy`` spill, a stale spill picked
+up by a disk-mode resume.
+
+- ``manifest`` — per-layer content checksums (crc32 over raw tensor
+  bytes) written atomically next to the layer files by the checkpoint
+  writers (``utils/checkpoint.py``), verified on every load by
+  ``_HostShardLoader`` (``runtime/executor.py``). A mismatch is
+  *retryable* (a re-read heals page-cache/NFS corruption); only
+  persistent mismatches escalate to a typed ``ShardCorruptError`` that
+  quarantines the shard path. Spill files (``runtime/activations.py``)
+  get one checksum sidecar per ``.npy``; a persistent spill mismatch
+  makes the executor *recompute* the affected block from the last good
+  shard boundary instead of crashing.
+- ``verify`` — an offline audit (the ``verify`` CLI subcommand) of a
+  prepared model dir and/or spill dir: recomputes every checksum and
+  reports per-file mismatches, manifest/dir structural drift, and
+  unreadable files; exits nonzero on any finding.
+
+Counters (``integrity_failures`` / ``reread_heals`` / ``recomputes`` /
+``quarantined_shards``) flow through ``utils.metrics.IntegrityRecorder``
+into executor stats and the serve stats line. Chaos coverage: the
+``corrupt_shard`` / ``corrupt_activation`` fault sites (``faults/
+inject.py``) deterministically bit-flip or truncate the streamed bytes,
+and ``tests/test_integrity.py`` pins outputs token-identical to a
+fault-free run. docs/integrity.md holds the threat model.
+"""
+
+from flexible_llm_sharding_tpu.integrity.manifest import (  # noqa: F401
+    MANIFEST_NAME,
+    ChecksumMismatch,
+    ShardCorruptError,
+    SpillCorruptError,
+    SpillReadError,
+    layer_entry,
+    load_manifest,
+    manifest_digest,
+    tensor_checksum,
+    verify_flat,
+    write_manifest,
+)
+
+__all__ = [
+    "MANIFEST_NAME",
+    "ChecksumMismatch",
+    "ShardCorruptError",
+    "SpillCorruptError",
+    "SpillReadError",
+    "layer_entry",
+    "load_manifest",
+    "manifest_digest",
+    "tensor_checksum",
+    "verify_flat",
+    "write_manifest",
+]

@@ -1,0 +1,287 @@
+"""Weight-manifest checksums: the detection half of the integrity layer.
+
+One ``integrity.json`` per prepared model dir, written atomically
+(tmp + rename) by every checkpoint writer, keyed by layer name:
+
+.. code-block:: json
+
+    {
+      "version": 1,
+      "algorithm": "crc32",
+      "layers": {
+        "model.layers.0": {
+          "file": "model.layers.0.safetensors",
+          "tensors": {"attn.wq": {"c": "9a3f01b2", "n": 16384}, ...}
+        }
+      }
+    }
+
+``c`` is the crc32 (hex) of the tensor's raw stored bytes — exactly the
+contiguous little-endian payload safetensors serializes, so verification
+reads the same bytes the mmap loader hands to ``device_put``. ``n`` is
+the byte count (catches truncation before the checksum pass even runs).
+crc32 (zlib, always available) rather than a cryptographic hash on
+purpose: the threat model is *accidental* corruption — media/bus/page-
+cache bit-flips and torn writes — not an adversary, and the stream reads
+GBs per sweep, so the checksum must be cheap. The ``algorithm`` field is
+self-describing so a future xxhash/crc32c upgrade stays compatible.
+
+Error taxonomy (consumed by ``runtime/executor.py`` and
+``runtime/activations.py``):
+
+- ``ChecksumMismatch`` — **an IOError, deliberately**: the retry layer
+  (``faults/retry.py``) treats it like any transient read fault, because
+  a re-read genuinely heals page-cache/NFS corruption. Only a mismatch
+  that survives every re-read means the bytes on disk are wrong.
+- ``ShardCorruptError`` — a weight shard's mismatch survived retry
+  exhaustion; subclasses ``ShardLoadError`` so the serving engine's
+  degrade path (wave-fail + source restart) applies unchanged. The
+  loader quarantines the file path: further loads fail fast instead of
+  re-paying the full retry ladder per sweep.
+- ``SpillCorruptError`` / ``SpillReadError`` — an activation spill is
+  corrupt / unreadable even after re-reads. NOT an OSError: the healing
+  action is recomputing the block from the last good shard boundary
+  (disk mode's generation ping-pong keeps the inputs intact), not
+  another retry.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import zlib
+
+import numpy as np
+
+from flexible_llm_sharding_tpu.faults.retry import ShardLoadError
+
+MANIFEST_NAME = "integrity.json"
+ALGORITHM = "crc32"
+
+
+class ChecksumMismatch(IOError):
+    """A stored tensor's bytes do not match the manifest. An ``IOError``
+    on purpose — the retry policy re-reads (page-cache/NFS corruption
+    heals on a re-read); persistence, not occurrence, escalates."""
+
+
+class ShardCorruptError(ShardLoadError):
+    """A weight shard's checksum mismatch survived every re-read: the
+    bytes on disk are wrong. The loader quarantines the path (further
+    loads of it fail fast). A ``ShardLoadError`` subclass, so existing
+    degrade paths (serve wave-fail + source restart) apply unchanged."""
+
+
+class SpillCorruptError(RuntimeError):
+    """An activation spill failed verification even after re-reads. The
+    executor recomputes the affected block from the last good shard
+    boundary (disk mode) instead of crashing; where recompute is
+    impossible the error carries the offending path and shard index."""
+
+
+class SpillReadError(SpillCorruptError):
+    """A spill file could not be read or decoded at all (truncated
+    ``.npy``, I/O failure) — named by path and shard index instead of a
+    bare numpy ValueError. Subclasses ``SpillCorruptError`` so the
+    executor's recompute heals truncated spills too."""
+
+
+def _raw_bytes(arr: np.ndarray) -> np.ndarray:
+    """A tensor's stored payload as a flat uint8 view (zero-copy for
+    contiguous inputs, including ml_dtypes extension types)."""
+    a = np.ascontiguousarray(arr)
+    if a.nbytes == 0:
+        return np.empty(0, np.uint8)
+    return a.reshape(-1).view(np.uint8)
+
+
+def checksum_bytes(buf) -> str:
+    return f"{zlib.crc32(buf) & 0xFFFFFFFF:08x}"
+
+
+def tensor_checksum(arr: np.ndarray) -> str:
+    """crc32 (hex) over a tensor's raw contiguous bytes — the single
+    checksum primitive shared by the manifest, the spill sidecars, and
+    the offline ``verify`` audit."""
+    return checksum_bytes(_raw_bytes(arr))
+
+
+def layer_entry(flat: dict[str, np.ndarray], file_name: str) -> dict:
+    """Manifest entry for one layer file's flat tensor dict (as stored)."""
+    return {
+        "file": file_name,
+        "tensors": {
+            k: {"c": tensor_checksum(v), "n": int(np.asarray(v).nbytes)}
+            for k, v in flat.items()
+        },
+    }
+
+
+def write_manifest(out_dir: str, layers: dict[str, dict]) -> str:
+    """Atomically write ``integrity.json`` (tmp + rename — a crash
+    mid-write leaves the previous manifest intact, mirroring the resume
+    marker contract). Returns the manifest path."""
+    path = os.path.join(out_dir, MANIFEST_NAME)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(
+            {"version": 1, "algorithm": ALGORITHM, "layers": layers},
+            f,
+            sort_keys=True,
+        )
+    os.replace(tmp, path)
+    return path
+
+
+# (path -> ((mtime_ns, size), parsed)) parse cache: a streaming run builds
+# one loader per executor call, and each would otherwise re-parse the same
+# JSON — for a large model the manifest is O(100 KB). Keyed by stat, so a
+# re-prepared dir (atomic rename = new mtime) always re-reads. Entries are
+# never evicted: processes touch a handful of model dirs.
+_MANIFEST_CACHE: dict[str, tuple[tuple[int, int], dict]] = {}
+
+
+def load_manifest(model_dir: str) -> dict | None:
+    """The dir's manifest, or None when absent (old prepared dirs load
+    with a one-time warning — back-compat). A *corrupt* manifest raises:
+    writes are atomic, so torn JSON here is itself evidence of the
+    corruption this layer exists to catch."""
+    path = os.path.join(model_dir, MANIFEST_NAME)
+    try:
+        st = os.stat(path)
+    except OSError:
+        return None
+    key = (st.st_mtime_ns, st.st_size)
+    hit = _MANIFEST_CACHE.get(path)
+    if hit is not None and hit[0] == key:
+        return hit[1]
+    with open(path) as f:
+        try:
+            data = json.load(f)
+        except ValueError as e:
+            raise ValueError(
+                f"{path}: integrity manifest is corrupt JSON ({e}); "
+                "re-prepare the model dir or delete the manifest to load "
+                "unverified"
+            ) from e
+    if not isinstance(data.get("layers"), dict):
+        raise ValueError(f"{path}: integrity manifest has no 'layers' map")
+    _MANIFEST_CACHE[path] = (key, data)
+    return data
+
+
+def manifest_digest(manifest: dict | None) -> str:
+    """Stable hash of a manifest ("" when absent) — folded into the
+    resume workload signature and recorded in progress markers so a
+    resumed run can never trust spills produced against different
+    weights."""
+    if manifest is None:
+        return ""
+    return hashlib.sha1(
+        json.dumps(manifest, sort_keys=True).encode()
+    ).hexdigest()
+
+
+def verify_flat(
+    layer_name: str,
+    flat: dict[str, np.ndarray],
+    manifest: dict,
+    path: str = "",
+) -> None:
+    """Verify one loaded layer's flat tensors against the manifest.
+
+    Raises ``ChecksumMismatch`` (retryable — see module docstring) naming
+    the file, tensor, and expected/got values. A layer absent from the
+    manifest verifies vacuously on the load path (structural drift is the
+    offline ``verify`` audit's job, where it fails with a precise diff).
+    """
+    entry = manifest.get("layers", {}).get(layer_name)
+    if entry is None:
+        return
+    where = path or layer_name
+    want = entry.get("tensors", {})
+    missing = want.keys() - flat.keys()
+    if missing:
+        raise ChecksumMismatch(
+            f"{where}: tensors {sorted(missing)} listed in the integrity "
+            "manifest are absent from the file"
+        )
+    extra = flat.keys() - want.keys()
+    if extra:
+        raise ChecksumMismatch(
+            f"{where}: tensors {sorted(extra)} present in the file but not "
+            "in the integrity manifest"
+        )
+    for key, meta in want.items():
+        arr = np.asarray(flat[key])
+        if int(arr.nbytes) != int(meta["n"]):
+            raise ChecksumMismatch(
+                f"{where}: tensor {key!r} has {arr.nbytes} bytes, manifest "
+                f"records {meta['n']} (truncated/resized payload)"
+            )
+        got = tensor_checksum(arr)
+        if got != meta["c"]:
+            raise ChecksumMismatch(
+                f"{where}: tensor {key!r} checksum {got} != manifest "
+                f"{meta['c']} (corrupt bytes)"
+            )
+
+
+# -- spill sidecars ---------------------------------------------------------
+# One tiny text sidecar per .npy activation spill: "crc32:<hex>:<nbytes>".
+# Written atomically after the .npy lands; absent on files from older runs
+# (those load unverified — back-compat).
+
+SIDECAR_SUFFIX = ".crc"
+
+
+def write_sidecar(npy_path: str, arr: np.ndarray) -> None:
+    tmp = npy_path + SIDECAR_SUFFIX + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(f"{ALGORITHM}:{tensor_checksum(arr)}:{int(arr.nbytes)}\n")
+    os.replace(tmp, npy_path + SIDECAR_SUFFIX)
+
+
+def read_sidecar(npy_path: str) -> tuple[str, int] | None:
+    """(checksum, nbytes) recorded for a spill, or None when the sidecar
+    is absent (legacy spill — unverified). A malformed sidecar reads as a
+    mismatch sentinel ("", -1): sidecar corruption is corruption."""
+    try:
+        with open(npy_path + SIDECAR_SUFFIX) as f:
+            algo, csum, nbytes = f.read().strip().split(":")
+        if algo != ALGORITHM:
+            return ("", -1)
+        return (csum, int(nbytes))
+    except FileNotFoundError:
+        return None
+    except (OSError, ValueError):
+        return ("", -1)
+
+
+def remove_sidecar(npy_path: str) -> None:
+    try:
+        os.remove(npy_path + SIDECAR_SUFFIX)
+    except OSError:
+        pass
+
+
+__all__ = [
+    "ALGORITHM",
+    "MANIFEST_NAME",
+    "SIDECAR_SUFFIX",
+    "ChecksumMismatch",
+    "ShardCorruptError",
+    "SpillCorruptError",
+    "SpillReadError",
+    "checksum_bytes",
+    "layer_entry",
+    "load_manifest",
+    "manifest_digest",
+    "read_sidecar",
+    "remove_sidecar",
+    "tensor_checksum",
+    "verify_flat",
+    "write_manifest",
+    "write_sidecar",
+]

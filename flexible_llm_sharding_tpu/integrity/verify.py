@@ -1,0 +1,205 @@
+"""Offline integrity audit — the ``verify`` CLI subcommand's engine.
+
+Recomputes every checksum in a prepared model dir (against its
+``integrity.json``) and/or a spill dir (against the per-``.npy``
+sidecars) and returns a structured per-file report. Unlike the load
+path — which tolerates a layer missing from the manifest so old
+prepared dirs keep loading — the audit is STRICT: manifest/dir drift
+(a layer in the manifest but not on disk, a layer file the manifest
+never heard of, tensor-set differences) fails with a precise diff.
+
+Pure host-side numpy: no JAX import, so it runs anywhere the files do.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from flexible_llm_sharding_tpu.integrity import manifest as iman
+
+
+def _problem(file: str, status: str, detail: str = "") -> dict:
+    return {"file": file, "status": status, "detail": detail}
+
+
+def verify_model_dir(model_dir: str) -> dict:
+    """Audit a prepared per-layer checkpoint dir.
+
+    Returns ``{"path", "ok", "layers_checked", "tensors_checked",
+    "problems": [{"file", "status", "detail"}, ...]}``. Statuses:
+    ``no_manifest`` | ``corrupt_manifest`` | ``missing_file`` |
+    ``not_in_manifest`` | ``unreadable`` | ``tensor_diff`` |
+    ``mismatch``.
+    """
+    # Function-level import (like _mmap_safetensors) keeps verify_spill_dir
+    # usable without the checkpoint module's heavier deps.
+    from flexible_llm_sharding_tpu.utils.checkpoint import (
+        LAYER_FILE_SUFFIX as _LAYER_SUFFIX,
+    )
+    from flexible_llm_sharding_tpu.utils.checkpoint import _mmap_safetensors
+
+    problems: list[dict] = []
+    layers_checked = tensors_checked = 0
+    try:
+        manifest = iman.load_manifest(model_dir)
+    except ValueError as e:
+        manifest = None
+        problems.append(_problem(iman.MANIFEST_NAME, "corrupt_manifest", str(e)))
+    else:
+        if manifest is None:
+            problems.append(
+                _problem(
+                    iman.MANIFEST_NAME,
+                    "no_manifest",
+                    "dir has no integrity manifest; re-prepare (or re-save) "
+                    "to enable verification",
+                )
+            )
+    man_layers = dict((manifest or {}).get("layers", {}))
+    disk_layers = {
+        f[: -len(_LAYER_SUFFIX)]
+        for f in os.listdir(model_dir)
+        if f.endswith(_LAYER_SUFFIX)
+    }
+    for layer in sorted(man_layers.keys() - disk_layers):
+        problems.append(
+            _problem(
+                man_layers[layer].get("file", layer + _LAYER_SUFFIX),
+                "missing_file",
+                f"layer {layer!r} is in the manifest but its file is gone",
+            )
+        )
+    for layer in sorted(disk_layers - man_layers.keys()):
+        if manifest is not None:
+            problems.append(
+                _problem(
+                    layer + _LAYER_SUFFIX,
+                    "not_in_manifest",
+                    f"layer file {layer!r} exists on disk but the manifest "
+                    "has no entry for it",
+                )
+            )
+    for layer in sorted(man_layers.keys() & disk_layers):
+        fname = layer + _LAYER_SUFFIX
+        path = os.path.join(model_dir, fname)
+        try:
+            flat = _mmap_safetensors(path)
+        except Exception as e:  # truncated header, bad magic, ...
+            problems.append(_problem(fname, "unreadable", repr(e)))
+            continue
+        layers_checked += 1
+        want = man_layers[layer].get("tensors", {})
+        missing = sorted(want.keys() - flat.keys())
+        extra = sorted(flat.keys() - want.keys())
+        if missing or extra:
+            problems.append(
+                _problem(
+                    fname,
+                    "tensor_diff",
+                    f"manifest-only tensors {missing}, file-only tensors "
+                    f"{extra}",
+                )
+            )
+        for key in sorted(want.keys() & flat.keys()):
+            tensors_checked += 1
+            arr = np.asarray(flat[key])
+            meta = want[key]
+            if int(arr.nbytes) != int(meta["n"]):
+                problems.append(
+                    _problem(
+                        fname,
+                        "mismatch",
+                        f"tensor {key!r}: {arr.nbytes} bytes vs manifest "
+                        f"{meta['n']} (truncated/resized)",
+                    )
+                )
+                continue
+            got = iman.tensor_checksum(arr)
+            if got != meta["c"]:
+                problems.append(
+                    _problem(
+                        fname,
+                        "mismatch",
+                        f"tensor {key!r}: checksum {got} != manifest "
+                        f"{meta['c']}",
+                    )
+                )
+    return {
+        "path": model_dir,
+        "ok": not problems,
+        "layers_checked": layers_checked,
+        "tensors_checked": tensors_checked,
+        "problems": problems,
+    }
+
+
+def verify_spill_dir(spill_dir: str) -> dict:
+    """Audit an activation spill dir: every ``.npy`` against its checksum
+    sidecar. Spills without a sidecar (legacy runs) count as
+    ``unverified`` — reported, but not a failure. Orphan sidecars
+    (spill file gone) and unreadable/mismatching spills are failures.
+    """
+    problems: list[dict] = []
+    checked = unverified = 0
+    names = sorted(os.listdir(spill_dir))
+    npys = [f for f in names if f.endswith(".npy")]
+    for f in names:
+        if f.endswith(".npy" + iman.SIDECAR_SUFFIX):
+            if f[: -len(iman.SIDECAR_SUFFIX)] not in npys:
+                problems.append(
+                    _problem(f, "orphan_sidecar", "spill file is gone")
+                )
+    for f in npys:
+        path = os.path.join(spill_dir, f)
+        side = iman.read_sidecar(path)
+        if side is None:
+            unverified += 1
+            continue
+        try:
+            arr = np.load(path)
+        except Exception as e:  # truncated / undecodable
+            problems.append(_problem(f, "unreadable", repr(e)))
+            continue
+        checked += 1
+        csum, nbytes = side
+        if int(arr.nbytes) != nbytes:
+            problems.append(
+                _problem(
+                    f,
+                    "mismatch",
+                    f"{arr.nbytes} bytes vs sidecar {nbytes} (truncated)",
+                )
+            )
+            continue
+        got = iman.tensor_checksum(arr)
+        if got != csum:
+            problems.append(
+                _problem(f, "mismatch", f"checksum {got} != sidecar {csum}")
+            )
+    return {
+        "path": spill_dir,
+        "ok": not problems,
+        "spills_checked": checked,
+        "spills_unverified": unverified,
+        "problems": problems,
+    }
+
+
+def format_report(report: dict) -> str:
+    """Human-readable per-file lines + one summary line."""
+    lines = []
+    for p in report["problems"]:
+        lines.append(f"{p['status'].upper():>15}  {p['file']}  {p['detail']}")
+    counted = ", ".join(
+        f"{v} {k.replace('_', ' ')}"
+        for k, v in report.items()
+        if k.endswith(("_checked", "_unverified")) and v
+    )
+    verdict = "OK" if report["ok"] else f"{len(report['problems'])} problem(s)"
+    lines.append(f"{report['path']}: {verdict}" + (f" ({counted})" if counted else ""))
+    return "\n".join(lines)
+
+
+__all__ = ["verify_model_dir", "verify_spill_dir", "format_report"]

@@ -22,6 +22,11 @@ TPU-first differences:
 - ``tpu`` keeps activations as device arrays; ``cpu`` uses
   ``jax.device_get`` (async transfer flushed at store time); ``disk`` writes
   float32-preserving raw dtypes via numpy.
+- Every ``.npy`` spill carries a checksum sidecar (integrity/manifest.py)
+  verified on fetch with a short re-read loop; truncated/undecodable or
+  persistently corrupt spills raise typed errors naming the file and shard
+  index, and the executor recomputes the block from the last good shard
+  boundary (docs/integrity.md) instead of crashing.
 """
 
 from __future__ import annotations
@@ -31,19 +36,33 @@ import os
 import jax
 import numpy as np
 
+from flexible_llm_sharding_tpu.integrity import manifest as integrity_manifest
+from flexible_llm_sharding_tpu.integrity.manifest import (
+    SpillCorruptError,
+    SpillReadError,
+)
+
+# Spill-read re-read attempts before a checksum mismatch / decode failure
+# is treated as PERSISTENT (and escalated to the executor's recompute
+# path): page-cache/NFS corruption heals on a re-read, on-disk corruption
+# does not. Cheap — the file is hot in cache after the first attempt.
+_SPILL_REREAD_ATTEMPTS = 3
+
 
 def _save_npy(path: str, arr: np.ndarray) -> None:
     """np.save that round-trips ml_dtypes extension types (bfloat16, fp8):
     the npy format stores them as raw void bytes that np.load returns as
     dtype 'V2', which JAX rejects — so store a same-width uint view instead
-    and let :func:`_load_npy` restore the real dtype."""
+    and let :func:`_restore_dtype` restore the real dtype on read. A sidecar
+    (``<path>.crc``, integrity/manifest.py) lands atomically alongside so
+    every later fetch verifies the bytes it feeds back into the model."""
     if arr.dtype.isbuiltin == 0:  # extension dtype numpy can't describe
         arr = arr.view(np.dtype(f"u{arr.dtype.itemsize}"))
     np.save(path, arr)
+    integrity_manifest.write_sidecar(path, arr)
 
 
-def _load_npy(path: str, np_dtype: np.dtype | None) -> np.ndarray:
-    arr = np.load(path)
+def _restore_dtype(arr: np.ndarray, np_dtype: np.dtype | None) -> np.ndarray:
     if (
         np_dtype is not None
         and arr.dtype != np_dtype
@@ -72,7 +91,12 @@ class ActivationStore:
         max_in_cpu: int | None = None,
         np_dtype: np.dtype | None = None,
         batch: int = 0,
+        injector=None,
+        integrity=None,
     ):
+        # injector: chaos-only FaultInjector (corrupt_activation site fires
+        # on every spill read). integrity: metrics.IntegrityRecorder for
+        # detected-corruption / re-read-heal counters (None = dropped).
         # np_dtype: the compute dtype of stored activations; needed to
         # restore ml_dtypes extension types (bfloat16) from disk files.
         # batch: the num_batch loop index — scopes disk file names (and the
@@ -111,6 +135,9 @@ class ActivationStore:
         self._write_futs: list = []
         self._store_gen = 0  # disk write/read generations (see set_shard)
         self._fetch_gen = 0
+        self._shard_idx = 0  # for spill error messages (set_shard)
+        self._injector = injector
+        self._integrity = integrity
         if location == "disk":
             os.makedirs(disk_folder, exist_ok=True)
 
@@ -144,6 +171,7 @@ class ActivationStore:
         No-op for tpu/cpu stores (the cpu spill path keeps generation 0 —
         spills live and die within one shard, so there is no overwrite
         hazard and no resume)."""
+        self._shard_idx = shard_idx
         if self.location == "disk":
             self._store_gen = shard_idx % 2
             self._fetch_gen = (shard_idx - 1) % 2
@@ -161,13 +189,62 @@ class ActivationStore:
             if prefix_np is not None:
                 _save_npy(ppath, prefix_np[row])
 
+    def _read_spill(self, path: str) -> np.ndarray:
+        """One verified spill read: np.load + (chaos) corruption injection
+        + sidecar checksum, with up to ``_SPILL_REREAD_ATTEMPTS`` re-reads —
+        a re-read heals page-cache/NFS corruption exactly as on the weight
+        path. Persistent failure raises ``SpillCorruptError`` (checksum) or
+        ``SpillReadError`` (truncated/undecodable), both naming the file
+        AND the shard index — never a bare numpy ValueError."""
+        where = f"{path} (activation spill, shard {self._shard_idx})"
+        last: Exception | None = None
+        decode_failure = False
+        for attempt in range(_SPILL_REREAD_ATTEMPTS):
+            try:
+                arr = np.load(path)
+                if self._injector is not None:
+                    arr = self._injector.corrupt_array(
+                        "corrupt_activation", arr, detail=path
+                    )
+            except (OSError, ValueError, EOFError) as e:
+                # Truncated/undecodable .npy (a spill writer killed
+                # mid-write, a short read) — retry too: an INJECTED
+                # truncated read is transient by construction, and a real
+                # short read can be as well.
+                last, decode_failure = e, True
+                if self._integrity is not None:
+                    self._integrity.count("integrity_failures")
+                continue
+            side = integrity_manifest.read_sidecar(path)
+            if side is not None:
+                csum, nbytes = side
+                if (
+                    int(arr.nbytes) != nbytes
+                    or integrity_manifest.tensor_checksum(arr) != csum
+                ):
+                    last, decode_failure = (
+                        SpillCorruptError(f"{where}: checksum mismatch"),
+                        False,
+                    )
+                    if self._integrity is not None:
+                        self._integrity.count("integrity_failures")
+                    continue
+            if attempt and self._integrity is not None:
+                self._integrity.count("reread_heals")
+            return _restore_dtype(arr, self.np_dtype)
+        exc_type = SpillReadError if decode_failure else SpillCorruptError
+        raise exc_type(
+            f"{where}: {'unreadable' if decode_failure else 'corrupt'} after "
+            f"{_SPILL_REREAD_ATTEMPTS} read attempt(s): {last!r}"
+        ) from last
+
     def _fetch_disk(self, prompt_idxs: list[int], with_prefix: bool, gen: int = 0):
         prefixes, suffixes = [], []
         for idx in prompt_idxs:
             ppath, spath = self._paths(idx, gen)
-            suffixes.append(_load_npy(spath, self.np_dtype))
+            suffixes.append(self._read_spill(spath))
             if with_prefix:
-                prefixes.append(_load_npy(ppath, self.np_dtype))
+                prefixes.append(self._read_spill(ppath))
         suffix = np.stack(suffixes)
         prefix = np.stack(prefixes) if with_prefix else None
         return prefix, suffix
@@ -186,6 +263,7 @@ class ActivationStore:
                             os.remove(path)
                         except OSError:
                             pass
+                        integrity_manifest.remove_sidecar(path)
             over = (
                 self.max_in_cpu is not None
                 and self._cpu_prompts + len(prompt_idxs) > self.max_in_cpu
@@ -284,6 +362,24 @@ class ActivationStore:
         if self._write_futs:
             self.flush()
         return self._fetch_disk(prompt_idxs, with_prefix, self._fetch_gen)
+
+    def fetch_recompute(
+        self, block_id, prompt_idxs: list[int], with_prefix: bool = True
+    ):
+        """The PREVIOUS shard's inputs for one block (disk mode only): the
+        executor's corruption-recompute path re-runs shard k-1 when shard
+        k's fetch failed verification. Shard k-1's inputs live at
+        generation k%2 == the current STORE generation — untouched for this
+        block, because a block's store happens only after its fetch (the
+        same ping-pong invariant that protects crash resume)."""
+        if self.location != "disk":
+            raise SpillCorruptError(
+                "recompute needs disk-mode activation generations "
+                f"(storage_location={self.location!r} pops its inputs on "
+                "fetch)"
+            )
+        self.flush()
+        return self._fetch_disk(prompt_idxs, with_prefix, self._store_gen)
 
     def clear(self) -> None:
         try:

@@ -613,6 +613,7 @@ class DecodeGenerator:
             layer_rope=self.model_cfg.layer_rope,
             retry_policy=self.cfg.retry_policy(),
             injector=FaultInjector.from_config(self.cfg.faults),
+            verify_weights=self.cfg.verify_weights,
         )
         it = iter(source)
         n_shards = len(self.shards)

@@ -42,6 +42,12 @@ from flexible_llm_sharding_tpu.faults.retry import (
     ShardLoadError,
     retry_call,
 )
+from flexible_llm_sharding_tpu.integrity import manifest as integrity_manifest
+from flexible_llm_sharding_tpu.integrity.manifest import (
+    ChecksumMismatch,
+    ShardCorruptError,
+    SpillCorruptError,
+)
 from flexible_llm_sharding_tpu.models import llama
 from flexible_llm_sharding_tpu.parallel.planner import ShardPlan, plan_shards_dp
 from flexible_llm_sharding_tpu.runtime.activations import ActivationStore
@@ -167,6 +173,7 @@ def process_block(
     scores: dict,
     use_pallas: bool = False,
     tp_mesh=None,
+    fetched=None,
 ):
     """Run one shard over one block: fetch its activations (unless this shard
     starts at the embed layer), apply the segments, scatter any head scores,
@@ -176,6 +183,11 @@ def process_block(
     nothing is stored after the final layer; score rows truncate to the true
     suffix count) live only here.
 
+    ``fetched``: optional (prefix_h, suffix_h) override — already-on-device
+    activations that REPLACE the store fetch (the executor's corruption
+    recompute path re-derives a block's inputs when its spill failed
+    verification, then re-enters here).
+
     Returns the block's suffix activations (device array) for optional
     synchronisation by the caller.
     """
@@ -183,6 +195,10 @@ def process_block(
     prefix_ids, suffix_ids, prefix_len, suffix_eos = meta
     if first == 0:
         prefix_h, suffix_h = None, None  # produced by the embed segment
+    elif fetched is not None:
+        prefix_h, suffix_h = fetched
+        if first > n_layers - 3:  # norm/head shard: prefix is dead weight
+            prefix_h = None
     else:
         with_prefix = first <= n_layers - 3
         prefix_h, suffix_h = store.fetch(b, idxs, with_prefix=with_prefix)
@@ -338,7 +354,8 @@ class _HostShardLoader:
                  layer_rope=None, readahead: str = "auto",
                  retry_policy: RetryPolicy | None = None,
                  injector: FaultInjector | None = None,
-                 retry_recorder=None, retry_abort=None):
+                 retry_recorder=None, retry_abort=None,
+                 integrity=None, verify_weights: bool = True):
         self.model_path = model_path
         # Transient-I/O hardening: every layer-file read retries under the
         # policy (faults/retry.py) and raises a typed ShardLoadError only on
@@ -351,6 +368,30 @@ class _HostShardLoader:
         self._injector = injector
         self._recorder = retry_recorder
         self._retry_abort = retry_abort
+        # Integrity verification (integrity/manifest.py): every load's
+        # tensors checksum against the dir's manifest; a mismatch is an
+        # IOError, so it re-reads under the SAME retry policy as real I/O
+        # blips (a re-read heals page-cache/NFS corruption); only a
+        # mismatch that survives exhaustion quarantines the path and
+        # raises the typed ShardCorruptError. ``integrity`` is a
+        # metrics.IntegrityRecorder (or None — counters dropped).
+        self._integrity = integrity
+        self.quarantined: set[str] = set()
+        self._manifest = None
+        if verify_weights:
+            self._manifest = integrity_manifest.load_manifest(model_path)
+            if self._manifest is None:
+                import warnings
+
+                # One-time (per loader) back-compat warning: dirs prepared
+                # before the integrity layer still load, just unverified.
+                warnings.warn(
+                    f"{model_path}: no {integrity_manifest.MANIFEST_NAME} — "
+                    "weight integrity verification skipped for this stream "
+                    "(re-run split/save to emit a manifest, or audit with "
+                    "the `verify` CLI subcommand)",
+                    stacklevel=3,
+                )
         self.layer_names = list(layer_names)
         self.np_dtype = np_dtype
         self.tied = tied_embeddings
@@ -393,26 +434,90 @@ class _HostShardLoader:
             )
         )
 
+    def _layer_file(self, name: str) -> str:
+        """The file a layer name actually reads (tied lm_head re-reads the
+        embedding file) — the quarantine key."""
+        fname = (
+            "model.embed_tokens" if (name == "lm_head" and self.tied) else name
+        )
+        return os.path.join(
+            self.model_path, f"{fname}{checkpoint.LAYER_FILE_SUFFIX}"
+        )
+
     def _load_one(self, name: str) -> Params:
+        path = self._layer_file(name)
+        if path in self.quarantined:
+            # Persistent corruption already proven: fail fast instead of
+            # re-paying the whole retry ladder on every sweep. A fresh
+            # loader (e.g. the serving engine's source restart) gets a
+            # clean slate, so a repaired file is picked up again.
+            raise ShardCorruptError(
+                f"{path}: quarantined after persistent checksum mismatches"
+            )
+        mismatches = {"n": 0}
+
         def attempt() -> Params:
             if self._injector is not None:
                 self._injector.fire("shard_read", detail=name)
-            return self._load_one_raw(name)
+            try:
+                return self._load_one_raw(name)
+            except ChecksumMismatch:
+                mismatches["n"] += 1
+                if self._integrity is not None:
+                    self._integrity.count("integrity_failures")
+                raise
 
-        return retry_call(
-            attempt,
-            policy=self._retry,
-            label="shard_read",
-            recorder=self._recorder,
-            wrap=ShardLoadError,
-            abort=self._retry_abort,
-        )
+        try:
+            out = retry_call(
+                attempt,
+                policy=self._retry,
+                label="shard_read",
+                recorder=self._recorder,
+                wrap=ShardLoadError,
+                abort=self._retry_abort,
+            )
+        except ShardLoadError as e:
+            if isinstance(e.__cause__, ChecksumMismatch) and mismatches["n"] >= 2:
+                # At least TWO independent reads came back wrong: the bytes
+                # ON DISK are corrupt, not a transient blip. Quarantine the
+                # path and surface the typed signal (still a
+                # ShardLoadError, so the serving degrade path applies
+                # unchanged). A single mismatch cut short by an abort (a
+                # closing source) or the retry deadline is NOT re-read
+                # evidence — it re-raises untyped and a later load retries
+                # the path fresh.
+                self.quarantined.add(path)
+                if self._integrity is not None:
+                    self._integrity.count("quarantined_shards")
+                raise ShardCorruptError(
+                    f"{path}: checksum mismatch survived every re-read — "
+                    "on-disk corruption; path quarantined (audit with the "
+                    "`verify` CLI subcommand, then re-prepare the shard)"
+                ) from e
+            raise
+        if mismatches["n"]:
+            # At least one read came back corrupt and a re-read healed it
+            # (page-cache/NFS corruption) — count the save, it is the
+            # integrity layer's whole value proposition.
+            if self._integrity is not None:
+                self._integrity.count("reread_heals")
+        return out
 
     def _load_one_raw(self, name: str) -> Params:
+        corrupt = None
+        if self._injector is not None:
+            corrupt = lambda flat, _n=name: self._injector.corrupt_flat(  # noqa: E731
+                "corrupt_shard", flat, detail=_n
+            )
         if name == "lm_head" and self.tied:
             if self._tied_head is not None:
                 return self._tied_head
-            emb = checkpoint.load_layer(self.model_path, "model.embed_tokens")
+            emb = checkpoint.load_layer(
+                self.model_path,
+                "model.embed_tokens",
+                manifest=self._manifest,
+                corrupt=corrupt,
+            )
             e = emb["embedding"]
             if checkpoint.is_quantized_leaf(e):
                 # Quantized checkpoints carry scales laid out for [V, D];
@@ -433,7 +538,9 @@ class _HostShardLoader:
             else:
                 self._tied_head = {"kernel": np.ascontiguousarray(e.T)}
             return self._tied_head
-        return checkpoint.load_layer(self.model_path, name)
+        return checkpoint.load_layer(
+            self.model_path, name, manifest=self._manifest, corrupt=corrupt
+        )
 
     def _cast(self, tree: Params) -> Params:
         from flexible_llm_sharding_tpu.utils.native import convert_array
@@ -693,6 +800,8 @@ class ShardWeightSource:
         retry_policy: RetryPolicy | None = None,
         injector: FaultInjector | None = None,
         retry_recorder=None,
+        integrity_recorder=None,
+        verify_weights: bool = True,
     ):
         self.shards = list(shards)
         # Either one device for every shard, or (pipeline mode) one target
@@ -713,6 +822,7 @@ class ShardWeightSource:
             model_path, layer_names, np_dtype, tied_embeddings, layer_sliding,
             layer_rope, retry_policy=self._retry, injector=injector,
             retry_recorder=retry_recorder, retry_abort=self._stop.is_set,
+            integrity=integrity_recorder, verify_weights=verify_weights,
         )
         self.produce_time = 0.0  # set BEFORE the producer thread starts
         self._q: Queue = Queue(maxsize=max(1, prefetch_depth))
@@ -930,6 +1040,8 @@ class BroadcastShardSource:
         retry_policy: RetryPolicy | None = None,
         injector: FaultInjector | None = None,
         retry_recorder=None,
+        integrity_recorder=None,
+        verify_weights: bool = True,
     ):
         self.shards = list(shards)
         self.devices = list(devices)
@@ -939,6 +1051,7 @@ class BroadcastShardSource:
             model_path, layer_names, np_dtype, tied_embeddings, layer_sliding,
             layer_rope, retry_policy=retry_policy, injector=injector,
             retry_recorder=retry_recorder, retry_abort=self._stop.is_set,
+            integrity=integrity_recorder, verify_weights=verify_weights,
         )
         depth = max(1, prefetch_depth)
         self._queues = [Queue(maxsize=depth) for _ in self.devices]
@@ -1094,6 +1207,17 @@ class StreamingExecutor:
         self._retry_policy = cfg.retry_policy()
         self._retry_recorder = metrics.RetryRecorder()
         self._injector = FaultInjector.from_config(cfg.faults)
+        # Integrity accounting (detected corruption / re-read heals / block
+        # recomputes / quarantines) — surfaced in stats when nonzero. The
+        # manifest digest pins the model-dir CONTENT into the resume
+        # signature and progress marker, so a resumed run can never consume
+        # spills produced against different weights.
+        self._integrity = metrics.IntegrityRecorder()
+        self._manifest_digest = integrity_manifest.manifest_digest(
+            integrity_manifest.load_manifest(cfg.model_path)
+            if cfg.verify_weights
+            else None
+        )
         self.cfg = cfg
         self.model_cfg = LlamaConfig.from_pretrained(cfg.model_path)
         self.device = device
@@ -1163,6 +1287,7 @@ class StreamingExecutor:
         return resume.workload_signature(
             toks, self.plan.shards, self.cfg.model_path,
             self.cfg.dtype, self.cfg.block_size,
+            manifest_digest=self._manifest_digest,
         )
 
     def _progress_path(self, store: ActivationStore, sig: str) -> str:
@@ -1179,14 +1304,18 @@ class StreamingExecutor:
         """
         if not (self.cfg.resume and self.cfg.storage_location == "disk"):
             return 0
-        data = resume.read_marker(self._progress_path(store, sig), sig)
+        data = resume.read_marker(
+            self._progress_path(store, sig), sig,
+            manifest_hash=self._manifest_digest,
+        )
         # The final shard produces the scores and is never marked complete,
         # so start is always < num_shards.
         return min(int(data.get("completed_shards", 0)), len(self.plan.shards) - 1)
 
     def _mark_progress(self, store: ActivationStore, sig: str, done: int) -> None:
         resume.write_marker(
-            self._progress_path(store, sig), sig, completed_shards=done
+            self._progress_path(store, sig), sig, completed_shards=done,
+            manifest_hash=self._manifest_digest,
         )
 
     def __call__(self, prompts, batch: int = 0) -> list[np.ndarray]:
@@ -1203,6 +1332,8 @@ class StreamingExecutor:
             max_in_cpu=self.cfg.max_activation_in_cpu,
             np_dtype=self._np_dtype,
             batch=batch,
+            injector=self._injector,
+            integrity=self._integrity,
         )
         resumable = self.cfg.storage_location == "disk"
         sig = self._resume_signature(toks) if resumable else ""
@@ -1233,6 +1364,8 @@ class StreamingExecutor:
                 retry_policy=self._retry_policy,
                 injector=self._injector,
                 retry_recorder=self._retry_recorder,
+                integrity_recorder=self._integrity,
+                verify_weights=self.cfg.verify_weights,
             )
             skip = 0
             # Baseline taken BEFORE the source's prefetch producer starts
@@ -1337,6 +1470,13 @@ class StreamingExecutor:
             # non-zero means the stream RECOVERED from real (or injected)
             # blips; absent means the run was clean.
             self.stats["io_retries"] = float(io_retries)
+        for k, v in self._integrity.snapshot().items():
+            # Corruption accounting (integrity_failures / reread_heals /
+            # recomputes / quarantined_shards): nonzero means checksums
+            # CAUGHT bad bytes and the run healed around them; absent
+            # means every byte verified clean.
+            if v:
+                self.stats[k] = float(v)
         self.stats_history.append(dict(self.stats))
         if self.recorder is not None:
             self.recorder.record(
@@ -1369,6 +1509,16 @@ class StreamingExecutor:
         total = (n_shards or len(self.plan.shards)) * max(len(blocks), 1)
         bar = metrics.progress_bar(total, desc="stream", unit="blk")
         it = enumerate(source)
+        # Spill-corruption self-healing (disk mode only — cpu/tpu stores pop
+        # their in-memory activations on fetch, so there is nothing left to
+        # recompute from): the PREVIOUS shard's weights are retained one
+        # extra iteration so a block whose spill fails verification can be
+        # re-derived from the last good shard boundary — disk's generation
+        # ping-pong guarantees the previous shard's own inputs are still
+        # intact. Costs one extra shard's worth of HBM while streaming in
+        # disk mode (comparable to prefetch_depth=1's queued shard).
+        heal_spills = store.location == "disk"
+        prev_shard = None  # (layer_idxs, segments) of the last shard run
         try:
             while True:
                 t_wait = time.perf_counter()
@@ -1390,22 +1540,41 @@ class StreamingExecutor:
                 store.set_shard(shard_i + (0 if skip else start_shard))
                 t0 = time.perf_counter()
                 for b, idxs in enumerate(blocks):
-                    suffix_h = process_block(
-                        self.model_cfg,
-                        self.dtype,
-                        segments,
-                        layer_idxs,
-                        n_layers,
-                        store,
-                        b,
-                        idxs,
-                        block_meta[b],
-                        self.device,
-                        toks,
-                        scores,
-                        use_pallas=self._use_pallas,
-                        tp_mesh=self._tp_mesh,
-                    )
+                    fetched = None
+                    while True:
+                        try:
+                            suffix_h = process_block(
+                                self.model_cfg,
+                                self.dtype,
+                                segments,
+                                layer_idxs,
+                                n_layers,
+                                store,
+                                b,
+                                idxs,
+                                block_meta[b],
+                                self.device,
+                                toks,
+                                scores,
+                                use_pallas=self._use_pallas,
+                                tp_mesh=self._tp_mesh,
+                                fetched=fetched,
+                            )
+                            break
+                        except SpillCorruptError:
+                            # The block's input spill is corrupt even after
+                            # re-reads. Recompute it from the last good
+                            # shard boundary — bounded to ONE recompute per
+                            # block per shard (a recompute that fails again
+                            # means the previous generation is corrupt too:
+                            # raise).
+                            if prev_shard is None or fetched is not None:
+                                raise
+                            self._integrity.count("recomputes")
+                            fetched = self._recompute_block(
+                                prev_shard, store, b, idxs, block_meta[b],
+                                n_layers,
+                            )
                     bar.update(1)
                 if not blocks:
                     bar.update(1)
@@ -1420,9 +1589,48 @@ class StreamingExecutor:
                 compute_time += time.perf_counter() - t0
                 if on_shard_done is not None:
                     on_shard_done(shard_i)
+                prev_shard = (layer_idxs, segments) if heal_spills else None
         finally:
             bar.close()
         return compute_time, source_wait
+
+    def _recompute_block(
+        self, prev_shard, store, b, idxs, meta, n_layers: int
+    ):
+        """Re-derive one block's activations by re-running the PREVIOUS
+        shard: its inputs live in the other disk generation (the ping-pong
+        that protects crash resume also protects this path — shard k-1's
+        inputs at generation k%2 are untouched until shard k stores this
+        very block). Returns (prefix_h, suffix_h) on device, ready to feed
+        the current shard via ``process_block(fetched=...)``."""
+        prev_idxs, prev_segments = prev_shard
+        prefix_ids, suffix_ids, prefix_len, suffix_eos = meta
+        first = prev_idxs[0]
+        if first == 0:
+            prefix_h, suffix_h = None, None  # re-embed from token ids
+        else:
+            with_prefix = first <= n_layers - 3
+            prefix_h, suffix_h = store.fetch_recompute(
+                b, idxs, with_prefix=with_prefix
+            )
+            act_target = getattr(self.device, "act", self.device)
+            suffix_h = jax.device_put(suffix_h, act_target)
+            if prefix_h is not None:
+                prefix_h = jax.device_put(prefix_h, act_target)
+        prefix_h, suffix_h, _ = apply_segments(
+            self.model_cfg,
+            self.dtype,
+            prev_segments,
+            prefix_h,
+            suffix_h,
+            prefix_ids,
+            suffix_ids,
+            prefix_len,
+            suffix_eos,
+            self._use_pallas,
+            self._tp_mesh,
+        )
+        return prefix_h, suffix_h
 
 
 __all__ = [
@@ -1430,6 +1638,8 @@ __all__ = [
     "ShardWeightSource",
     "BroadcastShardSource",
     "ShardLoadError",
+    "ShardCorruptError",
+    "SpillCorruptError",
     "apply_segments",
     "process_block",
     "finalize_scores",

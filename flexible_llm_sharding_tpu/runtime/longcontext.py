@@ -378,6 +378,7 @@ class LongContextScorer:
             layer_rope=self.model_cfg.layer_rope,
             retry_policy=self.cfg.retry_policy(),
             injector=FaultInjector.from_config(self.cfg.faults),
+            verify_weights=self.cfg.verify_weights,
         )
 
     def __call__(self, prompts) -> list[np.ndarray]:

@@ -267,6 +267,7 @@ def run_prompts(
         layer_rope=model_cfg.layer_rope,
         retry_policy=cfg.retry_policy(),
         injector=FaultInjector.from_config(cfg.faults),
+        verify_weights=cfg.verify_weights,
     )
 
     def run_one(slot: int) -> list[np.ndarray]:
@@ -425,6 +426,7 @@ def run_decode(
         layer_rope=model_cfg.layer_rope,
         retry_policy=cfg.retry_policy(),
         injector=FaultInjector.from_config(cfg.faults),
+        verify_weights=cfg.verify_weights,
     )
 
     def run_one(slot: int):

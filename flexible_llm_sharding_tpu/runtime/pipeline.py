@@ -82,6 +82,15 @@ class PipelineRunner:
         # Per-stage dispatch events; ``dispatch_wall_s`` vs ``total_wall_s``
         # in stats is the pipelining evidence — see _run_batch.
         self.recorder = metrics.Recorder(verbose=cfg.verbose_metrics)
+        # Model-content pin for resume (mirrors StreamingExecutor): the
+        # manifest digest rides in the workload signature and the progress
+        # marker, so a resumed pipeline never consumes inter-stage spills
+        # produced against different weights.
+        from flexible_llm_sharding_tpu.integrity import manifest as _iman
+
+        self._manifest_digest = _iman.manifest_digest(
+            _iman.load_manifest(cfg.model_path) if cfg.verify_weights else None
+        )
 
     @property
     def _np_dtype(self):
@@ -109,6 +118,7 @@ class PipelineRunner:
             self.cfg.model_path,
             self.cfg.dtype,
             self.cfg.block_size,
+            manifest_digest=self._manifest_digest,
         )
 
     def _marker_path(self, sig: str, tag: str) -> str:
@@ -117,12 +127,18 @@ class PipelineRunner:
     def _resume_start(self, sig: str, tag: str, last_real: int) -> int:
         if not (self.cfg.resume and self.cfg.storage_location == "disk"):
             return 0
-        data = resume.read_marker(self._marker_path(sig, tag), sig)
+        data = resume.read_marker(
+            self._marker_path(sig, tag), sig,
+            manifest_hash=self._manifest_digest,
+        )
         # The head stage produces the scores and is never marked complete.
         return min(int(data.get("completed_stages", 0)), last_real)
 
     def _mark_stage(self, sig: str, tag: str, done: int) -> None:
-        resume.write_marker(self._marker_path(sig, tag), sig, completed_stages=done)
+        resume.write_marker(
+            self._marker_path(sig, tag), sig, completed_stages=done,
+            manifest_hash=self._manifest_digest,
+        )
 
     def _run_batch(self, prompts, batch: int = 0) -> list[np.ndarray]:
         t_start = time.perf_counter()
@@ -159,6 +175,7 @@ class PipelineRunner:
             layer_rope=self.model_cfg.layer_rope,
             retry_policy=self.cfg.retry_policy(),
             injector=FaultInjector.from_config(self.cfg.faults),
+            verify_weights=self.cfg.verify_weights,
         )
 
         n_layers = len(self.layer_names)

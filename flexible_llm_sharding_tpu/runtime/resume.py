@@ -25,9 +25,20 @@ from typing import Any
 
 
 def workload_signature(
-    toks, plan_repr: Any, model_path: str, dtype: str, block_size: int
+    toks,
+    plan_repr: Any,
+    model_path: str,
+    dtype: str,
+    block_size: int,
+    manifest_digest: str = "",
 ) -> str:
-    """Hash of everything a resumed run must share with the crashed one."""
+    """Hash of everything a resumed run must share with the crashed one.
+
+    ``manifest_digest`` (integrity.manifest.manifest_digest) pins the model
+    dir's CONTENT, not just its path: re-preparing/repairing the weights in
+    place invalidates old markers, so a resumed run can never mix spills
+    produced against different bytes ("" = no manifest, path-only guard).
+    """
     h = hashlib.sha1(
         repr(
             (
@@ -37,6 +48,7 @@ def workload_signature(
                 plan_repr,
                 dtype,
                 block_size,
+                manifest_digest,
             )
         ).encode()
     )
@@ -54,14 +66,28 @@ def marker_path(disk_folder: str, sig: str, tag: str = "") -> str:
     return os.path.join(disk_folder, f"progress-{sig[:16]}{tag}.json")
 
 
-def read_marker(path: str, sig: str) -> dict:
-    """The marker's fields, or {} when absent/corrupt/foreign-signature."""
+def read_marker(path: str, sig: str, manifest_hash: str | None = None) -> dict:
+    """The marker's fields, or {} when absent/corrupt/foreign-signature.
+
+    ``manifest_hash``: when given AND the marker recorded one, the two must
+    match — a marker written against a model dir whose integrity manifest
+    has since changed (weights repaired/re-prepared in place) reads as
+    absent, belt-and-braces with the signature's own manifest digest.
+    """
     try:
         with open(path) as f:
             data = json.load(f)
     except (OSError, ValueError):
         return {}
-    return data if data.get("signature") == sig else {}
+    if data.get("signature") != sig:
+        return {}
+    if (
+        manifest_hash is not None
+        and "manifest_hash" in data
+        and data["manifest_hash"] != manifest_hash
+    ):
+        return {}
+    return data
 
 
 def write_marker(path: str, sig: str, **fields) -> None:

@@ -385,6 +385,8 @@ class ServeEngine:
             retry_policy=self._retry_policy,
             injector=self._injector,
             retry_recorder=self.metrics.retries,
+            integrity_recorder=self.metrics.integrity,
+            verify_weights=self.cfg.verify_weights,
         )
 
     def _acquire_weights(self) -> None:

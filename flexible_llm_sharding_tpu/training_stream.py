@@ -345,7 +345,7 @@ class StreamedTrainer:
             # np.savez silently mangles ml_dtypes (bfloat16 -> raw '|V2');
             # store a same-width uint view instead (zero growth, exact) and
             # restore reinterprets to the template leaf's dtype — the same
-            # trick as activations._save_npy/_load_npy.
+            # trick as activations._save_npy/_restore_dtype.
             def savable(x):
                 x = np.asarray(x)
                 if x.dtype.isbuiltin == 0:  # extension dtype (bf16, fp8)

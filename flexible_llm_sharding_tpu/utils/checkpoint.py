@@ -45,6 +45,7 @@ from safetensors.numpy import load_file as st_load_file
 from safetensors.numpy import save_file as st_save_file
 
 from flexible_llm_sharding_tpu.config import LlamaConfig
+from flexible_llm_sharding_tpu.integrity import manifest as integrity_manifest
 
 LAYER_FILE_SUFFIX = ".safetensors"
 NATIVE_LAYOUT_MARKER = "fls_tpu_layout.json"
@@ -643,6 +644,7 @@ def split_into_layers(
 
     state: dict[str, np.ndarray] = {}
     loaded: set[str] = set()
+    manifest_layers: dict[str, dict] = {}
     for layer in layer_list:
         for shard in layer2shards[layer] - loaded:
             loaded.add(shard)
@@ -670,10 +672,15 @@ def split_into_layers(
             sd = hf_layer_to_native(layer, sd)
         if quantize:
             sd = _quantize_flat(sd, dtype)
-        st_save_file(
-            {k: np.ascontiguousarray(v) for k, v in sd.items()},
-            os.path.join(out_dir, f"{layer}{LAYER_FILE_SUFFIX}"),
+        stored = {k: np.ascontiguousarray(v) for k, v in sd.items()}
+        st_save_file(stored, os.path.join(out_dir, f"{layer}{LAYER_FILE_SUFFIX}"))
+        # Per-layer content checksums over the EXACT stored bytes — the
+        # loader verifies every subsequent read against this manifest
+        # (integrity/manifest.py; written atomically after the last layer).
+        manifest_layers[layer] = integrity_manifest.layer_entry(
+            stored, f"{layer}{LAYER_FILE_SUFFIX}"
         )
+        del stored
         for k in layer2keys[layer]:
             del state[k]
         del sd
@@ -683,6 +690,7 @@ def split_into_layers(
 
     with open(os.path.join(out_dir, NATIVE_LAYOUT_MARKER), "w") as f:
         json.dump({"layout": layout, "dtype": dtype, "layers": layer_list}, f)
+    integrity_manifest.write_manifest(out_dir, manifest_layers)
     return layer_list
 
 
@@ -757,14 +765,36 @@ def dequantize_tree_np(tree):
     )
 
 
-def load_layer(model_path: str, layer_name: str) -> dict[str, Any]:
+def load_layer(
+    model_path: str,
+    layer_name: str,
+    manifest: dict | None = None,
+    corrupt=None,
+) -> dict[str, Any]:
     """Load one layer file into a native-layout parameter pytree (numpy;
     zero-copy mmap views where the file is already native layout). int8-
     compressed tensors come back as {"q8", "s"} leaf-groups, still int8 —
-    dequantization happens on device, after the transfer."""
+    dequantization happens on device, after the transfer.
+
+    ``manifest``: an integrity manifest (integrity/manifest.py) — when it
+    covers this layer, every stored tensor's checksum is verified and a
+    mismatch raises the retryable ``ChecksumMismatch`` (re-reads heal
+    page-cache corruption; the loader escalates persistence).
+    ``corrupt``: chaos-only hook (``FaultInjector.corrupt_flat``) applied
+    to the raw flat tensors BEFORE verification, so injected silent
+    corruption is exactly what the checksums must catch."""
     flat = _mmap_safetensors(
         os.path.join(model_path, f"{layer_name}{LAYER_FILE_SUFFIX}")
     )
+    if corrupt is not None:
+        flat = corrupt(flat)
+    if manifest is not None:
+        integrity_manifest.verify_flat(
+            layer_name,
+            flat,
+            manifest,
+            path=os.path.join(model_path, f"{layer_name}{LAYER_FILE_SUFFIX}"),
+        )
     if not _is_native(flat.keys()):
         flat = hf_layer_to_native(layer_name, flat)
     if any(k.endswith((QUANT_SCALE_SUFFIX, QUANT4_SCALE_SUFFIX)) for k in flat):
@@ -795,10 +825,18 @@ def requantize_native(
         raise ValueError(f"requantize_native: unsupported dtype {dtype!r}")
     os.makedirs(out_dir, exist_ok=True)
     done = []
+    manifest_layers: dict[str, dict] = {}
     for fn in sorted(os.listdir(src_dir)):
         src = os.path.join(src_dir, fn)
         if not fn.endswith(LAYER_FILE_SUFFIX):
-            if os.path.isfile(src) and fn != NATIVE_LAYOUT_MARKER:
+            # The source's integrity manifest must NOT ride along — its
+            # checksums describe the float tensors, not the re-encoded
+            # ones; a fresh manifest is written below.
+            if (
+                os.path.isfile(src)
+                and fn != NATIVE_LAYOUT_MARKER
+                and fn != integrity_manifest.MANIFEST_NAME
+            ):
                 shutil.copy(src, os.path.join(out_dir, fn))
             continue
         flat = _mmap_safetensors(src)
@@ -815,13 +853,15 @@ def requantize_native(
                 "original float checkpoint"
             )
         qd = _quantize_flat(flat, dtype)
-        st_save_file(
-            {k: np.ascontiguousarray(v) for k, v in qd.items()},
-            os.path.join(out_dir, fn),
+        stored = {k: np.ascontiguousarray(v) for k, v in qd.items()}
+        st_save_file(stored, os.path.join(out_dir, fn))
+        manifest_layers[fn[: -len(LAYER_FILE_SUFFIX)]] = (
+            integrity_manifest.layer_entry(stored, fn)
         )
         done.append(fn[: -len(LAYER_FILE_SUFFIX)])
     with open(os.path.join(out_dir, NATIVE_LAYOUT_MARKER), "w") as f:
         json.dump({"layout": "native", "dtype": dtype, "layers": done}, f)
+    integrity_manifest.write_manifest(out_dir, manifest_layers)
     return done
 
 
@@ -841,12 +881,22 @@ def save_params(params: dict[str, Any], out_dir: str, cfg: LlamaConfig) -> None:
                 # contiguity is mandatory here.
                 yield name, np.ascontiguousarray(np.asarray(v))
 
-    st_save_file(dict(flatten(params["embed"])), os.path.join(out_dir, "model.embed_tokens.safetensors"))
+    manifest_layers: dict[str, dict] = {}
+
+    def _save(layer_name: str, tree: dict[str, Any]) -> None:
+        flat = dict(flatten(tree))
+        st_save_file(flat, os.path.join(out_dir, f"{layer_name}.safetensors"))
+        manifest_layers[layer_name] = integrity_manifest.layer_entry(
+            flat, f"{layer_name}.safetensors"
+        )
+
+    _save("model.embed_tokens", params["embed"])
     for i, layer in enumerate(params["layers"]):
-        st_save_file(dict(flatten(layer)), os.path.join(out_dir, f"model.layers.{i}.safetensors"))
-    st_save_file(dict(flatten(params["norm"])), os.path.join(out_dir, "model.norm.safetensors"))
+        _save(f"model.layers.{i}", layer)
+    _save("model.norm", params["norm"])
     if "lm_head" in params and params["lm_head"]:
-        st_save_file(dict(flatten(params["lm_head"])), os.path.join(out_dir, "lm_head.safetensors"))
+        _save("lm_head", params["lm_head"])
+    integrity_manifest.write_manifest(out_dir, manifest_layers)
     import dataclasses as _dc
 
     # EVERY dataclass field serializes by name (tuples become json lists;

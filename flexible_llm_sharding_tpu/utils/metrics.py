@@ -274,6 +274,43 @@ class RetryRecorder:
             }
 
 
+class IntegrityRecorder:
+    """Thread-safe corruption-accounting counters (fed by the integrity
+    layer: ``_HostShardLoader``, ``ActivationStore``, the executor's
+    recompute path). Keys: ``integrity_failures`` (checksum mismatches /
+    unreadable spills DETECTED), ``reread_heals`` (loads that came back
+    clean on a re-read — page-cache/NFS corruption healed in place),
+    ``recomputes`` (blocks re-derived from the last good shard boundary
+    after a persistent spill mismatch), ``quarantined_shards`` (weight
+    files whose corruption survived every re-read). Surfaced in executor
+    stats and the serve stats line when nonzero."""
+
+    KEYS = (
+        "integrity_failures",
+        "reread_heals",
+        "recomputes",
+        "quarantined_shards",
+    )
+
+    def __init__(self) -> None:
+        import threading
+
+        self._lock = threading.Lock()
+        self._counts: dict[str, int] = {k: 0 for k in self.KEYS}
+
+    def count(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            self._counts[name] = self._counts.get(name, 0) + n
+
+    def total(self, name: str) -> int:
+        with self._lock:
+            return self._counts.get(name, 0)
+
+    def snapshot(self) -> dict[str, int]:
+        with self._lock:
+            return dict(self._counts)
+
+
 class ServingMetrics:
     """Counters/gauges/latency samples for the online serving subsystem.
 
@@ -303,6 +340,10 @@ class ServingMetrics:
         # Transient-I/O retry accounting for this engine's weight stream
         # (the engine threads it into its sources' loaders).
         self.retries = RetryRecorder()
+        # Corruption accounting (checksum failures / re-read heals /
+        # quarantines) for the same stream — nonzero counters appear in
+        # the stats line under "integrity".
+        self.integrity = IntegrityRecorder()
 
     def count(self, name: str, n: int = 1) -> None:
         with self._lock:
@@ -326,6 +367,7 @@ class ServingMetrics:
 
     def snapshot(self) -> dict:
         retries = self.retries.snapshot()
+        integrity = self.integrity.snapshot()
         with self._lock:
             out = {
                 "event": "serve_stats",
@@ -336,6 +378,8 @@ class ServingMetrics:
             }
         if retries:
             out["io_retries"] = retries
+        if any(integrity.values()):
+            out["integrity"] = integrity
         return out
 
     def emit(self) -> None:
@@ -743,6 +787,7 @@ def throughput(tokens: int, seconds: float, chips: int = 1) -> dict[str, float]:
 
 
 __all__ = [
+    "IntegrityRecorder",
     "LiveArrayPeakSampler",
     "Recorder",
     "RetryRecorder",

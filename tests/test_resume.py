@@ -297,6 +297,57 @@ def test_marker_write_survives_crash_mid_write(tmp_path):
     assert resume.read_marker(path, "sig")["completed_shards"] == 6
 
 
+def test_resume_rejects_marker_from_changed_model_dir(
+    tiny_cfg, model_dir, tmp_path
+):
+    """Integrity guard: a marker written against one model dir CONTENT must
+    not resume after the weights are re-prepared in place (same path!) —
+    the manifest digest rides in both the signature and the marker's
+    manifest_hash field, so the resumed run silently restarts from zero
+    and scores the NEW weights correctly."""
+    import shutil
+
+    from flexible_llm_sharding_tpu.models import llama as _llama
+
+    mutated = str(tmp_path / "model")
+    shutil.copytree(model_dir, mutated)
+    disk = str(tmp_path / "acts")
+    ex = StreamingExecutor(_cfg(mutated, disk), tokenizer=FakeTokenizer())
+    _run_and_crash_after(ex, list(PROMPTS), 3)
+    assert _marker_file(disk) is not None
+
+    # Repair/replace the weights IN PLACE (different init seed).
+    params = _llama.init_params(jax.random.PRNGKey(7), tiny_cfg)
+    save_params(jax.tree.map(np.asarray, params), mutated, tiny_cfg)
+
+    want = StreamingExecutor(
+        _cfg(mutated, str(tmp_path / "clean")), tokenizer=FakeTokenizer()
+    )(list(PROMPTS))
+    got = StreamingExecutor(
+        _cfg(mutated, disk, resume=True), tokenizer=FakeTokenizer()
+    )(list(PROMPTS))
+    for g, w in zip(got, want):
+        np.testing.assert_allclose(g, w, rtol=1e-5, atol=1e-6)
+
+
+def test_read_marker_rejects_different_manifest_hash(tmp_path):
+    """Unit half of the guard: read_marker with a current manifest hash
+    rejects a marker recorded under another, tolerates pre-field markers."""
+    from flexible_llm_sharding_tpu.runtime import resume
+
+    path = str(tmp_path / "progress-m.json")
+    resume.write_marker(path, "sig", completed_shards=4, manifest_hash="aaa")
+    assert resume.read_marker(path, "sig", manifest_hash="aaa")[
+        "completed_shards"
+    ] == 4
+    assert resume.read_marker(path, "sig", manifest_hash="bbb") == {}
+    assert resume.read_marker(path, "sig")["completed_shards"] == 4  # no check
+    resume.write_marker(path, "sig", completed_shards=2)  # legacy marker
+    assert resume.read_marker(path, "sig", manifest_hash="aaa")[
+        "completed_shards"
+    ] == 2
+
+
 def test_marker_corrupt_or_absent_reads_empty(tmp_path):
     from flexible_llm_sharding_tpu.runtime import resume
 
