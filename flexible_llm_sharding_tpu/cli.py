@@ -45,6 +45,12 @@ def _str2bool_or_auto(v: str) -> bool | None:
     return _str2bool(v)
 
 
+def _float_or_auto(v: str) -> float | None:
+    if v.lower() == "auto":
+        return None
+    return float(v)
+
+
 def _add_robustness_flags(p: argparse.ArgumentParser) -> None:
     """Shared by the batch and serve parsers: transient-I/O retry knobs and
     the deterministic chaos (fault-injection) switch."""
@@ -76,8 +82,23 @@ def _add_robustness_flags(p: argparse.ArgumentParser) -> None:
                    help="checksum-verify every streamed layer against the "
                         "model dir's integrity.json (mismatches re-read to "
                         "heal page-cache corruption; persistent corruption "
-                        "raises a typed ShardCorruptError). false skips the "
-                        "crc pass on a trusted medium")
+                        "raises a typed ShardCorruptError). The crc pass is "
+                        "amortized: each file generation is hashed once and "
+                        "later sweeps reuse the cached verdict. false skips "
+                        "it entirely on a trusted medium")
+    p.add_argument("--host_cache_gb", type=_float_or_auto, default=None,
+                   help="host-resident shard cache budget in GB: warm "
+                        "sweeps (serving, multi-token decode, multi-batch "
+                        "runs) skip disk read+parse+checksum and go "
+                        "straight to device_put. 'auto' (default) = a "
+                        "fraction of free RAM (off under --chaos); 0 = off")
+    p.add_argument("--readahead_threads", type=int, default=2,
+                   help="threads in the loader's page-cache readahead pool "
+                        "(posix_fadvise issuers, ~zero CPU each)")
+    p.add_argument("--score_sink_max_device", type=int, default=16,
+                   help="max head-stage score slices kept device-resident "
+                        "before older ones resolve to host numpy (bigger = "
+                        "fewer host syncs on big batches, more HBM)")
 
 
 def _fault_config_from_args(args: argparse.Namespace) -> FaultConfig:
@@ -211,6 +232,9 @@ def config_from_args(args: argparse.Namespace) -> FrameworkConfig:
         io_retry_base_s=args.io_retry_base_s,
         io_retry_deadline_s=args.io_retry_deadline_s,
         verify_weights=args.verify_weights,
+        host_cache_gb=args.host_cache_gb,
+        readahead_threads=args.readahead_threads,
+        score_sink_max_device=args.score_sink_max_device,
         faults=_fault_config_from_args(args),
     )
 
@@ -310,6 +334,9 @@ def serve_main(argv: list[str] | None = None, tokenizer=None) -> None:
         io_retry_base_s=args.io_retry_base_s,
         io_retry_deadline_s=args.io_retry_deadline_s,
         verify_weights=args.verify_weights,
+        host_cache_gb=args.host_cache_gb,
+        readahead_threads=args.readahead_threads,
+        score_sink_max_device=args.score_sink_max_device,
         faults=_fault_config_from_args(args),
     )
     serve_cfg = ServeConfig(

@@ -50,6 +50,7 @@ from __future__ import annotations
 import hashlib
 import json
 import os
+import threading
 import zlib
 
 import numpy as np
@@ -100,11 +101,33 @@ def checksum_bytes(buf) -> str:
     return f"{zlib.crc32(buf) & 0xFFFFFFFF:08x}"
 
 
+# Chunk size for the incremental crc pass. Chunking (rather than one
+# monolithic zlib call over a GB-scale mmap view) keeps the hash walking
+# the bytes in page-cache-friendly strides: each chunk's pages fault in,
+# get hashed while hot, and the kernel's readahead stays ahead of the
+# hasher — the hash rides the same read the loader is doing anyway
+# instead of forcing a second full-buffer pass pattern.
+_CRC_CHUNK = 4 << 20
+
+
+def checksum_chunked(flat_u8: np.ndarray, chunk: int = _CRC_CHUNK) -> str:
+    """Incremental crc32 over a flat uint8 array, ``chunk`` bytes at a
+    time — the shared chunked-hash reader used by the weight-manifest
+    verify pass and the activation-spill sidecar checks."""
+    crc = 0
+    n = flat_u8.nbytes
+    for off in range(0, n, chunk):
+        crc = zlib.crc32(flat_u8[off : off + chunk], crc)
+    return f"{crc & 0xFFFFFFFF:08x}"
+
+
 def tensor_checksum(arr: np.ndarray) -> str:
     """crc32 (hex) over a tensor's raw contiguous bytes — the single
     checksum primitive shared by the manifest, the spill sidecars, and
-    the offline ``verify`` audit."""
-    return checksum_bytes(_raw_bytes(arr))
+    the offline ``verify`` audit. Computed chunked (see
+    :func:`checksum_chunked`) so hashing a large mmap view streams its
+    pages instead of demanding the whole buffer at once."""
+    return checksum_chunked(_raw_bytes(arr))
 
 
 def layer_entry(flat: dict[str, np.ndarray], file_name: str) -> dict:
@@ -183,6 +206,114 @@ def manifest_digest(manifest: dict | None) -> str:
     ).hexdigest()
 
 
+# -- crc verdict cache -------------------------------------------------------
+# One crc pass per FILE GENERATION instead of one per sweep: the streaming
+# regime re-reads every layer file once per full-model sweep (and the serve
+# loop sweeps indefinitely), but the bytes only change when the file does.
+# A verdict is recorded ONLY after a full verify_flat pass succeeded, keyed
+# by the layer file's (path, mtime_ns, size) AND the manifest file's own
+# (mtime_ns, size) — so a repaired shard, an in-place re-prepare, on-disk
+# rot (any write updates mtime), or a regenerated manifest each invalidate
+# automatically. Failures are never cached: a mismatch re-verifies on every
+# re-read, exactly as the heal/quarantine ladder requires. Chaos-injected
+# in-memory corruption bypasses the cache entirely (utils/checkpoint.py
+# only consults it when the injector did not fire), so seeded fault
+# schedules keep their per-load detection semantics.
+
+_VERDICT_CACHE: dict[tuple, tuple] = {}
+_VERDICT_LOCK = threading.Lock()
+_VERDICT_STATS = {"verdict_hits": 0, "full_verifies": 0}
+
+
+def _file_key(path: str) -> tuple[int, int] | None:
+    try:
+        st = os.stat(path)
+    except OSError:
+        return None
+    return (st.st_mtime_ns, st.st_size)
+
+
+def _verdict_key(model_dir: str, file_path: str) -> tuple | None:
+    fk = _file_key(file_path)
+    mk = _file_key(os.path.join(model_dir, MANIFEST_NAME))
+    if fk is None or mk is None:
+        return None
+    return (file_path, mk)
+
+
+def verdict_token(model_dir: str, file_path: str):
+    """Capture the verdict identity of ``file_path`` — (cache key, file
+    stat) — or None when either file can't be stat'ed. Callers take this
+    BEFORE reading the bytes they are about to verify and hand it back to
+    :func:`record_verdict`: the verdict then binds to the generation
+    actually read, so a concurrent atomic replacement cannot earn the NEW
+    file a clean verdict from the OLD file's bytes (the stale token's
+    stat no longer matches and the next load re-verifies)."""
+    key = _verdict_key(model_dir, file_path)
+    fk = _file_key(file_path)
+    if key is None or fk is None:
+        return None
+    return (key, fk)
+
+
+def verdict_cached(token) -> bool:
+    """True when the file generation ``token`` describes already passed a
+    full verify against the dir's manifest (counted as a verdict hit)."""
+    if token is None:
+        return False
+    key, fk = token
+    with _VERDICT_LOCK:
+        hit = _VERDICT_CACHE.get(key) == fk
+        if hit:
+            _VERDICT_STATS["verdict_hits"] += 1
+        return hit
+
+
+def record_verdict(token) -> None:
+    """Record a clean full-verify for the pre-read ``token``."""
+    if token is None:
+        return
+    key, fk = token
+    with _VERDICT_LOCK:
+        _VERDICT_CACHE[key] = fk
+
+
+def invalidate_verdict(file_path: str) -> None:
+    """Drop any cached verdicts for ``file_path`` (the loader's quarantine
+    hook — a quarantined path must re-verify from scratch after repair)."""
+    with _VERDICT_LOCK:
+        for key in [k for k in _VERDICT_CACHE if k[0] == file_path]:
+            del _VERDICT_CACHE[key]
+
+
+def count_full_verify() -> None:
+    with _VERDICT_LOCK:
+        _VERDICT_STATS["full_verifies"] += 1
+
+
+def verdict_stats() -> dict[str, int]:
+    """Process-wide hash-amortization counters: ``verdict_hits`` (loads
+    that skipped the crc pass on a cached clean verdict) and
+    ``full_verifies`` (full verify_flat passes actually run). Executors
+    snapshot deltas into their stats; the serve stats line carries them."""
+    with _VERDICT_LOCK:
+        return dict(_VERDICT_STATS)
+
+
+def reset_verdict_stats() -> None:
+    with _VERDICT_LOCK:
+        _VERDICT_STATS["verdict_hits"] = 0
+        _VERDICT_STATS["full_verifies"] = 0
+
+
+def reset_verdicts() -> None:
+    """Drop every cached verdict AND zero the counters (tests)."""
+    with _VERDICT_LOCK:
+        _VERDICT_CACHE.clear()
+        _VERDICT_STATS["verdict_hits"] = 0
+        _VERDICT_STATS["full_verifies"] = 0
+
+
 def verify_flat(
     layer_name: str,
     flat: dict[str, np.ndarray],
@@ -196,6 +327,7 @@ def verify_flat(
     manifest verifies vacuously on the load path (structural drift is the
     offline ``verify`` audit's job, where it fails with a precise diff).
     """
+    count_full_verify()
     entry = manifest.get("layers", {}).get(layer_name)
     if entry is None:
         return
@@ -275,12 +407,19 @@ __all__ = [
     "SpillCorruptError",
     "SpillReadError",
     "checksum_bytes",
+    "checksum_chunked",
+    "invalidate_verdict",
     "layer_entry",
     "load_manifest",
     "manifest_digest",
     "read_sidecar",
+    "record_verdict",
     "remove_sidecar",
+    "reset_verdict_stats",
     "tensor_checksum",
+    "verdict_cached",
+    "verdict_stats",
+    "verdict_token",
     "verify_flat",
     "write_manifest",
     "write_sidecar",

@@ -600,6 +600,7 @@ class DecodeGenerator:
         if self.weight_source_factory is not None:
             return (lambda: iter(self.weight_source_factory())), None
         from flexible_llm_sharding_tpu.faults.inject import FaultInjector
+        from flexible_llm_sharding_tpu.runtime import hostcache
 
         source = ShardWeightSource(
             self.cfg.model_path,
@@ -614,6 +615,10 @@ class DecodeGenerator:
             retry_policy=self.cfg.retry_policy(),
             injector=FaultInjector.from_config(self.cfg.faults),
             verify_weights=self.cfg.verify_weights,
+            # Multi-sweep decode is the offline cache sweet spot: every
+            # generated token past the first re-reads the same shards.
+            host_cache=hostcache.cache_for(self.cfg),
+            readahead_threads=self.cfg.readahead_threads,
         )
         it = iter(source)
         n_shards = len(self.shards)

@@ -365,6 +365,7 @@ class LongContextScorer:
         ``repeats`` times): a cold source per pass would re-read the
         checkpoint with no prefetch overlap between passes."""
         from flexible_llm_sharding_tpu.faults.inject import FaultInjector
+        from flexible_llm_sharding_tpu.runtime import hostcache
 
         return ShardWeightSource(
             self.cfg.model_path,
@@ -379,6 +380,9 @@ class LongContextScorer:
             retry_policy=self.cfg.retry_policy(),
             injector=FaultInjector.from_config(self.cfg.faults),
             verify_weights=self.cfg.verify_weights,
+            # One source per batch = one sweep per prompt: prompt 2+ hits.
+            host_cache=hostcache.cache_for(self.cfg),
+            readahead_threads=self.cfg.readahead_threads,
         )
 
     def __call__(self, prompts) -> list[np.ndarray]:

@@ -23,6 +23,7 @@ from flexible_llm_sharding_tpu.parallel.planner import (
     plan_shards_dp,
     split_prompts_dp,
 )
+from flexible_llm_sharding_tpu.runtime import hostcache
 from flexible_llm_sharding_tpu.runtime.executor import (
     BroadcastShardSource,
     SourceClosed,
@@ -268,6 +269,8 @@ def run_prompts(
         retry_policy=cfg.retry_policy(),
         injector=FaultInjector.from_config(cfg.faults),
         verify_weights=cfg.verify_weights,
+        host_cache=hostcache.cache_for(cfg),
+        readahead_threads=cfg.readahead_threads,
     )
 
     def run_one(slot: int) -> list[np.ndarray]:
@@ -427,6 +430,8 @@ def run_decode(
         retry_policy=cfg.retry_policy(),
         injector=FaultInjector.from_config(cfg.faults),
         verify_weights=cfg.verify_weights,
+        host_cache=hostcache.cache_for(cfg),
+        readahead_threads=cfg.readahead_threads,
     )
 
     def run_one(slot: int):

@@ -162,6 +162,7 @@ class PipelineRunner:
         stage_shards = [s for (_, _, s) in self.stages[start_stage:]]
         stage_devs = [self.devices[r] for (_, r, _) in self.stages[start_stage:]]
         from flexible_llm_sharding_tpu.faults.inject import FaultInjector
+        from flexible_llm_sharding_tpu.runtime import hostcache
 
         source = ShardWeightSource(
             self.cfg.model_path,
@@ -176,10 +177,14 @@ class PipelineRunner:
             retry_policy=self.cfg.retry_policy(),
             injector=FaultInjector.from_config(self.cfg.faults),
             verify_weights=self.cfg.verify_weights,
+            host_cache=hostcache.cache_for(self.cfg),
+            readahead_threads=self.cfg.readahead_threads,
         )
 
         n_layers = len(self.layer_names)
-        scores: dict[int, np.ndarray] = ScoreSink()
+        scores: dict[int, np.ndarray] = ScoreSink(
+            max_device=self.cfg.score_sink_max_device
+        )
         # Block metadata is uploaded per device on first use (jit operands
         # must be colocated with that stage's weights).
         host_meta = {

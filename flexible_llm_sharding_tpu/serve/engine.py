@@ -163,6 +163,14 @@ class ServeEngine:
         # every source this engine builds.
         self._injector = FaultInjector.from_config(cfg.faults)
         self._retry_policy = cfg.retry_policy()
+        # Host shard cache: the cycling source's steady-state sweeps hit it
+        # and skip disk read/parse/checksum entirely — and because the
+        # cache outlives any one source, a recovery's source restart warms
+        # instantly too. The stats line carries its hit rate.
+        from flexible_llm_sharding_tpu.runtime import hostcache
+
+        self._host_cache = hostcache.cache_for(cfg)
+        self.metrics.host_cache = self._host_cache
         self.queue = AdmissionQueue(
             self.serve_cfg.queue_capacity, metrics=self.metrics,
             injector=self._injector,
@@ -387,6 +395,8 @@ class ServeEngine:
             retry_recorder=self.metrics.retries,
             integrity_recorder=self.metrics.integrity,
             verify_weights=self.cfg.verify_weights,
+            host_cache=self._host_cache,
+            readahead_threads=self.cfg.readahead_threads,
         )
 
     def _acquire_weights(self) -> None:

@@ -783,18 +783,40 @@ def load_layer(
     ``corrupt``: chaos-only hook (``FaultInjector.corrupt_flat``) applied
     to the raw flat tensors BEFORE verification, so injected silent
     corruption is exactly what the checksums must catch."""
-    flat = _mmap_safetensors(
-        os.path.join(model_path, f"{layer_name}{LAYER_FILE_SUFFIX}")
+    path = os.path.join(model_path, f"{layer_name}{LAYER_FILE_SUFFIX}")
+    # Verdict identity captured BEFORE the read, so a verify result can
+    # only ever be recorded against the generation actually read.
+    token = (
+        integrity_manifest.verdict_token(model_path, path)
+        if manifest is not None
+        else None
     )
+    flat = raw = _mmap_safetensors(path)
+    # Re-stat AFTER the mmap: pre==post brackets the mapping, proving the
+    # bytes belong to the generation the token names. On drift (the file
+    # was atomically replaced mid-load) the cached verdict of the OLD
+    # generation must not vouch for the NEW bytes — drop the token, which
+    # forces a full verify of this load and records nothing.
+    if token is not None and (
+        integrity_manifest.verdict_token(model_path, path) != token
+    ):
+        token = None
     if corrupt is not None:
         flat = corrupt(flat)
     if manifest is not None:
-        integrity_manifest.verify_flat(
-            layer_name,
-            flat,
-            manifest,
-            path=os.path.join(model_path, f"{layer_name}{LAYER_FILE_SUFFIX}"),
-        )
+        # Amortized hashing: a file generation is crc-verified ONCE, then
+        # later sweeps reuse the cached clean verdict keyed by the file's
+        # and the manifest's stat (any on-disk change invalidates). The
+        # cache is bypassed whenever the chaos injector actually corrupted
+        # this load (corrupt_flat returns a COPY then) — injected in-memory
+        # corruption must be caught by a real checksum pass every time.
+        injected = flat is not raw
+        if injected or not integrity_manifest.verdict_cached(token):
+            integrity_manifest.verify_flat(
+                layer_name, flat, manifest, path=path
+            )
+            if not injected:
+                integrity_manifest.record_verdict(token)
     if not _is_native(flat.keys()):
         flat = hf_layer_to_native(layer_name, flat)
     if any(k.endswith((QUANT_SCALE_SUFFIX, QUANT4_SCALE_SUFFIX)) for k in flat):

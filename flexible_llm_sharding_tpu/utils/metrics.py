@@ -344,6 +344,11 @@ class ServingMetrics:
         # quarantines) for the same stream — nonzero counters appear in
         # the stats line under "integrity".
         self.integrity = IntegrityRecorder()
+        # Host shard cache (runtime/hostcache.py) attached by the serving
+        # engine: the stats line carries its hit rate and counters so an
+        # operator can see the warm-sweep fast path engaging (and CI can
+        # grep a nonzero host_cache_hit_rate from the smoke).
+        self.host_cache = None
 
     def count(self, name: str, n: int = 1) -> None:
         with self._lock:
@@ -380,6 +385,10 @@ class ServingMetrics:
             out["io_retries"] = retries
         if any(integrity.values()):
             out["integrity"] = integrity
+        if self.host_cache is not None:
+            cache = self.host_cache.stats()
+            out["host_cache_hit_rate"] = cache["hit_rate"]
+            out["host_cache"] = cache
         return out
 
     def emit(self) -> None:

@@ -143,6 +143,8 @@ class FilePrefetcher:
     """
 
     def __init__(self, threads: int = 2):
+        import threading
+
         lib = _load_lib()
         self._lib = lib
         self._handle = lib.fp_create(threads) if lib is not None else None
@@ -150,17 +152,34 @@ class FilePrefetcher:
             None if lib is not None else ThreadPoolExecutor(max_workers=threads)
         )
         self._futures: list = []
+        # Serializes handle/pool use against close(): an abandoned
+        # producer thread may still call prefetch() while close() runs —
+        # without the lock the native arm could fp_prefetch a handle
+        # fp_destroy just freed (use-after-free in the C++ pool).
+        self._close_lock = threading.Lock()
 
     @property
     def native(self) -> bool:
         return self._handle is not None
 
     def prefetch(self, *paths: str) -> None:
-        for p in paths:
-            if self._handle is not None:
-                self._lib.fp_prefetch(self._handle, p.encode())
-            else:
-                self._futures.append(self._pool.submit(self._py_warm, p))
+        # No-op after close(): an abandoned producer thread (a source's
+        # bounded close gave up joining it) may still issue warms; readahead
+        # is advisory, so dropping them is correct — crashing is not. The
+        # lock fences BOTH arms against a concurrent close (native: the
+        # handle must not be destroyed mid-call; python: the pool must not
+        # shut down mid-submit).
+        with self._close_lock:
+            for p in paths:
+                if self._handle is not None:
+                    self._lib.fp_prefetch(self._handle, p.encode())
+                elif self._pool is not None:
+                    try:
+                        self._futures.append(
+                            self._pool.submit(self._py_warm, p)
+                        )
+                    except RuntimeError:  # pool shut down concurrently
+                        return
 
     @staticmethod
     def _py_warm(path: str) -> None:
@@ -177,20 +196,22 @@ class FilePrefetcher:
             pass  # loader will raise the real error on its own read
 
     def wait_all(self) -> None:
-        if self._handle is not None:
-            self._lib.fp_wait_all(self._handle)
-        else:
-            for f in self._futures:
-                f.result()
-            self._futures.clear()
+        with self._close_lock:
+            if self._handle is not None:
+                self._lib.fp_wait_all(self._handle)
+            else:
+                for f in self._futures:
+                    f.result()
+                self._futures.clear()
 
     def close(self) -> None:
-        if self._handle is not None:
-            self._lib.fp_destroy(self._handle)
-            self._handle = None
-        elif self._pool is not None:
-            self._pool.shutdown(wait=False)
-            self._pool = None
+        with self._close_lock:
+            if self._handle is not None:
+                self._lib.fp_destroy(self._handle)
+                self._handle = None
+            elif self._pool is not None:
+                self._pool.shutdown(wait=False)
+                self._pool = None
 
     def __del__(self):  # best-effort; close() is the real API
         try:

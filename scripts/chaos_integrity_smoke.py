@@ -140,6 +140,43 @@ def main() -> int:
         print("FAIL: serve stats report no reread_heals", file=sys.stderr)
         return 1
     print(f"serve under corrupt_shard: token-identical, reread_heals={heals}")
+
+    # 3) Serving with the HOST SHARD CACHE enabled (explicit budget; auto
+    # resolves off under chaos so the fault sites above kept firing): two
+    # rounds make every round-2 sweep a cache hit, the outputs must stay
+    # token-identical, and the stats line must carry a nonzero
+    # host_cache_hit_rate — the operator-visible witness of the warm-sweep
+    # fast path (CI greps it from the line printed below).
+    engine = ServeEngine(
+        _cfg(model_dir, host_cache_gb=1.0),
+        ServeConfig(max_wave_requests=2, default_max_new_tokens=1),
+        tokenizer=FakeTokenizer(),
+    )
+    try:
+        for _ in range(2):
+            reqs = [engine.submit(p, s) for p, s in PROMPTS]
+            results = [r.future.result(timeout=600) for r in reqs]
+            for res, want in zip(results, clean):
+                if not (res.scores.argmax(-1) == want.argmax(-1)).all():
+                    print(
+                        "FAIL: cached serve output diverged", file=sys.stderr
+                    )
+                    return 1
+    finally:
+        engine.shutdown(drain=True)
+    if engine.error is not None:
+        print(f"FAIL: cached engine error {engine.error!r}", file=sys.stderr)
+        return 1
+    stats = engine.stats()
+    print(json.dumps(stats))  # cache stats line CI greps
+    hit_rate = stats.get("host_cache_hit_rate", 0)
+    if not hit_rate:
+        print(
+            "FAIL: serve stats report no host_cache_hit_rate",
+            file=sys.stderr,
+        )
+        return 1
+    print(f"serve with host shard cache: token-identical, hit_rate={hit_rate}")
     return 0
 
 
