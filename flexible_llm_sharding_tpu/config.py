@@ -917,6 +917,20 @@ class FrameworkConfig:
     # Entries are stat-guarded and invalidated on quarantine/manifest
     # change, so PR 4's corruption self-healing is unaffected.
     host_cache_gb: float | None = None
+    # Device residency tier (runtime/residency.py): HBM byte budget for
+    # pinning the hottest layers (embedding, lm_head, final norm, then as
+    # many transformer blocks as fit) permanently on chip — pinned layers
+    # are subtracted from every sweep's weight stream, cutting the
+    # host->HBM link traffic by exactly their bytes while outputs stay
+    # token-identical. None = auto: measured free HBM minus an activation
+    # headroom (ACTIVATION_HEADROOM_FRACTION), OFF under fault injection
+    # (chaos schedules must keep their per-load draws; an explicit budget
+    # still wins) and on chips with unknown HBM. 0 (default) disables.
+    # Pins are loaded once through the manifest-verified path and survive
+    # serving source restarts and wave recoveries; a pin-time load whose
+    # corruption survives every re-read is demoted back to streaming, so
+    # wrong bytes are never resident.
+    hbm_pin_gb: float | None = 0.0
     # Threads in the loader's page-cache readahead pool
     # (utils/native.py FilePrefetcher — posix_fadvise(WILLNEED) issuers,
     # ~zero CPU each; more threads help deep dirs on high-QD storage).
@@ -986,6 +1000,11 @@ class FrameworkConfig:
                 "host_cache_gb must be >= 0 (or None for auto), got "
                 f"{self.host_cache_gb}"
             )
+        if self.hbm_pin_gb is not None and self.hbm_pin_gb < 0:
+            raise ValueError(
+                "hbm_pin_gb must be >= 0 (or None for auto), got "
+                f"{self.hbm_pin_gb}"
+            )
         if self.readahead_threads < 1:
             raise ValueError("readahead_threads must be >= 1")
         if self.score_sink_max_device < 1:
@@ -1010,6 +1029,26 @@ class FrameworkConfig:
         )
 
         return auto_budget_bytes()
+
+    def effective_hbm_pin_bytes(self, device=None) -> int:
+        """Resolve the tri-state ``hbm_pin_gb`` to a pin-tier byte budget.
+
+        Explicit value -> that many GB (0 = off). None (auto) -> measured
+        free HBM minus the activation headroom
+        (residency.auto_pin_budget_bytes) — except under fault injection,
+        where auto resolves to OFF: pinned layers skip the per-sweep load
+        path, silently starving a seeded chaos schedule of its draws (an
+        EXPLICIT budget still wins, for chaos pin-parity tests). Unknown
+        HBM (the CPU backend, unrecognized chips) also resolves to off."""
+        if self.hbm_pin_gb is not None:
+            return int(self.hbm_pin_gb * 1e9)
+        if self.faults.enabled:
+            return 0
+        from flexible_llm_sharding_tpu.runtime.residency import (
+            auto_pin_budget_bytes,
+        )
+
+        return auto_pin_budget_bytes(device)
 
     def retry_policy(self):
         """The transient-I/O RetryPolicy for this run's weight stream

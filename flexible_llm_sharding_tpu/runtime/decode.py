@@ -600,8 +600,22 @@ class DecodeGenerator:
         if self.weight_source_factory is not None:
             return (lambda: iter(self.weight_source_factory())), None
         from flexible_llm_sharding_tpu.faults.inject import FaultInjector
-        from flexible_llm_sharding_tpu.runtime import hostcache
+        from flexible_llm_sharding_tpu.runtime import hostcache, residency
 
+        # Partial residency: moot in resident mode (every placed shard is
+        # already kept on chip); in the streaming regime — the one the
+        # tier exists for — every decode step's sweep skips the pinned
+        # layers' link bytes.
+        tier = (
+            None
+            if self._resident
+            else residency.tier_for(
+                self.cfg,
+                self.layer_names,
+                self.model_cfg.tie_word_embeddings,
+                self._probe_dev,
+            )
+        )
         source = ShardWeightSource(
             self.cfg.model_path,
             self.layer_names,
@@ -619,6 +633,7 @@ class DecodeGenerator:
             # generated token past the first re-reads the same shards.
             host_cache=hostcache.cache_for(self.cfg),
             readahead_threads=self.cfg.readahead_threads,
+            residency=tier,
         )
         it = iter(source)
         n_shards = len(self.shards)

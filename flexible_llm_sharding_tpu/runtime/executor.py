@@ -498,14 +498,10 @@ class _HostShardLoader:
         )
 
     def _layer_file(self, name: str) -> str:
-        """The file a layer name actually reads (tied lm_head re-reads the
-        embedding file) — the quarantine key."""
-        fname = (
-            "model.embed_tokens" if (name == "lm_head" and self.tied) else name
-        )
-        return os.path.join(
-            self.model_path, f"{fname}{checkpoint.LAYER_FILE_SUFFIX}"
-        )
+        """The file a layer name actually reads — the quarantine key (and,
+        via the same shared mapping, the residency planner's byte
+        estimates)."""
+        return checkpoint.layer_file_for(self.model_path, name, self.tied)
 
     def _load_one(self, name: str) -> Params:
         path = self._layer_file(name)
@@ -926,6 +922,55 @@ def _place(
     return out
 
 
+def _stream_only(idxs, pinned: frozenset) -> tuple[int, ...]:
+    """A shard's still-streamed layer idxs (readahead targets) — shared by
+    both sources so the pin-subtraction rule can't drift between them."""
+    return tuple(i for i in idxs if i not in pinned)
+
+
+def _split_parts(
+    loader: _HostShardLoader, layer_idxs: tuple[int, ...], pinned: frozenset
+) -> list[tuple[str, Any]]:
+    """One shard's build, partial-residency aware: ``[("stream", host_
+    segments) | ("pin", idx), ...]`` in layer order. Only the streamed
+    runs touch disk; pinned layers contribute a marker that
+    ``_assemble_parts`` resolves to the tier's resident placed segments.
+    With no pins this is exactly one ("stream", build_host_shard(idxs))
+    part — the pre-residency fast path, byte for byte."""
+    if not pinned or not any(i in pinned for i in layer_idxs):
+        return [("stream", loader.build_host_shard(tuple(layer_idxs)))]
+    parts: list[tuple[str, Any]] = []
+    run: list[int] = []
+    for i in layer_idxs:
+        if i in pinned:
+            if run:
+                parts.append(("stream", loader.build_host_shard(tuple(run))))
+                run = []
+            parts.append(("pin", i))
+        else:
+            run.append(i)
+    if run:
+        parts.append(("stream", loader.build_host_shard(tuple(run))))
+    return parts
+
+
+def _assemble_parts(
+    parts, device, np_dtype, residency, loader
+) -> list[tuple[str, Any]]:
+    """Place the streamed runs and merge the pinned layers' resident
+    segments back at their positions — the full shard's segment list in
+    layer order, exactly what an unpinned ``_place(build_host_shard(...))``
+    would have produced (same trees, same order; the pinned ones just
+    weren't re-read or re-uploaded)."""
+    out: list[tuple[str, Any]] = []
+    for kind, val in parts:
+        if kind == "stream":
+            out.extend(_place(val, device, np_dtype=np_dtype))
+        else:
+            out.extend(residency.segments(val, device, loader))
+    return out
+
+
 class ShardWeightSource:
     """Loads shard weights disk -> host -> HBM, optionally prefetching ahead.
 
@@ -964,7 +1009,14 @@ class ShardWeightSource:
         verify_weights: bool = True,
         host_cache=None,
         readahead_threads: int = 2,
+        residency=None,
     ):
+        # residency: a runtime.residency.DeviceResidencyTier (or None) —
+        # pinned layers are subtracted from every shard build (their bytes
+        # never cross the link) and merged back as resident segments at
+        # placement. The pin set is FROZEN here so this source's segment
+        # structure can never change mid-life (a serving wave's prefill
+        # and decode must agree on it).
         self.shards = list(shards)
         # Either one device for every shard, or (pipeline mode) one target
         # device per shard — shard t's weights upload straight to its stage's
@@ -987,6 +1039,16 @@ class ShardWeightSource:
             integrity=integrity_recorder, verify_weights=verify_weights,
             host_cache=host_cache, readahead_threads=readahead_threads,
         )
+        self._residency = residency
+        self._pinned_idxs: frozenset = frozenset()
+        if residency is not None:
+            # Pre-pin (verified load + placement) BEFORE the producer
+            # thread starts; a pin that fails persistently demotes the
+            # layer back to streaming, where its typed error surfaces
+            # through the normal fault envelopes.
+            for idxs, dev in zip(self.shards, self.shard_devices):
+                residency.ensure_pinned(self._loader, dev, idxs)
+            self._pinned_idxs = residency.frozen_pinned(self.shards)
         self.produce_time = 0.0  # set BEFORE the producer thread starts
         self._q: Queue = Queue(maxsize=max(1, prefetch_depth))
         self._close_lock = threading.Lock()  # close() may race abort()/close()
@@ -1056,6 +1118,9 @@ class ShardWeightSource:
     def host_casts(self) -> int:
         return self._loader.host_casts
 
+    def _stream_only(self, idxs) -> tuple[int, ...]:
+        return _stream_only(idxs, self._pinned_idxs)
+
     def _build_shard(
         self, layer_idxs: tuple[int, ...], device
     ) -> list[tuple[str, Any]]:
@@ -1066,7 +1131,13 @@ class ShardWeightSource:
         # like with like; load_time alone under-counts what overlap must
         # hide on a slow host->HBM link).
         t0 = time.perf_counter()
-        host = self._loader.build_host_shard(layer_idxs)
+        parts = _split_parts(self._loader, layer_idxs, self._pinned_idxs)
+        if self._residency is not None:
+            # Count the sweep's saved link bytes ONCE per build (the put
+            # below may retry; retries must not double-count).
+            for kind, val in parts:
+                if kind == "pin":
+                    self._residency.note_skip(val)
 
         # The host->device put retries under the same policy as the reads:
         # through a wedged accelerator tunnel the transfer surfaces
@@ -1075,7 +1146,10 @@ class ShardWeightSource:
         def put():
             if self._injector is not None:
                 self._injector.fire("device_put", detail=str(layer_idxs))
-            return _place(host, device, np_dtype=self._loader.np_dtype)
+            return _assemble_parts(
+                parts, device, self._loader.np_dtype, self._residency,
+                self._loader,
+            )
 
         out = retry_call(
             put,
@@ -1114,13 +1188,14 @@ class ShardWeightSource:
                 if self._stop.is_set():
                     return
                 try:
-                    # Readahead the next shard's files; in cycle mode the
+                    # Readahead the next shard's files (pinned layers never
+                    # re-read, so they are skipped); in cycle mode the
                     # sweep wraps, so the last shard warms shard 0 again.
                     nxt = i + 1
                     if nxt < len(self.shards):
-                        self._loader.warm(self.shards[nxt])
+                        self._loader.warm(self._stream_only(self.shards[nxt]))
                     elif self.cycle:
-                        self._loader.warm(self.shards[0])
+                        self._loader.warm(self._stream_only(self.shards[0]))
                     item = self._build_shard(idxs, dev)
                 except Exception as e:
                     # Surface to the consumer at this shard's position, but
@@ -1162,7 +1237,7 @@ class ShardWeightSource:
                     if self._stop.is_set():
                         return
                     if i + 1 < len(self.shards):
-                        self._loader.warm(self.shards[i + 1])
+                        self._loader.warm(self._stream_only(self.shards[i + 1]))
                     yield idxs, self._build_shard(idxs, dev)
                 if not self.cycle:
                     return
@@ -1211,6 +1286,7 @@ class BroadcastShardSource:
         verify_weights: bool = True,
         host_cache=None,
         readahead_threads: int = 2,
+        residency=None,
     ):
         self.shards = list(shards)
         self.devices = list(devices)
@@ -1223,6 +1299,21 @@ class BroadcastShardSource:
             integrity=integrity_recorder, verify_weights=verify_weights,
             host_cache=host_cache, readahead_threads=readahead_threads,
         )
+        # Partial residency over a broadcast: each DP chip holds its own
+        # pinned copies (pinned once per chip, process lifetime); the ONE
+        # host build per shard then skips the pinned layers' disk work and
+        # every chip's upload skips their link bytes.
+        self._residency = residency
+        self._pinned_idxs: frozenset = frozenset()
+        if residency is not None:
+            # Read-once pre-pin: ONE host build per pinned layer, placed
+            # on every DP chip — the same convention as the stream below.
+            residency.ensure_pinned_broadcast(
+                self._loader,
+                self.devices,
+                sorted({i for s in self.shards for i in s}),
+            )
+            self._pinned_idxs = residency.frozen_pinned(self.shards)
         depth = max(1, prefetch_depth)
         self._queues = [Queue(maxsize=depth) for _ in self.devices]
         self._thread = threading.Thread(target=self._producer, daemon=True)
@@ -1250,8 +1341,19 @@ class BroadcastShardSource:
                     return
                 try:
                     if i + 1 < len(self.shards):
-                        self._loader.warm(self.shards[i + 1])
-                    host = self._loader.build_host_shard(idxs)
+                        self._loader.warm(
+                            _stream_only(self.shards[i + 1], self._pinned_idxs)
+                        )
+                    parts = _split_parts(
+                        self._loader, tuple(idxs), self._pinned_idxs
+                    )
+                    if self._residency is not None:
+                        # Saved bytes counted once per HOST build — the
+                        # same convention as streamed_bytes (one host
+                        # build serves every DP chip).
+                        for kind, val in parts:
+                            if kind == "pin":
+                                self._residency.note_skip(val)
                 except Exception as e:
                     # Broadcast streams are offline (one DP run): every rank
                     # sees the failure and the run fails, so no per-shard
@@ -1263,9 +1365,16 @@ class BroadcastShardSource:
                 for rank, dev in enumerate(self.devices):
                     # device_put is async — the transfers to the N chips
                     # overlap each other and the chips' compute.
-                    if not self._put(
-                        rank, _place(host, dev, np_dtype=self._loader.np_dtype)
-                    ):
+                    try:
+                        item = _assemble_parts(
+                            parts, dev, self._loader.np_dtype,
+                            self._residency, self._loader,
+                        )
+                    except Exception as e:
+                        for r2 in range(len(self.devices)):
+                            self._put(r2, _ShardFault(e))
+                        return
+                    if not self._put(rank, item):
                         return
 
     def view(self, rank: int) -> "_BroadcastView":
@@ -1434,6 +1543,14 @@ class StreamingExecutor:
                 "order with no empty shards (DP/single-device); use the MP "
                 "pipeline runner for interleaved stage plans"
             )
+        # Device residency tier (runtime/residency.py): layers pinned in
+        # HBM are subtracted from every sweep's stream — None when the
+        # budget resolves to 0 (hbm_pin_gb=0, chaos auto-off, unknown HBM).
+        from flexible_llm_sharding_tpu.runtime import residency
+
+        self._residency = residency.tier_for(
+            cfg, self.layer_names, self.model_cfg.tie_word_embeddings, device
+        )
         self.stats: dict[str, float] = {}
         # One stats dict per executor call, in call order — callers that run
         # several batches (or DP ranks) aggregate from here rather than from
@@ -1533,6 +1650,11 @@ class StreamingExecutor:
         verdict_before = (
             integrity_manifest.verdict_stats() if own_source else None
         )
+        residency_before = (
+            self._residency.stats()
+            if (self._residency is not None and own_source)
+            else None
+        )
         if self.weight_source_factory is not None:
             # Shared (DP broadcast) source: it streams EVERY shard to every
             # chip — a resuming rank cannot slice the stream, so it consumes
@@ -1563,6 +1685,7 @@ class StreamingExecutor:
                 verify_weights=self.cfg.verify_weights,
                 host_cache=self._host_cache,
                 readahead_threads=self.cfg.readahead_threads,
+                residency=self._residency,
             )
             skip = 0
             # Baseline taken BEFORE the source's prefetch producer starts
@@ -1661,6 +1784,14 @@ class StreamingExecutor:
             if getattr(source, "load_time_shared", False):
                 self.stats["streamed_bytes_shared"] = 1.0
         peak = metrics.peak_hbm_gb(self.device)
+        if self._residency is not None:
+            # HBM accounting honesty: the pin tier is device-resident for
+            # the whole run, so the reported peak can never sit below it —
+            # including on backends whose allocator reports no stats,
+            # where the tier's own bytes become the floor figure.
+            pinned_gb = self._residency.pinned_device_bytes(self.device) / 1e9
+            if pinned_gb:
+                peak = max(peak or 0.0, pinned_gb)
         if peak is not None:
             self.stats["peak_hbm_gb"] = peak
         io_retries = self._retry_recorder.total("retries")
@@ -1698,6 +1829,25 @@ class StreamingExecutor:
                 delta = v_after[key] - verdict_before[key]
                 if delta:
                     self.stats[f"crc_{key}"] = float(delta)
+        if residency_before is not None:
+            # Partial-residency accounting over THIS call's window: every
+            # sweep's streamed_bytes drops by exactly the pinned layers'
+            # host bytes; the saved traffic is reported alongside so the
+            # drop can be audited (pinned_bytes is the resident HBM cost
+            # on this executor's placement target).
+            r_after = self._residency.stats()
+            self.stats["pinned_bytes"] = float(
+                self._residency.pinned_device_bytes(self.device)
+            )
+            saved = (
+                r_after["stream_bytes_saved"]
+                - residency_before["stream_bytes_saved"]
+            )
+            hits = r_after["pin_hits"] - residency_before["pin_hits"]
+            if saved:
+                self.stats["stream_bytes_saved"] = float(saved)
+            if hits:
+                self.stats["pin_hits"] = float(hits)
         host_casts = getattr(source, "host_casts", None)
         if host_casts:
             # Host-side dtype casts the stream could NOT defer to the chip

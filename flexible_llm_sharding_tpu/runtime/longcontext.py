@@ -365,7 +365,7 @@ class LongContextScorer:
         ``repeats`` times): a cold source per pass would re-read the
         checkpoint with no prefetch overlap between passes."""
         from flexible_llm_sharding_tpu.faults.inject import FaultInjector
-        from flexible_llm_sharding_tpu.runtime import hostcache
+        from flexible_llm_sharding_tpu.runtime import hostcache, residency
 
         return ShardWeightSource(
             self.cfg.model_path,
@@ -383,6 +383,15 @@ class LongContextScorer:
             # One source per batch = one sweep per prompt: prompt 2+ hits.
             host_cache=hostcache.cache_for(self.cfg),
             readahead_threads=self.cfg.readahead_threads,
+            # Pins replicate over the sp mesh (placement_key keys on the
+            # mesh's chips + spec, so a scorer rebuilt per batch reuses
+            # the same resident copies instead of re-pinning).
+            residency=residency.tier_for(
+                self.cfg,
+                self.layer_names,
+                self.model_cfg.tie_word_embeddings,
+                residency.probe_chip(self.mesh),
+            ),
         )
 
     def __call__(self, prompts) -> list[np.ndarray]:

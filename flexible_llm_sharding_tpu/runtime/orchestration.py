@@ -23,7 +23,7 @@ from flexible_llm_sharding_tpu.parallel.planner import (
     plan_shards_dp,
     split_prompts_dp,
 )
-from flexible_llm_sharding_tpu.runtime import hostcache
+from flexible_llm_sharding_tpu.runtime import hostcache, residency
 from flexible_llm_sharding_tpu.runtime.executor import (
     BroadcastShardSource,
     SourceClosed,
@@ -79,6 +79,9 @@ def _gather_dp(pool: ThreadPoolExecutor, futures, source) -> list:
     finally:
         source.close()
         pool.shutdown(wait=True)
+
+
+_probe_chip = residency.probe_chip
 
 
 def _run_batched(ex: StreamingExecutor, prompts: list[Prompt], num_batch: int):
@@ -264,6 +267,13 @@ def run_prompts(
         prefetch_depth=cfg.effective_prefetch_depth(),
         tied_embeddings=model_cfg.tie_word_embeddings,
         rounds=cfg.num_batch,
+        residency=residency.tier_for(
+            cfg, layer_names, model_cfg.tie_word_embeddings,
+            # active is non-empty here (run_prompts early-returns on
+            # empty prompts); the fallback keeps an all-inactive split
+            # from a future caller at a rank-0 probe, not an IndexError.
+            _probe_chip(targets[active[0]] if active else targets[0]),
+        ),
         layer_sliding=model_cfg.layer_sliding,
         layer_rope=model_cfg.layer_rope,
         retry_policy=cfg.retry_policy(),
@@ -414,7 +424,7 @@ def run_decode(
     resident = cfg.decode_resident_enabled(
         model_cfg,
         t0.mesh.devices.size if hasattr(t0, "segment_target") else 1,
-        next(iter(t0.mesh.devices.flat)) if hasattr(t0, "mesh") else t0,
+        _probe_chip(t0),
     )
     source = BroadcastShardSource(
         cfg.model_path,
@@ -425,6 +435,17 @@ def run_decode(
         prefetch_depth=cfg.effective_prefetch_depth(),
         tied_embeddings=model_cfg.tie_word_embeddings,
         rounds=1 if resident else cfg.num_gen_token,
+        # Residency is moot once the decode is fully resident (one
+        # broadcast round, shards kept on chip); in the streaming regime
+        # every per-token round skips the pinned layers' bytes.
+        residency=(
+            None
+            if resident
+            else residency.tier_for(
+                cfg, layer_names, model_cfg.tie_word_embeddings,
+                _probe_chip(targets[active[0]]),
+            )
+        ),
         layer_sliding=model_cfg.layer_sliding,
         layer_rope=model_cfg.layer_rope,
         retry_policy=cfg.retry_policy(),

@@ -162,8 +162,17 @@ class PipelineRunner:
         stage_shards = [s for (_, _, s) in self.stages[start_stage:]]
         stage_devs = [self.devices[r] for (_, r, _) in self.stages[start_stage:]]
         from flexible_llm_sharding_tpu.faults.inject import FaultInjector
-        from flexible_llm_sharding_tpu.runtime import hostcache
+        from flexible_llm_sharding_tpu.runtime import hostcache, residency
 
+        # Partial residency over the pipeline: a pinned layer stays on its
+        # STAGE's chip (ensure_pinned runs per (shard, stage device) pair
+        # inside the source), so each stage's sweep skips its own pins.
+        tier = residency.tier_for(
+            self.cfg,
+            self.layer_names,
+            self.model_cfg.tie_word_embeddings,
+            self.devices[0],
+        )
         source = ShardWeightSource(
             self.cfg.model_path,
             self.layer_names,
@@ -179,6 +188,7 @@ class PipelineRunner:
             verify_weights=self.cfg.verify_weights,
             host_cache=hostcache.cache_for(self.cfg),
             readahead_threads=self.cfg.readahead_threads,
+            residency=tier,
         )
 
         n_layers = len(self.layer_names)
@@ -280,6 +290,10 @@ class PipelineRunner:
             "num_stages": float(len(self.stages)),
             "tokens_processed": float(sum(t.tokens_processed for t in toks)),
         }
+        if tier is not None:
+            rs = tier.stats()
+            # Process-wide gauge (per-stage pins sum across the chips).
+            self.stats["pinned_bytes"] = float(rs["pinned_bytes"])
         store.clear()
         return [scores[i] for i in range(len(prompts))]
 

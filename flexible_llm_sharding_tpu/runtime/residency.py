@@ -1,0 +1,631 @@
+"""Device residency tier: pin the hottest layers in HBM, stream the rest.
+
+The architecture's defining cost is that every sweep streams the whole
+model through the host->HBM link (PAPER.md §0: the loop inversion) while
+the chip's HBM sits nearly empty — the resident-vs-streaming gate was
+all-or-nothing (``config.decode_resident``). This module spends leftover
+HBM on a *partial* residency tier: given a byte budget
+(``FrameworkConfig.hbm_pin_gb``), a planner selects the layers with the
+highest streamed-bytes-per-sweep — the always-hot non-decoder layers
+(embedding, lm_head, final norm) first, then as many transformer blocks
+as fit — loads them ONCE through the existing manifest-verified loader
+path, and keeps them device-resident for the process lifetime. Every
+shard source subtracts pinned layers from its builds: their bytes never
+cross the link again, and the forward pass sees them merged back into the
+shard's segment list at placement (consumers already iterate per-segment,
+so a pinned layer is just one more pre-placed segment).
+
+Safety model (mirrors ``runtime/hostcache.py``):
+
+- Pins are loaded via ``_HostShardLoader.build_host_shard`` — retried,
+  checksum-verified, re-read-healed, and chaos-injected exactly like a
+  streamed load. A pinned tree is *verified-clean by construction*.
+- A load whose corruption survives every re-read is NEVER pinned: the
+  layer is demoted back to streaming (where the quarantine's typed error
+  surfaces through the normal degrade machinery) instead of poisoning a
+  resident copy for the process lifetime.
+- The pin set is frozen per source at construction, so a wave's prefill
+  and its decode steps always see the same segment structure.
+- Budget precedence follows the host cache's rule: an EXPLICIT
+  ``hbm_pin_gb`` pins the cap (a later auto-config component in the same
+  process cannot grow it); an auto budget only ever grows an auto-sized
+  tier; auto resolves to OFF under fault injection (chaos schedules must
+  keep their per-load draws) and on chips with unknown HBM.
+
+Accounting honesty: pinned bytes are device-resident for the whole run,
+so ``peak_hbm_gb`` figures are floored at the pin tier's bytes and the
+serve stats line carries ``pinned_bytes`` / ``stream_bytes_saved`` —
+the low-memory claim can never silently exclude the tier.
+
+Budget caveat: layers are charged at their on-disk (streamed) size. For
+int4/int8 checkpoints the pinned copy dequantizes to the compute dtype on
+placement (2-8x the packed bytes in HBM) — leave headroom accordingly
+(docs/residency.md).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+from typing import Sequence
+
+from flexible_llm_sharding_tpu.utils import checkpoint
+
+# Auto budget: fraction of the chip's TOTAL HBM held back for activations,
+# KV caches, the prefetch queue, and XLA scratch — the pin tier only
+# spends what is left of the measured free HBM after this headroom.
+ACTIVATION_HEADROOM_FRACTION = 0.35
+
+
+def layer_stream_bytes(
+    model_path: str, layer_names: Sequence[str], tied_embeddings: bool = False
+) -> dict[int, int]:
+    """Estimated streamed bytes per sweep per layer, from the layer files'
+    on-disk size — what ``build_host_shard`` reads and re-uploads every
+    sweep (quantized layers travel packed, so file size is the honest
+    per-sweep link proxy). The name->file mapping is the loader's own
+    (``checkpoint.layer_file_for``), so the estimates cannot desync from
+    what actually streams. Unreadable files count 0 (and are never
+    planned)."""
+    out: dict[int, int] = {}
+    for i, name in enumerate(layer_names):
+        try:
+            out[i] = os.path.getsize(
+                checkpoint.layer_file_for(model_path, name, tied_embeddings)
+            )
+        except OSError:
+            out[i] = 0
+    return out
+
+
+@dataclasses.dataclass(frozen=True)
+class ResidencyPlan:
+    """Which layers a byte budget pins, and what each saves per sweep."""
+
+    budget_bytes: int
+    pinned: tuple[int, ...]  # layer idxs, execution order
+    layer_bytes: tuple[tuple[int, int], ...]  # (idx, est streamed bytes)
+    skipped: tuple[int, ...]  # considered but didn't fit the budget
+
+    @property
+    def pinned_set(self) -> frozenset:
+        return frozenset(self.pinned)
+
+    @property
+    def pinned_bytes_est(self) -> int:
+        sizes = dict(self.layer_bytes)
+        return sum(sizes[i] for i in self.pinned)
+
+    @property
+    def total_bytes_est(self) -> int:
+        return sum(b for _, b in self.layer_bytes)
+
+    @property
+    def pinned_fraction(self) -> float:
+        total = self.total_bytes_est
+        return self.pinned_bytes_est / total if total else 0.0
+
+
+def plan_residency(
+    model_path: str,
+    layer_names: Sequence[str],
+    budget_bytes: int,
+    tied_embeddings: bool = False,
+) -> ResidencyPlan:
+    """Greedy selection under the byte budget.
+
+    Priority order: the always-hot non-decoder layers first (embedding,
+    lm_head, final norm — they run every sweep AND bracket every decode
+    step's embed/head hops), then transformer blocks by descending
+    streamed bytes (stable by layer index on ties — for the usual uniform
+    blocks that is simply the first N). A layer that does not fit is
+    skipped and the scan continues: smaller later layers may still fit
+    (greedy knapsack, never an error)."""
+    sizes = layer_stream_bytes(model_path, layer_names, tied_embeddings)
+
+    def tier(i: int) -> int:
+        return 1 if layer_names[i].startswith("model.layers.") else 0
+
+    order = sorted(range(len(layer_names)), key=lambda i: (tier(i), -sizes[i], i))
+    pinned: list[int] = []
+    skipped: list[int] = []
+    used = 0
+    for i in order:
+        if budget_bytes > 0 and sizes[i] > 0 and used + sizes[i] <= budget_bytes:
+            pinned.append(i)
+            used += sizes[i]
+        else:
+            skipped.append(i)
+    return ResidencyPlan(
+        budget_bytes=int(budget_bytes),
+        pinned=tuple(sorted(pinned)),
+        layer_bytes=tuple((i, sizes[i]) for i in range(len(layer_names))),
+        skipped=tuple(sorted(skipped)),
+    )
+
+
+def auto_pin_budget_bytes(device=None) -> int:
+    """Auto pin budget: measured free HBM minus the activation headroom.
+
+    Free = the allocator's ``bytes_limit - bytes_in_use`` when the device
+    reports memory stats, else the device-kind HBM table (assumed empty).
+    Unknown HBM (the CPU backend, unrecognized kinds) resolves to 0 (off)
+    — the budget is only ever spent where it is real."""
+    try:
+        from flexible_llm_sharding_tpu.utils.metrics import (
+            chip_hbm_gb,
+            device_memory_stats,
+        )
+
+        stats = device_memory_stats(device)
+    except Exception:
+        return 0
+    limit = stats.get("bytes_limit")
+    in_use = stats.get("bytes_in_use", 0.0)
+    if not limit:
+        try:
+            hbm = chip_hbm_gb(device)
+        except Exception:
+            hbm = None
+        if not hbm:
+            return 0
+        limit = hbm * 1e9
+        in_use = 0.0
+    free = limit - in_use
+    return int(max(0.0, free - ACTIVATION_HEADROOM_FRACTION * limit))
+
+
+def placement_key(device) -> tuple:
+    """Stable identity of a placement target, so pins survive the target
+    OBJECT being rebuilt (a NamedSharding recreated per scorer instance
+    must hit the same pins, not leak a second copy)."""
+    if device is None:
+        return ("default",)
+    if hasattr(device, "segment_target") and hasattr(device, "mesh"):
+        # TpPlacement: per-kind shardings over one tp mesh.
+        return (
+            "tp",
+            tuple(int(d.id) for d in device.mesh.devices.flat),
+        )
+    mesh = getattr(device, "mesh", None)
+    spec = getattr(device, "spec", None)
+    if mesh is not None and spec is not None:  # NamedSharding
+        return (
+            "sharding",
+            tuple(int(d.id) for d in mesh.devices.flat),
+            str(spec),
+        )
+    did = getattr(device, "id", None)
+    if did is not None:  # a plain jax Device
+        return ("device", int(did))
+    return ("object", id(device))
+
+
+def probe_chip(target):
+    """One real jax Device of a placement target (a TpPlacement,
+    NamedSharding, or raw Mesh resolves to its mesh's first chip) — for
+    HBM probes that need a concrete device handle."""
+    mesh = getattr(target, "mesh", None)
+    if mesh is None and hasattr(getattr(target, "devices", None), "flat"):
+        mesh = target  # a raw jax Mesh
+    if mesh is not None:
+        return next(iter(mesh.devices.flat))
+    return target
+
+
+def _tree_nbytes(segments) -> int:
+    """Total logical bytes of a HOST tree (unsharded numpy leaves) — the
+    per-sweep link traffic a pin skip saves."""
+    import jax
+
+    return sum(
+        int(a.nbytes)
+        for _, seg in segments
+        for a in jax.tree.leaves(seg)
+        if hasattr(a, "nbytes")
+    )
+
+
+def _placed_device_nbytes(segments) -> int:
+    """Per-chip resident bytes of a PLACED tree: the most bytes any single
+    device holds. ``jax.Array.nbytes`` is the GLOBAL logical size, so on a
+    TP/mesh placement it overstates per-chip HBM by the shard factor —
+    sharded leaves must count 1/Nth per chip, replicated leaves count
+    fully on every chip."""
+    import jax
+
+    per_dev: dict = {}
+    for _, seg in segments:
+        for a in jax.tree.leaves(seg):
+            shards = getattr(a, "addressable_shards", None)
+            if shards:
+                for sh in shards:
+                    d = sh.device
+                    per_dev[d] = per_dev.get(d, 0) + int(sh.data.nbytes)
+            elif hasattr(a, "nbytes"):
+                per_dev[None] = per_dev.get(None, 0) + int(a.nbytes)
+    return max(per_dev.values(), default=0)
+
+
+class DeviceResidencyTier:
+    """Process-lifetime pins of the planned layers' placed parameter trees.
+
+    ``segments(idx, device, loader)`` returns the pinned layer's placed
+    segment list for a placement target, loading and placing it on first
+    request THROUGH THE CALLER'S LOADER — the same manifest-verified,
+    retried, chaos-injected path every streamed byte takes. Callers treat
+    the returned segments as immutable (they are shared across sweeps and
+    across sources; the jitted blocks never donate parameter trees).
+
+    A pin-time load that fails persistently (quarantined corruption,
+    exhausted retries) permanently demotes the layer back to streaming
+    for this tier's lifetime: wrong bytes are never pinned, and the
+    layer's typed error keeps surfacing through the normal stream-side
+    degrade machinery. Demotion is one-way so a source's frozen pin set
+    can never disagree with a later source's segment structure mid-wave.
+    """
+
+    def __init__(
+        self, model_path: str, layer_names: Sequence[str], plan: ResidencyPlan
+    ):
+        self.model_path = model_path
+        self.layer_names = list(layer_names)
+        self.plan = plan
+        self._lock = threading.RLock()
+        # (placement key, idx) -> Event while a pin load is in flight: the
+        # slow work (disk read, checksum, retry ladder, device placement)
+        # runs OFF the tier lock so stats()/note_skip()/other pins never
+        # stall behind one load's backoff deadline; concurrent callers of
+        # the same pin wait on the event instead of loading a duplicate.
+        self._inflight: dict[tuple, threading.Event] = {}
+        self._failed: set[int] = set()
+        # idx -> host-tree bytes at pin time (the exact per-sweep link
+        # bytes a skip saves; recorded once, device-independent).
+        self._host_nbytes: dict[int, int] = {}
+        # Planner's byte estimates, dict-shaped once: note_skip runs under
+        # the lock on every shard build of every sweep.
+        self._plan_bytes: dict[int, int] = dict(plan.layer_bytes)
+        # placement key -> {idx: placed segment list}
+        self._placed: dict[tuple, dict[int, list]] = {}
+        self._dev_bytes: dict[tuple, int] = {}
+        self.pin_hits = 0
+        self.stream_bytes_saved = 0
+        self.pin_loads = 0
+        self.pin_failures = 0
+
+    # -- membership --------------------------------------------------------
+
+    def is_pinned(self, idx: int) -> bool:
+        with self._lock:
+            return idx in self.plan.pinned_set and idx not in self._failed
+
+    def frozen_pinned(self, layer_idxs_groups) -> frozenset:
+        """The pin set a source captures at construction: planned-and-
+        healthy layers among the shards it will stream. Frozen per source
+        so one source's segment structure never changes mid-life."""
+        with self._lock:
+            return frozenset(
+                i
+                for group in layer_idxs_groups
+                for i in group
+                if i in self.plan.pinned_set and i not in self._failed
+            )
+
+    # -- pinning -----------------------------------------------------------
+
+    def segments(self, idx: int, device, loader) -> list:
+        """The pinned layer's placed segment list on ``device`` (pin on
+        first request). Raises the loader's typed error when the pin load
+        fails — after demoting the layer so no later source plans it."""
+        from flexible_llm_sharding_tpu.runtime.executor import _place
+
+        key = placement_key(device)
+        while True:
+            with self._lock:
+                hit = self._placed.setdefault(key, {}).get(idx)
+                if hit is not None:
+                    return hit
+                if idx in self._failed:
+                    raise checkpoint_unavailable(self.layer_names[idx])
+                gate = self._inflight.get((key, idx))
+                if gate is None:
+                    gate = threading.Event()
+                    self._inflight[(key, idx)] = gate
+                    break
+            # Another caller owns this pin's load: wait off-lock, then
+            # re-check (their success seats it; their failure demotes).
+            gate.wait()
+        try:
+            host = loader.build_host_shard((idx,))
+            placed = _place(host, device, np_dtype=loader.np_dtype)
+        except Exception:
+            # Persistent corruption / exhausted retries: never pin
+            # unverified bytes — demote to streaming for good (the
+            # stream path surfaces the typed error and quarantine).
+            with self._lock:
+                self._failed.add(idx)
+                self.pin_failures += 1
+                self._inflight.pop((key, idx), None)
+            gate.set()
+            raise
+        with self._lock:
+            seats = self._placed.setdefault(key, {})
+            if seats.get(idx) is None:
+                seats[idx] = placed
+                self._host_nbytes.setdefault(idx, _tree_nbytes(host))
+                self._dev_bytes[key] = self._dev_bytes.get(
+                    key, 0
+                ) + _placed_device_nbytes(placed)
+                self.pin_loads += 1
+            # else: a concurrent pin_from_host seated this pin while our
+            # load was in flight (it doesn't ride the _inflight gate) —
+            # the earlier seat wins, our duplicate placement is dropped,
+            # never double-counted. Same rule as pin_from_host.
+            placed = seats[idx]
+            self._inflight.pop((key, idx), None)
+        gate.set()
+        return placed
+
+    def ensure_pinned(self, loader, device, layer_idxs) -> None:
+        """Best-effort pre-pin of the planned layers among ``layer_idxs``
+        on ``device`` (source construction). Failures demote the layer —
+        the caller's frozen pin set then streams it, and the stream load
+        surfaces the typed error through the normal envelopes instead of
+        failing construction."""
+        for i in layer_idxs:
+            if not self.is_pinned(i):
+                continue
+            try:
+                self.segments(i, device, loader)
+            except Exception:
+                pass  # demoted inside segments(); streamed path reports
+
+    def pin_from_host(self, idx: int, device, host, np_dtype) -> None:
+        """Seat an already-built (verified) host tree as ``idx``'s pin on
+        ``device`` — the broadcast pre-pin's read-once path. No-op when
+        already seated (a concurrent seat wins; the duplicate placement is
+        dropped, never double-counted)."""
+        from flexible_llm_sharding_tpu.runtime.executor import _place
+
+        key = placement_key(device)
+        with self._lock:
+            if self._placed.setdefault(key, {}).get(idx) is not None:
+                return
+        placed = _place(host, device, np_dtype=np_dtype)
+        with self._lock:
+            seats = self._placed.setdefault(key, {})
+            if seats.get(idx) is not None:
+                return
+            seats[idx] = placed
+            self._host_nbytes.setdefault(idx, _tree_nbytes(host))
+            self._dev_bytes[key] = self._dev_bytes.get(
+                key, 0
+            ) + _placed_device_nbytes(placed)
+            self.pin_loads += 1
+
+    def ensure_pinned_broadcast(self, loader, devices, layer_idxs) -> None:
+        """Best-effort pre-pin across a DP broadcast's chips with ONE host
+        build per pinned layer (the broadcast source's read-once
+        convention) — ``ensure_pinned`` per device would re-read and
+        re-checksum each pinned layer N times. Failures demote the layer
+        exactly like the per-device path."""
+        for i in layer_idxs:
+            if not self.is_pinned(i):
+                continue
+            with self._lock:
+                missing = [
+                    d
+                    for d in devices
+                    if self._placed.get(placement_key(d), {}).get(i) is None
+                ]
+            if not missing:
+                continue
+            try:
+                host = loader.build_host_shard((i,))
+            except Exception:
+                # Same demotion rule as segments(): never pin unverified
+                # bytes; the streamed path surfaces the typed error.
+                with self._lock:
+                    self._failed.add(i)
+                    self.pin_failures += 1
+                continue
+            for d in missing:
+                try:
+                    self.pin_from_host(i, d, host, loader.np_dtype)
+                except Exception:
+                    # Placement failure demotes too (mirrors segments());
+                    # copies already seated on other chips sit unused —
+                    # frozen_pinned excludes the layer, so it streams
+                    # everywhere and the structure stays uniform.
+                    with self._lock:
+                        self._failed.add(i)
+                        self.pin_failures += 1
+                    break
+
+    def note_skip(self, idx: int) -> None:
+        """One pinned layer's bytes were subtracted from one shard build
+        (one sweep's worth of link traffic saved)."""
+        with self._lock:
+            self.pin_hits += 1
+            saved = self._host_nbytes.get(idx)
+            if saved is None:
+                saved = self._plan_bytes.get(idx, 0)
+            self.stream_bytes_saved += saved
+
+    # -- observability -----------------------------------------------------
+
+    def pinned_device_bytes(self, device=None) -> int:
+        """Resident bytes pinned on ONE placement target — the per-chip
+        HBM cost of the tier (the peak_hbm floor)."""
+        with self._lock:
+            return self._dev_bytes.get(placement_key(device), 0)
+
+    def max_pinned_device_bytes(self) -> int:
+        """The heaviest single placement target's resident bytes — the
+        per-chip peak_hbm floor when the caller has no device handle (the
+        process-wide ``stats()['pinned_bytes']`` sums ALL targets, which
+        overstates a per-chip peak by Nx on pipeline/DP runs)."""
+        with self._lock:
+            return max(self._dev_bytes.values(), default=0)
+
+    def stats(self) -> dict[str, float]:
+        with self._lock:
+            return {
+                # Distinct layers seated on ANY placement target: DP
+                # replication seats the same idxs everywhere (union ==
+                # per-chip count) while pipeline mode splits the plan
+                # across stage chips (a per-target max would underreport
+                # an engaged tier as demotions).
+                "pinned_layers": len(
+                    {i for m in self._placed.values() for i in m}
+                ),
+                "planned_layers": len(self.plan.pinned),
+                # Per-chip resident bytes summed across placement targets
+                # (one chip: the tier's HBM cost; DP: the process-wide
+                # total; a TP mesh target contributes its per-chip cost,
+                # not the global logical size).
+                "pinned_bytes": sum(self._dev_bytes.values()),
+                "stream_bytes_saved": self.stream_bytes_saved,
+                "pin_hits": self.pin_hits,
+                "pin_loads": self.pin_loads,
+                "pin_failures": self.pin_failures,
+                "budget_bytes": self.plan.budget_bytes,
+            }
+
+    def set_budget(self, budget_bytes: int, tied_embeddings: bool = False) -> None:
+        """Re-plan under a new budget. Shrink drops layers from the PLAN
+        (future sources stream them; live sources keep their frozen sets
+        and the already-placed trees stay until process exit — dropping
+        them under a live source would desync its segment structure)."""
+        with self._lock:
+            self.plan = plan_residency(
+                self.model_path, self.layer_names, budget_bytes, tied_embeddings
+            )
+
+
+def checkpoint_unavailable(name: str):
+    """The typed error for a layer demoted after a failed pin: the same
+    ShardCorruptError family the stream path raises, so the serving
+    degrade machinery applies unchanged."""
+    from flexible_llm_sharding_tpu.integrity.manifest import ShardCorruptError
+
+    return ShardCorruptError(
+        f"{name}: pin-time load failed persistently; layer demoted to "
+        "streaming (audit with the `verify` CLI subcommand)"
+    )
+
+
+# -- process-wide tier -------------------------------------------------------
+# One tier per process (mirrors hostcache.cache_for): the serving engine
+# rebuilds its weight source on every recovery, offline decode builds one
+# source per call — all of them must find the SAME pins (load once, resident
+# for the process lifetime). Budget precedence follows the host cache's
+# rule: explicit pins the cap; auto only grows an auto-sized tier.
+
+_PROCESS_TIER: DeviceResidencyTier | None = None
+_PROCESS_TIER_KEY: tuple | None = None
+_PROCESS_BUDGET_EXPLICIT = False
+_PROCESS_LOCK = threading.Lock()
+
+
+def tier_for(
+    cfg, layer_names: Sequence[str], tied_embeddings: bool, device=None
+) -> DeviceResidencyTier | None:
+    """The process residency tier for ``cfg``, or None when the budget
+    resolves to 0 (hbm_pin_gb=0, chaos auto-off, unknown HBM)."""
+    budget = cfg.effective_hbm_pin_bytes(device)
+    if budget <= 0:
+        return None
+    explicit = cfg.hbm_pin_gb is not None
+    key = (
+        os.path.abspath(cfg.model_path),
+        cfg.dtype,
+        bool(cfg.verify_weights),
+        tuple(layer_names),
+        bool(tied_embeddings),
+    )
+    global _PROCESS_TIER, _PROCESS_TIER_KEY, _PROCESS_BUDGET_EXPLICIT
+    with _PROCESS_LOCK:
+        if _PROCESS_TIER is not None and _PROCESS_TIER_KEY == key:
+            tier = _PROCESS_TIER
+            if explicit:
+                if tier.plan.budget_bytes != budget:
+                    tier.set_budget(budget, tied_embeddings)
+                _PROCESS_BUDGET_EXPLICIT = True
+            elif (
+                not _PROCESS_BUDGET_EXPLICIT
+                and budget > tier.plan.budget_bytes
+            ):
+                tier.set_budget(budget, tied_embeddings)
+            return tier
+        plan = plan_residency(
+            cfg.model_path, layer_names, budget, tied_embeddings
+        )
+        _PROCESS_TIER = DeviceResidencyTier(cfg.model_path, layer_names, plan)
+        _PROCESS_TIER_KEY = key
+        _PROCESS_BUDGET_EXPLICIT = explicit
+        return _PROCESS_TIER
+
+
+def process_tier() -> DeviceResidencyTier | None:
+    """The live process tier (the CLI's end-of-run stats read it)."""
+    with _PROCESS_LOCK:
+        return _PROCESS_TIER
+
+
+def reset_process_tier() -> None:
+    """Drop the process tier and its pins (tests; benches isolating arms).
+    The placed device arrays free once the last source's references go."""
+    global _PROCESS_TIER, _PROCESS_TIER_KEY, _PROCESS_BUDGET_EXPLICIT
+    with _PROCESS_LOCK:
+        _PROCESS_TIER = None
+        _PROCESS_TIER_KEY = None
+        _PROCESS_BUDGET_EXPLICIT = False
+
+
+def plan_report(model_path: str, budget_bytes: int) -> dict:
+    """Dry-run planner audit for the ``verify`` CLI: which layers the
+    budget would pin and their per-sweep byte savings — no device, no
+    loads, just the plan."""
+    from flexible_llm_sharding_tpu.config import LlamaConfig
+
+    model_cfg = LlamaConfig.from_pretrained(model_path)
+    layer_names = checkpoint.layer_names_for(
+        model_cfg.num_hidden_layers, tie_word_embeddings=False
+    )
+    plan = plan_residency(
+        model_path, layer_names, budget_bytes, model_cfg.tie_word_embeddings
+    )
+    sizes = dict(plan.layer_bytes)
+    return {
+        "model_path": model_path,
+        "budget_gb": round(budget_bytes / 1e9, 3),
+        "pinned": [
+            {"layer": layer_names[i], "bytes": sizes[i]} for i in plan.pinned
+        ],
+        "pinned_layers": len(plan.pinned),
+        "total_layers": len(layer_names),
+        "pinned_bytes": plan.pinned_bytes_est,
+        "total_bytes": plan.total_bytes_est,
+        "pinned_fraction": round(plan.pinned_fraction, 4),
+        # Every sweep that would have streamed these layers now skips
+        # exactly these bytes on the host->HBM link.
+        "stream_bytes_saved_per_sweep": plan.pinned_bytes_est,
+        "skipped_layers": len(plan.skipped),
+    }
+
+
+__all__ = [
+    "ACTIVATION_HEADROOM_FRACTION",
+    "DeviceResidencyTier",
+    "ResidencyPlan",
+    "auto_pin_budget_bytes",
+    "layer_stream_bytes",
+    "placement_key",
+    "plan_report",
+    "plan_residency",
+    "process_tier",
+    "reset_process_tier",
+    "tier_for",
+]

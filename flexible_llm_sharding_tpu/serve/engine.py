@@ -167,10 +167,24 @@ class ServeEngine:
         # and skip disk read/parse/checksum entirely — and because the
         # cache outlives any one source, a recovery's source restart warms
         # instantly too. The stats line carries its hit rate.
-        from flexible_llm_sharding_tpu.runtime import hostcache
+        from flexible_llm_sharding_tpu.runtime import hostcache, residency
 
         self._host_cache = hostcache.cache_for(cfg)
         self.metrics.host_cache = self._host_cache
+        # Device residency tier: the hottest layers load once (manifest-
+        # verified) and stay on chip for the PROCESS lifetime — pins
+        # survive source restarts and wave recoveries, and every sweep's
+        # stream skips exactly their bytes. Moot when the whole model is
+        # already resident (decode_resident), so skipped there.
+        self._residency = (
+            None
+            if self._resident
+            else residency.tier_for(
+                cfg, self.layer_names, self.model_cfg.tie_word_embeddings,
+                device,
+            )
+        )
+        self.metrics.residency = self._residency
         self.queue = AdmissionQueue(
             self.serve_cfg.queue_capacity, metrics=self.metrics,
             injector=self._injector,
@@ -397,6 +411,7 @@ class ServeEngine:
             verify_weights=self.cfg.verify_weights,
             host_cache=self._host_cache,
             readahead_threads=self.cfg.readahead_threads,
+            residency=self._residency,
         )
 
     def _acquire_weights(self) -> None:

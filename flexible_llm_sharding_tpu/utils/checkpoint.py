@@ -214,6 +214,17 @@ def layer_names_for(num_hidden_layers: int, tie_word_embeddings: bool = False) -
     return names
 
 
+def layer_file_for(model_path: str, name: str, tied: bool = False) -> str:
+    """The file a layer name actually reads: with tied embeddings,
+    ``lm_head`` re-materialises from the embedding file. The ONE mapping
+    shared by the streaming loader (quarantine keys, stat guards) and the
+    residency planner's byte estimates — any change to the on-disk layout
+    must keep both views identical or the planner silently desyncs from
+    what the loader streams."""
+    fname = "model.embed_tokens" if (name == "lm_head" and tied) else name
+    return os.path.join(model_path, f"{fname}{LAYER_FILE_SUFFIX}")
+
+
 # ---------------------------------------------------------------------------
 # HF checkpoint enumeration (host side, offline)
 # ---------------------------------------------------------------------------

@@ -349,6 +349,11 @@ class ServingMetrics:
         # operator can see the warm-sweep fast path engaging (and CI can
         # grep a nonzero host_cache_hit_rate from the smoke).
         self.host_cache = None
+        # Device residency tier (runtime/residency.py) attached by the
+        # serving engine: the stats line carries pinned_bytes and
+        # stream_bytes_saved top-level — HBM accounting honesty (the
+        # low-memory claim can never silently exclude the pin tier).
+        self.residency = None
 
     def count(self, name: str, n: int = 1) -> None:
         with self._lock:
@@ -389,6 +394,11 @@ class ServingMetrics:
             cache = self.host_cache.stats()
             out["host_cache_hit_rate"] = cache["hit_rate"]
             out["host_cache"] = cache
+        if self.residency is not None:
+            res = self.residency.stats()
+            out["pinned_bytes"] = res["pinned_bytes"]
+            out["stream_bytes_saved"] = res["stream_bytes_saved"]
+            out["residency"] = res
         return out
 
     def emit(self) -> None:

@@ -1,0 +1,513 @@
+"""Partial HBM residency suite (PR 6): the device residency tier.
+
+The contract under test: with a nonzero pin budget the planner pins the
+hottest layers (embedding, lm_head, norm first, then blocks), every
+sweep's ``streamed_bytes`` drops by EXACTLY the pinned layers' bytes, and
+outputs stay token-identical to the unpinned run — offline, decode, and
+serving, including under chaos. Pin-time loads ride the manifest-verified
+loader path: injected corruption re-read-heals into a clean pin, and
+corruption that survives every re-read DEMOTES the layer back to
+streaming (typed error through the normal degrade machinery) instead of
+poisoning a resident copy. ``hbm_pin_gb=0`` is a strict no-op, and the
+auto budget follows the host cache's explicit-cap precedence rule.
+"""
+
+import io
+import json
+import os
+from contextlib import redirect_stdout
+
+import numpy as np
+import pytest
+
+import jax
+
+from flexible_llm_sharding_tpu.config import (
+    FaultConfig,
+    FrameworkConfig,
+    ServeConfig,
+)
+from flexible_llm_sharding_tpu.integrity import manifest as iman
+from flexible_llm_sharding_tpu.integrity.manifest import ShardCorruptError
+from flexible_llm_sharding_tpu.models import llama
+from flexible_llm_sharding_tpu.runtime import hostcache, residency
+from flexible_llm_sharding_tpu.runtime.decode import DecodeGenerator
+from flexible_llm_sharding_tpu.runtime.executor import StreamingExecutor
+from flexible_llm_sharding_tpu.serve import ServeEngine
+from flexible_llm_sharding_tpu.utils.checkpoint import (
+    layer_names_for,
+    save_params,
+)
+
+from tests.fake_tokenizer import FakeTokenizer
+
+CHAOS_SEED = int(os.environ.get("FLS_CHAOS_SEED", "1234"))
+
+PROMPTS = [
+    ("The capital of France", (" is Paris", " is Rome")),
+    ("Two plus two equals", (" four", " five")),
+    ("The sky is", (" blue", " green")),
+    ("Hello world", (" again", " anew")),
+]
+
+
+@pytest.fixture(scope="module")
+def model_dir(tiny_cfg, tmp_path_factory):
+    params = llama.init_params(jax.random.PRNGKey(0), tiny_cfg)
+    d = tmp_path_factory.mktemp("tiny_model_residency")
+    save_params(jax.tree.map(np.asarray, params), str(d), tiny_cfg)
+    return str(d)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_process_state():
+    residency.reset_process_tier()
+    hostcache.reset_process_cache()
+    iman.reset_verdicts()
+    yield
+    residency.reset_process_tier()
+    hostcache.reset_process_cache()
+
+
+def _fw(model_dir, **kw) -> FrameworkConfig:
+    base = dict(
+        model_path=model_dir,
+        layer_num_per_shard=1,
+        storage_location="cpu",
+        dtype="float32",
+        bucket_multiple=8,
+        block_size=2,
+        prefetch_depth=0,
+        host_cache_gb=0.0,  # isolate the pin tier from the host cache
+        io_retry_attempts=8,
+        io_retry_base_s=0.001,
+    )
+    base.update(kw)
+    return FrameworkConfig(**base)
+
+
+@pytest.fixture(scope="module")
+def clean_scores(model_dir):
+    """Unpinned, fault-free oracle shared by the parity tests."""
+    return StreamingExecutor(
+        _fw(model_dir), tokenizer=FakeTokenizer()
+    )(list(PROMPTS))
+
+
+def _sizes(model_dir):
+    return residency.layer_stream_bytes(model_dir, layer_names_for(4), False)
+
+
+def _partial_budget_gb(model_dir) -> float:
+    """A budget that pins embed + norm + lm_head + one block and no more."""
+    s = _sizes(model_dir)
+    return (s[0] + s[5] + s[6] + s[1] + 16) / 1e9
+
+
+# ---------------------------------------------------------------------------
+# Planner units
+# ---------------------------------------------------------------------------
+
+def test_planner_priority_and_budget(model_dir):
+    names = layer_names_for(4)
+    sizes = _sizes(model_dir)
+    # Non-decoder layers (embed=0, norm=5, lm_head=6) take priority.
+    plan = residency.plan_residency(
+        model_dir, names, sizes[0] + sizes[5] + sizes[6]
+    )
+    assert plan.pinned == (0, 5, 6)
+    assert plan.pinned_bytes_est <= plan.budget_bytes
+    # A bigger budget adds decoder blocks in order (uniform sizes).
+    plan2 = residency.plan_residency(
+        model_dir, names, sizes[0] + sizes[5] + sizes[6] + sizes[1]
+    )
+    assert plan2.pinned == (0, 1, 5, 6)
+    # Huge budget pins everything; zero pins nothing.
+    assert residency.plan_residency(model_dir, names, 1 << 40).pinned == tuple(
+        range(7)
+    )
+    empty = residency.plan_residency(model_dir, names, 0)
+    assert empty.pinned == () and empty.pinned_fraction == 0.0
+    # Greedy knapsack: a budget below the biggest tier-0 layer still pins
+    # what fits (norm is tiny) instead of stopping at the first miss.
+    small = residency.plan_residency(model_dir, names, sizes[5] + 1)
+    assert 5 in small.pinned and 0 not in small.pinned
+
+
+def test_config_validation_and_budget_resolution(model_dir):
+    with pytest.raises(ValueError, match="hbm_pin_gb"):
+        _fw(model_dir, hbm_pin_gb=-1.0)
+    assert _fw(model_dir, hbm_pin_gb=0.0).effective_hbm_pin_bytes() == 0
+    assert _fw(model_dir, hbm_pin_gb=2.0).effective_hbm_pin_bytes() == int(2e9)
+    chaos = FaultConfig(enabled=True, seed=1)
+    # Auto resolves OFF under chaos; an explicit budget still wins.
+    assert _fw(model_dir, hbm_pin_gb=None, faults=chaos).effective_hbm_pin_bytes() == 0
+    assert (
+        _fw(model_dir, hbm_pin_gb=1.0, faults=chaos).effective_hbm_pin_bytes()
+        == int(1e9)
+    )
+    # Auto on the CPU backend (unknown HBM) resolves to off.
+    assert _fw(model_dir, hbm_pin_gb=None).effective_hbm_pin_bytes() == 0
+
+
+def test_explicit_budget_pins_tier_against_auto_growth(model_dir):
+    # Mirror of the host cache's precedence rule: an explicit cap pins the
+    # tier's budget; a later auto config in the same process cannot grow it.
+    names = layer_names_for(4)
+    capped = residency.tier_for(
+        _fw(model_dir, hbm_pin_gb=1.0), names, False, None
+    )
+    assert capped is not None and capped.plan.budget_bytes == int(1e9)
+    auto = residency.tier_for(_fw(model_dir, hbm_pin_gb=None), names, False, None)
+    # Auto resolves to 0 on CPU -> no tier handed out, and the pinned cap
+    # is untouched.
+    assert auto is None
+    assert capped.plan.budget_bytes == int(1e9)
+    again = residency.tier_for(
+        _fw(model_dir, hbm_pin_gb=0.5), names, False, None
+    )
+    assert again is capped and again.plan.budget_bytes == int(5e8)
+
+
+# ---------------------------------------------------------------------------
+# Offline parity + exact byte accounting
+# ---------------------------------------------------------------------------
+
+def test_hbm_pin_zero_is_a_noop(model_dir, clean_scores):
+    ex = StreamingExecutor(
+        _fw(model_dir, hbm_pin_gb=0.0), tokenizer=FakeTokenizer()
+    )
+    got = ex(list(PROMPTS))
+    assert ex._residency is None
+    assert residency.process_tier() is None
+    for k in ("pinned_bytes", "stream_bytes_saved", "pin_hits"):
+        assert k not in ex.stats
+    for g, w in zip(got, clean_scores):
+        np.testing.assert_array_equal(g, w)
+
+
+def test_full_pin_parity_and_zero_stream(model_dir, clean_scores):
+    off = StreamingExecutor(_fw(model_dir), tokenizer=FakeTokenizer())
+    off(list(PROMPTS))
+    full_stream = off.stats["streamed_bytes"]
+    ex = StreamingExecutor(
+        _fw(model_dir, hbm_pin_gb=1.0), tokenizer=FakeTokenizer()
+    )
+    first = ex(list(PROMPTS))
+    warm = ex(list(PROMPTS))
+    s2 = dict(ex.stats)
+    for g, w in zip(first, clean_scores):
+        np.testing.assert_array_equal(g, w)
+    for g, w in zip(warm, clean_scores):
+        np.testing.assert_array_equal(g, w)
+    # Warm sweep: zero streamed bytes; the saved bytes are EXACTLY what
+    # the unpinned run streams, and the stats witness all of it.
+    assert s2["streamed_bytes"] == 0.0
+    assert s2["stream_bytes_saved"] == full_stream
+    assert s2["pin_hits"] == 7.0
+    assert s2["pinned_bytes"] > 0
+    # HBM honesty: the reported peak can never sit below the pin tier —
+    # on the stat-less CPU backend the tier's bytes ARE the floor figure.
+    assert s2["peak_hbm_gb"] >= s2["pinned_bytes"] / 1e9
+
+
+def test_partial_pin_streams_drop_by_exactly_pinned_bytes(
+    model_dir, clean_scores
+):
+    off = StreamingExecutor(_fw(model_dir), tokenizer=FakeTokenizer())
+    off(list(PROMPTS))
+    full_stream = off.stats["streamed_bytes"]
+    ex = StreamingExecutor(
+        _fw(model_dir, hbm_pin_gb=_partial_budget_gb(model_dir)),
+        tokenizer=FakeTokenizer(),
+    )
+    ex(list(PROMPTS))
+    warm = ex(list(PROMPTS))
+    s2 = dict(ex.stats)
+    for g, w in zip(warm, clean_scores):
+        np.testing.assert_array_equal(g, w)
+    tier = residency.process_tier()
+    assert tier.plan.pinned == (0, 1, 5, 6)
+    assert s2["streamed_bytes"] > 0  # the unpinned blocks still stream
+    assert s2["streamed_bytes"] + s2["stream_bytes_saved"] == full_stream
+    assert s2["pin_hits"] == 4.0
+
+
+def test_mid_shard_pin_splits_stacked_run_token_identical(model_dir):
+    # layer_num_per_shard=2 stacks two decoders per scan; pinning norm
+    # (idx 5) splits the (4, 5) shard into stream(4) + pin(5) — the merged
+    # segment list must score token-identically to the unsplit run.
+    want = StreamingExecutor(
+        _fw(model_dir, layer_num_per_shard=2), tokenizer=FakeTokenizer()
+    )(list(PROMPTS))
+    ex = StreamingExecutor(
+        _fw(
+            model_dir,
+            layer_num_per_shard=2,
+            hbm_pin_gb=_partial_budget_gb(model_dir),
+        ),
+        tokenizer=FakeTokenizer(),
+    )
+    got = ex(list(PROMPTS))
+    for g, w in zip(got, want):
+        assert (g[:, 0].argmax(-1) == w[:, 0].argmax(-1)).all()
+        np.testing.assert_allclose(g, w, rtol=0, atol=1e-6)
+
+
+def test_decode_parity_with_pins(model_dir):
+    kw = dict(num_gen_token=3, decode_resident="off", decode_fused="off")
+    sc_off, up_off = DecodeGenerator(
+        _fw(model_dir, **kw), tokenizer=FakeTokenizer()
+    )(list(PROMPTS))
+    residency.reset_process_tier()
+    gen = DecodeGenerator(
+        _fw(model_dir, hbm_pin_gb=_partial_budget_gb(model_dir), **kw),
+        tokenizer=FakeTokenizer(),
+    )
+    sc_on, up_on = gen(list(PROMPTS))
+    for a, b in zip(sc_off, sc_on):
+        np.testing.assert_array_equal(a, b)
+    assert up_off == up_on
+    # Multi-sweep decode is the tier's sweet spot: prefill + each step
+    # skipped the pinned layers every pass.
+    assert residency.process_tier().stats()["pin_hits"] >= 4 * 3
+
+
+# ---------------------------------------------------------------------------
+# Serving: parity, stats line, pins survive engine restarts
+# ---------------------------------------------------------------------------
+
+def test_serve_parity_stats_and_pin_survival(model_dir, clean_scores):
+    cfg = _fw(model_dir, hbm_pin_gb=1.0, prefetch_depth=1)
+    engine = ServeEngine(
+        cfg,
+        ServeConfig(max_wave_requests=2, default_max_new_tokens=1),
+        tokenizer=FakeTokenizer(),
+    )
+    try:
+        for _ in range(2):  # sweep 2+ is the warm regime
+            reqs = [engine.submit(p, s) for p, s in PROMPTS]
+            results = [r.future.result(timeout=300) for r in reqs]
+            assert engine.error is None
+            for res, want in zip(results, clean_scores):
+                assert (
+                    res.scores[:, 0].argmax(-1) == want[:, 0].argmax(-1)
+                ).all()
+    finally:
+        engine.shutdown(drain=True)
+    stats = engine.stats()
+    # The warm serve stats line must show the tier working (acceptance
+    # criterion: nonzero pinned_bytes AND stream_bytes_saved, top level).
+    assert stats["pinned_bytes"] > 0, stats
+    assert stats["stream_bytes_saved"] > 0, stats
+    assert stats["residency"]["pin_hits"] > 0
+    loads = residency.process_tier().stats()["pin_loads"]
+    assert loads == 7
+    # A second engine (source restart / process-internal redeploy) finds
+    # the pins already resident: zero new pin loads.
+    engine2 = ServeEngine(
+        cfg,
+        ServeConfig(max_wave_requests=2, default_max_new_tokens=1),
+        tokenizer=FakeTokenizer(),
+    )
+    try:
+        reqs = [engine2.submit(p, s) for p, s in PROMPTS]
+        results = [r.future.result(timeout=300) for r in reqs]
+        assert engine2.error is None
+        for res, want in zip(results, clean_scores):
+            assert (
+                res.scores[:, 0].argmax(-1) == want[:, 0].argmax(-1)
+            ).all()
+    finally:
+        engine2.shutdown(drain=True)
+    assert residency.process_tier().stats()["pin_loads"] == loads
+
+
+def test_serve_chaos_parity_with_pins(model_dir, clean_scores):
+    # Explicit pin budget + explicit cache budget override chaos auto-off;
+    # injected corruption on the (pin-time and streamed) loads must heal
+    # without ever changing a token.
+    cfg = _fw(
+        model_dir,
+        hbm_pin_gb=_partial_budget_gb(model_dir),
+        prefetch_depth=1,
+        faults=FaultConfig(
+            enabled=True, seed=CHAOS_SEED, error_rate=0.2,
+            sites=("corrupt_shard",),
+        ),
+    )
+    engine = ServeEngine(
+        cfg,
+        ServeConfig(max_wave_requests=2, default_max_new_tokens=1),
+        tokenizer=FakeTokenizer(),
+    )
+    try:
+        for _ in range(4):
+            reqs = [engine.submit(p, s) for p, s in PROMPTS]
+            results = [r.future.result(timeout=300) for r in reqs]
+            assert engine.error is None
+            for res, want in zip(results, clean_scores):
+                assert (
+                    res.scores[:, 0].argmax(-1) == want[:, 0].argmax(-1)
+                ).all()
+            if engine.metrics.integrity.total("integrity_failures"):
+                break
+    finally:
+        engine.shutdown(drain=True)
+    tier = residency.process_tier()
+    assert tier is not None and tier.stats()["pin_failures"] == 0
+
+
+# ---------------------------------------------------------------------------
+# Chaos at pin time: heal into a clean pin, or demote — never poison
+# ---------------------------------------------------------------------------
+
+def test_pin_time_corruption_rereads_and_heals(model_dir, clean_scores):
+    # One injected bit-flip, guaranteed to land on a pin-time load (rate
+    # 1.0, budget 1): the loader's retry re-reads clean bytes, the pin is
+    # verified-clean, and every output matches the oracle.
+    cfg = _fw(
+        model_dir,
+        hbm_pin_gb=1.0,
+        faults=FaultConfig(
+            enabled=True, seed=CHAOS_SEED, error_rate=1.0,
+            sites=("corrupt_shard",), max_faults=1,
+        ),
+    )
+    ex = StreamingExecutor(cfg, tokenizer=FakeTokenizer())
+    got = ex(list(PROMPTS))
+    for g, w in zip(got, clean_scores):
+        np.testing.assert_array_equal(g, w)
+    assert ex._integrity.total("reread_heals") >= 1
+    tier = residency.process_tier()
+    st = tier.stats()
+    assert st["pin_failures"] == 0 and st["pinned_layers"] == 7
+
+
+def test_persistent_pin_corruption_demotes_never_pins(model_dir):
+    # Unlimited injected corruption: every re-read is dirty, so NOTHING
+    # may be pinned (a poisoned resident layer would serve wrong bytes for
+    # the process lifetime) and the run surfaces the typed quarantine
+    # error through the normal stream path.
+    cfg = _fw(
+        model_dir,
+        hbm_pin_gb=1.0,
+        io_retry_attempts=2,
+        faults=FaultConfig(
+            enabled=True, seed=CHAOS_SEED, error_rate=1.0,
+            sites=("corrupt_shard",),
+        ),
+    )
+    ex = StreamingExecutor(cfg, tokenizer=FakeTokenizer())
+    with pytest.raises(ShardCorruptError):
+        ex(list(PROMPTS))
+    st = residency.process_tier().stats()
+    assert st["pinned_layers"] == 0
+    assert st["pin_failures"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# verify CLI: dry-run planner audit
+# ---------------------------------------------------------------------------
+
+def test_verify_cli_residency_dry_run(model_dir):
+    from flexible_llm_sharding_tpu.cli import verify_main
+
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        verify_main(["--model_path", model_dir, "--hbm_pin_gb", "1"])
+    out = buf.getvalue()
+    assert "residency plan @ 1.0 GB" in out
+    assert "model.embed_tokens" in out and "lm_head" in out
+    assert "per sweep" in out
+    # JSON mode carries the structured plan.
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        verify_main(
+            ["--model_path", model_dir, "--hbm_pin_gb", "0.0001", "--json"]
+        )
+    rep = json.loads(buf.getvalue())["residency_plan"]
+    assert rep["total_layers"] == 7
+    assert rep["pinned_bytes"] <= int(0.0001 * 1e9)
+    assert rep["stream_bytes_saved_per_sweep"] == rep["pinned_bytes"]
+    # Nothing was loaded or pinned by the audit.
+    assert residency.process_tier() is None
+    with pytest.raises(SystemExit, match="requires --model_path"):
+        verify_main(["--spill_dir", model_dir, "--hbm_pin_gb", "1"])
+
+
+def test_bench_pinned_fraction_zeroes_when_tier_disengaged(
+    model_dir, monkeypatch
+):
+    """The perf gate uses ``pinned_fraction`` as its tier-disengaged
+    detector, so bench must report the planner's ratio ONLY when the pin
+    arm's executor stats prove the runtime tier engaged (nonzero resident
+    bytes and saved link bytes); a run that silently streamed everything
+    records 0.0 and trips the gate's structural floor."""
+    import bench
+
+    class _Stub:
+        def __init__(self, stats):
+            self.stats = stats
+
+    def _fake_run_once(stats):
+        return lambda cfg, prompts, tok: (None, 1.0, _Stub(stats))
+
+    def _run(stats):
+        result = {}
+        monkeypatch.setattr(bench, "run_once", _fake_run_once(stats))
+        bench.bench_residency(
+            result,
+            model_dir,
+            list(PROMPTS),
+            FakeTokenizer(),
+            lambda: 1.0,
+            lambda prefetch: _fw(model_dir, prefetch_depth=prefetch),
+        )
+        return result
+
+    disengaged = _run({})  # no residency keys: tier never attached
+    assert disengaged["pinned_fraction"] == 0.0
+
+    engaged = _run({"pinned_bytes": 1.0, "stream_bytes_saved": 1.0})
+    assert engaged["pinned_fraction"] > 0.0
+
+
+def test_segments_respects_concurrent_pin_from_host_seat(model_dir):
+    """pin_from_host does not ride segments()' in-flight gate, so a
+    broadcast pre-pin can seat the same (device, idx) while a segments()
+    load is mid-flight. The earlier seat must win: one pin_load, device
+    bytes counted exactly once, and the seated copy returned (the race
+    previously double-counted _dev_bytes and replaced the seated pin)."""
+    from flexible_llm_sharding_tpu.runtime.executor import _HostShardLoader
+    from flexible_llm_sharding_tpu.runtime.residency import (
+        DeviceResidencyTier,
+        _placed_device_nbytes,
+        placement_key,
+        plan_residency,
+    )
+
+    names = layer_names_for(4)
+    plan = plan_residency(model_dir, names, 10**12, False)
+    tier = DeviceResidencyTier(model_dir, names, plan)
+    dev = jax.devices()[0]
+    inner = _HostShardLoader(model_dir, names, np.float32)
+
+    class _RacingLoader:
+        np_dtype = np.float32
+
+        def build_host_shard(self, idxs):
+            host = inner.build_host_shard(idxs)
+            # Seat the same pin via the broadcast read-once path while
+            # segments()' own load is still in flight.
+            tier.pin_from_host(idxs[0], dev, host, np.float32)
+            return host
+
+    placed = tier.segments(0, dev, _RacingLoader())
+    key = placement_key(dev)
+    with tier._lock:
+        seated = tier._placed[key][0]
+        dev_bytes = tier._dev_bytes[key]
+    assert placed is seated
+    assert tier.pin_loads == 1
+    assert dev_bytes == _placed_device_nbytes(seated)
