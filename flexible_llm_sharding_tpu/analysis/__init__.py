@@ -1,0 +1,27 @@
+"""``flscheck`` — project-invariant static analysis for this repo.
+
+The streaming architecture lives on a handful of concurrency and
+configuration invariants (one producer thread feeding consumers through
+process-wide caches and tiers; knobs threaded through two CLI parsers;
+fault sites registered in ``config.FAULT_SITES``; counters exported to
+stats). The last several PRs each burned review rounds on the *same*
+recurring defect classes — this package machine-checks them per PR.
+
+Entry points:
+
+- ``python -m flexible_llm_sharding_tpu.cli check`` (the CI surface)
+- ``python -m flexible_llm_sharding_tpu.analysis``
+- ``scripts/flscheck``
+
+See ``docs/analysis.md`` for the rule catalog, the pragma and baseline
+workflow, and how to add a rule.
+"""
+
+from flexible_llm_sharding_tpu.analysis.core import (
+    Finding,
+    analyze_source,
+    main,
+    run,
+)
+
+__all__ = ["Finding", "analyze_source", "main", "run"]

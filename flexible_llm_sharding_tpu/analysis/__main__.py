@@ -1,0 +1,8 @@
+"""``python -m flexible_llm_sharding_tpu.analysis`` — the flscheck CLI."""
+
+import sys
+
+from flexible_llm_sharding_tpu.analysis.core import main
+
+if __name__ == "__main__":
+    sys.exit(main())

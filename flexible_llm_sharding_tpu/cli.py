@@ -31,6 +31,35 @@ from flexible_llm_sharding_tpu.config import (
 )
 
 
+# --- KNOB-SYNC declarations (machine-checked by flscheck, analysis/rules.py).
+# A FrameworkConfig/FaultConfig flag normally belongs in BOTH parsers (the
+# recurring review defect was adding a knob to one and forgetting the other);
+# a flag listed here is deliberately single-parser, for the stated reason.
+BATCH_ONLY_FLAGS = frozenset({
+    # Workload shape of one offline batch run — serving has no fixed batch.
+    "num_batch", "num_gen_token", "disk_folder", "max_activation_in_cpu",
+    "resume", "long_context",
+    # Multi-chip layouts: serving v1 drives a single placement target
+    # (ServeEngine rejects data_parallel/tensor_parallel loudly).
+    "data_parallel", "num_devices", "tensor_parallel",
+    # Sampling: serving is greedy-only for now (per-request rng streams
+    # under sampling are future work; ServeEngine rejects temperature > 0).
+    "temperature", "top_k", "top_p", "seed",
+    # KV-decode specials that don't compose with the sweep engine yet.
+    "decode_fused", "speculative_k",
+    # Offline observability/profiling of a single run.
+    "verbose_metrics", "profile_dir",
+})
+SERVE_ONLY_FLAGS = frozenset()
+# Flags that drive the run (inputs/outputs/cluster wiring/demo pacing) and
+# set no config field.
+DRIVER_FLAGS = frozenset({
+    "prompt_pickle", "output_file", "kv_cache",
+    "coordinator_address", "num_processes", "process_id",
+    "stagger_ms",
+})
+
+
 def _str2bool(v: str) -> bool:
     if v.lower() in ("true", "1", "yes"):
         return True
@@ -179,6 +208,9 @@ def build_parser() -> argparse.ArgumentParser:
                         "(2 on TPU, 0 on the CPU backend where there is no "
                         "host->device link to overlap); 0 = serialized")
     p.add_argument("--num_devices", type=int, default=0, help="0 = all visible chips")
+    p.add_argument("--bucket_multiple", type=int, default=64,
+                   help="sequence lengths padded up to a multiple of this "
+                        "(fewer jit shapes; more padding)")
     p.add_argument("--tensor_parallel", type=int, default=1,
                    help="shard every streamed layer's matmuls over this many "
                         "chips (Megatron layout over ICI); cuts per-chip "
@@ -224,6 +256,7 @@ def config_from_args(args: argparse.Namespace) -> FrameworkConfig:
         block_size=args.block_size,
         prefetch_depth=args.prefetch_depth,
         num_devices=args.num_devices,
+        bucket_multiple=args.bucket_multiple,
         tensor_parallel=args.tensor_parallel,
         use_pallas=args.use_pallas,
         verbose_metrics=args.verbose_metrics,
@@ -568,6 +601,14 @@ def main(argv: list[str] | None = None, tokenizer=None) -> None:
         return serve_main(argv[1:], tokenizer=tokenizer)
     if argv and argv[0] == "verify":
         return verify_main(argv[1:])
+    if argv and argv[0] == "check":
+        # flscheck: the project-invariant static analyzer (docs/analysis.md).
+        from flexible_llm_sharding_tpu.analysis import main as check_main
+
+        rc = check_main(argv[1:])
+        if rc:
+            raise SystemExit(rc)
+        return None
     args = build_parser().parse_args(argv)
     print(args, file=sys.stderr)
     if (args.top_k or args.top_p) and args.temperature <= 0:

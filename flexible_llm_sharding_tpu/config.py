@@ -24,7 +24,10 @@ SUPPORTED_ACTIVATIONS = frozenset({"silu", "gelu", "gelu_pytorch_tanh"})
 
 # Named fault-injection sites (faults/inject.py fires these; config
 # validation and the --chaos CLI flag key off this tuple so a typo'd site
-# fails loudly instead of silently injecting nothing). The corrupt_* sites
+# fails loudly instead of silently injecting nothing). Machine-checked by
+# flscheck's SITE-REG rule (analysis/rules.py): every literal fired in the
+# package must be registered here AND documented in docs/faults.md's site
+# table, and every entry here must actually be fired somewhere. The corrupt_* sites
 # are SILENT-corruption sites: instead of raising, they bit-flip (or
 # truncate) the bytes mid-flight — what the integrity layer's checksums
 # exist to catch (corrupt_shard: one layer file's loaded tensors;

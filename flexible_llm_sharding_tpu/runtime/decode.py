@@ -332,7 +332,7 @@ def kv_fits_on_chip(
 
     try:
         hbm_gb = chip_hbm_gb(device)
-    except Exception:
+    except Exception:  # flscheck: disable=EXC-TAXONOMY: the residency auto-gate degrades to off on ANY probe failure (backends raise anything here); off is always correct, just slower
         return False
     if not hbm_gb:
         return False
@@ -527,7 +527,7 @@ class DecodeGenerator:
 
         try:
             return chip_hbm_gb(self._probe_dev)
-        except Exception:
+        except Exception:  # flscheck: disable=EXC-TAXONOMY: unknown-HBM probe degrades to None (auto gates resolve to off); off is always correct, just slower
             return None
 
     def _weight_bytes(self) -> float:

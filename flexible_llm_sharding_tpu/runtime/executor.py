@@ -28,7 +28,7 @@ import os
 import threading
 import time
 from functools import partial
-from queue import Queue
+from queue import Empty, Full, Queue
 from typing import Any, Callable, Sequence
 
 import jax
@@ -751,7 +751,7 @@ def _reraise_from_producer(exc: BaseException) -> None:
     to raising the original object."""
     try:
         clone = type(exc)(*exc.args)
-    except Exception:
+    except Exception:  # flscheck: disable=EXC-TAXONOMY: an exception constructor may raise anything; the fallback below re-raises the original object instead
         clone = None
     if clone is None or type(clone) is not type(exc):
         raise exc
@@ -1067,7 +1067,7 @@ class ShardWeightSource:
         while True:
             try:
                 self._q.get_nowait()
-            except Exception:
+            except Empty:
                 break
 
     def close(self, join_timeout_s: float = 10.0) -> None:
@@ -1092,13 +1092,13 @@ class ShardWeightSource:
                         break  # abandoned, self-terminates via _stop
                     try:
                         self._q.get_nowait()
-                    except Exception:
+                    except Empty:
                         self._thread.join(timeout=0.1)
                 self._thread = None
             while not self._q.empty():
                 try:
                     self._q.get_nowait()
-                except Exception:
+                except Empty:
                     break
             # Retire the loader's native readahead pool promptly — a source
             # is created per executor call and sits in a reference cycle
@@ -1164,8 +1164,6 @@ class ShardWeightSource:
 
     # -- prefetch thread ---------------------------------------------------
     def _put(self, item) -> bool:
-        from queue import Full
-
         while True:
             # Stop is re-checked BEFORE every put attempt, including the
             # first: close()/abort() may fire between building the item and
@@ -1197,7 +1195,7 @@ class ShardWeightSource:
                     elif self.cycle:
                         self._loader.warm(self._stream_only(self.shards[0]))
                     item = self._build_shard(idxs, dev)
-                except Exception as e:
+                except Exception as e:  # flscheck: disable=EXC-TAXONOMY: EVERY producer error must travel to the consumer as a _ShardFault envelope — narrowing would let an unexpected type kill the thread and hang the consumer's get
                     # Surface to the consumer at this shard's position, but
                     # keep the thread ALIVE: retries are already exhausted
                     # inside _build_shard, yet one persistently bad shard
@@ -1217,8 +1215,6 @@ class ShardWeightSource:
         """Queue get that close()/abort() can unblock: a consumer must never
         hang forever on a queue whose producer died or whose source a
         watchdog aborted."""
-        from queue import Empty
-
         while True:
             try:
                 return self._q.get(timeout=0.2)
@@ -1324,8 +1320,6 @@ class BroadcastShardSource:
         return self._loader.load_time
 
     def _put(self, rank: int, item) -> bool:
-        from queue import Full
-
         while not self._stop.is_set():
             try:
                 self._queues[rank].put(item, timeout=0.2)
@@ -1354,7 +1348,7 @@ class BroadcastShardSource:
                         for kind, val in parts:
                             if kind == "pin":
                                 self._residency.note_skip(val)
-                except Exception as e:
+                except Exception as e:  # flscheck: disable=EXC-TAXONOMY: every producer error must reach ALL ranks as a _ShardFault envelope — a narrowed miss would hang every consumer
                     # Broadcast streams are offline (one DP run): every rank
                     # sees the failure and the run fails, so no per-shard
                     # survival here — but the envelope keeps the typed
@@ -1370,7 +1364,7 @@ class BroadcastShardSource:
                             parts, dev, self._loader.np_dtype,
                             self._residency, self._loader,
                         )
-                    except Exception as e:
+                    except Exception as e:  # flscheck: disable=EXC-TAXONOMY: per-rank placement errors also travel as envelopes to every rank (same hang hazard as above)
                         for r2 in range(len(self.devices)):
                             self._put(r2, _ShardFault(e))
                         return
@@ -1387,14 +1381,14 @@ class BroadcastShardSource:
             for q in self._queues:
                 try:
                     q.get_nowait()
-                except Exception:
+                except Empty:
                     pass
             self._thread.join(timeout=0.1)
         for q in self._queues:
             while not q.empty():
                 try:
                     q.get_nowait()
-                except Exception:
+                except Empty:
                     break
         self._loader.close()
 
@@ -1433,8 +1427,6 @@ class _BroadcastView:
         return self._parent._loader.host_casts
 
     def __iter__(self):
-        from queue import Empty
-
         q = self._parent._queues[self._rank]
         for idxs in self._parent.shards:
             while True:  # get with stop-check so close() can unblock us
@@ -1740,7 +1732,7 @@ class StreamingExecutor:
             # which also acts as the final write barrier.)
             try:
                 store.clear()
-            except Exception:
+            except Exception:  # flscheck: disable=EXC-TAXONOMY: best-effort cleanup on the error path; the _stream exception re-raised below is the root cause and must not be masked
                 pass  # the _stream exception is the root cause; keep it
             raise
         finally:

@@ -113,9 +113,9 @@ class HostShardCache:
         self._lock = threading.RLock()
         self.budget_bytes = int(budget_bytes)
         # key -> (segments, nbytes, ((path, (mtime_ns, size)), ...))
-        self._entries: "OrderedDict[Any, tuple[Any, int, tuple]]" = OrderedDict()
-        self._by_path: dict[str, set] = {}
-        self.bytes = 0
+        self._entries: "OrderedDict[Any, tuple[Any, int, tuple]]" = OrderedDict()  # guarded by: _lock
+        self._by_path: dict[str, set] = {}  # guarded by: _lock
+        self.bytes = 0  # guarded by: _lock
         self.hits = 0
         self.misses = 0
         self.evictions = 0
@@ -191,6 +191,7 @@ class HostShardCache:
             return True
 
     def _drop(self, key) -> None:
+        # flscheck: holds=_lock: internal helper — every caller already owns the lock
         segments, nbytes, guard = self._entries.pop(key)
         self.bytes -= nbytes
         for p, _ in guard:

@@ -159,14 +159,14 @@ def auto_pin_budget_bytes(device=None) -> int:
         )
 
         stats = device_memory_stats(device)
-    except Exception:
+    except Exception:  # flscheck: disable=EXC-TAXONOMY: auto budget resolves to off (0) on ANY probe failure — backends raise anything from ImportError to RuntimeError here
         return 0
     limit = stats.get("bytes_limit")
     in_use = stats.get("bytes_in_use", 0.0)
     if not limit:
         try:
             hbm = chip_hbm_gb(device)
-        except Exception:
+        except Exception:  # flscheck: disable=EXC-TAXONOMY: unknown-HBM probes degrade to off, never fail the caller
             hbm = None
         if not hbm:
             return 0
@@ -271,24 +271,24 @@ class DeviceResidencyTier:
     ):
         self.model_path = model_path
         self.layer_names = list(layer_names)
-        self.plan = plan
+        self.plan = plan  # guarded by: _lock
         self._lock = threading.RLock()
         # (placement key, idx) -> Event while a pin load is in flight: the
         # slow work (disk read, checksum, retry ladder, device placement)
         # runs OFF the tier lock so stats()/note_skip()/other pins never
         # stall behind one load's backoff deadline; concurrent callers of
         # the same pin wait on the event instead of loading a duplicate.
-        self._inflight: dict[tuple, threading.Event] = {}
-        self._failed: set[int] = set()
+        self._inflight: dict[tuple, threading.Event] = {}  # guarded by: _lock
+        self._failed: set[int] = set()  # guarded by: _lock
         # idx -> host-tree bytes at pin time (the exact per-sweep link
         # bytes a skip saves; recorded once, device-independent).
-        self._host_nbytes: dict[int, int] = {}
+        self._host_nbytes: dict[int, int] = {}  # guarded by: _lock
         # Planner's byte estimates, dict-shaped once: note_skip runs under
         # the lock on every shard build of every sweep.
-        self._plan_bytes: dict[int, int] = dict(plan.layer_bytes)
+        self._plan_bytes: dict[int, int] = dict(plan.layer_bytes)  # guarded by: _lock
         # placement key -> {idx: placed segment list}
-        self._placed: dict[tuple, dict[int, list]] = {}
-        self._dev_bytes: dict[tuple, int] = {}
+        self._placed: dict[tuple, dict[int, list]] = {}  # guarded by: _lock
+        self._dev_bytes: dict[tuple, int] = {}  # guarded by: _lock
         self.pin_hits = 0
         self.stream_bytes_saved = 0
         self.pin_loads = 0
@@ -378,7 +378,7 @@ class DeviceResidencyTier:
                 continue
             try:
                 self.segments(i, device, loader)
-            except Exception:
+            except Exception:  # flscheck: disable=EXC-TAXONOMY: pre-pin is best-effort; segments() already demoted the layer and the streamed path surfaces its typed error
                 pass  # demoted inside segments(); streamed path reports
 
     def pin_from_host(self, idx: int, device, host, np_dtype) -> None:
@@ -423,7 +423,7 @@ class DeviceResidencyTier:
                 continue
             try:
                 host = loader.build_host_shard((i,))
-            except Exception:
+            except Exception:  # flscheck: disable=EXC-TAXONOMY: any pin-load failure demotes the layer to streaming, where the typed error surfaces
                 # Same demotion rule as segments(): never pin unverified
                 # bytes; the streamed path surfaces the typed error.
                 with self._lock:
@@ -433,7 +433,7 @@ class DeviceResidencyTier:
             for d in missing:
                 try:
                     self.pin_from_host(i, d, host, loader.np_dtype)
-                except Exception:
+                except Exception:  # flscheck: disable=EXC-TAXONOMY: placement failure demotes the layer; streaming it everywhere keeps segment structure uniform
                     # Placement failure demotes too (mirrors segments());
                     # copies already seated on other chips sit unused —
                     # frozen_pinned excludes the layer, so it streams
@@ -497,11 +497,21 @@ class DeviceResidencyTier:
         """Re-plan under a new budget. Shrink drops layers from the PLAN
         (future sources stream them; live sources keep their frozen sets
         and the already-placed trees stay until process exit — dropping
-        them under a live source would desync its segment structure)."""
+        them under a live source would desync its segment structure).
+
+        The re-plan stats every layer file on disk, so it runs OFF the
+        tier lock (a wedged filesystem must not stall note_skip/stats on
+        the hot path); only the plan swap happens inside. Two concurrent
+        re-plans race benignly: last swap wins, both plans are
+        self-consistent snapshots."""
+        plan = plan_residency(
+            self.model_path, self.layer_names, budget_bytes, tied_embeddings
+        )
+        self._install_plan(plan)
+
+    def _install_plan(self, plan: ResidencyPlan) -> None:
         with self._lock:
-            self.plan = plan_residency(
-                self.model_path, self.layer_names, budget_bytes, tied_embeddings
-            )
+            self.plan = plan
 
 
 def checkpoint_unavailable(name: str):
@@ -546,26 +556,98 @@ def tier_for(
         bool(tied_embeddings),
     )
     global _PROCESS_TIER, _PROCESS_TIER_KEY, _PROCESS_BUDGET_EXPLICIT
+    # Planning stats every layer file on disk, so it never runs under
+    # _PROCESS_LOCK (a wedged filesystem would stall process_tier() and
+    # every source construction in the process): decide under the lock,
+    # plan outside, install/adjust under the lock again.
+    resize = False
+    with _PROCESS_LOCK:
+        tier = (
+            _PROCESS_TIER
+            if _PROCESS_TIER is not None and _PROCESS_TIER_KEY == key
+            else None
+        )
+        if tier is not None:
+            if explicit:
+                resize = tier.plan.budget_bytes != budget
+                if not resize:
+                    # The cap is already in effect; when a resize IS
+                    # needed the latch waits for the install (a failed
+                    # off-lock re-plan must not leave the process marked
+                    # explicit with the cap never applied, permanently
+                    # blocking auto growth).
+                    _PROCESS_BUDGET_EXPLICIT = True
+            else:
+                resize = (
+                    not _PROCESS_BUDGET_EXPLICIT
+                    and budget > tier.plan.budget_bytes
+                )
+    if tier is not None:
+        if resize:
+            _apply_process_budget(tier, budget, explicit, tied_embeddings)
+        return tier
+    plan = plan_residency(cfg.model_path, layer_names, budget, tied_embeddings)
     with _PROCESS_LOCK:
         if _PROCESS_TIER is not None and _PROCESS_TIER_KEY == key:
+            # Lost the install race to a concurrent first caller: reuse the
+            # winner's tier, but still apply THIS caller's budget
+            # precedence — an explicit cap must pin the process budget
+            # (and resize to it) even when an auto caller won the install,
+            # or a later auto call could grow past the pinned cap.
             tier = _PROCESS_TIER
             if explicit:
-                if tier.plan.budget_bytes != budget:
-                    tier.set_budget(budget, tied_embeddings)
-                _PROCESS_BUDGET_EXPLICIT = True
-            elif (
-                not _PROCESS_BUDGET_EXPLICIT
-                and budget > tier.plan.budget_bytes
-            ):
-                tier.set_budget(budget, tied_embeddings)
-            return tier
+                resize = tier.plan.budget_bytes != budget
+                if not resize:
+                    _PROCESS_BUDGET_EXPLICIT = True
+            else:
+                resize = (
+                    not _PROCESS_BUDGET_EXPLICIT
+                    and budget > tier.plan.budget_bytes
+                )
+        else:
+            _PROCESS_TIER = DeviceResidencyTier(cfg.model_path, layer_names, plan)
+            _PROCESS_TIER_KEY = key
+            _PROCESS_BUDGET_EXPLICIT = explicit
+            return _PROCESS_TIER
+    if resize:
+        # Reuse the plan computed above — it was planned for exactly this
+        # budget; re-planning would repeat the full disk-stat sweep.
+        _apply_process_budget(tier, budget, explicit, tied_embeddings, plan=plan)
+    return tier
+
+
+def _apply_process_budget(
+    tier: DeviceResidencyTier,
+    budget: int,
+    explicit: bool,
+    tied_embeddings: bool,
+    plan: ResidencyPlan | None = None,
+) -> None:
+    """Re-plan ``tier`` to ``budget`` and install the plan iff this
+    caller's budget precedence STILL holds at install time. Planning stats
+    every layer file off all locks, so another caller can land while this
+    one is planning — without the re-check under _PROCESS_LOCK, a late
+    last-swap-wins install would silently override an explicitly pinned
+    cap, and of two racing auto growers the SMALLER budget could land
+    last (auto must only ever grow). Callers that already planned for
+    exactly ``budget`` (the tier_for install-race loser) pass ``plan`` to
+    skip the second disk-stat sweep."""
+    if plan is None:
         plan = plan_residency(
-            cfg.model_path, layer_names, budget, tied_embeddings
+            tier.model_path, tier.layer_names, budget, tied_embeddings
         )
-        _PROCESS_TIER = DeviceResidencyTier(cfg.model_path, layer_names, plan)
-        _PROCESS_TIER_KEY = key
-        _PROCESS_BUDGET_EXPLICIT = explicit
-        return _PROCESS_TIER
+    global _PROCESS_BUDGET_EXPLICIT
+    with _PROCESS_LOCK:
+        if explicit:
+            # Latch only here, with the plan in hand: the install and the
+            # explicit mark land together, so a re-plan failure above
+            # leaves the process un-marked and auto growth alive.
+            _PROCESS_BUDGET_EXPLICIT = True
+        elif _PROCESS_BUDGET_EXPLICIT or budget <= tier.plan.budget_bytes:
+            # An explicit cap was pinned, or a bigger auto budget was
+            # installed, while we planned; either way it wins.
+            return
+        tier._install_plan(plan)
 
 
 def process_tier() -> DeviceResidencyTier | None:

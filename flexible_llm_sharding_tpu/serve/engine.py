@@ -276,7 +276,7 @@ class ServeEngine:
     def _run(self) -> None:
         try:
             self._acquire_weights()
-        except BaseException as e:  # noqa: BLE001 — surfaced via futures
+        except BaseException as e:  # noqa: BLE001 — surfaced via futures  # flscheck: disable=EXC-TAXONOMY: daemon-thread boundary — the error is surfaced through every pending future via _fatal, never swallowed
             self._fatal(e)
             return
         wd = None
@@ -329,7 +329,7 @@ class ServeEngine:
                         wd.disarm()
                 self._post_sweep(time.perf_counter() - t0)
                 self.metrics.maybe_emit(self.serve_cfg.stats_interval_s)
-        except BaseException as e:  # noqa: BLE001
+        except BaseException as e:  # noqa: BLE001  # flscheck: disable=EXC-TAXONOMY: daemon-thread boundary — engine-fatal errors resolve every in-flight and queued future with the root cause
             self._fatal(e)
         finally:
             if wd is not None:
@@ -497,7 +497,25 @@ class ServeEngine:
                 slots=slots,
             )
             return True
-        except Exception as e:
+        except (
+            ValueError,
+            KeyError,
+            TypeError,
+            IndexError,
+            MemoryError,
+            RuntimeError,
+        ) as e:
+            # The typed workload-rejection family: tokenizer errors and the
+            # longrope straddle raise ValueError, malformed requests
+            # KeyError/TypeError/IndexError (an empty suffix tuple indexes
+            # an empty token array), an oversized prompt MemoryError (there
+            # is no admission-side length cap, so allocation is where a
+            # huge request first fails — it must reject that wave, not
+            # shut the engine down), XLA shape/compile problems
+            # RuntimeError. Anything OUTSIDE it is an engine bug, not a
+            # bad request — it escapes to _run's fatal path so the root
+            # cause surfaces instead of masquerading as a per-wave
+            # rejection forever.
             for r in wave.requests:
                 if not r.status.terminal:
                     r.fail(e, RequestStatus.FAILED)

@@ -39,8 +39,8 @@ class AdmissionQueue:
         self._metrics = metrics  # utils.metrics.ServingMetrics or None
         self._injector = injector  # faults.inject.FaultInjector or None
         self._lock = threading.Lock()
-        self._items: deque[Request] = deque()
-        self._closed = False
+        self._items: deque[Request] = deque()  # guarded by: _lock
+        self._closed = False  # guarded by: _lock
 
     # -- submit side -------------------------------------------------------
 
@@ -54,7 +54,7 @@ class AdmissionQueue:
             # into the submitter; a latency fault just delays admission.
             try:
                 self._injector.fire("queue_admission")
-            except Exception as e:
+            except Exception as e:  # flscheck: disable=EXC-TAXONOMY: ANY injected front-door fault resolves as a reasoned rejection through the request future — never an unhandled raise into the submitter
                 request.fail(e, RequestStatus.REJECTED)
                 if self._metrics is not None:
                     self._metrics.count("rejected")

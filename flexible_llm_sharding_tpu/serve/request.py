@@ -160,7 +160,7 @@ class Request:
         if self.callback is not None:
             try:
                 self.callback(self)
-            except Exception:
+            except Exception:  # flscheck: disable=EXC-TAXONOMY: user-supplied callback — a bug in it must not take down the serving loop (the request itself already resolved)
                 pass  # a callback bug must not take down the serving loop
 
     def resolve(self, scores: np.ndarray, updated: Prompt,

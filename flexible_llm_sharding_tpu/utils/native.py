@@ -47,6 +47,7 @@ def _host_build_tag() -> str:
 def _load_lib() -> ctypes.CDLL | None:
     """Compile (once) and load the native library; None if unavailable."""
     global _lib, _lib_failed
+    # flscheck: disable=LOCK-IO: one-time lazy compile+dlopen behind double-checked caching; every later call returns at the top of the block, and first-callers must genuinely wait for the build
     with _lib_lock:
         if _lib is not None or _lib_failed:
             return _lib
@@ -198,11 +199,20 @@ class FilePrefetcher:
     def wait_all(self) -> None:
         with self._close_lock:
             if self._handle is not None:
+                # The native arm waits UNDER the fence on purpose:
+                # fp_wait_all racing a concurrent close()'s fp_destroy is a
+                # use-after-free, and the stall is bounded (queued kernel
+                # readaheads complete on their own). Only the Python-pool
+                # arm below can await off the lock — its futures outlive a
+                # concurrent shutdown safely.
                 self._lib.fp_wait_all(self._handle)
-            else:
-                for f in self._futures:
-                    f.result()
-                self._futures.clear()
+                return
+            pending, self._futures = self._futures, []
+        # Awaited OFF the fence lock: a slow warm (cold disk, deep queue)
+        # must not block a concurrent prefetch()/close() on the lock —
+        # the snapshot-swap above keeps the handoff race-free.
+        for f in pending:
+            f.result()
 
     def close(self) -> None:
         with self._close_lock:

@@ -1,0 +1,65 @@
+#!/usr/bin/env python3
+"""CI ratchet for the flscheck baseline: the committed suppression set may
+only SHRINK.
+
+Usage: baseline_ratchet.py OLD.json NEW.json
+
+- Every fingerprint in NEW must already exist in OLD (no new grandfathered
+  findings — new code fixes its findings or pragmas them in place, with a
+  reason, where reviewers see them).
+- Every NEW entry must carry a real reason (non-empty, not TODO) — flscheck
+  itself enforces this too; checked here so a hand-edited baseline can't
+  slip past with a stale analyzer.
+- OLD missing (first PR that introduces the baseline, or a branch cut
+  before it existed) is treated as EMPTY: a first committed baseline must
+  itself be empty — new code fixes or pragmas its findings in place.
+
+Exit 0 = ok, 1 = ratchet violated.
+"""
+
+import json
+import sys
+
+
+def entries(path: str) -> dict[str, dict]:
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except (OSError, ValueError):  # missing file / empty (/dev/null) / bad json
+        return {}
+    return {e.get("fingerprint", ""): e for e in data.get("entries", [])}
+
+
+def main(argv: list[str]) -> int:
+    if len(argv) != 2:
+        print(__doc__, file=sys.stderr)
+        return 1
+    old, new = entries(argv[0]), entries(argv[1])
+    rc = 0
+    for fp, e in sorted(new.items()):
+        reason = (e.get("reason") or "").strip()
+        if not reason or reason.upper().startswith("TODO"):
+            print(
+                f"baseline entry {fp} ({e.get('rule')} at {e.get('path')}) "
+                "has no real reason string",
+                file=sys.stderr,
+            )
+            rc = 1
+        if fp not in old:
+            print(
+                f"baseline GREW: new entry {fp} ({e.get('rule')} at "
+                f"{e.get('path')}) — fix the finding or pragma it in place "
+                "with a reason; the committed baseline only shrinks",
+                file=sys.stderr,
+            )
+            rc = 1
+    if rc == 0:
+        print(
+            f"baseline ratchet ok: {len(new)} entr(y/ies), "
+            f"{len(old) - len(new) if old else 0} removed vs base"
+        )
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

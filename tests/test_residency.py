@@ -169,6 +169,161 @@ def test_explicit_budget_pins_tier_against_auto_growth(model_dir):
     assert again is capped and again.plan.budget_bytes == int(5e8)
 
 
+def test_tier_for_install_race_applies_losers_explicit_cap(model_dir, monkeypatch):
+    # An explicit-cap caller that loses the install race to a concurrent
+    # auto-budget caller must still pin the process budget (and resize the
+    # winner's tier to its cap) — otherwise a later auto call could grow
+    # past the explicitly pinned cap.
+    names = layer_names_for(4)
+    real_plan = residency.plan_residency
+    raced = []
+    loser_plans = []
+
+    def racing_plan(path, layer_names, budget_bytes, tied_embeddings=False):
+        if budget_bytes == int(5e8):
+            loser_plans.append(budget_bytes)
+        plan = real_plan(path, layer_names, budget_bytes, tied_embeddings)
+        if not raced:
+            raced.append(True)
+            # While the explicit caller plans off the lock, an auto caller
+            # wins the install with a bigger budget.
+            key = (
+                os.path.abspath(model_dir), "float32", False,
+                tuple(layer_names), bool(tied_embeddings),
+            )
+            with residency._PROCESS_LOCK:
+                residency._PROCESS_TIER = residency.DeviceResidencyTier(
+                    model_dir, layer_names,
+                    real_plan(path, layer_names, int(2e9), tied_embeddings),
+                )
+                residency._PROCESS_TIER_KEY = key
+                residency._PROCESS_BUDGET_EXPLICIT = False
+        return plan
+
+    monkeypatch.setattr(residency, "plan_residency", racing_plan)
+    tier = residency.tier_for(
+        _fw(model_dir, hbm_pin_gb=0.5), names, False, None
+    )
+    assert tier is residency.process_tier()  # reused the winner's tier
+    assert tier.plan.budget_bytes == int(5e8)  # loser's explicit cap applied
+    assert residency._PROCESS_BUDGET_EXPLICIT is True
+    # The loser's pre-lock plan was reused for the resize — no second
+    # disk-stat sweep at its budget.
+    assert loser_plans == [int(5e8)]
+
+
+def test_auto_grow_apply_revalidates_against_explicit_cap(model_dir, monkeypatch):
+    # An auto grower that decided to resize BEFORE an explicit cap landed
+    # must re-validate at install time and skip — planning runs off every
+    # lock, so its late last-swap-wins install would otherwise silently
+    # override the pinned cap.
+    names = layer_names_for(4)
+    real_plan = residency.plan_residency
+    auto_budget = [int(1e9)]
+    monkeypatch.setattr(
+        FrameworkConfig,
+        "effective_hbm_pin_bytes",
+        lambda self, device=None: (
+            auto_budget[0]
+            if self.hbm_pin_gb is None
+            else int(self.hbm_pin_gb * 1e9)
+        ),
+    )
+    seeded = residency.tier_for(_fw(model_dir, hbm_pin_gb=None), names, False, None)
+    assert seeded is not None and not residency._PROCESS_BUDGET_EXPLICIT
+    auto_budget[0] = int(2e9)
+    raced = []
+
+    def racing_plan(path, layer_names, budget_bytes, tied_embeddings=False):
+        if budget_bytes == int(2e9) and not raced:
+            raced.append(True)
+            # The explicit cap lands while the auto grower is planning.
+            residency.tier_for(
+                _fw(model_dir, hbm_pin_gb=0.5), names, False, None
+            )
+        return real_plan(path, layer_names, budget_bytes, tied_embeddings)
+
+    monkeypatch.setattr(residency, "plan_residency", racing_plan)
+    grown = residency.tier_for(_fw(model_dir, hbm_pin_gb=None), names, False, None)
+    assert grown is seeded
+    assert grown.plan.budget_bytes == int(5e8)  # the explicit cap held
+    assert residency._PROCESS_BUDGET_EXPLICIT is True
+
+
+def test_auto_grow_apply_revalidates_against_bigger_auto(model_dir, monkeypatch):
+    # Two auto growers race: the one with the SMALLER budget can finish
+    # planning last, and its install must skip — auto only ever grows the
+    # budget, a property the pre-off-lock code enforced atomically.
+    names = layer_names_for(4)
+    real_plan = residency.plan_residency
+    auto_budget = [int(1e9)]
+    monkeypatch.setattr(
+        FrameworkConfig,
+        "effective_hbm_pin_bytes",
+        lambda self, device=None: (
+            auto_budget[0]
+            if self.hbm_pin_gb is None
+            else int(self.hbm_pin_gb * 1e9)
+        ),
+    )
+    seeded = residency.tier_for(_fw(model_dir, hbm_pin_gb=None), names, False, None)
+    assert seeded is not None and seeded.plan.budget_bytes == int(1e9)
+    auto_budget[0] = int(15e8)
+    raced = []
+
+    def racing_plan(path, layer_names, budget_bytes, tied_embeddings=False):
+        if budget_bytes == int(15e8) and not raced:
+            raced.append(True)
+            # A bigger auto grower lands while this one is planning.
+            auto_budget[0] = int(2e9)
+            residency.tier_for(
+                _fw(model_dir, hbm_pin_gb=None), names, False, None
+            )
+        return real_plan(path, layer_names, budget_bytes, tied_embeddings)
+
+    monkeypatch.setattr(residency, "plan_residency", racing_plan)
+    grown = residency.tier_for(_fw(model_dir, hbm_pin_gb=None), names, False, None)
+    assert grown is seeded
+    assert grown.plan.budget_bytes == int(2e9)  # the bigger grower won
+    assert residency._PROCESS_BUDGET_EXPLICIT is False
+
+
+def test_failed_explicit_resize_does_not_latch_explicit(model_dir, monkeypatch):
+    # The explicit mark must land WITH the install: if the off-lock
+    # re-plan fails (transient disk error stat'ing layer files), the cap
+    # was never applied and the process must not be marked explicit —
+    # that would permanently block auto growth at the stale budget.
+    names = layer_names_for(4)
+    real_plan = residency.plan_residency
+    auto_budget = [int(1e9)]
+    monkeypatch.setattr(
+        FrameworkConfig,
+        "effective_hbm_pin_bytes",
+        lambda self, device=None: (
+            auto_budget[0]
+            if self.hbm_pin_gb is None
+            else int(self.hbm_pin_gb * 1e9)
+        ),
+    )
+    seeded = residency.tier_for(_fw(model_dir, hbm_pin_gb=None), names, False, None)
+    assert seeded is not None and seeded.plan.budget_bytes == int(1e9)
+
+    def failing_plan(path, layer_names, budget_bytes, tied_embeddings=False):
+        if budget_bytes == int(5e8):
+            raise OSError("transient stat failure")
+        return real_plan(path, layer_names, budget_bytes, tied_embeddings)
+
+    monkeypatch.setattr(residency, "plan_residency", failing_plan)
+    with pytest.raises(OSError):
+        residency.tier_for(_fw(model_dir, hbm_pin_gb=0.5), names, False, None)
+    assert residency._PROCESS_BUDGET_EXPLICIT is False
+    assert seeded.plan.budget_bytes == int(1e9)  # untouched
+    auto_budget[0] = int(2e9)
+    grown = residency.tier_for(_fw(model_dir, hbm_pin_gb=None), names, False, None)
+    assert grown is seeded
+    assert grown.plan.budget_bytes == int(2e9)  # auto growth still alive
+
+
 # ---------------------------------------------------------------------------
 # Offline parity + exact byte accounting
 # ---------------------------------------------------------------------------
