@@ -128,6 +128,10 @@ HEADLINE_KEYS = (
     "device_cast_speedup",
     "partial_residency_speedup",
     "pinned_fraction",
+    "trace_overhead_ratio",
+    "trace_overhead_ratio_spread",
+    "trace_overhead_ratio_inconclusive",
+    "trace_overhead_ratio_n",
     "device_kind",
 )
 
@@ -266,6 +270,7 @@ RATIO_SINGLETONS = (
     "device_cast_speedup",
     "partial_residency_speedup",
     "pinned_fraction",
+    "trace_overhead_ratio",
 )
 
 
@@ -329,6 +334,9 @@ PHASE_EVIDENCE_KEY = {
     "decode": "decode_speedup_4tok",
     "resident_mfu": "mfu_resident",
     "spec": "spec_mechanism_speedup",
+    # PR 8's satellite evidence: span tracing must not tax the hot path
+    # (rotation-paired trace-on vs trace-off sweep walls).
+    "trace_overhead": "trace_overhead_ratio",
 }
 
 
@@ -942,6 +950,60 @@ def bench_residency(
         residency.reset_process_tier()
 
 
+def bench_trace_overhead(
+    result: dict, prompts, tok, budget_left, fw
+) -> None:
+    """Observability-PR satellite evidence: the span tracer must be
+    effectively free, so it can stay compiled into every hot loop and be
+    switched on in production without a perf conversation.
+
+    ``trace_overhead_ratio``: full streaming sweep with tracing OFF vs
+    the same sweep with the tracer ENABLED (ring recording every span),
+    rotation-paired back-to-back like the hostcache/residency phases so
+    disk and scheduler drift cancel. ~1.0 means tracing-on costs noise;
+    a ratio sinking below ~0.85 means span recording has crept onto the
+    hot path. The trace-OFF arm is the production default path (the
+    per-emit cost there is one bool check), so the perf gate's advisory
+    floor on this ratio also pins that the no-op path stays a no-op —
+    tracing can never silently regress the hot path either way.
+    """
+    from flexible_llm_sharding_tpu.obs import trace as obs_trace
+
+    tracer = obs_trace.TRACER
+    was_enabled = tracer.enabled
+    try:
+        base = fw(None)
+        sub = prompts[: min(4, len(prompts))]
+        run_once(base, sub, tok)  # warm/compile outside both arms
+        ratios = []
+        for i in range(3):
+            tracer.disable()
+            _, w_off, _ = run_once(base, sub, tok)
+            tracer.enable()
+            try:
+                _, w_on, _ = run_once(base, sub, tok)
+            finally:
+                tracer.disable()
+                tracer.clear()  # a bench ring must not leak into a real run
+            ratios.append(w_off / w_on)
+            log(
+                f"trace-overhead pair {i}: off={w_off:.2f}s on={w_on:.2f}s "
+                f"ratio={ratios[-1]:.3f}"
+            )
+            if budget_left() < 0.7:
+                log("  trace-overhead pair budget exhausted; stopping reps")
+                break
+        _ratio_stats(result, "trace_overhead_ratio", ratios)
+        log(f"trace overhead: ratio={result['trace_overhead_ratio']}")
+    except Exception:
+        log("trace-overhead bench failed:\n" + traceback.format_exc())
+    finally:
+        if was_enabled:
+            tracer.enable()
+        else:
+            tracer.disable()
+
+
 def _timed(fn) -> float:
     t0 = time.perf_counter()
     fn()
@@ -1447,6 +1509,11 @@ def run_bench(result: dict) -> None:
         log("skipping residency bench (already captured)")
     else:
         bench_residency(result, model_path, prompts, tok, budget_left, fw)
+
+    if "trace_overhead" in skip:
+        log("skipping trace-overhead bench (already captured)")
+    else:
+        bench_trace_overhead(result, prompts, tok, budget_left, fw)
 
     # Host->HBM link bandwidth: the binding constraint of weight streaming;
     # makes every throughput number legible (the axon tunnel runs ~100x

@@ -800,6 +800,34 @@ _EXPORT_METHODS = frozenset({"stats", "snapshot"})
 _INTEGRITY_RECEIVERS = frozenset({"integrity", "_integrity"})
 
 
+def _class_registered_methods(cls: ast.ClassDef) -> frozenset:
+    """Method names THIS class registers as metrics-registry sources:
+    a ``<reg>.register("name", self.method)`` call anywhere in the class
+    body marks ``method`` as one of the class's export surfaces
+    (obs/registry.py collects registered sources into the Prometheus
+    exposition / --metrics_out dump), so a counter that reaches such a
+    method IS exported — the registry path satisfies COUNTER-EXPORT
+    exactly like stats()/snapshot() do. Scoped to ``self.<method>``
+    registrations inside the SAME class on purpose: a project-wide bag of
+    bare method names would let any class whose method merely shares a
+    name with someone else's registered source pass unexported."""
+    names: set[str] = set()
+    for node in ast.walk(cls):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "register"
+            and len(node.args) >= 2
+            and isinstance(node.args[0], ast.Constant)
+            and isinstance(node.args[0].value, str)
+            and isinstance(node.args[1], ast.Attribute)
+            and isinstance(node.args[1].value, ast.Name)
+            and node.args[1].value.id == "self"
+        ):
+            names.add(node.args[1].attr)
+    return frozenset(names)
+
+
 def _export_names(fns: list[ast.FunctionDef]) -> tuple[set[str], set[str]]:
     """(self.<attr> names, string constants) the export methods mention —
     exact AST nodes, so `self.hits_total` does not pass for `self.hits`
@@ -822,19 +850,27 @@ def _export_names(fns: list[ast.FunctionDef]) -> tuple[set[str], set[str]]:
 @project_rule(
     "COUNTER-EXPORT",
     "counters a class increments (self.x += n) must appear in its "
-    "stats()/snapshot() export; IntegrityRecorder.count() names must be "
-    "registered in its KEYS",
+    "stats()/snapshot() export or in a method the class itself registers "
+    "as a metrics-registry source (register(\"name\", self.method)); "
+    "IntegrityRecorder.count() names must be registered in its KEYS",
 )
 def counter_export(ctx: ProjectContext) -> list[Finding]:
     findings: list[Finding] = []
 
-    # 1. Class-attribute counters vs the class's own export method.
+    # 1. Class-attribute counters vs the class's export methods: the
+    #    canonical stats()/snapshot() names, plus any method THE CLASS
+    #    ITSELF registers into a metrics registry
+    #    (``reg.register("src", self.method)``) — registered sources land
+    #    in the Prometheus exposition and the --metrics_out dump, which
+    #    is precisely "exported".
     for info in ctx.files.values():
         for cls in [n for n in ast.walk(info.tree) if isinstance(n, ast.ClassDef)]:
+            registered = _class_registered_methods(cls)
             exporters = [
                 n
                 for n in cls.body
-                if isinstance(n, ast.FunctionDef) and n.name in _EXPORT_METHODS
+                if isinstance(n, ast.FunctionDef)
+                and (n.name in _EXPORT_METHODS or n.name in registered)
             ]
             if not exporters:
                 continue

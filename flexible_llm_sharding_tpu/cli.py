@@ -57,6 +57,8 @@ DRIVER_FLAGS = frozenset({
     "prompt_pickle", "output_file", "kv_cache",
     "coordinator_address", "num_processes", "process_id",
     "stagger_ms",
+    # One-shot metrics-registry JSON dump path (batch CLI output file).
+    "metrics_out",
 })
 
 
@@ -137,6 +139,23 @@ def _add_robustness_flags(p: argparse.ArgumentParser) -> None:
                    help="max head-stage score slices kept device-resident "
                         "before older ones resolve to host numpy (bigger = "
                         "fewer host syncs on big batches, more HBM)")
+
+
+def _add_observability_flags(p: argparse.ArgumentParser) -> None:
+    """Shared by the batch and serve parsers: sweep-timeline tracing
+    (obs/trace.py; docs/observability.md has the span model and the
+    Perfetto how-to)."""
+    p.add_argument("--trace", action="store_true",
+                   help="record the sweep timeline (shard loads, device "
+                        "puts, compute, source waits, cache hits, pin "
+                        "loads, retry/heal events, serve wave lifecycle) "
+                        "into a bounded ring, exported at run end to "
+                        "--trace_out; analyze with `trace-report` or load "
+                        "in Perfetto. Off = zero overhead")
+    p.add_argument("--trace_out", type=str, default="",
+                   help="trace export path (default fls_trace.json): "
+                        "Chrome trace-event JSON, or JSONL when the path "
+                        "ends in .jsonl")
 
 
 def _fault_config_from_args(args: argparse.Namespace) -> FaultConfig:
@@ -237,7 +256,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "omit for single-host")
     p.add_argument("--num_processes", type=int, default=None)
     p.add_argument("--process_id", type=int, default=None)
+    p.add_argument("--metrics_out", type=str, default="",
+                   help="write a one-shot JSON dump of the metrics "
+                        "registry (executor/stream/cache/residency/"
+                        "integrity counters — the machine-readable form "
+                        "of the final stats line) to this path at run end")
     _add_robustness_flags(p)
+    _add_observability_flags(p)
     return p
 
 
@@ -278,6 +303,8 @@ def config_from_args(args: argparse.Namespace) -> FrameworkConfig:
         hbm_pin_gb=args.hbm_pin_gb,
         readahead_threads=args.readahead_threads,
         score_sink_max_device=args.score_sink_max_device,
+        trace=args.trace,
+        trace_out=args.trace_out,
         faults=_fault_config_from_args(args),
     )
 
@@ -340,7 +367,15 @@ def build_serve_parser() -> argparse.ArgumentParser:
                         "that makes no shard progress for this long — the "
                         "stalled wave's requests fail with a structured "
                         "error instead of hanging forever (0 = off)")
+    p.add_argument("--metrics_port", type=int, default=None,
+                   help="serve a Prometheus /metrics endpoint (plus "
+                        "/metrics.json) on 127.0.0.1 at this port: queue "
+                        "depth, TTFT quantiles, streamed bytes, cache hit "
+                        "rate, residency savings, retry/heal/recovery "
+                        "counters in one scrape; 0 = ephemeral port, "
+                        "omit = off")
     _add_robustness_flags(p)
+    _add_observability_flags(p)
     # Demo driver: submit a prompt pickle at staggered times, write the
     # offline-contract outputs. Without it, requests are read as JSON lines
     # from stdin: {"prefix": ..., "suffixes": [...], "max_new_tokens": N}.
@@ -381,6 +416,8 @@ def serve_main(argv: list[str] | None = None, tokenizer=None) -> None:
         hbm_pin_gb=args.hbm_pin_gb,
         readahead_threads=args.readahead_threads,
         score_sink_max_device=args.score_sink_max_device,
+        trace=args.trace,
+        trace_out=args.trace_out,
         faults=_fault_config_from_args(args),
     )
     serve_cfg = ServeConfig(
@@ -391,6 +428,7 @@ def serve_main(argv: list[str] | None = None, tokenizer=None) -> None:
         default_deadline_s=args.deadline_s,
         stats_interval_s=args.stats_interval_s,
         watchdog_abort_s=args.watchdog_abort_s,
+        metrics_port=args.metrics_port,
     )
     if tokenizer is None:
         from transformers import AutoTokenizer
@@ -405,6 +443,13 @@ def serve_main(argv: list[str] | None = None, tokenizer=None) -> None:
     from flexible_llm_sharding_tpu.serve.request import RequestStatus
 
     engine = ServeEngine(cfg, serve_cfg, tokenizer=tokenizer)
+    if engine.metrics_server is not None:
+        print(
+            f"metrics endpoint: http://{engine.metrics_server.host}:"
+            f"{engine.metrics_server.port}/metrics",
+            file=sys.stderr,
+            flush=True,
+        )
     try:
         if args.prompt_pickle:
             with open(args.prompt_pickle, "rb") as f:
@@ -501,6 +546,21 @@ def serve_main(argv: list[str] | None = None, tokenizer=None) -> None:
         raise
     finally:
         engine.shutdown(drain=True)
+        # Trace export in the FINALLY: a run that died is exactly the run
+        # whose timeline (wave aborts, recoveries, watchdog stalls) the
+        # operator needs — exiting through the error paths above without
+        # writing it would discard the one diagnostic artifact tracing
+        # exists to produce.
+        if cfg.trace:
+            from flexible_llm_sharding_tpu.obs import trace as obs_trace
+
+            path = obs_trace.write_configured()
+            if path:
+                print(
+                    f"trace written -> {path} (analyze: `trace-report "
+                    f"--trace {path}`, or load in Perfetto)",
+                    file=sys.stderr,
+                )
     if engine.error is not None:
         raise SystemExit(f"serve engine failed: {engine.error!r}")
     print(json.dumps(engine.stats()), file=sys.stderr)
@@ -606,6 +666,16 @@ def main(argv: list[str] | None = None, tokenizer=None) -> None:
         from flexible_llm_sharding_tpu.analysis import main as check_main
 
         rc = check_main(argv[1:])
+        if rc:
+            raise SystemExit(rc)
+        return None
+    if argv and argv[0] == "trace-report":
+        # Trace analyzer (obs/report.py): link utilization, overlap
+        # efficiency, sweep breakdown, TTFT/token-latency quantiles from
+        # a --trace recording.
+        from flexible_llm_sharding_tpu.obs.report import main as report_main
+
+        rc = report_main(argv[1:])
         if rc:
             raise SystemExit(rc)
         return None
@@ -849,6 +919,27 @@ def main(argv: list[str] | None = None, tokenizer=None) -> None:
             for r, s in sorted(LAST_DP_RANK_STATS.items())
         }
     print(json.dumps(stats), file=sys.stderr)
+    if args.metrics_out:
+        # One-shot machine-readable dump: the metrics registry every
+        # subsystem registered into (executor stats, stream counters,
+        # host cache, residency tier, tracer) plus the final stats line —
+        # the scrapeable form of everything printed above.
+        from flexible_llm_sharding_tpu.obs.registry import REGISTRY
+
+        with open(args.metrics_out, "w") as f:
+            json.dump({"stats": stats, "metrics": REGISTRY.collect()}, f,
+                      indent=1)
+        print(f"metrics written -> {args.metrics_out}", file=sys.stderr)
+    if cfg.trace:
+        from flexible_llm_sharding_tpu.obs import trace as obs_trace
+
+        path = obs_trace.write_configured()
+        if path:
+            print(
+                f"trace written -> {path} (analyze: `trace-report --trace "
+                f"{path}`, or load in Perfetto)",
+                file=sys.stderr,
+            )
 
 
 if __name__ == "__main__":

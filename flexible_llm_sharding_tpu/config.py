@@ -853,6 +853,15 @@ class FrameworkConfig:
     tensor_parallel: int = 1
     verbose_metrics: bool = False  # one JSON line per structured event (stderr)
     profile_dir: str = ""  # jax.profiler trace output dir ("" = off)
+    # Sweep-timeline span tracing (obs/trace.py): record shard loads,
+    # device puts, compute, source waits, cache hits, pin loads, retry/
+    # heal events, and (serving) the wave lifecycle into a bounded ring,
+    # correlated by sweep_id/shard_idx/wave_id/request_id. Zero-cost
+    # no-op when False. The CLIs export at run end to ``trace_out``
+    # (Chrome trace-event JSON — Perfetto-loadable — or JSONL when the
+    # path ends in .jsonl); ``cli trace-report`` analyzes the file.
+    trace: bool = False
+    trace_out: str = ""  # "" = default fls_trace.json when trace is on
     resume: bool = False  # disk mode: resume from the last completed shard
     # Long context: prompts whose PREFIX exceeds max_token_len are scored
     # exactly via sequence parallelism (ring attention over an 'sp' mesh of
@@ -1163,6 +1172,13 @@ class ServeConfig:
     # structured WaveAborted instead of hanging forever), restarts the
     # source, and keeps serving. 0 = off.
     watchdog_abort_s: float = 0.0
+    # Prometheus metrics endpoint (obs/registry.py MetricsServer): serve
+    # /metrics (text exposition) and /metrics.json on 127.0.0.1 at this
+    # port — queue depth, TTFT quantiles, streamed bytes, cache hit rate,
+    # residency savings, retry/heal/recovery counters in one scrape.
+    # None = off; 0 = bind an ephemeral port (tests/parallel engines; the
+    # bound port is engine.metrics_server.port).
+    metrics_port: int | None = None
 
     def __post_init__(self) -> None:
         if self.queue_capacity < 1:
@@ -1183,3 +1199,8 @@ class ServeConfig:
             raise ValueError("stats_interval_s must be >= 0")
         if self.watchdog_abort_s < 0:
             raise ValueError("watchdog_abort_s must be >= 0")
+        if self.metrics_port is not None and not 0 <= self.metrics_port <= 65535:
+            raise ValueError(
+                "metrics_port must be in [0, 65535] (or None for off), "
+                f"got {self.metrics_port}"
+            )

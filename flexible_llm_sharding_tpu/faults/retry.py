@@ -22,6 +22,8 @@ import dataclasses
 import hashlib
 import time
 
+from flexible_llm_sharding_tpu.obs import trace as obs_trace
+
 
 class ShardLoadError(RuntimeError):
     """A shard's host load or device placement failed even after the retry
@@ -120,6 +122,10 @@ def retry_call(
                     else "deadline passed" if out_of_time
                     else "attempts exhausted"
                 )
+                obs_trace.instant(
+                    "io_exhausted", cat="faults", label=label or "call",
+                    attempts=attempt, why=why,
+                )
                 if wrap is not None:
                     raise wrap(
                         f"{label or 'call'}: giving up after {attempt} "
@@ -131,6 +137,12 @@ def retry_call(
                 delay = min(delay, max(0.0, deadline - time.monotonic()))
             if recorder is not None:
                 recorder.record(label, retries=1, backoff_s=delay)
+            # Retry visible on the timeline (correlates with the stalled
+            # shard_produce span above it); the ring append never blocks.
+            obs_trace.instant(
+                "io_retry", cat="faults", label=label or "call",
+                attempt=attempt, backoff_s=round(delay, 4),
+            )
             end = time.monotonic() + delay
             while True:
                 left = end - time.monotonic()

@@ -1,0 +1,17 @@
+"""Unified observability: span tracing, the metrics registry, and the
+trace analyzer.
+
+Submodules (imported directly — this package root stays import-light so
+hot-path modules can depend on it without cycles):
+
+- ``obs.trace``    bounded-ring span tracer with correlation ids
+  (sweep_id / shard_idx / wave_id / request_id), exported as Chrome
+  trace-event JSON (Perfetto-loadable) or JSONL. Zero-cost no-op when
+  disabled.
+- ``obs.registry`` the process metrics registry every subsystem's
+  counters register into, with Prometheus text exposition and an
+  optional HTTP endpoint (the serve engine's ``--metrics_port``).
+- ``obs.report``   the trace analyzer behind ``cli trace-report``:
+  link utilization, compute/stream overlap efficiency, per-phase sweep
+  breakdown, TTFT / per-token latency quantiles.
+"""

@@ -35,6 +35,7 @@ import numpy as np
 
 from flexible_llm_sharding_tpu.config import FrameworkConfig, LlamaConfig
 from flexible_llm_sharding_tpu.models import llama
+from flexible_llm_sharding_tpu.obs import trace as obs_trace
 from flexible_llm_sharding_tpu.parallel.planner import plan_shards_dp
 from flexible_llm_sharding_tpu.runtime.executor import (
     ShardWeightSource,
@@ -450,6 +451,13 @@ class DecodeGenerator:
             )
         self.weight_source_factory = weight_source_factory
         self._draft_fn = draft_fn if draft_fn is not None else propose_draft
+        from flexible_llm_sharding_tpu.obs.registry import (
+            REGISTRY,
+            weak_source,
+        )
+
+        obs_trace.ensure_configured(cfg)
+        REGISTRY.register("decode", weak_source(self))
         self.cfg = cfg
         self.model_cfg = LlamaConfig.from_pretrained(cfg.model_path)
         self.device = device
@@ -855,6 +863,21 @@ class DecodeGenerator:
                                 )
                         if layer_idxs[-1] != n_layers - 1:
                             kv_store.put(("x", b), x)
+
+            # Traced wrapper: every full-model decode walk is one "sweep"
+            # span (the offline counterpart of a serving sweep), so the
+            # timeline shows per-token weight passes with their shard
+            # loads/puts nested under the producer's stream spans.
+            _stream_pass_untraced = stream_pass
+
+            def stream_pass(embed_ids, decoders_fn, head_fn, skip_block=None):
+                sid = obs_trace.new_sweep_id() if obs_trace.enabled() else 0
+                with obs_trace.span(
+                    "sweep", cat="decode", sweep_id=sid, mode="decode_step",
+                ):
+                    return _stream_pass_untraced(
+                        embed_ids, decoders_fn, head_fn, skip_block
+                    )
 
             # --- decode steps ---------------------------------------------
             if fused:

@@ -49,6 +49,8 @@ from flexible_llm_sharding_tpu.integrity.manifest import (
     SpillCorruptError,
 )
 from flexible_llm_sharding_tpu.models import llama
+from flexible_llm_sharding_tpu.obs import trace as obs_trace
+from flexible_llm_sharding_tpu.obs.registry import REGISTRY as _OBS_REGISTRY
 from flexible_llm_sharding_tpu.parallel.planner import ShardPlan, plan_shards_dp
 from flexible_llm_sharding_tpu.runtime.activations import ActivationStore
 from flexible_llm_sharding_tpu.runtime.tokenization import (
@@ -352,6 +354,22 @@ def reset_process_streamed_bytes() -> None:
         _PROCESS_HOST_CASTS[0] = 0
 
 
+def stream_stats() -> dict[str, int]:
+    """The process-wide stream counters as ONE registry source — shared
+    by the process registry here and the serve engine's per-engine
+    registry, so the two surfaces can never drift."""
+    return {
+        "streamed_bytes": process_streamed_bytes(),
+        "host_casts": process_host_casts(),
+    }
+
+
+# The process-wide stream counters are registry citizens (obs/registry.py):
+# the serve metrics endpoint and the batch CLI's --metrics_out both expose
+# streamed bytes from here, the same numbers the stats lines print.
+_OBS_REGISTRY.register("stream", stream_stats)
+
+
 # Float dtypes the on-device cast path handles: uploaded in their stored
 # dtype (fp16/bf16 travel at half of fp32's link bytes; fp16<->bf16 at the
 # SAME bytes) and converted to the compute dtype inside one jitted program
@@ -554,6 +572,10 @@ class _HostShardLoader:
                 integrity_manifest.invalidate_verdict(path)
                 if self._integrity is not None:
                     self._integrity.count("quarantined_shards")
+                obs_trace.instant(
+                    "quarantine", cat="integrity", layer=name,
+                    mismatches=mismatches["n"],
+                )
                 raise ShardCorruptError(
                     f"{path}: checksum mismatch survived every re-read — "
                     "on-disk corruption; path quarantined (audit with the "
@@ -566,6 +588,10 @@ class _HostShardLoader:
             # integrity layer's whole value proposition.
             if self._integrity is not None:
                 self._integrity.count("reread_heals")
+            obs_trace.instant(
+                "reread_heal", cat="integrity", layer=name,
+                mismatches=mismatches["n"],
+            )
         return out
 
     def _load_one_raw(self, name: str) -> Params:
@@ -639,6 +665,20 @@ class _HostShardLoader:
         return jax.tree.map(one, tree, is_leaf=checkpoint.is_quantized_leaf)
 
     def build_host_shard(self, layer_idxs: tuple[int, ...]) -> list[tuple[str, Any]]:
+        # Traced wrapper: one "shard_load" span per host build (cache hits
+        # included — their near-zero duration IS the cache's evidence in
+        # the timeline; the hostcache emits its own hit/miss instants).
+        with obs_trace.span(
+            "shard_load",
+            cat="stream",
+            first=layer_idxs[0] if layer_idxs else -1,
+            n=len(layer_idxs),
+        ):
+            return self._build_host_shard(layer_idxs)
+
+    def _build_host_shard(
+        self, layer_idxs: tuple[int, ...]
+    ) -> list[tuple[str, Any]]:
         from flexible_llm_sharding_tpu.runtime.hostcache import stat_guard
 
         cache = self._host_cache
@@ -1131,34 +1171,42 @@ class ShardWeightSource:
         # like with like; load_time alone under-counts what overlap must
         # hide on a slow host->HBM link).
         t0 = time.perf_counter()
-        parts = _split_parts(self._loader, layer_idxs, self._pinned_idxs)
-        if self._residency is not None:
-            # Count the sweep's saved link bytes ONCE per build (the put
-            # below may retry; retries must not double-count).
-            for kind, val in parts:
-                if kind == "pin":
-                    self._residency.note_skip(val)
+        first = layer_idxs[0] if layer_idxs else -1
+        with obs_trace.span(
+            "shard_produce", cat="stream", first=first, n=len(layer_idxs)
+        ):
+            parts = _split_parts(self._loader, layer_idxs, self._pinned_idxs)
+            if self._residency is not None:
+                # Count the sweep's saved link bytes ONCE per build (the put
+                # below may retry; retries must not double-count).
+                for kind, val in parts:
+                    if kind == "pin":
+                        self._residency.note_skip(val)
 
-        # The host->device put retries under the same policy as the reads:
-        # through a wedged accelerator tunnel the transfer surfaces
-        # OSError/TimeoutError just like a flaky filesystem does. The
-        # 'device_put' fault site sits inside the retried region.
-        def put():
-            if self._injector is not None:
-                self._injector.fire("device_put", detail=str(layer_idxs))
-            return _assemble_parts(
-                parts, device, self._loader.np_dtype, self._residency,
-                self._loader,
-            )
+            # The host->device put retries under the same policy as the
+            # reads: through a wedged accelerator tunnel the transfer
+            # surfaces OSError/TimeoutError just like a flaky filesystem
+            # does. The 'device_put' fault site sits inside the retried
+            # region.
+            def put():
+                if self._injector is not None:
+                    self._injector.fire("device_put", detail=str(layer_idxs))
+                return _assemble_parts(
+                    parts, device, self._loader.np_dtype, self._residency,
+                    self._loader,
+                )
 
-        out = retry_call(
-            put,
-            policy=self._retry,
-            label="device_put",
-            recorder=self._recorder,
-            wrap=ShardLoadError,
-            abort=self._stop.is_set,
-        )
+            with obs_trace.span(
+                "device_put", cat="stream", first=first, n=len(layer_idxs)
+            ):
+                out = retry_call(
+                    put,
+                    policy=self._retry,
+                    label="device_put",
+                    recorder=self._recorder,
+                    wrap=ShardLoadError,
+                    abort=self._stop.is_set,
+                )
         self.produce_time += time.perf_counter() - t0
         return out
 
@@ -1473,6 +1521,17 @@ class StreamingExecutor:
         # views of one shared BroadcastShardSource so the disk is read once
         # for all chips.
         self.weight_source_factory = weight_source_factory
+        # Sweep-timeline tracing (obs/trace.py): enabled process-wide when
+        # the config asks (--trace); a no-op bool check everywhere else.
+        obs_trace.ensure_configured(cfg)
+        # The executor's latest per-call stats are a registry source (the
+        # batch CLI's --metrics_out and any endpoint see the same dict the
+        # stats line prints). Last executor wins the name — the process-
+        # wide cache/tier precedent — and the weakref source lets a
+        # dropped executor be collected instead of living in the registry.
+        from flexible_llm_sharding_tpu.obs.registry import weak_source
+
+        _OBS_REGISTRY.register("executor", weak_source(self))
         self.recorder: metrics.Recorder | None = (
             metrics.Recorder(verbose=True) if cfg.verbose_metrics else None
         )
@@ -1888,80 +1947,121 @@ class StreamingExecutor:
         # disk mode (comparable to prefetch_depth=1's queued shard).
         heal_spills = store.location == "disk"
         prev_shard = None  # (layer_idxs, segments) of the last shard run
+        # Correlation id for this full pass over the shards — the offline
+        # equivalent of one serving sweep; every span below carries it so
+        # the trace analyzer can group a pass's phases back together.
+        sweep_id = obs_trace.new_sweep_id() if obs_trace.enabled() else 0
         try:
-            while True:
-                t_wait = time.perf_counter()
-                try:
-                    shard_i, (layer_idxs, segments) = next(it)
-                except StopIteration:
-                    break
-                if shard_i < skip:
-                    # Resume over a shared source: this shard already ran in
-                    # the crashed attempt; drop its broadcast weights unused.
-                    # Its wait is NOT counted against overlap efficiency —
-                    # skipped shards run no compute that could hide it.
-                    del segments
-                    continue
-                source_wait += time.perf_counter() - t_wait
-                # Global shard index: shared sources yield every shard from
-                # 0 (skip consumed the resumed prefix); an own source yields
-                # only the resumed tail.
-                store.set_shard(shard_i + (0 if skip else start_shard))
-                t0 = time.perf_counter()
-                for b, idxs in enumerate(blocks):
-                    fetched = None
-                    while True:
-                        try:
-                            suffix_h = process_block(
-                                self.model_cfg,
-                                self.dtype,
-                                segments,
-                                layer_idxs,
-                                n_layers,
-                                store,
-                                b,
-                                idxs,
-                                block_meta[b],
-                                self.device,
-                                toks,
-                                scores,
-                                use_pallas=self._use_pallas,
-                                tp_mesh=self._tp_mesh,
-                                fetched=fetched,
-                            )
-                            break
-                        except SpillCorruptError:
-                            # The block's input spill is corrupt even after
-                            # re-reads. Recompute it from the last good
-                            # shard boundary — bounded to ONE recompute per
-                            # block per shard (a recompute that fails again
-                            # means the previous generation is corrupt too:
-                            # raise).
-                            if prev_shard is None or fetched is not None:
-                                raise
-                            self._integrity.count("recomputes")
-                            fetched = self._recompute_block(
-                                prev_shard, store, b, idxs, block_meta[b],
-                                n_layers,
-                            )
-                    bar.update(1)
-                if not blocks:
-                    bar.update(1)
-                # Every store path is async now (cpu: copy_to_host_async +
-                # depth-1 finalize; disk: writer thread), so block once per
-                # shard to keep compute_wall_s a device-time measure — the
-                # prefetch thread keeps uploading the next shard, and the
-                # disk writer keeps writing, concurrently with this wait.
-                # (blocks can be empty: num_batch > prompt count -> ex([]).)
-                if blocks and layer_idxs[-1] != n_layers - 1:
-                    jax.block_until_ready(suffix_h)
-                compute_time += time.perf_counter() - t0
-                if on_shard_done is not None:
-                    on_shard_done(shard_i)
-                prev_shard = (layer_idxs, segments) if heal_spills else None
+            with obs_trace.span(
+                "sweep", cat="sweep", sweep_id=sweep_id, mode="offline",
+                blocks=len(blocks),
+            ):
+                while True:
+                    t_wait = time.perf_counter()
+                    try:
+                        shard_i, (layer_idxs, segments) = next(it)
+                    except StopIteration:
+                        break
+                    if shard_i < skip:
+                        # Resume over a shared source: this shard already
+                        # ran in the crashed attempt; drop its broadcast
+                        # weights unused. Its wait is NOT counted against
+                        # overlap efficiency — skipped shards run no
+                        # compute that could hide it.
+                        del segments
+                        continue
+                    waited = time.perf_counter() - t_wait
+                    source_wait += waited
+                    # Recorded AFTER the skip check with the measured
+                    # timing, so the trace's source_wait total matches the
+                    # stats/bench overlap-efficiency definition exactly —
+                    # skipped shards' waits appear in neither.
+                    obs_trace.TRACER.complete(
+                        "source_wait", "sweep", t_wait, waited,
+                        sweep_id=sweep_id,
+                    )
+                    # Global shard index: shared sources yield every shard
+                    # from 0 (skip consumed the resumed prefix); an own
+                    # source yields only the resumed tail.
+                    shard_idx = shard_i + (0 if skip else start_shard)
+                    store.set_shard(shard_idx)
+                    t0 = time.perf_counter()
+                    with obs_trace.span(
+                        "compute", cat="sweep", sweep_id=sweep_id,
+                        shard_idx=shard_idx,
+                    ):
+                        self._stream_shard(
+                            store, toks, blocks, block_meta, scores,
+                            layer_idxs, segments, n_layers, prev_shard,
+                            bar, sweep_id,
+                        )
+                    compute_time += time.perf_counter() - t0
+                    if on_shard_done is not None:
+                        on_shard_done(shard_i)
+                    prev_shard = (
+                        (layer_idxs, segments) if heal_spills else None
+                    )
         finally:
             bar.close()
         return compute_time, source_wait
+
+    def _stream_shard(
+        self, store, toks, blocks, block_meta, scores, layer_idxs, segments,
+        n_layers, prev_shard, bar, sweep_id,
+    ) -> None:
+        """One shard's compute over every block — the body the traced
+        ``compute`` span wraps in ``_stream`` (same invariants as before
+        the split; the spill-corruption recompute path lives here)."""
+        for b, idxs in enumerate(blocks):
+            fetched = None
+            while True:
+                try:
+                    suffix_h = process_block(
+                        self.model_cfg,
+                        self.dtype,
+                        segments,
+                        layer_idxs,
+                        n_layers,
+                        store,
+                        b,
+                        idxs,
+                        block_meta[b],
+                        self.device,
+                        toks,
+                        scores,
+                        use_pallas=self._use_pallas,
+                        tp_mesh=self._tp_mesh,
+                        fetched=fetched,
+                    )
+                    break
+                except SpillCorruptError:
+                    # The block's input spill is corrupt even after
+                    # re-reads. Recompute it from the last good shard
+                    # boundary — bounded to ONE recompute per block per
+                    # shard (a recompute that fails again means the
+                    # previous generation is corrupt too: raise).
+                    if prev_shard is None or fetched is not None:
+                        raise
+                    self._integrity.count("recomputes")
+                    obs_trace.instant(
+                        "spill_recompute", cat="integrity", block=b,
+                        sweep_id=sweep_id,
+                    )
+                    fetched = self._recompute_block(
+                        prev_shard, store, b, idxs, block_meta[b],
+                        n_layers,
+                    )
+            bar.update(1)
+        if not blocks:
+            bar.update(1)
+        # Every store path is async now (cpu: copy_to_host_async +
+        # depth-1 finalize; disk: writer thread), so block once per
+        # shard to keep compute_wall_s a device-time measure — the
+        # prefetch thread keeps uploading the next shard, and the
+        # disk writer keeps writing, concurrently with this wait.
+        # (blocks can be empty: num_batch > prompt count -> ex([]).)
+        if blocks and layer_idxs[-1] != n_layers - 1:
+            jax.block_until_ready(suffix_h)
 
     def _recompute_block(
         self, prev_shard, store, b, idxs, meta, n_layers: int

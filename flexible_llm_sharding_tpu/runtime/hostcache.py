@@ -46,6 +46,8 @@ from collections import OrderedDict
 from typing import Any, Sequence
 
 from flexible_llm_sharding_tpu.integrity.manifest import _file_key as _stat_key
+from flexible_llm_sharding_tpu.obs import trace as obs_trace
+from flexible_llm_sharding_tpu.obs.registry import REGISTRY as _OBS_REGISTRY
 
 # Auto budget: this fraction of MemAvailable at first resolution. Small on
 # purpose — the cache is an accelerator, not a requirement, and the host
@@ -134,7 +136,12 @@ class HostShardCache:
             entry = self._entries.get(key)
             if entry is None:
                 self.misses += 1
-                return None
+        if entry is None:
+            # Emitted OFF the cache lock (like the hit/stale emits below):
+            # the tracer's ring lock must never nest inside the cache's
+            # critical section.
+            obs_trace.instant("hostcache_miss", cat="cache")
+            return None
         segments, nbytes, guard = entry
         stale = any(_stat_key(path) != stat for path, stat in guard)
         with self._lock:
@@ -143,17 +150,23 @@ class HostShardCache:
                 # Dropped or replaced while we were statting: our verdict
                 # no longer describes what the cache holds — miss.
                 self.misses += 1
-                return None
-            if stale:
+                hit = False
+            elif stale:
                 # Backing file changed (repair, re-prepare, rot): the
                 # entry is stale — drop it and force a verified re-read.
                 self._drop(key)
                 self.invalidations += 1
                 self.misses += 1
-                return None
-            self._entries.move_to_end(key)
-            self.hits += 1
-            return segments, nbytes
+                hit = False
+            else:
+                self._entries.move_to_end(key)
+                self.hits += 1
+                hit = True
+        if not hit:
+            obs_trace.instant("hostcache_miss", cat="cache", stale=stale)
+            return None
+        obs_trace.instant("hostcache_hit", cat="cache", bytes=nbytes)
+        return segments, nbytes
 
     def put(
         self,
@@ -278,6 +291,9 @@ def cache_for(cfg) -> HostShardCache | None:
         if _PROCESS_CACHE is None:
             _PROCESS_CACHE = HostShardCache(budget)
             _PROCESS_BUDGET_EXPLICIT = explicit
+            # Registry citizen: the metrics endpoint / --metrics_out see
+            # the same hit-rate counters the stats lines print.
+            _OBS_REGISTRY.register("host_cache", _PROCESS_CACHE.stats)
         elif explicit:
             if _PROCESS_CACHE.budget_bytes != budget:
                 _PROCESS_CACHE.set_budget(budget)
@@ -297,6 +313,8 @@ def reset_process_cache() -> None:
             _PROCESS_CACHE.clear()
         _PROCESS_CACHE = None
         _PROCESS_BUDGET_EXPLICIT = False
+    # A dropped cache must not leave a stale registry source behind.
+    _OBS_REGISTRY.unregister("host_cache")
 
 
 __all__ = [

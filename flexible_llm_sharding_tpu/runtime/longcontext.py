@@ -309,6 +309,14 @@ class LongContextScorer:
     """
 
     def __init__(self, cfg: FrameworkConfig, devices=None, tokenizer=None):
+        from flexible_llm_sharding_tpu.obs import trace as _trace
+        from flexible_llm_sharding_tpu.obs.registry import (
+            REGISTRY,
+            weak_source,
+        )
+
+        _trace.ensure_configured(cfg)
+        REGISTRY.register("longcontext", weak_source(self))
         self.cfg = cfg
         self.model_cfg = LlamaConfig.from_pretrained(cfg.model_path)
         devices = list(devices) if devices else None

@@ -35,6 +35,7 @@ import jax
 import numpy as np
 
 from flexible_llm_sharding_tpu.config import FrameworkConfig, LlamaConfig
+from flexible_llm_sharding_tpu.obs import trace as _trace
 from flexible_llm_sharding_tpu.parallel.planner import (
     batch_ranges,
     global_stage_order,
@@ -57,6 +58,13 @@ class PipelineRunner:
     """Drives one full scoring pass through the interleaved stage pipeline."""
 
     def __init__(self, cfg: FrameworkConfig, devices, tokenizer=None):
+        from flexible_llm_sharding_tpu.obs.registry import (
+            REGISTRY,
+            weak_source,
+        )
+
+        _trace.ensure_configured(cfg)
+        REGISTRY.register("pipeline", weak_source(self))
         self.cfg = cfg
         self.devices = list(devices)
         self.model_cfg = LlamaConfig.from_pretrained(cfg.model_path)
@@ -231,23 +239,27 @@ class PipelineRunner:
                 store.set_shard(stage_idx)
                 dev = self.devices[rank]
                 t_stage = time.perf_counter()
-                for b, idxs in enumerate(blocks):
-                    process_block(
-                        self.model_cfg,
-                        self.dtype,
-                        segments,
-                        layer_idxs,
-                        n_layers,
-                        store,
-                        b,
-                        idxs,
-                        meta_on(b, dev),
-                        dev,
-                        toks,
-                        scores,
-                        use_pallas=self._use_pallas,
-                    )
-                    bar.update(1)
+                with _trace.span(
+                    "pipeline_stage", cat="pipeline", stage=stage_idx,
+                    rank=rank,
+                ):
+                    for b, idxs in enumerate(blocks):
+                        process_block(
+                            self.model_cfg,
+                            self.dtype,
+                            segments,
+                            layer_idxs,
+                            n_layers,
+                            store,
+                            b,
+                            idxs,
+                            meta_on(b, dev),
+                            dev,
+                            toks,
+                            scores,
+                            use_pallas=self._use_pallas,
+                        )
+                        bar.update(1)
                 self.recorder.record(
                     "stage_dispatch",
                     time.perf_counter() - t_stage,

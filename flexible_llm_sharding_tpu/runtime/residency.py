@@ -50,6 +50,8 @@ import os
 import threading
 from typing import Sequence
 
+from flexible_llm_sharding_tpu.obs import trace as obs_trace
+from flexible_llm_sharding_tpu.obs.registry import REGISTRY as _OBS_REGISTRY
 from flexible_llm_sharding_tpu.utils import checkpoint
 
 # Auto budget: fraction of the chip's TOTAL HBM held back for activations,
@@ -337,8 +339,15 @@ class DeviceResidencyTier:
             # re-check (their success seats it; their failure demotes).
             gate.wait()
         try:
-            host = loader.build_host_shard((idx,))
-            placed = _place(host, device, np_dtype=loader.np_dtype)
+            # One traced span per pin load: pins ride the same verified/
+            # retried path as the stream, but load ONCE per process — the
+            # timeline shows them as one-time costs, not per-sweep ones.
+            with obs_trace.span(
+                "residency_pin", cat="residency",
+                layer=self.layer_names[idx], idx=idx,
+            ):
+                host = loader.build_host_shard((idx,))
+                placed = _place(host, device, np_dtype=loader.np_dtype)
         except Exception:
             # Persistent corruption / exhausted retries: never pin
             # unverified bytes — demote to streaming for good (the
@@ -608,6 +617,9 @@ def tier_for(
             _PROCESS_TIER = DeviceResidencyTier(cfg.model_path, layer_names, plan)
             _PROCESS_TIER_KEY = key
             _PROCESS_BUDGET_EXPLICIT = explicit
+            # Registry citizen: pinned_bytes / stream_bytes_saved on the
+            # metrics endpoint are the same numbers the stats lines print.
+            _OBS_REGISTRY.register("residency", _PROCESS_TIER.stats)
             return _PROCESS_TIER
     if resize:
         # Reuse the plan computed above — it was planned for exactly this
@@ -664,6 +676,8 @@ def reset_process_tier() -> None:
         _PROCESS_TIER = None
         _PROCESS_TIER_KEY = None
         _PROCESS_BUDGET_EXPLICIT = False
+    # A dropped tier must not leave a stale registry source behind.
+    _OBS_REGISTRY.unregister("residency")
 
 
 def plan_report(model_path: str, budget_bytes: int) -> dict:

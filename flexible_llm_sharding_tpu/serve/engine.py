@@ -46,6 +46,7 @@ from flexible_llm_sharding_tpu.config import (
     ServeConfig,
 )
 from flexible_llm_sharding_tpu.models import llama
+from flexible_llm_sharding_tpu.obs import trace as obs_trace
 from flexible_llm_sharding_tpu.parallel.planner import plan_shards_dp
 from flexible_llm_sharding_tpu.runtime.decode import (
     KVStore,
@@ -157,6 +158,9 @@ class ServeEngine:
         self._resident = cfg.decode_resident_enabled(
             self.model_cfg, 1, device
         )
+        # Sweep-timeline tracing (obs/trace.py): process-wide, enabled by
+        # --trace; every span below is a no-op bool check when off.
+        obs_trace.ensure_configured(cfg)
         self.metrics = ServingMetrics()
         # Chaos injector (None unless cfg.faults.enabled) and the weight
         # stream's retry policy — threaded into the admission queue and
@@ -185,6 +189,31 @@ class ServeEngine:
             )
         )
         self.metrics.residency = self._residency
+        # The engine registry (ServingMetrics.registry) additionally
+        # exposes the process stream counters and the tracer's own
+        # accounting, so ONE scrape answers the routing/health questions:
+        # queue depth, TTFT quantiles, streamed bytes, cache hit rate,
+        # residency savings, retry/heal/recovery counters.
+        from flexible_llm_sharding_tpu.runtime.executor import stream_stats
+
+        self.metrics.register(
+            "stream", stream_stats,
+            mirror=False,  # process-level: executor registers it globally
+        )
+        self.metrics.register(
+            "trace", obs_trace.TRACER.stats,
+            mirror=False,  # process-level: the tracer registers on enable
+        )
+        # Prometheus endpoint (ServeConfig.metrics_port / --metrics_port):
+        # None = off; 0 = ephemeral port (tests) — the bound port is
+        # self.metrics_server.port.
+        self.metrics_server = None
+        if self.serve_cfg.metrics_port is not None:
+            from flexible_llm_sharding_tpu.obs.registry import MetricsServer
+
+            self.metrics_server = MetricsServer(
+                self.metrics.registry, port=self.serve_cfg.metrics_port
+            )
         self.queue = AdmissionQueue(
             self.serve_cfg.queue_capacity, metrics=self.metrics,
             injector=self._injector,
@@ -259,10 +288,17 @@ class ServeEngine:
 
     def shutdown(self, drain: bool = True, timeout: float | None = None) -> bool:
         self.queue.close(drain=drain)
+        ok = True
         if self._thread is not None:
             self._thread.join(timeout)
-            return not self._thread.is_alive()
-        return True
+            ok = not self._thread.is_alive()
+        if self.metrics_server is not None:
+            self.metrics_server.close()
+        # Retract this engine's process-wide registry mirrors: a dead
+        # engine must neither serve stale counters to a later process-
+        # wide dump nor pin its object graph for the process lifetime.
+        self.metrics.close()
+        return ok
 
     @property
     def error(self) -> BaseException | None:
@@ -291,6 +327,7 @@ class ServeEngine:
             wd = StepWatchdog(
                 "serve-sweep", self.serve_cfg.watchdog_abort_s, self._on_stall
             )
+            self.metrics.register("watchdog", wd.stats)
         self._watchdog = wd
         try:
             while True:
@@ -298,6 +335,14 @@ class ServeEngine:
                 wave = self.batcher.admit_at_boundary()
                 if wave is not None and not self._init_wave(wave):
                     continue  # wave failed at tokenization; re-check queue
+                if wave is not None:
+                    obs_trace.instant(
+                        "wave_admit",
+                        cat="serve",
+                        wave_id=wave.wave_id,
+                        requests=len(wave.requests),
+                        request_ids=[r.request_id for r in wave.requests],
+                    )
                 if not self.batcher.waves:
                     if self.queue.closed and len(self.queue) == 0:
                         break
@@ -365,8 +410,17 @@ class ServeEngine:
             "keeps serving — resubmit"
         )
         err.__cause__ = root
+        for w in self.batcher.waves:
+            obs_trace.instant(
+                "wave_abort", cat="serve", wave_id=w.wave_id,
+                error=type(root).__name__,
+            )
         self.batcher.fail_all_active(err)
         self.metrics.count("engine_recoveries")
+        obs_trace.instant(
+            "engine_recovery", cat="serve", error=type(root).__name__,
+            waves=n_waves,
+        )
         if n_waves:
             self.metrics.count("waves_aborted", n_waves)
         if not self._resident:
@@ -521,6 +575,11 @@ class ServeEngine:
                     r.fail(e, RequestStatus.FAILED)
                     self.metrics.count("failed")
             self.batcher.waves.remove(wave)
+            obs_trace.instant(
+                "wave_reject", cat="serve",
+                wave_id=getattr(wave, "wave_id", -1),
+                error=type(e).__name__,
+            )
             return False
 
     # -- per-shard compute -------------------------------------------------
@@ -532,18 +591,37 @@ class ServeEngine:
         """One full weight pass: prefill segments for waves at step 0,
         one decode step for everyone else."""
         wd = self._watchdog
-        for shard_pos, (layer_idxs, segments) in self._sweep_shards():
-            if wd is not None:
-                wd.tick()
-            if self._injector is not None:
-                self._injector.fire("engine_step", detail=f"shard{shard_pos}")
-            if not layer_idxs:
-                continue
-            for wave in self.batcher.waves:
-                if wave.steps == 0:
-                    self._prefill_shard(wave, shard_pos, layer_idxs, segments)
-                else:
-                    self._decode_shard(wave, shard_pos, layer_idxs, segments)
+        sweep_id = obs_trace.new_sweep_id() if obs_trace.enabled() else 0
+        with obs_trace.span(
+            "sweep", cat="serve", sweep_id=sweep_id, mode="serve",
+            waves=len(self.batcher.waves),
+        ):
+            for shard_pos, (layer_idxs, segments) in self._sweep_shards():
+                if wd is not None:
+                    wd.tick()
+                if self._injector is not None:
+                    self._injector.fire(
+                        "engine_step", detail=f"shard{shard_pos}"
+                    )
+                if not layer_idxs:
+                    continue
+                for wave in self.batcher.waves:
+                    if wave.steps == 0:
+                        with obs_trace.span(
+                            "prefill_shard", cat="serve", sweep_id=sweep_id,
+                            shard_idx=shard_pos, wave_id=wave.wave_id,
+                        ):
+                            self._prefill_shard(
+                                wave, shard_pos, layer_idxs, segments
+                            )
+                    else:
+                        with obs_trace.span(
+                            "decode_shard", cat="serve", sweep_id=sweep_id,
+                            shard_idx=shard_pos, wave_id=wave.wave_id,
+                        ):
+                            self._decode_shard(
+                                wave, shard_pos, layer_idxs, segments
+                            )
 
     def _prefill_shard(self, wave, shard_pos, layer_idxs, segments) -> None:
         st: _WaveState = wave.state
@@ -664,6 +742,11 @@ class ServeEngine:
                 if prefilled and r.first_token_at is None:
                     r.first_token_at = now
                     self.metrics.observe_ttft(now - r.arrival)
+                    obs_trace.instant(
+                        "ttft", cat="serve", wave_id=wave.wave_id,
+                        request_id=r.request_id,
+                        seconds=round(now - r.arrival, 6),
+                    )
                 if r.tokens_emitted < r.max_new_tokens:
                     r.tokens_emitted += 1
                     emitted += 1
@@ -673,6 +756,10 @@ class ServeEngine:
         if emitted:
             self.metrics.count("tokens_emitted", emitted)
             self.metrics.observe_token_latency(sweep_wall_s)
+            obs_trace.instant(
+                "token_latency", cat="serve",
+                seconds=round(sweep_wall_s, 6), tokens=emitted,
+            )
         for w in self.batcher.retire_done():
             if w.state is not None:
                 w.state.kv_store.clear()
@@ -698,6 +785,10 @@ class ServeEngine:
         )
         r.resolve(scores, updated, tokens)
         self.metrics.count("completed")
+        obs_trace.instant(
+            "request_finish", cat="serve", wave_id=wave.wave_id,
+            request_id=r.request_id, tokens=int(n),
+        )
 
 
 __all__ = ["ServeEngine"]

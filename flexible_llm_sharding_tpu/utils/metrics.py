@@ -15,6 +15,8 @@ import sys
 import time
 from dataclasses import dataclass, field
 
+from flexible_llm_sharding_tpu.obs import trace as obs_trace
+
 
 def device_memory_stats(device=None) -> dict[str, float]:
     """Allocator stats for one chip (bytes). Empty on backends without
@@ -210,7 +212,9 @@ class Recorder:
 
 
 def _latency_summary(samples: list[float]) -> dict[str, float]:
-    """{count, mean, p50, p95, max} in seconds for a latency sample list."""
+    """{count, mean, p50, p95, p99, max} in seconds for a latency sample
+    list (the quantile set the Prometheus exposition and the trace
+    analyzer share)."""
     if not samples:
         return {"count": 0}
     import numpy as np
@@ -221,6 +225,7 @@ def _latency_summary(samples: list[float]) -> dict[str, float]:
         "mean": round(float(arr.mean()), 4),
         "p50": round(float(np.percentile(arr, 50)), 4),
         "p95": round(float(np.percentile(arr, 95)), 4),
+        "p99": round(float(np.percentile(arr, 99)), 4),
         "max": round(float(arr.max()), 4),
     }
 
@@ -311,28 +316,106 @@ class IntegrityRecorder:
             return dict(self._counts)
 
 
+# The stats-line / exposition merge policy for the serve registry's
+# WELL-KNOWN source names: these get the layout operators and CI greps
+# already depend on (nested-when-nonzero, top-level convenience keys);
+# any OTHER registered source appears as its own nested dict when it
+# carries a nonzero value. This is the ONE assembly path — the engine's
+# stats() and ServingMetrics.snapshot() both go through it, so the line
+# can never fork again.
+_SERVE_CORE_SOURCES = (
+    "serve", "io_retries", "integrity", "host_cache", "residency",
+)
+
+
+def assemble_serve_stats(collected: dict) -> dict:
+    """One serve stats line from a registry collection (see
+    ``ServingMetrics.snapshot``)."""
+    out: dict = {"event": "serve_stats"}
+    out.update(collected.get("serve", {}))
+    retries = collected.get("io_retries")
+    if retries:
+        out["io_retries"] = retries
+    integrity = collected.get("integrity")
+    if integrity and any(integrity.values()):
+        out["integrity"] = integrity
+    # .get(), never []: a failing source degrades to {"collect_error": 1}
+    # in the collection (obs/registry.py) — the stats line must render
+    # around it, not turn the tolerated failure into a KeyError that the
+    # serve loop's fatal path would promote to killing the engine.
+    cache = collected.get("host_cache")
+    if cache is not None:
+        if "hit_rate" in cache:
+            out["host_cache_hit_rate"] = cache["hit_rate"]
+        out["host_cache"] = cache
+    res = collected.get("residency")
+    if res is not None:
+        if "pinned_bytes" in res:
+            out["pinned_bytes"] = res["pinned_bytes"]
+        if "stream_bytes_saved" in res:
+            out["stream_bytes_saved"] = res["stream_bytes_saved"]
+        out["residency"] = res
+    for name in sorted(collected):
+        if name in _SERVE_CORE_SOURCES:
+            continue
+        snap = collected[name]
+        if any(isinstance(v, (int, float)) and v for v in snap.values()):
+            out[name] = snap
+    return out
+
+
 class ServingMetrics:
     """Counters/gauges/latency samples for the online serving subsystem.
 
     Thread-safe (submitters, the serving loop, and callbacks all touch it).
     Counters: admitted / rejected / expired / cancelled / completed /
-    failed / prefills / sweeps / tokens_emitted. Gauges: queue_depth /
-    active_requests / active_waves. Latency samples: ttft_s (submit ->
-    first token) and token_s (per-token decode latency) — kept in a
-    BOUNDED window (``sample_window`` newest samples) so a long-running
-    server neither grows memory with uptime nor recomputes percentiles
-    over its whole history inside the lock; the summaries are therefore
-    recent-window statistics, while the counters remain all-time totals.
-    ``snapshot()`` returns one JSON-able dict — the periodic structured
-    stats line — and ``maybe_emit(interval)`` prints it to stderr at most
-    once per interval (0 disables)."""
+    failed / prefills / sweeps / tokens_emitted (pre-seeded to 0 so the
+    Prometheus exposition always carries the full family — a scrape can
+    tell "zero recoveries" from "recoveries not exported"). Gauges:
+    queue_depth / active_requests / active_waves. Latency samples: ttft_s
+    (submit -> first token) and token_s (per-token decode latency) — kept
+    in a BOUNDED window (``sample_window`` newest samples) so a
+    long-running server neither grows memory with uptime nor recomputes
+    percentiles over its whole history inside the lock; the summaries are
+    therefore recent-window statistics, while the counters remain
+    all-time totals.
+
+    Every part registers into ``self.registry`` (an
+    ``obs.registry.MetricsRegistry``): its own counters/gauges/latency
+    under ``serve``, the retry and integrity recorders, and whatever the
+    engine attaches (host cache, residency tier, watchdog, tracer, the
+    process stream counters). ``snapshot()`` — the periodic structured
+    stats line — and the engine's Prometheus endpoint both render from
+    that one registry, so the two can never drift. The same sources are
+    mirrored into the process-wide registry (last engine wins, the
+    process cache/tier precedent) for the batch-style one-shot dump.
+    ``maybe_emit(interval)`` prints the line to stderr at most once per
+    interval (0 disables)."""
+
+    KNOWN_COUNTERS = (
+        "admitted",
+        "rejected",
+        "expired",
+        "cancelled",
+        "completed",
+        "failed",
+        "prefills",
+        "sweeps",
+        "tokens_emitted",
+        "engine_recoveries",
+        "waves_aborted",
+        "source_restarts",
+        "watchdog_stalls",
+    )
 
     def __init__(self, sample_window: int = 4096) -> None:
         import threading
         from collections import deque
 
+        from flexible_llm_sharding_tpu.obs.registry import MetricsRegistry
+
         self._lock = threading.Lock()
-        self._counters: dict[str, int] = {}
+        self._counters: dict[str, int] = {k: 0 for k in self.KNOWN_COUNTERS}
         self._gauges: dict[str, float] = {}
         self._ttft: deque[float] = deque(maxlen=sample_window)
         self._token_lat: deque[float] = deque(maxlen=sample_window)
@@ -344,16 +427,71 @@ class ServingMetrics:
         # quarantines) for the same stream — nonzero counters appear in
         # the stats line under "integrity".
         self.integrity = IntegrityRecorder()
-        # Host shard cache (runtime/hostcache.py) attached by the serving
-        # engine: the stats line carries its hit rate and counters so an
-        # operator can see the warm-sweep fast path engaging (and CI can
-        # grep a nonzero host_cache_hit_rate from the smoke).
-        self.host_cache = None
-        # Device residency tier (runtime/residency.py) attached by the
-        # serving engine: the stats line carries pinned_bytes and
-        # stream_bytes_saved top-level — HBM accounting honesty (the
-        # low-memory claim can never silently exclude the pin tier).
-        self.residency = None
+        self.registry = MetricsRegistry()
+        self._host_cache = None
+        self._residency = None
+        # Mirrored names -> the exact source object registered process-
+        # wide, so close() can retract THIS engine's mirrors without
+        # yanking a newer engine's (unregister_if identity check).
+        self._mirrored: dict[str, object] = {}
+        self.register("serve", self._core_snapshot)
+        self.register("io_retries", self.retries.snapshot)
+        self.register("integrity", self.integrity.snapshot)
+
+    def register(self, name: str, source, mirror: bool = True) -> None:
+        """Register a source into this engine's registry and (for
+        engine-scoped sources) mirror it into the process-wide one — last
+        engine wins there, and ``close()`` retracts the mirrors so a dead
+        engine neither serves stale counters nor pins its object graph.
+        Pass ``mirror=False`` for PROCESS-level sources (the stream
+        counters, the tracer, the host cache, the residency tier): their
+        owners register them process-wide themselves, and an engine
+        mirror would tear them down with the engine."""
+        from flexible_llm_sharding_tpu.obs.registry import REGISTRY
+
+        self.registry.register(name, source)
+        if mirror:
+            self._mirrored[name] = source
+            REGISTRY.register(name, source)
+
+    def close(self) -> None:
+        """Retract this engine's process-wide mirrors (engine shutdown).
+        Idempotent; a newer engine's same-name registrations survive."""
+        from flexible_llm_sharding_tpu.obs.registry import REGISTRY
+
+        for name, source in self._mirrored.items():
+            REGISTRY.unregister_if(name, source)
+        self._mirrored = {}
+
+    # Host shard cache / residency tier attached by the serving engine —
+    # kept as attribute-style setters for the existing call sites, but the
+    # attach IS a registry registration: the stats line and the endpoint
+    # read the same source. No process-wide mirror: both objects are
+    # process-level and register themselves there (cache_for / tier_for),
+    # so an engine detach must not disturb the live process source.
+    @property
+    def host_cache(self):
+        return self._host_cache
+
+    @host_cache.setter
+    def host_cache(self, cache) -> None:
+        self._host_cache = cache
+        if cache is not None:
+            self.register("host_cache", cache.stats, mirror=False)
+        else:
+            self.registry.unregister("host_cache")
+
+    @property
+    def residency(self):
+        return self._residency
+
+    @residency.setter
+    def residency(self, tier) -> None:
+        self._residency = tier
+        if tier is not None:
+            self.register("residency", tier.stats, mirror=False)
+        else:
+            self.registry.unregister("residency")
 
     def count(self, name: str, n: int = 1) -> None:
         with self._lock:
@@ -375,31 +513,19 @@ class ServingMetrics:
         with self._lock:
             return self._counters.get(name, 0)
 
-    def snapshot(self) -> dict:
-        retries = self.retries.snapshot()
-        integrity = self.integrity.snapshot()
+    def _core_snapshot(self) -> dict:
+        """The engine's own counters/gauges/latency summaries — the
+        ``serve`` registry source."""
         with self._lock:
-            out = {
-                "event": "serve_stats",
+            return {
                 **{k: v for k, v in sorted(self._counters.items())},
                 **{k: v for k, v in sorted(self._gauges.items())},
                 "ttft_s": _latency_summary(list(self._ttft)),
                 "token_latency_s": _latency_summary(list(self._token_lat)),
             }
-        if retries:
-            out["io_retries"] = retries
-        if any(integrity.values()):
-            out["integrity"] = integrity
-        if self.host_cache is not None:
-            cache = self.host_cache.stats()
-            out["host_cache_hit_rate"] = cache["hit_rate"]
-            out["host_cache"] = cache
-        if self.residency is not None:
-            res = self.residency.stats()
-            out["pinned_bytes"] = res["pinned_bytes"]
-            out["stream_bytes_saved"] = res["stream_bytes_saved"]
-            out["residency"] = res
-        return out
+
+    def snapshot(self) -> dict:
+        return assemble_serve_stats(self.registry.collect())
 
     def emit(self) -> None:
         print(json.dumps(self.snapshot()), file=sys.stderr, flush=True)
@@ -542,6 +668,16 @@ class StepWatchdog:
             token = self._token
             self._armed = False
             self.stalls += 1
+            # Structured span event FIRST (non-blocking ring append): the
+            # stall must be visible in the trace timeline — correlated
+            # with the sweep it killed — not only as an exception text.
+            obs_trace.instant(
+                "watchdog_stall",
+                cat="serve",
+                desc=self._desc,
+                idle_s=round(idle, 3),
+                stalls=self.stalls,
+            )
             print(
                 f"[stall] '{self._desc}' made no progress for {idle:.1f}s "
                 "— aborting for recovery",
@@ -552,6 +688,10 @@ class StepWatchdog:
                 self._on_stall(idle, token)
             except Exception:
                 pass  # recovery is best-effort; the watchdog must survive
+
+    def stats(self) -> dict[str, int]:
+        """Registry source: stall-abort count for the metrics endpoint."""
+        return {"stalls": self.stalls}
 
     def arm(self, token=None) -> None:
         self._token = token
@@ -812,6 +952,7 @@ __all__ = [
     "RetryRecorder",
     "ServingMetrics",
     "StepWatchdog",
+    "assemble_serve_stats",
     "chip_peak_flops",
     "model_flops_per_token",
     "compiled_memory_analysis",

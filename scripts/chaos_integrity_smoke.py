@@ -109,7 +109,10 @@ def main() -> int:
         f"token-identical; stats={json.dumps({k: v for k, v in ex.stats.items() if 'integrity' in k or k in ('reread_heals', 'recomputes', 'quarantined_shards')})}"
     )
 
-    # 2) Serving under corrupt_shard; the stats line must report the heals.
+    # 2) Serving under corrupt_shard; the stats line must report the heals,
+    # and ONE scrape of the Prometheus endpoint must expose the same
+    # counters — the end-to-end witness that the registry refactor kept
+    # every recorder's counters flowing to the machine-readable surface.
     engine = ServeEngine(
         _cfg(
             model_dir,
@@ -118,14 +121,43 @@ def main() -> int:
                 sites=("corrupt_shard",),
             ),
         ),
-        ServeConfig(max_wave_requests=2, default_max_new_tokens=1),
+        ServeConfig(
+            max_wave_requests=2, default_max_new_tokens=1, metrics_port=0,
+        ),
         tokenizer=FakeTokenizer(),
     )
     try:
         reqs = [engine.submit(p, s) for p, s in PROMPTS]
         results = [r.future.result(timeout=600) for r in reqs]
+        import re
+        import urllib.request
+
+        port = engine.metrics_server.port
+        exposition = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=30
+        ).read().decode()
     finally:
         engine.shutdown(drain=True)
+    # The recovery/heal counter family must be IN the exposition (zeros
+    # included — pre-seeded counters make "none happened" scrapeable), and
+    # this run's injected corruption must show up as nonzero reread_heals.
+    if "fls_serve_engine_recoveries" not in exposition:
+        print(
+            "FAIL: exposition lacks fls_serve_engine_recoveries",
+            file=sys.stderr,
+        )
+        return 1
+    m = re.search(r"^fls_integrity_reread_heals (\d+)", exposition, re.M)
+    if not m or int(m.group(1)) < 1:
+        print(
+            "FAIL: exposition reports no nonzero fls_integrity_reread_heals",
+            file=sys.stderr,
+        )
+        return 1
+    print(
+        f"metrics_endpoint_scrape_ok reread_heals={m.group(1)} "
+        f"series={len(exposition.splitlines()) // 2}"
+    )
     if engine.error is not None:
         print(f"FAIL: engine error {engine.error!r}", file=sys.stderr)
         return 1

@@ -67,6 +67,10 @@ FLOOR_RULES = {
     # "our schedule no better than the reference emulation" is the
     # regression this exists to catch.
     "vs_reference_schedule": 0.80,
+    # Span tracing crept onto the hot path (trace-off wall / trace-on
+    # wall sinking well below parity). Advisory: the healthy value IS
+    # parity, so a hard floor near 1.0 would flake on runner noise.
+    "trace_overhead_ratio": 0.85,
 }
 
 # Ratios whose loss-of-mechanism signature is "collapses to parity": the
@@ -82,8 +86,11 @@ PARITY_CLAMPED = {"partial_residency_speedup"}
 # close to parity by design (device_put is a memcpy), so a hard parity
 # floor would flake on shared runners — while the regression it exists
 # for (tier disengaged) is already caught deterministically by the
-# structural pinned_fraction floor.
-ADVISORY = {"partial_residency_speedup"}
+# structural pinned_fraction floor. trace_overhead_ratio's healthy value
+# is parity by CONSTRUCTION (tracing must be free), so its floor is an
+# advisory tripwire for span recording creeping onto the hot path, not
+# a hard line runner noise could cross.
+ADVISORY = {"partial_residency_speedup", "trace_overhead_ratio"}
 
 # Hard metrics with a sub-parity WARN band: the hard floor derives from
 # the WORST recorded pair (the spread) — the recording rig itself has
@@ -123,6 +130,7 @@ def measure() -> dict:
         bench_host_stream,
         bench_reference_schedule,
         bench_residency,
+        bench_trace_overhead,
         make_model,
         make_prompts,
     )
@@ -161,6 +169,7 @@ def measure() -> dict:
     bench_host_stream(result, model_path, budget)
     bench_host_cache(result, model_path, budget, jax.devices()[0])
     bench_residency(result, model_path, prompts, tok, budget, fw)
+    bench_trace_overhead(result, prompts, tok, budget, fw)
     bench_reference_schedule(jax, fw(None), prompts, tok, result, budget)
     result["gate_wall_s"] = round(time.perf_counter() - t0, 1)
     return result

@@ -1,0 +1,16 @@
+#!/usr/bin/env python3
+"""Repo entry point for the trace analyzer (same CLI as
+``python -m flexible_llm_sharding_tpu.cli trace-report``): link
+utilization, compute/stream overlap efficiency, per-phase sweep
+breakdown, and TTFT / per-token latency quantiles from a ``--trace``
+recording (Chrome trace-event JSON or JSONL)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from flexible_llm_sharding_tpu.obs.report import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -607,6 +607,78 @@ def test_counter_export_integrity_keys(tmp_path):
     assert not any("'reread_heals'" in x for x in m)
 
 
+# A counter exported only through a method the class registers as a
+# metrics-registry source (obs/registry.py) is exported; the same method
+# UNregistered is not, and the counter must be flagged.
+COUNTER_REGISTRY_MOD = """
+class C:
+    def __init__(self, registry):
+        self.hits = 0
+        self.drops = 0
+        registry.register("c", self.metrics)
+    def bump(self):
+        self.hits += 1
+        self.drops += 1
+    def metrics(self):
+        return {"hits": self.hits, "drops": self.drops}
+    def stats(self):
+        return {"hits": self.hits}
+"""
+COUNTER_UNREGISTERED_MOD = """
+class C:
+    def __init__(self):
+        self.hits = 0
+        self.drops = 0
+    def bump(self):
+        self.hits += 1
+        self.drops += 1
+    def metrics(self):
+        # Never registered anywhere: this is NOT an export surface.
+        return {"hits": self.hits, "drops": self.drops}
+    def stats(self):
+        return {"hits": self.hits}
+"""
+
+
+def test_counter_export_registry_registration_satisfies(tmp_path):
+    pkg = make_pkg(tmp_path, {"mod.py": COUNTER_REGISTRY_MOD})
+    res = run_pkg(pkg, select=["COUNTER-EXPORT"])
+    # self.drops reaches metrics(), which the class registers as a
+    # registry source — exported, no finding.
+    assert not msgs(res.findings, "COUNTER-EXPORT")
+
+
+def test_counter_export_unregistered_method_is_not_an_export(tmp_path):
+    pkg = make_pkg(tmp_path, {"mod.py": COUNTER_UNREGISTERED_MOD})
+    res = run_pkg(pkg, select=["COUNTER-EXPORT"])
+    m = msgs(res.findings, "COUNTER-EXPORT")
+    # self.drops reaches neither stats() nor any registered source: the
+    # counter counts but never exports — flagged.
+    assert any("self.drops" in x for x in m)
+    assert not any("self.hits" in x for x in m)
+
+
+def test_counter_export_registration_is_class_scoped(tmp_path):
+    # ANOTHER class registering a method that happens to share the name
+    # `metrics` must not grant this class an export surface: the
+    # registration scope is same-class `self.method` only.
+    other = """
+class D:
+    def __init__(self, registry):
+        registry.register("d", self.metrics)
+    def metrics(self):
+        return {}
+"""
+    pkg = make_pkg(
+        tmp_path,
+        {"mod.py": COUNTER_UNREGISTERED_MOD, "other.py": other},
+    )
+    res = run_pkg(pkg, select=["COUNTER-EXPORT"])
+    assert any(
+        "self.drops" in x for x in msgs(res.findings, "COUNTER-EXPORT")
+    )
+
+
 # ---------------------------------------------------------------------------
 # HYGIENE (fixture package)
 # ---------------------------------------------------------------------------

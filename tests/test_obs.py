@@ -1,0 +1,516 @@
+"""Unified observability (obs/): the span tracer's ring/drop semantics and
+exports, the metrics registry + Prometheus endpoint, the registry-backed
+serve stats line (layout pinned — the line CI and operators grep must not
+drift), the StepWatchdog's structured stall event, the trace analyzer's
+derived numbers, and end-to-end traces from a real streamed run and a
+real serve run."""
+
+import json
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+
+from flexible_llm_sharding_tpu.config import FrameworkConfig, ServeConfig
+from flexible_llm_sharding_tpu.models import llama
+from flexible_llm_sharding_tpu.obs import report as obs_report
+from flexible_llm_sharding_tpu.obs import trace as obs_trace
+from flexible_llm_sharding_tpu.obs.registry import (
+    MetricsRegistry,
+    MetricsServer,
+)
+from flexible_llm_sharding_tpu.obs.trace import Tracer
+from flexible_llm_sharding_tpu.utils.checkpoint import save_params
+from flexible_llm_sharding_tpu.utils.metrics import (
+    ServingMetrics,
+    StepWatchdog,
+    assemble_serve_stats,
+)
+
+from tests.fake_tokenizer import FakeTokenizer
+
+PROMPTS = [
+    ("The capital of France", (" is Paris", " is Rome")),
+    ("Two plus two equals", (" four", " five")),
+]
+
+
+@pytest.fixture(scope="module")
+def model(tiny_cfg, tmp_path_factory):
+    params = llama.init_params(jax.random.PRNGKey(0), tiny_cfg)
+    d = tmp_path_factory.mktemp("tiny_model_obs")
+    save_params(jax.tree.map(np.asarray, params), str(d), tiny_cfg)
+    return str(d)
+
+
+def _fw(model_dir, **kw):
+    base = dict(
+        model_path=model_dir,
+        layer_num_per_shard=1,
+        storage_location="cpu",
+        dtype="float32",
+        bucket_multiple=8,
+        block_size=2,
+        prefetch_depth=0,
+    )
+    base.update(kw)
+    return FrameworkConfig(**base)
+
+
+@pytest.fixture()
+def process_tracer():
+    """Enable the process tracer for one test; restore + clear after so
+    traces never bleed between tests."""
+    t = obs_trace.TRACER
+    was = t.enabled
+    t.clear()
+    t.enable()
+    yield t
+    t.disable()
+    t.clear()
+    if was:
+        t.enable()
+
+
+# ---------------------------------------------------------------------------
+# Tracer: ring, drops, zero-cost disabled path, exports
+# ---------------------------------------------------------------------------
+
+def test_tracer_disabled_records_nothing_and_shares_null_span():
+    t = Tracer()
+    assert not t.enabled
+    s1 = t.span("a")
+    s2 = t.span("b")
+    # The disabled path allocates nothing: one shared no-op object.
+    assert s1 is s2
+    with t.span("x", cat="c", k=1):
+        pass
+    t.instant("y")
+    assert len(t) == 0
+    assert t.stats()["trace_spans"] == 0
+
+
+def test_tracer_ring_overflow_drops_oldest_and_counts():
+    t = Tracer(capacity=10)
+    t.enabled = True  # direct: unit test must not touch the process registry
+    for i in range(25):
+        t.instant("ev", i=i)
+    assert len(t) == 10
+    assert t.drops == 15
+    assert t.stats()["trace_drops"] == 15
+    # Oldest dropped, NEWEST kept: the ring holds the trailing window.
+    kept = [s["i"] for s in t.snapshot()]
+    assert kept == list(range(15, 25))
+
+
+def test_tracer_span_timing_and_attrs():
+    t = Tracer()
+    t.enabled = True
+    with t.span("work", cat="test", sweep_id=7, shard_idx=3):
+        time.sleep(0.01)
+    (rec,) = t.snapshot()
+    assert rec["name"] == "work" and rec["cat"] == "test"
+    assert rec["sweep_id"] == 7 and rec["shard_idx"] == 3
+    assert rec["dur_s"] >= 0.009
+    assert rec["tid"] == threading.get_ident()
+
+
+def test_tracer_exports_chrome_and_jsonl(tmp_path):
+    t = Tracer(capacity=100)
+    t.enabled = True
+    with t.span("s", cat="c", sweep_id=1):
+        pass
+    t.instant("i", cat="c", request_id="r-1")
+    chrome = tmp_path / "t.json"
+    jsonl = tmp_path / "t.jsonl"
+    t.write(str(chrome))
+    t.write(str(jsonl))
+    doc = json.loads(chrome.read_text())
+    evs = doc["traceEvents"]
+    # Perfetto-loadable: complete ("X") spans with us timestamps, instant
+    # ("i") events, and the trace_meta drop-count record.
+    assert any(e.get("ph") == "X" and e["name"] == "s" for e in evs)
+    assert any(e.get("ph") == "i" and e["name"] == "i" for e in evs)
+    meta = [e for e in evs if e["name"] == "trace_meta"]
+    assert meta and meta[0]["args"]["trace_drops"] == 0
+    lines = [json.loads(ln) for ln in jsonl.read_text().splitlines()]
+    assert {ln["name"] for ln in lines} == {"s", "i", "trace_meta"}
+    span = next(ln for ln in lines if ln["name"] == "s")
+    assert "dur_s" in span and span["sweep_id"] == 1
+
+
+def test_jsonl_export_carries_drop_count():
+    """Ring overflow must be detectable in BOTH export formats — a
+    truncated timeline read as the full run is the silent loss the
+    bounded ring promises never happens."""
+    t = Tracer(capacity=4)
+    t.enabled = True
+    for i in range(9):
+        t.instant("ev", i=i)
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as d:
+        p = f"{d}/t.jsonl"
+        t.write(p)
+        rep = obs_report.analyze(obs_report.load_trace(p))
+    assert rep["trace_drops"] == 5
+
+
+# ---------------------------------------------------------------------------
+# StepWatchdog: the stall is a structured span event, not just an exception
+# ---------------------------------------------------------------------------
+
+def test_watchdog_abort_emits_structured_span_event(process_tracer):
+    fired = threading.Event()
+    wd = StepWatchdog(
+        "test-sweep", abort_s=0.05, on_stall=lambda idle, tok: fired.set(),
+        poll_s=0.01,
+    )
+    try:
+        wd.arm(token="src")
+        assert fired.wait(timeout=5.0)
+    finally:
+        wd.close()
+    stalls = [
+        s for s in process_tracer.snapshot() if s["name"] == "watchdog_stall"
+    ]
+    assert stalls, "stall must land in the trace as a structured event"
+    ev = stalls[0]
+    assert ev["cat"] == "serve"
+    assert ev["desc"] == "test-sweep"
+    assert ev["idle_s"] >= 0.05
+    assert wd.stats() == {"stalls": 1}
+
+
+# ---------------------------------------------------------------------------
+# Metrics registry + Prometheus endpoint
+# ---------------------------------------------------------------------------
+
+def test_registry_collect_and_prometheus_text():
+    reg = MetricsRegistry()
+    reg.register("a", lambda: {"x": 1, "nested": {"y": 2.5}})
+
+    class Src:
+        def stats(self):
+            return {"z": 3}
+
+    reg.register("b", Src())
+    got = reg.collect()
+    assert got == {"a": {"x": 1, "nested": {"y": 2.5}}, "b": {"z": 3}}
+    text = reg.prometheus_text()
+    assert "# TYPE fls_a_x gauge\nfls_a_x 1" in text
+    assert "fls_a_nested_y 2.5" in text
+    assert "fls_b_z 3" in text
+    # Re-registration replaces (last wins); unregister removes.
+    reg.register("b", lambda: {"z": 9})
+    assert reg.collect()["b"] == {"z": 9}
+    reg.unregister("a")
+    assert "a" not in reg.collect()
+
+
+def test_registry_broken_source_reports_error_not_raise():
+    reg = MetricsRegistry()
+
+    def broken():
+        raise RuntimeError("wedged")
+
+    reg.register("bad", broken)
+    assert reg.collect()["bad"] == {"collect_error": 1}
+    assert "fls_bad_collect_error 1" in reg.prometheus_text()
+
+
+def test_metrics_server_scrape():
+    reg = MetricsRegistry()
+    reg.register("s", lambda: {"up": 1})
+    srv = MetricsServer(reg, port=0)
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        text = urllib.request.urlopen(f"{base}/metrics", timeout=10).read()
+        assert b"fls_s_up 1" in text
+        js = json.loads(
+            urllib.request.urlopen(f"{base}/metrics.json", timeout=10).read()
+        )
+        assert js == {"s": {"up": 1}}
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(f"{base}/nope", timeout=10)
+    finally:
+        srv.close()
+    srv.close()  # idempotent
+
+
+# ---------------------------------------------------------------------------
+# The serve stats line: ONE registry-backed assembly path, layout pinned
+# ---------------------------------------------------------------------------
+
+class _FakeCache:
+    def stats(self):
+        return {"hits": 3, "misses": 1, "hit_rate": 0.75}
+
+
+class _FakeTier:
+    def stats(self):
+        return {
+            "pinned_bytes": 1024,
+            "stream_bytes_saved": 4096,
+            "pin_hits": 2,
+        }
+
+
+def test_stats_line_layout_regression():
+    """Regression pin for the consolidation: engine.stats() and
+    ServingMetrics.snapshot() are ONE registry-backed path, and the
+    line's layout — the keys CI greps and operators parse — is exactly
+    this."""
+    m = ServingMetrics()
+    m.count("admitted", 2)
+    m.count("completed", 1)
+    m.gauge("queue_depth", 5)
+    m.observe_ttft(0.25)
+    m.retries.record("shard_read", retries=1, backoff_s=0.05)
+    m.integrity.count("reread_heals")
+    m.host_cache = _FakeCache()
+    m.residency = _FakeTier()
+    line = m.snapshot()
+    # Top-level contract: event marker, every known counter (pre-seeded),
+    # gauges, latency summaries, and the nested recorder blocks with
+    # their top-level convenience keys.
+    for key in ServingMetrics.KNOWN_COUNTERS:
+        assert key in line, f"counter {key} missing from the stats line"
+    assert line["event"] == "serve_stats"
+    assert line["admitted"] == 2 and line["completed"] == 1
+    assert line["queue_depth"] == 5
+    assert set(line["ttft_s"]) == {"count", "mean", "p50", "p95", "p99", "max"}
+    assert line["token_latency_s"] == {"count": 0}
+    assert line["io_retries"]["shard_read"]["retries"] == 1
+    assert line["integrity"]["reread_heals"] == 1
+    assert line["host_cache_hit_rate"] == 0.75
+    assert line["host_cache"]["hits"] == 3
+    assert line["pinned_bytes"] == 1024
+    assert line["stream_bytes_saved"] == 4096
+    assert line["residency"]["pin_hits"] == 2
+    # The SAME collection renders the line: no second assembly path.
+    assert assemble_serve_stats(m.registry.collect()) == line
+
+
+def test_stats_line_omits_empty_recorder_blocks():
+    m = ServingMetrics()
+    line = m.snapshot()
+    assert "io_retries" not in line  # no retries recorded
+    assert "integrity" not in line  # all-zero integrity counters
+    assert "host_cache" not in line and "residency" not in line
+    # Detaching unregisters: attaching then clearing leaves no stale block.
+    m.host_cache = _FakeCache()
+    m.host_cache = None
+    assert "host_cache" not in m.snapshot()
+
+
+def test_stats_line_survives_broken_attached_source():
+    """A wedged host_cache/residency source degrades to collect_error in
+    the registry; the stats line must render around it — inside the serve
+    loop a raising snapshot() would be promoted to an engine-fatal error,
+    the exact outcome the degradation path exists to prevent."""
+    m = ServingMetrics()
+
+    class Broken:
+        def stats(self):
+            raise RuntimeError("wedged")
+
+    m.host_cache = Broken()
+    m.residency = Broken()
+    line = m.snapshot()  # must not raise
+    assert line["host_cache"] == {"collect_error": 1}
+    assert line["residency"] == {"collect_error": 1}
+    assert "host_cache_hit_rate" not in line
+    assert "pinned_bytes" not in line
+
+
+def test_metrics_close_retracts_only_own_process_mirrors():
+    """A dead engine's process-wide mirrors retract on close(); a newer
+    engine's same-name registrations survive (identity-checked), and
+    process-level sources (host cache) are never torn down by a detach."""
+    from flexible_llm_sharding_tpu.obs.registry import REGISTRY
+
+    a = ServingMetrics()
+    b = ServingMetrics()  # newer engine wins the process names
+    a.close()
+    # b's registrations survive a's teardown; the process collection
+    # still carries the serve source.
+    assert "serve" in REGISTRY.collect()
+    b.close()
+    assert "serve" not in REGISTRY.collect()
+    # Process-level source registered by its owner is untouched by an
+    # engine attaching/detaching a cache (mirror=False path).
+    REGISTRY.register("host_cache", lambda: {"hit_rate": 1.0})
+    c = ServingMetrics()
+    c.host_cache = _FakeCache()
+    c.host_cache = None
+    c.close()
+    assert REGISTRY.collect()["host_cache"] == {"hit_rate": 1.0}
+    REGISTRY.unregister("host_cache")
+
+
+def test_weak_source_releases_dead_instances():
+    from flexible_llm_sharding_tpu.obs.registry import weak_source
+
+    class Runner:
+        def __init__(self):
+            self.stats = {"x": 1}
+
+    r = Runner()
+    src = weak_source(r)
+    assert src() == {"x": 1}
+    del r
+    import gc
+
+    gc.collect()
+    assert src() == {}  # dead runner vanishes instead of being pinned
+
+
+def test_serving_metrics_prometheus_has_full_counter_family():
+    """Pre-seeded counters make 'zero recoveries' scrapeable (distinct
+    from 'recoveries not exported') — the smoke asserts this on a live
+    endpoint; this pins it at the unit level."""
+    m = ServingMetrics()
+    text = m.registry.prometheus_text()
+    for key in ("engine_recoveries", "waves_aborted", "source_restarts",
+                "watchdog_stalls", "admitted"):
+        assert f"fls_serve_{key} 0" in text
+
+
+# ---------------------------------------------------------------------------
+# Trace analyzer
+# ---------------------------------------------------------------------------
+
+def test_analyzer_derives_utilization_overlap_and_quantiles():
+    # Synthetic timeline: 2 produce spans (0.2s each, waits 0.1s total),
+    # serve latency instants with known quantiles.
+    evs = [
+        {"name": "shard_produce", "cat": "stream", "ts_s": 0.0, "dur_s": 0.2},
+        {"name": "shard_load", "cat": "stream", "ts_s": 0.0, "dur_s": 0.15},
+        {"name": "device_put", "cat": "stream", "ts_s": 0.15, "dur_s": 0.05},
+        {"name": "shard_produce", "cat": "stream", "ts_s": 0.5, "dur_s": 0.2},
+        {"name": "shard_load", "cat": "stream", "ts_s": 0.5, "dur_s": 0.2},
+        {"name": "source_wait", "cat": "sweep", "ts_s": 0.0, "dur_s": 0.1,
+         "sweep_id": 1},
+        {"name": "compute", "cat": "sweep", "ts_s": 0.2, "dur_s": 0.3,
+         "sweep_id": 1, "shard_idx": 0},
+        {"name": "sweep", "cat": "sweep", "ts_s": 0.0, "dur_s": 1.0,
+         "sweep_id": 1},
+    ] + [
+        {"name": "ttft", "cat": "serve", "ts_s": 0.9, "seconds": s}
+        for s in (0.1, 0.2, 0.3, 0.4)
+    ]
+    rep = obs_report.analyze(evs)
+    assert rep["wall_s"] == pytest.approx(1.0)
+    # Stream busy: union of shard_load/device_put = [0,0.2] + [0.5,0.7].
+    assert rep["stream_busy_s"] == pytest.approx(0.4)
+    assert rep["link_utilization"] == pytest.approx(0.4)
+    # overlap = 1 - wait/produce = 1 - 0.1/0.4.
+    assert rep["overlap_efficiency"] == pytest.approx(0.75)
+    assert rep["sweeps"] == 1
+    assert rep["sweep_phase_s"]["compute"] == pytest.approx(0.3)
+    assert rep["sweep_wall_s"] == pytest.approx(1.0)
+    q = rep["ttft_s"]
+    assert q["count"] == 4 and q["p50"] == 0.3 and q["max"] == 0.4
+    assert obs_report.format_report(rep)  # human rendering never raises
+
+
+def test_analyzer_roundtrips_both_export_formats(tmp_path):
+    t = Tracer()
+    time.sleep(0.05)  # real spans start well after tracer construction
+    t.enabled = True
+    with t.span("shard_load", cat="stream"):
+        time.sleep(0.002)
+    t.instant("ttft", cat="serve", seconds=0.5)
+    walls = {}
+    for suffix in ("chrome.json", "spans.jsonl"):
+        p = tmp_path / suffix
+        t.write(str(p))
+        evs = obs_report.load_trace(str(p))
+        rep = obs_report.analyze(evs)
+        assert rep["spans_by_name"]["shard_load"]["count"] == 1
+        assert rep["ttft_s"]["count"] == 1
+        walls[suffix] = rep["wall_s"]
+    # The Chrome export's synthetic trace_meta rides at ts=0 (tracer
+    # construction); the wall must anchor on the REAL events, so both
+    # formats report the same window for the same ring.
+    assert walls["chrome.json"] == pytest.approx(
+        walls["spans.jsonl"], abs=1e-3
+    )
+    assert walls["chrome.json"] < 0.05
+
+
+# ---------------------------------------------------------------------------
+# End to end: a traced streamed run and a traced serve run
+# ---------------------------------------------------------------------------
+
+def test_executor_run_produces_sweep_timeline(model, process_tracer):
+    from flexible_llm_sharding_tpu.runtime.executor import StreamingExecutor
+
+    ex = StreamingExecutor(_fw(model), tokenizer=FakeTokenizer())
+    ex(list(PROMPTS))
+    spans = process_tracer.snapshot()
+    names = {s["name"] for s in spans}
+    assert {"sweep", "compute", "source_wait", "shard_load",
+            "shard_produce", "device_put"} <= names
+    # Correlation: every compute span carries the pass's sweep_id.
+    sweep_ids = {s["sweep_id"] for s in spans if s["name"] == "compute"}
+    assert len(sweep_ids) == 1
+    rep = obs_report.analyze(spans)
+    assert rep["sweeps"] == 1
+    assert 0.0 <= rep["link_utilization"] <= 1.0
+    assert "overlap_efficiency" in rep
+
+
+def test_serve_run_traces_waves_and_exposes_metrics(model, process_tracer):
+    from flexible_llm_sharding_tpu.serve import ServeEngine
+
+    engine = ServeEngine(
+        _fw(model),
+        ServeConfig(
+            max_wave_requests=2, default_max_new_tokens=2, metrics_port=0,
+        ),
+        tokenizer=FakeTokenizer(),
+    )
+    try:
+        reqs = [engine.submit(p, s) for p, s in PROMPTS]
+        for r in reqs:
+            r.future.result(timeout=300)
+        port = engine.metrics_server.port
+        text = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=30
+        ).read().decode()
+    finally:
+        engine.shutdown(drain=True)
+    assert engine.error is None
+    # One scrape carries the acceptance set: queue depth, TTFT quantiles,
+    # streamed bytes, cache hit rate, retry/heal/recovery counters.
+    for series in (
+        "fls_serve_queue_depth",
+        "fls_serve_ttft_s_p99",
+        "fls_stream_streamed_bytes",
+        "fls_serve_engine_recoveries",
+        "fls_integrity_reread_heals",
+        "fls_host_cache_hit_rate",
+        "fls_trace_trace_drops",
+    ):
+        assert series in text, f"{series} missing from the exposition"
+    spans = process_tracer.snapshot()
+    names = {s["name"] for s in spans}
+    assert {"sweep", "prefill_shard", "decode_shard", "wave_admit",
+            "ttft", "token_latency", "request_finish"} <= names
+    # Wave correlation ids thread through: every prefill/decode span names
+    # its wave, every ttft its request.
+    assert all(
+        "wave_id" in s for s in spans
+        if s["name"] in ("prefill_shard", "decode_shard")
+    )
+    assert all("request_id" in s for s in spans if s["name"] == "ttft")
+    rep = obs_report.analyze(spans)
+    assert rep["ttft_s"]["count"] == len(PROMPTS)
+    assert rep["token_latency_s"]["count"] >= 1
+    assert rep["event_counts"]["wave_admit"] >= 1
