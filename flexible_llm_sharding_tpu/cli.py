@@ -109,6 +109,12 @@ def _add_robustness_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--chaos_sites", type=str, default="",
                    help=f"comma list of sites to inject at (default all): "
                         f"{','.join(FAULT_SITES)}")
+    p.add_argument("--chaos_max_faults", type=int, default=-1,
+                   help="total faults injected before the schedule goes "
+                        "permanently clean (-1 = unlimited) — models a "
+                        "transient outage that ENDS; e.g. replica_kill "
+                        "with a budget of 1 kills exactly one replica and "
+                        "lets the fleet prove clean failover")
     p.add_argument("--verify_weights", type=_str2bool, default=True,
                    help="checksum-verify every streamed layer against the "
                         "model dir's integrity.json (mismatches re-read to "
@@ -168,6 +174,7 @@ def _fault_config_from_args(args: argparse.Namespace) -> FaultConfig:
         truncate_rate=args.chaos_truncate_rate,
         latency_rate=args.chaos_latency_rate,
         sites=tuple(s for s in args.chaos_sites.split(",") if s),
+        max_faults=args.chaos_max_faults,
     )
 
 
@@ -374,6 +381,32 @@ def build_serve_parser() -> argparse.ArgumentParser:
                         "rate, residency savings, retry/heal/recovery "
                         "counters in one scrape; 0 = ephemeral port, "
                         "omit = off")
+    # Replica fleet (serve/fleet.py): N engines behind a shard-phase-aware
+    # router with health-driven draining and exactly-once re-dispatch.
+    p.add_argument("--replicas", type=int, default=1,
+                   help="serving engine replicas (thread-per-engine, one "
+                        "process, shared host shard cache). >1 runs the "
+                        "replica fleet: requests route to the healthiest "
+                        "replica by shard-phase proximity + queue depth, "
+                        "and a dead replica's queued/in-flight requests "
+                        "re-dispatch to a survivor exactly once, "
+                        "token-identically")
+    p.add_argument("--router_phase_weight", type=float, default=1.0,
+                   help="router score weight on sweep-phase proximity "
+                        "(fraction of a sweep until the replica's next "
+                        "shard-0 admission point)")
+    p.add_argument("--router_depth_weight", type=float, default=1.0,
+                   help="router score weight on normalized queue depth "
+                        "((queued + active) / max_active_requests)")
+    p.add_argument("--router_health_poll_s", type=float, default=0.2,
+                   help="fleet health-monitor poll interval: each tick "
+                        "reads per-replica registry health + the sweep "
+                        "liveness watermark (a busy replica stalled past "
+                        "--watchdog_abort_s is hard-failed)")
+    p.add_argument("--router_drain_recoveries", type=int, default=0,
+                   help="gracefully drain + recycle a replica whose "
+                        "engine_recoveries counter reaches this (a flaky-"
+                        "but-alive engine); 0 = off")
     _add_robustness_flags(p)
     _add_observability_flags(p)
     # Demo driver: submit a prompt pickle at staggered times, write the
@@ -429,6 +462,11 @@ def serve_main(argv: list[str] | None = None, tokenizer=None) -> None:
         stats_interval_s=args.stats_interval_s,
         watchdog_abort_s=args.watchdog_abort_s,
         metrics_port=args.metrics_port,
+        replicas=args.replicas,
+        router_phase_weight=args.router_phase_weight,
+        router_depth_weight=args.router_depth_weight,
+        router_health_poll_s=args.router_health_poll_s,
+        router_drain_recoveries=args.router_drain_recoveries,
     )
     if tokenizer is None:
         from transformers import AutoTokenizer
@@ -438,11 +476,17 @@ def serve_main(argv: list[str] | None = None, tokenizer=None) -> None:
 
     import time
 
-    from flexible_llm_sharding_tpu.serve import ServeEngine
+    from flexible_llm_sharding_tpu.serve import ReplicaFleet, ServeEngine
 
     from flexible_llm_sharding_tpu.serve.request import RequestStatus
 
-    engine = ServeEngine(cfg, serve_cfg, tokenizer=tokenizer)
+    # --replicas > 1 swaps the single engine for the replica fleet
+    # (serve/fleet.py) — same submit/drain/shutdown/stats surface, so the
+    # demo and jsonl frontends below drive either interchangeably.
+    if serve_cfg.replicas > 1:
+        engine = ReplicaFleet(cfg, serve_cfg, tokenizer=tokenizer)
+    else:
+        engine = ServeEngine(cfg, serve_cfg, tokenizer=tokenizer)
     if engine.metrics_server is not None:
         print(
             f"metrics endpoint: http://{engine.metrics_server.host}:"

@@ -31,7 +31,12 @@ SUPPORTED_ACTIVATIONS = frozenset({"silu", "gelu", "gelu_pytorch_tanh"})
 # are SILENT-corruption sites: instead of raising, they bit-flip (or
 # truncate) the bytes mid-flight — what the integrity layer's checksums
 # exist to catch (corrupt_shard: one layer file's loaded tensors;
-# corrupt_activation: one .npy spill read).
+# corrupt_activation: one .npy spill read). The replica_* sites are
+# REPLICA-level (serve/fleet.py, fired once per shard step of every
+# replica's sweep): replica_kill crashes a whole serving engine mid-sweep
+# (engine-fatal, modeling a dead replica process), replica_stall wedges
+# its thread until the fleet's liveness check declares it dead — both
+# exist to prove the router's hard-fail + exactly-once re-dispatch path.
 FAULT_SITES = (
     "shard_read",
     "device_put",
@@ -39,6 +44,8 @@ FAULT_SITES = (
     "queue_admission",
     "corrupt_shard",
     "corrupt_activation",
+    "replica_kill",
+    "replica_stall",
 )
 
 
@@ -1179,6 +1186,29 @@ class ServeConfig:
     # None = off; 0 = bind an ephemeral port (tests/parallel engines; the
     # bound port is engine.metrics_server.port).
     metrics_port: int | None = None
+    # --- replica fleet (serve/fleet.py; engaged by the CLI when > 1) ---
+    # N ServeEngine replicas behind a shard-phase-aware router: each runs
+    # its own sweep thread, all share the process host shard cache (a
+    # recycled replica re-warms instantly). Requests dispatch to the
+    # healthiest replica; a dead replica's queued and in-flight requests
+    # re-dispatch to a survivor exactly once, token-identically.
+    replicas: int = 1
+    # Router score = phase_weight * boundary_frac + depth_weight * load
+    # (serve/router.py): boundary_frac is the fraction of a sweep left
+    # until the replica's next shard-0 admission point, load its
+    # (queued + active) / max_active_requests. Lowest score wins.
+    router_phase_weight: float = 1.0
+    router_depth_weight: float = 1.0
+    # Fleet health-monitor poll interval (seconds): each tick reads every
+    # replica's registry health (engine_recoveries, watchdog stalls) and
+    # sweep-progress watermark; a busy replica whose watermark stalls past
+    # watchdog_abort_s is declared dead and hard-failed (watchdog_abort_s
+    # 0 disables the liveness check, as for the in-engine watchdog).
+    router_health_poll_s: float = 0.2
+    # Auto-drain threshold: a replica whose engine_recoveries counter
+    # (the PR 3 degrade path firing repeatedly — a flaky-but-alive
+    # engine) reaches this is gracefully drained and recycled. 0 = off.
+    router_drain_recoveries: int = 0
 
     def __post_init__(self) -> None:
         if self.queue_capacity < 1:
@@ -1204,3 +1234,13 @@ class ServeConfig:
                 "metrics_port must be in [0, 65535] (or None for off), "
                 f"got {self.metrics_port}"
             )
+        if self.replicas < 1:
+            raise ValueError("replicas must be >= 1")
+        if self.router_phase_weight < 0 or self.router_depth_weight < 0:
+            raise ValueError(
+                "router_phase_weight/router_depth_weight must be >= 0"
+            )
+        if self.router_health_poll_s <= 0:
+            raise ValueError("router_health_poll_s must be > 0")
+        if self.router_drain_recoveries < 0:
+            raise ValueError("router_drain_recoveries must be >= 0 (0 = off)")

@@ -11,6 +11,11 @@ hot paths, which call ``fire(site)`` at each named fault site:
   tensors (``corrupt_flat``: deterministic one-bit flip / truncate)
 - ``corrupt_activation`` — silent corruption of one ``.npy`` spill read
   (``corrupt_array``)
+- ``replica_kill``       — one shard step of one fleet replica's sweep:
+  the whole engine dies mid-sweep (``serve/fleet.py`` raises an
+  engine-fatal ``ReplicaKilled``)
+- ``replica_stall``      — same step: the engine thread wedges until the
+  fleet's liveness check declares the replica dead
 
 The schedule is a pure function of ``(seed, site, per-site call count)``
 via SHA-256 — NOT Python's ``hash`` (randomized per process) and NOT a

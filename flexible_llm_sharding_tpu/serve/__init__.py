@@ -15,6 +15,13 @@ over a fixed prompt set; this package turns the same runtime into a server:
 - ``engine``   — the serving loop: drives prefill/decode via the existing
   jitted runtime blocks, supports graceful drain and shutdown, resolves
   per-request futures/callbacks, and feeds utils.metrics.ServingMetrics.
+- ``router``   — shard-phase-aware replica ranking: dispatch to the
+  replica whose sweep reaches its next shard-0 admission point soonest,
+  weighted against normalized queue depth.
+- ``fleet``    — N engines behind the router: health-driven draining and
+  hard-fail (registry counters + sweep-watermark liveness), exactly-once
+  re-dispatch of a dead replica's requests, elastic join/leave, and the
+  replica-level chaos sites (replica_kill / replica_stall).
 """
 
 from flexible_llm_sharding_tpu.serve.request import (  # noqa: F401
@@ -29,14 +36,22 @@ from flexible_llm_sharding_tpu.serve.request import (  # noqa: F401
 from flexible_llm_sharding_tpu.serve.queue import AdmissionQueue  # noqa: F401
 from flexible_llm_sharding_tpu.serve.batcher import ShardAwareBatcher  # noqa: F401
 from flexible_llm_sharding_tpu.serve.engine import ServeEngine  # noqa: F401
+from flexible_llm_sharding_tpu.serve.router import Router  # noqa: F401
+from flexible_llm_sharding_tpu.serve.fleet import (  # noqa: F401
+    ReplicaFleet,
+    ReplicaKilled,
+)
 
 __all__ = [
     "AdmissionQueue",
     "DeadlineExceeded",
     "QueueFull",
+    "ReplicaFleet",
+    "ReplicaKilled",
     "Request",
     "RequestResult",
     "RequestStatus",
+    "Router",
     "ServeEngine",
     "ServeFuture",
     "ShardAwareBatcher",

@@ -118,8 +118,9 @@ class ShardAwareBatcher:
         cause (its future re-raises it) and all waves drop."""
         for w in self.waves:
             for r in w.requests:
-                if not r.status.terminal:
-                    r.fail(error, RequestStatus.FAILED)
+                # fail() is first-wins: a request a fleet reclaim already
+                # claimed must not be re-counted here.
+                if not r.status.terminal and r.fail(error, RequestStatus.FAILED):
                     if self._metrics is not None:
                         self._metrics.count("failed")
         self.waves = []

@@ -115,6 +115,7 @@ class ServeEngine:
         tokenizer=None,
         device=None,
         start: bool = True,
+        process_metrics_mirror: bool = True,
     ):
         if cfg.temperature > 0:
             raise ValueError(
@@ -161,7 +162,10 @@ class ServeEngine:
         # Sweep-timeline tracing (obs/trace.py): process-wide, enabled by
         # --trace; every span below is a no-op bool check when off.
         obs_trace.ensure_configured(cfg)
-        self.metrics = ServingMetrics()
+        # process_metrics_mirror=False: fleet-owned replica — this
+        # engine's sources stay out of the process-wide registry's bare
+        # 'serve'/... names (the fleet exports replica<idx> mirrors).
+        self.metrics = ServingMetrics(process_mirror=process_metrics_mirror)
         # Chaos injector (None unless cfg.faults.enabled) and the weight
         # stream's retry policy — threaded into the admission queue and
         # every source this engine builds.
@@ -230,6 +234,15 @@ class ServeEngine:
         self._watchdog: StepWatchdog | None = None
         self._error: BaseException | None = None
         self._thread: threading.Thread | None = None
+        # Fleet hooks (serve/fleet.py). _sweep_pos/_heartbeat are the
+        # sweep-progress watermark the router's phase scoring and liveness
+        # check read lock-free (scalar writes from the engine thread only;
+        # a torn read just skews one routing score by one shard).
+        # fleet_hook, when set, is called once per shard step from the
+        # engine thread — the fleet's replica-level chaos sites fire there.
+        self._sweep_pos = 0
+        self._heartbeat = time.monotonic()
+        self.fleet_hook: Callable[[int], Any] | None = None
         if start:
             self.start()
 
@@ -276,6 +289,13 @@ class ServeEngine:
             ),
             callback=callback,
         )
+        return self.submit_request(req)
+
+    def submit_request(self, req: Request) -> Request:
+        """Enqueue a pre-built request (the fleet path: a re-dispatched
+        request must keep its stable ``dispatch_id`` and fleet-owned
+        callback across replicas, so the fleet builds the Request itself
+        instead of going through ``submit``'s constructor)."""
         if req.max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
         return self.queue.submit(req)
@@ -307,6 +327,63 @@ class ServeEngine:
     def stats(self) -> dict:
         return self.metrics.snapshot()
 
+    # -- fleet hooks (serve/fleet.py) --------------------------------------
+
+    def sweep_position(self) -> dict:
+        """Router/health snapshot, callable from any thread (lock-free
+        scalar reads). ``boundary_frac`` is the fraction of a weight sweep
+        remaining until this engine's next shard-0 admission point — the
+        phase-proximity term of the router's score (0.0 for an idle
+        engine: it sits AT the boundary polling its queue). ``watermark``
+        is the last monotonic instant the sweep made progress; a busy
+        engine whose watermark stalls past ``watchdog_abort_s`` is
+        declared dead by the fleet."""
+        n = len(self.shards)
+        pos = self._sweep_pos
+        sweeping = bool(self.batcher.waves)
+        return {
+            "shard_pos": pos,
+            "n_shards": n,
+            "boundary_frac": (n - pos) / n if sweeping else 0.0,
+            "watermark": self._heartbeat,
+            "busy": sweeping or len(self.queue) > 0,
+        }
+
+    def reclaim_inflight(self) -> list[Request]:
+        """Dead-replica orphan handoff: collect every request this engine
+        still holds non-terminal — queued AND in-flight — and return them
+        with their original prompts and ``dispatch_id``s so the caller
+        (the fleet's hard-fail path) can RE-DISPATCH them to a surviving
+        replica instead of surfacing an error. Without this, a dead
+        engine's in-flight requests were simply lost: ``_recover`` fails
+        them with WaveAborted only when the engine thread is alive to run
+        it, and a wedged/killed thread never does.
+
+        Each reclaimed request's own future resolves WaveAborted
+        (first-wins — a wedged engine thread waking up later loses the
+        claim, so a re-dispatched request is never double-served) but its
+        callback is deliberately NOT fired: the caller owns the onward
+        re-dispatch, and the callback path would surface the abort to the
+        submitter instead. Only call this once the engine has been
+        declared dead or is being force-recycled."""
+        err = WaveAborted(
+            "replica declared dead; request reclaimed for re-dispatch"
+        )
+        orphans: list[Request] = []
+        pools: list[list[Request]] = [self.queue.reclaim()]
+        # list() copies: the batcher's wave list may still be mutated by a
+        # not-quite-dead engine thread; iteration must not race it.
+        pools.append(
+            [r for w in list(self.batcher.waves) for r in list(w.requests)]
+        )
+        for r in [r for pool in pools for r in pool]:
+            if not r.status.terminal and r.future.claim():
+                r.status = RequestStatus.FAILED
+                r.finished_at = time.monotonic()
+                r.future.finish_error(err)
+                orphans.append(r)
+        return orphans
+
     # -- the serving loop --------------------------------------------------
 
     def _run(self) -> None:
@@ -332,6 +409,9 @@ class ServeEngine:
         try:
             while True:
                 # ---- shard-0 boundary: the admission point ----------------
+                # Boundary passes are liveness too: an idle engine polling
+                # its empty queue must not look wedged to the fleet.
+                self._heartbeat = time.monotonic()
                 wave = self.batcher.admit_at_boundary()
                 if wave is not None and not self._init_wave(wave):
                     continue  # wave failed at tokenization; re-check queue
@@ -395,6 +475,11 @@ class ServeEngine:
         fail exactly those requests with a structured WaveAborted carrying
         the root cause, drop their KV, restart the weight source, and keep
         serving — the admission queue and later submissions are untouched."""
+        # Recovery is progress: a fleet watching the watermark must see a
+        # self-healing engine as live (only a recovery that itself wedges —
+        # e.g. blocks joining a dead producer — re-stalls the watermark and
+        # escalates to replica death).
+        self._heartbeat = time.monotonic()
         if self._watchdog is not None:
             # Recovery itself can block (joining a wedged producer); an
             # armed watchdog firing mid-recovery would abort the FRESH
@@ -571,8 +656,7 @@ class ServeEngine:
             # cause surfaces instead of masquerading as a per-wave
             # rejection forever.
             for r in wave.requests:
-                if not r.status.terminal:
-                    r.fail(e, RequestStatus.FAILED)
+                if not r.status.terminal and r.fail(e, RequestStatus.FAILED):
                     self.metrics.count("failed")
             self.batcher.waves.remove(wave)
             obs_trace.instant(
@@ -599,6 +683,15 @@ class ServeEngine:
             for shard_pos, (layer_idxs, segments) in self._sweep_shards():
                 if wd is not None:
                     wd.tick()
+                # Sweep-progress watermark: position feeds the router's
+                # phase scoring, the timestamp its liveness check.
+                self._sweep_pos = shard_pos
+                self._heartbeat = time.monotonic()
+                if self.fleet_hook is not None:
+                    # Replica-level chaos (replica_kill raises an engine-
+                    # FATAL ReplicaKilled; replica_stall wedges this
+                    # thread until the fleet declares the replica dead).
+                    self.fleet_hook(shard_pos)
                 if self._injector is not None:
                     self._injector.fire(
                         "engine_step", detail=f"shard{shard_pos}"
@@ -622,6 +715,8 @@ class ServeEngine:
                             self._decode_shard(
                                 wave, shard_pos, layer_idxs, segments
                             )
+            # Back at the boundary: the next shard-0 admission is NOW.
+            self._sweep_pos = 0
 
     def _prefill_shard(self, wave, shard_pos, layer_idxs, segments) -> None:
         st: _WaveState = wave.state
@@ -783,12 +878,12 @@ class ServeEngine:
                 for s_i, s in enumerate(r.suffixes)
             ),
         )
-        r.resolve(scores, updated, tokens)
-        self.metrics.count("completed")
-        obs_trace.instant(
-            "request_finish", cat="serve", wave_id=wave.wave_id,
-            request_id=r.request_id, tokens=int(n),
-        )
+        if r.resolve(scores, updated, tokens):
+            self.metrics.count("completed")
+            obs_trace.instant(
+                "request_finish", cat="serve", wave_id=wave.wave_id,
+                request_id=r.request_id, tokens=int(n),
+            )
 
 
 __all__ = ["ServeEngine"]

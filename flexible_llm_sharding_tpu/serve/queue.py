@@ -122,15 +122,32 @@ class AdmissionQueue:
     def _finish_expired(self, evicted: list[Request]) -> None:
         for r in evicted:
             waited = time.monotonic() - r.arrival
-            r.fail(
+            won = r.fail(
                 DeadlineExceeded(
                     f"request {r.request_id} waited {waited:.3f}s in the "
                     "admission queue, past its deadline"
                 ),
                 RequestStatus.EXPIRED,
             )
-            if self._metrics is not None:
+            if won and self._metrics is not None:
                 self._metrics.count("expired")
+
+    def reclaim(self) -> list[Request]:
+        """Hard-fail orphan handoff (``ServeEngine.reclaim_inflight``):
+        close the queue and hand back everything still queued WITHOUT
+        resolving the live requests — the caller (the fleet's dead-replica
+        path) owns their terminal transition, which is a re-dispatch to a
+        surviving replica, not a cancellation. Requests whose deadline
+        already passed still resolve EXPIRED here (their contract was lost
+        before the replica died; re-dispatching them would serve a request
+        that is already uselessly late)."""
+        with self._lock:
+            self._closed = True
+            evicted = self._evict_expired_locked()
+            items = list(self._items)
+            self._items.clear()
+        self._finish_expired(evicted)
+        return items
 
     # -- introspection / shutdown ------------------------------------------
 
@@ -162,11 +179,11 @@ class AdmissionQueue:
                 self._items.clear()
         self._finish_expired(evicted)
         for r in cancelled:
-            r.fail(
+            won = r.fail(
                 ServeClosed("serve queue shut down before admission"),
                 RequestStatus.CANCELLED,
             )
-            if self._metrics is not None:
+            if won and self._metrics is not None:
                 self._metrics.count("cancelled")
         if self._metrics is not None:
             self._metrics.gauge("queue_depth", len(self))

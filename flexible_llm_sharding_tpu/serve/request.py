@@ -85,23 +85,58 @@ class ServeFuture:
     request's terminal error (QueueFull / DeadlineExceeded / ServeClosed /
     the engine failure). An optional ``callback(request)`` fires exactly
     once on ANY terminal transition, from the resolving thread.
+
+    Terminal transitions are FIRST-WINS: ``claim()`` hands exactly one
+    caller the right to finish the future, so two racing resolvers (the
+    engine thread completing a request vs the fleet reclaiming it from a
+    replica it declared dead, ``serve/fleet.py``) can never double-resolve
+    — the loser's resolution is silently dropped, which is the
+    never-double-served half of the fleet's exactly-once re-dispatch
+    contract.
     """
 
     def __init__(self) -> None:
         self._event = threading.Event()
+        self._claim_lock = threading.Lock()
+        self._claimed = False  # guarded by: _claim_lock
         self._result: RequestResult | None = None
         self._error: BaseException | None = None
 
     def done(self) -> bool:
         return self._event.is_set()
 
-    def set_result(self, result: RequestResult) -> None:
+    def claim(self) -> bool:
+        """First-wins terminal claim: True for exactly one caller, ever.
+        The claimer MUST follow up with ``finish_result``/``finish_error``
+        (waiters block until one lands)."""
+        with self._claim_lock:
+            if self._claimed:
+                return False
+            self._claimed = True
+            return True
+
+    def finish_result(self, result: RequestResult) -> None:
+        """Claimer-only: publish the result and wake waiters."""
         self._result = result
         self._event.set()
 
-    def set_error(self, error: BaseException) -> None:
+    def finish_error(self, error: BaseException) -> None:
+        """Claimer-only: publish the error and wake waiters."""
         self._error = error
         self._event.set()
+
+    def set_result(self, result: RequestResult) -> bool:
+        """claim + finish in one step; False (no-op) if already terminal."""
+        if not self.claim():
+            return False
+        self.finish_result(result)
+        return True
+
+    def set_error(self, error: BaseException) -> bool:
+        if not self.claim():
+            return False
+        self.finish_error(error)
+        return True
 
     def result(self, timeout: float | None = None) -> RequestResult:
         if not self._event.wait(timeout):
@@ -129,6 +164,13 @@ class Request:
     # time-to-first-token contract is already lost.
     deadline: float | None = None
     callback: Callable[["Request"], Any] | None = None
+    # Stable dispatch id (serve/fleet.py): the FLEET-level request id this
+    # engine-side attempt serves. Survives re-dispatch — every attempt for
+    # one fleet request carries the same dispatch_id, so the router can
+    # map any engine-side outcome (or a reclaimed orphan) back to exactly
+    # one caller-facing future and a re-dispatched request is never
+    # double-served. None outside fleet mode.
+    dispatch_id: int | None = None
     request_id: int = dataclasses.field(
         default_factory=lambda: next(_REQUEST_IDS)
     )
@@ -154,7 +196,10 @@ class Request:
     # Ordering contract: status/finished_at are assigned BEFORE the future
     # resolves (a waiter woken by future.result() must never observe a
     # stale non-terminal status), and the callback fires last (it may call
-    # future.result() itself).
+    # future.result() itself). First-wins: both transitions gate on
+    # ``future.claim()``, so racing resolvers (engine completion vs fleet
+    # reclaim) produce exactly one terminal state and exactly one callback
+    # — the loser is a silent no-op.
 
     def _fire_callback(self) -> None:
         if self.callback is not None:
@@ -164,7 +209,13 @@ class Request:
                 pass  # a callback bug must not take down the serving loop
 
     def resolve(self, scores: np.ndarray, updated: Prompt,
-                tokens: np.ndarray) -> None:
+                tokens: np.ndarray) -> bool:
+        """Terminal DONE transition. Returns whether THIS call won the
+        claim — callers must gate side effects (completion counters,
+        trace events) on it, or a resolution racing a fleet reclaim
+        double-counts work that was re-dispatched elsewhere."""
+        if not self.future.claim():
+            return False  # already terminal (a racing fail/reclaim won)
         result = RequestResult(
             request_id=self.request_id,
             scores=scores,
@@ -176,14 +227,20 @@ class Request:
         )
         self.status = RequestStatus.DONE
         self.finished_at = time.monotonic()
-        self.future.set_result(result)
+        self.future.finish_result(result)
         self._fire_callback()
+        return True
 
-    def fail(self, error: BaseException, status: RequestStatus) -> None:
+    def fail(self, error: BaseException, status: RequestStatus) -> bool:
+        """Terminal failure transition; same claim/return contract as
+        ``resolve``."""
+        if not self.future.claim():
+            return False  # already terminal (first resolution wins)
         self.status = status
         self.finished_at = time.monotonic()
-        self.future.set_error(error)
+        self.future.finish_error(error)
         self._fire_callback()
+        return True
 
 
 __all__ = [

@@ -1,0 +1,75 @@
+"""Shard-phase-aware request routing for the replica fleet.
+
+Every replica runs the same endless weight sweep, and a request only ever
+JOINS at a shard-0 boundary (``serve/batcher.py``): a request handed to a
+replica whose sweep is about to re-enter shard 0 starts its prefill a full
+sweep sooner than one handed to a replica that just left the boundary.
+That makes routing phase-aware in a way generic load balancers cannot be
+— the "least loaded" replica is not the fastest to first token when its
+sweep has the whole model still to stream before the next admission point.
+
+The score combines the two signals the engine exports lock-free
+(``ServeEngine.sweep_position`` / queue+batcher depths)::
+
+    score(replica) = phase_weight * boundary_frac + depth_weight * load
+
+- ``boundary_frac``: fraction of a sweep remaining until the replica's
+  next shard-0 admission (0.0 for an idle replica — it admits
+  immediately; 1.0 for one that just started a sweep).
+- ``load``: (queued + active requests) / max_active_requests — queue
+  depth normalized by the replica's own admission budget, so replicas of
+  different sizes compare fairly.
+
+Lowest score wins; ties break to the lowest replica index (deterministic,
+and keeps a cold fleet filling from replica 0 so tests can reason about
+placement). Draining/dead replicas are never candidates — health is the
+fleet's job (``serve/fleet.py``); the router only ranks the replicas the
+fleet says are serving.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+
+class Router:
+    """Stateless ranking over replica snapshots (the fleet owns replica
+    lifecycle and the dispatch bookkeeping; the router only answers
+    "who should take the next request")."""
+
+    def __init__(
+        self, phase_weight: float = 1.0, depth_weight: float = 1.0
+    ) -> None:
+        if phase_weight < 0 or depth_weight < 0:
+            raise ValueError("router weights must be >= 0")
+        self.phase_weight = phase_weight
+        self.depth_weight = depth_weight
+
+    def score(self, snapshot: dict) -> float:
+        """Dispatch cost of one replica snapshot (lower = better):
+        ``{"boundary_frac", "queue_depth", "active", "max_active"}``."""
+        load = (snapshot["queue_depth"] + snapshot["active"]) / max(
+            snapshot.get("max_active", 1), 1
+        )
+        return (
+            self.phase_weight * snapshot["boundary_frac"]
+            + self.depth_weight * load
+        )
+
+    def pick(self, replicas: list[Any], exclude: Any = None):
+        """The healthiest serving replica for the next request, or None
+        when none is serving (the fleet parks the request until one
+        recovers). ``exclude`` — the replica a re-dispatched request just
+        failed on — is skipped whenever any alternative exists: an orphan
+        must land on a SURVIVING replica, but with a single serving
+        replica left (which may be the excluded one, freshly recovered)
+        serving beats failing."""
+        candidates = [r for r in replicas if r.serving]
+        if exclude is not None and len(candidates) > 1:
+            candidates = [r for r in candidates if r is not exclude] or candidates
+        if not candidates:
+            return None
+        return min(candidates, key=lambda r: (self.score(r.snapshot()), r.idx))
+
+
+__all__ = ["Router"]

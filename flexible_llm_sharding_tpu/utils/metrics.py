@@ -408,12 +408,22 @@ class ServingMetrics:
         "watchdog_stalls",
     )
 
-    def __init__(self, sample_window: int = 4096) -> None:
+    def __init__(
+        self, sample_window: int = 4096, process_mirror: bool = True
+    ) -> None:
         import threading
         from collections import deque
 
         from flexible_llm_sharding_tpu.obs.registry import MetricsRegistry
 
+        # process_mirror=False (fleet-owned engines): keep every source in
+        # this engine's OWN registry but never mirror it process-wide —
+        # with N replicas the last-wins 'serve'/'io_retries'/... names
+        # would otherwise expose ONE arbitrary replica's counters as the
+        # process family (and drop the family entirely whenever that
+        # replica is recycled). The fleet exports per-replica mirrors
+        # under replica<idx> instead.
+        self.process_mirror = process_mirror
         self._lock = threading.Lock()
         self._counters: dict[str, int] = {k: 0 for k in self.KNOWN_COUNTERS}
         self._gauges: dict[str, float] = {}
@@ -450,7 +460,7 @@ class ServingMetrics:
         from flexible_llm_sharding_tpu.obs.registry import REGISTRY
 
         self.registry.register(name, source)
-        if mirror:
+        if mirror and self.process_mirror:
             self._mirrored[name] = source
             REGISTRY.register(name, source)
 
@@ -542,6 +552,57 @@ class ServingMetrics:
             self._last_emit = now
         self.emit()
         return True
+
+
+class RouterMetrics:
+    """Counters/gauges for the replica fleet's router (``serve/fleet.py``).
+
+    Thread-safe (submitter threads dispatch, engine threads report
+    terminal outcomes, the health monitor drains/recycles). Counters are
+    PRE-SEEDED to 0 (``KNOWN_COUNTERS``) so the Prometheus exposition
+    always carries the full ``fls_router_*`` family — a scrape can tell
+    "zero re-dispatches happened" from "re-dispatches not exported", the
+    same zero-vs-unexported contract ``ServingMetrics.KNOWN_COUNTERS``
+    established. The fleet registers ``snapshot`` into the process-wide
+    metrics registry under the ``router`` source name."""
+
+    KNOWN_COUNTERS = (
+        "dispatches",          # requests handed to a replica (first attempt)
+        "redispatches",        # orphans re-dispatched to a surviving replica
+        "expired_orphans",     # orphans whose deadline lapsed -> EXPIRED
+        "stale_results",       # outcomes from attempts the fleet abandoned
+        "replicas_dead",       # hard-fails (engine-fatal / stalled watermark)
+        "replicas_drained",    # graceful drains completed
+        "replicas_recycled",   # fresh engines brought up in a dead/drained slot
+        "replicas_added",      # elastic joins
+        "replicas_removed",    # elastic leaves
+    )
+
+    def __init__(self) -> None:
+        import threading
+
+        self._lock = threading.Lock()
+        self._counters: dict[str, int] = {k: 0 for k in self.KNOWN_COUNTERS}
+        self._gauges: dict[str, float] = {}
+
+    def count(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + n
+
+    def gauge(self, name: str, value: float) -> None:
+        with self._lock:
+            self._gauges[name] = value
+
+    def counter(self, name: str) -> int:
+        with self._lock:
+            return self._counters.get(name, 0)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                **{k: v for k, v in sorted(self._counters.items())},
+                **{k: v for k, v in sorted(self._gauges.items())},
+            }
 
 
 @contextlib.contextmanager
@@ -950,6 +1011,7 @@ __all__ = [
     "LiveArrayPeakSampler",
     "Recorder",
     "RetryRecorder",
+    "RouterMetrics",
     "ServingMetrics",
     "StepWatchdog",
     "assemble_serve_stats",

@@ -209,6 +209,74 @@ def main() -> int:
         )
         return 1
     print(f"serve with host shard cache: token-identical, hit_rate={hit_rate}")
+
+    # 4) Replica FLEET under replica_kill: 3 engines behind the shard-
+    # phase-aware router; a seeded kill takes one whole engine down
+    # mid-sweep. Every request must still complete token-identical to the
+    # single-engine no-chaos oracle (the dead replica's queued/in-flight
+    # requests re-dispatch to a survivor exactly once), and ONE scrape of
+    # the fleet's metrics endpoint must report a nonzero
+    # fls_router_redispatches — the operator-visible witness that the
+    # failover actually ran (CI greps the line printed below).
+    from flexible_llm_sharding_tpu.serve import ReplicaFleet
+
+    fleet = ReplicaFleet(
+        _cfg(
+            model_dir,
+            faults=FaultConfig(
+                enabled=True, seed=SEED, error_rate=1.0,
+                sites=("replica_kill",), max_faults=1,
+            ),
+        ),
+        ServeConfig(
+            replicas=3, max_wave_requests=2, default_max_new_tokens=1,
+            router_health_poll_s=0.05, metrics_port=0,
+        ),
+        tokenizer=FakeTokenizer(),
+    )
+    try:
+        reqs = [fleet.submit(p, s) for p, s in PROMPTS]
+        results = [r.future.result(timeout=600) for r in reqs]
+        import re
+        import urllib.request
+
+        port = fleet.metrics_server.port
+        exposition = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=30
+        ).read().decode()
+    finally:
+        fleet.shutdown(drain=True)
+    if fleet.error is not None:
+        print(f"FAIL: fleet error {fleet.error!r}", file=sys.stderr)
+        return 1
+    for res, want in zip(results, clean):
+        if not (res.scores.argmax(-1) == want.argmax(-1)).all():
+            print(
+                "FAIL: fleet output diverged under replica_kill",
+                file=sys.stderr,
+            )
+            return 1
+    m = re.search(r"^fls_router_redispatches (\d+)", exposition, re.M)
+    if not m or int(m.group(1)) < 1:
+        print(
+            "FAIL: exposition reports no nonzero fls_router_redispatches "
+            "(did the kill land?)",
+            file=sys.stderr,
+        )
+        return 1
+    router = fleet.metrics.snapshot()
+    if router.get("replicas_dead", 0) < 1 or router.get("replicas_recycled", 0) < 1:
+        print(
+            f"FAIL: no replica died/recycled under replica_kill: {router}",
+            file=sys.stderr,
+        )
+        return 1
+    print(json.dumps({"event": "fleet_router_stats", **router}))
+    print(
+        f"fleet_chaos_ok redispatches={m.group(1)} "
+        f"replicas_dead={router['replicas_dead']} "
+        f"replicas_recycled={router['replicas_recycled']}"
+    )
     return 0
 
 

@@ -525,6 +525,66 @@ def test_site_reg_positive_and_negative(tmp_path):
     assert not any("'good_site'" in x for x in m)  # registered+documented+used
 
 
+FLEET_SITE_CONFIG = (
+    'FAULT_SITES = ("replica_kill", "replica_stall")\n'
+)
+FLEET_SITE_MOD = """
+class _Fleet:
+    def _chaos_step(self, rep, shard_pos):
+        inj = self._injector
+        if inj is None:
+            return
+        inj.fire("replica_kill", detail=f"replica{rep.idx}")
+        inj.fire("replica_stall", detail=f"replica{rep.idx}")
+"""
+FLEET_SITE_DOCS = (
+    "| `replica_kill` | each shard step of each fleet replica's sweep |\n"
+    "| `replica_stall` | same step: the engine thread wedges |\n"
+)
+
+
+def test_site_reg_fleet_level_sites_positive(tmp_path):
+    """SITE-REG covers fleet-LEVEL site literals: replica_kill /
+    replica_stall fired from a fleet chaos hook (a method on a class,
+    not a module function) are recognized as used when registered in
+    FAULT_SITES and documented — 0 findings; dropping the doc rows or
+    the registration is a finding again."""
+    pkg = make_pkg(
+        tmp_path,
+        {"config.py": FLEET_SITE_CONFIG, "serve/fleet.py": FLEET_SITE_MOD},
+        docs=FLEET_SITE_DOCS,
+    )
+    res = run_pkg(pkg, select=["SITE-REG"])
+    assert msgs(res.findings, "SITE-REG") == []
+
+    # Negative arm 1: an undocumented fleet site is flagged.
+    pkg2 = make_pkg(
+        tmp_path,
+        {"config.py": FLEET_SITE_CONFIG, "serve/fleet.py": FLEET_SITE_MOD},
+        docs="| `replica_kill` | documented |\n",
+        name="fleetdoc",
+    )
+    res2 = run_pkg(pkg2, select=["SITE-REG"])
+    assert any(
+        "'replica_stall'" in m and "missing from the docs" in m
+        for m in msgs(res2.findings, "SITE-REG")
+    )
+
+    # Negative arm 2: an unregistered fleet site is flagged at the hook.
+    pkg3 = make_pkg(
+        tmp_path,
+        {"config.py": 'FAULT_SITES = ("replica_kill",)\n',
+         "serve/fleet.py": FLEET_SITE_MOD},
+        docs=FLEET_SITE_DOCS,
+        name="fleetreg",
+    )
+    res3 = run_pkg(pkg3, select=["SITE-REG"])
+    assert any(
+        "'replica_stall' fired but not registered" in m
+        for m in msgs(res3.findings, "SITE-REG")
+    )
+
+
 def test_site_reg_missing_doc_entry(tmp_path):
     pkg = make_pkg(
         tmp_path,
