@@ -329,10 +329,20 @@ _FLAG_ALIASES = {
     "deadline_s": ("ServeConfig", "default_deadline_s"),
 }
 _CHAOS_PREFIX = "chaos_"
+_PRESSURE_PREFIX = "pressure_"
 
 # cli.py functions that thread parsed args into config constructions.
-_BATCH_READERS = ("config_from_args", "_fault_config_from_args", "main")
-_SERVE_READERS = ("serve_main", "_fault_config_from_args")
+_BATCH_READERS = (
+    "config_from_args",
+    "_fault_config_from_args",
+    "_pressure_config_from_args",
+    "main",
+)
+_SERVE_READERS = (
+    "serve_main",
+    "_fault_config_from_args",
+    "_pressure_config_from_args",
+)
 
 
 def _class_fields(tree: ast.Module, class_name: str) -> set[str]:
@@ -418,9 +428,9 @@ def _args_reads(tree: ast.Module) -> dict[str, dict[str, int]]:
 
 @project_rule(
     "KNOB-SYNC",
-    "every FrameworkConfig/ServeConfig/FaultConfig flag exists in both CLI "
-    "parsers (or is declared single-parser), maps to a real field, and is "
-    "threaded into the construction",
+    "every FrameworkConfig/ServeConfig/FaultConfig/PressureConfig flag "
+    "exists in both CLI parsers (or is declared single-parser), maps to a "
+    "real field, and is threaded into the construction",
 )
 def knob_sync(ctx: ProjectContext) -> list[Finding]:
     cli = ctx.get("cli.py")
@@ -437,6 +447,7 @@ def knob_sync(ctx: ProjectContext) -> list[Finding]:
     fw = _class_fields(config.tree, "FrameworkConfig")
     sv = _class_fields(config.tree, "ServeConfig")
     fc = _class_fields(config.tree, "FaultConfig")
+    pc = _class_fields(config.tree, "PressureConfig")
     flags = _parser_flags(cli.tree)
     batch = flags.get("build_parser", {})
     serve = flags.get("build_serve_parser", {})
@@ -453,6 +464,10 @@ def knob_sync(ctx: ProjectContext) -> list[Finding]:
             return ("FaultConfig", "enabled") if "enabled" in fc else ("?", flag)
         if flag.startswith(_CHAOS_PREFIX) and flag[len(_CHAOS_PREFIX):] in fc:
             return ("FaultConfig", flag[len(_CHAOS_PREFIX):])
+        if flag == "pressure":
+            return ("PressureConfig", "enabled") if "enabled" in pc else ("?", flag)
+        if flag.startswith(_PRESSURE_PREFIX) and flag[len(_PRESSURE_PREFIX):] in pc:
+            return ("PressureConfig", flag[len(_PRESSURE_PREFIX):])
         if flag in _FLAG_ALIASES:
             cls, field = _FLAG_ALIASES[flag]
             fields = sv if cls == "ServeConfig" else fw
@@ -481,8 +496,8 @@ def knob_sync(ctx: ProjectContext) -> list[Finding]:
                         cli.path,
                         line,
                         f"--{flag} ({parser_name} parser) maps to no "
-                        "FrameworkConfig/ServeConfig/FaultConfig field and is "
-                        "not in DRIVER_FLAGS",
+                        "FrameworkConfig/ServeConfig/FaultConfig/"
+                        "PressureConfig field and is not in DRIVER_FLAGS",
                         symbol=f"parser.{parser_name}",
                     )
                 )
@@ -568,6 +583,8 @@ def knob_sync(ctx: ProjectContext) -> list[Finding]:
         ("serve_main", "serve", serve),
         ("_fault_config_from_args", "batch", batch),
         ("_fault_config_from_args", "serve", serve),
+        ("_pressure_config_from_args", "batch", batch),
+        ("_pressure_config_from_args", "serve", serve),
     ):
         for attr, line in sorted(reads.get(fn_name, {}).items()):
             if attr not in parser:

@@ -28,6 +28,7 @@ from flexible_llm_sharding_tpu.config import (
     FAULT_SITES,
     FaultConfig,
     FrameworkConfig,
+    PressureConfig,
 )
 
 
@@ -147,6 +148,43 @@ def _add_robustness_flags(p: argparse.ArgumentParser) -> None:
                         "fewer host syncs on big batches, more HBM)")
 
 
+def _add_pressure_flags(p: argparse.ArgumentParser) -> None:
+    """Shared by the batch and serve parsers: the resource-pressure
+    brownout controller (runtime/pressure.py; docs/pressure.md has the
+    ladder stages and recovery semantics)."""
+    p.add_argument("--pressure", action="store_true",
+                   help="enable the brownout controller: monitor host "
+                        "RAM, spill-disk space, HBM headroom, and the "
+                        "host->HBM link; under sustained pressure walk a "
+                        "reversible degradation ladder (shrink the host "
+                        "cache, evict residency pins, shed admissions "
+                        "with typed Overloaded rejections, drain fleet "
+                        "replicas) instead of dying — and step back down "
+                        "when pressure lifts. Off = zero overhead")
+    p.add_argument("--pressure_poll_s", type=float, default=1.0,
+                   help="pressure-monitor sampling interval (seconds)")
+    p.add_argument("--pressure_host_min_gb", type=float, default=1.0,
+                   help="MemAvailable floor in GB; below it the ladder "
+                        "steps up (0 = host signal off)")
+    p.add_argument("--pressure_disk_min_gb", type=float, default=1.0,
+                   help="spill-disk (--disk_folder filesystem) free-bytes "
+                        "floor in GB (0 = disk signal off)")
+    p.add_argument("--pressure_hbm_headroom_frac", type=float, default=0.05,
+                   help="device free/limit HBM headroom floor (0 = off)")
+    p.add_argument("--pressure_link_min_gbps", type=float, default=0.0,
+                   help="host->HBM streamed-bytes rate floor in GB/s "
+                        "while streaming (0 = link signal off)")
+    p.add_argument("--pressure_cache_shrink_frac", type=float, default=0.5,
+                   help="ladder level 1: host shard cache budget "
+                        "multiplier (LRU-evicts down to this fraction)")
+    p.add_argument("--pressure_shed_retry_after_s", type=float, default=1.0,
+                   help="retry-after hint carried by Overloaded "
+                        "rejections while shedding (ladder level 3)")
+    p.add_argument("--pressure_step_down_polls", type=int, default=3,
+                   help="consecutive clean polls required per ladder "
+                        "step DOWN (hysteresis against flapping)")
+
+
 def _add_observability_flags(p: argparse.ArgumentParser) -> None:
     """Shared by the batch and serve parsers: sweep-timeline tracing
     (obs/trace.py; docs/observability.md has the span model and the
@@ -162,6 +200,22 @@ def _add_observability_flags(p: argparse.ArgumentParser) -> None:
                    help="trace export path (default fls_trace.json): "
                         "Chrome trace-event JSON, or JSONL when the path "
                         "ends in .jsonl")
+
+
+def _pressure_config_from_args(args: argparse.Namespace) -> PressureConfig:
+    if not args.pressure:
+        return PressureConfig()
+    return PressureConfig(
+        enabled=True,
+        poll_s=args.pressure_poll_s,
+        host_min_gb=args.pressure_host_min_gb,
+        disk_min_gb=args.pressure_disk_min_gb,
+        hbm_headroom_frac=args.pressure_hbm_headroom_frac,
+        link_min_gbps=args.pressure_link_min_gbps,
+        cache_shrink_frac=args.pressure_cache_shrink_frac,
+        shed_retry_after_s=args.pressure_shed_retry_after_s,
+        step_down_polls=args.pressure_step_down_polls,
+    )
 
 
 def _fault_config_from_args(args: argparse.Namespace) -> FaultConfig:
@@ -269,6 +323,7 @@ def build_parser() -> argparse.ArgumentParser:
                         "integrity counters — the machine-readable form "
                         "of the final stats line) to this path at run end")
     _add_robustness_flags(p)
+    _add_pressure_flags(p)
     _add_observability_flags(p)
     return p
 
@@ -313,6 +368,7 @@ def config_from_args(args: argparse.Namespace) -> FrameworkConfig:
         trace=args.trace,
         trace_out=args.trace_out,
         faults=_fault_config_from_args(args),
+        pressure=_pressure_config_from_args(args),
     )
 
 
@@ -407,7 +463,14 @@ def build_serve_parser() -> argparse.ArgumentParser:
                    help="gracefully drain + recycle a replica whose "
                         "engine_recoveries counter reaches this (a flaky-"
                         "but-alive engine); 0 = off")
+    p.add_argument("--max_request_tokens", type=int, default=0,
+                   help="admission-side request size cap: estimated "
+                        "prompt tokens (longest suffix included) + "
+                        "max_new_tokens above this are rejected typed "
+                        "(RequestTooLarge) at submit, before they can "
+                        "join a wave and fail it at allocation; 0 = off")
     _add_robustness_flags(p)
+    _add_pressure_flags(p)
     _add_observability_flags(p)
     # Demo driver: submit a prompt pickle at staggered times, write the
     # offline-contract outputs. Without it, requests are read as JSON lines
@@ -452,6 +515,7 @@ def serve_main(argv: list[str] | None = None, tokenizer=None) -> None:
         trace=args.trace,
         trace_out=args.trace_out,
         faults=_fault_config_from_args(args),
+        pressure=_pressure_config_from_args(args),
     )
     serve_cfg = ServeConfig(
         queue_capacity=args.queue_capacity,
@@ -467,6 +531,7 @@ def serve_main(argv: list[str] | None = None, tokenizer=None) -> None:
         router_depth_weight=args.router_depth_weight,
         router_health_poll_s=args.router_health_poll_s,
         router_drain_recoveries=args.router_drain_recoveries,
+        max_request_tokens=args.max_request_tokens,
     )
     if tokenizer is None:
         from transformers import AutoTokenizer
@@ -830,6 +895,16 @@ def main(argv: list[str] | None = None, tokenizer=None) -> None:
     # in one process).
     LAST_DP_RANK_STATS.clear()
     reset_process_streamed_bytes()
+
+    # Brownout controller (--pressure): started HERE for the offline
+    # path — the monitor thread, ladder, and fls_pressure_* export are
+    # process-wide singletons that serve engines start themselves, but a
+    # batch run has no engine, and without this call the flag would
+    # parse and thread yet never act (the silent-no-op class KNOB-SYNC
+    # can't see because the args ARE read).
+    from flexible_llm_sharding_tpu.runtime import pressure as _pressure
+
+    _pressure.controller_for(cfg)
 
     t0 = time.perf_counter()
     # The sampler is the peak-HBM fallback for devices whose memory_stats()

@@ -37,6 +37,13 @@ SUPPORTED_ACTIVATIONS = frozenset({"silu", "gelu", "gelu_pytorch_tanh"})
 # (engine-fatal, modeling a dead replica process), replica_stall wedges
 # its thread until the fleet's liveness check declares it dead — both
 # exist to prove the router's hard-fail + exactly-once re-dispatch path.
+# The RESOURCE-PRESSURE sites model the three exhaustion paths the
+# architecture leans on hardest (runtime/pressure.py, docs/pressure.md):
+# host_oom raises MemoryError inside a host shard build (typed to
+# HostOOMError and retried like any transient I/O blip), disk_full raises
+# ENOSPC inside an activation-spill write (typed DiskFullError, same
+# retry ladder), link_throttle stalls a host->HBM put for latency_s —
+# a saturated link slows, it never errors.
 FAULT_SITES = (
     "shard_read",
     "device_put",
@@ -46,6 +53,9 @@ FAULT_SITES = (
     "corrupt_activation",
     "replica_kill",
     "replica_stall",
+    "host_oom",
+    "disk_full",
+    "link_throttle",
 )
 
 
@@ -90,6 +100,50 @@ class FaultConfig:
                 f"unknown fault sites {sorted(unknown)} (one of {FAULT_SITES})"
             )
         object.__setattr__(self, "sites", tuple(self.sites))
+
+
+@dataclasses.dataclass(frozen=True)
+class PressureConfig:
+    """Resource-pressure brownout controller (runtime/pressure.py). Off by
+    default; enabled by ``--pressure`` on both CLIs.
+
+    A ``PressureMonitor`` samples host MemAvailable, spill-disk free
+    bytes, HBM headroom, and the host->HBM link rate every ``poll_s``;
+    when a threshold trips (or a hard resource failure — a real or
+    injected ``host_oom``/``disk_full`` event — is observed), the
+    ``BrownoutController`` walks an ordered, REVERSIBLE degradation
+    ladder: shrink the host shard cache, evict residency pins back to
+    streaming, shed new admissions with a typed ``Overloaded`` rejection
+    (carrying ``shed_retry_after_s`` as the retry hint), and drain fleet
+    replicas — then steps back down once ``step_down_polls`` consecutive
+    polls come back clean. Thresholds set to 0 disable that signal
+    (events still drive the ladder)."""
+
+    enabled: bool = False
+    poll_s: float = 1.0
+    # Signal thresholds (0 = that signal off; unknown samples never trip).
+    host_min_gb: float = 1.0      # MemAvailable floor
+    disk_min_gb: float = 1.0      # spill-disk (disk_folder) free-bytes floor
+    hbm_headroom_frac: float = 0.05  # device free/limit floor
+    link_min_gbps: float = 0.0    # host->HBM streamed-bytes rate floor
+    # Ladder behavior.
+    cache_shrink_frac: float = 0.5   # level-1 host-cache budget multiplier
+    shed_retry_after_s: float = 1.0  # Overloaded.retry_after_s hint
+    step_down_polls: int = 3         # consecutive clean polls per step down
+
+    def __post_init__(self) -> None:
+        if self.poll_s <= 0:
+            raise ValueError("poll_s must be > 0")
+        for name in ("host_min_gb", "disk_min_gb", "link_min_gbps",
+                     "shed_retry_after_s"):
+            if getattr(self, name) < 0:
+                raise ValueError(f"{name} must be >= 0")
+        for name in ("hbm_headroom_frac", "cache_shrink_frac"):
+            v = getattr(self, name)
+            if not 0.0 <= v <= 1.0:
+                raise ValueError(f"{name} must be in [0, 1], got {v}")
+        if self.step_down_polls < 1:
+            raise ValueError("step_down_polls must be >= 1")
 
 
 # Multimodal wrapper model types -> their language-model type. Published
@@ -963,6 +1017,9 @@ class FrameworkConfig:
     # and the chaos tests enable it). Frozen sub-config keeps this config
     # hashable.
     faults: FaultConfig = dataclasses.field(default_factory=FaultConfig)
+    # Resource-pressure brownout ladder (off by default; the --pressure
+    # CLI flag enables it — runtime/pressure.py, docs/pressure.md).
+    pressure: PressureConfig = dataclasses.field(default_factory=PressureConfig)
 
     def __post_init__(self) -> None:
         loc = self.storage_location
@@ -1209,6 +1266,13 @@ class ServeConfig:
     # (the PR 3 degrade path firing repeatedly — a flaky-but-alive
     # engine) reaches this is gracefully drained and recycled. 0 = off.
     router_drain_recoveries: int = 0
+    # Admission-side request size cap: a request whose estimated prompt
+    # tokens (longest suffix included) plus its max_new_tokens budget
+    # exceeds this is rejected at SUBMIT time with a typed
+    # RequestTooLarge — instead of first failing at allocation inside
+    # the wave (where an oversized request's MemoryError previously
+    # aborted the whole wave it joined). 0 = off.
+    max_request_tokens: int = 0
 
     def __post_init__(self) -> None:
         if self.queue_capacity < 1:
@@ -1244,3 +1308,5 @@ class ServeConfig:
             raise ValueError("router_health_poll_s must be > 0")
         if self.router_drain_recoveries < 0:
             raise ValueError("router_drain_recoveries must be >= 0 (0 = off)")
+        if self.max_request_tokens < 0:
+            raise ValueError("max_request_tokens must be >= 0 (0 = off)")

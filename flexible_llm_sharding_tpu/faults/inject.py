@@ -16,6 +16,13 @@ hot paths, which call ``fire(site)`` at each named fault site:
   engine-fatal ``ReplicaKilled``)
 - ``replica_stall``      — same step: the engine thread wedges until the
   fleet's liveness check declares the replica dead
+- ``host_oom``           — one layer read in ``_HostShardLoader``: raises
+  ``MemoryError`` (the loader types it to ``HostOOMError`` and retries —
+  the resource-pressure path, ``runtime/pressure.py``)
+- ``disk_full``          — one activation-spill file write: raises
+  ``OSError(ENOSPC)`` (typed to ``DiskFullError``, retried)
+- ``link_throttle``      — one shard's host->HBM put: every non-clean
+  draw SLEEPS ``latency_s`` (a saturated link slows, it never errors)
 
 The schedule is a pure function of ``(seed, site, per-site call count)``
 via SHA-256 — NOT Python's ``hash`` (randomized per process) and NOT a
@@ -129,10 +136,27 @@ class FaultInjector:
         if kind is None:
             return
         at = f"{site} #{n}" + (f" ({detail})" if detail else "")
+        if site == "link_throttle":
+            # A saturated host->HBM link SLOWS transfers, it never errors:
+            # every non-clean draw is a latency_s stall, whatever slot the
+            # shared rate partition put it in.
+            time.sleep(self.config.latency_s)
+            return
         if kind == "latency":
             time.sleep(self.config.latency_s)
         elif kind == "truncated":
             raise TruncatedRead(f"injected truncated read at {at}")
+        elif site == "host_oom":
+            # Resource-pressure site: a host allocation failure mid shard
+            # build. Raised as the REAL error type the hardened path must
+            # absorb (executor types it to HostOOMError and retries).
+            raise MemoryError(f"injected host OOM at {at}")
+        elif site == "disk_full":
+            import errno
+
+            # ENOSPC with a real errno, so the hardened spill-write path
+            # exercises exactly the branch a full disk takes.
+            raise OSError(errno.ENOSPC, f"injected disk full at {at}")
         else:
             raise InjectedFault(f"injected I/O error at {at}")
 

@@ -36,10 +36,17 @@ import os
 import jax
 import numpy as np
 
+import errno
+
+from flexible_llm_sharding_tpu.faults.retry import retry_call
 from flexible_llm_sharding_tpu.integrity import manifest as integrity_manifest
 from flexible_llm_sharding_tpu.integrity.manifest import (
     SpillCorruptError,
     SpillReadError,
+)
+from flexible_llm_sharding_tpu.runtime.pressure import (
+    DiskFullError,
+    note_event as _note_pressure_event,
 )
 
 # Spill-read re-read attempts before a checksum mismatch / decode failure
@@ -55,11 +62,40 @@ def _save_npy(path: str, arr: np.ndarray) -> None:
     dtype 'V2', which JAX rejects — so store a same-width uint view instead
     and let :func:`_restore_dtype` restore the real dtype on read. A sidecar
     (``<path>.crc``, integrity/manifest.py) lands atomically alongside so
-    every later fetch verifies the bytes it feeds back into the model."""
+    every later fetch verifies the bytes it feeds back into the model.
+
+    The write is ATOMIC (temp + rename): ``path`` either holds a complete
+    generation or is untouched, and the temp file is removed on any
+    failure — a disk-full event (ENOSPC surfaces at flush/close) can
+    never leave a truncated spill that later trips integrity re-reads or
+    masquerades as on-disk rot. np.save is handed the open file object
+    because the path form appends ``.npy`` to names that lack it, which
+    would break the temp-name contract."""
     if arr.dtype.isbuiltin == 0:  # extension dtype numpy can't describe
         arr = arr.view(np.dtype(f"u{arr.dtype.itemsize}"))
-    np.save(path, arr)
-    integrity_manifest.write_sidecar(path, arr)
+    tmp = f"{path}.tmp"
+    try:
+        with open(tmp, "wb") as f:
+            np.save(f, arr)
+            f.flush()
+            os.fsync(f.fileno())  # ENOSPC must surface HERE, not at rename
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass  # never-created / already-renamed temp
+        raise
+    try:
+        integrity_manifest.write_sidecar(path, arr)
+    except BaseException:
+        # The data landed but its NEW checksum didn't: drop whatever
+        # sidecar is present (the previous generation's would report the
+        # fresh, complete bytes as corruption) — a missing sidecar reads
+        # as unverified-but-intact, and the retrying caller rewrites
+        # both. Whole-or-absent stays true for the data file.
+        integrity_manifest.remove_sidecar(path)
+        raise
 
 
 def _restore_dtype(arr: np.ndarray, np_dtype: np.dtype | None) -> np.ndarray:
@@ -93,10 +129,17 @@ class ActivationStore:
         batch: int = 0,
         injector=None,
         integrity=None,
+        retry_policy=None,
+        retry_recorder=None,
     ):
         # injector: chaos-only FaultInjector (corrupt_activation site fires
-        # on every spill read). integrity: metrics.IntegrityRecorder for
+        # on every spill read; disk_full inside every retried spill
+        # write). integrity: metrics.IntegrityRecorder for
         # detected-corruption / re-read-heal counters (None = dropped).
+        # retry_policy/retry_recorder: spill WRITES retry ENOSPC under
+        # the same transient-I/O ladder as the weight stream (label
+        # 'spill_write'); exhaustion raises a typed DiskFullError with
+        # no partial file left behind.
         # np_dtype: the compute dtype of stored activations; needed to
         # restore ml_dtypes extension types (bfloat16) from disk files.
         # batch: the num_batch loop index — scopes disk file names (and the
@@ -138,6 +181,8 @@ class ActivationStore:
         self._shard_idx = 0  # for spill error messages (set_shard)
         self._injector = injector
         self._integrity = integrity
+        self._retry = retry_policy
+        self._retry_recorder = retry_recorder
         if location == "disk":
             os.makedirs(disk_folder, exist_ok=True)
 
@@ -177,6 +222,41 @@ class ActivationStore:
             self._fetch_gen = (shard_idx - 1) % 2
 
     # -- block API ---------------------------------------------------------
+    def _write_spill(self, path: str, arr: np.ndarray) -> None:
+        """One spill-file write, hardened for disk exhaustion: the atomic
+        ``_save_npy`` runs under the retry policy (the chaos ``disk_full``
+        site fires inside the retried region, exactly like ``shard_read``
+        on the weight path), ENOSPC is reported as a pressure event (the
+        brownout ladder frees space by shedding), and exhaustion raises a
+        typed :class:`DiskFullError` naming the file — with ``path``
+        guaranteed whole-or-absent by the temp+rename write."""
+
+        def attempt() -> None:
+            try:
+                if self._injector is not None:
+                    self._injector.fire("disk_full", detail=path)
+                _save_npy(path, arr)
+            except OSError as e:
+                if e.errno == errno.ENOSPC:
+                    _note_pressure_event("disk_full")
+                raise
+
+        try:
+            retry_call(
+                attempt,
+                policy=self._retry,
+                label="spill_write",
+                recorder=self._retry_recorder,
+            )
+        except OSError as e:
+            if e.errno == errno.ENOSPC:
+                raise DiskFullError(
+                    errno.ENOSPC,
+                    f"spill write failed, disk full: {path} "
+                    f"(shard {self._shard_idx}); no partial file was left",
+                ) from e
+            raise
+
     def _store_disk(
         self, prompt_idxs: list[int], prefix_h, suffix_h, gen: int = 0
     ) -> None:
@@ -185,9 +265,9 @@ class ActivationStore:
         suffix_np = np.asarray(jax.device_get(suffix_h))
         for row, idx in enumerate(prompt_idxs):
             ppath, spath = self._paths(idx, gen)
-            _save_npy(spath, suffix_np[row])
+            self._write_spill(spath, suffix_np[row])
             if prefix_np is not None:
-                _save_npy(ppath, prefix_np[row])
+                self._write_spill(ppath, prefix_np[row])
 
     def _read_spill(self, path: str) -> np.ndarray:
         """One verified spill read: np.load + (chaos) corruption injection

@@ -53,6 +53,10 @@ from flexible_llm_sharding_tpu.obs import trace as obs_trace
 from flexible_llm_sharding_tpu.obs.registry import REGISTRY as _OBS_REGISTRY
 from flexible_llm_sharding_tpu.parallel.planner import ShardPlan, plan_shards_dp
 from flexible_llm_sharding_tpu.runtime.activations import ActivationStore
+from flexible_llm_sharding_tpu.runtime.pressure import (
+    HostOOMError,
+    note_event as _note_pressure_event,
+)
 from flexible_llm_sharding_tpu.runtime.tokenization import (
     PromptTokenizer,
     check_longrope_regime,
@@ -534,15 +538,27 @@ class _HostShardLoader:
         mismatches = {"n": 0}
 
         def attempt() -> Params:
-            if self._injector is not None:
-                self._injector.fire("shard_read", detail=name)
             try:
+                if self._injector is not None:
+                    self._injector.fire("shard_read", detail=name)
+                    self._injector.fire("host_oom", detail=name)
                 return self._load_one_raw(name)
             except ChecksumMismatch:
                 mismatches["n"] += 1
                 if self._integrity is not None:
                     self._integrity.count("integrity_failures")
                 raise
+            except MemoryError as e:
+                # Host allocation failure (real, or the injected host_oom
+                # site above): typed into the RETRYABLE family — after
+                # the brownout ladder frees host RAM (cache shrink, pin
+                # eviction), a retry can succeed — and reported as a
+                # pressure event so the ladder engages. Before this, a
+                # MemoryError here escaped raw and was engine-FATAL.
+                _note_pressure_event("host_oom")
+                raise HostOOMError(
+                    f"host OOM loading {name}: {e}"
+                ) from e
 
         try:
             out = retry_call(
@@ -733,26 +749,37 @@ class _HostShardLoader:
                 run_decoder_idx.clear()
 
         t0 = time.perf_counter()
-        for idx in layer_idxs:
-            name = self.layer_names[idx]
-            params = self._cast(self._load_one(name))
-            if name.startswith("model.layers."):
-                if run and jax.tree.structure(run[-1]) != jax.tree.structure(params):
-                    # Mixed-structure stacks can't scan as one program
-                    # (llama4 interleaves dense and MoE layers): start a new
-                    # homogeneous run.
+        try:
+            for idx in layer_idxs:
+                name = self.layer_names[idx]
+                params = self._cast(self._load_one(name))
+                if name.startswith("model.layers."):
+                    if run and jax.tree.structure(run[-1]) != jax.tree.structure(params):
+                        # Mixed-structure stacks can't scan as one program
+                        # (llama4 interleaves dense and MoE layers): start a new
+                        # homogeneous run.
+                        flush()
+                    run.append(params)
+                    run_decoder_idx.append(int(name.split(".")[2]))
+                else:
                     flush()
-                run.append(params)
-                run_decoder_idx.append(int(name.split(".")[2]))
-            else:
-                flush()
-                kind = {
-                    "model.embed_tokens": "embed",
-                    "model.norm": "norm",
-                    "lm_head": "head",
-                }[name]
-                segments.append((kind, params))
-        flush()
+                    kind = {
+                        "model.embed_tokens": "embed",
+                        "model.norm": "norm",
+                        "lm_head": "head",
+                    }[name]
+                    segments.append((kind, params))
+            flush()
+        except MemoryError as e:
+            # Allocation failure in the stack/cast (outside _load_one's
+            # per-layer retry): typed + reported so the shard build fails
+            # as a degradable HostOOMError — the producer envelopes it,
+            # the serving engine fails only the in-flight waves — never
+            # as raw process-killing MemoryError.
+            _note_pressure_event("host_oom")
+            raise HostOOMError(
+                f"host OOM building shard {layer_idxs}: {e}"
+            ) from e
         self.load_time += time.perf_counter() - t0
         shard_bytes = sum(
             a.nbytes for _, seg in segments for a in jax.tree.leaves(seg)
@@ -1190,6 +1217,10 @@ class ShardWeightSource:
             # region.
             def put():
                 if self._injector is not None:
+                    # link_throttle stalls (never errors) — a saturated
+                    # host->HBM link is slowness the pressure monitor's
+                    # link-rate signal sees, not a fault to retry.
+                    self._injector.fire("link_throttle", detail=str(layer_idxs))
                     self._injector.fire("device_put", detail=str(layer_idxs))
                 return _assemble_parts(
                     parts, device, self._loader.np_dtype, self._residency,
@@ -1683,6 +1714,12 @@ class StreamingExecutor:
             batch=batch,
             injector=self._injector,
             integrity=self._integrity,
+            # Spill WRITES retry under the same policy as the weight
+            # stream's reads (disk_full/ENOSPC is transient when the
+            # pressure ladder frees space); retries land in io_retries
+            # under the 'spill_write' label.
+            retry_policy=self._retry_policy,
+            retry_recorder=self._retry_recorder,
         )
         resumable = self.cfg.storage_location == "disk"
         sig = self._resume_signature(toks) if resumable else ""

@@ -234,8 +234,13 @@ class HostShardCache:
             self.bytes = 0
 
     def set_budget(self, budget_bytes: int) -> None:
+        """Resize the budget. A SHRINK is safe for live readers: excess
+        entries evict LRU-first (counted as evictions, not
+        invalidations) while every surviving entry keeps serving hits —
+        shrinking changes capacity, never correctness. This is the
+        brownout ladder's cache lever (runtime/pressure.py)."""
         with self._lock:
-            self.budget_bytes = int(budget_bytes)
+            self.budget_bytes = max(int(budget_bytes), 0)
             while self.bytes > self.budget_bytes and self._entries:
                 self._drop(next(iter(self._entries)))
                 self.evictions += 1
@@ -265,6 +270,16 @@ class HostShardCache:
 
 _PROCESS_CACHE: HostShardCache | None = None
 _PROCESS_BUDGET_EXPLICIT = False
+# Brownout cap (runtime/pressure.py): while set, NO budget resolution —
+# explicit or auto — may exceed it. Without the latch, the very next
+# source construction after a pressure shrink would resize the cache
+# right back and undo the shed. _PRESSURE_INTENDED tracks the budget
+# the process WOULD run at absent the cap (normal precedence applied to
+# every resolution that lands mid-brownout), so lifting the cap
+# restores exactly that — never blindly the pre-brownout value, which
+# would override an explicit pin installed while the cap held.
+_PRESSURE_CAP: int | None = None
+_PRESSURE_INTENDED: int | None = None
 _PROCESS_LOCK = threading.Lock()
 
 
@@ -286,42 +301,126 @@ def cache_for(cfg) -> HostShardCache | None:
     if budget <= 0:
         return None
     explicit = cfg.host_cache_gb is not None
-    global _PROCESS_CACHE, _PROCESS_BUDGET_EXPLICIT
+    global _PROCESS_CACHE, _PROCESS_BUDGET_EXPLICIT, _PRESSURE_INTENDED
     with _PROCESS_LOCK:
+        cap = _PRESSURE_CAP
+        # Mid-brownout, precedence is decided against the INTENDED
+        # (un-capped) budget, which this resolution may move; the cache
+        # itself only ever sees min(intended, cap) — the ladder's cap
+        # bounds every resolution, and the 1-byte floor keeps the
+        # constructor/budget invariants while rendering the cache
+        # effectively empty. Lifting the cap installs the intended
+        # value, so an explicit pin that landed mid-brownout survives.
         if _PROCESS_CACHE is None:
+            if cap is not None:
+                _PRESSURE_INTENDED = budget
+                budget = min(budget, max(cap, 1))
             _PROCESS_CACHE = HostShardCache(budget)
             _PROCESS_BUDGET_EXPLICIT = explicit
             # Registry citizen: the metrics endpoint / --metrics_out see
             # the same hit-rate counters the stats lines print.
             _OBS_REGISTRY.register("host_cache", _PROCESS_CACHE.stats)
         elif explicit:
+            if cap is not None:
+                _PRESSURE_INTENDED = budget
+                budget = min(budget, max(cap, 1))
             if _PROCESS_CACHE.budget_bytes != budget:
                 _PROCESS_CACHE.set_budget(budget)
             _PROCESS_BUDGET_EXPLICIT = True
         elif not _PROCESS_BUDGET_EXPLICIT:
-            if budget > _PROCESS_CACHE.budget_bytes:
-                _PROCESS_CACHE.set_budget(budget)
+            base = (
+                _PRESSURE_INTENDED
+                if cap is not None and _PRESSURE_INTENDED is not None
+                else _PROCESS_CACHE.budget_bytes
+            )
+            if budget > base:
+                if cap is not None:
+                    _PRESSURE_INTENDED = budget
+                    budget = min(budget, max(cap, 1))
+                if budget > _PROCESS_CACHE.budget_bytes:
+                    _PROCESS_CACHE.set_budget(budget)
         return _PROCESS_CACHE
+
+
+def process_cache() -> HostShardCache | None:
+    """The live process cache, if any (the brownout ladder and the CLI's
+    end-of-run stats read it without resolving a budget)."""
+    with _PROCESS_LOCK:
+        return _PROCESS_CACHE
+
+
+def apply_pressure_cap(shrink_frac: float) -> int | None:
+    """Brownout level 1 (runtime/pressure.py): shrink the live process
+    cache to ``shrink_frac`` of its current budget — evicting LRU-first,
+    never invalidating surviving entries — and latch the cap so later
+    ``cache_for`` resolutions (explicit or auto) cannot grow past it
+    while the brownout holds (their un-capped value is tracked as the
+    INTENDED budget instead). Returns the pre-shrink budget, or None
+    when no cache is live."""
+    global _PRESSURE_CAP, _PRESSURE_INTENDED
+    with _PROCESS_LOCK:
+        cache = _PROCESS_CACHE
+        if cache is None:
+            return None
+        prev = cache.budget_bytes
+        _PRESSURE_CAP = max(int(prev * shrink_frac), 1)
+        _PRESSURE_INTENDED = prev
+        cap = _PRESSURE_CAP
+    # Eviction work runs OFF the process lock (set_budget takes the
+    # cache's own lock; a long eviction walk must not stall cache_for).
+    cache.set_budget(cap)
+    return prev
+
+
+def lift_pressure_cap(restore_bytes: int | None = None) -> None:
+    """Reverse :func:`apply_pressure_cap`: drop the latch and install
+    the INTENDED budget — the pre-shrink value, updated by normal
+    precedence for every resolution that landed while the cap held — so
+    an explicit pin installed mid-brownout is honored rather than blown
+    past by a blind restore. ``restore_bytes`` (apply's return value) is
+    only the fallback for callers holding state from before the
+    intended-budget tracking."""
+    global _PRESSURE_CAP, _PRESSURE_INTENDED
+    with _PROCESS_LOCK:
+        _PRESSURE_CAP = None
+        intended, _PRESSURE_INTENDED = _PRESSURE_INTENDED, None
+        cache = _PROCESS_CACHE
+    target = intended if intended is not None else restore_bytes
+    if cache is not None and target and target != cache.budget_bytes:
+        cache.set_budget(target)
+
+
+def pressure_cap() -> int | None:
+    """The live brownout cap (tests/introspection)."""
+    with _PROCESS_LOCK:
+        return _PRESSURE_CAP
 
 
 def reset_process_cache() -> None:
     """Drop the process cache (tests; a library caller switching models can
     simply let LRU eviction and the stat guards do their job)."""
-    global _PROCESS_CACHE, _PROCESS_BUDGET_EXPLICIT
+    global _PROCESS_CACHE, _PROCESS_BUDGET_EXPLICIT, _PRESSURE_CAP
+    global _PRESSURE_INTENDED
     with _PROCESS_LOCK:
         if _PROCESS_CACHE is not None:
             _PROCESS_CACHE.clear()
         _PROCESS_CACHE = None
         _PROCESS_BUDGET_EXPLICIT = False
+        _PRESSURE_CAP = None
+        _PRESSURE_INTENDED = None
     # A dropped cache must not leave a stale registry source behind.
     _OBS_REGISTRY.unregister("host_cache")
 
 
 __all__ = [
     "HostShardCache",
+    "apply_pressure_cap",
     "auto_budget_bytes",
     "available_host_bytes",
     "cache_for",
+    "lift_pressure_cap",
+    "pressure_cap",
+    "process_cache",
     "reset_process_cache",
     "stat_guard",
 ]

@@ -158,6 +158,10 @@ class PipelineRunner:
             max_in_cpu=self.cfg.max_activation_in_cpu,
             np_dtype=self._np_dtype,
             batch=batch,
+            # Spill writes retry ENOSPC under the run's policy (typed
+            # DiskFullError on exhaustion) — same contract as the
+            # single-device executor's store.
+            retry_policy=self.cfg.retry_policy(),
         )
         resumable = self.cfg.storage_location == "disk"
         last_real = max(

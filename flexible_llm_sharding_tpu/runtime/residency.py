@@ -295,6 +295,13 @@ class DeviceResidencyTier:
         self.stream_bytes_saved = 0
         self.pin_loads = 0
         self.pin_failures = 0
+        # Brownout demotion (runtime/pressure.py): while True, the plan
+        # is the empty pressure plan and tier_for skips every resize —
+        # an auto grower racing a brownout must not re-install pins the
+        # ladder just evicted. pressure_restore() re-installs the saved
+        # plan. Public so tier_for can read it without a tier method.
+        self.pressure_demoted = False  # guarded by: _lock
+        self._saved_plan: ResidencyPlan | None = None  # guarded by: _lock
 
     # -- membership --------------------------------------------------------
 
@@ -500,6 +507,9 @@ class DeviceResidencyTier:
                 "pin_loads": self.pin_loads,
                 "pin_failures": self.pin_failures,
                 "budget_bytes": self.plan.budget_bytes,
+                # 1 while a brownout holds the empty plan (the ladder's
+                # "pins evicted, not yet restored" witness).
+                "pressure_demoted": int(self.pressure_demoted),
             }
 
     def set_budget(self, budget_bytes: int, tied_embeddings: bool = False) -> None:
@@ -520,7 +530,70 @@ class DeviceResidencyTier:
 
     def _install_plan(self, plan: ResidencyPlan) -> None:
         with self._lock:
+            if self.pressure_demoted:
+                # A brownout demotion landed while the caller planned (or
+                # between its off-lock pressure_demoted pre-check and
+                # here — the pre-checks run under _PROCESS_LOCK, the
+                # demotion under THIS lock, so only this check is
+                # race-free): the evicted plan wins, the install is
+                # dropped. pressure_restore() reinstates the saved plan.
+                return
             self.plan = plan
+
+    # -- brownout (runtime/pressure.py) ------------------------------------
+
+    def pressure_unpin(self) -> int:
+        """Brownout level 2: evict the residency pins back to streaming.
+        Installs an EMPTY plan (budget 0) so every source built from now
+        on streams everything, and latches ``pressure_demoted`` so
+        ``tier_for`` cannot resize the plan back mid-brownout. Returns
+        the number of planned layers demoted (0 when already demoted or
+        nothing was planned).
+
+        The already-placed device trees are NOT dropped: live sources
+        froze their pin sets at construction and merge those exact
+        segments every build — yanking the seats would either desync
+        their segment structure or force a reload under the very memory
+        pressure this lever exists to relieve. The placed copies free
+        once the live sources cycle (the serve engine rebuilds its
+        source on every recovery; offline runs build one per call);
+        what this lever guarantees immediately is that no NEW HBM is
+        spent on pins and no new source plans any."""
+        with self._lock:
+            if self.pressure_demoted:
+                return 0
+            demoted = len(self.plan.pinned)
+            self._saved_plan = self.plan
+            self.plan = ResidencyPlan(
+                budget_bytes=0,
+                pinned=(),
+                layer_bytes=self.plan.layer_bytes,
+                skipped=tuple(range(len(self.layer_names))),
+            )
+            self.pressure_demoted = True
+        obs_trace.instant(
+            "pressure_unpin", cat="pressure", layers=demoted
+        )
+        return demoted
+
+    def pressure_restore(self) -> int:
+        """Reverse :meth:`pressure_unpin`: re-install the saved plan.
+        Pins whose placed trees survived (live sources kept them seated)
+        serve again immediately; dropped ones reload lazily through the
+        verified pin path on the next source construction. Returns the
+        number of layers restored to the plan."""
+        with self._lock:
+            if not self.pressure_demoted:
+                return 0
+            saved, self._saved_plan = self._saved_plan, None
+            if saved is not None:
+                self.plan = saved
+            self.pressure_demoted = False
+            restored = len(self.plan.pinned)
+        obs_trace.instant(
+            "pressure_repin", cat="pressure", layers=restored
+        )
+        return restored
 
 
 def checkpoint_unavailable(name: str):
@@ -577,7 +650,12 @@ def tier_for(
             else None
         )
         if tier is not None:
-            if explicit:
+            if tier.pressure_demoted:
+                # Mid-brownout: the ladder evicted the pins; no caller —
+                # explicit or auto — may re-plan them until the pressure
+                # lifts (pressure_restore re-installs the saved plan).
+                resize = False
+            elif explicit:
                 resize = tier.plan.budget_bytes != budget
                 if not resize:
                     # The cap is already in effect; when a resize IS
@@ -604,7 +682,9 @@ def tier_for(
             # (and resize to it) even when an auto caller won the install,
             # or a later auto call could grow past the pinned cap.
             tier = _PROCESS_TIER
-            if explicit:
+            if tier.pressure_demoted:
+                resize = False  # brownout holds the empty plan (see above)
+            elif explicit:
                 resize = tier.plan.budget_bytes != budget
                 if not resize:
                     _PROCESS_BUDGET_EXPLICIT = True
@@ -650,6 +730,11 @@ def _apply_process_budget(
         )
     global _PROCESS_BUDGET_EXPLICIT
     with _PROCESS_LOCK:
+        if tier.pressure_demoted:
+            # A brownout landed while this caller planned off-lock: the
+            # evicted plan wins; this install is dropped (the explicit
+            # latch is NOT taken either — the budget was never applied).
+            return
         if explicit:
             # Latch only here, with the plan in hand: the install and the
             # explicit mark land together, so a re-plan failure above

@@ -26,10 +26,12 @@ over a fixed prompt set; this package turns the same runtime into a server:
 
 from flexible_llm_sharding_tpu.serve.request import (  # noqa: F401
     DeadlineExceeded,
+    Overloaded,
     QueueFull,
     Request,
     RequestResult,
     RequestStatus,
+    RequestTooLarge,
     ServeFuture,
     WaveAborted,
 )
@@ -45,12 +47,14 @@ from flexible_llm_sharding_tpu.serve.fleet import (  # noqa: F401
 __all__ = [
     "AdmissionQueue",
     "DeadlineExceeded",
+    "Overloaded",
     "QueueFull",
     "ReplicaFleet",
     "ReplicaKilled",
     "Request",
     "RequestResult",
     "RequestStatus",
+    "RequestTooLarge",
     "Router",
     "ServeEngine",
     "ServeFuture",

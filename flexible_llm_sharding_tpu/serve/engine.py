@@ -221,7 +221,23 @@ class ServeEngine:
         self.queue = AdmissionQueue(
             self.serve_cfg.queue_capacity, metrics=self.metrics,
             injector=self._injector,
+            max_request_tokens=self.serve_cfg.max_request_tokens,
+            size_fn=self._request_size_tokens,
         )
+        # Resource-pressure brownout (runtime/pressure.py): the process
+        # controller (None unless cfg.pressure.enabled) sheds through
+        # this queue at its shed level — attached after construction so
+        # an engine joining mid-brownout starts shedding immediately —
+        # and its counters ride this engine's endpoint/stats line.
+        from flexible_llm_sharding_tpu.runtime import pressure as _pressure
+
+        self._pressure = _pressure.controller_for(cfg)
+        if self._pressure is not None:
+            self._pressure.attach_queue(self.queue)
+            self.metrics.register(
+                "pressure", self._pressure.stats,
+                mirror=False,  # process-level: controller_for registers it
+            )
         self.batcher = ShardAwareBatcher(
             self.queue,
             self.serve_cfg.max_wave_requests,
@@ -307,6 +323,10 @@ class ServeEngine:
         return self.shutdown(drain=True, timeout=timeout)
 
     def shutdown(self, drain: bool = True, timeout: float | None = None) -> bool:
+        if self._pressure is not None:
+            # A dead engine's queue must stop being a shed target (and a
+            # recycled replica's fresh queue attaches on construction).
+            self._pressure.detach_queue(self.queue)
         self.queue.close(drain=drain)
         ok = True
         if self._thread is not None:
@@ -583,6 +603,28 @@ class ServeEngine:
 
     # -- wave setup --------------------------------------------------------
 
+    def _request_size_tokens(self, req: Request) -> int:
+        """Admission-side size estimate: prefix tokens + the LONGEST
+        suffix's tokens + the generation budget — the per-row sequence
+        the wave will actually allocate (truncated exactly like the
+        PromptTokenizer will). Host-side tokenization only; runs on the
+        submitter thread, never the sweep loop. Known cost: with the cap
+        enabled, an ADMITTED request is tokenized again at wave init —
+        one extra host pass per request, accepted because the cap is
+        opt-in and reusing raw ids would entangle this estimate with
+        PromptTokenizer's bucketing state."""
+        pids = self.raw_tokenizer(
+            req.prefix, truncation=True, max_length=self.cfg.max_token_len
+        )["input_ids"]
+        longest = 0
+        if req.suffixes:
+            sids = self.raw_tokenizer(
+                list(req.suffixes), truncation=True,
+                max_length=self.cfg.max_token_len,
+            )["input_ids"]
+            longest = max((len(s) for s in sids), default=0)
+        return len(pids) + longest + req.max_new_tokens
+
     def _init_wave(self, wave: Wave) -> bool:
         """Tokenize/bucket the admitted requests and allocate wave state.
         A bad workload (e.g. a longrope regime straddle) fails ONLY this
@@ -647,11 +689,13 @@ class ServeEngine:
             # The typed workload-rejection family: tokenizer errors and the
             # longrope straddle raise ValueError, malformed requests
             # KeyError/TypeError/IndexError (an empty suffix tuple indexes
-            # an empty token array), an oversized prompt MemoryError (there
-            # is no admission-side length cap, so allocation is where a
-            # huge request first fails — it must reject that wave, not
-            # shut the engine down), XLA shape/compile problems
-            # RuntimeError. Anything OUTSIDE it is an engine bug, not a
+            # an empty token array), an oversized prompt MemoryError —
+            # the admission-side size cap (ServeConfig.max_request_tokens)
+            # rejects oversized requests typed at submit when configured,
+            # but the cap is optional and many concurrent waves can still
+            # exhaust the host, so allocation failures here must reject
+            # the wave, not shut the engine down — XLA shape/compile
+            # problems RuntimeError. Anything OUTSIDE it is an engine bug, not a
             # bad request — it escapes to _run's fatal path so the root
             # cause surfaces instead of masquerading as a per-wave
             # rejection forever.

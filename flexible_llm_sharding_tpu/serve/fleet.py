@@ -165,6 +165,16 @@ class ReplicaFleet:
         self._error: BaseException | None = None
         self._started = False
         obs_trace.ensure_configured(cfg)
+        # Resource-pressure brownout (runtime/pressure.py): at the
+        # ladder's deepest level the controller drains this fleet down to
+        # one replica (pressure_drain) and restores the population when
+        # pressure lifts (pressure_restore). Each replica's engine
+        # attaches its own admission queue as a shed target itself.
+        from flexible_llm_sharding_tpu.runtime import pressure as _pressure
+
+        self._pressure = _pressure.controller_for(cfg)
+        if self._pressure is not None:
+            self._pressure.attach_fleet(self)
         # Process-registry registration: the bound method is kept so
         # shutdown's unregister_if identity check matches.
         self._router_source = self.metrics.snapshot
@@ -227,6 +237,8 @@ class ReplicaFleet:
     def shutdown(
         self, drain: bool = True, timeout: float | None = None
     ) -> bool:
+        if self._pressure is not None:
+            self._pressure.detach_fleet(self)
         with self._lock:
             self._closed = True
             pending = list(self._pending)
@@ -443,6 +455,52 @@ class ReplicaFleet:
                 self._replicas.remove(rep)
         self.metrics.count("replicas_removed")
 
+    # -- brownout (runtime/pressure.py) ------------------------------------
+
+    def pressure_drain(self, keep: int = 1) -> int:
+        """Brownout level 4: gracefully retire all but ``keep`` serving
+        replicas — each drained slot serves out its queued and in-flight
+        requests (the monitor's ``_complete_drain`` path), then is
+        DROPPED rather than recycled (recycling would rebuild the engine
+        the ladder just shed). Non-blocking: returns how many replicas
+        were marked for removal. ``pressure_restore`` brings the
+        population back to ``serve_cfg.replicas`` once pressure lifts."""
+        marked: list[int] = []
+        with self._lock:
+            live = [r for r in self._replicas if r.serving]
+            for rep in live[max(keep, 1):]:
+                # The "removing" state rides the existing graceful-drain
+                # machinery; the >= 1 floor mirrors remove_replica's
+                # last-serving-replica refusal.
+                rep.state = "removing"
+                marked.append(rep.idx)
+        for idx in marked:
+            obs_trace.instant(
+                "replica_drain", cat="fleet", replica=idx, remove=True,
+                pressure=True,
+            )
+        return len(marked)
+
+    def pressure_restore(self) -> int:
+        """Reverse :meth:`pressure_drain`: add replicas back up to the
+        configured population. Returns how many were added. Safe to call
+        when nothing was drained (no-op) or after shutdown (0)."""
+        restored = 0
+        while True:
+            with self._lock:
+                if self._closed:
+                    return restored
+                deficit = self.serve_cfg.replicas - len(
+                    [r for r in self._replicas if r.serving]
+                )
+            if deficit <= 0:
+                return restored
+            try:
+                self.add_replica()
+            except ServeClosed:
+                return restored
+            restored += 1
+
     # -- chaos -------------------------------------------------------------
 
     def _chaos_step(self, rep: _Replica, shard_pos: int) -> None:
@@ -563,6 +621,12 @@ class ReplicaFleet:
                         deadline=outer.deadline,
                         callback=self._inner_terminal,
                         dispatch_id=outer.request_id,
+                        # A RE-dispatch is work the fleet accepted before
+                        # the original replica died: it must not be shed
+                        # Overloaded at the survivor's front door
+                        # (brownout sheds NEW admissions, never strands
+                        # already-accepted in-flight work).
+                        shed_exempt=redispatch,
                     )
                     disp.inner = inner
                     disp.replica = replica

@@ -14,6 +14,14 @@ loop pops batches at shard-0 boundaries. Three contracts, each loud:
 - **Drain-on-shutdown**: ``close(drain=True)`` refuses new submissions but
   lets the engine serve out everything already queued; ``drain=False``
   additionally cancels the queued requests (futures raise ``ServeClosed``).
+- **Brownout shedding** (``runtime/pressure.py``): while the pressure
+  ladder sits at its shed level, new submissions resolve as typed
+  ``Overloaded`` rejections carrying a retry-after hint — queued and
+  in-flight requests keep serving (brownout, not blackout).
+- **Size cap**: with ``ServeConfig.max_request_tokens`` set, a request
+  whose estimated prompt tokens + generation budget exceed the cap is
+  rejected typed (``RequestTooLarge``) at submit — before it can join a
+  wave and fail every co-admitted request at allocation.
 """
 
 from __future__ import annotations
@@ -24,29 +32,116 @@ from collections import deque
 
 from flexible_llm_sharding_tpu.serve.request import (
     DeadlineExceeded,
+    Overloaded,
     QueueFull,
     Request,
     RequestStatus,
+    RequestTooLarge,
     ServeClosed,
 )
 
 
 class AdmissionQueue:
-    def __init__(self, capacity: int, metrics=None, injector=None):
+    def __init__(
+        self,
+        capacity: int,
+        metrics=None,
+        injector=None,
+        max_request_tokens: int = 0,
+        size_fn=None,
+    ):
+        # max_request_tokens/size_fn: admission-side request size cap —
+        # size_fn(request) estimates prompt tokens + generation budget
+        # (the engine supplies a tokenizer-backed estimator); a request
+        # over the cap is rejected with a typed RequestTooLarge at
+        # submit, never admitted to fail a whole wave at allocation.
         if capacity < 1:
             raise ValueError("capacity must be >= 1")
         self.capacity = capacity
         self._metrics = metrics  # utils.metrics.ServingMetrics or None
         self._injector = injector  # faults.inject.FaultInjector or None
+        self._max_request_tokens = max_request_tokens
+        self._size_fn = size_fn
         self._lock = threading.Lock()
         self._items: deque[Request] = deque()  # guarded by: _lock
         self._closed = False  # guarded by: _lock
+        # Brownout shedding (runtime/pressure.py): while set, every new
+        # submit resolves as a typed Overloaded rejection carrying this
+        # retry-after hint; queued and in-flight requests keep serving.
+        self._shed_retry_after: float | None = None  # guarded by: _lock
+        self._on_shed = None  # guarded by: _lock
+
+    # -- brownout shedding (runtime/pressure.py) ---------------------------
+
+    def set_shedding(self, retry_after_s: float, on_shed=None) -> None:
+        """Start rejecting NEW submissions with a typed ``Overloaded``
+        carrying ``retry_after_s``. Idempotent; ``on_shed`` (a
+        no-argument callable, the brownout controller's shed counter)
+        fires once per rejected submit, outside the queue lock."""
+        with self._lock:
+            self._shed_retry_after = float(retry_after_s)
+            self._on_shed = on_shed
+
+    def clear_shedding(self) -> None:
+        """Resume admissions (the ladder stepped back down). Idempotent."""
+        with self._lock:
+            self._shed_retry_after = None
+            self._on_shed = None
+
+    @property
+    def shedding(self) -> bool:
+        with self._lock:
+            return self._shed_retry_after is not None
 
     # -- submit side -------------------------------------------------------
 
     def submit(self, request: Request) -> Request:
-        """Enqueue, or raise QueueFull/ServeClosed. Terminal transitions
-        happen OUTSIDE the lock (callbacks may be arbitrarily slow)."""
+        """Enqueue, or resolve the request as a typed rejection
+        (Overloaded while shedding, RequestTooLarge over the size cap,
+        QueueFull at capacity, ServeClosed after shutdown). Terminal
+        transitions happen OUTSIDE the lock (callbacks may be
+        arbitrarily slow)."""
+        with self._lock:
+            shed_after = self._shed_retry_after
+            on_shed = self._on_shed
+        if shed_after is not None and not request.shed_exempt:
+            # Brownout: deliberate load-shedding, cheapest check first —
+            # the whole point is to spend ~nothing per refused request.
+            hint = f"; retry after ~{shed_after:g}s" if shed_after else ""
+            request.fail(
+                Overloaded(
+                    "server is shedding load under resource pressure"
+                    f"{hint} (in-flight requests keep serving)",
+                    retry_after_s=shed_after or None,
+                ),
+                RequestStatus.REJECTED,
+            )
+            if self._metrics is not None:
+                self._metrics.count("rejected")
+            if on_shed is not None:
+                on_shed()
+            return request
+        if self._max_request_tokens > 0 and self._size_fn is not None:
+            # Size cap BEFORE the capacity check: an oversized request
+            # must not consume a queue slot on its way to a rejection.
+            # The estimate runs outside the lock (it tokenizes).
+            try:
+                est = self._size_fn(request)
+            except Exception:  # flscheck: disable=EXC-TAXONOMY: a size-estimator failure (tokenizer edge case) must not reject or crash admission — the wave-level typed rejection family still catches genuinely malformed requests with full context
+                est = None
+            if est is not None and est > self._max_request_tokens:
+                request.fail(
+                    RequestTooLarge(
+                        f"request {request.request_id}: ~{est} tokens "
+                        f"(prompt + max_new_tokens) exceeds the admission "
+                        f"cap of {self._max_request_tokens}; split the "
+                        "prompt or lower max_new_tokens"
+                    ),
+                    RequestStatus.REJECTED,
+                )
+                if self._metrics is not None:
+                    self._metrics.count("rejected")
+                return request
         if self._injector is not None:
             # Chaos site: a flaky front door. An injected error resolves
             # the request as a reasoned rejection (the same reject-with-

@@ -44,6 +44,28 @@ class QueueFull(RuntimeError):
     verbatim — the contract is reject-with-reason, never silent drops."""
 
 
+class Overloaded(QueueFull):
+    """Brownout load-shed rejection (runtime/pressure.py): the server is
+    under sustained resource pressure and is deliberately refusing NEW
+    admissions while it serves out what is already in flight. A QueueFull
+    subclass — every existing backpressure handler applies — that
+    additionally carries ``retry_after_s``, the operator-configured hint
+    for when the client should try again (the ladder steps back down once
+    pressure lifts)."""
+
+    def __init__(self, message: str, retry_after_s: float | None = None):
+        super().__init__(message)
+        self.retry_after_s = retry_after_s
+
+
+class RequestTooLarge(RuntimeError):
+    """Admission-side size rejection: the request's estimated prompt
+    tokens plus its generation budget exceed ``ServeConfig.
+    max_request_tokens``. Typed and raised at SUBMIT time — before the
+    request can join a wave and fail every co-admitted request at
+    allocation (the MemoryError-reaches-the-wave hole)."""
+
+
 class DeadlineExceeded(RuntimeError):
     """The request's queue-wait deadline passed before a wave admitted it."""
 
@@ -171,6 +193,12 @@ class Request:
     # one caller-facing future and a re-dispatched request is never
     # double-served. None outside fleet mode.
     dispatch_id: int | None = None
+    # Brownout-shed exemption (runtime/pressure.py x serve/fleet.py): a
+    # fleet RE-dispatch carries work the fleet accepted before its
+    # replica died — rejecting it Overloaded at the survivor's front
+    # door would break both the shed contract ("in-flight keeps
+    # serving") and exactly-once completion. Only the fleet sets this.
+    shed_exempt: bool = False
     request_id: int = dataclasses.field(
         default_factory=lambda: next(_REQUEST_IDS)
     )
@@ -245,11 +273,13 @@ class Request:
 
 __all__ = [
     "DeadlineExceeded",
+    "Overloaded",
     "Prompt",
     "QueueFull",
     "Request",
     "RequestResult",
     "RequestStatus",
+    "RequestTooLarge",
     "ServeClosed",
     "ServeFuture",
     "WaveAborted",

@@ -277,6 +277,142 @@ def main() -> int:
         f"replicas_dead={router['replicas_dead']} "
         f"replicas_recycled={router['replicas_recycled']}"
     )
+
+    # 5) Resource-pressure brownout (runtime/pressure.py): the process
+    # must DEGRADE under injected resource exhaustion, not die, and the
+    # degradation must REVERSE once pressure lifts.
+    import time
+
+    from flexible_llm_sharding_tpu.config import PressureConfig
+    from flexible_llm_sharding_tpu.runtime import hostcache, pressure
+    from flexible_llm_sharding_tpu.serve.request import Overloaded
+
+    # 5a) Offline disk-mode run under seeded disk_full on every spill
+    # write: the atomic (temp+rename) + retried write path absorbs the
+    # bounded outage token-identically, leaving no truncated spills.
+    ex = StreamingExecutor(
+        _cfg(
+            model_dir,
+            storage_location="disk",
+            disk_folder=os.path.join(tmp, "pressure_spills"),
+            faults=FaultConfig(
+                enabled=True, seed=SEED, error_rate=0.3,
+                sites=("disk_full",), max_faults=8,
+            ),
+        ),
+        tokenizer=FakeTokenizer(),
+    )
+    got = ex(list(PROMPTS))
+    for g, w in zip(got, clean):
+        np.testing.assert_array_equal(g, w)
+    n_enospc = ex._injector.count("disk_full")
+    if n_enospc < 1:
+        print("FAIL: disk_full schedule never fired", file=sys.stderr)
+        return 1
+    print(
+        f"offline disk under disk_full: token-identical, "
+        f"injected={n_enospc}, spill_write retries recovered"
+    )
+
+    # 5b) Serve under seeded host_oom with the brownout ladder on: hard
+    # OOM events escalate the ladder to its shed level (new submissions
+    # get typed Overloaded with a retry-after hint) while in-flight
+    # requests keep serving token-identically; once the bounded outage
+    # ends the ladder steps back down and the host-cache budget is
+    # restored — the reversibility half of the acceptance bar. The
+    # scraped endpoint must carry nonzero fls_pressure_sheds.
+    pressure.reset_process_pressure()
+    hostcache.reset_process_cache()
+    engine = ServeEngine(
+        _cfg(
+            model_dir,
+            host_cache_gb=0.5,  # explicit: stays live under chaos
+            faults=FaultConfig(
+                enabled=True, seed=SEED, error_rate=0.6,
+                sites=("host_oom",), max_faults=8,
+            ),
+            pressure=PressureConfig(
+                enabled=True, poll_s=0.05, host_min_gb=0.0,
+                disk_min_gb=0.0, hbm_headroom_frac=0.0,
+                shed_retry_after_s=0.05, step_down_polls=4,
+            ),
+        ),
+        ServeConfig(
+            max_wave_requests=2, default_max_new_tokens=1, metrics_port=0,
+        ),
+        tokenizer=FakeTokenizer(),
+    )
+    ctrl = pressure.process_controller()
+    cache = hostcache.process_cache()
+    budget_before = cache.budget_bytes
+    sheds = 0
+    served = []
+    try:
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline and (sheds == 0 or not served):
+            req = engine.submit(*PROMPTS[0])
+            try:
+                served.append(req.future.result(timeout=120))
+            except Overloaded:
+                sheds += 1
+            time.sleep(0.005)
+        if sheds < 1:
+            print("FAIL: brownout never shed a request", file=sys.stderr)
+            return 1
+        for res in served:
+            if not (res.scores.argmax(-1) == clean[0].argmax(-1)).all():
+                print(
+                    "FAIL: served output diverged under host_oom",
+                    file=sys.stderr,
+                )
+                return 1
+        # Pressure lifts (the fault budget is exhausted): the ladder
+        # must demonstrably reverse.
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline and ctrl.level > 0:
+            time.sleep(0.05)
+        if ctrl.level != 0:
+            print(
+                f"FAIL: ladder never stepped down (level {ctrl.level})",
+                file=sys.stderr,
+            )
+            return 1
+        if cache.budget_bytes != budget_before:
+            print(
+                f"FAIL: cache budget not restored "
+                f"({cache.budget_bytes} != {budget_before})",
+                file=sys.stderr,
+            )
+            return 1
+        # Post-recovery probe serves normally, token-identical.
+        res = engine.submit(*PROMPTS[0]).future.result(timeout=600)
+        if not (res.scores.argmax(-1) == clean[0].argmax(-1)).all():
+            print("FAIL: post-recovery output diverged", file=sys.stderr)
+            return 1
+        port = engine.metrics_server.port
+        exposition = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=30
+        ).read().decode()
+    finally:
+        engine.shutdown(drain=True)
+    if engine.error is not None:
+        print(f"FAIL: pressure engine error {engine.error!r}", file=sys.stderr)
+        return 1
+    m = re.search(r"^fls_pressure_sheds (\d+)", exposition, re.M)
+    if not m or int(m.group(1)) < 1:
+        print(
+            "FAIL: exposition reports no nonzero fls_pressure_sheds",
+            file=sys.stderr,
+        )
+        return 1
+    stats = ctrl.stats()
+    print(json.dumps({"event": "pressure_stats", **stats}))
+    print(
+        f"pressure_chaos_ok sheds={m.group(1)} "
+        f"steps_down={stats['steps_down']} level={stats['level']} "
+        f"host_oom_events={stats['host_oom_events']}"
+    )
+    pressure.reset_process_pressure()
     return 0
 
 

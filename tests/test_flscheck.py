@@ -1094,3 +1094,127 @@ def test_residency_set_budget_replans_off_lock(tmp_path):
     t.join(timeout=5.0)
     assert not t.is_alive() and done
     assert tier.plan.pinned == () and tier.stats()["budget_bytes"] == 0
+
+
+# ---------------------------------------------------------------------------
+# Pressure (PR 11): counter family + resource-pressure sites
+# ---------------------------------------------------------------------------
+
+PRESSURE_COUNTER_MOD = """
+class BrownoutController:
+    def __init__(self):
+        self.sheds = 0
+        self.cache_shrinks = 0
+        self.pin_evictions = 0
+    def note_shed(self):
+        self.sheds += 1
+    def engage(self):
+        self.cache_shrinks += 1
+        self.pin_evictions += 1
+    def stats(self):
+        return {
+            "sheds": self.sheds,
+            "cache_shrinks": self.cache_shrinks,
+            "pin_evictions": self.pin_evictions,
+        }
+"""
+
+
+def test_counter_export_pressure_family(tmp_path):
+    """The fls_pressure_* counter family satisfies COUNTER-EXPORT: every
+    ladder counter the controller increments reaches its stats() export
+    (positive), and dropping one from the export is a finding again
+    (negative) — the shape regression this fixture pins is a new ladder
+    counter added without wiring it to the scrapeable surface."""
+    pkg = make_pkg(tmp_path, {"pressure.py": PRESSURE_COUNTER_MOD})
+    res = run_pkg(pkg, select=["COUNTER-EXPORT"])
+    assert msgs(res.findings, "COUNTER-EXPORT") == []
+
+    broken = PRESSURE_COUNTER_MOD.replace('"sheds": self.sheds,\n', "")
+    pkg2 = make_pkg(
+        tmp_path, {"pressure.py": broken}, name="pressure_broken"
+    )
+    res2 = run_pkg(pkg2, select=["COUNTER-EXPORT"])
+    assert any(
+        "self.sheds" in x for x in msgs(res2.findings, "COUNTER-EXPORT")
+    )
+
+
+PRESSURE_SITE_CONFIG = (
+    'FAULT_SITES = ("host_oom", "disk_full", "link_throttle")\n'
+)
+PRESSURE_SITE_MOD = """
+class _Loader:
+    def attempt(self, name):
+        self._injector.fire("host_oom", detail=name)
+
+class _Store:
+    def _write_spill(self, path):
+        self._injector.fire("disk_full", detail=path)
+
+def put(inj, idxs):
+    inj.fire("link_throttle", detail=str(idxs))
+"""
+PRESSURE_SITE_DOCS = (
+    "| `host_oom` | each layer read |\n"
+    "| `disk_full` | each spill write |\n"
+    "| `link_throttle` | each host->HBM put |\n"
+)
+
+
+def test_site_reg_pressure_sites_positive_and_negative(tmp_path):
+    """The resource-pressure sites satisfy SITE-REG: registered, fired,
+    and documented is clean; dropping a doc row or the registration is a
+    finding again."""
+    pkg = make_pkg(
+        tmp_path,
+        {"config.py": PRESSURE_SITE_CONFIG, "runtime/mod.py": PRESSURE_SITE_MOD},
+        docs=PRESSURE_SITE_DOCS,
+    )
+    res = run_pkg(pkg, select=["SITE-REG"])
+    assert msgs(res.findings, "SITE-REG") == []
+
+    pkg2 = make_pkg(
+        tmp_path,
+        {"config.py": PRESSURE_SITE_CONFIG, "runtime/mod.py": PRESSURE_SITE_MOD},
+        docs="| `host_oom` | documented |\n| `disk_full` | documented |\n",
+        name="pressuredoc",
+    )
+    res2 = run_pkg(pkg2, select=["SITE-REG"])
+    assert any(
+        "'link_throttle'" in m and "missing from the docs" in m
+        for m in msgs(res2.findings, "SITE-REG")
+    )
+
+    pkg3 = make_pkg(
+        tmp_path,
+        {"config.py": 'FAULT_SITES = ("host_oom", "disk_full")\n',
+         "runtime/mod.py": PRESSURE_SITE_MOD},
+        docs=PRESSURE_SITE_DOCS,
+        name="pressurereg",
+    )
+    res3 = run_pkg(pkg3, select=["SITE-REG"])
+    assert any(
+        "'link_throttle' fired but not registered" in m
+        for m in msgs(res3.findings, "SITE-REG")
+    )
+
+
+def test_knob_sync_pressure_flags_map_and_desync_fires(tmp_path):
+    """PressureConfig flags resolve through the pressure_ prefix exactly
+    like chaos_ flags do: the real CLI is clean, and renaming a pressure
+    flag in both parsers while _pressure_config_from_args still reads
+    the old name trips the rule (AttributeError-at-runtime class)."""
+    files = {
+        "cli.py": (PKG_DIR / "cli.py").read_text(),
+        "config.py": (PKG_DIR / "config.py").read_text(),
+    }
+    desynced = dict(files)
+    desynced["cli.py"] = desynced["cli.py"].replace(
+        '"--pressure_poll_s"', '"--pressure_poll_sx"'
+    )
+    pkg = make_pkg(tmp_path, desynced, name="pressure_desynced")
+    res = run_pkg(pkg, select=["KNOB-SYNC"])
+    assert any(
+        "pressure_poll_s" in m for m in msgs(res.findings, "KNOB-SYNC")
+    )

@@ -531,3 +531,58 @@ def test_explicit_budget_pins_process_cache_against_auto_growth(model_dir):
         assert pinned is first and pinned.budget_bytes == int(5e8)
         after = hostcache.cache_for(_fw(model_dir))
         assert after is first and after.budget_bytes == int(5e8)
+
+
+def test_auto_budget_under_shrinking_memavailable(model_dir, monkeypatch):
+    """Auto re-resolution under a SHRINKING MemAvailable: an auto-sized
+    cache never shrink-churns against its own entries (auto only grows),
+    and no auto resolution — however large the host momentarily looks —
+    grows past an explicitly pinned cap."""
+    avail = {"bytes": int(8e9)}
+    monkeypatch.setattr(
+        hostcache, "available_host_bytes", lambda: avail["bytes"]
+    )
+    first = hostcache.cache_for(_fw(model_dir))
+    assert first is not None
+    start = first.budget_bytes
+    assert start == int(8e9 * hostcache.AUTO_FRACTION)
+    # The host tightens (the cache's own entries lower MemAvailable):
+    # auto must NOT shrink the budget it already granted.
+    avail["bytes"] = int(2e9)
+    again = hostcache.cache_for(_fw(model_dir))
+    assert again is first and again.budget_bytes == start
+    # An explicit cap lands; a later huge-looking auto resolution must
+    # not grow past it.
+    pinned = hostcache.cache_for(_fw(model_dir, host_cache_gb=0.5))
+    assert pinned is first and pinned.budget_bytes == int(5e8)
+    avail["bytes"] = int(64e9)
+    after = hostcache.cache_for(_fw(model_dir))
+    assert after is first and after.budget_bytes == int(5e8)
+
+
+def test_shrink_evicts_lru_first_without_invalidating_live_hits(tmp_path):
+    """The brownout shrink path: set_budget down evicts LRU-first (the
+    least-recently-HIT entries go first, counted as evictions, never
+    invalidations) and the surviving entries keep serving hits."""
+    paths = []
+    for i in range(3):
+        p = tmp_path / f"f{i}.bin"
+        p.write_bytes(b"x")
+        paths.append(str(p))
+    cache = HostShardCache(budget_bytes=300)
+    segs = [("decoders", {"w": np.zeros(25, np.uint8)})]  # 100 B nominal
+    for i, p in enumerate(paths):
+        assert cache.put(("k", i), segs, paths=[p], nbytes=100)
+    # Touch entry 0: LRU order becomes 1 (oldest), 2, 0 (newest).
+    assert cache.get(("k", 0)) is not None
+    before_inval = cache.invalidations
+    cache.set_budget(150)
+    stats = cache.stats()
+    assert stats["entries"] == 1 and stats["evictions"] == 2
+    assert cache.invalidations == before_inval  # shrink never invalidates
+    # The survivor is the most-recently-hit entry, and it still HITS.
+    assert cache.get(("k", 0)) is not None
+    assert cache.get(("k", 1)) is None and cache.get(("k", 2)) is None
+    # Growth back re-admits new entries normally.
+    cache.set_budget(300)
+    assert cache.put(("k", 9), segs, paths=[paths[1]], nbytes=100)

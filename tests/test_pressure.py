@@ -1,0 +1,716 @@
+"""Resource-pressure resilience suite (runtime/pressure.py): the
+brownout controller degrades instead of dying, and every degradation
+reverses once pressure lifts.
+
+Contracts under test:
+
+- the PressureMonitor trips exactly the configured thresholds and never
+  trips on unknown samples;
+- the ladder walks up one level per threshold-pressured poll, jumps to
+  the shed level on a hard event, engages the levers in order, and
+  releases them in reverse after ``step_down_polls`` clean polls;
+- the levers really act AND really reverse: the host cache budget
+  shrinks (LRU-evicting, hits preserved) and restores, residency pins
+  demote to streaming and re-plan, admission queues shed typed
+  ``Overloaded`` rejections with a retry-after hint, the fleet drains
+  to one replica and repopulates;
+- the hardened hard-failure paths: an injected (or real) MemoryError in
+  a host shard build becomes a retried-then-degradable ``HostOOMError``
+  (the serving engine fails only the wave, never the process), ENOSPC
+  in a spill write becomes a retried ``DiskFullError`` with the spill
+  file whole-or-absent;
+- the admission-side size cap rejects oversized requests typed at
+  submit, before they can fail a wave at allocation.
+"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from flexible_llm_sharding_tpu.config import (
+    FaultConfig,
+    FrameworkConfig,
+    PressureConfig,
+    ServeConfig,
+)
+from flexible_llm_sharding_tpu.faults.inject import FaultInjector
+from flexible_llm_sharding_tpu.faults.retry import RetryPolicy, ShardLoadError
+from flexible_llm_sharding_tpu.models import llama
+from flexible_llm_sharding_tpu.runtime import hostcache, pressure, residency
+from flexible_llm_sharding_tpu.runtime.activations import ActivationStore
+from flexible_llm_sharding_tpu.runtime.executor import StreamingExecutor
+from flexible_llm_sharding_tpu.runtime.pressure import (
+    BrownoutController,
+    DiskFullError,
+    HostOOMError,
+    PressureMonitor,
+    PressureSnapshot,
+)
+from flexible_llm_sharding_tpu.serve import (
+    AdmissionQueue,
+    Overloaded,
+    ReplicaFleet,
+    Request,
+    RequestStatus,
+    RequestTooLarge,
+    ServeEngine,
+    WaveAborted,
+)
+from flexible_llm_sharding_tpu.utils.checkpoint import save_params
+
+from tests.fake_tokenizer import FakeTokenizer
+
+CHAOS_SEED = int(os.environ.get("FLS_CHAOS_SEED", "1234"))
+
+PROMPTS = [
+    ("The capital of France", (" is Paris", " is Rome")),
+    ("Two plus two equals", (" four", " five")),
+    ("The sky is", (" blue", " green")),
+    ("Hello world", (" again", " anew")),
+]
+
+
+@pytest.fixture(scope="module")
+def model_dir(tiny_cfg, tmp_path_factory):
+    params = llama.init_params(jax.random.PRNGKey(0), tiny_cfg)
+    d = tmp_path_factory.mktemp("tiny_model_pressure")
+    save_params(jax.tree.map(np.asarray, params), str(d), tiny_cfg)
+    return str(d)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_process_state():
+    pressure.reset_process_pressure()
+    hostcache.reset_process_cache()
+    residency.reset_process_tier()
+    yield
+    pressure.reset_process_pressure()
+    hostcache.reset_process_cache()
+    residency.reset_process_tier()
+
+
+def _fw(model_dir, **kw) -> FrameworkConfig:
+    base = dict(
+        model_path=model_dir,
+        layer_num_per_shard=1,
+        storage_location="cpu",
+        dtype="float32",
+        bucket_multiple=8,
+        block_size=2,
+        prefetch_depth=0,
+        io_retry_attempts=8,
+        io_retry_base_s=0.001,
+    )
+    base.update(kw)
+    return FrameworkConfig(**base)
+
+
+def _pcfg(**kw) -> PressureConfig:
+    base = dict(
+        enabled=True, poll_s=0.02, host_min_gb=0.0, disk_min_gb=0.0,
+        hbm_headroom_frac=0.0, shed_retry_after_s=0.25, step_down_polls=2,
+    )
+    base.update(kw)
+    return PressureConfig(**base)
+
+
+@pytest.fixture(scope="module")
+def oracle(model_dir):
+    """Fault-free served completions (ServeEngine, 1 new token)."""
+    eng = ServeEngine(
+        _fw(model_dir),
+        ServeConfig(max_wave_requests=2, default_max_new_tokens=1),
+        tokenizer=FakeTokenizer(),
+    )
+    try:
+        reqs = [eng.submit(p, s) for p, s in PROMPTS]
+        return [r.future.result(timeout=600) for r in reqs]
+    finally:
+        eng.shutdown(drain=True)
+
+
+# ---------------------------------------------------------------------------
+# Config + monitor
+# ---------------------------------------------------------------------------
+
+
+def test_pressure_config_validation():
+    with pytest.raises(ValueError):
+        PressureConfig(poll_s=0.0)
+    with pytest.raises(ValueError):
+        PressureConfig(host_min_gb=-1)
+    with pytest.raises(ValueError):
+        PressureConfig(cache_shrink_frac=1.5)
+    with pytest.raises(ValueError):
+        PressureConfig(step_down_polls=0)
+    # A legal config round-trips.
+    assert PressureConfig(enabled=True).enabled
+
+
+def test_monitor_trips_exactly_configured_thresholds(model_dir):
+    cfg = _fw(
+        model_dir,
+        pressure=PressureConfig(
+            enabled=True, host_min_gb=1.0, disk_min_gb=2.0,
+            hbm_headroom_frac=0.1,
+        ),
+    )
+    ctrl = BrownoutController(cfg)
+    mon = PressureMonitor(
+        cfg, ctrl,
+        host_bytes_fn=lambda: int(0.5e9),     # below 1 GB -> trips
+        disk_free_fn=lambda: int(10e9),       # above 2 GB -> clean
+        hbm_free_frac_fn=lambda: 0.5,         # above 0.1 -> clean
+        link_bytes_fn=lambda: 0,
+    )
+    snap = mon.sample()
+    assert snap.tripped == frozenset({"host"})
+    # Unknown samples never trip, whatever the thresholds say.
+    mon2 = PressureMonitor(
+        cfg, ctrl,
+        host_bytes_fn=lambda: None,
+        disk_free_fn=lambda: None,
+        hbm_free_frac_fn=lambda: None,
+        link_bytes_fn=lambda: 0,
+    )
+    assert mon2.sample().tripped == frozenset()
+    # Threshold 0 = signal off even when the sample is terrible.
+    cfg_off = _fw(model_dir, pressure=_pcfg())
+    mon3 = PressureMonitor(
+        cfg_off, BrownoutController(cfg_off),
+        host_bytes_fn=lambda: 1,
+        disk_free_fn=lambda: 1,
+        hbm_free_frac_fn=lambda: 0.0,
+        link_bytes_fn=lambda: 0,
+    )
+    assert mon3.sample().tripped == frozenset()
+
+
+# ---------------------------------------------------------------------------
+# The ladder
+# ---------------------------------------------------------------------------
+
+
+class _FakeQueue:
+    def __init__(self):
+        self.shedding = False
+        self.retry_after = None
+
+    def set_shedding(self, retry_after_s, on_shed=None):
+        self.shedding = True
+        self.retry_after = retry_after_s
+
+    def clear_shedding(self):
+        self.shedding = False
+
+
+class _FakeFleet:
+    def __init__(self):
+        self.drained = 0
+        self.restored = 0
+
+    def pressure_drain(self, keep=1):
+        self.drained += 1
+        return 2
+
+    def pressure_restore(self):
+        self.restored += 1
+        return 2
+
+
+def _pressured(**kw):
+    return PressureSnapshot(tripped=frozenset(kw.get("tripped", {"host"})))
+
+
+def test_ladder_walks_up_engages_in_order_and_reverses(model_dir):
+    cfg = _fw(model_dir, host_cache_gb=0.001, pressure=_pcfg())
+    cache = hostcache.cache_for(cfg)
+    before = cache.budget_bytes
+    ctrl = BrownoutController(cfg)
+    q = _FakeQueue()
+    fleet = _FakeFleet()
+    ctrl.attach_queue(q)
+    ctrl.attach_fleet(fleet)
+
+    # Threshold pressure: one level per poll, gentlest lever first.
+    ctrl.on_sample(_pressured())
+    assert ctrl.level == 1
+    assert cache.budget_bytes < before  # cache shrunk
+    assert not q.shedding
+    ctrl.on_sample(_pressured())
+    assert ctrl.level == 2  # pin evict (no tier live: position still taken)
+    assert not q.shedding
+    ctrl.on_sample(_pressured())
+    assert ctrl.level == 3 and q.shedding
+    assert q.retry_after == ctrl.pcfg.shed_retry_after_s
+    ctrl.on_sample(_pressured())
+    assert ctrl.level == 4 and fleet.drained == 1
+    # Holding at max: further pressure doesn't overflow the ladder.
+    ctrl.on_sample(_pressured())
+    assert ctrl.level == 4
+
+    # Reversal: step_down_polls clean polls per level, reverse order.
+    clean = PressureSnapshot()
+    for _ in range(ctrl.pcfg.step_down_polls):
+        ctrl.on_sample(clean)
+    assert ctrl.level == 3 and fleet.restored == 1
+    assert q.shedding  # shed still engaged at level 3
+    for _ in range(ctrl.pcfg.step_down_polls):
+        ctrl.on_sample(clean)
+    assert ctrl.level == 2 and not q.shedding
+    for _ in range(2 * ctrl.pcfg.step_down_polls):
+        ctrl.on_sample(clean)
+    assert ctrl.level == 0
+    assert cache.budget_bytes == before  # budget restored
+    assert hostcache.pressure_cap() is None
+    stats = ctrl.stats()
+    assert stats["steps_up"] == 4 and stats["steps_down"] == 4
+    assert stats["cache_shrinks"] == 1
+
+
+def test_hard_event_jumps_straight_to_shed_level(model_dir):
+    cfg = _fw(model_dir, pressure=_pcfg())
+    ctrl = BrownoutController(cfg)
+    q = _FakeQueue()
+    ctrl.attach_queue(q)
+    ctrl.note_event("host_oom")
+    ctrl.on_sample(PressureSnapshot())  # no thresholds tripped — event only
+    assert ctrl.level == ctrl._level_of("shed")
+    assert q.shedding
+    assert ctrl.stats()["host_oom_events"] == 1
+    # The jump engaged the skipped levels too (counted as steps).
+    assert ctrl.stats()["steps_up"] == 3
+
+
+def test_queue_attached_mid_brownout_sheds_immediately(model_dir):
+    cfg = _fw(model_dir, pressure=_pcfg())
+    ctrl = BrownoutController(cfg)
+    ctrl.note_event("disk_full")
+    ctrl.on_sample(PressureSnapshot())
+    late = _FakeQueue()
+    ctrl.attach_queue(late)
+    assert late.shedding  # a recycled replica is not a brownout bypass
+
+
+def test_cache_for_cannot_grow_past_pressure_cap(model_dir):
+    cfg = _fw(model_dir, host_cache_gb=0.001, pressure=_pcfg())
+    cache = hostcache.cache_for(cfg)
+    before = cache.budget_bytes
+    prev = hostcache.apply_pressure_cap(0.5)
+    assert prev == before and cache.budget_bytes == before // 2
+    # A fresh resolution mid-brownout — explicit OR auto — stays capped.
+    assert hostcache.cache_for(cfg).budget_bytes == before // 2
+    bigger = _fw(model_dir, host_cache_gb=0.002, pressure=_pcfg())
+    assert hostcache.cache_for(bigger).budget_bytes == before // 2
+    # The lift installs the INTENDED budget: the 0.002 GB explicit pin
+    # that landed mid-brownout wins, not a blind pre-shrink restore.
+    hostcache.lift_pressure_cap(prev)
+    assert cache.budget_bytes == int(0.002 * 1e9)
+    assert hostcache.pressure_cap() is None
+
+
+def test_lift_pressure_cap_honors_mid_brownout_explicit_pin(model_dir):
+    """An explicit budget SMALLER than the pre-shrink value installed
+    while the cap held must survive the lift — restoring blindly to the
+    pre-brownout budget would blow past the operator's pin."""
+    big = hostcache.cache_for(_fw(model_dir, host_cache_gb=0.004))
+    pre = big.budget_bytes
+    hostcache.apply_pressure_cap(0.5)
+    assert big.budget_bytes == pre // 2
+    # Mid-brownout the operator pins 0.001 GB (below both pre and cap).
+    pinned = hostcache.cache_for(_fw(model_dir, host_cache_gb=0.001))
+    assert pinned is big and big.budget_bytes == int(0.001 * 1e9)
+    hostcache.lift_pressure_cap(pre)
+    assert big.budget_bytes == int(0.001 * 1e9)  # the pin, not pre
+
+
+def test_residency_pressure_unpin_and_restore(model_dir, tiny_cfg):
+    cfg = _fw(model_dir, hbm_pin_gb=1.0)
+    from flexible_llm_sharding_tpu.utils.checkpoint import layer_names_for
+
+    names = layer_names_for(
+        tiny_cfg.num_hidden_layers, tie_word_embeddings=False
+    )
+    tier = residency.tier_for(cfg, names, False)
+    assert tier is not None and tier.plan.pinned
+    planned = len(tier.plan.pinned)
+    n = tier.pressure_unpin()
+    assert n == planned
+    assert not tier.plan.pinned and tier.pressure_demoted
+    assert tier.frozen_pinned([range(len(names))]) == frozenset()
+    assert tier.stats()["pressure_demoted"] == 1
+    # tier_for must NOT re-plan while demoted (auto or explicit).
+    assert residency.tier_for(cfg, names, False) is tier
+    assert not tier.plan.pinned
+    # Idempotent; restore reinstates the saved plan exactly.
+    assert tier.pressure_unpin() == 0
+    assert tier.pressure_restore() == planned
+    assert len(tier.plan.pinned) == planned
+    assert not tier.pressure_demoted
+    assert tier.pressure_restore() == 0
+
+
+# ---------------------------------------------------------------------------
+# Queue shedding + size cap
+# ---------------------------------------------------------------------------
+
+
+def _req(prefix="p", suffixes=("s",), max_new_tokens=1):
+    return Request(prefix=prefix, suffixes=suffixes, max_new_tokens=max_new_tokens)
+
+
+def test_queue_shed_overloaded_typed_and_reversible():
+    shed_count = [0]
+    q = AdmissionQueue(4)
+    q.set_shedding(2.5, on_shed=lambda: shed_count.__setitem__(0, shed_count[0] + 1))
+    r = q.submit(_req())
+    assert r.status is RequestStatus.REJECTED
+    with pytest.raises(Overloaded) as ei:
+        r.future.result(timeout=0)
+    assert ei.value.retry_after_s == 2.5
+    assert isinstance(ei.value, Overloaded) and shed_count[0] == 1
+    assert len(q) == 0  # shed requests never consume a slot
+    q.clear_shedding()
+    r2 = q.submit(_req())
+    assert r2.status is RequestStatus.QUEUED and len(q) == 1
+
+
+def test_shed_exempt_redispatch_bypasses_shedding():
+    """A fleet RE-dispatch (work accepted before its replica died) must
+    not be rejected Overloaded at the survivor's front door: shedding
+    refuses NEW admissions, never strands accepted in-flight work."""
+    q = AdmissionQueue(4)
+    q.set_shedding(1.0)
+    orphan = Request(
+        prefix="p", suffixes=("s",), max_new_tokens=1, shed_exempt=True
+    )
+    assert q.submit(orphan).status is RequestStatus.QUEUED
+    fresh = q.submit(_req())
+    assert fresh.status is RequestStatus.REJECTED
+
+
+def test_install_plan_refused_while_pressure_demoted(model_dir, tiny_cfg):
+    """The race-free half of the pin-evict latch: a plan computed before
+    the demotion landed must not re-install pins mid-brownout (the
+    _PROCESS_LOCK pre-checks are advisory; _install_plan's own check
+    under the tier lock is the authoritative one)."""
+    from flexible_llm_sharding_tpu.utils.checkpoint import layer_names_for
+
+    names = layer_names_for(
+        tiny_cfg.num_hidden_layers, tie_word_embeddings=False
+    )
+    tier = residency.tier_for(_fw(model_dir, hbm_pin_gb=1.0), names, False)
+    stale_plan = tier.plan  # planned before the brownout
+    assert tier.pressure_unpin() > 0
+    tier._install_plan(stale_plan)  # the racing installer loses
+    assert not tier.plan.pinned
+    tier.pressure_restore()
+    assert tier.plan.pinned
+
+
+def test_note_event_unknown_kind_is_dropped(model_dir):
+    ctrl = BrownoutController(_fw(model_dir, pressure=_pcfg()))
+    ctrl.note_event("typo_kind")
+    ctrl.on_sample(PressureSnapshot())
+    assert ctrl.level == 0  # no pressure registered
+    assert ctrl.stats()["link_events"] == 0
+    # link_events counts tripped-link POLLS (the link never hard-fails).
+    ctrl.on_sample(PressureSnapshot(tripped=frozenset({"link"})))
+    assert ctrl.stats()["link_events"] == 1 and ctrl.level == 1
+
+
+def test_queue_size_cap_rejects_typed_at_admission():
+    q = AdmissionQueue(
+        4, max_request_tokens=10,
+        size_fn=lambda r: len(r.prefix) + r.max_new_tokens,
+    )
+    big = q.submit(_req(prefix="x" * 100))
+    assert big.status is RequestStatus.REJECTED
+    with pytest.raises(RequestTooLarge):
+        big.future.result(timeout=0)
+    small = q.submit(_req(prefix="xx"))
+    assert small.status is RequestStatus.QUEUED
+    # An estimator failure must not reject (the wave-level family covers
+    # genuinely malformed requests with full context).
+    def boom(r):
+        raise ValueError("tokenizer edge case")
+
+    q2 = AdmissionQueue(4, max_request_tokens=10, size_fn=boom)
+    ok = q2.submit(_req(prefix="x" * 100))
+    assert ok.status is RequestStatus.QUEUED
+
+
+def test_engine_size_cap_end_to_end(model_dir, oracle):
+    eng = ServeEngine(
+        _fw(model_dir),
+        ServeConfig(
+            max_wave_requests=2, default_max_new_tokens=1,
+            max_request_tokens=64,
+        ),
+        tokenizer=FakeTokenizer(),
+    )
+    try:
+        huge = eng.submit("x" * 4000, (" a", " b"))
+        with pytest.raises(RequestTooLarge):
+            huge.future.result(timeout=10)
+        assert huge.status is RequestStatus.REJECTED
+        ok = eng.submit(*PROMPTS[0])
+        res = ok.future.result(timeout=600)
+        assert (
+            res.scores.argmax(-1) == oracle[0].scores.argmax(-1)
+        ).all()
+    finally:
+        eng.shutdown(drain=True)
+    assert eng.error is None
+
+
+# ---------------------------------------------------------------------------
+# Hardened hard-failure paths
+# ---------------------------------------------------------------------------
+
+
+def test_serve_survives_bounded_host_oom_token_identical(model_dir, oracle):
+    """A budgeted host_oom outage: injected MemoryErrors are typed and
+    retried inside the load path; every request completes
+    token-identical and the engine never dies."""
+    fc = FaultConfig(
+        enabled=True, seed=CHAOS_SEED, error_rate=0.4,
+        sites=("host_oom",), max_faults=6,
+    )
+    eng = ServeEngine(
+        _fw(model_dir, faults=fc),
+        ServeConfig(max_wave_requests=2, default_max_new_tokens=1),
+        tokenizer=FakeTokenizer(),
+    )
+    try:
+        reqs = [eng.submit(p, s) for p, s in PROMPTS]
+        results = [r.future.result(timeout=600) for r in reqs]
+    finally:
+        eng.shutdown(drain=True)
+    assert eng.error is None
+    for res, want in zip(results, oracle):
+        assert (res.scores.argmax(-1) == want.scores.argmax(-1)).all()
+    assert eng._injector.count("host_oom") > 0
+    # The OOMs were absorbed by the RETRY ladder (shard_read label).
+    retries = eng.metrics.retries.snapshot()
+    assert retries.get("shard_read", {}).get("recovered", 0) > 0
+
+
+def test_serve_persistent_host_oom_degrades_not_dies(model_dir):
+    """An unbounded OOM storm: waves fail with WaveAborted (typed,
+    recoverable), the engine stays alive and NOT engine-fatal — the
+    exact MemoryError path that used to kill the process."""
+    fc = FaultConfig(
+        enabled=True, seed=CHAOS_SEED, error_rate=1.0, sites=("host_oom",),
+    )
+    eng = ServeEngine(
+        _fw(model_dir, faults=fc, io_retry_attempts=2),
+        ServeConfig(max_wave_requests=2, default_max_new_tokens=1),
+        tokenizer=FakeTokenizer(),
+    )
+    try:
+        r = eng.submit(*PROMPTS[0])
+        with pytest.raises(WaveAborted) as ei:
+            r.future.result(timeout=120)
+        # Root cause chain names the typed OOM family, not a raw
+        # MemoryError escaping to the fatal path.
+        cause = ei.value.__cause__
+        assert isinstance(cause, (ShardLoadError, HostOOMError, OSError))
+        assert eng.error is None  # alive: degrade, don't die
+        assert eng.metrics.counter("engine_recoveries") >= 1
+    finally:
+        eng.shutdown(drain=False)
+
+
+def test_spill_write_atomic_enospc_typed_and_clean(tmp_path):
+    """Persistent ENOSPC: typed DiskFullError, and the spill path is
+    whole-or-absent — no truncated .npy, no leftover temp file."""
+    inj = FaultInjector(
+        FaultConfig(
+            enabled=True, seed=CHAOS_SEED, error_rate=1.0,
+            sites=("disk_full",),
+        )
+    )
+    store = ActivationStore(
+        "disk", str(tmp_path), np_dtype=np.dtype(np.float32), injector=inj,
+        retry_policy=RetryPolicy(max_attempts=3, base_delay_s=0.0),
+    )
+    path = str(tmp_path / "suffix-00000.npy")
+    with pytest.raises(DiskFullError) as ei:
+        store._write_spill(path, np.ones((4, 4), np.float32))
+    assert ei.value.errno is not None  # carries the real ENOSPC errno
+    assert not os.path.exists(path)
+    assert not any(f.endswith(".tmp") for f in os.listdir(tmp_path))
+    # Bounded outage: the retry ladder absorbs it and the file lands
+    # complete and verifiable.
+    inj2 = FaultInjector(
+        FaultConfig(
+            enabled=True, seed=CHAOS_SEED, error_rate=1.0,
+            sites=("disk_full",), max_faults=1,
+        )
+    )
+    store2 = ActivationStore(
+        "disk", str(tmp_path), np_dtype=np.dtype(np.float32), injector=inj2,
+        retry_policy=RetryPolicy(max_attempts=3, base_delay_s=0.0),
+    )
+    arr = np.arange(16, dtype=np.float32).reshape(4, 4)
+    store2._write_spill(path, arr)
+    np.testing.assert_array_equal(np.load(path), arr)
+    assert not any(f.endswith(".tmp") for f in os.listdir(tmp_path))
+
+
+def test_offline_disk_run_survives_bounded_disk_full(model_dir):
+    """Disk-mode batch run under injected ENOSPC on spill writes: the
+    retries absorb the outage and the output is token-identical to a
+    clean run (the spill_write label appears in io_retries)."""
+    clean = StreamingExecutor(_fw(model_dir), tokenizer=FakeTokenizer())(
+        list(PROMPTS)
+    )
+    import tempfile
+
+    spills = tempfile.mkdtemp(prefix="fls_pressure_spills_")
+    fc = FaultConfig(
+        enabled=True, seed=CHAOS_SEED, error_rate=0.3,
+        sites=("disk_full",), max_faults=8,
+    )
+    ex = StreamingExecutor(
+        _fw(
+            model_dir, storage_location="disk", disk_folder=spills,
+            faults=fc,
+        ),
+        tokenizer=FakeTokenizer(),
+    )
+    got = ex(list(PROMPTS))
+    for g, w in zip(got, clean):
+        np.testing.assert_array_equal(g, w)
+    assert ex._injector.count("disk_full") > 0
+    assert ex._retry_recorder.snapshot().get("spill_write", {}).get(
+        "recovered", 0
+    ) > 0
+    # No temp debris anywhere in the spill dir.
+    assert not any(f.endswith(".tmp") for f in os.listdir(spills))
+
+
+def test_link_throttle_stalls_never_raises():
+    inj = FaultInjector(
+        FaultConfig(
+            enabled=True, seed=CHAOS_SEED, error_rate=0.5,
+            truncate_rate=0.25, latency_rate=0.25, latency_s=0.0,
+            sites=("link_throttle",),
+        )
+    )
+    for _ in range(64):
+        inj.fire("link_throttle")  # every draw: sleep or clean, NEVER raise
+    assert inj.count("link_throttle") > 0
+
+
+# ---------------------------------------------------------------------------
+# End-to-end: brownout under chaos, then full reversal
+# ---------------------------------------------------------------------------
+
+
+def test_serve_brownout_sheds_then_reverses(model_dir, oracle):
+    """The acceptance path in miniature (the chaos smoke runs the full
+    version): a bounded host_oom outage drives the ladder to shed; new
+    submissions get typed Overloaded; after the outage the ladder steps
+    back down, the cache budget is restored, and serving resumes
+    token-identically."""
+    fc = FaultConfig(
+        enabled=True, seed=CHAOS_SEED, error_rate=0.6,
+        sites=("host_oom",), max_faults=8,
+    )
+    eng = ServeEngine(
+        _fw(
+            model_dir, faults=fc, host_cache_gb=0.01,
+            pressure=_pcfg(poll_s=0.02, step_down_polls=3),
+        ),
+        ServeConfig(max_wave_requests=2, default_max_new_tokens=1),
+        tokenizer=FakeTokenizer(),
+    )
+    ctrl = pressure.process_controller()
+    cache = hostcache.process_cache()
+    assert ctrl is not None and cache is not None
+    before = cache.budget_bytes
+    sheds = 0
+    served = []
+    try:
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline and (sheds == 0 or not served):
+            r = eng.submit(*PROMPTS[0])
+            try:
+                served.append(r.future.result(timeout=120))
+            except Overloaded as e:
+                sheds += 1
+                assert e.retry_after_s == ctrl.pcfg.shed_retry_after_s
+            time.sleep(0.005)
+        assert sheds > 0, "brownout never shed"
+        assert ctrl.stats()["host_oom_events"] > 0
+        # Pressure lifts (the fault budget is exhausted): full reversal.
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline and ctrl.level > 0:
+            time.sleep(0.02)
+        assert ctrl.level == 0
+        assert cache.budget_bytes == before
+        assert ctrl.stats()["steps_down"] >= 1
+        res = eng.submit(*PROMPTS[0]).future.result(timeout=600)
+        for r in served + [res]:
+            assert (
+                r.scores.argmax(-1) == oracle[0].scores.argmax(-1)
+            ).all()
+    finally:
+        eng.shutdown(drain=True)
+    assert eng.error is None
+
+
+def test_fleet_pressure_drain_and_restore(model_dir):
+    fleet = ReplicaFleet(
+        _fw(model_dir),
+        ServeConfig(
+            replicas=3, max_wave_requests=2, default_max_new_tokens=1,
+            router_health_poll_s=0.05,
+        ),
+        tokenizer=FakeTokenizer(),
+    )
+    try:
+        cfg = _fw(model_dir, pressure=_pcfg(step_down_polls=1))
+        ctrl = BrownoutController(cfg)
+        ctrl.attach_fleet(fleet)
+        # Walk to the drain level (4 pressured polls).
+        for _ in range(4):
+            ctrl.on_sample(_pressured())
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline and len(fleet.replicas) > 1:
+            time.sleep(0.05)
+        assert len(fleet.replicas) == 1
+        assert ctrl.stats()["replica_drains"] == 2
+        # Clean polls all the way down: population restored.
+        for _ in range(4):
+            ctrl.on_sample(PressureSnapshot())
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline and len(fleet.replicas) < 3:
+            time.sleep(0.05)
+        assert len(fleet.replicas) == 3
+        assert ctrl.stats()["replica_restores"] >= 2
+        # The restored fleet still serves.
+        res = fleet.submit(*PROMPTS[0]).future.result(timeout=600)
+        assert res.tokens.shape[-1] == 1
+    finally:
+        fleet.shutdown(drain=True)
+
+
+def test_pressure_counters_scrapeable(model_dir):
+    from flexible_llm_sharding_tpu.obs.registry import REGISTRY
+
+    cfg = _fw(model_dir, pressure=_pcfg())
+    ctrl = pressure.controller_for(cfg)
+    assert ctrl is pressure.controller_for(cfg)  # process singleton
+    ctrl.note_event("host_oom")
+    ctrl.on_sample(PressureSnapshot())
+    text = REGISTRY.prometheus_text()
+    assert "fls_pressure_level" in text
+    assert "fls_pressure_sheds 0" in text  # pre-seeded zero, scrapeable
+    assert "fls_pressure_host_oom_events 1" in text
