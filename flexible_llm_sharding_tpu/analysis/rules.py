@@ -330,6 +330,7 @@ _FLAG_ALIASES = {
 }
 _CHAOS_PREFIX = "chaos_"
 _PRESSURE_PREFIX = "pressure_"
+_SCHED_PREFIX = "sched_"
 
 # cli.py functions that thread parsed args into config constructions.
 _BATCH_READERS = (
@@ -342,6 +343,7 @@ _SERVE_READERS = (
     "serve_main",
     "_fault_config_from_args",
     "_pressure_config_from_args",
+    "_sched_config_from_args",
 )
 
 
@@ -428,9 +430,10 @@ def _args_reads(tree: ast.Module) -> dict[str, dict[str, int]]:
 
 @project_rule(
     "KNOB-SYNC",
-    "every FrameworkConfig/ServeConfig/FaultConfig/PressureConfig flag "
-    "exists in both CLI parsers (or is declared single-parser), maps to a "
-    "real field, and is threaded into the construction",
+    "every FrameworkConfig/ServeConfig/SchedConfig/FaultConfig/"
+    "PressureConfig flag exists in both CLI parsers (or is declared "
+    "single-parser; serving-only classes are exempt), maps to a real "
+    "field, and is threaded into the construction",
 )
 def knob_sync(ctx: ProjectContext) -> list[Finding]:
     cli = ctx.get("cli.py")
@@ -448,6 +451,7 @@ def knob_sync(ctx: ProjectContext) -> list[Finding]:
     sv = _class_fields(config.tree, "ServeConfig")
     fc = _class_fields(config.tree, "FaultConfig")
     pc = _class_fields(config.tree, "PressureConfig")
+    sc = _class_fields(config.tree, "SchedConfig")
     flags = _parser_flags(cli.tree)
     batch = flags.get("build_parser", {})
     serve = flags.get("build_serve_parser", {})
@@ -468,6 +472,10 @@ def knob_sync(ctx: ProjectContext) -> list[Finding]:
             return ("PressureConfig", "enabled") if "enabled" in pc else ("?", flag)
         if flag.startswith(_PRESSURE_PREFIX) and flag[len(_PRESSURE_PREFIX):] in pc:
             return ("PressureConfig", flag[len(_PRESSURE_PREFIX):])
+        if flag == "sched":
+            return ("SchedConfig", "enabled") if "enabled" in sc else ("?", flag)
+        if flag.startswith(_SCHED_PREFIX) and flag[len(_SCHED_PREFIX):] in sc:
+            return ("SchedConfig", flag[len(_SCHED_PREFIX):])
         if flag in _FLAG_ALIASES:
             cls, field = _FLAG_ALIASES[flag]
             fields = sv if cls == "ServeConfig" else fw
@@ -502,7 +510,7 @@ def knob_sync(ctx: ProjectContext) -> list[Finding]:
                     )
                 )
                 continue
-            if cls == "ServeConfig":
+            if cls in ("ServeConfig", "SchedConfig"):
                 continue  # serving knobs are inherently serve-parser-only
             if flag not in other and flag not in single_ok:
                 findings.append(
@@ -585,6 +593,9 @@ def knob_sync(ctx: ProjectContext) -> list[Finding]:
         ("_fault_config_from_args", "serve", serve),
         ("_pressure_config_from_args", "batch", batch),
         ("_pressure_config_from_args", "serve", serve),
+        # Serve-path-only reader: SchedConfig is a serving subsystem, so
+        # its reads validate against the serve parser alone.
+        ("_sched_config_from_args", "serve", serve),
     ):
         for attr, line in sorted(reads.get(fn_name, {}).items()):
             if attr not in parser:

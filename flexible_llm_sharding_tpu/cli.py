@@ -202,6 +202,80 @@ def _add_observability_flags(p: argparse.ArgumentParser) -> None:
                         "ends in .jsonl")
 
 
+def _add_sched_flags(p: argparse.ArgumentParser) -> None:
+    """Serve parser only: the multi-tenant sweep scheduler
+    (serve/sched/; docs/scheduling.md has the class semantics, fairness
+    math, preemption state machine, and coalescing contract)."""
+    p.add_argument("--sched", action="store_true",
+                   help="enable the multi-tenant sweep scheduler: strict "
+                        "SLO-class priority (interactive > standard > "
+                        "best_effort) with deficit-weighted round-robin "
+                        "across tenants inside a class, per-tenant token-"
+                        "bucket rate limits (typed RateLimited with a "
+                        "retry-after hint), sweep-boundary preemption of "
+                        "best-effort waves by waiting interactive work "
+                        "(resumed token-identically), and same-prefix "
+                        "request coalescing into one shared prefill. "
+                        "Off = the plain FIFO admission path")
+    p.add_argument("--sched_interactive_deadline_s", type=float, default=0.0,
+                   help="default admission deadline for interactive "
+                        "requests that name none (0 = fall back to "
+                        "--deadline_s)")
+    p.add_argument("--sched_standard_deadline_s", type=float, default=0.0,
+                   help="default admission deadline for standard requests "
+                        "(0 = fall back to --deadline_s)")
+    p.add_argument("--sched_best_effort_deadline_s", type=float, default=0.0,
+                   help="default admission deadline for best_effort "
+                        "requests (0 = fall back to --deadline_s)")
+    p.add_argument("--sched_tenant_weights", type=str, default="",
+                   help="deficit-round-robin weights, 'tenantA=4,tenantB=1' "
+                        "(unlisted tenants weigh 1): a weight-w tenant "
+                        "gets ~w shares of each class's admission budget "
+                        "while backlogged")
+    p.add_argument("--sched_tenant_limits", type=str, default="",
+                   help="token-bucket rate limits in requests/second, "
+                        "'tenantA=5' (unlisted = unlimited); over-limit "
+                        "submits resolve as typed RateLimited carrying "
+                        "retry_after_s")
+    p.add_argument("--sched_tenant_burst", type=float, default=4.0,
+                   help="token-bucket capacity (burst requests) for every "
+                        "rate-limited tenant")
+    p.add_argument("--sched_preempt", type=_str2bool, default=True,
+                   help="allow a waiting interactive request to retire the "
+                        "youngest best-effort wave at a shard-0 boundary "
+                        "(never mid-sweep); the preempted requests resume "
+                        "token-identically with their generated-so-far "
+                        "tokens folded into the prefill")
+    p.add_argument("--sched_coalesce", type=_str2bool, default=True,
+                   help="merge same-tokenized-prefix requests admitted at "
+                        "one boundary into a single wave entry that "
+                        "prefills the shared prefix KV once")
+    p.add_argument("--sched_interactive_phase_boost", type=float, default=2.0,
+                   help="fleet routing: multiply the router's phase weight "
+                        "by this for interactive requests, so they land "
+                        "on the replica nearest its next shard-0 "
+                        "admission point (1 = no boost)")
+
+
+def _sched_config_from_args(args: argparse.Namespace):
+    from flexible_llm_sharding_tpu.config import SchedConfig
+
+    if not args.sched:
+        return SchedConfig()
+    return SchedConfig(
+        enabled=True,
+        interactive_deadline_s=args.sched_interactive_deadline_s,
+        standard_deadline_s=args.sched_standard_deadline_s,
+        best_effort_deadline_s=args.sched_best_effort_deadline_s,
+        tenant_weights=args.sched_tenant_weights,
+        tenant_limits=args.sched_tenant_limits,
+        tenant_burst=args.sched_tenant_burst,
+        preempt=args.sched_preempt,
+        coalesce=args.sched_coalesce,
+        interactive_phase_boost=args.sched_interactive_phase_boost,
+    )
+
+
 def _pressure_config_from_args(args: argparse.Namespace) -> PressureConfig:
     if not args.pressure:
         return PressureConfig()
@@ -472,6 +546,7 @@ def build_serve_parser() -> argparse.ArgumentParser:
     _add_robustness_flags(p)
     _add_pressure_flags(p)
     _add_observability_flags(p)
+    _add_sched_flags(p)
     # Demo driver: submit a prompt pickle at staggered times, write the
     # offline-contract outputs. Without it, requests are read as JSON lines
     # from stdin: {"prefix": ..., "suffixes": [...], "max_new_tokens": N}.
@@ -532,6 +607,7 @@ def serve_main(argv: list[str] | None = None, tokenizer=None) -> None:
         router_health_poll_s=args.router_health_poll_s,
         router_drain_recoveries=args.router_drain_recoveries,
         max_request_tokens=args.max_request_tokens,
+        sched=_sched_config_from_args(args),
     )
     if tokenizer is None:
         from transformers import AutoTokenizer
@@ -627,6 +703,11 @@ def serve_main(argv: list[str] | None = None, tokenizer=None) -> None:
                         max_new_tokens=d.get("max_new_tokens"),
                         deadline_s=d.get("deadline_s"),
                         callback=reply,
+                        # Multi-tenant scheduling (serve/sched): an
+                        # unknown slo_class raises typed and lands in the
+                        # bad-request reply below, never a silent default.
+                        slo_class=d.get("slo_class"),
+                        tenant_id=d.get("tenant_id"),
                     )
                 except Exception as e:
                     # One malformed line must not take the server down for

@@ -1194,6 +1194,113 @@ class FrameworkConfig:
             return False
 
 
+def _parse_tenant_map(spec: str, what: str) -> dict[str, float]:
+    """Parse a ``"tenantA=2,tenantB=0.5"`` CLI spec into ``{tenant: value}``.
+    Shared by SchedConfig's weight and rate-limit fields so the two can't
+    grow divergent syntaxes; raises ValueError naming the offending entry."""
+    out: dict[str, float] = {}
+    for entry in (e.strip() for e in spec.split(",") if e.strip()):
+        name, sep, value = entry.partition("=")
+        if not sep or not name:
+            raise ValueError(
+                f"{what}: bad entry {entry!r} (expected tenant=value)"
+            )
+        try:
+            out[name] = float(value)
+        except ValueError:
+            raise ValueError(
+                f"{what}: non-numeric value in {entry!r}"
+            ) from None
+    return out
+
+
+@dataclasses.dataclass(frozen=True)
+class SchedConfig:
+    """Multi-tenant sweep scheduler (serve/sched/; docs/scheduling.md).
+
+    Off by default — the admission queue then pops strict FIFO, exactly
+    the pre-scheduler serving path. Enabled (``--sched``), the queue pops
+    by STRICT PRIORITY across SLO classes (interactive > standard >
+    best_effort) with deficit-weighted round-robin across tenants inside
+    a class, tenants can carry token-bucket rate limits (over-limit
+    submits resolve as typed ``RateLimited`` rejections with a
+    ``retry_after_s`` hint), an interactive request stuck behind
+    best-effort waves preempts the youngest best-effort wave at a
+    shard-0 sweep boundary (never mid-sweep; the preempted requests
+    resume token-identically), and same-prefix requests coalesce into
+    one shared-prefix prefill."""
+
+    enabled: bool = False
+    # Per-class default ADMISSION deadlines (seconds), applied when a
+    # request names neither its own deadline nor one via the serve-level
+    # default; 0 = no class default (fall back to
+    # ServeConfig.default_deadline_s).
+    interactive_deadline_s: float = 0.0
+    standard_deadline_s: float = 0.0
+    best_effort_deadline_s: float = 0.0
+    # Deficit-round-robin weights: "tenantA=4,tenantB=1"; unlisted
+    # tenants weigh 1. A tenant with weight w gets ~w shares of each
+    # class's admission budget while it has queued work.
+    tenant_weights: str = ""
+    # Token-bucket rate limits in requests/second: "tenantA=5"; unlisted
+    # tenants are unlimited. Over-limit submits resolve as typed
+    # RateLimited (a QueueFull subclass) carrying retry_after_s.
+    tenant_limits: str = ""
+    # Bucket capacity (burst) in requests, shared by every limited
+    # tenant: a tenant idle long enough accumulates up to this many
+    # instantly-admittable requests.
+    tenant_burst: float = 4.0
+    # Sweep-boundary preemption: an interactive request waiting while
+    # every active-request slot is held and a best-effort wave is in
+    # flight retires the YOUNGEST best-effort wave at the next shard-0
+    # boundary; its requests re-enqueue with generated-so-far tokens
+    # folded into their suffixes and resume token-identically.
+    preempt: bool = True
+    # Admission-time prefix coalescing: same-tokenized-prefix requests
+    # admitted at one boundary merge into one wave entry that prefills
+    # the shared prefix KV once and fans the suffix/decode streams out
+    # per request.
+    coalesce: bool = True
+    # Fleet routing (serve/router.py): multiply the router's phase
+    # weight by this for interactive requests, so interactive work lands
+    # on the replica nearest its next shard-0 admission point.
+    interactive_phase_boost: float = 2.0
+
+    def __post_init__(self) -> None:
+        for name in ("interactive_deadline_s", "standard_deadline_s",
+                     "best_effort_deadline_s"):
+            if getattr(self, name) < 0:
+                raise ValueError(f"{name} must be >= 0 (0 = no default)")
+        weights = _parse_tenant_map(self.tenant_weights, "tenant_weights")
+        for t, w in weights.items():
+            # The DRR loop's visit bound is ~1/min_weight; a zero or
+            # absurdly small weight would spin it, not starve gracefully.
+            if not 0.01 <= w <= 1e6:
+                raise ValueError(
+                    f"tenant_weights: weight for {t!r} must be in "
+                    f"[0.01, 1e6], got {w}"
+                )
+        limits = _parse_tenant_map(self.tenant_limits, "tenant_limits")
+        for t, r in limits.items():
+            if r <= 0:
+                raise ValueError(
+                    f"tenant_limits: rate for {t!r} must be > 0 "
+                    "(omit the tenant for unlimited)"
+                )
+        if self.tenant_burst < 1:
+            raise ValueError("tenant_burst must be >= 1")
+        if self.interactive_phase_boost < 1:
+            raise ValueError(
+                "interactive_phase_boost must be >= 1 (1 = no boost)"
+            )
+
+    def tenant_weight_map(self) -> dict[str, float]:
+        return _parse_tenant_map(self.tenant_weights, "tenant_weights")
+
+    def tenant_limit_map(self) -> dict[str, float]:
+        return _parse_tenant_map(self.tenant_limits, "tenant_limits")
+
+
 @dataclasses.dataclass(frozen=True)
 class ServeConfig:
     """Online-serving knobs (the ``serve`` CLI subcommand / serve.engine).
@@ -1273,6 +1380,11 @@ class ServeConfig:
     # the wave (where an oversized request's MemoryError previously
     # aborted the whole wave it joined). 0 = off.
     max_request_tokens: int = 0
+    # Multi-tenant sweep scheduler (serve/sched/; --sched* flags): SLO
+    # classes with strict priority + sweep-boundary preemption,
+    # per-tenant fair queueing and rate limits, prefix coalescing. Off
+    # by default — the queue then pops strict FIFO.
+    sched: SchedConfig = dataclasses.field(default_factory=SchedConfig)
 
     def __post_init__(self) -> None:
         if self.queue_capacity < 1:

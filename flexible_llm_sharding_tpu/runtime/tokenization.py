@@ -131,6 +131,56 @@ class PromptTokenizer:
         )
 
 
+def extend_tokenized(
+    tp: TokenizedPrompt,
+    gen: np.ndarray,
+    pad_id: int,
+    bucket_multiple: int,
+    max_token_len: int,
+) -> TokenizedPrompt:
+    """Fold already-generated token ids into a tokenized prompt's suffix
+    rows — the preemption-resume path (serve/sched, docs/scheduling.md).
+
+    ``gen`` is int32 ``[num_suffixes, n_done]``: the tokens each real
+    suffix already received before its wave was preempted at a sweep
+    boundary. They are appended as TOKEN IDS directly after each row's
+    last real token (never a decode->retokenize round trip, which real
+    tokenizers don't guarantee to invert), so the resumed prefill
+    recomputes exactly the KV the interrupted decode held and the next
+    greedy step continues token-identically. Raises ValueError when an
+    extended row would exceed ``max_token_len`` (the wave-reject
+    taxonomy turns that into a per-request failure, not an engine stop).
+    """
+    n_done = int(gen.shape[1])
+    if n_done == 0:
+        return tp
+    eos = tp.suffix_eos
+    longest = int(
+        (eos[: tp.num_suffixes] + 1).max()
+    ) + n_done if tp.num_suffixes else n_done
+    if longest > max_token_len:
+        raise ValueError(
+            f"preemption resume would extend a suffix to {longest} tokens, "
+            f"past max_token_len={max_token_len}"
+        )
+    s_b = tp.suffix_ids.shape[0]
+    ls_new = bucket_len(longest, bucket_multiple, max_token_len)
+    out = np.full((s_b, ls_new), pad_id, dtype=np.int32)
+    new_eos = eos.copy()
+    for s in range(tp.num_suffixes):
+        real = int(eos[s]) + 1
+        out[s, :real] = tp.suffix_ids[s, :real]
+        out[s, real : real + n_done] = gen[s]
+        new_eos[s] = real + n_done - 1
+    return TokenizedPrompt(
+        prefix_ids=tp.prefix_ids,
+        suffix_ids=out,
+        prefix_len=tp.prefix_len,
+        suffix_eos=new_eos,
+        num_suffixes=tp.num_suffixes,
+    )
+
+
 def longrope_total_len(model_cfg, prefix_len, suffix_eos):
     """Per-prompt real total length for longrope's long/short table choice
     (None for every other scaling kind). prefix_len: scalar or [B];
@@ -219,6 +269,7 @@ def make_blocks(
 __all__ = [
     "PromptTokenizer",
     "TokenizedPrompt",
+    "extend_tokenized",
     "make_blocks",
     "bucket_len",
     "count_tokens",

@@ -22,6 +22,11 @@ over a fixed prompt set; this package turns the same runtime into a server:
   hard-fail (registry counters + sweep-watermark liveness), exactly-once
   re-dispatch of a dead replica's requests, elastic join/leave, and the
   replica-level chaos sites (replica_kill / replica_stall).
+- ``sched``    — the multi-tenant sweep scheduler (docs/scheduling.md):
+  SLO classes with strict priority and sweep-boundary preemption of
+  best-effort waves, per-tenant deficit-round-robin fairness and
+  token-bucket rate limits, and cross-request prefix coalescing (one
+  shared prefill for N same-prefix requests).
 """
 
 from flexible_llm_sharding_tpu.serve.request import (  # noqa: F401
@@ -43,12 +48,18 @@ from flexible_llm_sharding_tpu.serve.fleet import (  # noqa: F401
     ReplicaFleet,
     ReplicaKilled,
 )
+from flexible_llm_sharding_tpu.serve.sched import (  # noqa: F401
+    RateLimited,
+    SweepScheduler,
+    UnknownSLOClass,
+)
 
 __all__ = [
     "AdmissionQueue",
     "DeadlineExceeded",
     "Overloaded",
     "QueueFull",
+    "RateLimited",
     "ReplicaFleet",
     "ReplicaKilled",
     "Request",
@@ -59,5 +70,7 @@ __all__ = [
     "ServeEngine",
     "ServeFuture",
     "ShardAwareBatcher",
+    "SweepScheduler",
+    "UnknownSLOClass",
     "WaveAborted",
 ]

@@ -27,6 +27,26 @@ from flexible_llm_sharding_tpu.serve.request import Request, RequestStatus
 
 _WAVE_IDS = itertools.count()
 
+# Strict SLO-class priority order, mirrored from serve/sched/classes.py
+# (importing it here would make the base batcher depend on the optional
+# scheduler package; tests/test_sched.py pins the two in sync).
+_CLASS_RANK = {"interactive": 0, "standard": 1, "best_effort": 2}
+
+
+@dataclass
+class WaveEntry:
+    """One PREFILL unit inside a wave: a single request, or a
+    prefix-coalesced group (serve/sched/coalesce.py) whose members share
+    one tokenized prefix. ``suffixes`` is the members' suffix
+    concatenation — the entry tokenizes as ONE (prefix, suffixes) prompt,
+    so the shared prefix KV prefills once and each member's rows slice
+    back out via ``slices`` (per member: (suffix offset, count))."""
+
+    requests: list[Request]
+    prefix: str
+    suffixes: tuple[str, ...]
+    slices: list[tuple[int, int]]
+
 
 @dataclass
 class Wave:
@@ -35,16 +55,54 @@ class Wave:
     The wave's first sweep runs its prefill segments (capturing KV and the
     first token); every later sweep runs one decode step against that KV.
     The engine owns the compute state (``state``); the batcher owns
-    membership and retirement."""
+    membership and retirement. ``entries`` (None -> one entry per
+    request) is the prefill structure: prefix-coalesced groups share one
+    entry."""
 
     requests: list[Request]
     wave_id: int = field(default_factory=lambda: next(_WAVE_IDS))
     steps: int = 0  # tokens picked per suffix so far (1 after prefill)
     state: Any = None  # engine-private compute state (_WaveState)
+    entries: list[WaveEntry] | None = None
+
+    def ensure_entries(self) -> list[WaveEntry]:
+        if self.entries is None:
+            self.entries = [
+                WaveEntry(
+                    requests=[r],
+                    prefix=r.prefix,
+                    suffixes=r.suffixes,
+                    slices=[(0, len(r.suffixes))],
+                )
+                for r in self.requests
+            ]
+        return self.entries
+
+    def locate(self, r: Request) -> tuple[int, int, int]:
+        """(entry index, suffix offset, suffix count) of one member."""
+        for e_idx, e in enumerate(self.ensure_entries()):
+            for (off, cnt), member in zip(e.slices, e.requests):
+                if member is r:
+                    return e_idx, off, cnt
+        raise ValueError(f"request {r.request_id} is not in wave {self.wave_id}")
 
     @property
     def max_steps(self) -> int:
-        return max(r.max_new_tokens for r in self.requests)
+        # Remaining budget, not the absolute one: a preemption-resumed
+        # request's already-served tokens ride in via its extended
+        # suffixes, so the wave only decodes what is left.
+        return max(r.max_new_tokens - r.resume_len for r in self.requests)
+
+    @property
+    def slo_class(self) -> str:
+        """The wave's effective class for preemption decisions: the BEST
+        (highest-priority) class among members — a wave carrying even one
+        interactive request is never a best-effort preemption victim.
+        Scheduler-formed waves are single-class by construction."""
+        return min(
+            (r.slo_class for r in self.requests),
+            key=lambda c: _CLASS_RANK.get(c, _CLASS_RANK["standard"]),
+        )
 
     @property
     def done(self) -> bool:
@@ -58,11 +116,16 @@ class ShardAwareBatcher:
         max_wave_requests: int,
         max_active_requests: int,
         metrics=None,
+        entry_builder=None,
     ):
+        # entry_builder (serve/sched/coalesce.build_entries partial, or
+        # None): maps one boundary's popped requests to WaveEntry groups —
+        # the prefix-coalescing hook. None keeps one entry per request.
         self.queue = queue
         self.max_wave_requests = max_wave_requests
         self.max_active_requests = max_active_requests
         self._metrics = metrics
+        self._entry_builder = entry_builder
         self.waves: list[Wave] = []
 
     @property
@@ -96,7 +159,12 @@ class ShardAwareBatcher:
         for r in reqs:
             r.status = RequestStatus.ACTIVE
             r.admitted_at = now
-        wave = Wave(requests=reqs)
+        entries = (
+            self._entry_builder(reqs)
+            if self._entry_builder is not None
+            else None
+        )
+        wave = Wave(requests=reqs, entries=entries)
         self.waves.append(wave)
         if self._metrics is not None:
             self._metrics.count("admitted", len(reqs))
@@ -132,4 +200,4 @@ class ShardAwareBatcher:
         self._metrics.gauge("active_waves", len(self.waves))
 
 
-__all__ = ["ShardAwareBatcher", "Wave"]
+__all__ = ["ShardAwareBatcher", "Wave", "WaveEntry"]

@@ -70,6 +70,7 @@ from flexible_llm_sharding_tpu.runtime.executor import (
 from flexible_llm_sharding_tpu.runtime.tokenization import (
     PromptTokenizer,
     check_longrope_regime,
+    extend_tokenized,
     longrope_total_len,
     make_blocks,
 )
@@ -79,6 +80,12 @@ from flexible_llm_sharding_tpu.serve.request import (
     Request,
     RequestStatus,
     WaveAborted,
+)
+from flexible_llm_sharding_tpu.serve.sched import (
+    SweepScheduler,
+    build_entries,
+    class_deadline_s,
+    parse_class,
 )
 from flexible_llm_sharding_tpu.utils import checkpoint
 from flexible_llm_sharding_tpu.utils.metrics import ServingMetrics, StepWatchdog
@@ -95,7 +102,7 @@ class _WaveState:
     kv_store: KVStore
     scores: dict[int, list[np.ndarray]]
     tok_hist: dict[int, list[np.ndarray]]
-    loc: dict[int, tuple[int, int]]  # request pos in wave -> (block, row)
+    loc: dict[int, tuple[int, int]]  # wave-entry index -> (block, row)
     slots: int
     norm_p: Any = None  # per-sweep: norm params ride shard->head shard
 
@@ -116,7 +123,12 @@ class ServeEngine:
         device=None,
         start: bool = True,
         process_metrics_mirror: bool = True,
+        scheduler=None,
     ):
+        # scheduler: a SHARED SweepScheduler (serve/fleet.py passes the
+        # fleet-wide instance so tenant rate limits and DRR fairness span
+        # replicas instead of multiplying by the replica count). None =
+        # this engine builds its own when serve_cfg.sched.enabled.
         if cfg.temperature > 0:
             raise ValueError(
                 "serving is greedy-only for now (per-request rng streams "
@@ -218,11 +230,23 @@ class ServeEngine:
             self.metrics_server = MetricsServer(
                 self.metrics.registry, port=self.serve_cfg.metrics_port
             )
+        # Multi-tenant sweep scheduler (serve/sched/, docs/scheduling.md):
+        # None keeps the strict-FIFO pop (the pre-scheduler path, and the
+        # parity baseline tests/test_sched.py pins against). When on, the
+        # queue pops by class priority + tenant DRR, submit enforces
+        # per-tenant rate limits, boundaries may preempt best-effort
+        # waves, and same-prefix admissions coalesce into one prefill.
+        self._sched = scheduler
+        if self._sched is None and self.serve_cfg.sched.enabled:
+            self._sched = SweepScheduler(self.serve_cfg.sched)
+        if self._sched is not None:
+            self.metrics.register("sched", self._sched.stats)
         self.queue = AdmissionQueue(
             self.serve_cfg.queue_capacity, metrics=self.metrics,
             injector=self._injector,
             max_request_tokens=self.serve_cfg.max_request_tokens,
             size_fn=self._request_size_tokens,
+            scheduler=self._sched,
         )
         # Resource-pressure brownout (runtime/pressure.py): the process
         # controller (None unless cfg.pressure.enabled) sheds through
@@ -243,6 +267,14 @@ class ServeEngine:
             self.serve_cfg.max_wave_requests,
             self.serve_cfg.max_active_requests,
             metrics=self.metrics,
+            # Prefix coalescing (serve/sched/coalesce.py): keyed by the
+            # TOKENIZED prefix, so string-distinct prefixes that tokenize
+            # identically still share one prefill.
+            entry_builder=(
+                (lambda reqs: build_entries(reqs, self._prefix_key))
+                if self._sched is not None and self.serve_cfg.sched.coalesce
+                else None
+            ),
         )
         self._kept: list | None = None  # resident: placed shards
         self._source: ShardWeightSource | None = None  # streamed: cycling
@@ -285,9 +317,17 @@ class ServeEngine:
         max_new_tokens: int | None = None,
         deadline_s: float | None = None,
         callback: Callable[[Request], Any] | None = None,
+        slo_class: str | None = None,
+        tenant_id: str | None = None,
     ) -> Request:
         """Enqueue one request (any thread). Backpressure/closed/deadline
-        outcomes surface through the returned request's future."""
+        outcomes surface through the returned request's future; an
+        unknown ``slo_class`` raises typed (UnknownSLOClass) to the
+        submitter. Deadline precedence: the request's own, else the SLO
+        class's default (scheduler on), else the serve-level default."""
+        slo = parse_class(slo_class)
+        if deadline_s is None:
+            deadline_s = class_deadline_s(self.serve_cfg.sched, slo)
         if deadline_s is None and self.serve_cfg.default_deadline_s > 0:
             deadline_s = self.serve_cfg.default_deadline_s
         req = Request(
@@ -304,6 +344,8 @@ class ServeEngine:
                 else None
             ),
             callback=callback,
+            slo_class=slo,
+            tenant_id=tenant_id if tenant_id is not None else "default",
         )
         return self.submit_request(req)
 
@@ -432,6 +474,10 @@ class ServeEngine:
                 # Boundary passes are liveness too: an idle engine polling
                 # its empty queue must not look wedged to the fleet.
                 self._heartbeat = time.monotonic()
+                # Preemption BEFORE admission: a retired best-effort wave
+                # frees slots this same boundary's pop hands to the
+                # waiting interactive work (serve/sched, never mid-sweep).
+                self._maybe_preempt()
                 wave = self.batcher.admit_at_boundary()
                 if wave is not None and not self._init_wave(wave):
                     continue  # wave failed at tokenization; re-check queue
@@ -625,15 +671,136 @@ class ServeEngine:
             longest = max((len(s) for s in sids), default=0)
         return len(pids) + longest + req.max_new_tokens
 
+    def _prefix_key(self, prefix: str) -> tuple:
+        """Coalescing key: the tokenized prefix (truncation-aware), so
+        requests merge exactly when their prefix TOKEN streams match.
+        One extra host-side prefix tokenization per admitted request —
+        the same order of cost as the admission size cap, paid only with
+        coalescing on."""
+        return tuple(
+            self.raw_tokenizer(
+                prefix, truncation=True, max_length=self.cfg.max_token_len
+            )["input_ids"]
+        )
+
+    def _prefix_kv_bytes(self, prefix_tokens: int) -> int:
+        """Estimated prefix-KV bytes ONE prefill materializes for a
+        ``prefix_tokens``-long prefix: K + V per layer per kv-head at the
+        compute dtype — the per-request savings a coalesced entry's
+        shared prefill banks (the ``prefill_kv_bytes_saved`` counter)."""
+        mc = self.model_cfg
+        itemsize = np.dtype(self.dtype).itemsize
+        return int(
+            prefix_tokens
+            * mc.num_hidden_layers
+            * mc.num_key_value_heads
+            * (mc.head_dim + mc.v_dim)
+            * itemsize
+        )
+
+    def _tokenize_entry(self, entry):
+        """One (prefix, merged-suffixes) prompt per wave entry; a
+        preemption-resumed request's generated-so-far tokens fold into
+        its suffix rows as TOKEN IDS (resume entries are never coalesced,
+        serve/sched/coalesce.py), so the resumed prefill recomputes
+        exactly the interrupted decode's KV."""
+        tp = self.tokenizer(entry.prefix, entry.suffixes)
+        r = entry.requests[0]
+        if len(entry.requests) == 1 and r.resume_len:
+            gen = np.stack(r.resume_tokens, axis=1).astype(np.int32)
+            tp = extend_tokenized(
+                tp, gen, self.tokenizer.pad_id,
+                self.cfg.bucket_multiple, self.cfg.max_token_len,
+            )
+        return tp
+
+    # -- sweep-boundary preemption (serve/sched) ---------------------------
+
+    def _maybe_preempt(self) -> None:
+        """At a shard-0 boundary: if an interactive request waits with no
+        free active-request slot and a purely best-effort wave in flight,
+        retire the youngest best-effort wave (the scheduler decides,
+        ``SweepScheduler.pick_preempt``) so this boundary's admission can
+        seat the interactive work. Never fires mid-sweep."""
+        if self._sched is None:
+            return
+        free = self.serve_cfg.max_active_requests - self.batcher.active_requests
+        victim = self._sched.pick_preempt(self.batcher.waves, self.queue, free)
+        if victim is not None:
+            self._preempt_wave(victim)
+
+    def _preempt_wave(self, wave: Wave) -> None:
+        """Retire one in-flight wave at a boundary WITHOUT resolving
+        anything: each live request captures its generated-so-far scores
+        and token ids as resume state, drops back to QUEUED, and
+        re-enqueues at the queue front. Its KV is released; on
+        re-admission the resume tokens fold into the suffix ids so the
+        continuation is token-identical to an uninterrupted run (the
+        exactly-once ``claim()`` machinery guarantees no double
+        resolution if a fleet reclaim races this)."""
+        st = wave.state
+        live: list[Request] = []
+        for r in wave.requests:
+            if r.status.terminal:
+                continue
+            if st is not None and wave.steps > 0:
+                e_idx, s_off, s_cnt = wave.locate(r)
+                b, row = st.loc[e_idx]
+                # Steps THIS wave served it (a twice-preempted request's
+                # earlier tokens are already in its resume lists).
+                done_here = r.tokens_emitted - r.resume_len
+                for t in range(max(done_here, 0)):
+                    r.resume_scores.append(
+                        st.scores[b][t][row, s_off : s_off + s_cnt].copy()
+                    )
+                    r.resume_tokens.append(
+                        st.tok_hist[b][t][row, s_off : s_off + s_cnt].copy()
+                    )
+            if r.first_token_at is not None:
+                # The admission deadline guards TIME TO FIRST TOKEN; once
+                # the first token is out, expiring the request while it
+                # waits to resume would discard served work over a
+                # contract it already met.
+                r.deadline = None
+            r.status = RequestStatus.QUEUED
+            live.append(r)
+        if st is not None:
+            st.kv_store.clear()
+        self.batcher.waves.remove(wave)
+        self._sched.note_preempted(len(live))
+        obs_trace.instant(
+            "wave_preempt", cat="sched", wave_id=wave.wave_id,
+            requests=len(live), steps=wave.steps,
+            request_ids=[r.request_id for r in live],
+        )
+        self.queue.requeue(live)
+
     def _init_wave(self, wave: Wave) -> bool:
-        """Tokenize/bucket the admitted requests and allocate wave state.
-        A bad workload (e.g. a longrope regime straddle) fails ONLY this
+        """Tokenize/bucket the admitted entries (one per request, or one
+        per prefix-coalesced group) and allocate wave state. A bad
+        workload (e.g. a longrope regime straddle) fails ONLY this
         wave's requests; the engine keeps serving."""
+        entries = wave.ensure_entries()
         try:
-            toks = [self.tokenizer(r.prefix, r.suffixes) for r in wave.requests]
+            toks = [self._tokenize_entry(e) for e in entries]
             check_longrope_regime(
                 self.model_cfg, toks, extra_len=max(wave.max_steps - 1, 0)
             )
+            if self._sched is not None:
+                for e, tp in zip(entries, toks):
+                    if len(e.requests) > 1:
+                        saved = (len(e.requests) - 1) * self._prefix_kv_bytes(
+                            tp.prefix_len
+                        )
+                        self._sched.note_coalesced(len(e.requests), saved)
+                        obs_trace.instant(
+                            "prefix_coalesce", cat="sched",
+                            wave_id=wave.wave_id,
+                            requests=len(e.requests),
+                            request_ids=[r.request_id for r in e.requests],
+                            prefix_tokens=tp.prefix_len,
+                            kv_bytes_saved=saved,
+                        )
             blocks = make_blocks(toks, self.cfg.block_size)
             meta = {
                 b: (
@@ -816,9 +983,12 @@ class ServeEngine:
             # (statuses only change in _post_sweep, so liveness is stable
             # within a sweep): a mixed-budget wave must not keep paying
             # full decode + head + host transfer for finished rows until
-            # its slowest request completes.
+            # its slowest request completes. Rows are ENTRIES (possibly
+            # prefix-coalesced groups), so the check spans their members.
             if all(
-                wave.requests[i].status.terminal for i in st.blocks[b]
+                r.status.terminal
+                for i in st.blocks[b]
+                for r in wave.entries[i].requests
             ):
                 continue
             _, _, prefix_len, suffix_eos = st.meta[b]
@@ -880,7 +1050,7 @@ class ServeEngine:
                     continue
                 if prefilled and r.first_token_at is None:
                     r.first_token_at = now
-                    self.metrics.observe_ttft(now - r.arrival)
+                    self.metrics.observe_ttft(now - r.arrival, r.slo_class)
                     obs_trace.instant(
                         "ttft", cat="serve", wave_id=wave.wave_id,
                         request_id=r.request_id,
@@ -905,16 +1075,22 @@ class ServeEngine:
 
     def _resolve(self, wave: Wave, r: Request) -> None:
         st: _WaveState = wave.state
-        i = wave.requests.index(r)
-        b, row = st.loc[i]
-        s_true = st.toks[i].num_suffixes
+        e_idx, s_off, s_cnt = wave.locate(r)
+        b, row = st.loc[e_idx]
+        # Steps served by THIS wave; a preemption-resumed request stitches
+        # its pre-preemption steps (resume_scores/resume_tokens) in front,
+        # so the caller sees one uninterrupted [n_suffixes, n, vocab]
+        # stream regardless of how many boundaries interrupted it.
+        rem = r.max_new_tokens - r.resume_len
+        step_scores = list(r.resume_scores) + [
+            st.scores[b][t][row, s_off : s_off + s_cnt] for t in range(rem)
+        ]
+        step_tokens = list(r.resume_tokens) + [
+            st.tok_hist[b][t][row, s_off : s_off + s_cnt] for t in range(rem)
+        ]
         n = r.max_new_tokens
-        scores = np.stack(
-            [st.scores[b][t][row, :s_true] for t in range(n)], axis=1
-        )
-        tokens = np.stack(
-            [st.tok_hist[b][t][row, :s_true] for t in range(n)], axis=1
-        )
+        scores = np.stack(step_scores, axis=1)
+        tokens = np.stack(step_tokens, axis=1)
         updated = (
             r.prefix,
             tuple(
@@ -922,8 +1098,10 @@ class ServeEngine:
                 for s_i, s in enumerate(r.suffixes)
             ),
         )
+        latency = time.monotonic() - r.arrival
         if r.resolve(scores, updated, tokens):
             self.metrics.count("completed")
+            self.metrics.observe_request_latency(latency, r.slo_class)
             obs_trace.instant(
                 "request_finish", cat="serve", wave_id=wave.wave_id,
                 request_id=r.request_id, tokens=int(n),

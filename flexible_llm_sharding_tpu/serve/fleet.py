@@ -70,6 +70,7 @@ from flexible_llm_sharding_tpu.serve.request import (
     WaveAborted,
 )
 from flexible_llm_sharding_tpu.serve.router import Router
+from flexible_llm_sharding_tpu.serve.sched import classes as sched_classes
 from flexible_llm_sharding_tpu.utils.metrics import RouterMetrics
 
 
@@ -175,6 +176,25 @@ class ReplicaFleet:
         self._pressure = _pressure.controller_for(cfg)
         if self._pressure is not None:
             self._pressure.attach_fleet(self)
+        # ONE scheduler shared by every replica (serve/sched): tenant
+        # rate limits and DRR fairness are fleet-wide — per-replica
+        # buckets would multiply every tenant's rate by the replica
+        # count as the router spreads its traffic. Preemption decisions
+        # stay per-engine (each at its own sweep boundaries). Registered
+        # at the fleet endpoint as the process-level `sched` source.
+        from flexible_llm_sharding_tpu.serve.sched import SweepScheduler
+
+        self._sched = (
+            SweepScheduler(self.serve_cfg.sched)
+            if self.serve_cfg.sched.enabled
+            else None
+        )
+        # Bound method kept for shutdown's identity-checked unregister.
+        self._sched_source = (
+            self._sched.stats if self._sched is not None else None
+        )
+        if self._sched_source is not None:
+            REGISTRY.register("sched", self._sched_source)
         # Process-registry registration: the bound method is kept so
         # shutdown's unregister_if identity check matches.
         self._router_source = self.metrics.snapshot
@@ -265,6 +285,8 @@ class ReplicaFleet:
         if self.metrics_server is not None:
             self.metrics_server.close()
         REGISTRY.unregister_if("router", self._router_source)
+        if self._sched_source is not None:
+            REGISTRY.unregister_if("sched", self._sched_source)
         return ok
 
     # -- replica lifecycle -------------------------------------------------
@@ -282,6 +304,9 @@ class ReplicaFleet:
             # last-wins would expose one arbitrary replica as THE process
             # family; the replica<idx> registration below is the mirror.
             process_metrics_mirror=False,
+            # Fleet-wide scheduling state: rate limits and fairness must
+            # not multiply by the replica count.
+            scheduler=self._sched,
         )
         with self._lock:
             idx = self._next_idx
@@ -544,11 +569,21 @@ class ReplicaFleet:
         max_new_tokens: int | None = None,
         deadline_s: float | None = None,
         callback=None,
+        slo_class: str | None = None,
+        tenant_id: str | None = None,
     ) -> Request:
         """Enqueue one request (any thread) — the ``ServeEngine.submit``
         surface. The returned request's future resolves from whichever
         replica ultimately serves it; a mid-flight replica death is
-        invisible to the caller beyond latency."""
+        invisible to the caller beyond latency. SLO class/tenant ride
+        every attempt: the replica's own scheduler fair-queues and may
+        preempt for them, and the router biases interactive dispatch
+        toward the replica nearest its shard-0 boundary."""
+        slo = sched_classes.parse_class(slo_class)
+        if deadline_s is None:
+            deadline_s = sched_classes.class_deadline_s(
+                self.serve_cfg.sched, slo
+            )
         if deadline_s is None and self.serve_cfg.default_deadline_s > 0:
             deadline_s = self.serve_cfg.default_deadline_s
         req = Request(
@@ -565,6 +600,8 @@ class ReplicaFleet:
                 else None
             ),
             callback=callback,
+            slo_class=slo,
+            tenant_id=tenant_id if tenant_id is not None else "default",
         )
         if req.max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
@@ -606,7 +643,20 @@ class ReplicaFleet:
                 choice = "closed"
                 replica = None
             else:
-                replica = self.router.pick(self._replicas, exclude=failed_on)
+                # Class-aware dispatch (serve/sched): interactive work
+                # weighs boundary proximity harder, landing on the
+                # replica whose next shard-0 admission point is soonest.
+                bias = (
+                    self.serve_cfg.sched.interactive_phase_boost
+                    if (
+                        self.serve_cfg.sched.enabled
+                        and outer.slo_class == sched_classes.INTERACTIVE
+                    )
+                    else 1.0
+                )
+                replica = self.router.pick(
+                    self._replicas, exclude=failed_on, phase_bias=bias
+                )
                 if replica is None:
                     # No serving replica right now (all dead/draining):
                     # park; the monitor re-dispatches when one recovers.
@@ -627,6 +677,8 @@ class ReplicaFleet:
                         # (brownout sheds NEW admissions, never strands
                         # already-accepted in-flight work).
                         shed_exempt=redispatch,
+                        slo_class=outer.slo_class,
+                        tenant_id=outer.tenant_id,
                     )
                     disp.inner = inner
                     disp.replica = replica

@@ -22,6 +22,12 @@ loop pops batches at shard-0 boundaries. Three contracts, each loud:
   whose estimated prompt tokens + generation budget exceed the cap is
   rejected typed (``RequestTooLarge``) at submit — before it can join a
   wave and fail every co-admitted request at allocation.
+- **Scheduling** (``serve/sched/``, opt-in): with a ``SweepScheduler``
+  attached, ``pop_wave`` picks by strict SLO-class priority + per-tenant
+  deficit round-robin instead of FIFO, ``submit`` enforces per-tenant
+  token-bucket rate limits (over-limit -> typed ``RateLimited`` with a
+  retry-after hint), and ``requeue``/``has_waiting`` carry the
+  sweep-boundary preemption protocol (docs/scheduling.md).
 """
 
 from __future__ import annotations
@@ -49,12 +55,17 @@ class AdmissionQueue:
         injector=None,
         max_request_tokens: int = 0,
         size_fn=None,
+        scheduler=None,
     ):
         # max_request_tokens/size_fn: admission-side request size cap —
         # size_fn(request) estimates prompt tokens + generation budget
         # (the engine supplies a tokenizer-backed estimator); a request
         # over the cap is rejected with a typed RequestTooLarge at
         # submit, never admitted to fail a whole wave at allocation.
+        # scheduler (serve/sched/scheduler.SweepScheduler or None): when
+        # attached, pop_wave delegates the pick to its class-priority +
+        # tenant-DRR policy instead of FIFO, and submit consults its
+        # per-tenant rate limiter (over-limit -> typed RateLimited).
         if capacity < 1:
             raise ValueError("capacity must be >= 1")
         self.capacity = capacity
@@ -62,6 +73,7 @@ class AdmissionQueue:
         self._injector = injector  # faults.inject.FaultInjector or None
         self._max_request_tokens = max_request_tokens
         self._size_fn = size_fn
+        self._scheduler = scheduler
         self._lock = threading.Lock()
         self._items: deque[Request] = deque()  # guarded by: _lock
         self._closed = False  # guarded by: _lock
@@ -121,6 +133,16 @@ class AdmissionQueue:
             if on_shed is not None:
                 on_shed()
             return request
+        if self._scheduler is not None:
+            # Per-tenant rate limit (serve/sched): cheapest refusal after
+            # the brownout check — a typed RateLimited with retry_after_s,
+            # before the request can cost a size estimate or a queue slot.
+            limited = self._scheduler.admit_check(request)
+            if limited is not None:
+                request.fail(limited, RequestStatus.REJECTED)
+                if self._metrics is not None:
+                    self._metrics.count("rejected")
+                return request
         if self._max_request_tokens > 0 and self._size_fn is not None:
             # Size cap BEFORE the capacity check: an oversized request
             # must not consume a queue slot on its way to a rejection.
@@ -130,6 +152,7 @@ class AdmissionQueue:
             except Exception:  # flscheck: disable=EXC-TAXONOMY: a size-estimator failure (tokenizer edge case) must not reject or crash admission — the wave-level typed rejection family still catches genuinely malformed requests with full context
                 est = None
             if est is not None and est > self._max_request_tokens:
+                self._refund_rate_token(request)
                 request.fail(
                     RequestTooLarge(
                         f"request {request.request_id}: ~{est} tokens "
@@ -150,6 +173,7 @@ class AdmissionQueue:
             try:
                 self._injector.fire("queue_admission")
             except Exception as e:  # flscheck: disable=EXC-TAXONOMY: ANY injected front-door fault resolves as a reasoned rejection through the request future — never an unhandled raise into the submitter
+                self._refund_rate_token(request)
                 request.fail(e, RequestStatus.REJECTED)
                 if self._metrics is not None:
                     self._metrics.count("rejected")
@@ -177,6 +201,10 @@ class AdmissionQueue:
                     depth = len(self._items)
         self._finish_expired(evicted)
         if reject is not None:
+            # The attempt never enqueued (full/closed): a debited rate
+            # token must flow back, or backpressure retries would burn
+            # the tenant's budget without admitting anything.
+            self._refund_rate_token(request)
             request.fail(reject, status)
             if self._metrics is not None:
                 if status is RequestStatus.REJECTED:
@@ -188,21 +216,63 @@ class AdmissionQueue:
             self._metrics.gauge("queue_depth", depth)
         return request
 
+    def _refund_rate_token(self, request: Request) -> None:
+        """A submit that passed the rate gate but was rejected DOWNSTREAM
+        (size cap, chaos, capacity, closed) returns its token — the
+        refusal must not also count against the tenant's rate budget."""
+        if self._scheduler is not None:
+            self._scheduler.refund(request)
+
     # -- pop side (the batcher, at shard-0 boundaries) ---------------------
 
     def pop_wave(self, max_requests: int) -> list[Request]:
-        """Up to ``max_requests`` non-expired requests in arrival order;
-        expired ones encountered on the way are evicted."""
+        """Up to ``max_requests`` non-expired requests — in arrival order
+        (FIFO), or by the attached scheduler's class-priority + tenant-DRR
+        policy (serve/sched; the pick is pure computation, safe under the
+        lock). Expired requests encountered on the way are evicted."""
         with self._lock:
             evicted = self._evict_expired_locked()
-            out: list[Request] = []
-            while self._items and len(out) < max_requests:
-                out.append(self._items.popleft())
+            if self._scheduler is not None:
+                out = self._scheduler.select(self._items, max_requests)
+            else:
+                out = []
+                while self._items and len(out) < max_requests:
+                    out.append(self._items.popleft())
             depth = len(self._items)
         self._finish_expired(evicted)
         if self._metrics is not None:
             self._metrics.gauge("queue_depth", depth)
         return out
+
+    def requeue(self, requests: list[Request]) -> None:
+        """Re-enqueue preempted requests at the FRONT of the queue, with
+        no capacity check: they held active-request slots a moment ago
+        (preemption must never convert held work into a QueueFull), and
+        front placement keeps them first among their class/tenant peers
+        so a resume never waits behind later arrivals. Allowed while
+        closed-for-drain — drain serves out everything queued, which now
+        includes the preempted work."""
+        if not requests:
+            return
+        with self._lock:
+            self._items.extendleft(reversed(requests))
+            depth = len(self._items)
+        if self._metrics is not None:
+            self._metrics.gauge("queue_depth", depth)
+
+    def has_waiting(self, slo_class: str) -> bool:
+        """Whether any LIVE queued request carries ``slo_class`` — the
+        scheduler's preemption trigger reads this at sweep boundaries.
+        Expired waiters don't count: lazy eviction only resolves them at
+        the next pop, and preempting a best-effort wave for a request
+        that is about to be evicted would shed real progress for a dead
+        one."""
+        now = time.monotonic()
+        with self._lock:
+            return any(
+                r.slo_class == slo_class and not r.expired(now)
+                for r in self._items
+            )
 
     def _evict_expired_locked(self) -> list[Request]:
         now = time.monotonic()

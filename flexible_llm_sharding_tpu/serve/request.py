@@ -199,6 +199,23 @@ class Request:
     # door would break both the shed contract ("in-flight keeps
     # serving") and exactly-once completion. Only the fleet sets this.
     shed_exempt: bool = False
+    # Multi-tenant scheduling (serve/sched/): the request's SLO class
+    # (validated against sched.classes.SLO_CLASSES at submit) and tenant.
+    # With the scheduler off both are inert labels; on, they drive strict
+    # class priority, per-tenant fair queueing/rate limits, and
+    # sweep-boundary preemption (docs/scheduling.md).
+    slo_class: str = "standard"
+    tenant_id: str = "default"
+    # Preemption resume state (engine-owned, serve/sched): per decode
+    # step already served before a sweep-boundary preemption, the
+    # [n_suffixes, vocab] score slice and [n_suffixes] picked-token ids.
+    # On re-admission the engine folds the tokens into the suffix ids
+    # (prefill recomputes their KV; token-id append semantics, exactly
+    # the offline kv_cache contract) and the final resolve stitches
+    # these in front of the post-resume steps — the caller sees one
+    # uninterrupted token stream.
+    resume_scores: list = dataclasses.field(default_factory=list, repr=False)
+    resume_tokens: list = dataclasses.field(default_factory=list, repr=False)
     request_id: int = dataclasses.field(
         default_factory=lambda: next(_REQUEST_IDS)
     )
@@ -214,6 +231,11 @@ class Request:
     @property
     def prompt(self) -> Prompt:
         return (self.prefix, self.suffixes)
+
+    @property
+    def resume_len(self) -> int:
+        """Tokens per suffix already served before preemption(s)."""
+        return len(self.resume_tokens)
 
     def expired(self, now: float | None = None) -> bool:
         return self.deadline is not None and (

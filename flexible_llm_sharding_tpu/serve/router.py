@@ -45,18 +45,25 @@ class Router:
         self.phase_weight = phase_weight
         self.depth_weight = depth_weight
 
-    def score(self, snapshot: dict) -> float:
+    def score(self, snapshot: dict, phase_bias: float = 1.0) -> float:
         """Dispatch cost of one replica snapshot (lower = better):
-        ``{"boundary_frac", "queue_depth", "active", "max_active"}``."""
+        ``{"boundary_frac", "queue_depth", "active", "max_active"}``.
+        ``phase_bias`` multiplies the phase term — the class-aware
+        dispatch hook (serve/sched): interactive requests weigh
+        boundary proximity harder, so they land on the replica whose
+        next shard-0 admission point is soonest even when a
+        farther-from-boundary replica is marginally less loaded."""
         load = (snapshot["queue_depth"] + snapshot["active"]) / max(
             snapshot.get("max_active", 1), 1
         )
         return (
-            self.phase_weight * snapshot["boundary_frac"]
+            self.phase_weight * phase_bias * snapshot["boundary_frac"]
             + self.depth_weight * load
         )
 
-    def pick(self, replicas: list[Any], exclude: Any = None):
+    def pick(
+        self, replicas: list[Any], exclude: Any = None, phase_bias: float = 1.0
+    ):
         """The healthiest serving replica for the next request, or None
         when none is serving (the fleet parks the request until one
         recovers). ``exclude`` — the replica a re-dispatched request just
@@ -69,7 +76,10 @@ class Router:
             candidates = [r for r in candidates if r is not exclude] or candidates
         if not candidates:
             return None
-        return min(candidates, key=lambda r: (self.score(r.snapshot()), r.idx))
+        return min(
+            candidates,
+            key=lambda r: (self.score(r.snapshot(), phase_bias), r.idx),
+        )
 
 
 __all__ = ["Router"]

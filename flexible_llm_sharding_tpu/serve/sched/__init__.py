@@ -1,0 +1,54 @@
+"""Multi-tenant sweep scheduler (docs/scheduling.md).
+
+The serving queue was a single FIFO with capacity backpressure: one batch
+tenant could starve every interactive request, and N requests sharing a
+system prompt each redundantly prefilled the same prefix KV. This package
+makes each sweep carry the *right* tokens:
+
+- ``classes``   — SLO classes (interactive / standard / best_effort)
+  carried on every ``Request``, per-class deadline defaults, and the
+  typed class-based rejection taxonomy (``RateLimited``,
+  ``UnknownSLOClass``).
+- ``scheduler`` — ``SweepScheduler``: strict priority across classes +
+  deficit-weighted round-robin across tenants within a class, per-tenant
+  token-bucket rate limits, and the sweep-boundary preemption decision
+  (an interactive arrival retires the youngest best-effort wave AT a
+  shard-0 boundary, never mid-sweep; the wave's requests resume
+  token-identically).
+- ``coalesce``  — admission-time prefix coalescing: same-tokenized-prefix
+  requests merge into one wave entry that prefills the shared prefix KV
+  once and fans the suffix/decode streams out per request — the paper's
+  own ``(prefix, suffixes)`` expansion generalized across requests.
+"""
+
+from flexible_llm_sharding_tpu.serve.sched.classes import (  # noqa: F401
+    BEST_EFFORT,
+    CLASS_RANK,
+    INTERACTIVE,
+    SLO_CLASSES,
+    STANDARD,
+    RateLimited,
+    UnknownSLOClass,
+    class_deadline_s,
+    parse_class,
+)
+from flexible_llm_sharding_tpu.serve.sched.coalesce import (  # noqa: F401
+    build_entries,
+)
+from flexible_llm_sharding_tpu.serve.sched.scheduler import (  # noqa: F401
+    SweepScheduler,
+)
+
+__all__ = [
+    "BEST_EFFORT",
+    "CLASS_RANK",
+    "INTERACTIVE",
+    "SLO_CLASSES",
+    "STANDARD",
+    "RateLimited",
+    "SweepScheduler",
+    "UnknownSLOClass",
+    "build_entries",
+    "class_deadline_s",
+    "parse_class",
+]

@@ -1,0 +1,95 @@
+"""SLO classes and the typed class-based rejection taxonomy.
+
+Three classes, in strict priority order (docs/scheduling.md):
+
+- ``interactive``  — latency-contract traffic (chat turns, completions a
+  human is waiting on). Admitted first at every boundary; may preempt
+  best-effort waves at a shard-0 boundary.
+- ``standard``     — the default for requests that name no class.
+- ``best_effort``  — batch/background traffic. Admitted only when no
+  higher class waits; its in-flight waves are the preemption victims.
+
+The class rides on ``Request.slo_class`` (a plain string, validated at
+submit by ``parse_class``) together with ``Request.tenant_id`` — the
+scheduler fair-queues across tenants *within* a class, never across
+classes. ``utils.metrics`` keeps a mirrored name tuple
+(``SLO_CLASS_NAMES``) for its per-class latency pre-seeding; it must not
+import this module (engine -> metrics -> serve would cycle), so the two
+tuples are kept in sync by ``tests/test_sched.py``.
+"""
+
+from __future__ import annotations
+
+from flexible_llm_sharding_tpu.serve.request import QueueFull
+
+INTERACTIVE = "interactive"
+STANDARD = "standard"
+BEST_EFFORT = "best_effort"
+
+# Strict priority order: lower rank admits first.
+SLO_CLASSES = (INTERACTIVE, STANDARD, BEST_EFFORT)
+CLASS_RANK = {c: i for i, c in enumerate(SLO_CLASSES)}
+
+
+class UnknownSLOClass(ValueError):
+    """Submit-side validation: the request named an SLO class outside the
+    taxonomy. Raised synchronously at ``submit`` (like a bad
+    ``max_new_tokens``) — an unknown class must fail the submitter
+    loudly, not silently serve at some default priority."""
+
+
+class RateLimited(QueueFull):
+    """Per-tenant token-bucket rejection (``SchedConfig.tenant_limits``):
+    the tenant submitted faster than its configured rate and the bucket
+    is empty. A ``QueueFull`` subclass — every existing backpressure
+    handler applies — that additionally carries ``retry_after_s`` (when
+    the bucket next refills one request) and ``tenant``, mirroring the
+    brownout ``Overloaded`` contract."""
+
+    def __init__(
+        self,
+        message: str,
+        retry_after_s: float | None = None,
+        tenant: str | None = None,
+    ):
+        super().__init__(message)
+        self.retry_after_s = retry_after_s
+        self.tenant = tenant
+
+
+def parse_class(name: str | None) -> str:
+    """Validate/default an SLO class name (None -> ``standard``)."""
+    if name is None:
+        return STANDARD
+    if name not in CLASS_RANK:
+        raise UnknownSLOClass(
+            f"unknown slo_class {name!r} (one of {', '.join(SLO_CLASSES)})"
+        )
+    return name
+
+
+def class_deadline_s(sched_cfg, slo_class: str) -> float | None:
+    """The class's default admission deadline in seconds, or None when
+    the scheduler is off / the class sets none (callers then fall back
+    to ``ServeConfig.default_deadline_s``)."""
+    if sched_cfg is None or not sched_cfg.enabled:
+        return None
+    v = {
+        INTERACTIVE: sched_cfg.interactive_deadline_s,
+        STANDARD: sched_cfg.standard_deadline_s,
+        BEST_EFFORT: sched_cfg.best_effort_deadline_s,
+    }.get(slo_class, 0.0)
+    return v if v > 0 else None
+
+
+__all__ = [
+    "BEST_EFFORT",
+    "CLASS_RANK",
+    "INTERACTIVE",
+    "SLO_CLASSES",
+    "STANDARD",
+    "RateLimited",
+    "UnknownSLOClass",
+    "class_deadline_s",
+    "parse_class",
+]

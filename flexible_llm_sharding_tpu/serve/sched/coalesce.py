@@ -1,0 +1,69 @@
+"""Admission-time prefix coalescing: one prefill for N same-prefix requests.
+
+The runtime's native prompt shape is ``(prefix, suffixes)``: one prompt's
+suffixes already share a single prefix-KV prefill (the paper's own
+workload shape, ``runtime/decode.py``). Production traffic has the same
+structure ACROSS requests — most requests share a system prompt — but
+each request used to prefill its own copy of that prefix KV. This module
+generalizes the expansion across requests: requests admitted at the same
+shard-0 boundary whose TOKENIZED prefix matches merge into one
+``WaveEntry`` whose suffix list is the concatenation of the members'
+suffixes. The engine then prefills the shared prefix KV **once** per
+entry and fans the suffix/decode streams out per request; at resolve
+time each request slices its own suffix rows back out
+(``WaveEntry.slices``). Numerics are untouched — suffix rows were always
+independent given the prefix KV, so a merged entry scores each suffix
+exactly as the per-request oracle does (asserted in
+``tests/test_sched.py``).
+
+Not coalesced: requests carrying preemption resume state (their suffixes
+are extended with generated-so-far tokens at wave init — entry-private
+by construction), and requests whose key_fn raises (tokenizer edge case:
+coalescing is an optimization, never a correctness gate).
+"""
+
+from __future__ import annotations
+
+from flexible_llm_sharding_tpu.serve.batcher import WaveEntry
+
+
+def build_entries(requests, key_fn) -> list[WaveEntry]:
+    """Group ``requests`` (one boundary's admission, order-preserving)
+    into wave entries by ``key_fn(prefix)`` — the engine supplies the
+    tokenized-prefix key, so two prefixes that tokenize identically
+    coalesce even if their strings differ (and truncation-equal prefixes
+    merge exactly when their token streams do)."""
+    groups: dict[object, list] = {}
+    order: list[object] = []
+    for i, r in enumerate(requests):
+        if r.resume_len:
+            key = ("resume", i)  # entry-private: suffixes get extended
+        else:
+            try:
+                key = ("prefix", key_fn(r.prefix))
+            except Exception:  # flscheck: disable=EXC-TAXONOMY: a key-fn (tokenizer) failure must degrade to no-coalescing — the wave-init taxonomy still rejects a genuinely malformed request with full context
+                key = ("solo", i)
+        if key not in groups:
+            groups[key] = []
+            order.append(key)
+        groups[key].append(r)
+    entries: list[WaveEntry] = []
+    for key in order:
+        members = groups[key]
+        suffixes: list[str] = []
+        slices: list[tuple[int, int]] = []
+        for r in members:
+            slices.append((len(suffixes), len(r.suffixes)))
+            suffixes.extend(r.suffixes)
+        entries.append(
+            WaveEntry(
+                requests=members,
+                prefix=members[0].prefix,
+                suffixes=tuple(suffixes),
+                slices=slices,
+            )
+        )
+    return entries
+
+
+__all__ = ["build_entries"]

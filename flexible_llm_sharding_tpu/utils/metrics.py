@@ -316,6 +316,14 @@ class IntegrityRecorder:
             return dict(self._counts)
 
 
+# SLO class names for the per-class latency breakdown — mirrored from
+# serve/sched/classes.py (importing it here would cycle: engine ->
+# metrics -> serve). tests/test_sched.py pins the two tuples in sync.
+# Pre-seeded so the fls_serve_ttft_by_class_* / latency_by_class_*
+# families are always scrapeable ("no samples yet" vs "not exported").
+SLO_CLASS_NAMES = ("interactive", "standard", "best_effort")
+
+
 # The stats-line / exposition merge policy for the serve registry's
 # WELL-KNOWN source names: these get the layout operators and CI greps
 # already depend on (nested-when-nonzero, top-level convenience keys);
@@ -429,6 +437,14 @@ class ServingMetrics:
         self._gauges: dict[str, float] = {}
         self._ttft: deque[float] = deque(maxlen=sample_window)
         self._token_lat: deque[float] = deque(maxlen=sample_window)
+        # Per-SLO-class breakdowns (serve/sched): TTFT and full request
+        # latency, same bounded-window semantics as the aggregate above.
+        self._ttft_class: dict[str, deque] = {
+            c: deque(maxlen=sample_window) for c in SLO_CLASS_NAMES
+        }
+        self._latency_class: dict[str, deque] = {
+            c: deque(maxlen=sample_window) for c in SLO_CLASS_NAMES
+        }
         self._last_emit = 0.0
         # Transient-I/O retry accounting for this engine's weight stream
         # (the engine threads it into its sources' loaders).
@@ -511,9 +527,29 @@ class ServingMetrics:
         with self._lock:
             self._gauges[name] = value
 
-    def observe_ttft(self, seconds: float) -> None:
+    def observe_ttft(self, seconds: float, slo_class: str | None = None) -> None:
+        from collections import deque
+
         with self._lock:
             self._ttft.append(seconds)
+            if slo_class is not None:
+                self._ttft_class.setdefault(
+                    slo_class, deque(maxlen=self._ttft.maxlen)
+                ).append(seconds)
+
+    def observe_request_latency(
+        self, seconds: float, slo_class: str | None = None
+    ) -> None:
+        """Full submit->completion latency, bucketed per SLO class — the
+        per-class half of the latency story (TTFT above is the other)."""
+        if slo_class is None:
+            return
+        from collections import deque
+
+        with self._lock:
+            self._latency_class.setdefault(
+                slo_class, deque(maxlen=self._ttft.maxlen)
+            ).append(seconds)
 
     def observe_token_latency(self, seconds: float) -> None:
         with self._lock:
@@ -532,6 +568,17 @@ class ServingMetrics:
                 **{k: v for k, v in sorted(self._gauges.items())},
                 "ttft_s": _latency_summary(list(self._ttft)),
                 "token_latency_s": _latency_summary(list(self._token_lat)),
+                # Per-SLO-class breakdowns (serve/sched): always present
+                # (classes pre-seeded) so the fls_serve_*_by_class_*
+                # families are scrapeable even before the first sample.
+                "ttft_by_class": {
+                    c: _latency_summary(list(d))
+                    for c, d in sorted(self._ttft_class.items())
+                },
+                "latency_by_class": {
+                    c: _latency_summary(list(d))
+                    for c, d in sorted(self._latency_class.items())
+                },
             }
 
     def snapshot(self) -> dict:

@@ -976,16 +976,23 @@ class _StubInitEngine:
         self._exc = exc
         self.metrics = ServingMetrics()
         self.batcher = types.SimpleNamespace(waves=[])
+        self._sched = None  # scheduler off: the FIFO/parity path
 
     def tokenizer(self, prefix, suffixes):
         raise self._exc
 
+    def _tokenize_entry(self, entry):
+        # The real method's failure surface: tokenization raising inside
+        # the _init_wave try block.
+        return self.tokenizer(entry.prefix, entry.suffixes)
+
 
 def _wave():
+    from flexible_llm_sharding_tpu.serve.batcher import Wave
     from flexible_llm_sharding_tpu.serve.request import Request
 
     req = Request(prefix="p", suffixes=("s",), max_new_tokens=1)
-    return types.SimpleNamespace(requests=[req], state=None, max_steps=2)
+    return Wave(requests=[req])
 
 
 def test_init_wave_workload_error_fails_only_the_wave():
@@ -1197,6 +1204,81 @@ def test_site_reg_pressure_sites_positive_and_negative(tmp_path):
     assert any(
         "'link_throttle' fired but not registered" in m
         for m in msgs(res3.findings, "SITE-REG")
+    )
+
+
+SCHED_COUNTER_MOD = """
+class SweepScheduler:
+    def __init__(self):
+        self.preemptions = 0
+        self.preempted_requests = 0
+        self.rate_limited = 0
+        self.coalesced_requests = 0
+        self.prefill_kv_bytes_saved = 0
+    def note_preempted(self, n):
+        self.preemptions += 1
+        self.preempted_requests += n
+    def admit_check(self):
+        self.rate_limited += 1
+    def note_coalesced(self, n, saved):
+        self.coalesced_requests += n
+        self.prefill_kv_bytes_saved += saved
+    def stats(self):
+        return {
+            "preemptions": self.preemptions,
+            "preempted_requests": self.preempted_requests,
+            "rate_limited": self.rate_limited,
+            "coalesced_requests": self.coalesced_requests,
+            "prefill_kv_bytes_saved": self.prefill_kv_bytes_saved,
+        }
+"""
+
+
+def test_counter_export_sched_family(tmp_path):
+    """The fls_sched_* counter family satisfies COUNTER-EXPORT: every
+    scheduler counter reaches its stats() export (positive), and
+    dropping one from the export is a finding again (negative) — the
+    regression this pins is a new scheduling counter added without
+    wiring it to the scrapeable surface."""
+    pkg = make_pkg(tmp_path, {"serve/sched/scheduler.py": SCHED_COUNTER_MOD})
+    res = run_pkg(pkg, select=["COUNTER-EXPORT"])
+    assert msgs(res.findings, "COUNTER-EXPORT") == []
+
+    broken = SCHED_COUNTER_MOD.replace(
+        '"preemptions": self.preemptions,\n', ""
+    )
+    pkg2 = make_pkg(
+        tmp_path, {"serve/sched/scheduler.py": broken}, name="sched_broken"
+    )
+    res2 = run_pkg(pkg2, select=["COUNTER-EXPORT"])
+    assert any(
+        "self.preemptions" in x for x in msgs(res2.findings, "COUNTER-EXPORT")
+    )
+
+
+def test_knob_sync_sched_flags_map_and_desync_fires(tmp_path):
+    """SchedConfig flags resolve through the sched_ prefix exactly like
+    pressure_ flags (serve-parser-only: SchedConfig is a serving
+    subsystem, so the both-parsers check exempts it): the real CLI is
+    clean, and renaming a sched flag in both the parser and nowhere else
+    while _sched_config_from_args still reads the old name trips the
+    rule (AttributeError-at-runtime class)."""
+    files = {
+        "cli.py": (PKG_DIR / "cli.py").read_text(),
+        "config.py": (PKG_DIR / "config.py").read_text(),
+    }
+    pkg = make_pkg(tmp_path, files, name="sched_clean")
+    res = run_pkg(pkg, select=["KNOB-SYNC"])
+    assert res.findings == [], [f.format() for f in res.findings]
+
+    desynced = dict(files)
+    desynced["cli.py"] = desynced["cli.py"].replace(
+        '"--sched_tenant_limits"', '"--sched_tenant_limitsx"'
+    )
+    pkg2 = make_pkg(tmp_path, desynced, name="sched_desynced")
+    res2 = run_pkg(pkg2, select=["KNOB-SYNC"])
+    assert any(
+        "sched_tenant_limits" in m for m in msgs(res2.findings, "KNOB-SYNC")
     )
 
 

@@ -269,7 +269,7 @@ def test_stats_line_layout_regression():
     m.count("admitted", 2)
     m.count("completed", 1)
     m.gauge("queue_depth", 5)
-    m.observe_ttft(0.25)
+    m.observe_ttft(0.25, "interactive")
     m.retries.record("shard_read", retries=1, backoff_s=0.05)
     m.integrity.count("reread_heals")
     m.host_cache = _FakeCache()
@@ -285,6 +285,17 @@ def test_stats_line_layout_regression():
     assert line["queue_depth"] == 5
     assert set(line["ttft_s"]) == {"count", "mean", "p50", "p95", "p99", "max"}
     assert line["token_latency_s"] == {"count": 0}
+    # Per-SLO-class breakdowns (serve/sched): the three classes are
+    # pre-seeded so "no samples yet" is scrapeable, and a class-tagged
+    # observation lands in its class summary as well as the aggregate.
+    for block in ("ttft_by_class", "latency_by_class"):
+        assert set(line[block]) == {"best_effort", "interactive", "standard"}
+    assert line["ttft_by_class"]["interactive"]["count"] == 1
+    assert set(line["ttft_by_class"]["interactive"]) == {
+        "count", "mean", "p50", "p95", "p99", "max",
+    }
+    assert line["ttft_by_class"]["standard"] == {"count": 0}
+    assert line["latency_by_class"]["best_effort"] == {"count": 0}
     assert line["io_retries"]["shard_read"]["retries"] == 1
     assert line["integrity"]["reread_heals"] == 1
     assert line["host_cache_hit_rate"] == 0.75
@@ -379,6 +390,11 @@ def test_serving_metrics_prometheus_has_full_counter_family():
     for key in ("engine_recoveries", "waves_aborted", "source_restarts",
                 "watchdog_stalls", "admitted"):
         assert f"fls_serve_{key} 0" in text
+    # Per-class latency families pre-seed too (serve/sched): a scrape
+    # can tell "no interactive traffic yet" from "not exported".
+    for cls in ("interactive", "standard", "best_effort"):
+        assert f"fls_serve_ttft_by_class_{cls}_count 0" in text
+        assert f"fls_serve_latency_by_class_{cls}_count 0" in text
 
 
 # ---------------------------------------------------------------------------

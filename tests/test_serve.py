@@ -129,6 +129,27 @@ def test_queue_deadline_eviction():
     assert len(q) == 0
 
 
+def test_queue_submit_evicts_expired_before_capacity_check():
+    """Regression: a live submit against a queue FULL of already-expired
+    waiters must not reject QueueFull while dead entries hold seats —
+    submit evicts expired requests first (their futures resolve
+    DeadlineExceeded), then judges capacity against the live depth."""
+    metrics = ServingMetrics()
+    q = AdmissionQueue(capacity=2, metrics=metrics)
+    dead = [_req(deadline=time.monotonic() + 0.01) for _ in range(2)]
+    for r in dead:
+        q.submit(r)
+    time.sleep(0.03)  # both queued entries expire in place
+    live = q.submit(_req())
+    assert live.status is RequestStatus.QUEUED, "dead entries held seats"
+    for r in dead:
+        assert r.status is RequestStatus.EXPIRED
+        with pytest.raises(DeadlineExceeded):
+            r.future.result(timeout=1)
+    assert metrics.counter("expired") == 2
+    assert q.pop_wave(8) == [live]
+
+
 def test_queue_drain_and_no_drain_shutdown():
     """close(drain=True) keeps queued requests for the engine to serve out;
     close(drain=False) cancels them (futures raise ServeClosed); either way
