@@ -118,6 +118,9 @@ HEADLINE_KEYS = (
     "spec_mechanism_speedup_n",
     "spec_acceptance",
     "spec_pairs",
+    "spec_serve_tokens_per_sweep",
+    "spec_serve_sweep_ratio",
+    "spec_serve_acceptance",
     "host_stream_zero_copy_warm_gbps",
     "host_stream_zero_copy_cold_gbps",
     "host_stream_cast_warm_gbps",
@@ -271,6 +274,9 @@ RATIO_SINGLETONS = (
     "partial_residency_speedup",
     "pinned_fraction",
     "trace_overhead_ratio",
+    "spec_serve_tokens_per_sweep",
+    "spec_serve_sweep_ratio",
+    "spec_serve_acceptance",
 )
 
 
@@ -334,6 +340,9 @@ PHASE_EVIDENCE_KEY = {
     "decode": "decode_speedup_4tok",
     "resident_mfu": "mfu_resident",
     "spec": "spec_mechanism_speedup",
+    # Speculation on the SERVING path (serve/engine.py): the structural
+    # tokens-per-sweep headline under a replay draft source.
+    "spec_serve": "spec_serve_tokens_per_sweep",
     # PR 8's satellite evidence: span tracing must not tax the hot path
     # (rotation-paired trace-on vs trace-off sweep walls).
     "trace_overhead": "trace_overhead_ratio",
@@ -1307,6 +1316,31 @@ def _set_throughput(result: dict, total_tokens: int, wall: float, dev) -> None:
             result["mfu"] = round(fpt * tps / peak_fl, 6)
 
 
+def _make_replay_draft(tok, prompt, chain):
+    """Replay draft source: propose the plain run's own greedy ``chain``
+    verbatim, making acceptance exactly 1.0 — the verification
+    mechanism's upper bound, isolated from draft quality. ``base_len``
+    mirrors the PromptTokenizer context layout (prefix ids incl. BOS +
+    suffix ids minus the shared leading BOS). ONE helper shared by
+    bench_spec (offline mechanism wall ratio) and bench_spec_serve
+    (serving tokens-per-sweep) so the done-offset arithmetic cannot
+    drift between the two phases."""
+    base_len = (
+        len(tok(prompt[0])["input_ids"])
+        + len(tok(prompt[1][0])["input_ids"])
+        - 1
+    )
+
+    def replay_draft(context_ids, k, ngram=2, corpus=None):
+        done = len(context_ids) - base_len  # tokens generated so far
+        d = list(chain[done : done + k])
+        while len(d) < k:
+            d.append(d[-1] if d else chain[-1])
+        return np.asarray(d, np.int64)
+
+    return replay_draft
+
+
 def bench_spec(cfg_obj, tok, result: dict, budget_left, n_tok: int = 8, k: int = 8) -> None:
     """Speculative streamed decode vs plain streamed decode.
     decode_resident='off' emulates the regime the mode exists for — a model
@@ -1365,17 +1399,7 @@ def bench_spec(cfg_obj, tok, result: dict, budget_left, n_tok: int = 8, k: int =
         s == prompts[0][1][0] for s in prompts[0][1]
     ), "replay draft source requires an all-identical spec workload"
     chain = [int(np.argmax(plain_scores[0][0, t])) for t in range(n_tok)]
-    base_ids = tok(prompts[0][0])["input_ids"] + tok(prompts[0][1][0])[
-        "input_ids"
-    ][1:]
-    base_len = len(base_ids)
-
-    def replay_draft(context_ids, kk):
-        done = len(context_ids) - base_len  # tokens generated so far
-        d = chain[done : done + kk]
-        while len(d) < kk:
-            d.append(d[-1] if d else chain[-1])
-        return np.asarray(d, np.int64)
+    replay_draft = _make_replay_draft(tok, prompts[0], chain)
 
     mech = DecodeGenerator(spec_cfg, tokenizer=tok, draft_fn=replay_draft)
     mech(prompts)  # warm/compile
@@ -1422,6 +1446,98 @@ def bench_spec(cfg_obj, tok, result: dict, budget_left, n_tok: int = 8, k: int =
         if budget_left() < 0.06:
             log("  spec pair budget exhausted; stopping reps")
             break
+
+
+def bench_spec_serve(
+    cfg_obj, tok, result: dict, budget_left, n_tok: int = 8, k: int = 7
+) -> None:
+    """Serve-level speculative headline: tokens per weight sweep.
+
+    Runs the SERVING engine (continuous batching, ServeConfig.
+    speculative_k, serve/engine.py) spec-off then spec-on on an identical
+    two-request wave, with a replay draft source (the spec-off run's own
+    greedy chain, monkey-installed over propose_draft) forcing acceptance
+    1.0 — the mechanism's upper bound isolated from draft quality,
+    exactly the spec_mechanism_speedup idea lifted to the serving path.
+    Token-identity between the two runs is asserted first, so the
+    numbers can never come from a diverged stream. Records:
+
+    - ``spec_serve_tokens_per_sweep``: tokens emitted / weight sweeps in
+      the spec-on run — the serving headline (plain serving is exactly 1
+      decode token per suffix per sweep plus the prefill sweep).
+    - ``spec_serve_sweep_ratio``: plain sweeps / spec sweeps on the SAME
+      workload — structural and timing-free (the pinned_fraction idea):
+      a lost mechanism collapses it to ~1.0, which no runner noise can
+      hide.
+    - ``spec_serve_acceptance``: accepted/drafted across the spec run.
+    """
+    import dataclasses
+
+    from flexible_llm_sharding_tpu.config import ServeConfig
+    from flexible_llm_sharding_tpu.runtime import decode as decode_mod
+    from flexible_llm_sharding_tpu.serve import ServeEngine
+
+    rng = np.random.default_rng(7)
+    words = [f"w{i}" for i in range(40)]
+    phrase = " ".join(rng.choice(words, size=12))
+    prompt = (f"{phrase} {phrase} {phrase}", (f" {phrase}",))
+    base = dataclasses.replace(cfg_obj, num_gen_token=n_tok)
+
+    def run(spec_k):
+        engine = ServeEngine(
+            base,
+            ServeConfig(
+                max_wave_requests=2,
+                default_max_new_tokens=n_tok,
+                speculative_k=spec_k,
+            ),
+            tokenizer=tok,
+            start=False,  # both requests admit at ONE boundary
+        )
+        try:
+            reqs = [engine.submit(*prompt) for _ in range(2)]
+            engine.start()
+            out = [r.future.result(timeout=600) for r in reqs]
+        finally:
+            engine.shutdown(drain=True)
+        if engine.error is not None:
+            raise RuntimeError(f"serve bench engine error: {engine.error!r}")
+        return out, engine.stats()
+
+    plain, plain_stats = run(0)
+    chain = [int(t) for t in plain[0].tokens[0]]
+    replay_draft = _make_replay_draft(tok, prompt, chain)
+
+    orig = decode_mod.propose_draft
+    decode_mod.propose_draft = replay_draft
+    try:
+        spec, spec_stats = run(k)
+    finally:
+        decode_mod.propose_draft = orig
+
+    for p, s in zip(plain, spec):
+        if not (p.tokens == s.tokens).all():
+            raise RuntimeError(
+                "spec-on serve run diverged from spec-off (greedy-exact "
+                "verification broken) — refusing to record its numbers"
+            )
+    tokens = spec_stats["tokens_emitted"]
+    result["spec_serve_tokens_per_sweep"] = round(
+        tokens / spec_stats["sweeps"], 3
+    )
+    result["spec_serve_sweep_ratio"] = round(
+        plain_stats["sweeps"] / spec_stats["sweeps"], 3
+    )
+    result["spec_serve_acceptance"] = spec_stats.get("spec", {}).get(
+        "acceptance_rate", 0.0
+    )
+    log(
+        f"spec serve: tokens_per_sweep={result['spec_serve_tokens_per_sweep']} "
+        f"sweep_ratio={result['spec_serve_sweep_ratio']} "
+        f"(plain {plain_stats['sweeps']} sweeps -> spec "
+        f"{spec_stats['sweeps']}) acceptance="
+        f"{result['spec_serve_acceptance']}"
+    )
 
 
 def run_bench(result: dict) -> None:
@@ -1721,6 +1837,13 @@ def run_bench(result: dict) -> None:
                 log("spec bench failed:\n" + traceback.format_exc())
         else:
             log("skipping spec bench (deadline budget exhausted)")
+        if budget_left() > 0.05:
+            try:
+                bench_spec_serve(fw(2), tok, result, budget_left)
+            except Exception:
+                log("spec serve bench failed:\n" + traceback.format_exc())
+        else:
+            log("skipping spec serve bench (deadline budget exhausted)")
         return
 
     # TPU-only phases from here (the early return above handled CPU), as
@@ -1830,6 +1953,15 @@ def run_bench(result: dict) -> None:
                 log("spec bench failed:\n" + traceback.format_exc())
         else:
             log("skipping spec bench (deadline budget exhausted)")
+        if "spec_serve" in skip:
+            log("skipping spec serve bench (already captured)")
+        elif budget_left() > 0.05:
+            try:
+                bench_spec_serve(fw(2), tok, result, budget_left)
+            except Exception:
+                log("spec serve bench failed:\n" + traceback.format_exc())
+        else:
+            log("skipping spec serve bench (deadline budget exhausted)")
 
     phases = [
         ("quant", quant_phase),

@@ -460,8 +460,16 @@ def knob_sync(ctx: ProjectContext) -> list[Finding]:
     serve_only = _module_str_set(cli.tree, "SERVE_ONLY_FLAGS")
     driver = _module_str_set(cli.tree, "DRIVER_FLAGS")
 
-    def map_flag(flag: str) -> tuple[str, str] | None:
-        """(config class, field) a flag sets, or None for driver flags."""
+    def map_flag(flag: str, parser_name: str = "batch") -> tuple[str, str] | None:
+        """(config class, field) a flag sets, or None for driver flags.
+
+        Parser-aware: one flag NAME may set different config classes per
+        parser (``--speculative_k`` is FrameworkConfig's offline-scorer
+        knob on the batch parser and ServeConfig's serving-speculation
+        knob on the serve parser), so the serve parser resolves
+        ServeConfig fields FIRST — a serve flag shadowed by a same-named
+        FrameworkConfig field would otherwise validate against the wrong
+        class and dodge the serve-side threading checks."""
         if flag in driver:
             return None
         if flag == "chaos":
@@ -480,6 +488,8 @@ def knob_sync(ctx: ProjectContext) -> list[Finding]:
             cls, field = _FLAG_ALIASES[flag]
             fields = sv if cls == "ServeConfig" else fw
             return (cls, field) if field in fields else ("?", flag)
+        if parser_name == "serve" and flag in sv:
+            return ("ServeConfig", flag)
         if flag in fw:
             return ("FrameworkConfig", flag)
         if flag in sv:
@@ -488,12 +498,12 @@ def knob_sync(ctx: ProjectContext) -> list[Finding]:
 
     # 1. Every flag maps to a real config field (or is a declared driver
     #    flag), and shared-runtime flags live in BOTH parsers.
-    for parser_name, parser, other, single_ok in (
-        ("batch", batch, serve, batch_only),
-        ("serve", serve, batch, serve_only),
+    for parser_name, parser, other, other_name, single_ok in (
+        ("batch", batch, serve, "serve", batch_only),
+        ("serve", serve, batch, "batch", serve_only),
     ):
         for flag, line in sorted(parser.items()):
-            mapped = map_flag(flag)
+            mapped = map_flag(flag, parser_name)
             if mapped is None:
                 continue
             cls, field = mapped
@@ -512,7 +522,12 @@ def knob_sync(ctx: ProjectContext) -> list[Finding]:
                 continue
             if cls in ("ServeConfig", "SchedConfig"):
                 continue  # serving knobs are inherently serve-parser-only
-            if flag not in other and flag not in single_ok:
+            # "Shared" means the OTHER parser's same-named flag sets the
+            # SAME field: a flag name reused for a different config class
+            # (serve --speculative_k -> ServeConfig) does not satisfy the
+            # both-parsers requirement for this parser's knob.
+            shared = flag in other and map_flag(flag, other_name) == mapped
+            if not shared and flag not in single_ok:
                 findings.append(
                     Finding(
                         "KNOB-SYNC",
@@ -527,10 +542,13 @@ def knob_sync(ctx: ProjectContext) -> list[Finding]:
                     )
                 )
 
-    # 2. Declared single-parser sets stay honest.
-    for declared, name, parser, other in (
-        (batch_only, "BATCH_ONLY_FLAGS", batch, serve),
-        (serve_only, "SERVE_ONLY_FLAGS", serve, batch),
+    # 2. Declared single-parser sets stay honest. A same-named flag in
+    #    the other parser only voids the declaration when it sets the
+    #    SAME config field — a reused name over a different class (the
+    #    batch/serve --speculative_k pair) keeps both declarations valid.
+    for declared, name, parser, parser_name, other, other_name in (
+        (batch_only, "BATCH_ONLY_FLAGS", batch, "batch", serve, "serve"),
+        (serve_only, "SERVE_ONLY_FLAGS", serve, "serve", batch, "batch"),
     ):
         for flag in sorted(declared):
             if flag not in parser:
@@ -544,7 +562,9 @@ def knob_sync(ctx: ProjectContext) -> list[Finding]:
                         symbol=name,
                     )
                 )
-            elif flag in other:
+            elif flag in other and map_flag(flag, other_name) == map_flag(
+                flag, parser_name
+            ):
                 findings.append(
                     Finding(
                         "KNOB-SYNC",
@@ -565,7 +585,7 @@ def knob_sync(ctx: ProjectContext) -> list[Finding]:
     ):
         read_here = {a for r in readers for a in reads.get(r, {})}
         for flag, line in sorted(parser.items()):
-            mapped = map_flag(flag)
+            mapped = map_flag(flag, parser_name)
             if mapped is None or mapped[0] == "?":
                 continue
             if flag not in read_here:

@@ -46,7 +46,13 @@ BATCH_ONLY_FLAGS = frozenset({
     # Sampling: serving is greedy-only for now (per-request rng streams
     # under sampling are future work; ServeEngine rejects temperature > 0).
     "temperature", "top_k", "top_p", "seed",
-    # KV-decode specials that don't compose with the sweep engine yet.
+    # KV-decode specials that don't compose with the sweep engine. NOTE:
+    # the serve parser ALSO defines --speculative_k, but that one sets
+    # ServeConfig.speculative_k (the serving-path speculation knob,
+    # docs/speculative.md) — this declaration covers the batch parser's
+    # FrameworkConfig.speculative_k (the offline scorer's knob); the two
+    # are distinct fields behind one flag name, and KNOB-SYNC resolves
+    # each parser's flag against its own config class.
     "decode_fused", "speculative_k",
     # Offline observability/profiling of a single run.
     "verbose_metrics", "profile_dir",
@@ -543,6 +549,15 @@ def build_serve_parser() -> argparse.ArgumentParser:
                         "max_new_tokens above this are rejected typed "
                         "(RequestTooLarge) at submit, before they can "
                         "join a wave and fail it at allocation; 0 = off")
+    p.add_argument("--speculative_k", type=int, default=0,
+                   help="speculative decoding on the serving path: each "
+                        "in-flight request drafts this many prompt-lookup "
+                        "tokens per sweep and the engine verifies all "
+                        "drafts batch-wide inside the SAME weight sweep "
+                        "(K+1-slot verify pass) — accepted drafts "
+                        "multiply tokens-per-sweep at no extra stream "
+                        "cost, and output stays token-identical to 0 "
+                        "(greedy-exact verification); 0 = off")
     _add_robustness_flags(p)
     _add_pressure_flags(p)
     _add_observability_flags(p)
@@ -607,6 +622,7 @@ def serve_main(argv: list[str] | None = None, tokenizer=None) -> None:
         router_health_poll_s=args.router_health_poll_s,
         router_drain_recoveries=args.router_drain_recoveries,
         max_request_tokens=args.max_request_tokens,
+        speculative_k=args.speculative_k,
         sched=_sched_config_from_args(args),
     )
     if tokenizer is None:

@@ -1380,6 +1380,19 @@ class ServeConfig:
     # the wave (where an oversized request's MemoryError previously
     # aborted the whole wave it joined). 0 = off.
     max_request_tokens: int = 0
+    # Speculative decoding on the serving path (docs/speculative.md):
+    # each in-flight request carries its own prompt-lookup draft stream,
+    # and every decode sweep verifies all drafts batch-wide in ONE
+    # K+1-slot pass (runtime/decode.SpecVerifier) — a sweep costs the
+    # same whether it advances each request by 1 token or by k accepted
+    # tokens, so acceptance multiplies tokens-per-sweep directly. Output
+    # stays greedy-exact (token-identical to speculative_k=0, which
+    # remains the default and the non-speculative fast path). Composes
+    # with sched preemption (draft state truncates to the resume
+    # watermark; resume tokens fold into the draft context), prefix
+    # coalescing (coalesced entries draft per-suffix), and the fleet
+    # (re-dispatch restarts generation, greedy-exact either way).
+    speculative_k: int = 0
     # Multi-tenant sweep scheduler (serve/sched/; --sched* flags): SLO
     # classes with strict priority + sweep-boundary preemption,
     # per-tenant fair queueing and rate limits, prefix coalescing. Off
@@ -1422,3 +1435,8 @@ class ServeConfig:
             raise ValueError("router_drain_recoveries must be >= 0 (0 = off)")
         if self.max_request_tokens < 0:
             raise ValueError("max_request_tokens must be >= 0 (0 = off)")
+        if not 0 <= self.speculative_k <= 64:
+            raise ValueError(
+                "ServeConfig.speculative_k must be in [0, 64], got "
+                f"{self.speculative_k}"
+            )

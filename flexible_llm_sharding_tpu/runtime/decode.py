@@ -265,7 +265,7 @@ def _spec_norm_head(cfg: LlamaConfig, norm_params, head_params, x):
     )
 
 
-def propose_draft(context_ids, k: int, ngram: int = 2):
+def propose_draft(context_ids, k: int, ngram: int = 2, corpus=None):
     """Prompt-lookup drafting (public technique — Saxena's prompt lookup
     decoding / HF assisted generation's n-gram candidate source): find the
     LAST earlier occurrence of the context's final n-gram and propose the
@@ -273,26 +273,289 @@ def propose_draft(context_ids, k: int, ngram: int = 2):
     quality rides the input-grounded nature of the workload (the reference's
     continuation-scoring prompts repeat prompt phrases constantly).
 
+    ``corpus`` (optional): extra id sequences to fall back to when the
+    request's own context has no match — the verifier passes the SIBLING
+    suffixes' contexts of the same prompt. The paper's workload scores
+    several continuations of one prefix, and their greedy chains converge
+    to the same attractor, so a cycle one suffix has already entered
+    predicts a sibling that is entering it — crucial when the model's
+    generated tokens never appear in the prompt itself (then self-lookup
+    has nothing to match until the suffix's OWN history repeats).
+    Soundness is free: verification is draft-agnostic, any source keeps
+    greedy-exact output and only changes acceptance.
+
     Returns EXACTLY ``k`` draft ids (the verify step needs static shapes);
     when no match or continuation exists it pads by repeating the last
     token — bad drafts cost nothing but rejected slots.
     """
     ids = np.asarray(context_ids, np.int64)
+    pools = [np.asarray(c, np.int64) for c in (corpus or ())]
     n = len(ids)
     draft: list[int] = []
     for g in range(min(ngram, n - 1), 0, -1):
         tail = ids[n - g :]
-        win = np.lib.stride_tricks.sliding_window_view(ids[: n - 1], g)
-        hits = np.flatnonzero((win == tail[None, :]).all(axis=1))
-        if len(hits):
-            start = int(hits[-1])
-            cont = ids[start + g : start + g + k]
-            if len(cont):
-                draft = [int(c) for c in cont]
+        # Own context first (most relevant), then each sibling pool. The
+        # own-context haystack excludes the tail's own position; a pool is
+        # a whole foreign sequence, so every window of it is "earlier".
+        for hay, pool in [(ids[: n - 1], ids)] + [(p, p) for p in pools]:
+            if len(hay) < g:
+                continue
+            win = np.lib.stride_tricks.sliding_window_view(hay, g)
+            hits = np.flatnonzero((win == tail[None, :]).all(axis=1))
+            # Last match with a nonempty continuation (a pool match at the
+            # pool's very end proposes nothing).
+            for start in hits[::-1]:
+                cont = pool[int(start) + g : int(start) + g + k]
+                if len(cont):
+                    draft = [int(c) for c in cont]
+                    break
+            if draft:
                 break
+        if draft:
+            break
     while len(draft) < k:
         draft.append(int(draft[-1] if draft else ids[-1]))
     return np.asarray(draft[:k], np.int64)
+
+
+def draft_contexts(tps, t0):
+    """[B][S] initial draft contexts for one block: real prefix + real
+    suffix + the first picked token, per tokenized prompt ``tps[r]`` and
+    prefill picks ``t0`` [B, S]. ONE construction rule shared by the
+    offline DecodeGenerator (one prompt per row) and the serving engine
+    (one wave entry per row; a resumed request's generated-so-far tokens
+    are already folded into its suffix ids, so they ride the context) —
+    the context contract cannot drift between the two paths."""
+    return [
+        [
+            np.concatenate(
+                [
+                    tp.prefix_ids[: tp.prefix_len],
+                    tp.suffix_ids[s][: int(tp.suffix_eos[s]) + 1],
+                    [int(t0[r, s])],
+                ]
+            )
+            for s in range(tp.suffix_ids.shape[0])
+        ]
+        for r, tp in enumerate(tps)
+    ]
+
+
+class SpecVerifier:
+    """The K+1-slot batch-verification state machine for ONE block — the
+    shared core of speculative decoding, used by the offline
+    ``DecodeGenerator`` loop and the serving engine's per-wave verify
+    passes (``serve/engine.py``).
+
+    Each pass feeds, per suffix, the last accepted token plus ``spec_k``
+    drafts through ONE weight sweep (``_spec_decoders`` +
+    ``_spec_norm_head``), then accepts the longest draft prefix matching
+    the greedy argmax chain and emits 1..K+1 tokens. Per-suffix
+    acceptance differs, so each suffix keeps its own generated-KV slot
+    clock (``g`` - 1 is the base offset the next pass writes from) —
+    the slot-clock drift the verify kernel vmaps over. Output is
+    greedy-exact: position j's argmax is exactly what sequential greedy
+    would emit after the accepted prefix, whatever the drafts were.
+
+    State per suffix: the emitted distribution/token histories (ragged —
+    suffixes advance at different rates), the draft context (prefix +
+    suffix + emitted ids; serve folds preemption-resume tokens into the
+    suffix ids BEFORE construction, so resumed work is never re-drafted
+    stale), and the per-suffix budget (total picks including the
+    prefill's). Inactive rows (bucket padding) are frozen at budget with
+    constant histories: they never gate ``done``, draft, or count stats.
+    """
+
+    def __init__(
+        self, spec_k: int, draft_fn, contexts, budgets, init_dist,
+        init_toks, active=None,
+    ):
+        # contexts: [B][S] int arrays, each ending with the first picked
+        # token; budgets: int [B, S]; init_dist: [B, S, V] float32 (the
+        # prefill head's distributions); init_toks: [B, S] picked ids;
+        # active: [B][S] bools (None = all rows real).
+        import inspect
+
+        self.k = spec_k
+        self._draft = draft_fn if draft_fn is not None else propose_draft
+        try:
+            self._corpus_ok = (
+                "corpus" in inspect.signature(self._draft).parameters
+            )
+        except (TypeError, ValueError):
+            self._corpus_ok = False
+        self.budgets = np.asarray(budgets, np.int64)
+        bsz, s_b = self.budgets.shape
+        self.active = (
+            np.asarray(active, bool)
+            if active is not None
+            else np.ones((bsz, s_b), bool)
+        )
+        self.ctx = [[np.asarray(contexts[r][s], np.int64) for s in range(s_b)]
+                    for r in range(bsz)]
+        self.g = np.ones((bsz, s_b), np.int64)
+        self.hist_d = [
+            [[init_dist[r, s]] for s in range(s_b)] for r in range(bsz)
+        ]
+        self.hist_t = [
+            [[int(init_toks[r, s])] for s in range(s_b)] for r in range(bsz)
+        ]
+        self.drafted = 0
+        self.accepted = 0
+        self.rejected = 0
+        self.passes = 0
+        for r in range(bsz):
+            for s in range(s_b):
+                if not self.active[r, s]:
+                    # Padding rows: frozen at budget with constant
+                    # histories (their text is discarded; the constant
+                    # fill keeps step-major reshapes rectangular).
+                    bud = int(self.budgets[r, s])
+                    self.g[r, s] = bud
+                    self.hist_d[r][s] = [init_dist[r, s]] * bud
+                    self.hist_t[r][s] = [int(init_toks[r, s])] * bud
+        self._fed = self._drafts = self._base = None
+
+    @property
+    def done(self) -> bool:
+        return bool((self.g >= self.budgets).all())
+
+    def emitted(self, r: int, s: int) -> int:
+        """Tokens emitted so far for one suffix (incl. the prefill's)."""
+        return int(self.g[r, s])
+
+    def stats(self) -> dict[str, int]:
+        """Draft-economy counters (the serve metrics' spec family reads
+        per-pass deltas; this snapshot serves tests/debugging)."""
+        return {
+            "passes": self.passes,
+            "drafted": self.drafted,
+            "accepted": self.accepted,
+            "rejected": self.rejected,
+        }
+
+    def begin_pass(self):
+        """Fix this pass's fed tokens and per-suffix slot offsets BEFORE
+        the weight sweep: (fed [B, S, K+1] int64, base [B, S] int32).
+        Per-request draft streams: each unfinished suffix drafts over its
+        own context via ``draft_fn``, with the sibling suffixes' contexts
+        as a fallback corpus when the draft source accepts one."""
+        k1 = self.k + 1
+        bsz, s_b = self.g.shape
+        fed = np.zeros((bsz, s_b, k1), np.int64)
+        drafts = np.zeros((bsz, s_b, self.k), np.int64)
+        for r in range(bsz):
+            for s in range(s_b):
+                fed[r, s, 0] = self.hist_t[r][s][-1]
+                # Draft only when an accepted token could still be
+                # emitted (remaining > 1): at remaining == 1 the pass
+                # emits exactly picks[0] whatever rides the draft slots.
+                if self.budgets[r, s] - self.g[r, s] > 1:
+                    if self._corpus_ok:
+                        sib = [
+                            self.ctx[r][j]
+                            for j in range(s_b)
+                            if j != s and self.active[r, j]
+                        ]
+                        drafts[r, s] = self._draft(
+                            self.ctx[r][s], self.k, corpus=sib
+                        )
+                    else:
+                        drafts[r, s] = self._draft(self.ctx[r][s], self.k)
+        fed[:, :, 1:] = drafts
+        self._fed, self._drafts = fed, drafts
+        self._base = (self.g - 1).astype(np.int32)
+        return fed, self._base
+
+    def finish_pass(self, dist: np.ndarray) -> np.ndarray:
+        """Accept against the verify head's ``dist`` [B, S, K+1, V]:
+        longest draft prefix matching the argmax chain, plus the one
+        token the pass always yields. Returns tokens emitted per suffix
+        this pass ([B, S] int). Stats count only USEFUL draft slots
+        (at most remaining-1 drafts can become emissions)."""
+        assert self._drafts is not None, "finish_pass without begin_pass"
+        self.passes += 1
+        picks = np.argmax(dist, axis=-1)  # [B, S, K+1]
+        bsz, s_b = self.g.shape
+        emitted = np.zeros((bsz, s_b), np.int64)
+        for r in range(bsz):
+            for s in range(s_b):
+                if self.g[r, s] >= self.budgets[r, s]:
+                    continue
+                a = 0
+                while (
+                    a < self.k
+                    and picks[r, s, a] == self._drafts[r, s, a]
+                ):
+                    a += 1
+                remaining = int(self.budgets[r, s] - self.g[r, s])
+                useful_k = min(self.k, remaining - 1)
+                acc = min(a, useful_k)
+                self.drafted += useful_k
+                self.accepted += acc
+                self.rejected += useful_k - acc
+                emit = int(min(a + 1, remaining))
+                for j in range(emit):
+                    # copy(): a bare dist[r, s, j] view would pin the
+                    # whole [B, S, K+1, V] pass tensor in the history for
+                    # the wave's lifetime — (K+1)x the plain path's score
+                    # retention per pass.
+                    self.hist_d[r][s].append(dist[r, s, j].copy())
+                    self.hist_t[r][s].append(int(picks[r, s, j]))
+                self.ctx[r][s] = np.concatenate(
+                    [self.ctx[r][s], picks[r, s, :emit]]
+                )
+                self.g[r, s] = min(
+                    self.g[r, s] + a + 1, self.budgets[r, s]
+                )
+                emitted[r, s] = emit
+        self._fed = self._drafts = self._base = None
+        return emitted
+
+    def request_steps(self, row: int, s_off: int, s_cnt: int, n_steps: int):
+        """Step-major history slices for ONE request's suffix span
+        ([s_cnt, V] scores and [s_cnt] int64 token rows per step) — the
+        serving engine's resolve/preemption-capture read path. Lives here
+        so the ragged-history layout is indexed in exactly one module."""
+        scores = [
+            np.stack(
+                [self.hist_d[row][s_off + s][t] for s in range(s_cnt)]
+            )
+            for t in range(n_steps)
+        ]
+        toks = [
+            np.asarray(
+                [self.hist_t[row][s_off + s][t] for s in range(s_cnt)],
+                np.int64,
+            )
+            for t in range(n_steps)
+        ]
+        return scores, toks
+
+    def step_major(self, n_steps: int):
+        """Re-shape the ragged histories into the step-major
+        ([B, S] per step) layout the offline output assembly expects —
+        every row must have reached ``n_steps`` emissions."""
+        bsz, s_b = self.g.shape
+        dists = [
+            np.stack(
+                [
+                    [self.hist_d[r][s][i] for s in range(s_b)]
+                    for r in range(bsz)
+                ]
+            )
+            for i in range(n_steps)
+        ]
+        toks = [
+            np.array(
+                [
+                    [self.hist_t[r][s][i] for s in range(s_b)]
+                    for r in range(bsz)
+                ]
+            )
+            for i in range(n_steps)
+        ]
+        return dists, toks
 
 
 # ---------------------------------------------------------------------------
@@ -944,70 +1207,35 @@ class DecodeGenerator:
                 # number of full weight streams per generated token drops by
                 # the acceptance factor. Greedy-exact: position j's argmax
                 # is precisely what sequential greedy would emit after the
-                # accepted prefix, so outputs equal plain KV decode.
-                k1 = spec_k + 1
-                g_state: dict[int, np.ndarray] = {}
-                hist_d: dict[int, list] = {}
-                hist_t: dict[int, list] = {}
-                ctx: dict[int, list] = {}
+                # accepted prefix, so outputs equal plain KV decode. The
+                # accept/draft/slot-clock machinery lives in SpecVerifier
+                # (one per block), shared verbatim with the serving engine.
+                verifiers: dict[int, SpecVerifier] = {}
                 for b, idxs in enumerate(blocks):
                     bsz = len(idxs)
                     s_b = toks[idxs[0]].suffix_ids.shape[0]
-                    # One token per suffix already picked (prefill's).
-                    g_state[b] = np.ones((bsz, s_b), np.int64)
                     d0, t0 = all_scores[b][0], tok_hist[b][0]
-                    hist_d[b] = [
-                        [[d0[r, s]] for s in range(s_b)] for r in range(bsz)
-                    ]
-                    hist_t[b] = [
-                        [[int(t0[r, s])] for s in range(s_b)]
-                        for r in range(bsz)
-                    ]
-                    # Draft context: real prefix + real suffix + history.
-                    ctx[b] = [
-                        [
-                            np.concatenate(
-                                [
-                                    toks[i].prefix_ids[: toks[i].prefix_len],
-                                    toks[i].suffix_ids[s][
-                                        : int(toks[i].suffix_eos[s]) + 1
-                                    ],
-                                    [int(t0[r, s])],
-                                ]
-                            )
-                            for s in range(s_b)
-                        ]
-                        for r, i in enumerate(idxs)
-                    ]
-                    # Bucket-padding rows: their text is discarded, so they
-                    # must neither gate the pass count nor pollute the
-                    # acceptance stats — frozen at done with a constant
-                    # history (their KV slot clock stays parked).
-                    for r, i in enumerate(idxs):
-                        for s in range(toks[i].num_suffixes, s_b):
-                            g_state[b][r, s] = n_gen
-                            hist_d[b][r][s] = [d0[r, s]] * n_gen
-                            hist_t[b][r][s] = [int(t0[r, s])] * n_gen
-                spec_passes = spec_drafted = spec_accepted = 0
-                while any(
-                    (g_state[b] < n_gen).any() for b in range(len(blocks))
-                ):
-                    # Fed tokens/drafts are fixed per pass BEFORE streaming.
-                    fed, drafts, base = {}, {}, {}
-                    for b in range(len(blocks)):
-                        bsz, s_b = g_state[b].shape
-                        f = np.zeros((bsz, s_b, k1), np.int64)
-                        d = np.zeros((bsz, s_b, spec_k), np.int64)
-                        for r in range(bsz):
-                            for s in range(s_b):
-                                f[r, s, 0] = hist_t[b][r][s][-1]
-                                if g_state[b][r, s] < n_gen:
-                                    d[r, s] = self._draft_fn(
-                                        ctx[b][r][s], spec_k
-                                    )
-                        f[:, :, 1:] = d
-                        fed[b], drafts[b] = f, d
-                        base[b] = (g_state[b] - 1).astype(np.int32)
+                    verifiers[b] = SpecVerifier(
+                        spec_k,
+                        self._draft_fn,
+                        draft_contexts([toks[i] for i in idxs], t0),
+                        np.full((bsz, s_b), n_gen, np.int64),
+                        d0,
+                        t0,
+                        active=[
+                            [s < toks[i].num_suffixes for s in range(s_b)]
+                            for i in idxs
+                        ],
+                    )
+                while any(not v.done for v in verifiers.values()):
+                    # Fed tokens/drafts are fixed per pass BEFORE streaming;
+                    # blocks whose rows all finished sit the pass out
+                    # (their state is frozen; recomputing them would only
+                    # burn chip time and head transfers).
+                    fed, base = {}, {}
+                    for b, v in verifiers.items():
+                        if not v.done:
+                            fed[b], base[b] = v.begin_pass()
                     head_dists: dict[int, np.ndarray] = {}
 
                     def spec_head(b, norm_p, head_p, x):
@@ -1026,78 +1254,25 @@ class DecodeGenerator:
                             pl, se, jnp.asarray(base[b]),
                         ),
                         spec_head,
-                        # Blocks whose rows all finished sit the pass out
-                        # (their state is frozen; recomputing them would
-                        # only burn chip time and head transfers).
-                        skip_block=lambda b: bool(
-                            (g_state[b] >= n_gen).all()
-                        ),
+                        skip_block=lambda b: b not in fed,
                     )
                     # Accept: longest draft prefix matching the argmax chain.
-                    spec_passes += 1
-                    for b in range(len(blocks)):
-                        if b not in head_dists:  # block sat this pass out
-                            continue
-                        dist = head_dists[b]  # [B, S, K+1, V]
-                        picks = np.argmax(dist, axis=-1)  # [B, S, K+1]
-                        bsz, s_b = g_state[b].shape
-                        for r in range(bsz):
-                            for s in range(s_b):
-                                if g_state[b][r, s] >= n_gen:
-                                    continue
-                                a = 0
-                                while (
-                                    a < spec_k
-                                    and picks[r, s, a] == drafts[b][r, s, a]
-                                ):
-                                    a += 1
-                                # Stats count only USEFUL draft slots: with
-                                # `remaining` tokens of budget, at most
-                                # remaining-1 drafts can turn into emissions
-                                # — charging all spec_k would understate the
-                                # acceptance the perf case rests on.
-                                remaining = int(n_gen - g_state[b][r, s])
-                                useful_k = min(spec_k, remaining - 1)
-                                spec_drafted += useful_k
-                                spec_accepted += min(a, useful_k)
-                                emit = int(min(a + 1, remaining))
-                                for j in range(emit):
-                                    hist_d[b][r][s].append(dist[r, s, j])
-                                    hist_t[b][r][s].append(
-                                        int(picks[r, s, j])
-                                    )
-                                ctx[b][r][s] = np.concatenate(
-                                    [ctx[b][r][s], picks[r, s, :emit]]
-                                )
-                                g_state[b][r, s] = min(
-                                    g_state[b][r, s] + a + 1, n_gen
-                                )
+                    for b, dist in head_dists.items():
+                        verifiers[b].finish_pass(dist)
                 # Re-shape the ragged per-suffix histories into the common
                 # step-major [B, S] layout the output assembly expects.
-                for b in range(len(blocks)):
-                    bsz, s_b = g_state[b].shape
-                    all_scores[b] = [
-                        np.stack(
-                            [
-                                [hist_d[b][r][s][i] for s in range(s_b)]
-                                for r in range(bsz)
-                            ]
-                        )
-                        for i in range(n_gen)
-                    ]
-                    tok_hist[b] = [
-                        np.array(
-                            [
-                                [hist_t[b][r][s][i] for s in range(s_b)]
-                                for r in range(bsz)
-                            ]
-                        )
-                        for i in range(n_gen)
-                    ]
+                for b, v in verifiers.items():
+                    all_scores[b], tok_hist[b] = v.step_major(n_gen)
                 spec_stats = {
-                    "spec_passes": float(spec_passes),
-                    "spec_drafted": float(spec_drafted),
-                    "spec_accepted": float(spec_accepted),
+                    "spec_passes": float(
+                        max(v.passes for v in verifiers.values())
+                    ),
+                    "spec_drafted": float(
+                        sum(v.drafted for v in verifiers.values())
+                    ),
+                    "spec_accepted": float(
+                        sum(v.accepted for v in verifiers.values())
+                    ),
                 }
             # --- decode steps: stream weights, one token per suffix ------
             for t in ([] if fused or speculative else range(n_gen - 1)):
@@ -1166,7 +1341,10 @@ class DecodeGenerator:
 __all__ = [
     "DecodeGenerator",
     "KVStore",
+    "SpecVerifier",
     "block_kv_bytes",
+    "draft_contexts",
     "extend_gen_kv",
     "kv_fits_on_chip",
+    "propose_draft",
 ]

@@ -53,15 +53,20 @@ class Wave:
     """One prefill cohort: requests admitted together at a shard-0 boundary.
 
     The wave's first sweep runs its prefill segments (capturing KV and the
-    first token); every later sweep runs one decode step against that KV.
-    The engine owns the compute state (``state``); the batcher owns
-    membership and retirement. ``entries`` (None -> one entry per
-    request) is the prefill structure: prefix-coalesced groups share one
-    entry."""
+    first token); every later sweep runs one decode step against that KV —
+    or, under ``ServeConfig.speculative_k``, one K+1-slot batch verify
+    pass that advances each suffix by 1..K+1 accepted tokens
+    (docs/speculative.md). The engine owns the compute state (``state``);
+    the batcher owns membership and retirement. ``entries`` (None -> one
+    entry per request) is the prefill structure: prefix-coalesced groups
+    share one entry."""
 
     requests: list[Request]
     wave_id: int = field(default_factory=lambda: next(_WAVE_IDS))
-    steps: int = 0  # tokens picked per suffix so far (1 after prefill)
+    # Sweeps this wave has run (1 after prefill). On the plain path this
+    # IS each suffix's token count and decode slot clock; a speculative
+    # wave's per-suffix clocks live in its SpecVerifiers instead.
+    steps: int = 0
     state: Any = None  # engine-private compute state (_WaveState)
     entries: list[WaveEntry] | None = None
 

@@ -23,9 +23,18 @@ weight source restarts and the loop keeps serving the queue. Anything
 else stays engine-fatal (every future resolves with the root cause and
 the loop stops).
 
+Speculative serving (``ServeConfig.speculative_k`` > 0,
+docs/speculative.md): each in-flight request carries its own prompt-lookup
+draft stream over its accepted context, and every decode sweep becomes ONE
+K+1-slot batch verify pass (``runtime/decode.SpecVerifier`` — the same
+core the offline scorer uses), emitting 1..K+1 tokens per suffix per
+sweep. Per-suffix acceptance differs, so per-suffix KV slot clocks drift
+exactly as the offline path handles; output stays greedy-exact
+(token-identical to ``speculative_k=0``, which remains the default).
+
 Serving scope (v1, loud rejects): single placement target, greedy
 selection (per-request rng streams under sampling are future work), no
-speculative passes, no long-context routing.
+long-context routing.
 """
 
 from __future__ import annotations
@@ -50,9 +59,13 @@ from flexible_llm_sharding_tpu.obs import trace as obs_trace
 from flexible_llm_sharding_tpu.parallel.planner import plan_shards_dp
 from flexible_llm_sharding_tpu.runtime.decode import (
     KVStore,
+    SpecVerifier,
     _decode_decoders,
     _decode_norm_head,
     _prefill_decoders,
+    _spec_decoders,
+    _spec_norm_head,
+    draft_contexts,
     extend_gen_kv,
     kv_fits_on_chip,
 )
@@ -105,6 +118,15 @@ class _WaveState:
     loc: dict[int, tuple[int, int]]  # wave-entry index -> (block, row)
     slots: int
     norm_p: Any = None  # per-sweep: norm params ride shard->head shard
+    # Speculative serving (ServeConfig.speculative_k > 0 and the wave
+    # decodes at all): one SpecVerifier per block — per-request draft
+    # streams, ragged per-suffix histories, per-suffix KV slot clocks.
+    # None = the wave decodes plain (the default path, and waves whose
+    # budget ends at prefill).
+    spec: dict[int, SpecVerifier] | None = None
+    # Per-sweep slot offsets fixed at the embed segment (shard 0) and
+    # consumed by every decoder segment of the same sweep.
+    spec_base: dict[int, np.ndarray] = dataclasses.field(default_factory=dict)
 
 
 class ServeEngine:
@@ -135,7 +157,11 @@ class ServeEngine:
                 "under sampling are future work); set temperature=0"
             )
         if cfg.speculative_k:
-            raise ValueError("speculative_k does not compose with serving")
+            raise ValueError(
+                "FrameworkConfig.speculative_k is the OFFLINE scorer's "
+                "knob; serving speculation is ServeConfig.speculative_k "
+                "(--speculative_k on the serve parser)"
+            )
         if cfg.long_context:
             raise ValueError("long_context serving is not supported yet")
         if cfg.data_parallel or cfg.tensor_parallel > 1:
@@ -145,6 +171,9 @@ class ServeEngine:
             )
         self.cfg = cfg
         self.serve_cfg = serve_cfg or ServeConfig()
+        # Speculative serving: 0 keeps the plain one-token-per-sweep
+        # decode (the parity baseline every spec test pins against).
+        self._spec_k = self.serve_cfg.speculative_k
         self.device = device
         self.model_cfg = LlamaConfig.from_pretrained(cfg.model_path)
         self.dtype = _DTYPES[cfg.dtype]
@@ -749,13 +778,25 @@ class ServeEngine:
                 # Steps THIS wave served it (a twice-preempted request's
                 # earlier tokens are already in its resume lists).
                 done_here = r.tokens_emitted - r.resume_len
-                for t in range(max(done_here, 0)):
-                    r.resume_scores.append(
-                        st.scores[b][t][row, s_off : s_off + s_cnt].copy()
+                if st.spec is not None:
+                    # Speculative wave: capture up to the request's
+                    # SLOWEST suffix (tokens_emitted is that watermark).
+                    # A suffix that ran ahead on accepted drafts drops
+                    # its surplus — verification is greedy-exact, so the
+                    # resumed wave re-derives the identical tokens.
+                    sc, tk = st.spec[b].request_steps(
+                        row, s_off, s_cnt, max(done_here, 0)
                     )
-                    r.resume_tokens.append(
-                        st.tok_hist[b][t][row, s_off : s_off + s_cnt].copy()
-                    )
+                    r.resume_scores.extend(sc)
+                    r.resume_tokens.extend(tk)
+                else:
+                    for t in range(max(done_here, 0)):
+                        r.resume_scores.append(
+                            st.scores[b][t][row, s_off : s_off + s_cnt].copy()
+                        )
+                        r.resume_tokens.append(
+                            st.tok_hist[b][t][row, s_off : s_off + s_cnt].copy()
+                        )
             if r.first_token_at is not None:
                 # The admission deadline guards TIME TO FIRST TOKEN; once
                 # the first token is out, expiring the request while it
@@ -781,10 +822,17 @@ class ServeEngine:
         workload (e.g. a longrope regime straddle) fails ONLY this
         wave's requests; the engine keeps serving."""
         entries = wave.ensure_entries()
+        # Speculative waves only where there is decode to amortize: a
+        # wave whose whole budget is the prefill pick never drafts.
+        spec_wave = self._spec_k > 0 and wave.max_steps > 1
         try:
             toks = [self._tokenize_entry(e) for e in entries]
+            # A speculative pass's fixed-width K+1 window can overshoot
+            # the budget by spec_k fed positions (offline precedent).
             check_longrope_regime(
-                self.model_cfg, toks, extra_len=max(wave.max_steps - 1, 0)
+                self.model_cfg, toks,
+                extra_len=max(wave.max_steps - 1, 0)
+                + (self._spec_k if spec_wave else 0),
             )
             if self._sched is not None:
                 for e, tp in zip(entries, toks):
@@ -820,7 +868,15 @@ class ServeEngine:
                 for b, idxs in enumerate(blocks)
                 for row, i in enumerate(idxs)
             }
-            slots = max(1, wave.max_steps - 1)
+            # Generated-KV slots: plain decode fills one slot per sweep; a
+            # speculative pass writes K+1 slots at per-suffix offsets
+            # capped at max_steps-1, so the last write touches slot
+            # max_steps-1+K (the offline gen_slots arithmetic).
+            slots = (
+                wave.max_steps + self._spec_k
+                if spec_wave
+                else max(1, wave.max_steps - 1)
+            )
             # Same KV placement rule as the offline path: KV follows the
             # weights onto the chip when they are resident and the wave's
             # KV fits beside them — host-parked KV costs a full round trip
@@ -916,6 +972,16 @@ class ServeEngine:
                             shard_idx=shard_pos, wave_id=wave.wave_id,
                         ):
                             self._prefill_shard(
+                                wave, shard_pos, layer_idxs, segments
+                            )
+                    elif wave.state.spec is not None:
+                        # Speculative wave: this sweep is one K+1-slot
+                        # batch verify pass instead of a 1-token step.
+                        with obs_trace.span(
+                            "decode_shard", cat="serve", sweep_id=sweep_id,
+                            shard_idx=shard_pos, wave_id=wave.wave_id,
+                        ):
+                            self._spec_decode_shard(
                                 wave, shard_pos, layer_idxs, segments
                             )
                     else:
@@ -1035,6 +1101,128 @@ class ServeEngine:
             if layer_idxs[-1] != self._n_layers - 1:
                 st.kv_store.put(("x", b), x)
 
+    def _init_spec(self, wave) -> None:
+        """Arm a freshly prefilled wave's speculative state: one
+        SpecVerifier per block, seeded from the prefill's distributions
+        and picks. Per-suffix draft contexts are prefix + suffix + first
+        pick — a preemption-resumed request's generated-so-far tokens are
+        already folded INTO its suffix ids (``_tokenize_entry``), so
+        resume work rides the draft context and is never re-drafted
+        stale; a coalesced entry's suffix rows span several requests but
+        share the prefix, and each drafts per-suffix over its own row.
+        Per-suffix budgets come from the OWNING request (mixed budgets in
+        one wave finish early per request, exactly like the plain path)."""
+        st: _WaveState = wave.state
+        st.spec = {}
+        for b, idxs in enumerate(st.blocks):
+            bsz = len(idxs)
+            s_b = st.toks[idxs[0]].suffix_ids.shape[0]
+            budgets = np.ones((bsz, s_b), np.int64)
+            active = np.zeros((bsz, s_b), bool)
+            for row, e_idx in enumerate(idxs):
+                e = wave.entries[e_idx]
+                for (off, cnt), member in zip(e.slices, e.requests):
+                    budgets[row, off : off + cnt] = (
+                        member.max_new_tokens - member.resume_len
+                    )
+                    active[row, off : off + cnt] = True
+            # Padding rows: budget 1 (frozen immediately; their constant
+            # history fill stays minimal).
+            d0, t0 = st.scores[b][0], st.tok_hist[b][0]
+            st.spec[b] = SpecVerifier(
+                self._spec_k,
+                None,
+                draft_contexts([st.toks[i] for i in idxs], t0),
+                budgets,
+                d0,
+                t0,
+                active=active,
+            )
+
+    def _spec_decode_shard(self, wave, shard_pos, layer_idxs, segments) -> None:
+        """One shard of a speculative verify pass: embed the per-suffix
+        (last accepted + K drafts) windows, run the K+1-token decode scan
+        at per-suffix slot offsets, and at the head accept the longest
+        matching draft prefix — all inside the SAME weight sweep the
+        other waves' prefill/decode segments ride."""
+        st: _WaveState = wave.state
+        act_dev = self._act_dev()
+        for b in range(len(st.blocks)):
+            v = st.spec[b]
+            # Finished blocks sit the sweep out: every suffix at budget,
+            # or every owning request already terminal.
+            if v.done or all(
+                r.status.terminal
+                for i in st.blocks[b]
+                for r in wave.entries[i].requests
+            ):
+                continue
+            _, _, prefix_len, suffix_eos = st.meta[b]
+            x = (
+                None
+                if layer_idxs[0] == 0
+                else st.kv_store.get(("x", b), act_dev)
+            )
+            di = 0
+            for kind, params in segments:
+                if kind == "embed":
+                    # Drafts are fixed per pass BEFORE the sweep's
+                    # decoders run; base rides wave state to every
+                    # decoder segment of this sweep.
+                    fed, base = v.begin_pass()
+                    st.spec_base[b] = base
+                    obs_trace.instant(
+                        "spec_draft", cat="spec", wave_id=wave.wave_id,
+                        # Suffixes that DRAFTED this pass (begin_pass
+                        # skips remaining==1), matching spec_verify's
+                        # drafted accounting.
+                        block=b, drafted=int((v.budgets - v.g > 1).sum()),
+                    )
+                    x = llama.embed(
+                        params,
+                        jnp.asarray(fed, jnp.int32),
+                        self.dtype,
+                        self.model_cfg,
+                    )
+                elif kind == "decoders":
+                    kv = st.kv_store.get(("kv", shard_pos, di, b), act_dev)
+                    x, kv = _spec_decoders(
+                        self.model_cfg, None, params, kv, x,
+                        prefix_len, suffix_eos,
+                        jnp.asarray(st.spec_base[b]),
+                    )
+                    st.kv_store.put(("kv", shard_pos, di, b), kv)
+                    di += 1
+                elif kind == "norm":
+                    st.norm_p = params  # applied in the head shard
+                else:  # head
+                    assert st.norm_p is not None
+                    dist = np.asarray(
+                        jax.device_get(
+                            _spec_norm_head(
+                                self.model_cfg,
+                                jax.device_put(st.norm_p, act_dev),
+                                params,
+                                x,
+                            )
+                        )
+                    )
+                    before = (v.drafted, v.accepted, v.rejected)
+                    emitted = v.finish_pass(dist)
+                    d_draft = v.drafted - before[0]
+                    d_acc = v.accepted - before[1]
+                    d_rej = v.rejected - before[2]
+                    self.metrics.spec_count(
+                        drafted=d_draft, accepted=d_acc, rejected=d_rej
+                    )
+                    obs_trace.instant(
+                        "spec_verify", cat="spec", wave_id=wave.wave_id,
+                        block=b, accepted=int(d_acc), drafted=int(d_draft),
+                        emitted=int(emitted.sum()),
+                    )
+            if layer_idxs[-1] != self._n_layers - 1:
+                st.kv_store.put(("x", b), x)
+
     # -- post-sweep bookkeeping --------------------------------------------
 
     def _post_sweep(self, sweep_wall_s: float) -> None:
@@ -1045,6 +1233,11 @@ class ServeEngine:
             wave.steps += 1
             if prefilled:
                 self.metrics.count("prefills")
+                if self._spec_k > 0 and wave.max_steps > 1:
+                    # Arm the verify passes off the prefill's picks; the
+                    # next sweep for this wave is a draft+verify pass.
+                    self._init_spec(wave)
+            st = wave.state
             for r in wave.requests:
                 if r.status.terminal:
                     continue
@@ -1056,7 +1249,26 @@ class ServeEngine:
                         request_id=r.request_id,
                         seconds=round(now - r.arrival, 6),
                     )
-                if r.tokens_emitted < r.max_new_tokens:
+                if st is not None and st.spec is not None:
+                    # Speculative wave: a sweep advances each suffix by
+                    # 1..K+1 accepted tokens; the REQUEST's progress is
+                    # the slowest of its suffix rows (the result shape is
+                    # rectangular per request). An accepted run that
+                    # crosses max_new_tokens finishes the request early —
+                    # the cap below discards nothing (the verifier stops
+                    # emitting at each suffix's own budget).
+                    e_idx, s_off, s_cnt = wave.locate(r)
+                    b, row = st.loc[e_idx]
+                    v = st.spec[b]
+                    prog = min(
+                        v.emitted(row, s_off + s) for s in range(s_cnt)
+                    )
+                    new_total = min(
+                        r.resume_len + prog, r.max_new_tokens
+                    )
+                    emitted += max(new_total - r.tokens_emitted, 0)
+                    r.tokens_emitted = new_total
+                elif r.tokens_emitted < r.max_new_tokens:
                     r.tokens_emitted += 1
                     emitted += 1
                 if r.tokens_emitted >= r.max_new_tokens:
@@ -1082,12 +1294,21 @@ class ServeEngine:
         # so the caller sees one uninterrupted [n_suffixes, n, vocab]
         # stream regardless of how many boundaries interrupted it.
         rem = r.max_new_tokens - r.resume_len
-        step_scores = list(r.resume_scores) + [
-            st.scores[b][t][row, s_off : s_off + s_cnt] for t in range(rem)
-        ]
-        step_tokens = list(r.resume_tokens) + [
-            st.tok_hist[b][t][row, s_off : s_off + s_cnt] for t in range(rem)
-        ]
+        if st.spec is not None:
+            # Speculative wave: histories are ragged per suffix inside
+            # the verifier; re-slice this request's rows step-major.
+            sc, tk = st.spec[b].request_steps(row, s_off, s_cnt, rem)
+            step_scores = list(r.resume_scores) + sc
+            step_tokens = list(r.resume_tokens) + tk
+        else:
+            step_scores = list(r.resume_scores) + [
+                st.scores[b][t][row, s_off : s_off + s_cnt]
+                for t in range(rem)
+            ]
+            step_tokens = list(r.resume_tokens) + [
+                st.tok_hist[b][t][row, s_off : s_off + s_cnt]
+                for t in range(rem)
+            ]
         n = r.max_new_tokens
         scores = np.stack(step_scores, axis=1)
         tokens = np.stack(step_tokens, axis=1)

@@ -225,6 +225,12 @@ class Request:
     admitted_at: float | None = None
     first_token_at: float | None = None
     finished_at: float | None = None
+    # Tokens served per suffix so far (incl. resume_len): +1 per sweep on
+    # the plain decode path; on the speculative path (ServeConfig.
+    # speculative_k, docs/speculative.md) a sweep advances it by the
+    # request's SLOWEST suffix's accepted count — it is the watermark
+    # preemption capture truncates to (ahead-suffix surplus re-derives
+    # greedy-exactly after resume) and the completion check reads.
     tokens_emitted: int = 0
     future: ServeFuture = dataclasses.field(default_factory=ServeFuture)
 

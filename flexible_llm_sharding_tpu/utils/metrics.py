@@ -453,6 +453,17 @@ class ServingMetrics:
         # quarantines) for the same stream — nonzero counters appear in
         # the stats line under "integrity".
         self.integrity = IntegrityRecorder()
+        # Speculative-serving draft economy (serve/engine.py spec path):
+        # pre-seeded so the fls_spec_* family is always scrapeable —
+        # "zero drafts" vs "spec not exported" — and registered as its
+        # OWN source so the exposition names are fls_spec_drafted_tokens
+        # / fls_spec_accepted_tokens / fls_spec_rejected_tokens plus the
+        # derived acceptance_rate and extra_tokens_per_sweep.
+        self._spec: dict[str, int] = {
+            "drafted_tokens": 0,
+            "accepted_tokens": 0,
+            "rejected_tokens": 0,
+        }
         self.registry = MetricsRegistry()
         self._host_cache = None
         self._residency = None
@@ -463,6 +474,7 @@ class ServingMetrics:
         self.register("serve", self._core_snapshot)
         self.register("io_retries", self.retries.snapshot)
         self.register("integrity", self.integrity.snapshot)
+        self.register("spec", self.spec_snapshot)
 
     def register(self, name: str, source, mirror: bool = True) -> None:
         """Register a source into this engine's registry and (for
@@ -554,6 +566,36 @@ class ServingMetrics:
     def observe_token_latency(self, seconds: float) -> None:
         with self._lock:
             self._token_lat.append(seconds)
+
+    def spec_count(
+        self, drafted: int = 0, accepted: int = 0, rejected: int = 0
+    ) -> None:
+        """One verify pass's draft economy (serve/engine.py spec path):
+        USEFUL drafted slots, accepted, rejected — drafted == accepted +
+        rejected by construction (SpecVerifier.finish_pass)."""
+        with self._lock:
+            self._spec["drafted_tokens"] += drafted
+            self._spec["accepted_tokens"] += accepted
+            self._spec["rejected_tokens"] += rejected
+
+    def spec_snapshot(self) -> dict:
+        """The ``spec`` registry source: raw counters + the two derived
+        headline figures — acceptance rate (accepted / drafted) and extra
+        tokens per sweep (accepted / sweeps: how many tokens beyond the
+        baseline one-per-sweep each weight sweep bought)."""
+        with self._lock:
+            drafted = self._spec["drafted_tokens"]
+            accepted = self._spec["accepted_tokens"]
+            sweeps = self._counters.get("sweeps", 0)
+            return {
+                **self._spec,
+                "acceptance_rate": round(accepted / drafted, 4)
+                if drafted
+                else 0.0,
+                "extra_tokens_per_sweep": round(accepted / sweeps, 4)
+                if sweeps
+                else 0.0,
+            }
 
     def counter(self, name: str) -> int:
         with self._lock:

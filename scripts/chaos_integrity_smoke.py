@@ -15,8 +15,11 @@ Run from the repo root: ``python scripts/chaos_integrity_smoke.py``.
 
 import json
 import os
+import re
 import sys
 import tempfile
+import time
+import urllib.request
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -129,9 +132,6 @@ def main() -> int:
     try:
         reqs = [engine.submit(p, s) for p, s in PROMPTS]
         results = [r.future.result(timeout=600) for r in reqs]
-        import re
-        import urllib.request
-
         port = engine.metrics_server.port
         exposition = urllib.request.urlopen(
             f"http://127.0.0.1:{port}/metrics", timeout=30
@@ -237,9 +237,18 @@ def main() -> int:
     try:
         reqs = [fleet.submit(p, s) for p, s in PROMPTS]
         results = [r.future.result(timeout=600) for r in reqs]
-        import re
-        import urllib.request
-
+        # The death/recycle counters are EVENTUALLY consistent: the
+        # request-callback path can re-dispatch a dead replica's orphans
+        # (and complete them, warm) before the health monitor's next poll
+        # ever observes the engine-fatal error — shutting down in that
+        # window read replicas_dead=0 and flaked this phase. Wait
+        # (bounded) for the monitor to register the death it WILL see.
+        deadline = time.monotonic() + 60
+        while (
+            fleet.metrics.counter("replicas_dead") < 1
+            or fleet.metrics.counter("replicas_recycled") < 1
+        ) and time.monotonic() < deadline:
+            time.sleep(0.05)
         port = fleet.metrics_server.port
         exposition = urllib.request.urlopen(
             f"http://127.0.0.1:{port}/metrics", timeout=30
@@ -281,8 +290,6 @@ def main() -> int:
     # 5) Resource-pressure brownout (runtime/pressure.py): the process
     # must DEGRADE under injected resource exhaustion, not die, and the
     # degradation must REVERSE once pressure lifts.
-    import time
-
     from flexible_llm_sharding_tpu.config import PressureConfig
     from flexible_llm_sharding_tpu.runtime import hostcache, pressure
     from flexible_llm_sharding_tpu.serve.request import Overloaded
@@ -536,6 +543,104 @@ def main() -> int:
         return 1
     print(
         f"sched_chaos_ok preemptions={n_preempt} "
+        f"redispatches={router['redispatches']}"
+    )
+
+    # 7) Speculative decoding on the serving path (docs/speculative.md):
+    # --speculative_k under seeded shard_read faults must stay
+    # TOKEN-IDENTICAL to the non-speculative oracle while actually
+    # accepting drafts (nonzero fls_spec_accepted_tokens on the scraped
+    # endpoint — a spec run that silently degraded to plain decode would
+    # pass parity but fail the counter), and the same spec config on a
+    # 3-replica fleet under replica_kill must survive re-dispatch
+    # token-identically. CI greps the spec_chaos_ok marker below.
+    spec_gen = 6
+    spec_oracle, _ = DecodeGenerator(
+        _cfg(model_dir, num_gen_token=spec_gen), tokenizer=FakeTokenizer()
+    )(list(PROMPTS))
+    engine = ServeEngine(
+        _cfg(
+            model_dir,
+            faults=FaultConfig(
+                enabled=True, seed=SEED, error_rate=0.2,
+                sites=("shard_read",),
+            ),
+        ),
+        ServeConfig(
+            max_wave_requests=2, default_max_new_tokens=spec_gen,
+            speculative_k=4, metrics_port=0,
+        ),
+        tokenizer=FakeTokenizer(),
+    )
+    try:
+        reqs = [engine.submit(p, s) for p, s in PROMPTS]
+        results = [r.future.result(timeout=600) for r in reqs]
+        port = engine.metrics_server.port
+        exposition = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=30
+        ).read().decode()
+    finally:
+        engine.shutdown(drain=True)
+    if engine.error is not None:
+        print(f"FAIL: spec engine error {engine.error!r}", file=sys.stderr)
+        return 1
+    for res, want in zip(results, spec_oracle):
+        if not (res.tokens == want.argmax(-1)).all():
+            print(
+                "FAIL: speculative serve output diverged under shard_read",
+                file=sys.stderr,
+            )
+            return 1
+    m = re.search(r"^fls_spec_accepted_tokens (\d+)", exposition, re.M)
+    if not m or int(m.group(1)) < 1:
+        print(
+            "FAIL: exposition reports no nonzero fls_spec_accepted_tokens "
+            "(speculation silently degraded to plain decode?)",
+            file=sys.stderr,
+        )
+        return 1
+    n_accepted = int(m.group(1))
+
+    fleet = _Fleet(
+        _cfg(
+            model_dir,
+            faults=FaultConfig(
+                enabled=True, seed=SEED, error_rate=1.0,
+                sites=("replica_kill",), max_faults=1,
+            ),
+        ),
+        ServeConfig(
+            replicas=3, max_wave_requests=2,
+            default_max_new_tokens=spec_gen, speculative_k=4,
+            router_health_poll_s=0.05,
+        ),
+        tokenizer=FakeTokenizer(),
+    )
+    try:
+        reqs = [fleet.submit(p, s) for p, s in PROMPTS]
+        results = [r.future.result(timeout=600) for r in reqs]
+    finally:
+        fleet.shutdown(drain=True)
+    if fleet.error is not None:
+        print(f"FAIL: spec fleet error {fleet.error!r}", file=sys.stderr)
+        return 1
+    for res, want in zip(results, spec_oracle):
+        if not (res.tokens == want.argmax(-1)).all():
+            print(
+                "FAIL: speculative fleet output diverged under replica_kill",
+                file=sys.stderr,
+            )
+            return 1
+    router = fleet.metrics.snapshot()
+    if router.get("redispatches", 0) < 1:
+        print(
+            f"FAIL: spec fleet saw no re-dispatch under replica_kill: "
+            f"{router}",
+            file=sys.stderr,
+        )
+        return 1
+    print(
+        f"spec_chaos_ok accepted={n_accepted} "
         f"redispatches={router['redispatches']}"
     )
     return 0

@@ -457,6 +457,85 @@ def test_knob_sync_detects_unthreaded_flag(tmp_path):
     )
 
 
+def test_knob_sync_spec_knob_one_name_two_classes(tmp_path):
+    """The speculative_k shape: ONE flag name on both parsers setting
+    DIFFERENT config classes (batch -> FrameworkConfig's offline knob,
+    serve -> ServeConfig's serving knob). Parser-aware mapping keeps the
+    clean layout clean: the batch side stays validly declared
+    BATCH_ONLY (the serve parser's same-named flag is a different knob,
+    so it neither voids the declaration nor counts as 'shared')."""
+    config = KNOB_CONFIG.replace(
+        "class FrameworkConfig:\n    alpha: int = 1",
+        "class FrameworkConfig:\n    alpha: int = 1\n    speculative_k: int = 0",
+    ).replace(
+        "class ServeConfig:\n    default_max_new_tokens: int = 16",
+        "class ServeConfig:\n    default_max_new_tokens: int = 16\n"
+        "    speculative_k: int = 0",
+    )
+    cli = KNOB_CLI.replace(
+        'BATCH_ONLY_FLAGS = frozenset({"beta"})',
+        'BATCH_ONLY_FLAGS = frozenset({"beta", "speculative_k"})',
+    ).replace(
+        'p.add_argument("--beta", type=int, default=2)',
+        'p.add_argument("--beta", type=int, default=2)\n'
+        '    p.add_argument("--speculative_k", type=int, default=0)',
+    ).replace(
+        'p.add_argument("--max_new_tokens", type=int, default=16)',
+        'p.add_argument("--max_new_tokens", type=int, default=16)\n'
+        '    p.add_argument("--speculative_k", type=int, default=0)',
+    ).replace(
+        "return FrameworkConfig(alpha=args.alpha, beta=args.beta)",
+        "return FrameworkConfig(alpha=args.alpha, beta=args.beta, "
+        "speculative_k=args.speculative_k)",
+    ).replace(
+        "sc = ServeConfig(default_max_new_tokens=args.max_new_tokens)",
+        "sc = ServeConfig(default_max_new_tokens=args.max_new_tokens, "
+        "speculative_k=args.speculative_k)",
+    )
+    pkg = make_pkg(tmp_path, {"config.py": config, "cli.py": cli})
+    res = run_pkg(pkg, select=["KNOB-SYNC"])
+    assert res.findings == []
+
+
+def test_knob_sync_spec_knob_serve_reader_validation(tmp_path):
+    """Negative half of the spec-knob extension: the serve parser's
+    --speculative_k resolves to ServeConfig, so serve_main must actually
+    READ args.speculative_k — dropping the read is a silent no-op
+    finding AGAINST THE SERVE PARSER (the batch parser's own read of the
+    same-named FrameworkConfig knob must not mask it)."""
+    config = KNOB_CONFIG.replace(
+        "class FrameworkConfig:\n    alpha: int = 1",
+        "class FrameworkConfig:\n    alpha: int = 1\n    speculative_k: int = 0",
+    ).replace(
+        "class ServeConfig:\n    default_max_new_tokens: int = 16",
+        "class ServeConfig:\n    default_max_new_tokens: int = 16\n"
+        "    speculative_k: int = 0",
+    )
+    cli = KNOB_CLI.replace(
+        'BATCH_ONLY_FLAGS = frozenset({"beta"})',
+        'BATCH_ONLY_FLAGS = frozenset({"beta", "speculative_k"})',
+    ).replace(
+        'p.add_argument("--beta", type=int, default=2)',
+        'p.add_argument("--beta", type=int, default=2)\n'
+        '    p.add_argument("--speculative_k", type=int, default=0)',
+    ).replace(
+        'p.add_argument("--max_new_tokens", type=int, default=16)',
+        'p.add_argument("--max_new_tokens", type=int, default=16)\n'
+        '    p.add_argument("--speculative_k", type=int, default=0)',
+    ).replace(
+        "return FrameworkConfig(alpha=args.alpha, beta=args.beta)",
+        "return FrameworkConfig(alpha=args.alpha, beta=args.beta, "
+        "speculative_k=args.speculative_k)",
+    )
+    # serve_main never reads args.speculative_k.
+    pkg = make_pkg(tmp_path, {"config.py": config, "cli.py": cli})
+    res = run_pkg(pkg, select=["KNOB-SYNC"])
+    assert any(
+        "--speculative_k" in m and "serve" in m and "silent no-op" in m
+        for m in msgs(res.findings, "KNOB-SYNC")
+    )
+
+
 def test_knob_sync_shared_reader_requires_flag_in_both_parsers(tmp_path):
     # _fault_config_from_args runs on BOTH CLI paths: a chaos flag parsed
     # only by the serve parser — even declared SERVE_ONLY, which silences
@@ -654,6 +733,48 @@ class C:
     pkg = make_pkg(tmp_path, {"mod.py": src})
     res = run_pkg(pkg, select=["COUNTER-EXPORT"])
     assert any("self.hits" in x for x in msgs(res.findings, "COUNTER-EXPORT"))
+
+
+# The speculative-serving counter family (utils/metrics.py spec_snapshot,
+# serve/engine.py spec path): accepted/drafted/rejected must all reach the
+# registered export. Positive/negative pair over the registry-source path.
+SPEC_COUNTER_OK = """
+class SpecMetrics:
+    def __init__(self, registry):
+        self.spec_drafted_tokens = 0
+        self.spec_accepted_tokens = 0
+        self.spec_rejected_tokens = 0
+        registry.register("spec", self.spec_snapshot)
+    def bump(self, drafted, accepted):
+        self.spec_drafted_tokens += drafted
+        self.spec_accepted_tokens += accepted
+        self.spec_rejected_tokens += drafted - accepted
+    def spec_snapshot(self):
+        return {
+            "drafted_tokens": self.spec_drafted_tokens,
+            "accepted_tokens": self.spec_accepted_tokens,
+            "rejected_tokens": self.spec_rejected_tokens,
+        }
+"""
+
+
+def test_counter_export_spec_family_positive_and_negative(tmp_path):
+    """The fls_spec_* family shape: counters incremented by the verify
+    pass and exported through a registered ``spec`` source pass; dropping
+    one counter from the export (here rejected_tokens) is the
+    counts-but-never-exports defect the rule exists for."""
+    pkg = make_pkg(tmp_path, {"mod.py": SPEC_COUNTER_OK})
+    res = run_pkg(pkg, select=["COUNTER-EXPORT"])
+    assert msgs(res.findings, "COUNTER-EXPORT") == []
+
+    broken = SPEC_COUNTER_OK.replace(
+        '            "rejected_tokens": self.spec_rejected_tokens,\n', ""
+    )
+    pkg = make_pkg(tmp_path, {"mod2.py": broken}, name="pkg2")
+    res = run_pkg(pkg, select=["COUNTER-EXPORT"])
+    m = msgs(res.findings, "COUNTER-EXPORT")
+    assert any("self.spec_rejected_tokens" in x for x in m)
+    assert not any("self.spec_accepted_tokens" in x for x in m)
 
 
 def test_counter_export_integrity_keys(tmp_path):
@@ -977,6 +1098,7 @@ class _StubInitEngine:
         self.metrics = ServingMetrics()
         self.batcher = types.SimpleNamespace(waves=[])
         self._sched = None  # scheduler off: the FIFO/parity path
+        self._spec_k = 0  # speculation off: the plain decode path
 
     def tokenizer(self, prefix, suffixes):
         raise self._exc
