@@ -131,6 +131,10 @@ HEADLINE_KEYS = (
     "device_cast_speedup",
     "partial_residency_speedup",
     "pinned_fraction",
+    "mixedprec_bytes_saved_frac",
+    "mixedprec_divergence",
+    "mixedprec_divergence_cap",
+    "mixedprec_plan",
     "trace_overhead_ratio",
     "trace_overhead_ratio_spread",
     "trace_overhead_ratio_inconclusive",
@@ -273,6 +277,10 @@ RATIO_SINGLETONS = (
     "device_cast_speedup",
     "partial_residency_speedup",
     "pinned_fraction",
+    "mixedprec_bytes_saved_frac",
+    "mixedprec_divergence",
+    "mixedprec_divergence_cap",
+    "mixedprec_plan",
     "trace_overhead_ratio",
     "spec_serve_tokens_per_sweep",
     "spec_serve_sweep_ratio",
@@ -329,6 +337,10 @@ PHASE_EVIDENCE_KEY = {
     # PR 6's tentpole evidence: a pin budget must cut the per-sweep
     # stream by the pinned fraction (rotation-paired, hostcache-style).
     "residency": "partial_residency_speedup",
+    # ISSUE 14's tentpole evidence: a sensitivity-planned mixed-precision
+    # checkpoint must stream fewer bytes per sweep than uniform bf16
+    # (structural byte counters; divergence asserted before recording).
+    "mixedprec": "mixedprec_bytes_saved_frac",
     "pairs": "vs_baseline",
     "refsched": "vs_reference_schedule",
     "int8": "int8_speedup",
@@ -957,6 +969,126 @@ def bench_residency(
         # Drop the pins so the later phases' memory/throughput numbers
         # aren't measured next to a half-resident model.
         residency.reset_process_tier()
+
+
+def bench_mixedprec(
+    result: dict, model_path: str, prompts, tok, budget_left, fw
+) -> None:
+    """Mixed-precision streaming evidence (ISSUE 14 tentpole): a
+    sensitivity-planned int4/int8/bf16 checkpoint must cut the bytes each
+    sweep moves over the host->HBM link vs uniform bf16, without drifting
+    past the plan's own declared divergence cap.
+
+    - ``mixedprec_bytes_saved_frac``: 1 - (mixed streamed bytes / bf16
+      streamed bytes) over identical sweeps, read from the executors' OWN
+      ``streamed_bytes`` stats — structural and timing-free (byte
+      counters, not walls), so the perf gate holds a hard floor on it.
+    - ``mixedprec_divergence``: mean next-token KL of the mixed stream's
+      scores vs the bf16 stream's — ASSERTED under the plan's declared
+      cap before anything is recorded, and the plan's bf16 layers are
+      asserted bit-identical to the uniform-bf16 source files. A phase
+      that can't prove quality must not report bandwidth.
+    """
+    import dataclasses
+
+    from flexible_llm_sharding_tpu.runtime import precisionplan as pp
+    from flexible_llm_sharding_tpu.runtime import residency as _res
+    from flexible_llm_sharding_tpu.config import LlamaConfig
+    from flexible_llm_sharding_tpu.utils import checkpoint as _ckpt
+
+    try:
+        if budget_left() <= 0.2:
+            # The probe alone is 2 forwards per layer on the calibration
+            # batch; a nearly-spent window (a wedged-tunnel run) must
+            # leave its remaining time to the later phases.
+            log("mixedprec bench: budget exhausted, skipping")
+            return
+        mc = LlamaConfig.from_pretrained(model_path)
+        names = _ckpt.layer_names_for(
+            mc.num_hidden_layers, mc.tie_word_embeddings
+        )
+        baseline = sum(
+            _res.layer_stream_bytes(
+                model_path, names, mc.tie_word_embeddings
+            ).values()
+        )
+        # 60% of the uniform-bf16 sweep: deep enough that the planner
+        # provably engages int8/int4 (>= 40% savings — the gate floor
+        # derives from here), shallow enough that the most sensitive
+        # layers stay bf16 for the bit-identity half of the claim.
+        calib = prompts[:1]
+        plan = pp.build_plan(
+            model_path, calib, tok, bytes_budget=int(baseline * 0.60)
+        )
+        mixed_dir = os.path.join(BENCH_DIR, "model-mixedprec")
+        if os.path.exists(mixed_dir):
+            import shutil
+
+            shutil.rmtree(mixed_dir)
+        _ckpt.requantize_native(model_path, mixed_dir, plan=plan)
+
+        # bf16 layers bit-identical to the uniform-bf16 source, tensor
+        # for tensor (requantize's bf16 arm is the same cast rule the
+        # uniform baseline was stored with).
+        bf16_layers = [n for n, d in plan.layers if d == "bf16"]
+        for name in bf16_layers:
+            a = _ckpt._mmap_safetensors(
+                _ckpt.layer_file_for(model_path, name, mc.tie_word_embeddings)
+            )
+            b = _ckpt._mmap_safetensors(
+                _ckpt.layer_file_for(mixed_dir, name, mc.tie_word_embeddings)
+            )
+            assert set(a) == set(b), f"{name}: bf16 layer tensor set drifted"
+            for k in a:
+                assert np.array_equal(
+                    np.asarray(a[k]).view(np.uint8),
+                    np.asarray(b[k]).view(np.uint8),
+                ), f"{name}/{k}: bf16 layer not bit-identical to uniform bf16"
+
+        if budget_left() <= 0.1:
+            log("mixedprec bench: budget low after probe, skipping runs")
+            return
+        # Identical sweeps, byte counters from the executors themselves.
+        base_cfg = dataclasses.replace(fw(None), host_cache_gb=0.0)
+        mixed_cfg = dataclasses.replace(base_cfg, model_path=mixed_dir)
+        sub = prompts[: min(2, len(prompts))]
+        scores_b, _, ex_b = run_once(base_cfg, sub, tok)
+        scores_m, _, ex_m = run_once(mixed_cfg, sub, tok)
+        bytes_b = float(ex_b.stats["streamed_bytes"])
+        bytes_m = float(ex_m.stats["streamed_bytes"])
+        assert bytes_b > 0 and bytes_m > 0
+
+        # Quality gate BEFORE recording: the mixed stream's next-token
+        # distributions vs the bf16 stream's, under the plan's declared
+        # cap (pp.kl_divergence is the probe's own definition).
+        divs = [
+            pp.kl_divergence(b[s, 0][None], m[s, 0][None])
+            for b, m in zip(scores_b, scores_m)
+            for s in range(b.shape[0])
+        ]
+        divergence = float(np.mean(divs))
+        assert divergence <= plan.divergence_cap, (
+            f"mixed stream diverges {divergence:.3e} > declared cap "
+            f"{plan.divergence_cap:.3e}"
+        )
+
+        result["mixedprec_bytes_saved_frac"] = round(1.0 - bytes_m / bytes_b, 3)
+        result["mixedprec_divergence"] = divergence
+        result["mixedprec_divergence_cap"] = plan.divergence_cap
+        counts = plan.counts()
+        result["mixedprec_plan"] = (
+            f"{counts['bf16']}xbf16/{counts['int8']}xint8/"
+            f"{counts['int4']}xint4"
+        )
+        log(
+            f"mixedprec: bytes_saved_frac="
+            f"{result['mixedprec_bytes_saved_frac']} "
+            f"({bytes_m / 1e6:.1f} MB vs {bytes_b / 1e6:.1f} MB/sweep) "
+            f"plan={result['mixedprec_plan']} "
+            f"divergence={divergence:.3e} cap={plan.divergence_cap:.3e}"
+        )
+    except Exception:
+        log("mixedprec bench failed:\n" + traceback.format_exc())
 
 
 def bench_trace_overhead(
@@ -1625,6 +1757,11 @@ def run_bench(result: dict) -> None:
         log("skipping residency bench (already captured)")
     else:
         bench_residency(result, model_path, prompts, tok, budget_left, fw)
+
+    if "mixedprec" in skip:
+        log("skipping mixed-precision bench (already captured)")
+    else:
+        bench_mixedprec(result, model_path, prompts, tok, budget_left, fw)
 
     if "trace_overhead" in skip:
         log("skipping trace-overhead bench (already captured)")

@@ -9,6 +9,7 @@ Every rule here encodes an invariant a past PR review caught by hand:
 - EXC-TAXONOMY   swallowing broad excepts / unchained re-raises in hot paths
 - COUNTER-EXPORT counters incremented but absent from stats()/snapshot()
 - DETERMINISM    unseeded randomness / wall-clock in faults+integrity
+- QUANT-MANIFEST layer-file writers must record a manifest dtype entry
 - HYGIENE        stray package dirs, missing __init__.py
 
 Rules are AST-walks plus a little comment scanning — no imports of the
@@ -1035,6 +1036,71 @@ def determinism(info: FileInfo, ctx: ProjectContext) -> list[Finding]:
                     )
                 )
             self.generic_visit(node)
+
+    V().visit(info.tree)
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# QUANT-MANIFEST
+# ---------------------------------------------------------------------------
+
+
+@file_rule(
+    "QUANT-MANIFEST",
+    "every function that writes a layer safetensors file (st_save_file/"
+    "save_file) must record an integrity-manifest entry for it "
+    "(integrity_manifest.layer_entry) in the same function — layer_entry "
+    "is what stamps the per-layer dtype kind, so a writer that skips it "
+    "emits quantized leaf-groups the executor's precision check "
+    "(PrecisionMismatch) can never audit",
+)
+def quant_manifest(info: FileInfo, ctx: ProjectContext) -> list[Finding]:
+    findings: list[Finding] = []
+
+    def _is_save(chain: tuple[str, ...]) -> bool:
+        return bool(chain) and chain[-1] in ("st_save_file", "save_file")
+
+    def _is_entry(chain: tuple[str, ...]) -> bool:
+        return bool(chain) and chain[-1] == "layer_entry"
+
+    class V(_SymbolWalker):
+        def _scan(self, fn: ast.AST) -> None:
+            # Direct statements only: a nested def is its own scope and
+            # is scanned on its own visit (save_params pairs the calls
+            # inside its nested _save, which is the pairing that counts).
+            saves: list[ast.Call] = []
+            paired = False
+            for stmt in fn.body:
+                for node in _walk_pruned(stmt):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    chain = _dotted(node.func)
+                    if _is_save(chain):
+                        saves.append(node)
+                    elif _is_entry(chain):
+                        paired = True
+            if saves and not paired:
+                findings.append(
+                    Finding(
+                        "QUANT-MANIFEST",
+                        info.path,
+                        saves[0].lineno,
+                        "writes a layer safetensors file without recording "
+                        "an integrity_manifest.layer_entry in the same "
+                        "function — the manifest's per-layer dtype kind is "
+                        "what lets the load path type a precision mismatch",
+                        symbol=self.symbol,
+                    )
+                )
+
+        def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+            self.stack.append(node.name)
+            self._scan(node)
+            self.generic_visit(node)
+            self.stack.pop()
+
+        visit_AsyncFunctionDef = visit_FunctionDef
 
     V().visit(info.tree)
     return findings

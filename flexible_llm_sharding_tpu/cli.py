@@ -861,12 +861,108 @@ def verify_main(argv: list[str] | None = None) -> None:
         raise SystemExit(2)
 
 
+def build_plan_precision_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="flexible-llm-sharding-tpu plan-precision",
+        description="Mixed-precision calibration (docs/precision.md): "
+        "probe per-layer quality sensitivity on a calibration batch "
+        "(one-layer-at-a-time quantization vs the bf16 oracle), plan an "
+        "int4/int8/bf16 dtype per layer under a bytes-per-sweep budget "
+        "OR an end-to-end divergence cap, and emit the serializable "
+        "PrecisionPlan (optionally materializing the mixed checkpoint).",
+    )
+    p.add_argument("--model_path", type=str, required=True,
+                   help="FLOAT native per-layer checkpoint dir (the "
+                        "original precision — quantized dirs are "
+                        "rejected, requantize_native's rule)")
+    p.add_argument("--calib_pickle", type=str, required=True,
+                   help="calibration prompts pickle, the batch CLI's "
+                        "[(prefix, (suffixes...)), ...] format")
+    p.add_argument("--calib_limit", type=int, default=8,
+                   help="use at most this many calibration prompts (the "
+                        "probe runs one forward per layer per candidate "
+                        "dtype per row)")
+    p.add_argument("--bytes_budget_gb", type=float, default=None,
+                   help="plan mode 1: fit the sweep under this many GB "
+                        "of streamed weight bytes, minimizing divergence")
+    p.add_argument("--divergence_cap", type=float, default=None,
+                   help="plan mode 2: minimize streamed bytes subject to "
+                        "this cap on calibration next-token KL vs the "
+                        "bf16 oracle")
+    p.add_argument("--out", type=str, default=None,
+                   help="write the plan JSON here (default: print only)")
+    p.add_argument("--apply", type=str, default=None,
+                   help="also materialize the mixed checkpoint into this "
+                        "dir (requantize_native(plan=...); embeds the "
+                        "plan + per-layer dtype manifest)")
+    p.add_argument("--json", action="store_true",
+                   help="emit the plan as JSON on stdout")
+    return p
+
+
+def plan_precision_main(argv: list[str] | None = None, tokenizer=None) -> None:
+    args = build_plan_precision_parser().parse_args(argv)
+    if (args.bytes_budget_gb is None) == (args.divergence_cap is None):
+        raise SystemExit(
+            "plan-precision: give exactly one of --bytes_budget_gb / "
+            "--divergence_cap"
+        )
+    from flexible_llm_sharding_tpu.runtime.precisionplan import build_plan
+    from flexible_llm_sharding_tpu.utils.checkpoint import requantize_native
+
+    with open(args.calib_pickle, "rb") as f:
+        prompts = pickle.load(f)
+    prompts = prompts[: max(1, args.calib_limit)]
+    if tokenizer is None:
+        from transformers import AutoTokenizer
+
+        tokenizer = AutoTokenizer.from_pretrained(args.model_path)
+    plan = build_plan(
+        args.model_path,
+        prompts,
+        tokenizer,
+        bytes_budget=(
+            int(args.bytes_budget_gb * 1e9)
+            if args.bytes_budget_gb is not None
+            else None
+        ),
+        divergence_cap=args.divergence_cap,
+    )
+    if args.out:
+        plan.write(args.out)
+    if args.json:
+        print(json.dumps(plan.to_json()))
+    else:
+        counts = plan.counts()
+        print(
+            f"plan: {counts['bf16']} bf16 / {counts['int8']} int8 / "
+            f"{counts['int4']} int4 layers — "
+            f"{plan.est_bytes / 1e9:.3f} GB/sweep vs "
+            f"{plan.baseline_bytes / 1e9:.3f} GB uniform bf16 "
+            f"({plan.bytes_saved_frac:.1%} saved); measured divergence "
+            f"{plan.measured_divergence:.3e} (declared cap "
+            f"{plan.divergence_cap:.3e})"
+        )
+        for name, dt in plan.layers:
+            print(f"  {dt:>5}  {name}")
+    if args.apply:
+        done = requantize_native(args.model_path, args.apply, plan=plan)
+        print(
+            f"materialized {len(done)} mixed-precision layers -> "
+            f"{args.apply}",
+            file=sys.stderr,
+        )
+
+
 def main(argv: list[str] | None = None, tokenizer=None) -> None:
     argv = sys.argv[1:] if argv is None else list(argv)
     if argv and argv[0] == "serve":
         return serve_main(argv[1:], tokenizer=tokenizer)
     if argv and argv[0] == "verify":
         return verify_main(argv[1:])
+    if argv and argv[0] == "plan-precision":
+        # Mixed-precision calibration planner (docs/precision.md).
+        return plan_precision_main(argv[1:], tokenizer=tokenizer)
     if argv and argv[0] == "check":
         # flscheck: the project-invariant static analyzer (docs/analysis.md).
         from flexible_llm_sharding_tpu.analysis import main as check_main

@@ -88,6 +88,17 @@ class SpillReadError(SpillCorruptError):
     executor's recompute heals truncated spills too."""
 
 
+class PrecisionMismatch(ShardLoadError):
+    """A layer file's actual storage dtype disagrees with what the
+    integrity manifest (or the checkpoint's embedded ``PrecisionPlan``)
+    declares for it — e.g. an int4 file swapped in where the manifest
+    records bf16. STRUCTURAL, not transient: a re-read returns the same
+    wrong dtype, so this is deliberately NOT an ``OSError`` (the retry
+    ladder must not triple its latency) — but it IS a ``ShardLoadError``,
+    so the serving degrade path (wave-fail + source restart) applies
+    unchanged while the message names the layer and both dtypes."""
+
+
 def _raw_bytes(arr: np.ndarray) -> np.ndarray:
     """A tensor's stored payload as a flat uint8 view (zero-copy for
     contiguous inputs, including ml_dtypes extension types)."""
@@ -131,9 +142,23 @@ def tensor_checksum(arr: np.ndarray) -> str:
 
 
 def layer_entry(flat: dict[str, np.ndarray], file_name: str) -> dict:
-    """Manifest entry for one layer file's flat tensor dict (as stored)."""
+    """Manifest entry for one layer file's flat tensor dict (as stored).
+
+    ``dtype`` records the layer's storage-dtype kind (int4/int8/bfloat16/
+    float32 — ``checkpoint.flat_dtype_kind``, the ONE derivation shared
+    with the load-path check), so a file whose precision silently
+    disagrees with the manifest (a uniform-int4 file swapped into a
+    mixed-precision dir's bf16 slot) is a typed ``PrecisionMismatch`` at
+    load time, not a quality regression discovered in production.
+    Entries written before this field load unchecked (back-compat)."""
+    # Function-level import: checkpoint.py imports this module at module
+    # scope; by the time any writer calls layer_entry the checkpoint
+    # module is importable, so the kind derivation stays single-sourced.
+    from flexible_llm_sharding_tpu.utils.checkpoint import flat_dtype_kind
+
     return {
         "file": file_name,
+        "dtype": flat_dtype_kind(flat),
         "tensors": {
             k: {"c": tensor_checksum(v), "n": int(np.asarray(v).nbytes)}
             for k, v in flat.items()
@@ -403,6 +428,7 @@ __all__ = [
     "MANIFEST_NAME",
     "SIDECAR_SUFFIX",
     "ChecksumMismatch",
+    "PrecisionMismatch",
     "ShardCorruptError",
     "SpillCorruptError",
     "SpillReadError",

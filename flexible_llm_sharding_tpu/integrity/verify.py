@@ -31,7 +31,10 @@ def verify_model_dir(model_dir: str) -> dict:
     "problems": [{"file", "status", "detail"}, ...]}``. Statuses:
     ``no_manifest`` | ``corrupt_manifest`` | ``missing_file`` |
     ``not_in_manifest`` | ``unreadable`` | ``tensor_diff`` |
-    ``mismatch``.
+    ``mismatch`` — plus, for mixed-precision dirs with an embedded
+    ``precision_plan.json``: ``corrupt_plan`` | ``precision_mismatch`` |
+    ``plan_missing_file`` | ``not_in_plan`` (each layer's actual stored
+    dtype kind and its manifest-recorded kind audited against the plan).
     """
     # Function-level import (like _mmap_safetensors) keeps verify_spill_dir
     # usable without the checkpoint module's heavier deps.
@@ -81,6 +84,23 @@ def verify_model_dir(model_dir: str) -> dict:
                     "has no entry for it",
                 )
             )
+    plan, plan_problems = _load_plan(model_dir)
+    problems.extend(plan_problems)
+    if plan is not None:
+        # Plan vs the manifest's RECORDED kinds — the same shared
+        # comparison the loader raises PrecisionMismatch from
+        # (precisionplan.plan_manifest_problems), reported here in full.
+        from flexible_llm_sharding_tpu.runtime.precisionplan import (
+            plan_manifest_problems,
+        )
+
+        for layer, detail in plan_manifest_problems(plan, manifest):
+            problems.append(
+                _problem(
+                    layer + _LAYER_SUFFIX, "precision_mismatch", detail
+                )
+            )
+    plan_layers_checked = 0
     for layer in sorted(man_layers.keys() & disk_layers):
         fname = layer + _LAYER_SUFFIX
         path = os.path.join(model_dir, fname)
@@ -90,6 +110,10 @@ def verify_model_dir(model_dir: str) -> dict:
             problems.append(_problem(fname, "unreadable", repr(e)))
             continue
         layers_checked += 1
+        if plan is not None:
+            plan_layers_checked += _check_plan_layer(
+                plan, layer, fname, flat, problems
+            )
         want = man_layers[layer].get("tensors", {})
         missing = sorted(want.keys() - flat.keys())
         extra = sorted(flat.keys() - want.keys())
@@ -126,13 +150,92 @@ def verify_model_dir(model_dir: str) -> dict:
                         f"{meta['c']}",
                     )
                 )
-    return {
+    if plan is not None:
+        # Coverage both ways: every planned layer must exist on disk and
+        # every layer file must have a plan entry (requantize_native
+        # enforces this at write time; drift after the fact is exactly
+        # what the audit exists to catch).
+        for layer in sorted(set(plan.dtypes) - disk_layers):
+            problems.append(
+                _problem(
+                    layer + _LAYER_SUFFIX,
+                    "plan_missing_file",
+                    f"precision plan covers layer {layer!r} but its file "
+                    "is gone",
+                )
+            )
+        for layer in sorted(disk_layers - set(plan.dtypes)):
+            problems.append(
+                _problem(
+                    layer + _LAYER_SUFFIX,
+                    "not_in_plan",
+                    f"layer file {layer!r} exists on disk but the "
+                    "embedded precision plan has no entry for it",
+                )
+            )
+    report = {
         "path": model_dir,
         "ok": not problems,
         "layers_checked": layers_checked,
         "tensors_checked": tensors_checked,
         "problems": problems,
     }
+    if plan is not None:
+        report["plan_layers_checked"] = plan_layers_checked
+        report["plan_divergence_cap"] = plan.divergence_cap
+    return report
+
+
+def _load_plan(model_dir: str):
+    """(PrecisionPlan | None, problems): the checkpoint's embedded
+    mixed-precision plan, with a corrupt plan reported instead of
+    raised (the audit must keep walking the rest of the dir)."""
+    from flexible_llm_sharding_tpu.runtime.precisionplan import (
+        PLAN_NAME,
+        PrecisionPlan,
+    )
+
+    try:
+        return PrecisionPlan.load(model_dir), []
+    except ValueError as e:
+        return None, [_problem(PLAN_NAME, "corrupt_plan", str(e))]
+    except OSError as e:
+        # The plan EXISTS but can't be read (EACCES, EIO): a failure,
+        # never "uniform checkpoint" — skipping the plan audit silently
+        # is the exact hole the audit exists to close.
+        return None, [
+            _problem(PLAN_NAME, "corrupt_plan", f"unreadable: {e}")
+        ]
+
+
+def _check_plan_layer(
+    plan, layer: str, fname: str, flat, problems: list
+) -> int:
+    """Validate one layer's ACTUAL stored bytes against the embedded
+    PrecisionPlan (the plan-vs-manifest half runs once up front through
+    the shared ``precisionplan.plan_manifest_problems``). Returns 1 when
+    the layer was plan-checked (0 when the plan does not cover it — the
+    coverage pass reports that separately)."""
+    from flexible_llm_sharding_tpu.runtime.precisionplan import (
+        PLAN_KIND_ACCEPTS,
+    )
+    from flexible_llm_sharding_tpu.utils.checkpoint import flat_dtype_kind
+
+    plan_dtype = plan.dtypes.get(layer)
+    if plan_dtype is None:
+        return 0
+    accepted = PLAN_KIND_ACCEPTS.get(plan_dtype, ())
+    got = flat_dtype_kind(flat)
+    if got not in accepted:
+        problems.append(
+            _problem(
+                fname,
+                "precision_mismatch",
+                f"layer {layer!r} stores dtype kind {got!r}; the embedded "
+                f"plan declares {plan_dtype!r} (accepts {list(accepted)})",
+            )
+        )
+    return 1
 
 
 def verify_spill_dir(spill_dir: str) -> dict:

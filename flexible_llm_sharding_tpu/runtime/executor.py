@@ -341,6 +341,14 @@ _PROCESS_STREAM_LOCK = threading.Lock()
 # warm-sweep invariant against this counter.
 _PROCESS_HOST_CASTS = [0]
 
+# Process-wide count of tied-lm_head dequant->transpose->requant passes
+# actually computed (a [V, D] pass per occurrence — heavy enough that the
+# decode hot path must amortize it). The result is seated in the host
+# shard cache keyed by the embedding file's stat, so a WARM process —
+# source restarts, new executors, fresh decode calls — performs ZERO of
+# these; tests pin that invariant against this counter.
+_PROCESS_TIED_REQUANTS = [0]
+
 
 def process_streamed_bytes() -> int:
     return _PROCESS_STREAM_BYTES[0]
@@ -350,12 +358,17 @@ def process_host_casts() -> int:
     return _PROCESS_HOST_CASTS[0]
 
 
+def process_tied_head_requants() -> int:
+    return _PROCESS_TIED_REQUANTS[0]
+
+
 def reset_process_streamed_bytes() -> None:
     """Zero the counters — the CLI calls this at run start so a second
     cli.main() in one process doesn't report the first run's bytes."""
     with _PROCESS_STREAM_LOCK:
         _PROCESS_STREAM_BYTES[0] = 0
         _PROCESS_HOST_CASTS[0] = 0
+        _PROCESS_TIED_REQUANTS[0] = 0
 
 
 def stream_stats() -> dict[str, int]:
@@ -365,6 +378,7 @@ def stream_stats() -> dict[str, int]:
     return {
         "streamed_bytes": process_streamed_bytes(),
         "host_casts": process_host_casts(),
+        "tied_head_requants": process_tied_head_requants(),
     }
 
 
@@ -372,6 +386,40 @@ def stream_stats() -> dict[str, int]:
 # the serve metrics endpoint and the batch CLI's --metrics_out both expose
 # streamed bytes from here, the same numbers the stats lines print.
 _OBS_REGISTRY.register("stream", stream_stats)
+
+
+def _check_precision_plan(model_path: str, manifest: dict) -> None:
+    """Validate an embedded PrecisionPlan against the integrity manifest's
+    recorded per-layer dtype kinds; a disagreement raises the typed
+    ``PrecisionMismatch`` (ShardLoadError family, so serving degrade
+    paths apply). No-op for uniform checkpoints (no plan file) and for
+    pre-dtype manifests (back-compat)."""
+    from flexible_llm_sharding_tpu.runtime.precisionplan import (
+        PrecisionPlan,
+        plan_manifest_problems,
+    )
+
+    try:
+        plan = PrecisionPlan.load(model_path)
+    except (ValueError, OSError) as e:
+        # A torn/corrupt embedded plan — or one that EXISTS but cannot
+        # be read (EACCES/EIO; load maps only FileNotFoundError to
+        # "uniform checkpoint") — is a plan that cannot vouch for the
+        # checkpoint: type it, so the serve loop's degrade handler
+        # (ShardLoadError family) fails the wave instead of the engine
+        # dying on a bare ValueError, and the audit (verify._load_plan)
+        # and the load path agree on the handling.
+        raise integrity_manifest.PrecisionMismatch(str(e)) from e
+    if plan is None:
+        return
+    problems = plan_manifest_problems(plan, manifest)
+    if problems:
+        _, detail = problems[0]
+        raise integrity_manifest.PrecisionMismatch(
+            f"{model_path}: {detail} — the checkpoint does not match its "
+            "embedded precision plan (audit with the `verify` CLI "
+            "subcommand)"
+        )
 
 
 # Float dtypes the on-device cast path handles: uploaded in their stored
@@ -450,6 +498,15 @@ class _HostShardLoader:
                     "the `verify` CLI subcommand)",
                     stacklevel=3,
                 )
+            else:
+                # Mixed-precision dirs embed their PrecisionPlan: check
+                # the plan's layer->dtype mapping against the manifest's
+                # recorded per-layer dtype kinds ONCE here (two JSON
+                # files, no tensor reads), so a plan/manifest mismatch is
+                # a typed error at source construction — before a single
+                # wrong-precision byte crosses the link. The per-file
+                # bytes-vs-manifest check runs in load_layer per load.
+                _check_precision_plan(model_path, self._manifest)
         self.layer_names = list(layer_names)
         self.np_dtype = np.dtype(np_dtype)
         self.tied = tied_embeddings
@@ -619,6 +676,27 @@ class _HostShardLoader:
         if name == "lm_head" and self.tied:
             if self._tied_head is not None:
                 return self._tied_head
+            # Cross-loader amortization: the built head (requantized or
+            # transposed) is seated in the process host shard cache keyed
+            # by the embedding FILE's stat, so a fresh loader — a serve
+            # source restart, a new decode call — reuses it instead of
+            # re-paying the [V, D] dequant+transpose+requant. Skipped
+            # under chaos injection (the cache is off there anyway, and a
+            # seeded corrupt_shard draw must hit a real load).
+            cache = self._host_cache if self._injector is None else None
+            embed_path = self._layer_file("model.embed_tokens")
+            cache_key = guard = None
+            if cache is not None:
+                from flexible_llm_sharding_tpu.runtime.hostcache import (
+                    stat_guard,
+                )
+
+                cache_key = self._cache_key_base + ("__tied_head__",)
+                guard = stat_guard([embed_path])
+                hit = cache.get(cache_key) if guard is not None else None
+                if hit is not None:
+                    self._tied_head = hit[0]
+                    return self._tied_head
             emb = checkpoint.load_layer(
                 self.model_path,
                 "model.embed_tokens",
@@ -639,11 +717,27 @@ class _HostShardLoader:
                 # are immutable for the loader's lifetime, and the decode
                 # loop re-streams lm_head every token — a dequant+transpose+
                 # requant of [V, D] per token would land on the hot path.
+                with _PROCESS_STREAM_LOCK:
+                    _PROCESS_TIED_REQUANTS[0] += 1
                 deq = np.ascontiguousarray(checkpoint.dequantize_np(e).T)
                 q, s = checkpoint._quantize_int8(deq)
                 self._tied_head = {"kernel": {"q8": q, "s": s}}
             else:
                 self._tied_head = {"kernel": np.ascontiguousarray(e.T)}
+            if cache is not None and guard is not None:
+                # Seated only after the embed load's integrity check
+                # passed (load_layer raised otherwise); charged at its
+                # real packed bytes. The guard binds to the embed file's
+                # pre-read stat, so a re-prepared dir invalidates.
+                kern = self._tied_head["kernel"]
+                nbytes = (
+                    int(kern["q8"].nbytes + kern["s"].nbytes)
+                    if checkpoint.is_quantized_leaf(kern)
+                    else int(kern.nbytes)
+                )
+                cache.put(
+                    cache_key, self._tied_head, nbytes=nbytes, guard=guard
+                )
             return self._tied_head
         return checkpoint.load_layer(
             self.model_path, name, manifest=self._manifest, corrupt=corrupt
@@ -2144,6 +2238,7 @@ __all__ = [
     "ShardWeightSource",
     "BroadcastShardSource",
     "process_host_casts",
+    "process_tied_head_requants",
     "ShardLoadError",
     "ShardCorruptError",
     "SpillCorruptError",

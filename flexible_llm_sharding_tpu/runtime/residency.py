@@ -66,19 +66,52 @@ def layer_stream_bytes(
     """Estimated streamed bytes per sweep per layer, from the layer files'
     on-disk size — what ``build_host_shard`` reads and re-uploads every
     sweep (quantized layers travel packed, so file size is the honest
-    per-sweep link proxy). The name->file mapping is the loader's own
+    per-sweep link proxy — NEVER the dequantized logical size, which
+    would inflate mixed-precision pinning budgets by the compression
+    factor). The name->file mapping is the loader's own
     (``checkpoint.layer_file_for``), so the estimates cannot desync from
-    what actually streams. Unreadable files count 0 (and are never
-    planned)."""
+    what actually streams. The one layer whose stream differs from its
+    file is the tied lm_head over a QUANTIZED embedding: the loader
+    dequantizes, transposes, and requantizes it to int8
+    (executor._load_one_raw), so what crosses the link is the int8
+    [D, V] payload + fp32 [V] scale, not the embed file's packed bytes —
+    estimated from the file header's shapes. Unreadable files count 0
+    (and are never planned)."""
     out: dict[int, int] = {}
     for i, name in enumerate(layer_names):
+        path = checkpoint.layer_file_for(model_path, name, tied_embeddings)
         try:
-            out[i] = os.path.getsize(
-                checkpoint.layer_file_for(model_path, name, tied_embeddings)
-            )
+            if name == "lm_head" and tied_embeddings:
+                out[i] = _tied_head_stream_bytes(path)
+            else:
+                out[i] = os.path.getsize(path)
         except OSError:
             out[i] = 0
     return out
+
+
+def _tied_head_stream_bytes(embed_path: str) -> int:
+    """The tied lm_head's ACTUAL per-sweep link bytes. Float embeddings
+    re-materialize as a transpose (same bytes as the file); quantized
+    ones requantize to int8 per output channel — q int8 [D, V] + fp32
+    scale [V] — whatever the embed file's own packing was."""
+    try:
+        header, _ = checkpoint.safetensors_header(embed_path)
+        q4 = "embedding" + checkpoint.QUANT4_SCALE_SUFFIX in header
+        q8 = "embedding" + checkpoint.QUANT_SCALE_SUFFIX in header
+        meta = header.get("embedding")
+        if meta is None or not (q4 or q8):
+            return os.path.getsize(embed_path)
+        shape = meta["shape"]
+        # int4 packs two values per byte along V (axis -2): the stored
+        # payload is [V/2, D], so the logical vocab doubles back.
+        v = int(shape[0]) * (2 if q4 else 1)
+        d = int(shape[1])
+        return d * v + 4 * v
+    except (ValueError, KeyError, IndexError):
+        # Unparseable header: fall back to the file-size proxy (the
+        # integrity layer, not the planner, is where corruption fails).
+        return os.path.getsize(embed_path)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -123,13 +156,44 @@ def plan_residency(
     streamed bytes (stable by layer index on ties — for the usual uniform
     blocks that is simply the first N). A layer that does not fit is
     skipped and the scan continues: smaller later layers may still fit
-    (greedy knapsack, never an error)."""
+    (greedy knapsack, never an error).
+
+    Mixed-precision checkpoints co-optimize: a pinned layer keeps its
+    dtype (pinning is purely a bytes-saved lever, never a quality one),
+    so streamed size stays the primary key — which ALREADY pins the
+    plan's bf16 layers first for uniform-width models, since
+    uncompressed layers are the most expensive to stream. The embedded
+    plan's dtype (bf16 before int8 before int4) breaks SIZE TIES only:
+    it must never outrank a larger lower-precision layer, which would
+    strictly reduce the bytes a budget saves."""
     sizes = layer_stream_bytes(model_path, layer_names, tied_embeddings)
+    dtype_rank = {}
+    try:
+        from flexible_llm_sharding_tpu.runtime.precisionplan import (
+            PrecisionPlan,
+        )
+
+        plan = PrecisionPlan.load(model_path)
+    except (ValueError, OSError):
+        # Corrupt or unreadable embedded plan: planning is an
+        # optimization (losing the dtype tie-break only) and must not be
+        # its enforcement point — the loader's plan/manifest check
+        # (executor._check_precision_plan) surfaces the typed error.
+        plan = None
+    if plan is not None:
+        rank = {"bf16": 0, "int8": 1, "int4": 2}
+        dtype_rank = {
+            i: rank.get(plan.dtypes.get(name, ""), 0)
+            for i, name in enumerate(layer_names)
+        }
 
     def tier(i: int) -> int:
         return 1 if layer_names[i].startswith("model.layers.") else 0
 
-    order = sorted(range(len(layer_names)), key=lambda i: (tier(i), -sizes[i], i))
+    order = sorted(
+        range(len(layer_names)),
+        key=lambda i: (tier(i), -sizes[i], dtype_rank.get(i, 0), i),
+    )
     pinned: list[int] = []
     skipped: list[int] = []
     used = 0

@@ -73,6 +73,15 @@ QUANT4_SCALE_SUFFIX = "::scale4"
 INT4_GROUP = 64
 
 
+def is_float_like(a) -> bool:
+    """True for real float dtypes AND the bfloat16 extension type — the
+    ONE spelling of "does this tensor cast/quantize" shared by the
+    quantizers, the dtype-kind derivation, and the planner (a second
+    spelling drifting on a future fp8 addition is the failure mode)."""
+    dt = np.asarray(a).dtype
+    return np.issubdtype(dt, np.floating) or dt.name == "bfloat16"
+
+
 def _quantize_int8(w: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
     """Symmetric per-output-channel int8: returns (q [same shape], scale).
 
@@ -133,9 +142,7 @@ def _quantize_flat(
     qd: dict[str, np.ndarray] = {}
     for k, v in sd.items():
         v = np.asarray(v)
-        if v.ndim >= 2 and (
-            np.issubdtype(v.dtype, np.floating) or v.dtype == _BFLOAT16
-        ):
+        if v.ndim >= 2 and is_float_like(v):
             if dtype == "int4" and v.shape[-2] % INT4_GROUP == 0:
                 q, sc = _quantize_int4(v)
                 qd[k] = q
@@ -144,8 +151,14 @@ def _quantize_flat(
                 q, sc = _quantize_int8(v)
                 qd[k] = q
                 qd[k + QUANT_SCALE_SUFFIX] = sc
+        elif is_float_like(v) and v.dtype.itemsize < 4:
+            # Sub-fp32 floats (bf16, fp16) up-cast EXACTLY to the
+            # documented "1-D tensors stay exact in float32" contract —
+            # fp16 passing through unchanged silently broke the
+            # planner's byte estimates for fp16-source checkpoints.
+            qd[k] = np.asarray(v, np.float32)
         else:
-            qd[k] = np.asarray(v, np.float32) if v.dtype == _BFLOAT16 else v
+            qd[k] = v
     return qd
 
 
@@ -159,6 +172,46 @@ def is_quantized_leaf(node) -> bool:
 def quant_kind(node) -> str:
     """'q8' or 'q4' for a quantized leaf-group."""
     return "q8" if "q8" in node else "q4"
+
+
+def flat_dtype_kind(flat: dict[str, Any]) -> str:
+    """Storage-dtype kind of one layer file's flat tensor dict — the ONE
+    derivation shared by the manifest writer (``layer_entry`` records it
+    per layer) and the load-path check (``load_layer`` compares it), so
+    the two can never desync. 'int4' when any group-scale twin is
+    present (int8 per-tensor fallbacks inside an int4 file keep the int4
+    kind — leaves self-describe), 'int8' for per-channel scales, else
+    the dtype name of the layer's largest float tensor ('bfloat16',
+    'float32', ...) or 'none' for a float-free file."""
+    keys = flat.keys()
+    if any(k.endswith(QUANT4_SCALE_SUFFIX) for k in keys):
+        return "int4"
+    if any(k.endswith(QUANT_SCALE_SUFFIX) for k in keys):
+        return "int8"
+    best = None
+    for k in sorted(keys):
+        a = np.asarray(flat[k])
+        if is_float_like(a):
+            if best is None or a.nbytes > best.nbytes:
+                best = a
+    return best.dtype.name if best is not None else "none"
+
+
+def simulate_quantized(a: np.ndarray, dtype: str) -> np.ndarray:
+    """Quantize->dequantize round trip of ONE kernel under exactly the
+    branch rule ``_quantize_flat`` materializes (int4 falls back to
+    per-output-channel int8 when the in-dim is off the group) — float32
+    out. The sensitivity probe (runtime/precisionplan.py) scores layers
+    through this, so what it measures is what ``requantize_native``
+    later writes and ``executor._dequant_tree`` later computes."""
+    if dtype not in ("int8", "int4"):
+        raise ValueError(f"simulate_quantized: unsupported dtype {dtype!r}")
+    a32 = np.asarray(a, np.float32)
+    if dtype == "int4" and a32.ndim >= 2 and a32.shape[-2] % INT4_GROUP == 0:
+        q, s = _quantize_int4(a32)
+        return dequantize_np({"q4": q, "s": s}).astype(np.float32)
+    q, s = _quantize_int8(a32)
+    return dequantize_np({"q8": q, "s": s}).astype(np.float32)
 
 
 def dequant4_math(b, s, xp):
@@ -678,7 +731,10 @@ def split_into_layers(
             )
         sd = {k: state[k] for k in layer2keys[layer]}
         if cast is not None:
-            sd = {k: np.asarray(v, dtype=cast) if np.issubdtype(np.asarray(v).dtype, np.floating) or v.dtype == _BFLOAT16 else v for k, v in sd.items()}
+            sd = {
+                k: np.asarray(v, dtype=cast) if is_float_like(v) else v
+                for k, v in sd.items()
+            }
         if layout == "native":
             sd = hf_layer_to_native(layer, sd)
         if quantize:
@@ -725,6 +781,19 @@ if _BFLOAT16 is not None:
     _ST_DTYPES["BF16"] = _BFLOAT16
 
 
+def safetensors_header(path: str) -> tuple[dict[str, dict], int]:
+    """Parse a safetensors file's header WITHOUT touching the payload:
+    ``({key: {"dtype": tag, "shape": [...], "data_offsets": [b, e]}},
+    payload_base_offset)``. One small read — byte-accounting estimators
+    (``residency.layer_stream_bytes``) use it to see a layer's stored
+    shapes/dtypes without faulting a multi-GB payload into RAM."""
+    with open(path, "rb") as f:
+        n = int.from_bytes(f.read(8), "little")
+        header = json.loads(f.read(n))
+    header.pop("__metadata__", None)
+    return header, 8 + n
+
+
 def _mmap_safetensors(path: str) -> dict[str, np.ndarray]:
     """True zero-copy safetensors read: parse the header, then return
     read-only ``np.memmap`` views into the payload.
@@ -737,13 +806,9 @@ def _mmap_safetensors(path: str) -> dict[str, np.ndarray]:
     the transfer itself. Falls back to the library loader for any dtype tag
     this table doesn't know.
     """
-    with open(path, "rb") as f:
-        n = int.from_bytes(f.read(8), "little")
-        header = json.loads(f.read(n))
-    header.pop("__metadata__", None)
+    header, base = safetensors_header(path)
     if any(m["dtype"] not in _ST_DTYPES for m in header.values()):
         return st_load_file(path)
-    base = 8 + n
     mm = np.memmap(path, mode="r", dtype=np.uint8)
     out = {}
     for k, meta in header.items():
@@ -828,6 +893,24 @@ def load_layer(
             )
             if not injected:
                 integrity_manifest.record_verdict(token)
+        # Per-layer PRECISION check, on every load (cheap — a key scan
+        # plus header dtypes, independent of the crc verdict cache): the
+        # file's actual storage-dtype kind must match what the manifest
+        # declares for this layer. Catches a silently swapped file whose
+        # precision disagrees with the mixed-precision plan the manifest
+        # was written against — typed and structural, never retried.
+        entry = manifest.get("layers", {}).get(layer_name) or {}
+        want_kind = entry.get("dtype")
+        if want_kind is not None:
+            got_kind = flat_dtype_kind(flat)
+            if got_kind != want_kind:
+                raise integrity_manifest.PrecisionMismatch(
+                    f"{path}: layer {layer_name!r} stores dtype kind "
+                    f"{got_kind!r} but the integrity manifest declares "
+                    f"{want_kind!r} — the file does not match the "
+                    "precision the checkpoint was prepared at (audit "
+                    "with the `verify` CLI subcommand)"
+                )
     if not _is_native(flat.keys()):
         flat = hf_layer_to_native(layer_name, flat)
     if any(k.endswith((QUANT_SCALE_SUFFIX, QUANT4_SCALE_SUFFIX)) for k in flat):
@@ -846,17 +929,70 @@ def load_layer(
     return native_to_pytree(layer_name, flat)
 
 
+def _cast_flat_bf16(sd: dict[str, np.ndarray]) -> dict[str, np.ndarray]:
+    """Cast every float tensor to bfloat16 — the SAME uniform cast rule
+    ``split_into_layers(dtype='bfloat16')`` applies, so a plan's bf16
+    layers are bit-identical to the uniform-bf16 baseline checkpoint."""
+    if _BFLOAT16 is None:
+        raise ImportError("dtype='bfloat16' requires ml_dtypes")
+    return {
+        k: np.asarray(v, dtype=_BFLOAT16) if is_float_like(v) else v
+        for k, v in sd.items()
+    }
+
+
+def _encode_flat(sd: dict[str, np.ndarray], dtype: str) -> dict[str, np.ndarray]:
+    """One layer's flat native tensors re-encoded at ``dtype`` — the
+    per-layer primitive requantize_native applies uniformly or per a
+    PrecisionPlan. Plan dtype 'bf16' aliases the storage name."""
+    if dtype in ("bfloat16", "bf16"):
+        return _cast_flat_bf16(sd)
+    return _quantize_flat(sd, dtype)
+
+
 def requantize_native(
-    src_dir: str, out_dir: str, dtype: str = "int8"
+    src_dir: str, out_dir: str, dtype: str = "int8", plan=None
 ) -> list[str]:
     """Re-encode an existing NATIVE per-layer checkpoint dir as int8
-    (per-output-channel) or int4 (group-wise packed) — same conventions as
-    ``split_into_layers(dtype=...)`` — without going back through the HF
-    source. Copies aux files (config.json, tokenizer) alongside. Returns
-    the layer names converted."""
-    if dtype not in ("int8", "int4"):
+    (per-output-channel), int4 (group-wise packed), bfloat16 (cast only)
+    — same conventions as ``split_into_layers(dtype=...)`` — or, with
+    ``plan`` (a ``runtime.precisionplan.PrecisionPlan``), at a PER-LAYER
+    dtype mix, without going back through the HF source. A plan must
+    cover every layer file (a partial plan raises — silently defaulting
+    a layer's precision is exactly the drift the plan artifact exists to
+    prevent); the plan is embedded in the output dir
+    (``precision_plan.json``) and the fresh integrity manifest records
+    each layer's dtype kind, so the `verify` audit and the load path can
+    both detect a plan/file mismatch as a typed error. Copies aux files
+    (config.json, tokenizer) alongside. Returns the layer names
+    converted."""
+    if plan is None and dtype not in ("int8", "int4", "bfloat16"):
         raise ValueError(f"requantize_native: unsupported dtype {dtype!r}")
+    if plan is not None:
+        # Coverage validated BOTH ways BEFORE the first byte is written:
+        # a drifted plan must fail up front, not strand a half-quantized
+        # output dir (layer files but no manifest, no embedded plan —
+        # which would later load unverified) after hours of work.
+        on_disk = {
+            fn[: -len(LAYER_FILE_SUFFIX)]
+            for fn in os.listdir(src_dir)
+            if fn.endswith(LAYER_FILE_SUFFIX)
+        }
+        missing = on_disk - set(plan.dtypes)
+        extra = set(plan.dtypes) - on_disk
+        if missing or extra:
+            raise ValueError(
+                f"precision plan and {src_dir} drifted: layers on disk "
+                f"with no plan entry {sorted(missing)}; planned layers "
+                f"with no file {sorted(extra)}"
+            )
     os.makedirs(out_dir, exist_ok=True)
+    # Function-level import (checkpoint is imported by precisionplan at
+    # module scope; by requantize time both are importable).
+    from flexible_llm_sharding_tpu.runtime.precisionplan import (
+        PLAN_NAME as _PLAN_NAME,
+    )
+
     done = []
     manifest_layers: dict[str, dict] = {}
     for fn in sorted(os.listdir(src_dir)):
@@ -864,14 +1000,17 @@ def requantize_native(
         if not fn.endswith(LAYER_FILE_SUFFIX):
             # The source's integrity manifest must NOT ride along — its
             # checksums describe the float tensors, not the re-encoded
-            # ones; a fresh manifest is written below.
+            # ones; a fresh manifest is written below. A source-embedded
+            # precision plan is stale for the same reason.
             if (
                 os.path.isfile(src)
                 and fn != NATIVE_LAYOUT_MARKER
                 and fn != integrity_manifest.MANIFEST_NAME
+                and fn != _PLAN_NAME
             ):
                 shutil.copy(src, os.path.join(out_dir, fn))
             continue
+        layer_name = fn[: -len(LAYER_FILE_SUFFIX)]
         flat = _mmap_safetensors(src)
         if not _is_native(flat.keys()):
             raise ValueError(f"{fn}: not native layout (run split_into_layers)")
@@ -885,15 +1024,25 @@ def requantize_native(
                 f"{fn}: source is already quantized; requantize from the "
                 "original float checkpoint"
             )
-        qd = _quantize_flat(flat, dtype)
+        layer_dtype = dtype if plan is None else plan.dtype_for(layer_name)
+        qd = _encode_flat(flat, layer_dtype)
         stored = {k: np.ascontiguousarray(v) for k, v in qd.items()}
         st_save_file(stored, os.path.join(out_dir, fn))
-        manifest_layers[fn[: -len(LAYER_FILE_SUFFIX)]] = (
-            integrity_manifest.layer_entry(stored, fn)
+        manifest_layers[layer_name] = integrity_manifest.layer_entry(
+            stored, fn
         )
-        done.append(fn[: -len(LAYER_FILE_SUFFIX)])
+        done.append(layer_name)
+    if plan is not None:
+        plan.save(out_dir)
     with open(os.path.join(out_dir, NATIVE_LAYOUT_MARKER), "w") as f:
-        json.dump({"layout": "native", "dtype": dtype, "layers": done}, f)
+        json.dump(
+            {
+                "layout": "native",
+                "dtype": "mixed" if plan is not None else dtype,
+                "layers": done,
+            },
+            f,
+        )
     integrity_manifest.write_manifest(out_dir, manifest_layers)
     return done
 

@@ -4,17 +4,26 @@
 ``--dtype bfloat16`` casts at split time and ``--layout native`` (default)
 pre-transposes kernels to the framework's [in, out] layout so the streaming
 hot path is a zero-copy mmap. ``--layout hf`` emits reference-identical files.
+``--precision_plan plan.json`` materializes a per-layer MIXED-precision
+checkpoint (int4/int8/bf16 chosen per layer — docs/precision.md) from an
+already-split NATIVE float dir; build the plan with the ``plan-precision``
+CLI subcommand.
 """
 
 import argparse
 import sys
 
-from flexible_llm_sharding_tpu.utils.checkpoint import split_into_layers
+from flexible_llm_sharding_tpu.utils.checkpoint import (
+    requantize_native,
+    split_into_layers,
+)
 
 
 def main(argv=None) -> None:
     p = argparse.ArgumentParser(description=__doc__)
-    p.add_argument("bin_dir", help="HF checkpoint dir (.bin or .safetensors)")
+    p.add_argument("bin_dir", help="HF checkpoint dir (.bin or .safetensors); "
+                                   "with --precision_plan: a NATIVE float "
+                                   "per-layer dir (already split)")
     p.add_argument("new_file_dir", help="output dir for per-layer files")
     p.add_argument(
         "--dtype",
@@ -25,7 +34,36 @@ def main(argv=None) -> None:
         "int4 = group-wise packed nibbles (a quarter of the bf16 bytes)",
     )
     p.add_argument("--layout", default="native", choices=["native", "hf"])
+    p.add_argument(
+        "--precision_plan",
+        default=None,
+        help="PrecisionPlan JSON (from the `plan-precision` CLI "
+        "subcommand): re-encode a NATIVE float per-layer dir at a "
+        "per-layer int4/int8/bf16 mix; the plan is embedded in the "
+        "output and every layer's dtype lands in the integrity manifest",
+    )
     args = p.parse_args(argv)
+    if args.precision_plan is not None:
+        if args.dtype is not None:
+            raise SystemExit(
+                "--precision_plan chooses each layer's dtype itself; "
+                "drop --dtype"
+            )
+        import json
+
+        from flexible_llm_sharding_tpu.runtime.precisionplan import (
+            PrecisionPlan,
+        )
+
+        with open(args.precision_plan) as f:
+            plan = PrecisionPlan.from_json(json.load(f))
+        layers = requantize_native(args.bin_dir, args.new_file_dir, plan=plan)
+        print(
+            f"wrote {len(layers)} mixed-precision layer files to "
+            f"{args.new_file_dir}",
+            file=sys.stderr,
+        )
+        return
     layers = split_into_layers(
         args.bin_dir,
         args.new_file_dir,

@@ -1422,3 +1422,82 @@ def test_knob_sync_pressure_flags_map_and_desync_fires(tmp_path):
     assert any(
         "pressure_poll_s" in m for m in msgs(res.findings, "KNOB-SYNC")
     )
+
+
+# ---------------------------------------------------------------------------
+# QUANT-MANIFEST
+# ---------------------------------------------------------------------------
+
+QUANT_MANIFEST_BAD = """
+from safetensors.numpy import save_file as st_save_file
+def write_layer(flat, path):
+    st_save_file(flat, path)
+"""
+
+QUANT_MANIFEST_GOOD = """
+from safetensors.numpy import save_file as st_save_file
+from flexible_llm_sharding_tpu.integrity import manifest as integrity_manifest
+def write_layer(flat, path, manifest_layers):
+    st_save_file(flat, path)
+    manifest_layers["x"] = integrity_manifest.layer_entry(flat, "x.safetensors")
+"""
+
+# The save_params shape: the pairing lives inside a NESTED helper, which
+# is its own scope — the outer function must not be flagged for calls it
+# never makes, and the inner one pairs correctly.
+QUANT_MANIFEST_NESTED = """
+from safetensors.numpy import save_file as st_save_file
+from flexible_llm_sharding_tpu.integrity import manifest as integrity_manifest
+def save_all(layers, out):
+    manifest_layers = {}
+    def _save(name, flat):
+        st_save_file(flat, name)
+        manifest_layers[name] = integrity_manifest.layer_entry(flat, name)
+    for name, flat in layers.items():
+        _save(name, flat)
+"""
+
+
+def test_quant_manifest_positive():
+    """A layer-file writer with no layer_entry in the same function is a
+    finding: the manifest's per-layer dtype kind is what the load path's
+    PrecisionMismatch check audits, and a writer that skips it emits
+    files the check can never type."""
+    found = analyze_source(
+        QUANT_MANIFEST_BAD, "utils/x.py", select=["QUANT-MANIFEST"]
+    )
+    assert rules_of(found) == ["QUANT-MANIFEST"]
+    assert "layer_entry" in found[0].message
+
+
+def test_quant_manifest_negative_paired_and_nested():
+    assert (
+        analyze_source(
+            QUANT_MANIFEST_GOOD, "utils/x.py", select=["QUANT-MANIFEST"]
+        )
+        == []
+    )
+    assert (
+        analyze_source(
+            QUANT_MANIFEST_NESTED, "utils/x.py", select=["QUANT-MANIFEST"]
+        )
+        == []
+    )
+
+
+def test_quant_manifest_nested_unpaired_fires():
+    """The nested helper is its own scope: a save inside it with the
+    layer_entry only in the OUTER function does not count as paired."""
+    src = """
+from safetensors.numpy import save_file as st_save_file
+from flexible_llm_sharding_tpu.integrity import manifest as integrity_manifest
+def save_all(layers, out):
+    integrity_manifest.layer_entry({}, "decoy")
+    def _save(name, flat):
+        st_save_file(flat, name)
+    for name, flat in layers.items():
+        _save(name, flat)
+"""
+    found = analyze_source(src, "utils/x.py", select=["QUANT-MANIFEST"])
+    assert rules_of(found) == ["QUANT-MANIFEST"]
+    assert found[0].symbol.endswith("_save")

@@ -800,3 +800,643 @@ def test_requantize_rejects_quantized_source(dirs4, tmp_path):
     _, q4 = dirs4
     with pytest.raises(ValueError, match="already quantized"):
         ckpt.requantize_native(q4, str(tmp_path / "bad"), dtype="int8")
+
+
+# ---------------------------------------------------------------------------
+# Per-layer mixed precision (ISSUE 14): sensitivity-planned int4/int8/bf16
+# ---------------------------------------------------------------------------
+
+from flexible_llm_sharding_tpu.integrity.manifest import (  # noqa: E402
+    PrecisionMismatch,
+    load_manifest,
+)
+from flexible_llm_sharding_tpu.runtime import precisionplan as pp  # noqa: E402
+
+
+def _mixed_plan() -> pp.PrecisionPlan:
+    """The suite's hand-built plan: bf16 layer 0 + int8 middle + int4
+    elsewhere (the ISSUE's canonical shape)."""
+    return pp.PrecisionPlan(
+        layers=(
+            ("model.embed_tokens", "int4"),
+            ("model.layers.0", "bf16"),
+            ("model.layers.1", "int8"),
+            ("model.layers.2", "int4"),
+            ("model.layers.3", "int4"),
+            ("model.norm", "bf16"),
+            ("lm_head", "int4"),
+        ),
+        divergence_cap=1.0,
+    )
+
+
+@pytest.fixture(scope="module")
+def dirs_mixed(tiny_cfg, tmp_path_factory):
+    """(f32_dir, uniform_bf16_dir, mixed_dir, plan)."""
+    params = llama.init_params(jax.random.PRNGKey(7), tiny_cfg)
+    base = tmp_path_factory.mktemp("mixed")
+    f32 = base / "f32"
+    save_params(jax.tree.map(np.asarray, params), str(f32), tiny_cfg)
+    bf16 = base / "bf16"
+    ckpt.requantize_native(str(f32), str(bf16), dtype="bfloat16")
+    plan = _mixed_plan()
+    mixed = base / "mixed"
+    ckpt.requantize_native(str(f32), str(mixed), plan=plan)
+    return str(f32), str(bf16), str(mixed), plan
+
+
+def _mixed_oracle_params(mixed_dir: str, cfg: LlamaConfig):
+    """Host oracle from the ACTUAL mixed files: quantized leaf-groups
+    dequantized per layer, bf16 tensors cast to f32 (exactly what the
+    on-device dequant + cast land in HBM)."""
+    def fix(tree):
+        return jax.tree.map(
+            lambda n: (
+                ckpt.dequantize_np(n)
+                if ckpt.is_quantized_leaf(n)
+                else np.asarray(n, np.float32)
+            ),
+            tree,
+            is_leaf=ckpt.is_quantized_leaf,
+        )
+
+    out = {
+        "embed": fix(ckpt.load_layer(mixed_dir, "model.embed_tokens")),
+        "layers": [
+            fix(ckpt.load_layer(mixed_dir, f"model.layers.{i}"))
+            for i in range(cfg.num_hidden_layers)
+        ],
+        "norm": fix(ckpt.load_layer(mixed_dir, "model.norm")),
+        "lm_head": fix(ckpt.load_layer(mixed_dir, "lm_head")),
+    }
+    return jax.tree.map(jnp.asarray, out)
+
+
+def test_mixed_precision_streaming_matches_oracle(dirs_mixed, tiny_cfg):
+    """The machinery invariant for a HETEROGENEOUS checkpoint: streaming
+    the mixed dir (per-layer int4/int8/bf16 over the link, per-leaf
+    on-device dequant/cast) equals the monolithic forward of the same
+    network dequantized per layer on host. layer_num_per_shard=2 makes
+    adjacent layers with DIFFERENT precisions land in one shard — the
+    loader must split the scan runs at every structure change."""
+    _, _, mixed, _ = dirs_mixed
+    fw = FrameworkConfig(
+        model_path=mixed,
+        dtype="float32",
+        bucket_multiple=8,
+        layer_num_per_shard=2,
+        prefetch_depth=1,
+    )
+    got = StreamingExecutor(fw, tokenizer=FakeTokenizer())(PROMPTS)
+    params = _mixed_oracle_params(mixed, tiny_cfg)
+    tok = PromptTokenizer(FakeTokenizer(), bucket_multiple=8)
+    for (prefix, suffixes), sc in zip(PROMPTS, got):
+        t = tok(prefix, suffixes)
+        for s in range(t.num_suffixes):
+            n_real = int(t.suffix_eos[s]) + 1
+            full = np.concatenate(
+                [t.prefix_ids[: t.prefix_len], t.suffix_ids[s, :n_real]]
+            )[None, :]
+            logits = llama.forward_full(params, tiny_cfg, jnp.asarray(full))
+            want = np.asarray(jax.nn.softmax(logits[0, -1]))
+            np.testing.assert_allclose(sc[s, 0], want, rtol=2e-4, atol=2e-5)
+
+
+def test_mixed_bf16_layers_bit_identical_to_uniform(dirs_mixed):
+    """The plan's bf16 layers must be BIT-identical to the uniform-bf16
+    baseline's files, tensor for tensor — same cast rule, zero extra
+    rounding (the acceptance criterion's quality half)."""
+    _, bf16, mixed, plan = dirs_mixed
+    bf16_layers = [n for n, d in plan.layers if d == "bf16"]
+    assert bf16_layers  # the plan must actually exercise the claim
+    for name in bf16_layers:
+        a = ckpt._mmap_safetensors(
+            os.path.join(bf16, f"{name}{ckpt.LAYER_FILE_SUFFIX}")
+        )
+        b = ckpt._mmap_safetensors(
+            os.path.join(mixed, f"{name}{ckpt.LAYER_FILE_SUFFIX}")
+        )
+        assert set(a) == set(b)
+        for k in a:
+            assert a[k].dtype == b[k].dtype
+            assert np.array_equal(
+                np.asarray(a[k]).view(np.uint8),
+                np.asarray(b[k]).view(np.uint8),
+            ), f"{name}/{k} drifted from the uniform bf16 encoding"
+
+
+def test_mixed_manifest_dtypes_and_verify_audit(dirs_mixed):
+    """The fresh integrity manifest records each layer's dtype kind, the
+    plan is embedded, and the strict `verify` audit passes the dir —
+    then catches a plan edit that no longer matches the files."""
+    import json as _json
+
+    from flexible_llm_sharding_tpu.integrity.verify import verify_model_dir
+
+    _, _, mixed, plan = dirs_mixed
+    man = load_manifest(mixed)
+    kinds = {k: v["dtype"] for k, v in man["layers"].items()}
+    assert kinds["model.layers.0"] == "bfloat16"
+    assert kinds["model.layers.1"] == "int8"
+    assert kinds["model.layers.2"] == "int4"
+    assert kinds["model.embed_tokens"] == "int4"
+    report = verify_model_dir(mixed)
+    assert report["ok"], report["problems"]
+    assert report["plan_layers_checked"] == len(plan.layers)
+
+    # Flip one plan entry on disk: the audit must flag the layer whose
+    # file/manifest no longer match the declared precision.
+    path = os.path.join(mixed, pp.PLAN_NAME)
+    with open(path) as f:
+        data = _json.load(f)
+    data["layers"]["model.layers.1"] = "bf16"
+    with open(path, "w") as f:
+        _json.dump(data, f)
+    try:
+        report = verify_model_dir(mixed)
+        assert not report["ok"]
+        assert any(
+            p["status"] == "precision_mismatch" for p in report["problems"]
+        )
+    finally:
+        plan.save(mixed)  # restore for the other module tests
+
+
+def test_precision_mismatch_is_typed_at_load(dirs, tmp_path):
+    """Manifest-vs-file precision drift is the typed PrecisionMismatch,
+    not a crc error and not a retry storm: a manifest whose dtype entry
+    disagrees with the (checksum-clean) file fails the load with the
+    ShardLoadError-family error the serving degrade path understands."""
+    _, q8, _ = dirs
+    man = load_manifest(q8)
+    bad = {
+        "layers": {
+            **man["layers"],
+            "model.layers.1": {
+                **man["layers"]["model.layers.1"],
+                "dtype": "int4",
+            },
+        }
+    }
+    with pytest.raises(PrecisionMismatch, match="dtype kind 'int8'"):
+        ckpt.load_layer(q8, "model.layers.1", manifest=bad)
+    # Untouched entries still load clean.
+    ckpt.load_layer(q8, "model.layers.0", manifest=man)
+
+
+def test_plan_manifest_mismatch_typed_at_source_construction(
+    dirs_mixed, tiny_cfg, tmp_path
+):
+    """An embedded plan that disagrees with the manifest fails at LOADER
+    construction (two JSON files, no tensor reads) — before any wrong-
+    precision byte crosses the link."""
+    import json as _json
+    import shutil
+
+    from flexible_llm_sharding_tpu.runtime.executor import _HostShardLoader
+
+    _, _, mixed, plan = dirs_mixed
+    broken = tmp_path / "broken"
+    shutil.copytree(mixed, broken)
+    path = os.path.join(broken, pp.PLAN_NAME)
+    with open(path) as f:
+        data = _json.load(f)
+    data["layers"]["model.layers.1"] = "bf16"  # manifest says int8
+    with open(path, "w") as f:
+        _json.dump(data, f)
+    names = ckpt.layer_names_for(tiny_cfg.num_hidden_layers, False)
+    with pytest.raises(PrecisionMismatch, match="planned 'bf16'"):
+        _HostShardLoader(str(broken), names, np.float32)
+
+
+def test_planner_determinism(dirs_mixed):
+    """Same calibration batch + same budget -> bit-identical plan (the
+    probe is RNG- and clock-free; greedy ties break by layer index)."""
+    f32, _, _, _ = dirs_mixed
+    budget = int(
+        sum(
+            pp.layer_dtype_bytes(ckpt.load_layer(f32, n))["bf16"]
+            for n in ckpt.layer_names_for(4, False)
+        )
+        * 0.6
+    )
+    a = pp.build_plan(f32, PROMPTS[:1], FakeTokenizer(), bytes_budget=budget)
+    b = pp.build_plan(f32, PROMPTS[:1], FakeTokenizer(), bytes_budget=budget)
+    assert a.layers == b.layers
+    assert a.est_bytes == b.est_bytes
+    assert a.measured_divergence == b.measured_divergence
+    assert a.est_bytes <= budget
+    sens_a = pp.probe_sensitivity(f32, PROMPTS[:1], FakeTokenizer())
+    sens_b = pp.probe_sensitivity(f32, PROMPTS[:1], FakeTokenizer())
+    assert sens_a == sens_b
+
+
+def test_plan_from_sensitivity_modes():
+    """Greedy semantics, both constraint modes, on a synthetic table:
+    budget mode downgrades the least-sensitive layer first; cap mode
+    upgrades the most-relief-per-byte layer first."""
+    names = ["a", "b"]
+    sizes = {
+        n: {"bf16": 100, "int8": 55, "int4": 30} for n in names
+    }
+    sens = {
+        "a": {"int8": 0.001, "int4": 0.01},
+        "b": {"int8": 0.1, "int4": 0.5},
+    }
+    plan = pp.plan_from_sensitivity(
+        names, sizes, sens, bytes_budget=155
+    )
+    assert plan.dtypes == {"a": "int8", "b": "bf16"}
+    assert plan.est_bytes == 155
+    plan = pp.plan_from_sensitivity(
+        names, sizes, sens, divergence_cap=0.011
+    )
+    assert plan.dtypes == {"a": "int4", "b": "bf16"}
+    assert plan.divergence_cap == 0.011
+    # A layer where quantization saves nothing lands at bf16 (dominance:
+    # lossless AND no more bytes).
+    sizes["c"] = {"bf16": 10, "int8": 20, "int4": 20}
+    sens["c"] = {"int8": 0.0, "int4": 0.0}
+    plan = pp.plan_from_sensitivity(
+        names + ["c"], sizes, sens, divergence_cap=1.0
+    )
+    assert plan.dtypes["c"] == "bf16"
+    # Stuck-rung regression: a layer whose int4 encoding falls back to
+    # int8 entirely (same bytes, same divergence) has a zero-relief
+    # int4->int8 step — cap mode must still reach bf16 through the
+    # multi-rung move, or the plan would violate its own declared cap.
+    plan = pp.plan_from_sensitivity(
+        ["d"],
+        {"d": {"bf16": 100, "int8": 55, "int4": 55}},
+        {"d": {"int8": 0.5, "int4": 0.5}},
+        divergence_cap=0.01,
+    )
+    assert plan.dtypes == {"d": "bf16"}
+    assert plan.est_divergence <= 0.01
+
+
+def test_layer_dtype_bytes_matches_materialized(dirs_mixed, tiny_cfg):
+    """The planner's shapes-only byte estimates equal the converter's
+    actual packed output, layer for layer and dtype for dtype — the
+    estimate can never drift to the dequantized logical size."""
+    f32, bf16, mixed, plan = dirs_mixed
+    for name, dt in plan.layers:
+        est = pp.layer_dtype_bytes(ckpt.load_layer(f32, name))[dt]
+        src = mixed if dt != "bf16" else bf16
+        flat = ckpt._mmap_safetensors(
+            os.path.join(src, f"{name}{ckpt.LAYER_FILE_SUFFIX}")
+        )
+        actual = sum(np.asarray(v).nbytes for v in flat.values())
+        assert est == actual, (name, dt, est, actual)
+
+
+def test_mixed_composes_with_tensor_parallel(tmp_path):
+    """Mixed precision + TP: per-leaf sharding adaptation (q4 group
+    scales, q8 channel scales, raw bf16) must reproduce the single-
+    device mixed run exactly. hidden=128 keeps every row shard on whole
+    int4 groups."""
+    from flexible_llm_sharding_tpu.parallel.sharding import TpPlacement
+
+    cfg = LlamaConfig(
+        vocab_size=256,
+        hidden_size=128,
+        intermediate_size=256,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        rms_norm_eps=1e-5,
+        rope_theta=10000.0,
+        max_position_embeddings=512,
+        tie_word_embeddings=False,
+    )
+    params = llama.init_params(jax.random.PRNGKey(3), cfg)
+    f32 = tmp_path / "f32"
+    save_params(jax.tree.map(np.asarray, params), str(f32), cfg)
+    plan = pp.PrecisionPlan(
+        layers=(
+            ("model.embed_tokens", "int8"),
+            ("model.layers.0", "bf16"),
+            ("model.layers.1", "int4"),
+            ("model.norm", "bf16"),
+            ("lm_head", "int8"),
+        ),
+        divergence_cap=1.0,
+    )
+    mixed = tmp_path / "mixed"
+    ckpt.requantize_native(str(f32), str(mixed), plan=plan)
+    fw = FrameworkConfig(
+        model_path=str(mixed), dtype="float32", bucket_multiple=8,
+        prefetch_depth=0,
+    )
+    single = StreamingExecutor(fw, tokenizer=FakeTokenizer())(PROMPTS)
+    pl = TpPlacement(jax.devices()[:2], cfg)
+    sharded = StreamingExecutor(fw, device=pl, tokenizer=FakeTokenizer())(
+        PROMPTS
+    )
+    for a, b in zip(single, sharded):
+        np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5)
+
+
+def test_mixed_serve_parity(dirs_mixed):
+    """Mixed precision on the SERVING path: engine completions over the
+    mixed checkpoint are token-identical to the offline KV-decode batch
+    on the same prompts."""
+    from flexible_llm_sharding_tpu.config import ServeConfig
+    from flexible_llm_sharding_tpu.runtime.decode import DecodeGenerator
+    from flexible_llm_sharding_tpu.serve import ServeEngine
+
+    _, _, mixed, _ = dirs_mixed
+    prompts = [
+        ("The capital of France", (" is Paris", " is Rome")),
+        ("Two plus two equals", (" four", " five")),
+    ]
+    fw = FrameworkConfig(
+        model_path=mixed,
+        dtype="float32",
+        bucket_multiple=8,
+        layer_num_per_shard=1,
+        storage_location="cpu",
+        block_size=2,
+        prefetch_depth=0,
+        num_gen_token=2,
+    )
+    off_scores, off_updated = DecodeGenerator(fw, tokenizer=FakeTokenizer())(
+        list(prompts)
+    )
+    engine = ServeEngine(
+        fw,
+        ServeConfig(max_wave_requests=2, default_max_new_tokens=2),
+        tokenizer=FakeTokenizer(),
+    )
+    try:
+        reqs = [engine.submit(p, s) for p, s in prompts]
+        results = [r.future.result(timeout=300) for r in reqs]
+        assert engine.drain(timeout=120)
+    finally:
+        engine.shutdown(drain=False)
+    assert engine.error is None
+    for res, want, upd in zip(results, off_scores, off_updated):
+        assert res.updated == upd
+        assert (res.scores.argmax(-1) == want.argmax(-1)).all()
+        np.testing.assert_allclose(res.scores, want, rtol=1e-5, atol=1e-6)
+
+
+def test_mixed_fleet_parity(dirs_mixed):
+    """Mixed precision under the replica fleet: 2 replicas sharing the
+    process host shard cache over the mixed checkpoint, token-identical
+    to the offline path."""
+    from flexible_llm_sharding_tpu.config import ServeConfig
+    from flexible_llm_sharding_tpu.runtime.decode import DecodeGenerator
+    from flexible_llm_sharding_tpu.serve import ReplicaFleet
+
+    _, _, mixed, _ = dirs_mixed
+    prompts = [
+        ("The capital of France", (" is Paris", " is Rome")),
+        ("Two plus two equals", (" four", " five")),
+        ("The sky is", (" blue", " green")),
+    ]
+    fw = FrameworkConfig(
+        model_path=mixed,
+        dtype="float32",
+        bucket_multiple=8,
+        layer_num_per_shard=1,
+        storage_location="cpu",
+        block_size=2,
+        prefetch_depth=0,
+        num_gen_token=2,
+    )
+    off_scores, off_updated = DecodeGenerator(fw, tokenizer=FakeTokenizer())(
+        list(prompts)
+    )
+    fleet = ReplicaFleet(
+        fw,
+        ServeConfig(
+            replicas=2,
+            max_wave_requests=2,
+            default_max_new_tokens=2,
+            router_health_poll_s=0.05,
+        ),
+        tokenizer=FakeTokenizer(),
+    )
+    try:
+        reqs = [fleet.submit(p, s) for p, s in prompts]
+        results = [r.future.result(timeout=300) for r in reqs]
+    finally:
+        fleet.shutdown(drain=True)
+    assert fleet.error is None
+    for res, want, upd in zip(results, off_scores, off_updated):
+        assert res.updated == upd
+        assert (res.scores.argmax(-1) == want.argmax(-1)).all()
+        np.testing.assert_allclose(res.scores, want, rtol=1e-5, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# Satellites: tied-head requant amortization + packed byte accounting
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def tied_q4_dir(tiny_cfg, tmp_path):
+    import dataclasses
+
+    cfg = dataclasses.replace(tiny_cfg, tie_word_embeddings=True)
+    params = llama.init_params(jax.random.PRNGKey(11), cfg)
+    hf = tmp_path / "hf"
+    _write_hf_checkpoint(params, cfg, str(hf))
+    q4 = tmp_path / "q4"
+    ckpt.split_into_layers(str(hf), str(q4), dtype="int4")
+    return str(q4), cfg
+
+
+def test_tied_head_requant_cached_across_loaders(tied_q4_dir, tiny_cfg):
+    """Satellite 1 (executor.py lm_head hot path): the tied/quantized
+    head's dequant->transpose->requant result is seated in the host
+    shard cache, so a WARM process — a fresh loader from a serve source
+    restart or a new decode call — performs ZERO requants; the process
+    counter and the cache's hit stats prove it."""
+    from flexible_llm_sharding_tpu.runtime.executor import (
+        _HostShardLoader,
+        np_dtype_for,
+        process_tied_head_requants,
+        reset_process_streamed_bytes,
+    )
+    from flexible_llm_sharding_tpu.runtime.hostcache import HostShardCache
+
+    q4, cfg = tied_q4_dir
+    names = ckpt.layer_names_for(cfg.num_hidden_layers, False)
+    head_idx = names.index("lm_head")
+    cache = HostShardCache(budget_bytes=1 << 30)
+    reset_process_streamed_bytes()
+    loader1 = _HostShardLoader(
+        q4, names, np_dtype_for("float32"), tied_embeddings=True,
+        host_cache=cache,
+    )
+    cold = loader1.build_host_shard((head_idx,))
+    assert process_tied_head_requants() == 1
+    loader1.close()
+
+    # Fresh loader, same process cache: zero additional requants AND the
+    # warm build's head segments are numerically identical to the cold
+    # build's.
+    loader2 = _HostShardLoader(
+        q4, names, np_dtype_for("float32"), tied_embeddings=True,
+        host_cache=cache,
+    )
+    hits_before = cache.stats()["hits"]
+    warm = loader2.build_host_shard((head_idx,))
+    loader2.close()
+    assert process_tied_head_requants() == 1  # zero requants when warm
+    assert cache.stats()["hits"] > hits_before
+    ck, cs = cold[0][1]["kernel"]["q8"], cold[0][1]["kernel"]["s"]
+    wk, ws = warm[0][1]["kernel"]["q8"], warm[0][1]["kernel"]["s"]
+    assert np.array_equal(ck, wk) and np.array_equal(cs, ws)
+
+
+def test_tied_head_per_loader_memo_without_cache(tied_q4_dir):
+    """With no host cache (chaos mode disables it) the per-loader memo
+    still bounds the cost at one requant per loader — never per sweep."""
+    from flexible_llm_sharding_tpu.runtime.executor import (
+        _HostShardLoader,
+        np_dtype_for,
+        process_tied_head_requants,
+        reset_process_streamed_bytes,
+    )
+
+    q4, cfg = tied_q4_dir
+    names = ckpt.layer_names_for(cfg.num_hidden_layers, False)
+    head_idx = names.index("lm_head")
+    reset_process_streamed_bytes()
+    loader = _HostShardLoader(
+        q4, names, np_dtype_for("float32"), tied_embeddings=True
+    )
+    for _ in range(3):  # three sweeps' worth of head re-streams
+        loader.build_host_shard((head_idx,))
+    loader.close()
+    assert process_tied_head_requants() == 1
+
+
+def test_layer_stream_bytes_tied_quantized_head(tied_q4_dir):
+    """Satellite 2: the tied lm_head over a quantized embedding streams
+    the int8 REQUANT (q [D, V] + fp32 scale [V]), not the embed file's
+    packed int4 bytes and certainly not the dequantized logical size —
+    the planner's estimate must equal the loader's actual built tree."""
+    from flexible_llm_sharding_tpu.runtime.executor import (
+        _HostShardLoader,
+        np_dtype_for,
+    )
+    from flexible_llm_sharding_tpu.runtime.residency import layer_stream_bytes
+
+    q4, cfg = tied_q4_dir
+    names = ckpt.layer_names_for(cfg.num_hidden_layers, False)
+    head_idx = names.index("lm_head")
+    sizes = layer_stream_bytes(q4, names, tied_embeddings=True)
+    v, d = cfg.vocab_size, cfg.hidden_size
+    want = d * v + 4 * v  # int8 payload + fp32 per-V-channel scale
+    assert sizes[head_idx] == want
+    embed_file = os.path.getsize(
+        os.path.join(q4, "model.embed_tokens.safetensors")
+    )
+    assert sizes[head_idx] != embed_file  # int4-packed file underestimates
+    # The estimate equals what the loader actually builds for upload.
+    loader = _HostShardLoader(
+        q4, names, np_dtype_for("float32"), tied_embeddings=True
+    )
+    segs = loader.build_host_shard((head_idx,))
+    loader.close()
+    built = sum(
+        a.nbytes for _, seg in segs for a in jax.tree.leaves(seg)
+    )
+    assert built == want
+
+
+def test_hostcache_charges_packed_bytes(dirs4, tiny_cfg):
+    """The hostcache budget charges quantized shard trees at their
+    PACKED size (q + scales) — the dequantized logical size would
+    overstate the entry ~4x and starve the LRU."""
+    from flexible_llm_sharding_tpu.runtime.executor import (
+        _HostShardLoader,
+        np_dtype_for,
+    )
+    from flexible_llm_sharding_tpu.runtime.hostcache import HostShardCache
+
+    _, q4 = dirs4
+    names = ckpt.layer_names_for(tiny_cfg.num_hidden_layers, False)
+    idx = names.index("model.layers.0")
+    cache = HostShardCache(budget_bytes=1 << 30)
+    loader = _HostShardLoader(
+        q4, names, np_dtype_for("float32"), host_cache=cache
+    )
+    segs = loader.build_host_shard((idx,))
+    loader.close()
+    packed = sum(a.nbytes for _, seg in segs for a in jax.tree.leaves(seg))
+    logical = sum(
+        np.asarray(a, np.float32).nbytes
+        if a.dtype != np.float32
+        else a.nbytes
+        for _, seg in segs
+        for a in jax.tree.leaves(seg)
+    )
+    assert cache.stats()["bytes"] == packed
+    assert packed < logical  # packing is the whole point
+
+
+def test_residency_plan_pins_bf16_layers_first(dirs_mixed, tiny_cfg):
+    """Residency/plan co-optimization: the bf16 decoder is the most
+    expensive to stream (largest packed file), so the size-first pin
+    order — with the embedded plan's dtype breaking size ties — buys it
+    back first: a budget sized for exactly the always-hot layers plus
+    one decoder pins the plan's bf16 decoder, not an int4 one."""
+    from flexible_llm_sharding_tpu.runtime.residency import (
+        layer_stream_bytes,
+        plan_residency,
+    )
+
+    _, _, mixed, _ = dirs_mixed
+    names = ckpt.layer_names_for(tiny_cfg.num_hidden_layers, False)
+    sizes = layer_stream_bytes(mixed, names)
+    non_decoder = sum(
+        sizes[i]
+        for i, n in enumerate(names)
+        if not n.startswith("model.layers.")
+    )
+    bf16_idx = names.index("model.layers.0")
+    budget = non_decoder + sizes[bf16_idx]
+    plan = plan_residency(mixed, names, budget)
+    decoder_pins = [
+        i for i in plan.pinned if names[i].startswith("model.layers.")
+    ]
+    assert decoder_pins == [bf16_idx]
+
+
+def test_corrupt_plan_typed_at_source_construction(dirs_mixed, tmp_path):
+    """A torn/corrupt embedded plan is the same structural defect as a
+    plan/manifest mismatch — typed PrecisionMismatch at loader
+    construction, never a bare ValueError escaping to the serve loop's
+    fatal path."""
+    import shutil
+
+    from flexible_llm_sharding_tpu.runtime.executor import _HostShardLoader
+
+    _, _, mixed, _ = dirs_mixed
+    broken = tmp_path / "torn"
+    shutil.copytree(mixed, broken)
+    with open(os.path.join(broken, pp.PLAN_NAME), "w") as f:
+        f.write('{"version": 1, "layers": {truncated')
+    names = ckpt.layer_names_for(4, False)
+    with pytest.raises(PrecisionMismatch, match="corrupt precision plan"):
+        _HostShardLoader(str(broken), names, np.float32)
+
+
+def test_quantize_flat_fp16_oned_upcasts_and_estimator_agrees():
+    """Sub-fp32 1-D floats honor the documented "stay exact in float32"
+    contract (fp16 used to pass through at 2 B/elem, silently breaking
+    the planner's estimate==materialized invariant on fp16 sources);
+    the shapes-only estimator matches the materialized bytes."""
+    sd = {
+        "scale": np.ones(8, np.float16),
+        "kern": np.ones((8, 8), np.float16),
+    }
+    qd = ckpt._quantize_flat(sd, "int8")
+    assert qd["scale"].dtype == np.float32
+    est = pp.layer_dtype_bytes(sd)
+    actual = sum(v.nbytes for v in qd.values())
+    assert est["int8"] == actual == 8 * 4 + 8 * 8 * 1 + 8 * 4
