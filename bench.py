@@ -139,6 +139,10 @@ HEADLINE_KEYS = (
     "trace_overhead_ratio_spread",
     "trace_overhead_ratio_inconclusive",
     "trace_overhead_ratio_n",
+    "recorder_overhead_ratio",
+    "recorder_overhead_ratio_spread",
+    "recorder_overhead_ratio_inconclusive",
+    "recorder_overhead_ratio_n",
     "device_kind",
 )
 
@@ -282,6 +286,7 @@ RATIO_SINGLETONS = (
     "mixedprec_divergence_cap",
     "mixedprec_plan",
     "trace_overhead_ratio",
+    "recorder_overhead_ratio",
     "spec_serve_tokens_per_sweep",
     "spec_serve_sweep_ratio",
     "spec_serve_acceptance",
@@ -358,6 +363,10 @@ PHASE_EVIDENCE_KEY = {
     # PR 8's satellite evidence: span tracing must not tax the hot path
     # (rotation-paired trace-on vs trace-off sweep walls).
     "trace_overhead": "trace_overhead_ratio",
+    # Flight-recorder satellite evidence (docs/incidents.md): journal +
+    # incident recorder armed must not tax the serving hot path
+    # (rotation-paired journal-off vs journal-armed serve walls).
+    "recorder_overhead": "recorder_overhead_ratio",
 }
 
 
@@ -1145,6 +1154,88 @@ def bench_trace_overhead(
             tracer.disable()
 
 
+def bench_recorder_overhead(
+    result: dict, prompts, tok, budget_left, fw
+) -> None:
+    """Flight-recorder satellite evidence (docs/incidents.md): durability
+    must be free on the serving hot path.
+
+    ``recorder_overhead_ratio``: an identical small SERVE session —
+    admit, prefill, decode, resolve — with the journal OFF vs the
+    journal armed to a real directory with the incident recorder
+    attached, rotation-paired back-to-back like the trace-overhead
+    phase so disk and scheduler drift cancel. The journal's emit sites
+    are failure paths only (never per token/shard/sweep), so a healthy
+    serve with the recorder armed must cost noise (~1.0); a ratio
+    sinking below ~0.85 means journaling crept onto the hot path. The
+    journal-OFF arm is the production default (one bool per failure
+    event), so the perf gate's advisory floor also pins that the no-op
+    path stays a no-op.
+    """
+    import shutil as _shutil
+
+    from flexible_llm_sharding_tpu.config import ServeConfig
+    from flexible_llm_sharding_tpu.obs import events as obs_events
+    from flexible_llm_sharding_tpu.obs import incident as obs_incident
+    from flexible_llm_sharding_tpu.serve import ServeEngine
+
+    journal_dir = os.path.join(BENCH_DIR, "recorder_journal")
+
+    def serve_once(base) -> float:
+        engine = ServeEngine(
+            base,
+            ServeConfig(max_wave_requests=4, default_max_new_tokens=4),
+            tokenizer=tok,
+            start=False,
+        )
+        t0 = time.perf_counter()
+        try:
+            reqs = [
+                engine.submit(p, s)
+                for p, s in prompts[: min(4, len(prompts))]
+            ]
+            engine.start()
+            for r in reqs:
+                r.future.result(timeout=600)
+        finally:
+            engine.shutdown(drain=True)
+        if engine.error is not None:
+            raise RuntimeError(f"recorder bench engine error: {engine.error!r}")
+        return time.perf_counter() - t0
+
+    try:
+        base = fw(None)
+        serve_once(base)  # warm/compile outside both arms
+        ratios = []
+        for i in range(3):
+            obs_events.reset_journal()
+            w_off = serve_once(base)
+            _shutil.rmtree(journal_dir, ignore_errors=True)
+            obs_events.JOURNAL.configure(journal_dir)
+            obs_events.JOURNAL.attach_recorder(
+                obs_incident.IncidentRecorder(journal_dir, settle_s=0)
+            )
+            try:
+                w_on = serve_once(base)
+            finally:
+                obs_events.reset_journal()  # a bench journal must not leak
+            ratios.append(w_off / w_on)
+            log(
+                f"recorder-overhead pair {i}: off={w_off:.2f}s "
+                f"on={w_on:.2f}s ratio={ratios[-1]:.3f}"
+            )
+            if budget_left() < 0.7:
+                log("  recorder-overhead pair budget exhausted; stopping reps")
+                break
+        _ratio_stats(result, "recorder_overhead_ratio", ratios)
+        log(f"recorder overhead: ratio={result['recorder_overhead_ratio']}")
+    except Exception:
+        log("recorder-overhead bench failed:\n" + traceback.format_exc())
+    finally:
+        obs_events.reset_journal()
+        _shutil.rmtree(journal_dir, ignore_errors=True)
+
+
 def _timed(fn) -> float:
     t0 = time.perf_counter()
     fn()
@@ -1767,6 +1858,11 @@ def run_bench(result: dict) -> None:
         log("skipping trace-overhead bench (already captured)")
     else:
         bench_trace_overhead(result, prompts, tok, budget_left, fw)
+
+    if "recorder_overhead" in skip:
+        log("skipping recorder-overhead bench (already captured)")
+    else:
+        bench_recorder_overhead(result, prompts, tok, budget_left, fw)
 
     # Host->HBM link bandwidth: the binding constraint of weight streaming;
     # makes every throughput number legible (the axon tunnel runs ~100x

@@ -6,6 +6,7 @@ Every rule here encodes an invariant a past PR review caught by hand:
 - GUARDED-BY     ``# guarded by: _lock`` attributes touched off-lock
 - KNOB-SYNC      config fields vs the two CLI parsers vs construction
 - SITE-REG       ``injector.fire("<site>")`` vs FAULT_SITES vs docs table
+- EVENT-REG      ``emit("<kind>")`` vs obs/events.EVENT_KINDS vs docs table
 - EXC-TAXONOMY   swallowing broad excepts / unchained re-raises in hot paths
 - COUNTER-EXPORT counters incremented but absent from stats()/snapshot()
 - DETERMINISM    unseeded randomness / wall-clock in faults+integrity
@@ -332,6 +333,7 @@ _FLAG_ALIASES = {
 _CHAOS_PREFIX = "chaos_"
 _PRESSURE_PREFIX = "pressure_"
 _SCHED_PREFIX = "sched_"
+_SLO_PREFIX = "slo_"
 
 # cli.py functions that thread parsed args into config constructions.
 _BATCH_READERS = (
@@ -345,6 +347,7 @@ _SERVE_READERS = (
     "_fault_config_from_args",
     "_pressure_config_from_args",
     "_sched_config_from_args",
+    "_slo_config_from_args",
 )
 
 
@@ -431,7 +434,7 @@ def _args_reads(tree: ast.Module) -> dict[str, dict[str, int]]:
 
 @project_rule(
     "KNOB-SYNC",
-    "every FrameworkConfig/ServeConfig/SchedConfig/FaultConfig/"
+    "every FrameworkConfig/ServeConfig/SchedConfig/SLOConfig/FaultConfig/"
     "PressureConfig flag exists in both CLI parsers (or is declared "
     "single-parser; serving-only classes are exempt), maps to a real "
     "field, and is threaded into the construction",
@@ -453,6 +456,7 @@ def knob_sync(ctx: ProjectContext) -> list[Finding]:
     fc = _class_fields(config.tree, "FaultConfig")
     pc = _class_fields(config.tree, "PressureConfig")
     sc = _class_fields(config.tree, "SchedConfig")
+    oc = _class_fields(config.tree, "SLOConfig")
     flags = _parser_flags(cli.tree)
     batch = flags.get("build_parser", {})
     serve = flags.get("build_serve_parser", {})
@@ -485,6 +489,10 @@ def knob_sync(ctx: ProjectContext) -> list[Finding]:
             return ("SchedConfig", "enabled") if "enabled" in sc else ("?", flag)
         if flag.startswith(_SCHED_PREFIX) and flag[len(_SCHED_PREFIX):] in sc:
             return ("SchedConfig", flag[len(_SCHED_PREFIX):])
+        if flag == "slo":
+            return ("SLOConfig", "enabled") if "enabled" in oc else ("?", flag)
+        if flag.startswith(_SLO_PREFIX) and flag[len(_SLO_PREFIX):] in oc:
+            return ("SLOConfig", flag[len(_SLO_PREFIX):])
         if flag in _FLAG_ALIASES:
             cls, field = _FLAG_ALIASES[flag]
             fields = sv if cls == "ServeConfig" else fw
@@ -521,7 +529,7 @@ def knob_sync(ctx: ProjectContext) -> list[Finding]:
                     )
                 )
                 continue
-            if cls in ("ServeConfig", "SchedConfig"):
+            if cls in ("ServeConfig", "SchedConfig", "SLOConfig"):
                 continue  # serving knobs are inherently serve-parser-only
             # "Shared" means the OTHER parser's same-named flag sets the
             # SAME field: a flag name reused for a different config class
@@ -614,9 +622,10 @@ def knob_sync(ctx: ProjectContext) -> list[Finding]:
         ("_fault_config_from_args", "serve", serve),
         ("_pressure_config_from_args", "batch", batch),
         ("_pressure_config_from_args", "serve", serve),
-        # Serve-path-only reader: SchedConfig is a serving subsystem, so
-        # its reads validate against the serve parser alone.
+        # Serve-path-only readers: SchedConfig/SLOConfig are serving
+        # subsystems, so their reads validate against the serve parser.
         ("_sched_config_from_args", "serve", serve),
+        ("_slo_config_from_args", "serve", serve),
     ):
         for attr, line in sorted(reads.get(fn_name, {}).items()):
             if attr not in parser:
@@ -738,6 +747,128 @@ def site_reg(ctx: ProjectContext) -> list[Finding]:
                     declared_line,
                     f"fault site {site!r} is missing from the docs/faults.md "
                     "site table",
+                )
+            )
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# EVENT-REG
+# ---------------------------------------------------------------------------
+
+# Journal-emit call shapes: the module-level ``obs_events.emit("<kind>",
+# ...)`` (any receiver alias) and a bare ``emit("<kind>", ...)`` import.
+# Only calls whose FIRST argument is a string literal are vocabulary
+# uses; dynamic kinds are the journal's own plumbing (events.py is
+# excluded like inject.py is for SITE-REG).
+_EVENT_EMIT_NAMES = frozenset({"emit"})
+_EVENTS_MODULE = "obs/events.py"
+
+
+@project_rule(
+    "EVENT-REG",
+    "every journal event kind literal (`emit(\"<kind>\")`) is declared "
+    "in obs/events.EVENT_KINDS and documented in docs/incidents.md's "
+    "kinds table; every declared kind is emitted somewhere",
+)
+def event_reg(ctx: ProjectContext) -> list[Finding]:
+    findings: list[Finding] = []
+    events = ctx.get(_EVENTS_MODULE)
+    declared: set[str] = set()
+    declared_line = 1
+    if events is not None:
+        for node in events.tree.body:
+            if isinstance(node, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == "EVENT_KINDS"
+                for t in node.targets
+            ):
+                try:
+                    # A dict literal literal_evals to a dict; its key
+                    # set is the declared vocabulary.
+                    declared = set(ast.literal_eval(node.value))
+                except ValueError:
+                    pass
+                declared_line = node.lineno
+    if not declared:
+        return [
+            Finding(
+                "EVENT-REG",
+                events.path if events else _EVENTS_MODULE,
+                declared_line,
+                "obs/events.EVENT_KINDS not found (journal event kinds "
+                "cannot be validated)",
+            )
+        ]
+
+    used: dict[str, tuple[str, int]] = {}
+    for info in ctx.files.values():
+        if info.relkey == _EVENTS_MODULE:
+            continue  # the journal records whatever kind it is handed
+        for node in ast.walk(info.tree):
+            if not (isinstance(node, ast.Call) and node.args):
+                continue
+            fn = node.func
+            name = (
+                fn.attr
+                if isinstance(fn, ast.Attribute)
+                else fn.id if isinstance(fn, ast.Name) else None
+            )
+            if name not in _EVENT_EMIT_NAMES:
+                continue
+            arg = node.args[0]
+            if not (isinstance(arg, ast.Constant) and isinstance(arg.value, str)):
+                continue
+            kind = arg.value
+            used.setdefault(kind, (info.path, node.lineno))
+            if kind not in declared:
+                findings.append(
+                    Finding(
+                        "EVENT-REG",
+                        info.path,
+                        node.lineno,
+                        f"journal event kind {kind!r} emitted but not "
+                        "declared in obs/events.EVENT_KINDS",
+                    )
+                )
+
+    docs_path = ctx.repo_root / "docs" / "incidents.md"
+    if not docs_path.exists():
+        findings.append(
+            Finding(
+                "EVENT-REG",
+                "docs/incidents.md",
+                1,
+                "docs/incidents.md missing — the kinds table documents "
+                "every declared journal event kind",
+            )
+        )
+        documented = None
+    else:
+        documented = set()
+        for line in docs_path.read_text().splitlines():
+            m = _DOC_SITE_RE.match(line.strip())
+            if m:
+                documented.add(m.group(1))
+
+    for kind in sorted(declared):
+        if kind not in used:
+            findings.append(
+                Finding(
+                    "EVENT-REG",
+                    events.path,
+                    declared_line,
+                    f"EVENT_KINDS declares {kind!r} but no call site "
+                    "emits it (dead registration)",
+                )
+            )
+        if documented is not None and kind not in documented:
+            findings.append(
+                Finding(
+                    "EVENT-REG",
+                    events.path,
+                    declared_line,
+                    f"journal event kind {kind!r} is missing from the "
+                    "docs/incidents.md kinds table",
                 )
             )
     return findings

@@ -206,6 +206,50 @@ def _add_observability_flags(p: argparse.ArgumentParser) -> None:
                    help="trace export path (default fls_trace.json): "
                         "Chrome trace-event JSON, or JSONL when the path "
                         "ends in .jsonl")
+    # Black-box flight recorder (obs/events.py + obs/incident.py;
+    # docs/incidents.md).
+    p.add_argument("--journal_dir", type=str, default="",
+                   help="durable append-only JSONL event journal: every "
+                        "failure-path event (engine recoveries, wave "
+                        "aborts, replica death/drain/redispatch, "
+                        "quarantines, heals, pressure steps, watchdog "
+                        "stalls, preemptions, SLO budget exhaustion) is "
+                        "written here with monotonic seq + correlation "
+                        "ids, surviving the process that emitted it. "
+                        "Rotates atomically at --journal_max_mb; a write "
+                        "failure degrades to a counted drop "
+                        "(fls_journal_events_dropped), never an error. "
+                        "Empty = off (zero overhead)")
+    p.add_argument("--journal_max_mb", type=float, default=16.0,
+                   help="journal rotation size in MB (one previous "
+                        "generation is kept)")
+    p.add_argument("--incidents_dir", type=str, default="",
+                   help="arm the incident recorder: a journal event at "
+                        "(or above) --incident_trigger severity captures "
+                        "a self-contained bundle dir here — journal "
+                        "tail, full metrics snapshot, trace ring as "
+                        "Chrome trace JSON, resolved config, manifest — "
+                        "debounced so a failure storm yields ONE bundle. "
+                        "Disk-budgeted (--incidents_max_mb), oldest "
+                        "bundle evicted first. Inspect with `cli "
+                        "incidents list/show/analyze`. Empty = off")
+    p.add_argument("--incidents_max_mb", type=float, default=256.0,
+                   help="incidents dir disk budget in MB (oldest bundles "
+                        "evicted; the newest always survives)")
+    p.add_argument("--incident_trigger", type=str, default="error",
+                   choices=("info", "warning", "error", "critical"),
+                   help="minimum journal-event severity that captures an "
+                        "incident bundle")
+    p.add_argument("--incident_debounce_s", type=float, default=60.0,
+                   help="after a capture, trigger events within this "
+                        "window only count (fls_journal_debounces) — a "
+                        "failure storm yields one bundle, not hundreds")
+    p.add_argument("--incident_settle_s", type=float, default=1.0,
+                   help="capture settles this long after the trigger "
+                        "(extended while trigger-severity events keep "
+                        "landing, bounded) so the whole storm — replica "
+                        "death, re-dispatch, recycle — lands inside the "
+                        "bundle's journal tail; 0 = capture immediately")
 
 
 def _add_sched_flags(p: argparse.ArgumentParser) -> None:
@@ -261,6 +305,49 @@ def _add_sched_flags(p: argparse.ArgumentParser) -> None:
                         "by this for interactive requests, so they land "
                         "on the replica nearest its next shard-0 "
                         "admission point (1 = no boost)")
+
+
+def _add_slo_flags(p: argparse.ArgumentParser) -> None:
+    """Serve parser only: SLO targets + error budgets (obs/slo.py;
+    docs/incidents.md has the budget math)."""
+    p.add_argument("--slo", action="store_true",
+                   help="enable SLO error-budget tracking over the "
+                        "per-class latency streams: per-class p95 TTFT "
+                        "targets, an aggregate per-token-latency target, "
+                        "and an availability target export fls_slo_* "
+                        "burn-rate/remaining-budget gauges, and a class "
+                        "that exhausts its budget emits an "
+                        "slo_budget_exhausted journal event (capturing "
+                        "an incident bundle when the recorder is armed). "
+                        "Off = the per-class exports carry no contract")
+    p.add_argument("--slo_ttft_p95_s", type=str, default="",
+                   help="per-class p95 TTFT targets in seconds, "
+                        "'interactive=0.5,standard=2.0' (unlisted "
+                        "classes carry no target)")
+    p.add_argument("--slo_token_latency_p95_s", type=float, default=0.0,
+                   help="aggregate per-token decode-latency p95 target "
+                        "in seconds (0 = off)")
+    p.add_argument("--slo_availability_target", type=float, default=0.0,
+                   help="fraction of requests that must complete, e.g. "
+                        "0.999 — failures burn the 1-target budget "
+                        "(0 = off)")
+    p.add_argument("--slo_min_samples", type=int, default=20,
+                   help="budgets are not judged below this many samples "
+                        "(a single slow first request must not page)")
+
+
+def _slo_config_from_args(args: argparse.Namespace):
+    from flexible_llm_sharding_tpu.config import SLOConfig
+
+    if not args.slo:
+        return SLOConfig()
+    return SLOConfig(
+        enabled=True,
+        ttft_p95_s=args.slo_ttft_p95_s,
+        token_latency_p95_s=args.slo_token_latency_p95_s,
+        availability_target=args.slo_availability_target,
+        min_samples=args.slo_min_samples,
+    )
 
 
 def _sched_config_from_args(args: argparse.Namespace):
@@ -447,6 +534,13 @@ def config_from_args(args: argparse.Namespace) -> FrameworkConfig:
         score_sink_max_device=args.score_sink_max_device,
         trace=args.trace,
         trace_out=args.trace_out,
+        journal_dir=args.journal_dir,
+        journal_max_mb=args.journal_max_mb,
+        incidents_dir=args.incidents_dir,
+        incidents_max_mb=args.incidents_max_mb,
+        incident_trigger=args.incident_trigger,
+        incident_debounce_s=args.incident_debounce_s,
+        incident_settle_s=args.incident_settle_s,
         faults=_fault_config_from_args(args),
         pressure=_pressure_config_from_args(args),
     )
@@ -562,6 +656,7 @@ def build_serve_parser() -> argparse.ArgumentParser:
     _add_pressure_flags(p)
     _add_observability_flags(p)
     _add_sched_flags(p)
+    _add_slo_flags(p)
     # Demo driver: submit a prompt pickle at staggered times, write the
     # offline-contract outputs. Without it, requests are read as JSON lines
     # from stdin: {"prefix": ..., "suffixes": [...], "max_new_tokens": N}.
@@ -604,6 +699,13 @@ def serve_main(argv: list[str] | None = None, tokenizer=None) -> None:
         score_sink_max_device=args.score_sink_max_device,
         trace=args.trace,
         trace_out=args.trace_out,
+        journal_dir=args.journal_dir,
+        journal_max_mb=args.journal_max_mb,
+        incidents_dir=args.incidents_dir,
+        incidents_max_mb=args.incidents_max_mb,
+        incident_trigger=args.incident_trigger,
+        incident_debounce_s=args.incident_debounce_s,
+        incident_settle_s=args.incident_settle_s,
         faults=_fault_config_from_args(args),
         pressure=_pressure_config_from_args(args),
     )
@@ -624,6 +726,7 @@ def serve_main(argv: list[str] | None = None, tokenizer=None) -> None:
         max_request_tokens=args.max_request_tokens,
         speculative_k=args.speculative_k,
         sched=_sched_config_from_args(args),
+        slo=_slo_config_from_args(args),
     )
     if tokenizer is None:
         from transformers import AutoTokenizer
@@ -954,6 +1057,93 @@ def plan_precision_main(argv: list[str] | None = None, tokenizer=None) -> None:
         )
 
 
+def build_incidents_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="flexible-llm-sharding-tpu incidents",
+        description="Inspect flight-recorder incident bundles "
+        "(--incidents_dir; docs/incidents.md): list the bundles in a "
+        "directory, show one bundle's manifest, or analyze one into a "
+        "human timeline (journal events + correlation ids + the "
+        "embedded trace's report).",
+    )
+    p.add_argument("action", choices=("list", "show", "analyze"),
+                   help="list bundles in --dir; show one bundle's "
+                        "manifest; analyze one bundle into a timeline")
+    p.add_argument("bundle", nargs="?", default=None,
+                   help="bundle directory (show/analyze)")
+    p.add_argument("--dir", type=str, default="incidents",
+                   help="incidents directory to list (default: "
+                        "./incidents)")
+    p.add_argument("--json", action="store_true",
+                   help="emit the structured report as JSON on stdout")
+    return p
+
+
+def incidents_main(argv: list[str] | None = None) -> None:
+    args = build_incidents_parser().parse_args(argv)
+    from flexible_llm_sharding_tpu.obs.report import (
+        analyze_bundle,
+        format_incident,
+        journal_tail_len,
+        load_manifest,
+    )
+
+    if args.action == "list":
+        try:
+            names = sorted(os.listdir(args.dir))
+        except OSError as e:
+            raise SystemExit(f"incidents: cannot list {args.dir}: {e}")
+        rows = []
+        for name in names:
+            path = os.path.join(args.dir, name)
+            if not name.startswith("incident-") or not os.path.isdir(path):
+                continue
+            try:
+                # Manifest + tail line count only: listing a full
+                # incidents dir must not parse every bundle's multi-MB
+                # trace export.
+                manifest = load_manifest(path)
+            except ValueError:
+                continue  # half-written/foreign dir: skip, never crash
+            trig = manifest.get("trigger", {})
+            rows.append(
+                {
+                    "bundle": name,
+                    "captured_at": manifest.get("captured_at"),
+                    "trigger": trig.get("kind"),
+                    "severity": trig.get("severity"),
+                    "journal_events": journal_tail_len(path),
+                }
+            )
+        if args.json:
+            print(json.dumps(rows))
+        elif not rows:
+            print(f"no incident bundles under {args.dir}")
+        else:
+            for r in rows:
+                print(
+                    f"{r['bundle']}  {r['captured_at']}  "
+                    f"trigger={r['trigger']} ({r['severity']})  "
+                    f"journal_events={r['journal_events']}"
+                )
+        return None
+    if not args.bundle:
+        raise SystemExit(f"incidents {args.action}: give a bundle dir")
+    try:
+        if args.action == "show":
+            manifest = load_manifest(args.bundle)
+            print(json.dumps(manifest, indent=None if args.json else 1))
+            return None
+        report = analyze_bundle(args.bundle)
+    except ValueError as e:
+        raise SystemExit(f"incidents: {e}")
+    if args.json:
+        print(json.dumps(report))
+    else:
+        print(format_incident(report))
+    return None
+
+
 def main(argv: list[str] | None = None, tokenizer=None) -> None:
     argv = sys.argv[1:] if argv is None else list(argv)
     if argv and argv[0] == "serve":
@@ -971,6 +1161,10 @@ def main(argv: list[str] | None = None, tokenizer=None) -> None:
         if rc:
             raise SystemExit(rc)
         return None
+    if argv and argv[0] == "incidents":
+        # Flight-recorder bundle inspector (obs/report.py,
+        # docs/incidents.md): list / show / analyze.
+        return incidents_main(argv[1:])
     if argv and argv[0] == "trace-report":
         # Trace analyzer (obs/report.py): link utilization, overlap
         # efficiency, sweep breakdown, TTFT/token-latency quantiles from
@@ -1098,6 +1292,13 @@ def main(argv: list[str] | None = None, tokenizer=None) -> None:
     from flexible_llm_sharding_tpu.runtime import pressure as _pressure
 
     _pressure.controller_for(cfg)
+    # Flight recorder (--journal_dir/--incidents_dir): armed here for the
+    # offline path — serve engines arm it themselves, but a batch run's
+    # failure paths (quarantines, heals, pressure events) must journal
+    # and bundle too.
+    from flexible_llm_sharding_tpu.obs import incident as _incident
+
+    _incident.ensure_configured(cfg)
 
     t0 = time.perf_counter()
     # The sampler is the peak-HBM fallback for devices whose memory_stats()

@@ -923,6 +923,32 @@ class FrameworkConfig:
     # path ends in .jsonl); ``cli trace-report`` analyzes the file.
     trace: bool = False
     trace_out: str = ""  # "" = default fls_trace.json when trace is on
+    # Black-box flight recorder (obs/events.py + obs/incident.py;
+    # docs/incidents.md). journal_dir enables the durable append-only
+    # JSONL event journal every failure-path site writes through
+    # (engine recoveries, wave aborts, replica death/drain/redispatch,
+    # quarantines, re-read heals, pressure steps, watchdog stalls,
+    # preemptions, SLO budget exhaustion). "" = off (zero cost: one
+    # bool check per failure event). The journal rotates atomically at
+    # journal_max_mb (one previous generation kept) and a write failure
+    # degrades to a counted drop, never an engine error.
+    journal_dir: str = ""
+    journal_max_mb: float = 16.0
+    # incidents_dir arms the incident recorder: a journal event at (or
+    # above) incident_trigger severity captures a self-contained bundle
+    # directory — journal tail, full metrics snapshot, trace ring as
+    # Chrome trace JSON, resolved config, manifest — debounced so a
+    # failure storm yields ONE bundle (the capture settles
+    # incident_settle_s after the trigger, extended while trigger-level
+    # events keep landing, then debounces for incident_debounce_s).
+    # The dir is disk-budgeted at incidents_max_mb, oldest evicted.
+    # Setting incidents_dir without journal_dir keeps the journal
+    # beside the bundles. "" = off.
+    incidents_dir: str = ""
+    incidents_max_mb: float = 256.0
+    incident_trigger: str = "error"  # info|warning|error|critical
+    incident_debounce_s: float = 60.0
+    incident_settle_s: float = 1.0
     resume: bool = False  # disk mode: resume from the last completed shard
     # Long context: prompts whose PREFIX exceeds max_token_len are scored
     # exactly via sequence parallelism (ring attention over an 'sp' mesh of
@@ -1085,6 +1111,19 @@ class FrameworkConfig:
             raise ValueError("readahead_threads must be >= 1")
         if self.score_sink_max_device < 1:
             raise ValueError("score_sink_max_device must be >= 1")
+        if self.journal_max_mb <= 0:
+            raise ValueError("journal_max_mb must be > 0")
+        if self.incidents_max_mb <= 0:
+            raise ValueError("incidents_max_mb must be > 0")
+        if self.incident_trigger not in ("info", "warning", "error", "critical"):
+            raise ValueError(
+                "incident_trigger must be info|warning|error|critical, "
+                f"got {self.incident_trigger!r}"
+            )
+        if self.incident_debounce_s < 0 or self.incident_settle_s < 0:
+            raise ValueError(
+                "incident_debounce_s/incident_settle_s must be >= 0"
+            )
 
     def effective_host_cache_bytes(self) -> int:
         """Resolve the tri-state ``host_cache_gb`` to a byte budget.
@@ -1302,6 +1341,66 @@ class SchedConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class SLOConfig:
+    """SLO targets + error budgets (obs/slo.py; docs/incidents.md has
+    the budget math). Off by default — the per-class latency exports
+    then carry no contract, exactly the pre-SLO behaviour.
+
+    Enabled, the tracker turns the existing ``ttft_by_class`` /
+    ``latency_by_class`` streams into error-budget accounting: a p95
+    target allows 5% of samples over the line, the burn rate is the
+    violating fraction over that allowance, and a class that exhausts
+    its budget (burn rate >= 1 with at least ``min_samples`` samples)
+    emits an ``slo_budget_exhausted`` journal event — which, with the
+    incident recorder armed, captures a bundle exactly like a crash."""
+
+    enabled: bool = False
+    # Per-class p95 TTFT targets in seconds, the tenant-map syntax:
+    # "interactive=0.5,standard=2.0" (unlisted classes carry no target).
+    ttft_p95_s: str = ""
+    # Aggregate per-token decode-latency p95 target in seconds (0 = off).
+    token_latency_p95_s: float = 0.0
+    # Availability target as a fraction of requests that must complete
+    # (e.g. 0.999); failed requests burn the 1-target budget. 0 = off.
+    availability_target: float = 0.0
+    # Budgets are not judged (no exhaustion events) below this many
+    # samples — a single slow first request must not trip a page.
+    min_samples: int = 20
+
+    def __post_init__(self) -> None:
+        targets = _parse_tenant_map(self.ttft_p95_s, "ttft_p95_s")
+        if targets:
+            # Lazy import: utils.metrics mirrors the sched class names
+            # (importing serve here would cycle); config stays light.
+            from flexible_llm_sharding_tpu.utils.metrics import (
+                SLO_CLASS_NAMES,
+            )
+        for cls, target in targets.items():
+            if cls not in SLO_CLASS_NAMES:
+                raise ValueError(
+                    f"ttft_p95_s: unknown SLO class {cls!r} "
+                    f"(one of {SLO_CLASS_NAMES})"
+                )
+            if target <= 0:
+                raise ValueError(
+                    f"ttft_p95_s: target for {cls!r} must be > 0 "
+                    "(omit the class for no target)"
+                )
+        if self.token_latency_p95_s < 0:
+            raise ValueError("token_latency_p95_s must be >= 0 (0 = off)")
+        if not 0.0 <= self.availability_target < 1.0:
+            raise ValueError(
+                "availability_target must be in [0, 1) — 0 disables, "
+                "1.0 would allow no failures ever (an unpayable budget)"
+            )
+        if self.min_samples < 1:
+            raise ValueError("min_samples must be >= 1")
+
+    def ttft_target_map(self) -> dict[str, float]:
+        return _parse_tenant_map(self.ttft_p95_s, "ttft_p95_s")
+
+
+@dataclasses.dataclass(frozen=True)
 class ServeConfig:
     """Online-serving knobs (the ``serve`` CLI subcommand / serve.engine).
 
@@ -1398,6 +1497,12 @@ class ServeConfig:
     # per-tenant fair queueing and rate limits, prefix coalescing. Off
     # by default — the queue then pops strict FIFO.
     sched: SchedConfig = dataclasses.field(default_factory=SchedConfig)
+    # SLO targets + error budgets (obs/slo.py; --slo* flags): per-class
+    # p95 TTFT targets, an aggregate token-latency target, and an
+    # availability target over the per-class latency streams PR 12
+    # exports — burn-rate/remaining-budget gauges (fls_slo_*) plus a
+    # journal event (and, armed, an incident bundle) on exhaustion.
+    slo: SLOConfig = dataclasses.field(default_factory=SLOConfig)
 
     def __post_init__(self) -> None:
         if self.queue_capacity < 1:

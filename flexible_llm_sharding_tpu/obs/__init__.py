@@ -13,5 +13,14 @@ hot-path modules can depend on it without cycles):
   optional HTTP endpoint (the serve engine's ``--metrics_port``).
 - ``obs.report``   the trace analyzer behind ``cli trace-report``:
   link utilization, compute/stream overlap efficiency, per-phase sweep
-  breakdown, TTFT / per-token latency quantiles.
+  breakdown, TTFT / per-token latency quantiles — plus the
+  incident-bundle analyzer behind ``cli incidents``.
+- ``obs.events``   the black-box flight recorder's durable append-only
+  JSONL event journal (docs/incidents.md): every failure-path site
+  writes through it; zero-cost no-op when disabled.
+- ``obs.incident`` severity-triggered incident bundles: journal tail +
+  metrics snapshot + trace ring + resolved config, debounced and
+  disk-budgeted.
+- ``obs.slo``      SLO targets + error budgets over the per-class
+  latency streams, exported as the ``fls_slo_*`` gauge family.
 """

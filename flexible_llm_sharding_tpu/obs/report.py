@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 
 # Span names whose intervals constitute "the stream is busy" for link
@@ -36,11 +37,98 @@ PRODUCE_SPAN = "shard_produce"
 WAIT_SPAN = "source_wait"
 
 
+def _bundle_manifest(path: str) -> tuple[str, dict] | None:
+    """(bundle_dir, manifest) when ``path`` is an incident bundle — the
+    bundle dir itself, its manifest.json, or a path whose parsed JSON
+    carries the bundle format marker. None otherwise."""
+    manifest_path = None
+    if os.path.isdir(path):
+        manifest_path = os.path.join(path, "manifest.json")
+    elif os.path.basename(path) == "manifest.json":
+        manifest_path = path
+    if manifest_path is None or not os.path.isfile(manifest_path):
+        return None
+    try:
+        with open(manifest_path) as f:
+            manifest = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if manifest.get("format") != "fls-incident-bundle":
+        return None
+    return os.path.dirname(manifest_path) or ".", manifest
+
+
+def load_manifest(path: str) -> dict:
+    """Just the manifest of an incident bundle — the cheap form for
+    ``incidents list``/``show``, which must not parse every bundle's
+    multi-MB trace to print a one-line summary."""
+    found = _bundle_manifest(path)
+    if found is None:
+        raise ValueError(f"{path} is not an incident bundle")
+    return found[1]
+
+
+def journal_tail_len(path: str) -> int:
+    """Event count of a bundle's journal tail (line count — no JSON
+    parse; the ``incidents list`` summary column)."""
+    found = _bundle_manifest(path)
+    if found is None:
+        return 0
+    try:
+        with open(os.path.join(found[0], "journal_tail.jsonl")) as f:
+            return sum(1 for line in f if line.strip())
+    except OSError:
+        return 0
+
+
+def load_bundle(path: str) -> dict:
+    """An incident bundle's parts: ``{"path", "manifest", "journal",
+    "metrics", "config", "trace_events"}`` — missing files load as
+    empty (a partially-captured bundle still renders)."""
+    found = _bundle_manifest(path)
+    if found is None:
+        raise ValueError(f"{path} is not an incident bundle")
+    bundle_dir, manifest = found
+
+    def load_json(name: str, default):
+        p = os.path.join(bundle_dir, name)
+        try:
+            with open(p) as f:
+                if name.endswith(".jsonl"):
+                    return [
+                        json.loads(line)
+                        for line in f.read().splitlines()
+                        if line.strip()
+                    ]
+                return json.load(f)
+        except (OSError, ValueError):
+            return default
+
+    trace_path = os.path.join(bundle_dir, "trace.json")
+    try:
+        trace_events = load_trace(trace_path)
+    except (OSError, ValueError):
+        trace_events = []
+    return {
+        "path": bundle_dir,
+        "manifest": manifest,
+        "journal": load_json("journal_tail.jsonl", []),
+        "metrics": load_json("metrics.json", {}),
+        "config": load_json("config.json", {}),
+        "trace_events": trace_events,
+    }
+
+
 def load_trace(path: str) -> list[dict]:
     """Normalized event list from a Chrome trace JSON or a JSONL export:
     ``{"name", "cat", "ts_s", "dur_s"?, ...attrs}`` per event. Format is
     detected by parsing, not extension: a whole-file JSON document is the
-    Chrome form; anything else is read line-by-line as JSONL."""
+    Chrome form; anything else is read line-by-line as JSONL. An
+    incident-bundle directory (or its manifest.json) resolves to the
+    bundle's embedded ``trace.json``."""
+    found = _bundle_manifest(path)
+    if found is not None:
+        path = os.path.join(found[0], "trace.json")
     with open(path) as f:
         text = f.read()
     doc = None
@@ -265,6 +353,112 @@ def format_report(report: dict) -> str:
     return "\n".join(lines)
 
 
+def analyze_bundle(path: str) -> dict:
+    """Structured incident report for one bundle: the manifest, journal
+    event counts by kind/severity, the correlation-id surface (replicas,
+    waves, requests the journal names), and the embedded trace's own
+    analyzer report."""
+    b = load_bundle(path)
+    journal = b["journal"]
+    by_kind: dict[str, int] = {}
+    by_severity: dict[str, int] = {}
+    replicas: set = set()
+    waves: set = set()
+    requests: set = set()
+    for ev in journal:
+        by_kind[ev.get("kind", "?")] = by_kind.get(ev.get("kind", "?"), 0) + 1
+        sev = ev.get("severity", "?")
+        by_severity[sev] = by_severity.get(sev, 0) + 1
+        if ev.get("replica") is not None:
+            replicas.add(ev["replica"])
+        if ev.get("wave_id") is not None:
+            waves.add(ev["wave_id"])
+        for rid in ev.get("request_ids") or (
+            [ev["request_id"]] if ev.get("request_id") is not None else []
+        ):
+            requests.add(rid)
+    report = {
+        "path": b["path"],
+        "captured_at": b["manifest"].get("captured_at"),
+        "trigger": b["manifest"].get("trigger", {}),
+        "journal_events": len(journal),
+        "events_by_kind": {k: by_kind[k] for k in sorted(by_kind)},
+        "events_by_severity": {
+            k: by_severity[k] for k in sorted(by_severity)
+        },
+        "replicas": sorted(replicas),
+        "waves": sorted(waves),
+        "requests": sorted(requests),
+        "journal_health": b["manifest"].get("journal", {}),
+        "timeline": journal,
+    }
+    if b["trace_events"]:
+        report["trace_report"] = analyze(b["trace_events"])
+    return report
+
+
+def format_incident(report: dict) -> str:
+    """Human timeline for one bundle (``cli incidents analyze``)."""
+    trig = report.get("trigger", {})
+    lines = [
+        f"incident bundle: {report.get('path')}",
+        f"captured: {report.get('captured_at')}  trigger: "
+        f"{trig.get('kind')} (severity {trig.get('severity')}, "
+        f"seq {trig.get('seq')})",
+        "events: "
+        + (
+            " ".join(
+                f"{k}={v}"
+                for k, v in sorted(report.get("events_by_kind", {}).items())
+            )
+            or "(empty journal tail)"
+        ),
+    ]
+    corr = []
+    if report.get("replicas"):
+        corr.append(f"replicas={report['replicas']}")
+    if report.get("waves"):
+        corr.append(f"waves={report['waves']}")
+    if report.get("requests"):
+        corr.append(f"requests={len(report['requests'])}")
+    if corr:
+        lines.append("correlation: " + " ".join(corr))
+    health = report.get("journal_health", {})
+    if health:
+        lines.append(
+            f"journal: written={health.get('events_written', 0)} "
+            f"dropped={health.get('events_dropped', 0)} "
+            f"rotations={health.get('rotations', 0)} "
+            f"bundles={health.get('bundles', 0)} "
+            f"debounces={health.get('debounces', 0)}"
+        )
+    lines.append("timeline:")
+    t0 = None
+    for ev in report.get("timeline", []):
+        ts = ev.get("ts")
+        if t0 is None and ts is not None:
+            t0 = ts
+        rel = f"+{ts - t0:8.3f}s" if ts is not None and t0 is not None else " " * 10
+        extras = " ".join(
+            f"{k}={v}"
+            for k, v in ev.items()
+            if k not in ("seq", "ts", "kind", "severity")
+        )
+        lines.append(
+            f"  {rel}  #{ev.get('seq', '?'):>5} "
+            f"[{ev.get('severity', '?'):>8}] {ev.get('kind', '?')}"
+            + (f"  {extras}" if extras else "")
+        )
+    tr = report.get("trace_report")
+    if tr:
+        lines.append(
+            f"trace: {tr.get('events', 0)} events over "
+            f"{tr.get('wall_s', 0.0):.3f}s wall (load "
+            f"{report.get('path')}/trace.json in Perfetto)"
+        )
+    return "\n".join(lines)
+
+
 def main(argv: list[str] | None = None) -> int:
     p = argparse.ArgumentParser(
         prog="flexible-llm-sharding-tpu trace-report",
@@ -274,7 +468,8 @@ def main(argv: list[str] | None = None) -> int:
     )
     p.add_argument("--trace", type=str, required=True,
                    help="trace file written by --trace_out (Chrome JSON "
-                        "or JSONL)")
+                        "or JSONL), or an incident-bundle directory — "
+                        "its embedded trace.json is analyzed")
     p.add_argument("--json", action="store_true",
                    help="emit the full report as one JSON object on stdout")
     args = p.parse_args(argv)
@@ -292,4 +487,14 @@ def main(argv: list[str] | None = None) -> int:
     return 0
 
 
-__all__ = ["analyze", "format_report", "load_trace", "main"]
+__all__ = [
+    "analyze",
+    "analyze_bundle",
+    "format_incident",
+    "format_report",
+    "journal_tail_len",
+    "load_bundle",
+    "load_manifest",
+    "load_trace",
+    "main",
+]

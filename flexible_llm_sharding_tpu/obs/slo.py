@@ -1,0 +1,191 @@
+"""Black-box flight recorder, part 3: SLO targets and error budgets.
+
+PR 12 gave every request an SLO *class* and exported per-class TTFT /
+latency quantiles — numbers with no contract behind them. This module
+adds the contract: :class:`~flexible_llm_sharding_tpu.config.SLOConfig`
+declares per-class p95 TTFT targets, an aggregate per-token-latency p95
+target, and an availability target, and :class:`SLOTracker` turns the
+existing ``ServingMetrics`` streams into **error-budget accounting**:
+
+- A p95 target allows 5% of samples over the line by definition. The
+  **burn rate** is ``violating_fraction / 0.05`` over the bounded
+  recent-sample window — 1.0 means burning budget exactly at the
+  allowed rate, 2.0 means at twice it; **budget remaining** is
+  ``max(0, 1 - burn_rate)``.
+- Availability compares the failed-request fraction against the allowed
+  ``1 - availability_target`` the same way.
+
+Everything exports as the ``fls_slo_*`` gauge family (pre-seeded for
+all three classes, so "no samples yet" is scrapeable), and a class that
+**exhausts** its budget (burn rate >= 1 with at least ``min_samples``
+samples) emits an ``slo_budget_exhausted`` journal event — severity
+``error``, so with the incident recorder armed at its default trigger,
+burning through an error budget captures a bundle exactly like a crash
+does. The exhaustion latch re-arms once the burn rate falls back below
+0.5 (hysteresis against flapping at the boundary).
+
+The tracker is pull-based: it reads the metrics windows at scrape /
+stats-line time (plus a rate-limited per-sweep check), so the serving
+hot path pays nothing for SLO accounting.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from flexible_llm_sharding_tpu.obs import events as obs_events
+
+# A p95 target tolerates this fraction of samples over the line; the
+# error budget is measured against it.
+P95_ALLOWED_VIOLATION = 0.05
+# Exhaustion latch re-arms below this burn rate (hysteresis).
+REARM_BURN_RATE = 0.5
+
+
+def _p95(samples: list[float]) -> float:
+    if not samples:
+        return 0.0
+    xs = sorted(samples)
+    i = min(len(xs) - 1, max(0, round(0.95 * (len(xs) - 1))))
+    return round(xs[i], 4)
+
+
+def _budget(samples: list[float], target: float) -> dict:
+    """Burn rate + remaining budget of one p95 stream vs its target."""
+    n = len(samples)
+    if not target or not n:
+        return {
+            "target_s": target,
+            "samples": n,
+            "p95_s": _p95(samples),
+            "burn_rate": 0.0,
+            "budget_remaining": 1.0,
+        }
+    violations = sum(1 for s in samples if s > target)
+    burn = (violations / n) / P95_ALLOWED_VIOLATION
+    return {
+        "target_s": target,
+        "samples": n,
+        "p95_s": _p95(samples),
+        "burn_rate": round(burn, 4),
+        "budget_remaining": round(max(0.0, 1.0 - burn), 4),
+    }
+
+
+class SLOTracker:
+    """Compliance tracker over a ``ServingMetrics`` (module docstring).
+
+    Registered as the ``slo`` registry source on every serving engine —
+    the exposition carries ``fls_slo_ttft_<class>_burn_rate`` /
+    ``_budget_remaining`` / ``_p95_s`` per class plus the aggregate
+    token-latency and availability budgets, all pre-seeded."""
+
+    def __init__(self, slo_cfg, metrics):
+        self.cfg = slo_cfg
+        self.metrics = metrics
+        self._ttft_targets = (
+            slo_cfg.ttft_target_map() if slo_cfg.enabled else {}
+        )
+        self._lock = threading.Lock()
+        self._latched: set = set()  # guarded by: _lock
+        self._last_check = 0.0  # guarded by: _lock
+        self.budget_exhausted_events = 0  # guarded by: _lock
+
+    # -- accounting --------------------------------------------------------
+
+    def stats(self) -> dict:
+        """The ``slo`` registry source. Computing the budgets IS the
+        exhaustion check — scrapes, stats lines, and the engine's
+        rate-limited per-sweep probe all share this one path, so the
+        numbers an operator sees and the journal events agree by
+        construction."""
+        from flexible_llm_sharding_tpu.utils.metrics import SLO_CLASS_NAMES
+
+        out: dict = {"enabled": int(self.cfg.enabled)}
+        exhausted: list[tuple[str, dict]] = []
+        ttft: dict = {}
+        for cls in SLO_CLASS_NAMES:
+            entry = _budget(
+                self.metrics.ttft_class_samples(cls),
+                self._ttft_targets.get(cls, 0.0),
+            )
+            ttft[cls] = entry
+            self._judge(f"ttft:{cls}", entry, exhausted)
+        out["ttft"] = ttft
+        tok = _budget(
+            self.metrics.token_latency_samples(),
+            self.cfg.token_latency_p95_s if self.cfg.enabled else 0.0,
+        )
+        out["token_latency"] = tok
+        self._judge("token_latency", tok, exhausted)
+        out["availability"] = self._availability(exhausted)
+        with self._lock:
+            out["budget_exhausted_events"] = self.budget_exhausted_events
+        for key, entry in exhausted:
+            metric, _, cls = key.partition(":")
+            obs_events.emit(
+                "slo_budget_exhausted",
+                metric=metric,
+                slo_class=cls or None,
+                burn_rate=entry.get("burn_rate"),
+                target=entry.get("target_s", entry.get("target")),
+                samples=entry.get("samples", entry.get("requests")),
+            )
+        return out
+
+    def _availability(self, exhausted: list) -> dict:
+        target = self.cfg.availability_target if self.cfg.enabled else 0.0
+        completed = self.metrics.counter("completed")
+        failed = self.metrics.counter("failed")
+        total = completed + failed
+        entry: dict = {
+            "target": target,
+            "requests": total,
+            "observed": round(completed / total, 4) if total else 1.0,
+            "burn_rate": 0.0,
+            "budget_remaining": 1.0,
+        }
+        if target and total:
+            allowed = max(1.0 - target, 1e-9)
+            burn = (failed / total) / allowed
+            entry["burn_rate"] = round(burn, 4)
+            entry["budget_remaining"] = round(max(0.0, 1.0 - burn), 4)
+        self._judge("availability", entry, exhausted)
+        return entry
+
+    def _judge(self, key: str, entry: dict, exhausted: list) -> None:
+        """Latch-guarded exhaustion decision for one budget entry. The
+        journal emit happens OUTSIDE the tracker lock (the caller
+        drains ``exhausted``); the latch keeps a sustained burn from
+        emitting once per scrape."""
+        n = entry.get("samples", entry.get("requests", 0))
+        burning = (
+            entry["burn_rate"] >= 1.0 and n >= self.cfg.min_samples
+        )
+        with self._lock:
+            if burning and key not in self._latched:
+                self._latched.add(key)
+                self.budget_exhausted_events += 1
+                exhausted.append((key, entry))
+            elif not burning and entry["burn_rate"] < REARM_BURN_RATE:
+                self._latched.discard(key)
+
+    # -- hot-path probe ----------------------------------------------------
+
+    def maybe_check(self, interval_s: float = 1.0) -> None:
+        """Per-sweep probe (engine ``_post_sweep``): evaluate budgets at
+        most once per ``interval_s`` so exhaustion journals promptly on
+        a busy server even when nothing scrapes the endpoint. Disabled
+        SLOs return on one bool check."""
+        if not self.cfg.enabled:
+            return
+        now = time.monotonic()
+        with self._lock:
+            if now - self._last_check < interval_s:
+                return
+            self._last_check = now
+        self.stats()
+
+
+__all__ = ["P95_ALLOWED_VIOLATION", "REARM_BURN_RATE", "SLOTracker"]

@@ -49,6 +49,7 @@ from flexible_llm_sharding_tpu.integrity.manifest import (
     SpillCorruptError,
 )
 from flexible_llm_sharding_tpu.models import llama
+from flexible_llm_sharding_tpu.obs import events as obs_events
 from flexible_llm_sharding_tpu.obs import trace as obs_trace
 from flexible_llm_sharding_tpu.obs.registry import REGISTRY as _OBS_REGISTRY
 from flexible_llm_sharding_tpu.parallel.planner import ShardPlan, plan_shards_dp
@@ -649,6 +650,10 @@ class _HostShardLoader:
                     "quarantine", cat="integrity", layer=name,
                     mismatches=mismatches["n"],
                 )
+                obs_events.emit(
+                    "quarantine", layer=name, path=path,
+                    mismatches=mismatches["n"],
+                )
                 raise ShardCorruptError(
                     f"{path}: checksum mismatch survived every re-read — "
                     "on-disk corruption; path quarantined (audit with the "
@@ -664,6 +669,9 @@ class _HostShardLoader:
             obs_trace.instant(
                 "reread_heal", cat="integrity", layer=name,
                 mismatches=mismatches["n"],
+            )
+            obs_events.emit(
+                "reread_heal", layer=name, mismatches=mismatches["n"]
             )
         return out
 
@@ -1649,6 +1657,14 @@ class StreamingExecutor:
         # Sweep-timeline tracing (obs/trace.py): enabled process-wide when
         # the config asks (--trace); a no-op bool check everywhere else.
         obs_trace.ensure_configured(cfg)
+        # Flight recorder (obs/events.py + obs/incident.py): journal AND
+        # incident recorder, so a programmatic batch run (no CLI) with
+        # incidents_dir set still bundles its quarantines/pressure
+        # events — not just journals them. One bool check per failure
+        # event when unconfigured. Lazy import: incident is cold-path.
+        from flexible_llm_sharding_tpu.obs import incident as obs_incident
+
+        obs_incident.ensure_configured(cfg)
         # The executor's latest per-call stats are a registry source (the
         # batch CLI's --metrics_out and any endpoint see the same dict the
         # stats line prints). Last executor wins the name — the process-
@@ -2177,6 +2193,9 @@ class StreamingExecutor:
                     obs_trace.instant(
                         "spill_recompute", cat="integrity", block=b,
                         sweep_id=sweep_id,
+                    )
+                    obs_events.emit(
+                        "spill_recompute", block=b, sweep_id=sweep_id
                     )
                     fetched = self._recompute_block(
                         prev_shard, store, b, idxs, block_meta[b],

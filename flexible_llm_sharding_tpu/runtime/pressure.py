@@ -68,6 +68,7 @@ import threading
 import time
 from dataclasses import dataclass, field
 
+from flexible_llm_sharding_tpu.obs import events as obs_journal
 from flexible_llm_sharding_tpu.obs import trace as obs_trace
 from flexible_llm_sharding_tpu.obs.registry import REGISTRY as _OBS_REGISTRY
 
@@ -388,11 +389,20 @@ class BrownoutController:
                 stage=self.LADDER[idx],
                 tripped=sorted(snap.tripped), events=pending,
             )
+            obs_journal.emit(
+                "pressure_step", direction="up", level=level,
+                stage=self.LADDER[idx], tripped=sorted(snap.tripped),
+                events=pending,
+            )
             self._engage(idx)
         if release_idx is not None:
             obs_trace.instant(
                 "pressure_step", cat="pressure", direction="down",
                 level=level, stage=self.LADDER[release_idx],
+            )
+            obs_journal.emit(
+                "pressure_step", direction="down", level=level,
+                stage=self.LADDER[release_idx],
             )
             self._release(release_idx)
 
@@ -537,7 +547,15 @@ def process_controller() -> BrownoutController | None:
 def note_event(kind: str) -> None:
     """Report a hard resource failure to the process controller, if one
     is running (the hardened failure paths call this unconditionally —
-    one ``is None`` check when pressure handling is off)."""
+    one ``is None`` check when pressure handling is off). The event is
+    ALSO journaled (obs/events.py) whether or not a controller exists:
+    an OOM/ENOSPC that really happened is flight-recorder material even
+    when the brownout ladder is off. Unknown kinds stay dropped (the
+    controller applies the same rule to its counters)."""
+    if kind in ("host_oom", "disk_full"):
+        # Field named `resource` (not `kind`): the journal reserves
+        # `kind` for the event kind itself.
+        obs_journal.emit("pressure_event", resource=kind)
     ctrl = process_controller()
     if ctrl is not None:
         ctrl.note_event(kind)

@@ -55,7 +55,10 @@ from flexible_llm_sharding_tpu.config import (
     ServeConfig,
 )
 from flexible_llm_sharding_tpu.models import llama
+from flexible_llm_sharding_tpu.obs import events as obs_events
+from flexible_llm_sharding_tpu.obs import incident as obs_incident
 from flexible_llm_sharding_tpu.obs import trace as obs_trace
+from flexible_llm_sharding_tpu.obs.slo import SLOTracker
 from flexible_llm_sharding_tpu.parallel.planner import plan_shards_dp
 from flexible_llm_sharding_tpu.runtime.decode import (
     KVStore,
@@ -203,6 +206,13 @@ class ServeEngine:
         # Sweep-timeline tracing (obs/trace.py): process-wide, enabled by
         # --trace; every span below is a no-op bool check when off.
         obs_trace.ensure_configured(cfg)
+        # Flight recorder (obs/events.py + obs/incident.py): the durable
+        # event journal every failure path below writes through, and the
+        # incident recorder that bundles journal tail + metrics + trace
+        # on trigger-severity events. Both process-wide, both zero-cost
+        # no-ops unless --journal_dir/--incidents_dir configured them.
+        obs_events.ensure_configured(cfg)
+        obs_incident.ensure_configured(cfg, self.serve_cfg)
         # process_metrics_mirror=False: fleet-owned replica — this
         # engine's sources stay out of the process-wide registry's bare
         # 'serve'/... names (the fleet exports replica<idx> mirrors).
@@ -249,6 +259,16 @@ class ServeEngine:
             "trace", obs_trace.TRACER.stats,
             mirror=False,  # process-level: the tracer registers on enable
         )
+        self.metrics.register(
+            "journal", obs_events.JOURNAL.stats,
+            mirror=False,  # process-level: the journal registers on enable
+        )
+        # SLO error budgets (obs/slo.py): always registered so the
+        # fls_slo_* family scrapes pre-seeded even before targets are
+        # configured; with --slo on, budget exhaustion journals (and,
+        # recorder armed, captures an incident bundle).
+        self._slo = SLOTracker(self.serve_cfg.slo, self.metrics)
+        self.metrics.register("slo", self._slo.stats)
         # Prometheus endpoint (ServeConfig.metrics_port / --metrics_port):
         # None = off; 0 = ephemeral port (tests) — the bound port is
         # self.metrics_server.port.
@@ -560,6 +580,13 @@ class ServeEngine:
         """Engine-fatal: every in-flight AND queued request fails with the
         root cause; the loop stops; later submits see ServeClosed."""
         self._error = error
+        obs_events.emit(
+            "engine_fatal",
+            error=type(error).__name__,
+            detail=str(error)[:200],
+            waves=len(self.batcher.waves),
+            wave_ids=[w.wave_id for w in self.batcher.waves],
+        )
         self.batcher.fail_all_active(error)
         self.queue.close(drain=False)  # cancels queued; futures resolve
         self._release_weights()
@@ -595,11 +622,20 @@ class ServeEngine:
                 "wave_abort", cat="serve", wave_id=w.wave_id,
                 error=type(root).__name__,
             )
+            obs_events.emit(
+                "wave_abort", wave_id=w.wave_id,
+                error=type(root).__name__,
+                request_ids=[r.request_id for r in w.requests],
+            )
         self.batcher.fail_all_active(err)
         self.metrics.count("engine_recoveries")
         obs_trace.instant(
             "engine_recovery", cat="serve", error=type(root).__name__,
             waves=n_waves,
+        )
+        obs_events.emit(
+            "engine_recovery", error=type(root).__name__,
+            detail=str(root)[:200], waves=n_waves,
         )
         if n_waves:
             self.metrics.count("waves_aborted", n_waves)
@@ -814,6 +850,10 @@ class ServeEngine:
             requests=len(live), steps=wave.steps,
             request_ids=[r.request_id for r in live],
         )
+        obs_events.emit(
+            "wave_preempt", wave_id=wave.wave_id, steps=wave.steps,
+            request_ids=[r.request_id for r in live],
+        )
         self.queue.requeue(live)
 
     def _init_wave(self, wave: Wave) -> bool:
@@ -930,6 +970,11 @@ class ServeEngine:
                 "wave_reject", cat="serve",
                 wave_id=getattr(wave, "wave_id", -1),
                 error=type(e).__name__,
+            )
+            obs_events.emit(
+                "wave_reject", wave_id=getattr(wave, "wave_id", -1),
+                error=type(e).__name__,
+                request_ids=[r.request_id for r in wave.requests],
             )
             return False
 
@@ -1274,6 +1319,9 @@ class ServeEngine:
                 if r.tokens_emitted >= r.max_new_tokens:
                     self._resolve(wave, r)
         self.metrics.count("sweeps")
+        # SLO budgets (obs/slo.py): rate-limited re-evaluation so budget
+        # exhaustion journals promptly even when nothing scrapes.
+        self._slo.maybe_check()
         if emitted:
             self.metrics.count("tokens_emitted", emitted)
             self.metrics.observe_token_latency(sweep_wall_s)

@@ -59,6 +59,8 @@ from collections import deque
 
 from flexible_llm_sharding_tpu.config import FrameworkConfig, ServeConfig
 from flexible_llm_sharding_tpu.faults.inject import FaultInjector, InjectedFault
+from flexible_llm_sharding_tpu.obs import events as obs_events
+from flexible_llm_sharding_tpu.obs import incident as obs_incident
 from flexible_llm_sharding_tpu.obs import trace as obs_trace
 from flexible_llm_sharding_tpu.obs.registry import REGISTRY, MetricsServer
 from flexible_llm_sharding_tpu.serve.engine import ServeEngine
@@ -166,6 +168,10 @@ class ReplicaFleet:
         self._error: BaseException | None = None
         self._started = False
         obs_trace.ensure_configured(cfg)
+        # Flight recorder: armed BEFORE the replicas build, so a replica
+        # that dies during construction already journals through it.
+        obs_events.ensure_configured(cfg)
+        obs_incident.ensure_configured(cfg, self.serve_cfg)
         # Resource-pressure brownout (runtime/pressure.py): at the
         # ladder's deepest level the controller drains this fleet down to
         # one replica (pressure_drain) and restores the population when
@@ -379,6 +385,7 @@ class ReplicaFleet:
         obs_trace.instant(
             "replica_drain", cat="fleet", replica=target.idx, remove=True
         )
+        obs_events.emit("replica_drain", replica=target.idx, remove=True)
         deadline = (
             time.monotonic() + timeout if timeout is not None else None
         )
@@ -400,6 +407,7 @@ class ReplicaFleet:
         obs_trace.instant(
             "replica_drain", cat="fleet", replica=rep.idx, remove=False
         )
+        obs_events.emit("replica_drain", replica=rep.idx, remove=False)
 
     def _complete_drain(self, rep: _Replica) -> None:
         """Monitor path: the draining replica is idle — retire its engine
@@ -429,6 +437,7 @@ class ReplicaFleet:
         obs_trace.instant(
             "replica_dead", cat="fleet", replica=rep.idx, reason=reason
         )
+        obs_events.emit("replica_dead", replica=rep.idx, reason=reason)
         rep.release.set()  # unwedge a chaos-stalled thread so it can exit
         orphans = rep.engine.reclaim_inflight()
         rep.engine.shutdown(drain=False, timeout=2.0)
@@ -472,6 +481,9 @@ class ReplicaFleet:
             "replica_recycled", cat="fleet", replica=rep.idx,
             new_replica=new.idx,
         )
+        obs_events.emit(
+            "replica_recycled", replica=rep.idx, new_replica=new.idx
+        )
         self._flush_pending()
 
     def _drop(self, rep: _Replica) -> None:
@@ -503,6 +515,9 @@ class ReplicaFleet:
             obs_trace.instant(
                 "replica_drain", cat="fleet", replica=idx, remove=True,
                 pressure=True,
+            )
+            obs_events.emit(
+                "replica_drain", replica=idx, remove=True, pressure=True
             )
         return len(marked)
 
@@ -697,6 +712,10 @@ class ReplicaFleet:
             obs_trace.instant(
                 "redispatch", cat="fleet", request_id=outer.request_id,
                 replica=replica.idx,
+            )
+            obs_events.emit(
+                "redispatch", request_id=outer.request_id,
+                replica=replica.idx, attempts=disp.attempts,
             )
         # Outside the fleet lock: queue.submit may resolve synchronously
         # (backpressure/chaos rejection -> _inner_terminal re-enters).

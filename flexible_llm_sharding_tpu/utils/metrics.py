@@ -15,6 +15,7 @@ import sys
 import time
 from dataclasses import dataclass, field
 
+from flexible_llm_sharding_tpu.obs import events as obs_events
 from flexible_llm_sharding_tpu.obs import trace as obs_trace
 
 
@@ -567,6 +568,18 @@ class ServingMetrics:
         with self._lock:
             self._token_lat.append(seconds)
 
+    def ttft_class_samples(self, slo_class: str) -> list[float]:
+        """Copy of one class's bounded TTFT window (obs/slo.py reads it
+        at scrape time — pull-based, nothing on the serving hot path)."""
+        with self._lock:
+            d = self._ttft_class.get(slo_class)
+            return list(d) if d is not None else []
+
+    def token_latency_samples(self) -> list[float]:
+        """Copy of the bounded per-token latency window (obs/slo.py)."""
+        with self._lock:
+            return list(self._token_lat)
+
     def spec_count(
         self, drafted: int = 0, accepted: int = 0, rejected: int = 0
     ) -> None:
@@ -824,6 +837,14 @@ class StepWatchdog:
             obs_trace.instant(
                 "watchdog_stall",
                 cat="serve",
+                desc=self._desc,
+                idle_s=round(idle, 3),
+                stalls=self.stalls,
+            )
+            # Durable twin of the trace instant: the stall that killed a
+            # sweep must survive the recovery (or the process) it causes.
+            obs_events.emit(
+                "watchdog_stall",
                 desc=self._desc,
                 idle_s=round(idle, 3),
                 stalls=self.stalls,

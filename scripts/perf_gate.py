@@ -83,6 +83,11 @@ FLOOR_RULES = {
     # wall sinking well below parity). Advisory: the healthy value IS
     # parity, so a hard floor near 1.0 would flake on runner noise.
     "trace_overhead_ratio": 0.85,
+    # Flight recorder armed vs off on an identical serve session (the
+    # journal's emit sites are failure paths only, so durability must
+    # cost noise). Advisory for the same reason as trace_overhead_ratio:
+    # the healthy value IS parity.
+    "recorder_overhead_ratio": 0.85,
     # Speculative decoding, both halves of the claim (ISSUE 13 — the TPU
     # capture once disowned its spec numbers as clock drift; these rules
     # exist so the claim can never rot silently again):
@@ -122,6 +127,7 @@ PARITY_CLAMPED = {"partial_residency_speedup"}
 ADVISORY = {
     "partial_residency_speedup",
     "trace_overhead_ratio",
+    "recorder_overhead_ratio",
     "spec_mechanism_speedup",
 }
 
@@ -162,6 +168,7 @@ def measure() -> dict:
         bench_host_cache,
         bench_host_stream,
         bench_mixedprec,
+        bench_recorder_overhead,
         bench_reference_schedule,
         bench_residency,
         bench_spec,
@@ -207,6 +214,7 @@ def measure() -> dict:
     bench_residency(result, model_path, prompts, tok, budget, fw)
     bench_mixedprec(result, model_path, prompts, tok, budget, fw)
     bench_trace_overhead(result, prompts, tok, budget, fw)
+    bench_recorder_overhead(result, prompts, tok, budget, fw)
     bench_reference_schedule(jax, fw(None), prompts, tok, result, budget)
     # Speculative decoding (ISSUE 13): small token/draft budgets — the
     # gate needs the mechanism witnessed, not the full-depth measurement

@@ -3,7 +3,10 @@
 ``python -m flexible_llm_sharding_tpu.cli trace-report``): link
 utilization, compute/stream overlap efficiency, per-phase sweep
 breakdown, and TTFT / per-token latency quantiles from a ``--trace``
-recording (Chrome trace-event JSON or JSONL)."""
+recording (Chrome trace-event JSON or JSONL). ``--trace`` also accepts
+an incident-bundle directory (obs/incident.py, docs/incidents.md) —
+its embedded ``trace.json`` is analyzed; render the full bundle
+timeline with ``cli incidents analyze`` instead."""
 
 import os
 import sys

@@ -678,6 +678,82 @@ def test_site_reg_missing_doc_entry(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# EVENT-REG (fixture package)
+# ---------------------------------------------------------------------------
+
+EVENT_KINDS_MOD = (
+    'EVENT_KINDS = {\n'
+    '    "good_event": "error",\n'
+    '    "unused_event": "warning",\n'
+    '}\n'
+)
+EVENT_EMIT_MOD = """
+from pkg.obs import events as obs_events
+
+def f():
+    obs_events.emit("good_event", replica=1)
+    obs_events.emit("rogue_event", replica=1)
+"""
+EVENT_DOCS = (
+    "| `good_event` | error | somewhere | meaning |\n"
+    "| `unused_event` | warning | elsewhere | meaning |\n"
+)
+
+
+def _make_event_pkg(tmp_path, kinds, mod, docs, name="pkg"):
+    pkg = make_pkg(
+        tmp_path,
+        {"obs/events.py": kinds, "serve/mod.py": mod},
+        name=name,
+    )
+    (tmp_path / "docs").mkdir(exist_ok=True)
+    (tmp_path / "docs" / "incidents.md").write_text(docs)
+    return pkg
+
+
+def test_event_reg_positive_and_negative(tmp_path):
+    """EVENT-REG mirrors SITE-REG for journal event kinds: an emitted
+    literal missing from EVENT_KINDS is a finding, a declared kind
+    nobody emits is a dead registration, and a registered+documented+
+    emitted kind is clean."""
+    pkg = _make_event_pkg(tmp_path, EVENT_KINDS_MOD, EVENT_EMIT_MOD, EVENT_DOCS)
+    res = run_pkg(pkg, select=["EVENT-REG"])
+    m = msgs(res.findings, "EVENT-REG")
+    assert any(
+        "'rogue_event' emitted but not declared" in x for x in m
+    )
+    assert any("'unused_event'" in x and "dead registration" in x for x in m)
+    assert not any("'good_event'" in x for x in m)
+
+
+def test_event_reg_missing_doc_entry(tmp_path):
+    """A kind declared and emitted but absent from the docs/incidents.md
+    kinds table is flagged — the table is the operator-facing contract."""
+    pkg = _make_event_pkg(
+        tmp_path,
+        'EVENT_KINDS = {"good_event": "error"}\n',
+        'from pkg.obs import events as obs_events\n'
+        'def f():\n    obs_events.emit("good_event")\n',
+        "| `other_event` | error | x | y |\n",
+        name="eventdoc",
+    )
+    res = run_pkg(pkg, select=["EVENT-REG"])
+    assert any(
+        "'good_event' is missing from the docs" in x
+        for x in msgs(res.findings, "EVENT-REG")
+    )
+
+
+def test_event_reg_repo_is_clean():
+    """The real package: every emitted kind declared + documented, every
+    declared kind emitted — 0 findings (the ISSUE acceptance bar)."""
+    res = run(PKG_DIR, repo_root=REPO_ROOT, baseline_path="", select=["EVENT-REG"])
+    assert msgs(res.findings, "EVENT-REG") == [], [
+        f.format() for f in res.findings
+    ]
+
+
+# ---------------------------------------------------------------------------
 # COUNTER-EXPORT (fixture package)
 # ---------------------------------------------------------------------------
 

@@ -1,0 +1,570 @@
+"""Black-box flight recorder (obs/events.py + obs/incident.py +
+obs/slo.py; docs/incidents.md): the durable journal's append/rotation/
+degrade semantics, incident-bundle capture (trigger severities,
+debounce, settle, disk budget, collect_error preservation), SLO error
+budgets (burn-rate math, exhaustion latch, journal emission), the
+bundle analyzer + CLI, and end-to-end serve runs proving failure paths
+journal while the engine never pays an error for durability."""
+
+import json
+import os
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+
+from flexible_llm_sharding_tpu.config import (
+    FaultConfig,
+    FrameworkConfig,
+    SLOConfig,
+    ServeConfig,
+)
+from flexible_llm_sharding_tpu.faults.inject import FaultInjector
+from flexible_llm_sharding_tpu.models import llama
+from flexible_llm_sharding_tpu.obs import events as obs_events
+from flexible_llm_sharding_tpu.obs import incident as obs_incident
+from flexible_llm_sharding_tpu.obs import report as obs_report
+from flexible_llm_sharding_tpu.obs import trace as obs_trace
+from flexible_llm_sharding_tpu.obs.registry import REGISTRY
+from flexible_llm_sharding_tpu.obs.slo import SLOTracker
+from flexible_llm_sharding_tpu.utils.checkpoint import save_params
+from flexible_llm_sharding_tpu.utils.metrics import ServingMetrics
+
+from tests.fake_tokenizer import FakeTokenizer
+
+PROMPTS = [
+    ("The capital of France", (" is Paris", " is Rome")),
+    ("Two plus two equals", (" four", " five")),
+]
+
+
+@pytest.fixture(scope="module")
+def model(tiny_cfg, tmp_path_factory):
+    params = llama.init_params(jax.random.PRNGKey(0), tiny_cfg)
+    d = tmp_path_factory.mktemp("tiny_model_incidents")
+    save_params(jax.tree.map(np.asarray, params), str(d), tiny_cfg)
+    return str(d)
+
+
+def _fw(model_dir, **kw):
+    base = dict(
+        model_path=model_dir,
+        layer_num_per_shard=1,
+        storage_location="cpu",
+        dtype="float32",
+        bucket_multiple=8,
+        block_size=2,
+        prefetch_depth=0,
+    )
+    base.update(kw)
+    return FrameworkConfig(**base)
+
+
+@pytest.fixture(autouse=True)
+def fresh_journal():
+    """Every test starts and ends with a closed process journal so the
+    singleton never bleeds events, recorders, or registry entries."""
+    obs_events.reset_journal()
+    yield
+    obs_events.reset_journal()
+
+
+def _arm(tmp_path, trigger="error", debounce_s=60.0, settle_s=0.0,
+         max_bytes=50_000_000, journal_max=1_000_000, injector=None,
+         config_snapshot=None):
+    d = str(tmp_path / "incidents")
+    obs_events.JOURNAL.configure(d, max_bytes=journal_max, injector=injector)
+    rec = obs_incident.IncidentRecorder(
+        d, max_bytes=max_bytes, trigger=trigger, debounce_s=debounce_s,
+        settle_s=settle_s, config_snapshot=config_snapshot,
+    )
+    obs_events.JOURNAL.attach_recorder(rec)
+    return d, rec
+
+
+def _bundles(d):
+    return sorted(
+        n for n in os.listdir(d)
+        if n.startswith("incident-") and not n.endswith(".tmp")
+    )
+
+
+# ---------------------------------------------------------------------------
+# Journal: append, seq, rotation, degrade-to-drops
+# ---------------------------------------------------------------------------
+
+def test_journal_disabled_is_noop_and_enabled_appends(tmp_path):
+    obs_events.emit("reread_heal", layer="l0")  # disabled: no-op
+    assert len(obs_events.JOURNAL) == 0
+    obs_events.JOURNAL.configure(str(tmp_path / "j"))
+    obs_events.emit("reread_heal", layer="l0", mismatches=1)
+    obs_events.emit("quarantine", layer="l1", path="/x")
+    lines = [
+        json.loads(line)
+        for line in open(obs_events.JOURNAL.path).read().splitlines()
+    ]
+    assert [ev["seq"] for ev in lines] == [1, 2]
+    assert lines[0]["kind"] == "reread_heal"
+    assert lines[0]["severity"] == "warning"
+    assert lines[1]["severity"] == "critical"
+    assert lines[1]["layer"] == "l1"
+    st = obs_events.JOURNAL.stats()
+    assert st["events_written"] == 2 and st["events_dropped"] == 0
+    # The journal is a registry citizen: fls_journal_* scrapes.
+    assert "journal" in REGISTRY.names()
+    assert "fls_journal_events_written 2" in REGISTRY.prometheus_text()
+
+
+def test_journal_unknown_kind_counts_drop_never_raises(tmp_path):
+    obs_events.JOURNAL.configure(str(tmp_path / "j"))
+    obs_events.emit("not_a_kind", x=1)
+    st = obs_events.JOURNAL.stats()
+    assert st["events_dropped"] == 1 and st["events_written"] == 0
+
+
+def test_journal_rotation_is_atomic_and_bounded(tmp_path):
+    obs_events.JOURNAL.configure(str(tmp_path / "j"), max_bytes=400)
+    for i in range(40):
+        obs_events.emit("reread_heal", layer=f"layer{i}", mismatches=1)
+    st = obs_events.JOURNAL.stats()
+    assert st["rotations"] >= 1
+    assert st["events_written"] == 40 and st["events_dropped"] == 0
+    path = obs_events.JOURNAL.path
+    assert os.path.exists(path) and os.path.exists(path + ".1")
+    # Only ever two generations: live + one rotated.
+    gens = [n for n in os.listdir(tmp_path / "j") if n.startswith("journal")]
+    assert sorted(gens) == ["journal.jsonl", "journal.jsonl.1"]
+    # No event lost ACROSS the rotation boundary: the union of the two
+    # generations is a contiguous seq range ending at the newest.
+    seqs = []
+    for gen in (path + ".1", path):
+        seqs += [json.loads(line)["seq"] for line in open(gen).read().splitlines()]
+    assert sorted(seqs) == list(range(min(seqs), 41))
+    assert max(seqs) == 40
+
+
+def test_journal_write_failure_degrades_to_counted_drops(tmp_path):
+    """Satellite pin: ENOSPC on the journal's own write (the existing
+    disk_full fault site) degrades to counted drops — the failure path
+    being recorded never sees an exception, and the in-memory ring
+    still serves the tail."""
+    inj = FaultInjector(
+        FaultConfig(enabled=True, seed=7, error_rate=1.0,
+                    sites=("disk_full",))
+    )
+    obs_events.JOURNAL.configure(str(tmp_path / "j"), injector=inj)
+    for i in range(5):
+        obs_events.emit("reread_heal", layer=f"l{i}")  # must not raise
+    st = obs_events.JOURNAL.stats()
+    assert st["events_dropped"] == 5 and st["events_written"] == 0
+    # The ring keeps the events the disk lost: a later incident bundle
+    # still gets its journal tail.
+    assert [e["layer"] for e in obs_events.JOURNAL.tail()] == [
+        f"l{i}" for i in range(5)
+    ]
+
+
+# ---------------------------------------------------------------------------
+# Incident bundles: trigger, contents, debounce, settle, budget
+# ---------------------------------------------------------------------------
+
+def test_incident_bundle_contents_and_collect_error_preserved(tmp_path):
+    """The bundle freezes journal tail + metrics + trace + config; a
+    raising registry source is preserved as its collect_error marker —
+    never dropped from the snapshot (satellite pin)."""
+
+    class Broken:
+        def stats(self):
+            raise RuntimeError("wedged at capture time")
+
+    REGISTRY.register("broken_src", Broken().stats)
+    try:
+        d, rec = _arm(
+            tmp_path, settle_s=0.0,
+            config_snapshot={"framework": {"dtype": "float32"}},
+        )
+        obs_events.emit("wave_abort", wave_id=9, error="ShardLoadError",
+                        request_ids=[4, 5])
+        bundles = _bundles(d)
+        assert len(bundles) == 1
+        b = obs_report.load_bundle(os.path.join(d, bundles[0]))
+        assert b["manifest"]["trigger"]["kind"] == "wave_abort"
+        assert b["manifest"]["format"] == "fls-incident-bundle"
+        assert set(b["manifest"]["files"]) == {
+            "config.json", "journal_tail.jsonl", "metrics.json",
+            "trace.json",
+        }
+        assert b["metrics"]["broken_src"] == {"collect_error": 1}
+        assert b["config"]["framework"]["dtype"] == "float32"
+        assert [e["kind"] for e in b["journal"]] == ["wave_abort"]
+        assert b["journal"][0]["request_ids"] == [4, 5]
+        # The capture itself journals (info — below the trigger, so it
+        # can never re-trigger a capture).
+        kinds = [e["kind"] for e in obs_events.JOURNAL.tail()]
+        assert kinds == ["wave_abort", "incident_capture"]
+        assert rec.bundles == 1
+    finally:
+        REGISTRY.unregister("broken_src")
+
+
+def test_incident_trigger_severity_threshold(tmp_path):
+    d, rec = _arm(tmp_path, trigger="critical", settle_s=0.0)
+    obs_events.emit("engine_recovery", error="OSError", waves=1)  # error
+    assert _bundles(d) == [] and rec.bundles == 0
+    # An event with a missing/unknown severity must never trigger (the
+    # rank helper's unknown-ranks-high fail-safe is for THRESHOLDS; the
+    # event side rejects unknowns explicitly).
+    rec.observe({"kind": "manual", "severity": "shouting", "seq": 99})
+    rec.observe({"kind": "manual", "seq": 100})
+    assert _bundles(d) == [] and rec.bundles == 0
+    obs_events.emit("replica_dead", replica=2, reason="test")  # critical
+    assert len(_bundles(d)) == 1
+
+
+def test_incident_storm_debounces_to_one_bundle(tmp_path):
+    d, rec = _arm(tmp_path, settle_s=0.0, debounce_s=60.0)
+    for i in range(10):
+        obs_events.emit("wave_abort", wave_id=i, error="X")
+    assert len(_bundles(d)) == 1
+    assert rec.debounces == 9
+    st = obs_events.JOURNAL.stats()
+    assert st["bundles"] == 1 and st["debounces"] == 9
+
+
+def test_incident_settle_window_captures_the_whole_storm(tmp_path):
+    """With a settle window, the trigger and the events that FOLLOW it
+    (replica death -> orphan re-dispatch) land inside one bundle's
+    journal tail instead of after its snapshot."""
+    d, rec = _arm(tmp_path, trigger="critical", settle_s=0.3,
+                  debounce_s=60.0)
+    obs_events.emit("replica_dead", replica=1, reason="kill")
+    obs_events.emit("redispatch", request_id=7, replica=2, attempts=2)
+    deadline = time.monotonic() + 30
+    while not _bundles(d) and time.monotonic() < deadline:
+        time.sleep(0.02)
+    bundles = _bundles(d)
+    assert len(bundles) == 1
+    tail = obs_report.load_bundle(os.path.join(d, bundles[0]))["journal"]
+    assert {"replica_dead", "redispatch"} <= {e["kind"] for e in tail}
+
+
+def test_incidents_dir_disk_budget_evicts_oldest(tmp_path):
+    d, rec = _arm(tmp_path, settle_s=0.0, debounce_s=0.0)
+    for i in range(4):
+        obs_events.emit("wave_abort", wave_id=i, error="X")
+    assert len(_bundles(d)) == 4
+    # Shrink the budget below one bundle's size: the next capture keeps
+    # itself and evicts every older bundle.
+    rec.max_bytes = 1
+    obs_events.emit("wave_abort", wave_id=99, error="X")
+    left = _bundles(d)
+    assert len(left) == 1 and left[0].endswith("wave_abort")
+    assert rec.bundle_evictions == 4
+    assert obs_events.JOURNAL.stats()["bundle_evictions"] == 4
+
+
+def test_capture_failure_counts_never_raises(tmp_path):
+    d, rec = _arm(tmp_path, settle_s=0.0)
+    rec.out_dir = str(tmp_path / "nonexistent" / "deep" / "x")
+    os_mkdir_blocker = str(tmp_path / "blocker")
+    with open(os_mkdir_blocker, "w") as f:
+        f.write("")
+    rec.out_dir = os_mkdir_blocker  # a FILE: makedirs inside must fail
+    obs_events.emit("wave_abort", wave_id=1, error="X")  # must not raise
+    assert rec.bundles == 0 and rec.bundle_errors == 1
+
+
+# ---------------------------------------------------------------------------
+# SLO error budgets
+# ---------------------------------------------------------------------------
+
+def test_slo_pre_seeded_zeros_and_disabled_noop():
+    m = ServingMetrics(process_mirror=False)
+    t = SLOTracker(SLOConfig(), m)
+    s = t.stats()
+    assert s["enabled"] == 0
+    for cls in ("interactive", "standard", "best_effort"):
+        assert s["ttft"][cls]["burn_rate"] == 0.0
+        assert s["ttft"][cls]["budget_remaining"] == 1.0
+    assert s["budget_exhausted_events"] == 0
+    m.close()
+
+
+def test_slo_burn_rate_math_and_exhaustion_latch(tmp_path):
+    obs_events.JOURNAL.configure(str(tmp_path / "j"))
+    m = ServingMetrics(process_mirror=False)
+    cfg = SLOConfig(enabled=True, ttft_p95_s="interactive=0.1",
+                    min_samples=10)
+    t = SLOTracker(cfg, m)
+    # 1 violation in 20 samples = 5% violating = burn rate exactly 1.0
+    # is the boundary; stay under it first.
+    for _ in range(19):
+        m.observe_ttft(0.05, "interactive")
+    m.observe_ttft(0.5, "interactive")
+    e = t.stats()["ttft"]["interactive"]
+    assert e["burn_rate"] == pytest.approx(1.0)
+    assert e["budget_remaining"] == pytest.approx(0.0)
+    assert t.stats()["budget_exhausted_events"] == 1  # >= 1.0 exhausts
+    # Latched: a second evaluation does not re-emit.
+    assert t.stats()["budget_exhausted_events"] == 1
+    kinds = [ev["kind"] for ev in obs_events.JOURNAL.tail()]
+    assert kinds.count("slo_budget_exhausted") == 1
+    ev = obs_events.JOURNAL.tail()[0]
+    assert ev["metric"] == "ttft" and ev["slo_class"] == "interactive"
+    # Recovery: flood with compliant samples until burn < 0.5, the
+    # latch re-arms, and a fresh burn emits again.
+    for _ in range(500):
+        m.observe_ttft(0.01, "interactive")
+    assert t.stats()["ttft"]["interactive"]["burn_rate"] < 0.5
+    for _ in range(500):
+        m.observe_ttft(0.9, "interactive")
+    assert t.stats()["budget_exhausted_events"] == 2
+    m.close()
+
+
+def test_slo_min_samples_gate():
+    m = ServingMetrics(process_mirror=False)
+    t = SLOTracker(
+        SLOConfig(enabled=True, ttft_p95_s="standard=0.1", min_samples=50),
+        m,
+    )
+    for _ in range(10):
+        m.observe_ttft(5.0, "standard")  # all violating, but n < 50
+    s = t.stats()
+    assert s["ttft"]["standard"]["burn_rate"] > 1.0
+    assert s["budget_exhausted_events"] == 0
+    m.close()
+
+
+def test_slo_exhaustion_captures_incident_bundle(tmp_path):
+    """The acceptance wiring: budget exhaustion is severity error, so an
+    armed recorder bundles it exactly like a crash."""
+    d, rec = _arm(tmp_path, settle_s=0.0)
+    m = ServingMetrics(process_mirror=False)
+    t = SLOTracker(
+        SLOConfig(enabled=True, availability_target=0.5, min_samples=4),
+        m,
+    )
+    for _ in range(5):
+        m.count("failed")
+    t.stats()
+    bundles = _bundles(d)
+    assert len(bundles) == 1
+    assert bundles[0].endswith("slo_budget_exhausted")
+    m.close()
+
+
+def test_slo_config_validation():
+    with pytest.raises(ValueError, match="unknown SLO class"):
+        SLOConfig(enabled=True, ttft_p95_s="nope=1")
+    with pytest.raises(ValueError, match="must be > 0"):
+        SLOConfig(enabled=True, ttft_p95_s="interactive=0")
+    with pytest.raises(ValueError, match="availability_target"):
+        SLOConfig(enabled=True, availability_target=1.0)
+    with pytest.raises(ValueError, match="incident_trigger"):
+        FrameworkConfig(incident_trigger="loud")
+    with pytest.raises(ValueError, match="journal_max_mb"):
+        FrameworkConfig(journal_max_mb=0)
+
+
+# ---------------------------------------------------------------------------
+# Analyzer + CLI
+# ---------------------------------------------------------------------------
+
+def test_trace_report_accepts_bundle_dir(tmp_path, capsys):
+    obs_trace.TRACER.clear()
+    obs_trace.TRACER.enable()
+    try:
+        with obs_trace.span("sweep", cat="serve", sweep_id=1):
+            obs_trace.instant("replica_kill", cat="fleet", replica=0)
+        d, rec = _arm(tmp_path, settle_s=0.0)
+        obs_events.emit("replica_dead", replica=0, reason="t")
+    finally:
+        obs_trace.TRACER.disable()
+        obs_trace.TRACER.clear()
+    bundle = os.path.join(d, _bundles(d)[0])
+    # load_trace format auto-detect: the bundle dir resolves to its
+    # embedded trace.json (and the manifest path does too).
+    events = obs_report.load_trace(bundle)
+    assert any(e["name"] == "replica_kill" for e in events)
+    events2 = obs_report.load_trace(os.path.join(bundle, "manifest.json"))
+    assert len(events2) == len(events)
+    # The script-level CLI path: trace-report --trace <bundle dir>.
+    assert obs_report.main(["--trace", bundle, "--json"]) == 0
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["events"] >= 2
+
+
+def test_incidents_cli_list_show_analyze(tmp_path, capsys):
+    from flexible_llm_sharding_tpu.cli import incidents_main
+
+    d, rec = _arm(tmp_path, settle_s=0.0)
+    obs_events.emit(
+        "replica_dead", replica=3, reason="kill",
+    )
+    obs_events.emit("redispatch", request_id=11, replica=1, attempts=2)
+    bundle = os.path.join(d, _bundles(d)[0])
+
+    incidents_main(["list", "--dir", d, "--json"])
+    rows = json.loads(capsys.readouterr().out)
+    assert len(rows) == 1 and rows[0]["trigger"] == "replica_dead"
+
+    incidents_main(["show", bundle, "--json"])
+    manifest = json.loads(capsys.readouterr().out)
+    assert manifest["trigger"]["replica"] == 3
+
+    incidents_main(["analyze", bundle])
+    out = capsys.readouterr().out
+    assert "replica_dead" in out and "timeline:" in out
+
+    incidents_main(["analyze", bundle, "--json"])
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["replicas"] == [3]
+    assert rep["trigger"]["kind"] == "replica_dead"
+
+    with pytest.raises(SystemExit):
+        incidents_main(["analyze", str(tmp_path)])  # not a bundle
+
+
+# ---------------------------------------------------------------------------
+# End to end: serving with the recorder armed
+# ---------------------------------------------------------------------------
+
+def test_serve_failure_paths_journal_and_bundle(model, tmp_path):
+    """A serve run under seeded engine_step faults: the recovery path
+    journals engine_recovery + wave_abort with wave/request correlation
+    ids, ONE debounced bundle lands, requests still complete, and the
+    engine never errors for durability."""
+    from flexible_llm_sharding_tpu.serve import ServeEngine
+
+    inc_dir = str(tmp_path / "inc")
+    engine = ServeEngine(
+        _fw(
+            model,
+            incidents_dir=inc_dir,
+            incident_settle_s=0.0,
+            incident_debounce_s=600.0,
+            io_retry_attempts=2,
+            io_retry_base_s=0.001,
+            faults=FaultConfig(
+                enabled=True, seed=3, error_rate=1.0,
+                sites=("engine_step",), max_faults=1,
+            ),
+        ),
+        ServeConfig(max_wave_requests=2, default_max_new_tokens=1,
+                    metrics_port=0),
+        tokenizer=FakeTokenizer(),
+    )
+    try:
+        reqs = [engine.submit(p, s) for p, s in PROMPTS]
+        outcomes = []
+        for r in reqs:
+            try:
+                outcomes.append(r.future.result(timeout=300))
+            except Exception as e:  # the aborted wave's requests
+                outcomes.append(e)
+        port = engine.metrics_server.port
+        text = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=30
+        ).read().decode()
+    finally:
+        engine.shutdown(drain=True)
+    assert engine.error is None
+    kinds = [e["kind"] for e in obs_events.JOURNAL.tail()]
+    assert "engine_recovery" in kinds and "wave_abort" in kinds
+    aborts = [
+        e for e in obs_events.JOURNAL.tail() if e["kind"] == "wave_abort"
+    ]
+    assert all("wave_id" in e and e["request_ids"] for e in aborts)
+    bundles = _bundles(inc_dir)
+    assert len(bundles) == 1
+    # Pre-seeded journal + SLO families ride the engine's endpoint.
+    assert "fls_journal_events_written" in text
+    assert "fls_journal_bundles 1" in text
+    assert "fls_slo_ttft_interactive_burn_rate 0" in text
+
+
+def test_serve_journal_enospc_never_an_engine_error(model, tmp_path):
+    """Satellite pin, serve-level: every journal write failing with
+    ENOSPC (injected disk_full) while failure events fire — the engine
+    serves on, output resolves, drops are counted."""
+    from flexible_llm_sharding_tpu.serve import ServeEngine
+
+    inj = FaultInjector(
+        FaultConfig(enabled=True, seed=11, error_rate=1.0,
+                    sites=("disk_full",))
+    )
+    obs_events.JOURNAL.configure(str(tmp_path / "j"), injector=inj)
+    engine = ServeEngine(
+        _fw(
+            model,
+            io_retry_attempts=2,
+            io_retry_base_s=0.001,
+            faults=FaultConfig(
+                enabled=True, seed=3, error_rate=1.0,
+                sites=("engine_step",), max_faults=1,
+            ),
+        ),
+        ServeConfig(max_wave_requests=2, default_max_new_tokens=1),
+        tokenizer=FakeTokenizer(),
+    )
+    try:
+        reqs = [engine.submit(p, s) for p, s in PROMPTS]
+        done = 0
+        for r in reqs:
+            try:
+                r.future.result(timeout=300)
+                done += 1
+            except Exception:
+                pass  # the aborted wave's requests resubmit in real life
+    finally:
+        engine.shutdown(drain=True)
+    assert engine.error is None
+    st = obs_events.JOURNAL.stats()
+    assert st["events_dropped"] >= 1 and st["events_written"] == 0
+    # The ring still carries the recovery story for an incident tail.
+    assert "engine_recovery" in [e["kind"] for e in obs_events.JOURNAL.tail()]
+
+
+def test_ensure_configured_arms_journal_only_configs(tmp_path):
+    """Regression (found by the CLI drive): a journal-only config
+    (journal_dir set, incidents_dir empty) must arm the journal through
+    incident.ensure_configured — the kv_cache batch path reaches no
+    other ensure call — and incidents_dir-only must keep the journal
+    beside the bundles."""
+    cfg = _fw(".", journal_dir=str(tmp_path / "j"))
+    assert obs_incident.ensure_configured(cfg) is None
+    assert obs_events.JOURNAL.enabled
+    assert obs_events.JOURNAL.path == str(tmp_path / "j" / "journal.jsonl")
+    obs_events.reset_journal()
+    cfg = _fw(".", incidents_dir=str(tmp_path / "inc"))
+    rec = obs_incident.ensure_configured(cfg)
+    assert rec is not None and obs_events.JOURNAL.enabled
+    assert obs_events.JOURNAL.path == str(
+        tmp_path / "inc" / "journal.jsonl"
+    )
+
+
+def test_journal_concurrent_emits_keep_seq_monotonic(tmp_path):
+    obs_events.JOURNAL.configure(str(tmp_path / "j"))
+    n_threads, per = 8, 50
+
+    def worker():
+        for _ in range(per):
+            obs_events.emit("reread_heal", layer="x")
+
+    ts = [threading.Thread(target=worker) for _ in range(n_threads)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    lines = [
+        json.loads(line)
+        for line in open(obs_events.JOURNAL.path).read().splitlines()
+    ]
+    assert len(lines) == n_threads * per
+    assert [ev["seq"] for ev in lines] == list(range(1, n_threads * per + 1))
