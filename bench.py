@@ -121,6 +121,7 @@ HEADLINE_KEYS = (
     "spec_serve_tokens_per_sweep",
     "spec_serve_sweep_ratio",
     "spec_serve_acceptance",
+    "kv_prefix_reuse_frac",
     "host_stream_zero_copy_warm_gbps",
     "host_stream_zero_copy_cold_gbps",
     "host_stream_cast_warm_gbps",
@@ -290,6 +291,7 @@ RATIO_SINGLETONS = (
     "spec_serve_tokens_per_sweep",
     "spec_serve_sweep_ratio",
     "spec_serve_acceptance",
+    "kv_prefix_reuse_frac",
 )
 
 
@@ -360,6 +362,10 @@ PHASE_EVIDENCE_KEY = {
     # Speculation on the SERVING path (serve/engine.py): the structural
     # tokens-per-sweep headline under a replay draft source.
     "spec_serve": "spec_serve_tokens_per_sweep",
+    # ISSUE 16's tentpole evidence: a prefix prefilled in wave N must be
+    # served from pooled pages in wave N+1 (structural token counters;
+    # pool-on/pool-off token-identity asserted before recording).
+    "kv_reuse": "kv_prefix_reuse_frac",
     # PR 8's satellite evidence: span tracing must not tax the hot path
     # (rotation-paired trace-on vs trace-off sweep walls).
     "trace_overhead": "trace_overhead_ratio",
@@ -1763,6 +1769,82 @@ def bench_spec_serve(
     )
 
 
+def bench_kv_reuse(cfg_obj, tok, result: dict, budget_left,
+                   n_tok: int = 8) -> None:
+    """Paged prefix-KV pool headline: fraction of total prefix prefill
+    work served from pooled pages across two sequential same-prefix
+    waves (runtime/kvpool.py, docs/kvpool.md).
+
+    Serves the SAME prefix twice with max_active_requests=1, forcing
+    two waves: wave 1 prefills and contributes its pages, wave 2 must
+    assemble them (zero prefix prefill recompute). Token-identity
+    against a pool-off run of the identical workload is asserted FIRST,
+    so the number can never come from a diverged stream. Records:
+
+    - ``kv_prefix_reuse_frac``: prefix_reuse_tokens /
+      (prefix_reuse_tokens + prefix_prefill_tokens) — structural and
+      timing-free (token counters, not walls). Two same-prefix waves
+      put the healthy value at exactly 0.5; the pool disengaging
+      collapses it to 0.0, which no runner noise can fake.
+    """
+    import dataclasses
+
+    from flexible_llm_sharding_tpu.config import ServeConfig
+    from flexible_llm_sharding_tpu.runtime import kvpool
+    from flexible_llm_sharding_tpu.serve import ServeEngine
+
+    rng = np.random.default_rng(11)
+    words = [f"w{i}" for i in range(40)]
+    phrase = " ".join(rng.choice(words, size=24))
+    suffixes = (" alpha beta", " gamma delta")
+    base = dataclasses.replace(cfg_obj, num_gen_token=n_tok)
+
+    def run(pool_on):
+        kvpool.reset_process_pools()  # no pages leak in from other phases
+        cfg = base if pool_on else dataclasses.replace(base, kv_pool_gb=0.0)
+        engine = ServeEngine(
+            cfg,
+            ServeConfig(
+                max_wave_requests=1,
+                max_active_requests=1,  # wave 2 starts after wave 1 retires
+                default_max_new_tokens=n_tok,
+            ),
+            tokenizer=tok,
+        )
+        try:
+            outs = [
+                engine.submit(phrase, (sfx,)).future.result(timeout=600)
+                for sfx in suffixes
+            ]
+        finally:
+            engine.shutdown(drain=True)
+        if engine.error is not None:
+            raise RuntimeError(f"kv reuse bench engine error: {engine.error!r}")
+        reuse = engine.metrics.counter("prefix_reuse_tokens")
+        prefill = engine.metrics.counter("prefix_prefill_tokens")
+        kvpool.reset_process_pools()
+        return outs, reuse, prefill
+
+    off, _, _ = run(False)
+    on, reuse, prefill = run(True)
+    for p, q in zip(off, on):
+        if not (p.tokens == q.tokens).all():
+            raise RuntimeError(
+                "pool-on serve run diverged from pool-off (paged prefix "
+                "reuse broken) — refusing to record its numbers"
+            )
+    if reuse <= 0:
+        raise RuntimeError(
+            "kv reuse bench: the second same-prefix wave reused no pooled "
+            "prefix tokens"
+        )
+    result["kv_prefix_reuse_frac"] = round(reuse / (reuse + prefill), 3)
+    log(
+        f"kv reuse: frac={result['kv_prefix_reuse_frac']} "
+        f"(prefill {prefill} tokens, reuse {reuse} tokens)"
+    )
+
+
 def run_bench(result: dict) -> None:
     t_bench0 = time.perf_counter()
     deadline_s = float(os.environ.get("BENCH_DEADLINE_S", "2400"))
@@ -2077,6 +2159,13 @@ def run_bench(result: dict) -> None:
                 log("spec serve bench failed:\n" + traceback.format_exc())
         else:
             log("skipping spec serve bench (deadline budget exhausted)")
+        if budget_left() > 0.03:
+            try:
+                bench_kv_reuse(fw(2), tok, result, budget_left)
+            except Exception:
+                log("kv reuse bench failed:\n" + traceback.format_exc())
+        else:
+            log("skipping kv reuse bench (deadline budget exhausted)")
         return
 
     # TPU-only phases from here (the early return above handled CPU), as
@@ -2195,6 +2284,15 @@ def run_bench(result: dict) -> None:
                 log("spec serve bench failed:\n" + traceback.format_exc())
         else:
             log("skipping spec serve bench (deadline budget exhausted)")
+        if "kv_reuse" in skip:
+            log("skipping kv reuse bench (already captured)")
+        elif budget_left() > 0.03:
+            try:
+                bench_kv_reuse(fw(2), tok, result, budget_left)
+            except Exception:
+                log("kv reuse bench failed:\n" + traceback.format_exc())
+        else:
+            log("skipping kv reuse bench (deadline budget exhausted)")
 
     phases = [
         ("quant", quant_phase),

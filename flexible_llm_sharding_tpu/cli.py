@@ -145,6 +145,24 @@ def _add_robustness_flags(p: argparse.ArgumentParser) -> None:
                         "bytes, outputs token-identical. 'auto' = measured "
                         "free HBM minus activation headroom (off under "
                         "--chaos and on unknown chips); 0 (default) = off")
+    p.add_argument("--kv_page_tokens", type=int, default=16,
+                   help="rows per paged prefix-KV page (runtime/kvpool.py) "
+                        "— the cross-wave sharing granularity; <= 0 "
+                        "disables the pool")
+    p.add_argument("--kv_pool_gb", type=_float_or_auto, default=None,
+                   help="host-RAM budget in GB for resident prefix-KV "
+                        "pages: a recurring prefix prefills once per "
+                        "PROCESS and later same-prefix waves reuse its "
+                        "pages (refcounted, copy-on-write). 'auto' "
+                        "(default) = a small slice of free RAM (stays on "
+                        "under --chaos: spill reads are chaos sites); "
+                        "0 = off")
+    p.add_argument("--kv_host_spill", type=_str2bool, default=True,
+                   help="true (default): cold prefix-KV pages spill to "
+                        "checksummed disk files that heal on read "
+                        "(re-read + .crc sidecars, typed SpillCorruptError "
+                        "when corruption persists); false: drop them and "
+                        "re-prefill on next use")
     p.add_argument("--readahead_threads", type=int, default=2,
                    help="threads in the loader's page-cache readahead pool "
                         "(posix_fadvise issuers, ~zero CPU each)")
@@ -163,8 +181,9 @@ def _add_pressure_flags(p: argparse.ArgumentParser) -> None:
                         "RAM, spill-disk space, HBM headroom, and the "
                         "host->HBM link; under sustained pressure walk a "
                         "reversible degradation ladder (shrink the host "
-                        "cache, evict residency pins, shed admissions "
-                        "with typed Overloaded rejections, drain fleet "
+                        "cache, evict pooled prefix-KV pages, evict "
+                        "residency pins, shed admissions with typed "
+                        "Overloaded rejections, drain fleet "
                         "replicas) instead of dying — and step back down "
                         "when pressure lifts. Off = zero overhead")
     p.add_argument("--pressure_poll_s", type=float, default=1.0,
@@ -529,6 +548,9 @@ def config_from_args(args: argparse.Namespace) -> FrameworkConfig:
         io_retry_deadline_s=args.io_retry_deadline_s,
         verify_weights=args.verify_weights,
         host_cache_gb=args.host_cache_gb,
+        kv_page_tokens=args.kv_page_tokens,
+        kv_pool_gb=args.kv_pool_gb,
+        kv_host_spill=args.kv_host_spill,
         hbm_pin_gb=args.hbm_pin_gb,
         readahead_threads=args.readahead_threads,
         score_sink_max_device=args.score_sink_max_device,
@@ -694,6 +716,9 @@ def serve_main(argv: list[str] | None = None, tokenizer=None) -> None:
         io_retry_deadline_s=args.io_retry_deadline_s,
         verify_weights=args.verify_weights,
         host_cache_gb=args.host_cache_gb,
+        kv_page_tokens=args.kv_page_tokens,
+        kv_pool_gb=args.kv_pool_gb,
+        kv_host_spill=args.kv_host_spill,
         hbm_pin_gb=args.hbm_pin_gb,
         readahead_threads=args.readahead_threads,
         score_sink_max_device=args.score_sink_max_device,

@@ -1016,6 +1016,20 @@ class FrameworkConfig:
     # Entries are stat-guarded and invalidated on quarantine/manifest
     # change, so PR 4's corruption self-healing is unaffected.
     host_cache_gb: float | None = None
+    # Paged prefix-KV pool (runtime/kvpool.py): process-lived, refcounted
+    # pages share a recurring prefix's post-RoPE KV across admission waves
+    # with copy-on-write at the first divergent token, so a hot system
+    # prompt prefills once per PROCESS instead of once per wave.
+    # kv_page_tokens: rows per page (the sharing granularity; <= 0
+    # disables the pool). kv_pool_gb: host-RAM budget for resident pages —
+    # None = auto (a small slice of available RAM; unlike the shard cache
+    # it stays ON under fault injection, because the pool's spill reads
+    # are themselves chaos sites), 0 disables. kv_host_spill: True spills
+    # cold pages to checksummed disk files that heal on read (PR 4
+    # machinery); False drops them (the prefix simply re-prefills later).
+    kv_page_tokens: int = 16
+    kv_pool_gb: float | None = None
+    kv_host_spill: bool = True
     # Device residency tier (runtime/residency.py): HBM byte budget for
     # pinning the hottest layers (embedding, lm_head, final norm, then as
     # many transformer blocks as fit) permanently on chip — pinned layers
@@ -1102,6 +1116,11 @@ class FrameworkConfig:
                 "host_cache_gb must be >= 0 (or None for auto), got "
                 f"{self.host_cache_gb}"
             )
+        if self.kv_pool_gb is not None and self.kv_pool_gb < 0:
+            raise ValueError(
+                "kv_pool_gb must be >= 0 (or None for auto), got "
+                f"{self.kv_pool_gb}"
+            )
         if self.hbm_pin_gb is not None and self.hbm_pin_gb < 0:
             raise ValueError(
                 "hbm_pin_gb must be >= 0 (or None for auto), got "
@@ -1144,6 +1163,22 @@ class FrameworkConfig:
         )
 
         return auto_budget_bytes()
+
+    def effective_kv_pool_bytes(self) -> int:
+        """Resolve the tri-state ``kv_pool_gb`` to a byte budget.
+
+        Explicit value -> that many GB (0 = off). None (auto) -> a small
+        slice of the host's available RAM (kvpool._auto_budget_bytes).
+        Unlike the shard cache, auto stays ON under fault injection: the
+        pool's spill reads are themselves corrupt_activation chaos sites,
+        so chaos runs keep (and exercise) their draws through the pool."""
+        if self.kv_pool_gb is not None:
+            return int(self.kv_pool_gb * 1e9)
+        from flexible_llm_sharding_tpu.runtime.kvpool import (
+            _auto_budget_bytes,
+        )
+
+        return _auto_budget_bytes()
 
     def effective_hbm_pin_bytes(self, device=None) -> int:
         """Resolve the tri-state ``hbm_pin_gb`` to a pin-tier byte budget.

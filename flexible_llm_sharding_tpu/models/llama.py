@@ -728,6 +728,98 @@ def prefix_suffix_layer(
     return prefix_out, suffix_out
 
 
+def suffix_only_layer(
+    params: Params,
+    cfg: LlamaConfig,
+    kp: jax.Array,
+    vp: jax.Array,
+    suffix_h: jax.Array,
+    prefix_len: jax.Array,
+    use_pallas: bool = False,
+    sliding=None,
+    rope_on=None,
+    tp_mesh=None,
+    total_len=None,
+) -> tuple[jax.Array, dict]:
+    """The suffix half of :func:`prefix_suffix_layer`, fed a CACHED prefix KV.
+
+    In ``prefix_suffix_layer`` the suffix stream depends on the prefix only
+    through the post-RoPE (k, v) — so when a pooled prefix entry
+    (runtime/kvpool.py) already holds those arrays, a same-prefix wave can
+    skip the prefix stream entirely and run just this half, bit-identically:
+    same norm, same rotary positions ``prefix_len + i``, same shared-prefix
+    attention ops, same residual MLP.
+
+    kp/vp: ``[Lp, n_kv, hd]`` / ``[Lp, n_kv, v_dim]`` post-RoPE prefix KV at
+        the SAME Lp bucket the entry was prefilled at (positions past
+        ``prefix_len`` are the pad tail, masked like always).
+    Returns ``(suffix_out, {"ks": ks, "vs": vs})`` — the caller re-attaches
+    kp/vp to rebuild the full decode-KV dict.
+    """
+    lp = kp.shape[0]
+    s, ls, _ = suffix_h.shape
+    eps = cfg.rms_norm_eps
+    rope_sliding = sliding  # rope base selection survives the window shortcut
+    window, chunk, sliding = _effective_window(cfg, sliding)
+    if (window is not None and lp + ls <= window) or (
+        chunk is not None and lp + ls <= chunk
+    ):
+        # Same shortcut as prefix_suffix_layer: at these bucket shapes the
+        # local mask equals full causal, so drop it (keeps flash eligible).
+        window = chunk = sliding = None
+    tp_size = tp_mesh.shape["tp"] if tp_mesh is not None else 1
+    n_kv_eff = (
+        cfg.num_attention_heads if cfg.kv_lora_rank else cfg.num_key_value_heads
+    )
+    flash = use_pallas and pallas_attention.supports(
+        cfg.num_attention_heads // tp_size,
+        n_kv_eff // tp_size,
+        cfg.head_dim,
+        ls,
+        lp,
+        v_dim=cfg.v_dim,
+    )
+
+    hs = rms_norm(suffix_h, params["input_layernorm"]["scale"], eps, cfg.norm_unit_offset)
+    pos_s = prefix_len + jnp.arange(ls)
+    qs, ks, vs = positioned_qkv(
+        params, cfg, hs, pos_s, rope_sliding, rope_on, total_len
+    )
+
+    if flash:
+        flash_kw = dict(
+            scale=cfg.attn_scale,
+            window=window,
+            chunk=chunk,
+            softcap=cfg.attn_logit_softcap,
+        )
+        if tp_mesh is not None:
+            attn_s = _flash_tp_prefix_shared(
+                tp_mesh, qs, kp, vp, ks, vs, prefix_len, sliding, flash_kw
+            )
+        else:
+            attn_s = pallas_attention.flash_prefix_shared_attention(
+                qs, kp, vp, ks, vs, prefix_len, local_on=sliding, **flash_kw
+            )
+    else:
+        attn_s = prefix_shared_attention(
+            qs,
+            kp,
+            vp,
+            ks,
+            vs,
+            prefix_len,
+            scale=cfg.attn_scale,
+            window=window,
+            softcap=cfg.attn_logit_softcap,
+            sliding=sliding,
+            chunk=chunk,
+        )
+    suffix_mid = _residual_attn(params, cfg, suffix_h, attn_s)
+    suffix_out = _residual_mlp(params, cfg, suffix_mid)
+    return suffix_out, {"ks": ks, "vs": vs}
+
+
 def decode_step_layer(
     params: Params,
     cfg: LlamaConfig,

@@ -231,6 +231,17 @@ class IncidentRecorder:
             "files": sorted(files),
             "journal": obs_events.JOURNAL.stats(),
         }
+        try:
+            # Paged prefix-KV pool state (runtime/kvpool.py): counters +
+            # a bounded page-table summary per pool, so a KV-related
+            # failure shows what the pool held and shared post-mortem.
+            # Best-effort like every bundle source; {} when no pool runs.
+            from flexible_llm_sharding_tpu.runtime import kvpool
+
+            if kvpool.process_pools():
+                manifest["kvpool"] = kvpool.process_summary()
+        except Exception:  # noqa: BLE001 — flight-recorder pillar 2
+            manifest["kvpool"] = {"collect_error": 1}
         with open(os.path.join(bundle_dir, MANIFEST_NAME), "w") as f:
             json.dump(manifest, f, indent=1, default=str)
 

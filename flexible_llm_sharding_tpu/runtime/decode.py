@@ -102,6 +102,50 @@ def _prefill_decoders(
     return prefix_h, suffix_h, kv
 
 
+@partial(jax.jit, static_argnums=(0, 1, 2), donate_argnums=(5,))
+def _suffix_prefill_decoders(
+    cfg: LlamaConfig, use_pallas, tp_mesh, seg, kv_p, suffix_h, prefix_len,
+    total_len=None,
+):
+    """Suffix-only prefill scan over a block, fed POOLED prefix KV.
+
+    The cross-wave reuse path (runtime/kvpool.py): when a sealed prefix
+    entry already holds this segment's post-RoPE (kp, vp), only the suffix
+    half of each layer runs (llama.suffix_only_layer) — bit-identical to
+    _prefill_decoders' suffix stream, with zero prefix compute.
+
+    kv_p: {"kp": [k, B, Lp, n_kv, hd], "vp": [k, B, Lp, n_kv, v_dim]} —
+    NOT donated; the caller re-attaches these leaves to the decode-KV dict.
+    Returns (suffix_h, {"ks","vs"} with leaves shaped [k, B, ...]).
+    """
+    stacked, flags, rflags = seg["layers"], seg["sliding"], seg.get("rope")
+
+    def body(s, xs):
+        layer_params, sliding, rope_on, kp_l, vp_l = xs
+
+        def one_layer(lp_, c_, kp_, vp_, s_, plen_, tlen_):
+            return llama.suffix_only_layer(
+                lp_, c_, kp_, vp_, s_, plen_,
+                use_pallas=use_pallas,
+                sliding=sliding,
+                rope_on=rope_on,
+                tp_mesh=tp_mesh,
+                total_len=tlen_,
+            )
+
+        step = jax.vmap(
+            one_layer,
+            in_axes=(None, None, 0, 0, 0, 0, 0 if total_len is not None else None),
+        )
+        s, kv_s = step(layer_params, cfg, kp_l, vp_l, s, prefix_len, total_len)
+        return s, kv_s
+
+    suffix_h, kv_s = jax.lax.scan(
+        body, suffix_h, (stacked, flags, rflags, kv_p["kp"], kv_p["vp"])
+    )
+    return suffix_h, kv_s
+
+
 def _decode_decoders_impl(
     cfg: LlamaConfig,
     use_pallas,
@@ -791,6 +835,12 @@ class DecodeGenerator:
             self._tp_mesh is not None
             or len({id(d) for d in self.shard_devices}) <= 1
         )
+        # The one scheduling policy object (runtime/schedcore.py) — slot
+        # sizing and KV residency decisions shared verbatim with the
+        # serving engine so the two paths cannot drift.
+        from flexible_llm_sharding_tpu.runtime.schedcore import SchedCore
+
+        self._sched_core = SchedCore(cfg)
         self.stats: dict[str, float] = {}
 
     def _hbm_gb(self) -> float | None:
@@ -935,10 +985,12 @@ class DecodeGenerator:
         blocks = make_blocks(toks, cfg.block_size)
         # KV follows the weights: once the model is resident there is HBM
         # headroom, and host-parked KV would be re-uploaded per shard per
-        # step — the dominant cost of a resident decode step.
-        plain_slots = max(1, n_gen - 1)
-        kv_on_device = cfg.storage_location == "tpu" or (
-            self._resident and self._kv_fits_on_chip(toks, blocks, plain_slots)
+        # step — the dominant cost of a resident decode step. Both the slot
+        # sizing and the residency call go through the shared SchedCore.
+        plain_slots = self._sched_core.gen_slots(n_gen)
+        kv_on_device = self._sched_core.kv_on_device(
+            self.model_cfg, cfg.dtype, toks, blocks, plain_slots,
+            self._resident, device=self._probe_dev, n_chips=self._n_chips,
         )
         kv_store = KVStore(on_device=kv_on_device)
         n_layers = len(self.layer_names)
@@ -974,10 +1026,14 @@ class DecodeGenerator:
         # Generated-KV slots: plain decode fills one slot per step; a
         # speculative pass writes K+1 slots at per-suffix offsets capped at
         # n_gen-1, so the last write touches slot n_gen-1+K.
-        gen_slots = (n_gen + spec_k) if speculative else plain_slots
+        gen_slots = self._sched_core.gen_slots(n_gen, spec_k, speculative)
         if speculative and kv_on_device and cfg.storage_location != "tpu":
             # Re-judge the resident-KV decision at the larger footprint.
-            kv_on_device = self._kv_fits_on_chip(toks, blocks, gen_slots)
+            kv_on_device = self._sched_core.kv_on_device(
+                self.model_cfg, cfg.dtype, toks, blocks, gen_slots,
+                self._resident, device=self._probe_dev,
+                n_chips=self._n_chips,
+            )
             kv_store = KVStore(on_device=kv_on_device)
 
         block_meta = {

@@ -1,0 +1,731 @@
+"""Paged, refcounted prefix-KV pool: cross-wave copy-on-write prefix sharing.
+
+Prefix coalescing (serve/sched/coalesce.py) shares a prefill only WITHIN one
+admission wave; a hot system prompt re-prefills on every later wave, forever.
+This module makes prefix KV a first-class, process-lived resource in the
+vLLM/PagedAttention mold, adapted to the streaming-weights regime:
+
+- **Pages.** A prefix's post-RoPE KV is cut into fixed-size pages of
+  ``kv_page_tokens`` rows, one page per (token chunk, decoder segment). A
+  page stores K and V as host numpy (``[k_layers, rows, n_kv, hd]`` /
+  ``[..., v_dim]`` — MLA's K/V dims differ, so the two stay separate
+  arrays that evict/heal as one unit).
+- **Block tables via a trie.** Pages hang off a trie of token chunks keyed
+  by the ACTUAL token ids (the same tokenized-prefix key
+  ``coalesce.build_entries`` computes). A node's identity is its full
+  root path, so a chunk is shared exactly when every token before it
+  matches too — which is precisely when causal attention makes its KV
+  rows content-identical. An entry's "block table" IS its root->leaf
+  path; per-node refcounts are the table's liveness.
+- **Copy-on-write.** Two prefixes that share a head walk the same nodes
+  (``pages_shared``); the first divergent chunk forks its own node and
+  pages (``cow_splits``). Nothing is ever copied eagerly — the fork is
+  the allocation of the divergent tail only.
+- **Reuse.** A SEALED entry (every decoder segment's pages contributed by
+  a completed prefill) lets a later same-prefix request skip its prefix
+  prefill entirely: the engine assembles the pages back into the
+  ``[k_layers, B, Lp, n_kv, hd]`` leaves the decode path expects and runs
+  only the suffix half of each layer (``llama.suffix_only_layer``).
+  Rows at positions >= prefix_len are the Lp-bucket pad tail; the leaf
+  is keyed by (tokens, lp_bucket) so a bucket change never aliases.
+- **Two-tier store + checksummed spill.** Resident pages live in host RAM
+  under ``kv_pool_gb``; under budget (or brownout — the ``kv_evict``
+  lever, runtime/pressure.py) cold zero-ref pages either spill to disk
+  with the PR 4 sidecar machinery (``kv_host_spill=true``: atomic
+  ``_save_npy`` + ``.crc`` sidecar, verified 3-attempt re-read heals on
+  fetch, typed ``SpillCorruptError`` when corruption persists) or drop
+  (``false``: the owning entries unseal and simply re-prefill later).
+  Refcounted (in-use) pages are never evicted, so an acquire->assemble
+  window can't lose its pages mid-wave.
+
+Longrope models are excluded by the engine (their prefix KV depends on the
+prompt's TOTAL length through the rope-table switch, so "same prefix
+tokens" does not imply "same prefix KV").
+
+Thread-safety: one ``threading.RLock`` guards all pool state (the engine
+thread, metrics scrape threads, and the pressure monitor all touch it);
+file I/O for spill/unspill runs OFF the lock (hostcache precedent).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+import numpy as np
+
+from flexible_llm_sharding_tpu.integrity import manifest as integrity_manifest
+from flexible_llm_sharding_tpu.integrity.manifest import (
+    SpillCorruptError,
+    SpillReadError,
+)
+from flexible_llm_sharding_tpu.runtime.activations import (
+    _SPILL_REREAD_ATTEMPTS,
+    _restore_dtype,
+    _save_npy,
+)
+
+
+class _Page:
+    """KV rows for ONE token chunk of ONE decoder segment.
+
+    ``k``/``v`` are host numpy while resident and None while spilled
+    (``paths`` then names the two checksummed ``.npy`` files).
+    ``pending_spill`` marks an off-lock spill write in flight so the
+    victim scan never double-picks."""
+
+    __slots__ = ("k", "v", "paths", "nbytes", "last_used", "node",
+                 "pending_spill")
+
+    def __init__(self, k: np.ndarray, v: np.ndarray, node, clock: int):
+        self.k = k
+        self.v = v
+        self.paths: tuple[str, str] | None = None
+        self.nbytes = int(k.nbytes) + int(v.nbytes)
+        self.last_used = clock
+        self.node = node
+        self.pending_spill = False
+
+    @property
+    def resident(self) -> bool:
+        return self.k is not None
+
+
+class _Node:
+    """One token chunk in the trie. Identity is the full root path, so a
+    node is shared exactly between prefixes whose token streams match up
+    to and including this chunk."""
+
+    __slots__ = ("key", "parent", "children", "pages", "refs", "span",
+                 "entry")
+
+    def __init__(self, key, parent, span):
+        self.key = key
+        self.parent = parent
+        self.children: dict = {}
+        self.pages: dict[tuple, _Page] = {}  # seg_key -> page
+        self.refs = 0  # live PrefixHandles whose path includes this node
+        self.span = span  # (row_start, row_end) within the Lp bucket
+        # Leaf-only entry metadata: dict(sealed, prefix_len, lp_bucket,
+        # seg_keys) or None for interior/unsealed nodes.
+        self.entry: dict | None = None
+
+
+class PrefixHandle:
+    """One request-entry's lease on a trie path (its block table).
+
+    ``reusable`` means the leaf was already sealed by an earlier prefill
+    at the same Lp bucket: the engine assembles pages instead of running
+    the prefix prefill. The handle refcounts every node on the path from
+    ``acquire`` until ``release`` — pages in the table are eviction-proof
+    for exactly that window."""
+
+    __slots__ = ("pool", "path", "reusable", "released", "segs",
+                 "prefix_len", "lp_bucket", "shared_any", "alloc_any")
+
+    def __init__(self, pool, path, prefix_len, lp_bucket, reusable,
+                 segs):
+        self.pool = pool
+        self.path: list[_Node] = path
+        self.prefix_len = prefix_len
+        self.lp_bucket = lp_bucket
+        self.reusable = reusable
+        self.released = False
+        self.segs: set[tuple] = segs  # decoder seg keys with pages
+        self.shared_any = False  # >=1 chunk found already present
+        self.alloc_any = False  # >=1 chunk newly allocated
+
+
+def _chunk_keys(ids: tuple, prefix_len: int, lp_bucket: int,
+                page_tokens: int):
+    """(key, (row_start, row_end)) per chunk. Interior chunks are keyed by
+    their token ids alone (their KV rows depend on nothing later); the
+    FINAL chunk carries the Lp-bucket pad tail, so its key folds in the
+    bucket — same tokens at a different bucket fork a new leaf."""
+    out = []
+    for a in range(0, prefix_len, page_tokens):
+        b = min(a + page_tokens, prefix_len)
+        if b == prefix_len:
+            out.append((("tail", ids[a:b], lp_bucket), (a, lp_bucket)))
+        else:
+            out.append((("mid", ids[a:b]), (a, b)))
+    return out
+
+
+class KVPagePool:
+    """Process-lived paged prefix-KV allocator (module docstring)."""
+
+    COUNTERS = (
+        "pages_allocated",
+        "pages_shared",
+        "cow_splits",
+        "pages_evicted",
+        "pages_healed",
+        "prefix_reuse_hits",
+    )
+
+    def __init__(self, page_tokens: int, budget_bytes: int,
+                 spill_dir: str, host_spill: bool = True):
+        self._lock = threading.RLock()
+        self.page_tokens = int(page_tokens)  # guarded by: _lock
+        self.budget_bytes = int(budget_bytes)  # guarded by: _lock
+        self.host_spill = bool(host_spill)  # guarded by: _lock
+        self.spill_dir = spill_dir  # guarded by: _lock
+        self._root = _Node(None, None, (0, 0))  # guarded by: _lock
+        self._pages: set[_Page] = set()  # guarded by: _lock
+        self._clock = 0  # guarded by: _lock
+        self._page_seq = 0  # guarded by: _lock
+        self._np_dtype = None  # guarded by: _lock
+        # Brownout latch (the pressure ladder's kv_evict lever): while
+        # set, the effective budget is 0 — every zero-ref page evicts and
+        # new contributions spill/drop immediately. Reversible: lifting
+        # the latch restores the configured budget; spilled pages reload
+        # on demand through the verified read path.
+        self._pressure_evicting = False  # guarded by: _lock
+        self._injector = None  # guarded by: _lock
+        # Counters (all exported by stats(); pre-seeded so the
+        # fls_kvpool_* family is always scrapeable).
+        self.pages_allocated = 0  # guarded by: _lock
+        self.pages_shared = 0  # guarded by: _lock
+        self.cow_splits = 0  # guarded by: _lock
+        self.pages_evicted = 0  # guarded by: _lock
+        self.pages_healed = 0  # guarded by: _lock
+        self.prefix_reuse_hits = 0  # guarded by: _lock
+        self.bytes_resident = 0  # guarded by: _lock
+        self.entries_sealed = 0  # guarded by: _lock
+
+    # -- configuration -----------------------------------------------------
+
+    def set_budget(self, budget_bytes: int) -> None:
+        with self._lock:
+            self.budget_bytes = int(budget_bytes)
+        self._enforce_budget()
+
+    def set_injector(self, injector) -> None:
+        """Chaos-only FaultInjector: corrupt_activation fires on every
+        spill read, exactly like the activation-spill path. Last engine
+        wins (the pool is process-lived, injectors are per-engine)."""
+        with self._lock:
+            self._injector = injector
+
+    # -- lease lifecycle ---------------------------------------------------
+
+    def acquire(self, ids: tuple, prefix_len: int,
+                lp_bucket: int) -> PrefixHandle:
+        """Lease the trie path for one tokenized prefix. Creates missing
+        nodes (the contribute path fills their pages) and refcounts every
+        node; ``reusable`` when an earlier prefill sealed this exact
+        (tokens, bucket) leaf — the caller then assembles instead of
+        prefilling."""
+        with self._lock:
+            if prefix_len <= 0 or self.page_tokens <= 0:
+                return PrefixHandle(self, [], prefix_len, lp_bucket,
+                                    False, set())
+            path = []
+            node = self._root
+            for key, span in _chunk_keys(tuple(ids), prefix_len,
+                                         lp_bucket, self.page_tokens):
+                child = node.children.get(key)
+                if child is None:
+                    child = _Node(key, node, span)
+                    node.children[key] = child
+                child.refs += 1
+                path.append(child)
+                node = child
+            leaf = path[-1]
+            e = leaf.entry
+            reusable = bool(
+                e is not None
+                and e["sealed"]
+                and e["lp_bucket"] == lp_bucket
+                and e["prefix_len"] == prefix_len
+            )
+            segs = set(e["seg_keys"]) if reusable else set()
+            if reusable:
+                self.prefix_reuse_hits += 1
+            return PrefixHandle(self, path, prefix_len, lp_bucket,
+                                reusable, segs)
+
+    def release(self, handle: PrefixHandle) -> None:
+        """Drop the lease (request retired/preempted/failed). Idempotent.
+        Pages persist for future reuse — only refcounts drop, making the
+        path evictable again."""
+        with self._lock:
+            if handle.released:
+                return
+            handle.released = True
+            for node in handle.path:
+                node.refs -= 1
+
+    # -- write path (full prefill contributes its pages) -------------------
+
+    def contribute(self, handle: PrefixHandle, seg_key: tuple,
+                   k: np.ndarray, v: np.ndarray) -> None:
+        """Cut one decoder segment's prefix KV (``[k_layers, Lp_bucket,
+        n_kv, hd]`` host arrays, one block row) into pages along the
+        handle's path. Chunks another prefix already contributed are
+        deduplicated in place (``pages_shared``); only the divergent tail
+        allocates."""
+        if handle.released or not handle.path:
+            return
+        with self._lock:
+            if self._np_dtype is None:
+                self._np_dtype = k.dtype
+            self._clock += 1
+            for node in handle.path:
+                page = node.pages.get(seg_key)
+                if page is not None:
+                    self.pages_shared += 1
+                    page.last_used = self._clock
+                    handle.shared_any = True
+                    continue
+                a, b = node.span
+                page = _Page(
+                    np.ascontiguousarray(k[:, a:b]),
+                    np.ascontiguousarray(v[:, a:b]),
+                    node, self._clock,
+                )
+                node.pages[seg_key] = page
+                self._pages.add(page)
+                self.pages_allocated += 1
+                self.bytes_resident += page.nbytes
+                handle.alloc_any = True
+            handle.segs.add(seg_key)
+        self._enforce_budget()
+
+    def seal(self, handle: PrefixHandle) -> None:
+        """Mark the entry complete: every decoder segment contributed and
+        the owning wave's prefill finished. From here, same-prefix
+        acquires are ``reusable``. A COW fork (some chunks shared, some
+        newly allocated) counts once, at seal."""
+        with self._lock:
+            if handle.released or not handle.path or not handle.segs:
+                return
+            leaf = handle.path[-1]
+            if leaf.entry is None or not leaf.entry["sealed"]:
+                self.entries_sealed += 1
+            leaf.entry = {
+                "sealed": True,
+                "prefix_len": handle.prefix_len,
+                "lp_bucket": handle.lp_bucket,
+                "seg_keys": frozenset(handle.segs),
+            }
+            if handle.shared_any and handle.alloc_any:
+                self.cow_splits += 1
+
+    # -- read path (reuse assembles pages back into KV leaves) -------------
+
+    def assemble(self, handle: PrefixHandle, seg_key: tuple):
+        """(k, v) host arrays ``[k_layers, lp_bucket, n_kv, hd]`` for one
+        decoder segment, concatenated from the handle's pages. Spilled
+        pages reload through the verified read path (checksum sidecar +
+        re-read heals; persistent corruption raises a typed
+        ``SpillCorruptError`` the engine's wave-reject path absorbs)."""
+        with self._lock:
+            if handle.released or seg_key not in handle.segs:
+                raise KeyError(
+                    f"kvpool: segment {seg_key!r} not present for this "
+                    "prefix entry"
+                )
+            self._clock += 1
+            pages = []
+            for node in handle.path:
+                page = node.pages[seg_key]
+                page.last_used = self._clock
+                pages.append(page)
+            jobs = [p for p in pages if not p.resident]
+        for page in jobs:
+            self._unspill(page)
+        with self._lock:
+            ks = [p.k for p in pages]
+            vs = [p.v for p in pages]
+        return (
+            np.concatenate(ks, axis=1) if len(ks) > 1 else ks[0],
+            np.concatenate(vs, axis=1) if len(vs) > 1 else vs[0],
+        )
+
+    def entry_bytes(self, handle: PrefixHandle) -> int:
+        """ACTUAL bytes the pool holds for this entry's prefix KV (sum of
+        its pages across all contributed segments, resident or spilled)
+        — the allocator-bookkeeping figure `prefill_kv_bytes_saved`
+        accounting reads instead of the analytic estimate."""
+        with self._lock:
+            total = 0
+            for node in handle.path:
+                for seg_key in handle.segs:
+                    page = node.pages.get(seg_key)
+                    if page is not None:
+                        total += page.nbytes
+            return total
+
+    # -- eviction / spill --------------------------------------------------
+
+    def _effective_budget(self) -> int:
+        # flscheck: holds=_lock: internal helper — every caller already owns the lock
+        return 0 if self._pressure_evicting else self.budget_bytes
+
+    def _pick_victim(self) -> _Page | None:
+        # flscheck: holds=_lock: internal helper — every caller already owns the lock
+        # LRU over RESIDENT pages of zero-ref
+        # paths; refcounted pages are pinned by their lease.
+        best = None
+        for page in self._pages:
+            if not page.resident or page.pending_spill:
+                continue
+            if page.node.refs > 0:
+                continue
+            if best is None or page.last_used < best.last_used:
+                best = page
+        return best
+
+    def _page_paths(self) -> tuple[str, str]:
+        # flscheck: holds=_lock: internal helper — every caller already owns the lock
+        self._page_seq += 1
+        stem = os.path.join(self.spill_dir,
+                            f"kvpage-{self._page_seq:08d}")
+        return f"{stem}-k.npy", f"{stem}-v.npy"
+
+    def _enforce_budget(self) -> None:
+        """Evict LRU zero-ref pages until resident bytes fit the budget.
+        Spill writes run OFF the lock (LOCK-IO discipline; the files are
+        whole-or-absent via _save_npy's temp+rename)."""
+        while True:
+            with self._lock:
+                if self.bytes_resident <= self._effective_budget():
+                    return
+                page = self._pick_victim()
+                if page is None:
+                    return  # everything left is leased — nothing to do
+                if not self.host_spill:
+                    self._drop_page(page)
+                    continue
+                page.pending_spill = True
+                k, v = page.k, page.v
+                kp, vp = self._page_paths()
+                spill_dir = self.spill_dir
+            try:
+                os.makedirs(spill_dir, exist_ok=True)
+                _save_npy(kp, k)
+                _save_npy(vp, v)
+                ok = True
+            except OSError:
+                ok = False  # disk full/unwritable: fall back to dropping
+            with self._lock:
+                page.pending_spill = False
+                if not page.resident:
+                    continue  # dropped or superseded meanwhile
+                if ok:
+                    page.k = page.v = None
+                    page.paths = (kp, vp)
+                    self.bytes_resident -= page.nbytes
+                    self.pages_evicted += 1
+                else:
+                    self._drop_page(page)
+
+    def _drop_page(self, page: _Page) -> None:
+        # flscheck: holds=_lock: internal helper — every caller already owns the lock
+        # Dropping breaks every sealed entry whose
+        # path crosses this node: unseal the subtree so later acquires
+        # re-prefill (correct, just slower) instead of assembling a hole.
+        node = page.node
+        for seg_key, p in list(node.pages.items()):
+            if p is page:
+                del node.pages[seg_key]
+                break
+        self._pages.discard(page)
+        if page.resident:
+            self.bytes_resident -= page.nbytes
+            page.k = page.v = None
+        self.pages_evicted += 1
+        self._remove_spill_files(page)
+        self._unseal_subtree(node)
+
+    def _unseal_subtree(self, node: _Node) -> None:
+        # flscheck: holds=_lock: internal helper — every caller already owns the lock
+        stack = [node]
+        while stack:
+            n = stack.pop()
+            if n.entry is not None and n.entry["sealed"]:
+                n.entry["sealed"] = False
+                self.entries_sealed -= 1
+            stack.extend(n.children.values())
+
+    def _remove_spill_files(self, page: _Page) -> None:
+        if page.paths is None:
+            return
+        for path in page.paths:
+            try:
+                os.remove(path)
+            except OSError:
+                pass  # never spilled / already reclaimed
+            integrity_manifest.remove_sidecar(path)
+        page.paths = None
+
+    def _unspill(self, page: _Page) -> None:
+        """Reload one spilled page through the verified read path: np.load
+        + (chaos) corruption injection + sidecar checksum, with up to
+        ``_SPILL_REREAD_ATTEMPTS`` re-reads per file — a re-read heals
+        page-cache/NFS corruption (``pages_healed``); persistence raises
+        the typed spill errors, naming the file."""
+        with self._lock:
+            if page.resident or page.paths is None:
+                return
+            paths = page.paths
+            injector = self._injector
+            np_dtype = self._np_dtype
+        arrs = []
+        healed = False
+        for path in paths:
+            where = f"{path} (kvpool page)"
+            last: Exception | None = None
+            decode_failure = False
+            arr = None
+            for attempt in range(_SPILL_REREAD_ATTEMPTS):
+                try:
+                    arr = np.load(path)
+                    if injector is not None:
+                        arr = injector.corrupt_array(
+                            "corrupt_activation", arr, detail=path
+                        )
+                except (OSError, ValueError, EOFError) as e:
+                    last, decode_failure, arr = e, True, None
+                    continue
+                side = integrity_manifest.read_sidecar(path)
+                if side is not None:
+                    csum, nbytes = side
+                    if (
+                        int(arr.nbytes) != nbytes
+                        or integrity_manifest.tensor_checksum(arr) != csum
+                    ):
+                        last, decode_failure, arr = (
+                            SpillCorruptError(f"{where}: checksum mismatch"),
+                            False, None,
+                        )
+                        continue
+                if attempt:
+                    healed = True
+                break
+            if arr is None:
+                # The page is irrecoverable: drop it NOW (unsealing every
+                # entry whose table crosses it) so the failing wave's
+                # retry re-prefills instead of re-reading the same
+                # corruption forever.
+                with self._lock:
+                    self._drop_page(page)
+                exc_type = (SpillReadError if decode_failure
+                            else SpillCorruptError)
+                raise exc_type(
+                    f"{where}: "
+                    f"{'unreadable' if decode_failure else 'corrupt'} after "
+                    f"{_SPILL_REREAD_ATTEMPTS} read attempt(s): {last!r}"
+                ) from last
+            arrs.append(_restore_dtype(arr, np_dtype))
+        with self._lock:
+            if healed:
+                self.pages_healed += 1
+            if page.resident:
+                return  # a concurrent assemble won the reload
+            page.k, page.v = arrs
+            self.bytes_resident += page.nbytes
+            self._remove_spill_files(page)
+
+    # -- brownout lever (runtime/pressure.py "kv_evict") -------------------
+
+    def pressure_evict(self) -> int:
+        """Engage the kv_evict brownout stage: latch the effective budget
+        to 0 and evict every zero-ref resident page now (spill when
+        ``kv_host_spill``, else drop+unseal). Returns pages evicted by
+        this call. Reversible — see :meth:`pressure_restore`."""
+        with self._lock:
+            self._pressure_evicting = True
+            before = self.pages_evicted
+        self._enforce_budget()
+        with self._lock:
+            return self.pages_evicted - before
+
+    def pressure_restore(self) -> None:
+        """Release the kv_evict stage: the configured budget applies again
+        and spilled pages reload on demand through the verified path."""
+        with self._lock:
+            self._pressure_evicting = False
+
+    # -- observability -----------------------------------------------------
+
+    def stats(self) -> dict:
+        with self._lock:
+            spilled = sum(
+                1 for p in self._pages
+                if not p.resident and p.paths is not None
+            )
+            return {
+                "pages_allocated": self.pages_allocated,
+                "pages_shared": self.pages_shared,
+                "cow_splits": self.cow_splits,
+                "pages_evicted": self.pages_evicted,
+                "pages_healed": self.pages_healed,
+                "prefix_reuse_hits": self.prefix_reuse_hits,
+                "pages_resident": sum(
+                    1 for p in self._pages if p.resident
+                ),
+                "pages_spilled": spilled,
+                "bytes_resident": self.bytes_resident,
+                "budget_bytes": self._effective_budget(),
+                "entries_sealed": self.entries_sealed,
+            }
+
+    def summary(self) -> dict:
+        """Page-table summary for incident bundles (obs/incident.py):
+        counters plus a bounded per-entry table — enough to see what the
+        pool held and shared when a KV-related failure fired."""
+        with self._lock:
+            entries = []
+            stack = [(self._root, 0)]
+            while stack and len(entries) < 64:
+                node, depth = stack.pop()
+                if node.entry is not None:
+                    entries.append({
+                        "prefix_len": node.entry["prefix_len"],
+                        "lp_bucket": node.entry["lp_bucket"],
+                        "sealed": node.entry["sealed"],
+                        "segs": len(node.entry["seg_keys"]),
+                        "chunks": depth,
+                        "refs": node.refs,
+                    })
+                stack.extend((c, depth + 1)
+                             for c in node.children.values())
+        return {"counters": self.stats(), "entries": entries}
+
+
+# -- process-wide pools ------------------------------------------------------
+# One pool per (model, dtype, paging geometry): the serving engine rebuilds
+# on recovery and tests build several engines per process — all must hit the
+# same sealed prefixes, which is the whole point (prefill once per PROCESS).
+
+_POOLS: dict[tuple, KVPagePool] = {}
+_POOLS_LOCK = threading.Lock()
+_REGISTERED = False
+
+
+def _auto_budget_bytes() -> int:
+    """Auto ``kv_pool_gb``: a small slice of available host RAM (5%,
+    capped at 4 GB), or a 1 GB floor when free RAM is unknowable. Unlike
+    the host shard cache, auto does NOT disable under fault injection:
+    the pool's spill reads are themselves chaos sites (corrupt_activation
+    fires per page fetch), so chaos runs keep their draws."""
+    from flexible_llm_sharding_tpu.runtime.hostcache import (
+        available_host_bytes,
+    )
+
+    avail = available_host_bytes()
+    if not avail:
+        return int(1e9)
+    return min(int(avail * 0.05), int(4e9))
+
+
+def pool_for(cfg) -> KVPagePool | None:
+    """The process pool for this config's (model, dtype, paging geometry),
+    or None when disabled (``kv_pool_gb=0`` / ``kv_page_tokens<=0``).
+    Budget/spill knobs follow the most recent resolving config."""
+    budget = cfg.effective_kv_pool_bytes()
+    if budget <= 0 or cfg.kv_page_tokens <= 0:
+        return None
+    key = (
+        cfg.model_path,
+        cfg.dtype,
+        int(cfg.kv_page_tokens),
+        int(cfg.layer_num_per_shard),
+        int(cfg.bucket_multiple),
+        int(cfg.max_token_len),
+    )
+    global _REGISTERED
+    with _POOLS_LOCK:
+        pool = _POOLS.get(key)
+        if pool is None:
+            pool = KVPagePool(
+                cfg.kv_page_tokens,
+                budget,
+                spill_dir=os.path.join(cfg.disk_folder, "kvpool"),
+                host_spill=cfg.kv_host_spill,
+            )
+            _POOLS[key] = pool
+            if not _REGISTERED:
+                # Registry citizen: the fls_kvpool_* family scrapes from
+                # the same aggregate the stats lines print.
+                from flexible_llm_sharding_tpu.obs.registry import REGISTRY
+
+                REGISTRY.register("kvpool", process_stats)
+                _REGISTERED = True
+        else:
+            with pool._lock:
+                pool.budget_bytes = int(budget)
+                pool.host_spill = bool(cfg.kv_host_spill)
+    return pool
+
+
+def process_pools() -> list[KVPagePool]:
+    with _POOLS_LOCK:
+        return list(_POOLS.values())
+
+
+def process_stats() -> dict:
+    """Aggregate counters across every live pool (usually one) — the
+    process-registry source backing the fls_kvpool_* exposition family;
+    pre-seeded so 'zero reuse' is distinguishable from 'not exported'."""
+    agg = {
+        k: 0
+        for k in KVPagePool.COUNTERS + (
+            "pages_resident", "pages_spilled", "bytes_resident",
+            "budget_bytes", "entries_sealed",
+        )
+    }
+    for pool in process_pools():
+        for k, n in pool.stats().items():
+            agg[k] = agg.get(k, 0) + n
+    return agg
+
+
+def process_summary() -> dict:
+    """Incident-bundle payload: per-pool page-table summaries."""
+    return {"pools": [pool.summary() for pool in process_pools()]}
+
+
+def process_pressure_evict() -> int:
+    """Brownout engage hook (runtime/pressure.py kv_evict stage)."""
+    return sum(pool.pressure_evict() for pool in process_pools())
+
+
+def process_pressure_restore() -> None:
+    """Brownout release hook: budgets apply again everywhere."""
+    for pool in process_pools():
+        pool.pressure_restore()
+
+
+def reset_process_pools() -> None:
+    """Drop every pool and its spill files (tests)."""
+    global _REGISTERED
+    with _POOLS_LOCK:
+        pools = list(_POOLS.values())
+        _POOLS.clear()
+        registered, _REGISTERED = _REGISTERED, False
+    for pool in pools:
+        with pool._lock:
+            pages = list(pool._pages)
+        for page in pages:
+            pool._remove_spill_files(page)
+    if registered:
+        from flexible_llm_sharding_tpu.obs.registry import REGISTRY
+
+        REGISTRY.unregister("kvpool")
+
+
+__all__ = [
+    "KVPagePool",
+    "PrefixHandle",
+    "pool_for",
+    "process_pools",
+    "process_pressure_evict",
+    "process_pressure_restore",
+    "process_stats",
+    "process_summary",
+    "reset_process_pools",
+]

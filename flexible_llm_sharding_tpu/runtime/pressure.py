@@ -254,7 +254,11 @@ class BrownoutController:
     """
 
     # Ladder levels above 0 (normal), in engage order.
-    LADDER = ("cache_shrink", "pin_evict", "shed", "replica_drain")
+    # kv_evict sits between the shard-cache shrink (gentlest: cached
+    # shards re-read from disk) and pin eviction: pooled prefix-KV pages
+    # spill to checksummed disk (or drop and re-prefill) — cheaper to
+    # give back than pinned weights, dearer than a clean shard cache.
+    LADDER = ("cache_shrink", "kv_evict", "pin_evict", "shed", "replica_drain")
 
     def __init__(self, cfg):
         self.cfg = cfg
@@ -272,6 +276,7 @@ class BrownoutController:
         self.steps_down = 0
         self.sheds = 0
         self.cache_shrinks = 0
+        self.kv_evictions = 0
         self.pin_evictions = 0
         self.replica_drains = 0
         self.replica_restores = 0
@@ -419,6 +424,13 @@ class BrownoutController:
                     with self._lock:
                         self._saved_cache_budget = prev
                         self.cache_shrinks += 1
+            elif stage == "kv_evict":
+                from flexible_llm_sharding_tpu.runtime import kvpool
+
+                n = kvpool.process_pressure_evict()
+                if n:
+                    with self._lock:
+                        self.kv_evictions += n
             elif stage == "pin_evict":
                 from flexible_llm_sharding_tpu.runtime import residency
 
@@ -456,6 +468,10 @@ class BrownoutController:
                     restore = self._saved_cache_budget
                     self._saved_cache_budget = None
                 hostcache.lift_pressure_cap(restore)
+            elif stage == "kv_evict":
+                from flexible_llm_sharding_tpu.runtime import kvpool
+
+                kvpool.process_pressure_restore()
             elif stage == "pin_evict":
                 from flexible_llm_sharding_tpu.runtime import residency
 
@@ -490,6 +506,7 @@ class BrownoutController:
                 "steps_down": self.steps_down,
                 "sheds": self.sheds,
                 "cache_shrinks": self.cache_shrinks,
+                "kv_evictions": self.kv_evictions,
                 "pin_evictions": self.pin_evictions,
                 "replica_drains": self.replica_drains,
                 "replica_restores": self.replica_restores,

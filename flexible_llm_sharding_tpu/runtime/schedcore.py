@@ -1,0 +1,93 @@
+"""The ONE sweep-scheduling policy object.
+
+The offline scoring path (runtime/decode.py DecodeGenerator) and the serve
+engine (serve/engine.py + serve/batcher.py) grew three copies of the same
+scheduling arithmetic — wave admission quotas, generated-KV slot sizing, the
+KV residency decision, and the spill policy. Copies drift: PR 14's
+speculative re-judge had to be hand-mirrored into both paths, and the serve
+side's `max(1, wave.max_steps - 1)` is the same expression as decode's
+`max(1, n_gen - 1)` wearing different variable names.
+
+``SchedCore`` extracts those decisions into one object both paths consume:
+
+- **admission_quota** — how many queued requests a wave boundary may admit
+  (the batcher's budget line).
+- **gen_slots** — how many generated-KV slots a wave's cache must carve:
+  plain decode fills one slot per step with the last step's never written
+  (``budget - 1``, floored at 1); a speculative pass writes K+1 slots at
+  per-suffix offsets capped at budget-1, so the high-water slot is
+  ``budget + spec_k``.
+- **kv_on_device** — KV follows the weights: pinned-on-TPU storage always
+  keeps KV on chip; streamed storage keeps it on chip only when the model
+  is host-RAM resident (otherwise KV re-uploads per shard per step) AND
+  the measured footprint fits HBM. The speculative paths re-judge at the
+  larger slot count through this same method.
+- **spill_policy** — whether cold KV pages spill to checksummed disk files
+  (heal-on-read) or drop and re-prefill (``kv_host_spill``).
+
+Keeping the object stateless (pure functions of config + wave shape) means
+preemption resume costs nothing here: a resumed request re-enters admission
+like any other, and its KV comes back from the kvpool block table instead
+of a re-run prefill.
+"""
+
+from __future__ import annotations
+
+
+class SchedCore:
+    """Shared scheduling policy; ``cfg`` is a FrameworkConfig or None
+    (admission-only consumers like the default batcher need no config)."""
+
+    def __init__(self, cfg=None):
+        self.cfg = cfg
+
+    # -- wave admission ----------------------------------------------------
+
+    def admission_quota(self, max_active: int, active: int) -> int:
+        """Requests a shard-0 boundary may admit: the active-request cap
+        minus what is already in flight (never negative)."""
+        return max(0, max_active - active)
+
+    # -- generated-KV slot sizing ------------------------------------------
+
+    def gen_slots(self, budget: int, spec_k: int = 0,
+                  speculative: bool = False) -> int:
+        """Slots to carve for generated KV given a token budget (offline:
+        n_gen; serve: the wave's max remaining steps). Speculative passes
+        write K+1 slots at offsets capped at budget-1 — high-water slot
+        budget-1+K — while plain decode never writes the final step's KV."""
+        if speculative:
+            return budget + spec_k
+        return max(1, budget - 1)
+
+    # -- KV residency ------------------------------------------------------
+
+    def kv_on_device(self, model_cfg, dtype, toks, blocks, gen_slots,
+                     resident, device=None, n_chips=1) -> bool:
+        """KV follows the weights: on chip when storage is pinned-TPU, or
+        when the model is resident and the measured KV + weights footprint
+        fits HBM at this slot count. Re-invoke at a larger ``gen_slots``
+        to re-judge for speculative passes."""
+        cfg = self.cfg
+        if cfg is not None and cfg.storage_location == "tpu":
+            return True
+        if not resident:
+            return False
+        # Lazy import: decode.py constructs a SchedCore at module import.
+        from flexible_llm_sharding_tpu.runtime.decode import kv_fits_on_chip
+
+        dt = cfg.dtype if cfg is not None else dtype
+        return kv_fits_on_chip(
+            model_cfg, dt, toks, blocks, gen_slots,
+            device=device, n_chips=n_chips,
+        )
+
+    # -- spill policy ------------------------------------------------------
+
+    def spill_policy(self) -> bool:
+        """True: cold KV pages spill to checksummed disk sidecar files and
+        heal on read; False: they drop and the prefix re-prefills."""
+        return bool(self.cfg.kv_host_spill) if self.cfg is not None else True
+
+
+__all__ = ["SchedCore"]

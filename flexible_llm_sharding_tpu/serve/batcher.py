@@ -122,15 +122,22 @@ class ShardAwareBatcher:
         max_active_requests: int,
         metrics=None,
         entry_builder=None,
+        sched_core=None,
     ):
         # entry_builder (serve/sched/coalesce.build_entries partial, or
         # None): maps one boundary's popped requests to WaveEntry groups —
         # the prefix-coalescing hook. None keeps one entry per request.
+        # sched_core: the shared scheduling policy object — the engine
+        # passes its own; standalone batchers get a config-less default
+        # (admission needs no config).
+        from flexible_llm_sharding_tpu.runtime.schedcore import SchedCore
+
         self.queue = queue
         self.max_wave_requests = max_wave_requests
         self.max_active_requests = max_active_requests
         self._metrics = metrics
         self._entry_builder = entry_builder
+        self._sched_core = sched_core or SchedCore(None)
         self.waves: list[Wave] = []
 
     @property
@@ -148,7 +155,9 @@ class ShardAwareBatcher:
         returns the new wave (already tracked) or None."""
         import time
 
-        budget = self.max_active_requests - self.active_requests
+        budget = self._sched_core.admission_quota(
+            self.max_active_requests, self.active_requests
+        )
         if budget <= 0:
             # No admission this boundary, but deadline eviction must not
             # stall behind a saturated active set: a zero-size pop still

@@ -60,6 +60,8 @@ from flexible_llm_sharding_tpu.obs import incident as obs_incident
 from flexible_llm_sharding_tpu.obs import trace as obs_trace
 from flexible_llm_sharding_tpu.obs.slo import SLOTracker
 from flexible_llm_sharding_tpu.parallel.planner import plan_shards_dp
+from flexible_llm_sharding_tpu.integrity.manifest import SpillCorruptError
+from flexible_llm_sharding_tpu.runtime import kvpool
 from flexible_llm_sharding_tpu.runtime.decode import (
     KVStore,
     SpecVerifier,
@@ -68,10 +70,11 @@ from flexible_llm_sharding_tpu.runtime.decode import (
     _prefill_decoders,
     _spec_decoders,
     _spec_norm_head,
+    _suffix_prefill_decoders,
     draft_contexts,
     extend_gen_kv,
-    kv_fits_on_chip,
 )
+from flexible_llm_sharding_tpu.runtime.schedcore import SchedCore
 from flexible_llm_sharding_tpu.faults.inject import FaultInjector
 from flexible_llm_sharding_tpu.runtime.executor import (
     ShardLoadError,
@@ -130,6 +133,13 @@ class _WaveState:
     # Per-sweep slot offsets fixed at the embed segment (shard 0) and
     # consumed by every decoder segment of the same sweep.
     spec_base: dict[int, np.ndarray] = dataclasses.field(default_factory=dict)
+    # Paged prefix-KV pool (runtime/kvpool.py): one PrefixHandle per wave
+    # entry — the entry's lease on its block table, held from admission
+    # to retire/preempt/abort — and the blocks whose EVERY row reuses a
+    # sealed pool entry (those skip the prefix prefill and run the
+    # suffix-only scan over assembled pages).
+    pool_handles: dict[int, Any] = dataclasses.field(default_factory=dict)
+    reuse_blocks: set[int] = dataclasses.field(default_factory=set)
 
 
 class ServeEngine:
@@ -311,11 +321,34 @@ class ServeEngine:
                 "pressure", self._pressure.stats,
                 mirror=False,  # process-level: controller_for registers it
             )
+        # The one scheduling policy object (runtime/schedcore.py): wave
+        # admission quotas, generated-KV slot sizing, and the residency
+        # decision — shared verbatim with the offline DecodeGenerator so
+        # the two paths cannot drift.
+        self._sched_core = SchedCore(cfg)
+        # Paged prefix-KV pool (runtime/kvpool.py): a recurring prefix
+        # prefills once per PROCESS; later same-prefix waves reuse its
+        # refcounted pages with zero prefix recompute (copy-on-write at
+        # the first divergent token). Longrope models opt out: their
+        # prefix KV depends on the prompt's TOTAL length through the
+        # rope-table switch, so same prefix tokens != same prefix KV.
+        self._kv_pool = (
+            None
+            if self.model_cfg.rope_scaling_kind == "longrope"
+            else kvpool.pool_for(cfg)
+        )
+        if self._kv_pool is not None:
+            self._kv_pool.set_injector(self._injector)
+            self.metrics.register(
+                "kvpool", kvpool.process_stats,
+                mirror=False,  # process-level: pool_for registers it
+            )
         self.batcher = ShardAwareBatcher(
             self.queue,
             self.serve_cfg.max_wave_requests,
             self.serve_cfg.max_active_requests,
             metrics=self.metrics,
+            sched_core=self._sched_core,
             # Prefix coalescing (serve/sched/coalesce.py): keyed by the
             # TOKENIZED prefix, so string-distinct prefixes that tokenize
             # identically still share one prefill.
@@ -557,11 +590,16 @@ class ServeEngine:
                         # fresh replacement.
                         wd.arm(token=self._source)
                     self._sweep()
-                except (ShardLoadError, SourceClosed, OSError) as e:
+                except (
+                    ShardLoadError, SourceClosed, OSError, SpillCorruptError,
+                ) as e:
                     # Degrade, don't die: an exhausted shard load, a
-                    # watchdog-aborted stall, or a transient I/O error that
-                    # escaped the retry layer fails ONLY the in-flight
-                    # waves; queued and future requests keep being served.
+                    # watchdog-aborted stall, a transient I/O error that
+                    # escaped the retry layer, or a pooled KV page whose
+                    # corruption survived every re-read (the pool already
+                    # dropped it, so the retry re-prefills) fails ONLY the
+                    # in-flight waves; queued and future requests keep
+                    # being served.
                     self._recover(e)
                     continue
                 finally:
@@ -587,6 +625,9 @@ class ServeEngine:
             waves=len(self.batcher.waves),
             wave_ids=[w.wave_id for w in self.batcher.waves],
         )
+        for w in self.batcher.waves:
+            if w.state is not None:
+                self._release_pool_handles(w.state)
         self.batcher.fail_all_active(error)
         self.queue.close(drain=False)  # cancels queued; futures resolve
         self._release_weights()
@@ -611,6 +652,7 @@ class ServeEngine:
         for w in self.batcher.waves:
             if w.state is not None:
                 w.state.kv_store.clear()
+                self._release_pool_handles(w.state)
         err = WaveAborted(
             f"in-flight wave aborted by a recoverable engine fault "
             f"({type(root).__name__}: {root}); the engine recovered and "
@@ -749,10 +791,12 @@ class ServeEngine:
         )
 
     def _prefix_kv_bytes(self, prefix_tokens: int) -> int:
-        """Estimated prefix-KV bytes ONE prefill materializes for a
+        """ANALYTIC prefix-KV bytes one prefill materializes for a
         ``prefix_tokens``-long prefix: K + V per layer per kv-head at the
-        compute dtype — the per-request savings a coalesced entry's
-        shared prefill banks (the ``prefill_kv_bytes_saved`` counter)."""
+        compute dtype. Pool-OFF fallback only — with the paged pool on,
+        ``prefill_kv_bytes_saved`` reads the allocator's actual page
+        bookkeeping (``KVPagePool.entry_bytes``, via ``_note_coalesced``)
+        so the counter cannot drift from what the pool really shares."""
         mc = self.model_cfg
         itemsize = np.dtype(self.dtype).itemsize
         return int(
@@ -762,6 +806,32 @@ class ServeEngine:
             * (mc.head_dim + mc.v_dim)
             * itemsize
         )
+
+    def _note_coalesced(self, wave, entry, tp, handle) -> None:
+        """Bank one coalesced entry's savings from the ALLOCATOR's page
+        bookkeeping (entry_bytes sums the entry's actual pages) rather
+        than the analytic estimate — called at seal time for freshly
+        prefilled entries (pages exist only then) and at admission for
+        reuse-path entries (their pages already exist)."""
+        saved = (len(entry.requests) - 1) * self._kv_pool.entry_bytes(handle)
+        self._sched.note_coalesced(len(entry.requests), saved)
+        obs_trace.instant(
+            "prefix_coalesce", cat="sched",
+            wave_id=wave.wave_id,
+            requests=len(entry.requests),
+            request_ids=[r.request_id for r in entry.requests],
+            prefix_tokens=tp.prefix_len,
+            kv_bytes_saved=saved,
+        )
+
+    def _release_pool_handles(self, st) -> None:
+        """Drop a wave's block-table leases (retire, preempt, abort,
+        fatal). Idempotent; pages persist for future same-prefix reuse —
+        only the refcounts pinning them drop."""
+        if self._kv_pool is None:
+            return
+        for h in st.pool_handles.values():
+            self._kv_pool.release(h)
 
     def _tokenize_entry(self, entry):
         """One (prefix, merged-suffixes) prompt per wave entry; a
@@ -843,6 +913,11 @@ class ServeEngine:
             live.append(r)
         if st is not None:
             st.kv_store.clear()
+            # Release the block-table leases; the PAGES persist, so on
+            # re-admission the resumed entries acquire the same sealed
+            # prefix and restore their block tables with zero prefix
+            # prefill recompute instead of re-running the prefill.
+            self._release_pool_handles(st)
         self.batcher.waves.remove(wave)
         self._sched.note_preempted(len(live))
         obs_trace.instant(
@@ -865,6 +940,7 @@ class ServeEngine:
         # Speculative waves only where there is decode to amortize: a
         # wave whose whole budget is the prefill pick never drafts.
         spec_wave = self._spec_k > 0 and wave.max_steps > 1
+        pool_handles: dict[int, Any] = {}
         try:
             toks = [self._tokenize_entry(e) for e in entries]
             # A speculative pass's fixed-width K+1 window can overshoot
@@ -874,7 +950,11 @@ class ServeEngine:
                 extra_len=max(wave.max_steps - 1, 0)
                 + (self._spec_k if spec_wave else 0),
             )
-            if self._sched is not None:
+            if self._sched is not None and self._kv_pool is None:
+                # Pool off: bank the ANALYTIC estimate at admission. With
+                # the pool on, savings come from the allocator's actual
+                # page bookkeeping instead (_note_coalesced) — at seal
+                # time for fresh prefills, below for reuse-path entries.
                 for e, tp in zip(entries, toks):
                     if len(e.requests) > 1:
                         saved = (len(e.requests) - 1) * self._prefix_kv_bytes(
@@ -908,14 +988,41 @@ class ServeEngine:
                 for b, idxs in enumerate(blocks)
                 for row, i in enumerate(idxs)
             }
+            # Paged prefix-KV pool: lease each entry's block table (trie
+            # path, refcounted until retire/preempt/abort). A block whose
+            # EVERY row leases a sealed same-prefix entry skips its
+            # prefix prefill entirely — _prefill_shard assembles the
+            # pages and runs only the suffix stream, so the recurring
+            # prefix prefills once per PROCESS, not once per wave.
+            reuse_blocks: set[int] = set()
+            if self._kv_pool is not None:
+                for i, tp in enumerate(toks):
+                    ids = tuple(
+                        int(t) for t in tp.prefix_ids[: tp.prefix_len]
+                    )
+                    pool_handles[i] = self._kv_pool.acquire(
+                        ids, int(tp.prefix_len), int(tp.prefix_ids.shape[0])
+                    )
+                for b, idxs in enumerate(blocks):
+                    if idxs and all(pool_handles[i].reusable for i in idxs):
+                        reuse_blocks.add(b)
+                for i, (e, tp) in enumerate(zip(entries, toks)):
+                    if loc[i][0] in reuse_blocks:
+                        self.metrics.count(
+                            "prefix_reuse_tokens", int(tp.prefix_len)
+                        )
+                        if self._sched is not None and len(e.requests) > 1:
+                            self._note_coalesced(wave, e, tp, pool_handles[i])
+                    else:
+                        self.metrics.count(
+                            "prefix_prefill_tokens", int(tp.prefix_len)
+                        )
             # Generated-KV slots: plain decode fills one slot per sweep; a
             # speculative pass writes K+1 slots at per-suffix offsets
             # capped at max_steps-1, so the last write touches slot
             # max_steps-1+K (the offline gen_slots arithmetic).
-            slots = (
-                wave.max_steps + self._spec_k
-                if spec_wave
-                else max(1, wave.max_steps - 1)
+            slots = self._sched_core.gen_slots(
+                wave.max_steps, self._spec_k, spec_wave
             )
             # Same KV placement rule as the offline path: KV follows the
             # weights onto the chip when they are resident and the wave's
@@ -923,12 +1030,9 @@ class ServeEngine:
             # per shard per decode step. The fit check is per WAVE; with
             # several concurrent waves the 80% headroom in kv_fits_on_chip
             # absorbs the others (waves are bounded by max_active_requests).
-            kv_on_device = self.cfg.storage_location == "tpu" or (
-                self._resident
-                and kv_fits_on_chip(
-                    self.model_cfg, self.cfg.dtype, toks, blocks, slots,
-                    device=self.device,
-                )
+            kv_on_device = self._sched_core.kv_on_device(
+                self.model_cfg, self.cfg.dtype, toks, blocks, slots,
+                self._resident, device=self.device,
             )
             wave.state = _WaveState(
                 toks=toks,
@@ -939,6 +1043,8 @@ class ServeEngine:
                 tok_hist={b: [] for b in range(len(blocks))},
                 loc=loc,
                 slots=slots,
+                pool_handles=pool_handles,
+                reuse_blocks=reuse_blocks,
             )
             return True
         except (
@@ -962,6 +1068,9 @@ class ServeEngine:
             # bad request — it escapes to _run's fatal path so the root
             # cause surfaces instead of masquerading as a per-wave
             # rejection forever.
+            if self._kv_pool is not None:
+                for h in pool_handles.values():
+                    self._kv_pool.release(h)
             for r in wave.requests:
                 if not r.status.terminal and r.fail(e, RequestStatus.FAILED):
                     self.metrics.count("failed")
@@ -1045,6 +1154,13 @@ class ServeEngine:
         act_dev = self._act_dev()
         for b in range(len(st.blocks)):
             prefix_ids, suffix_ids, prefix_len, suffix_eos = st.meta[b]
+            # Pool-reuse block: every row leases a SEALED same-prefix pool
+            # entry — the prefix stream never runs. The suffix stream
+            # depends on the prefix only through its post-RoPE (k, v)
+            # (llama.prefix_suffix_layer), so feeding the assembled pages
+            # to the suffix-only scan is bit-identical, at zero prefix
+            # prefill recompute.
+            reuse = b in st.reuse_blocks
             total_len = longrope_total_len(
                 self.model_cfg, prefix_len, suffix_eos
             )
@@ -1055,15 +1171,59 @@ class ServeEngine:
             di = 0
             for kind, params in segments:
                 if kind == "embed":
-                    ph, sh = _embed_block(
-                        self.model_cfg, self.dtype, params,
-                        prefix_ids, suffix_ids,
-                    )
+                    if reuse:
+                        # Suffix embeddings only; the prefix hidden stream
+                        # stays dead (None rides the ("h", b) handoff as
+                        # an empty pytree leaf).
+                        ph, sh = None, llama.embed(
+                            params, suffix_ids, self.dtype, self.model_cfg
+                        )
+                    else:
+                        ph, sh = _embed_block(
+                            self.model_cfg, self.dtype, params,
+                            prefix_ids, suffix_ids,
+                        )
                 elif kind == "decoders":
-                    ph, sh, kv = _prefill_decoders(
-                        self.model_cfg, self._use_pallas, None, params,
-                        ph, sh, prefix_len, total_len,
-                    )
+                    if reuse:
+                        rows_k, rows_v = [], []
+                        for i in st.blocks[b]:
+                            k_np, v_np = self._kv_pool.assemble(
+                                st.pool_handles[i], (shard_pos, di)
+                            )
+                            rows_k.append(k_np)
+                            rows_v.append(v_np)
+                        kp = jax.device_put(
+                            np.stack(rows_k, axis=1), act_dev
+                        )
+                        vp = jax.device_put(
+                            np.stack(rows_v, axis=1), act_dev
+                        )
+                        sh, kv_s = _suffix_prefill_decoders(
+                            self.model_cfg, self._use_pallas, None, params,
+                            {"kp": kp, "vp": vp}, sh, prefix_len, total_len,
+                        )
+                        kv = {
+                            "kp": kp, "vp": vp,
+                            "ks": kv_s["ks"], "vs": kv_s["vs"],
+                        }
+                        ph = None
+                    else:
+                        ph, sh, kv = _prefill_decoders(
+                            self.model_cfg, self._use_pallas, None, params,
+                            ph, sh, prefix_len, total_len,
+                        )
+                        if self._kv_pool is not None and st.pool_handles:
+                            # Bank this segment's prefix KV into the pool
+                            # (per-row pages; chunks another prefix
+                            # already contributed dedup in place).
+                            k_np, v_np = jax.device_get(
+                                (kv["kp"], kv["vp"])
+                            )
+                            for row, i in enumerate(st.blocks[b]):
+                                self._kv_pool.contribute(
+                                    st.pool_handles[i], (shard_pos, di),
+                                    k_np[:, row], v_np[:, row],
+                                )
                     kv = extend_gen_kv(
                         kv, st.slots, self.dtype, device=act_dev
                     )
@@ -1278,6 +1438,22 @@ class ServeEngine:
             wave.steps += 1
             if prefilled:
                 self.metrics.count("prefills")
+                st0 = wave.state
+                if self._kv_pool is not None and st0 is not None:
+                    # The wave's prefill just completed: seal each freshly
+                    # prefilled entry (every decoder segment contributed),
+                    # making it reusable by later same-prefix waves, and
+                    # bank coalesced entries' savings from the pool's
+                    # actual page bookkeeping.
+                    for i, handle in st0.pool_handles.items():
+                        if st0.loc[i][0] in st0.reuse_blocks:
+                            continue
+                        self._kv_pool.seal(handle)
+                        e = wave.entries[i]
+                        if self._sched is not None and len(e.requests) > 1:
+                            self._note_coalesced(
+                                wave, e, st0.toks[i], handle
+                            )
                 if self._spec_k > 0 and wave.max_steps > 1:
                     # Arm the verify passes off the prefill's picks; the
                     # next sweep for this wave is a draft+verify pass.
@@ -1332,6 +1508,7 @@ class ServeEngine:
         for w in self.batcher.retire_done():
             if w.state is not None:
                 w.state.kv_store.clear()
+                self._release_pool_handles(w.state)
 
     def _resolve(self, wave: Wave, r: Request) -> None:
         st: _WaveState = wave.state

@@ -409,6 +409,13 @@ class ServingMetrics:
         "completed",
         "failed",
         "prefills",
+        # Prefix-prefill token accounting (runtime/kvpool.py reuse):
+        # prefix_prefill_tokens = prefix tokens actually prefilled;
+        # prefix_reuse_tokens = prefix tokens served from pooled pages
+        # with ZERO prefill recompute (the kv_prefix_reuse_frac bench
+        # metric is reuse / (reuse + prefill)).
+        "prefix_prefill_tokens",
+        "prefix_reuse_tokens",
         "sweeps",
         "tokens_emitted",
         "engine_recoveries",

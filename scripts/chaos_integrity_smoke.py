@@ -792,6 +792,104 @@ def main() -> int:
         f"incident_chaos_ok bundles={len(bundles)} "
         f"dead_replica={sorted(dead)} redispatches={len(redispatched)}"
     )
+
+    # 9) Paged prefix-KV pool (runtime/kvpool.py, docs/kvpool.md): two
+    # sequential same-prefix waves with a brownout in between. Wave 1
+    # prefills and contributes its pages; a hard host-pressure event
+    # walks the ladder through its kv_evict lever (the pool's pages
+    # spill to checksummed disk) and the ladder reverses; wave 2 must
+    # then REUSE the prefix — assembling the spilled pages back through
+    # the verified read path under seeded corrupt_activation — with
+    # token-identical output and one endpoint scrape carrying nonzero
+    # fls_kvpool_prefix_reuse_hits.
+    from flexible_llm_sharding_tpu.runtime import kvpool
+    pressure.reset_process_pressure()
+    hostcache.reset_process_cache()
+    kvpool.reset_process_pools()
+    engine = ServeEngine(
+        _cfg(
+            model_dir,
+            disk_folder=os.path.join(tmp, "kvpool_spills"),
+            faults=FaultConfig(
+                enabled=True, seed=SEED, error_rate=0.3,
+                sites=("corrupt_activation",), max_faults=4,
+            ),
+            pressure=PressureConfig(
+                enabled=True, poll_s=0.05, host_min_gb=0.0,
+                disk_min_gb=0.0, hbm_headroom_frac=0.0,
+                shed_retry_after_s=0.05, step_down_polls=2,
+            ),
+        ),
+        ServeConfig(
+            max_wave_requests=1, max_active_requests=1,
+            default_max_new_tokens=1, metrics_port=0,
+        ),
+        tokenizer=FakeTokenizer(),
+    )
+    ctrl = pressure.process_controller()
+    try:
+        res1 = engine.submit(*PROMPTS[0]).future.result(timeout=600)
+        # Hard pressure event: the ladder engages every lever up to shed
+        # (kv_evict included — wave 1's pages spill), then reverses.
+        pressure.note_event("host_oom")
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline and ctrl.level == 0:
+            time.sleep(0.02)
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline and ctrl.level > 0:
+            time.sleep(0.02)
+        if ctrl.level != 0:
+            print(
+                f"FAIL: kvpool brownout never reversed (level {ctrl.level})",
+                file=sys.stderr,
+            )
+            return 1
+        pool_mid = kvpool.process_stats()
+        if pool_mid["pages_evicted"] < 1:
+            print(
+                f"FAIL: kv_evict lever spilled no pages: {pool_mid}",
+                file=sys.stderr,
+            )
+            return 1
+        # Wave 2, same prefix: assembles the spilled pages under seeded
+        # corrupt_activation — the sidecar catches flips, re-reads heal.
+        res2 = engine.submit(*PROMPTS[0]).future.result(timeout=600)
+        for res in (res1, res2):
+            if not (res.scores.argmax(-1) == clean[0].argmax(-1)).all():
+                print(
+                    "FAIL: kvpool serve output diverged under "
+                    "corrupt_activation + pressure",
+                    file=sys.stderr,
+                )
+                return 1
+        port = engine.metrics_server.port
+        exposition = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=30
+        ).read().decode()
+    finally:
+        engine.shutdown(drain=True)
+    if engine.error is not None:
+        print(f"FAIL: kvpool engine error {engine.error!r}", file=sys.stderr)
+        return 1
+    m = re.search(r"^fls_kvpool_prefix_reuse_hits (\d+)", exposition, re.M)
+    if not m or int(m.group(1)) < 1:
+        print(
+            "FAIL: exposition reports no nonzero "
+            "fls_kvpool_prefix_reuse_hits",
+            file=sys.stderr,
+        )
+        return 1
+    pool_stats = kvpool.process_stats()
+    print(json.dumps({"event": "kvpool_stats", **pool_stats}))
+    print(
+        f"kvpool_chaos_ok reuse_hits={m.group(1)} "
+        f"pages_evicted={pool_stats['pages_evicted']} "
+        f"pages_healed={pool_stats['pages_healed']} "
+        f"kv_evictions={ctrl.stats().get('kv_evictions', 0)}"
+    )
+    pressure.reset_process_pressure()
+    hostcache.reset_process_cache()
+    kvpool.reset_process_pools()
     return 0
 
 

@@ -100,6 +100,14 @@ FLOOR_RULES = {
     #   which no runner noise can fake — so this one gates hard, the
     #   pinned_fraction precedent.
     "spec_serve_tokens_per_sweep": 0.95,
+    # Paged prefix-KV pool (ISSUE 16): fraction of total prefix prefill
+    # work the second same-prefix wave serves from pooled pages, read
+    # from the engine's own token counters — structural and timing-free
+    # (two same-prefix waves put the healthy value at exactly 0.5; the
+    # phase asserts pool-on/pool-off token-identity BEFORE recording).
+    # The pool disengaging collapses it to 0.0, which no runner noise
+    # can fake — so this gates hard, the pinned_fraction precedent.
+    "kv_prefix_reuse_frac": 0.95,
 }
 
 # Ratios whose loss-of-mechanism signature is "collapses to parity": the
@@ -167,6 +175,7 @@ def measure() -> dict:
         BenchTokenizer,
         bench_host_cache,
         bench_host_stream,
+        bench_kv_reuse,
         bench_mixedprec,
         bench_recorder_overhead,
         bench_reference_schedule,
@@ -221,6 +230,9 @@ def measure() -> dict:
     # the TPU capture runs (bench.py defaults).
     bench_spec(fw(None), tok, result, budget, n_tok=4, k=4)
     bench_spec_serve(fw(None), tok, result, budget)
+    # Paged prefix-KV pool (ISSUE 16): small token budget — the gate
+    # needs cross-wave reuse witnessed, not a throughput measurement.
+    bench_kv_reuse(fw(None), tok, result, budget, n_tok=4)
     result["gate_wall_s"] = round(time.perf_counter() - t0, 1)
     return result
 

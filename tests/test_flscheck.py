@@ -1175,6 +1175,7 @@ class _StubInitEngine:
         self.batcher = types.SimpleNamespace(waves=[])
         self._sched = None  # scheduler off: the FIFO/parity path
         self._spec_k = 0  # speculation off: the plain decode path
+        self._kv_pool = None  # pool off: the analytic-accounting path
 
     def tokenizer(self, prefix, suffixes):
         raise self._exc

@@ -242,33 +242,36 @@ def test_ladder_walks_up_engages_in_order_and_reverses(model_dir):
     assert cache.budget_bytes < before  # cache shrunk
     assert not q.shedding
     ctrl.on_sample(_pressured())
-    assert ctrl.level == 2  # pin evict (no tier live: position still taken)
+    assert ctrl.level == 2  # kv evict (no pool live: position still taken)
     assert not q.shedding
     ctrl.on_sample(_pressured())
-    assert ctrl.level == 3 and q.shedding
+    assert ctrl.level == 3  # pin evict (no tier live: position still taken)
+    assert not q.shedding
+    ctrl.on_sample(_pressured())
+    assert ctrl.level == 4 and q.shedding
     assert q.retry_after == ctrl.pcfg.shed_retry_after_s
     ctrl.on_sample(_pressured())
-    assert ctrl.level == 4 and fleet.drained == 1
+    assert ctrl.level == 5 and fleet.drained == 1
     # Holding at max: further pressure doesn't overflow the ladder.
     ctrl.on_sample(_pressured())
-    assert ctrl.level == 4
+    assert ctrl.level == 5
 
     # Reversal: step_down_polls clean polls per level, reverse order.
     clean = PressureSnapshot()
     for _ in range(ctrl.pcfg.step_down_polls):
         ctrl.on_sample(clean)
-    assert ctrl.level == 3 and fleet.restored == 1
-    assert q.shedding  # shed still engaged at level 3
+    assert ctrl.level == 4 and fleet.restored == 1
+    assert q.shedding  # shed still engaged at level 4
     for _ in range(ctrl.pcfg.step_down_polls):
         ctrl.on_sample(clean)
-    assert ctrl.level == 2 and not q.shedding
-    for _ in range(2 * ctrl.pcfg.step_down_polls):
+    assert ctrl.level == 3 and not q.shedding
+    for _ in range(3 * ctrl.pcfg.step_down_polls):
         ctrl.on_sample(clean)
     assert ctrl.level == 0
     assert cache.budget_bytes == before  # budget restored
     assert hostcache.pressure_cap() is None
     stats = ctrl.stats()
-    assert stats["steps_up"] == 4 and stats["steps_down"] == 4
+    assert stats["steps_up"] == 5 and stats["steps_down"] == 5
     assert stats["cache_shrinks"] == 1
 
 
@@ -283,7 +286,7 @@ def test_hard_event_jumps_straight_to_shed_level(model_dir):
     assert q.shedding
     assert ctrl.stats()["host_oom_events"] == 1
     # The jump engaged the skipped levels too (counted as steps).
-    assert ctrl.stats()["steps_up"] == 3
+    assert ctrl.stats()["steps_up"] == 4
 
 
 def test_queue_attached_mid_brownout_sheds_immediately(model_dir):
@@ -679,8 +682,8 @@ def test_fleet_pressure_drain_and_restore(model_dir):
         cfg = _fw(model_dir, pressure=_pcfg(step_down_polls=1))
         ctrl = BrownoutController(cfg)
         ctrl.attach_fleet(fleet)
-        # Walk to the drain level (4 pressured polls).
-        for _ in range(4):
+        # Walk to the drain level (5 pressured polls).
+        for _ in range(5):
             ctrl.on_sample(_pressured())
         deadline = time.monotonic() + 60
         while time.monotonic() < deadline and len(fleet.replicas) > 1:
@@ -688,7 +691,7 @@ def test_fleet_pressure_drain_and_restore(model_dir):
         assert len(fleet.replicas) == 1
         assert ctrl.stats()["replica_drains"] == 2
         # Clean polls all the way down: population restored.
-        for _ in range(4):
+        for _ in range(5):
             ctrl.on_sample(PressureSnapshot())
         deadline = time.monotonic() + 60
         while time.monotonic() < deadline and len(fleet.replicas) < 3:
