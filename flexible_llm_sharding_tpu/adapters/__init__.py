@@ -1,0 +1,47 @@
+"""Multi-tenant LoRA adapter serving: thousands of fine-tuned variants
+over ONE base-model sweep.
+
+The architecture's defining property is that every sweep streams the
+whole base model through the chip over the ~0.1 GB/s host->HBM link
+(PAPER.md §0) — which makes it uniquely shaped for multi-model serving:
+stream the shared base ONCE per sweep and apply tiny per-tenant low-rank
+deltas at near-zero extra link cost. Requests carry an ``adapter_id``;
+the wave groups rows by adapter and the decoder scans apply
+``h += (h @ A_g) @ B_g * scale_g`` at each layer entry (adapters/apply.py),
+so N tenants' models decode in one sweep with one base stream.
+
+- ``registry.py`` — named adapters on disk: per-layer safetensors delta
+  dirs with an ``adapter_plan.json`` (the PR 14 plan shape) and an
+  integrity manifest, plus the HF PEFT converter behind the
+  ``prepare-adapter`` CLI subcommand.
+- ``apply.py`` — the grouped/gather-per-row delta math and the host-side
+  wave grouping + factor stacking helpers.
+- ``loader.py`` — per-tenant hot-load/evict under its own byte budget: a
+  host-resident, stat-guarded LRU mirroring ``runtime/hostcache.py``,
+  with checksummed reads (transient corruption heals via re-read;
+  persistent corruption raises the typed, non-retried
+  ``AdapterCorruptError``) and a reversible ``adapter_evict`` lever on
+  the pressure ladder.
+
+See docs/adapters.md.
+"""
+
+from flexible_llm_sharding_tpu.adapters.registry import (
+    ADAPTER_PLAN_NAME,
+    AdapterCorruptError,
+    AdapterNotFound,
+    AdapterPlan,
+    AdapterRegistry,
+    convert_peft_checkpoint,
+    save_adapter,
+)
+
+__all__ = [
+    "ADAPTER_PLAN_NAME",
+    "AdapterCorruptError",
+    "AdapterNotFound",
+    "AdapterPlan",
+    "AdapterRegistry",
+    "convert_peft_checkpoint",
+    "save_adapter",
+]

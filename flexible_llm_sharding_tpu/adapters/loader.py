@@ -1,0 +1,475 @@
+"""Per-tenant adapter hot-load/evict: a byte-budgeted host LRU.
+
+Mirrors ``runtime/hostcache.py`` — the same safety model, applied to
+LoRA delta factors instead of base shards:
+
+- Entries are inserted only AFTER every delta file's checksum verified
+  against the adapter dir's integrity manifest; a cached adapter is a
+  *verified-clean* adapter by construction.
+- Every entry records its backing files' ``(mtime_ns, size)`` at load
+  time (captured BEFORE the read — ``hostcache.stat_guard``) and
+  re-stats on hit: any drift drops the entry and forces a fresh
+  verified read, so a re-prepared or repaired adapter dir is picked up
+  without a restart.
+- Reads are chaos sites: the engine's ``FaultInjector`` fires
+  ``corrupt_shard`` on each delta-file read. Transient corruption heals
+  via re-read (counted as ``reread_heals``); corruption that survives
+  every re-read raises the typed, NON-retried
+  :class:`~flexible_llm_sharding_tpu.adapters.registry.AdapterCorruptError`
+  — the store drops the adapter (``corrupt_evictions``) and only that
+  tenant's requests fail, base traffic unaffected.
+
+Budgeting: ``AdapterConfig.max_gb`` — explicit GB, 0 to disable, or
+None (auto) for a small fraction of available host RAM. Auto stays ON
+under fault injection (chaos-exempt like the KV pool: the chaos smoke
+serves adapters *under* faults). The brownout ladder's reversible
+``adapter_evict`` lever (runtime/pressure.py) shrinks the live budget
+via :func:`apply_pressure_cap` and restores it on release, with the
+same intended-budget latch as the host cache.
+
+Exported as the ``fls_adapter_*`` metric family (obs registry source
+``"adapter"``): loads / hits / evictions / applied_rows / delta_bytes /
+reread_heals / corrupt_evictions.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from collections import OrderedDict
+from typing import Any
+
+from flexible_llm_sharding_tpu.adapters.registry import (
+    ADAPTER_PLAN_NAME,
+    AdapterCorruptError,
+    AdapterRegistry,
+)
+from flexible_llm_sharding_tpu.faults.inject import InjectedFault
+from flexible_llm_sharding_tpu.integrity import manifest as integrity_manifest
+from flexible_llm_sharding_tpu.obs import trace as obs_trace
+from flexible_llm_sharding_tpu.obs.registry import REGISTRY as _OBS_REGISTRY
+from flexible_llm_sharding_tpu.runtime.hostcache import (
+    available_host_bytes,
+    stat_guard,
+)
+from flexible_llm_sharding_tpu.utils.checkpoint import st_load_file
+
+# Auto budget: a small slice of MemAvailable — deltas are tiny next to
+# the base model, so even thousands of adapters fit a sliver of RAM.
+ADAPTER_AUTO_FRACTION = 0.05
+# Unknown free RAM (non-Linux) must not disable adapter serving the way
+# the shard cache's auto-off does — a dir full of adapters with no
+# budget would fail every tenant. Fall back to a fixed 1 GB.
+_AUTO_FALLBACK_BYTES = 1 << 30
+
+# Per-layer read attempts before corruption counts as persistent. Two
+# mismatching re-reads is the executor's on-disk-corruption bar.
+_READ_ATTEMPTS = 3
+
+
+class AdapterStore:
+    """Byte-budgeted, thread-safe LRU of verified adapter factor trees.
+
+    Values are ``(plan, factors)`` with ``factors`` mapping decoder
+    layer names to ``{"lora_A": [D, r], "lora_B": [r, D]}`` float32
+    numpy arrays; callers must treat them as IMMUTABLE (shared across
+    waves). ``get`` stat-revalidates on hit, loads + verifies on miss.
+    """
+
+    def __init__(self, root: str, budget_bytes: int, injector=None):
+        if budget_bytes <= 0:
+            raise ValueError("budget_bytes must be > 0 (use None store to disable)")
+        self.registry = AdapterRegistry(root)
+        self._lock = threading.RLock()
+        self.budget_bytes = int(budget_bytes)
+        self.injector = injector
+        # name -> ((plan, factors), nbytes, ((path, (mtime_ns, size)), ...))
+        self._entries: "OrderedDict[str, tuple[Any, int, tuple]]" = OrderedDict()  # guarded by: _lock
+        self._by_path: dict[str, set] = {}  # guarded by: _lock
+        self.bytes = 0  # guarded by: _lock
+        self.hits = 0
+        self.misses = 0
+        self.loads = 0
+        self.evictions = 0
+        self.invalidations = 0
+        self.reread_heals = 0
+        self.corrupt_evictions = 0
+        self.applied_rows = 0
+        self.delta_bytes = 0
+
+    # -- core API ----------------------------------------------------------
+
+    def get(self, name: str):
+        """``(plan, factors)`` for adapter ``name`` — from the LRU when
+        current, else loaded + checksum-verified from disk (and cached
+        when it fits the budget). Raises ``AdapterNotFound`` for an
+        unknown name and ``AdapterCorruptError`` for artifacts whose
+        corruption survives every re-read (typed, non-retried)."""
+        with self._lock:
+            entry = self._entries.get(name)
+            if entry is None:
+                self.misses += 1
+        if entry is not None:
+            value, nbytes, guard = entry
+            # Stat OUTSIDE the lock (the hostcache rule: a wedged
+            # filesystem must not stall every wave in the process).
+            stale = any(
+                integrity_manifest._file_key(path) != stat
+                for path, stat in guard
+            )
+            with self._lock:
+                cur = self._entries.get(name)
+                if cur is not entry:
+                    self.misses += 1
+                elif stale:
+                    self._drop(name)
+                    self.invalidations += 1
+                    self.misses += 1
+                else:
+                    self._entries.move_to_end(name)
+                    self.hits += 1
+                    obs_trace.instant(
+                        "adapter_hit", cat="adapter", adapter=name, bytes=nbytes
+                    )
+                    return value
+        value, nbytes, guard = self._load(name)
+        with self._lock:
+            self.loads += 1
+            if nbytes <= self.budget_bytes:
+                if name in self._entries:
+                    self._drop(name)
+                while (
+                    self.bytes + nbytes > self.budget_bytes and self._entries
+                ):
+                    self._drop(next(iter(self._entries)))
+                    self.evictions += 1
+                self._entries[name] = (value, int(nbytes), guard)
+                self.bytes += int(nbytes)
+                for p, _ in guard:
+                    self._by_path.setdefault(p, set()).add(name)
+        obs_trace.instant(
+            "adapter_load", cat="adapter", adapter=name, bytes=nbytes
+        )
+        return value
+
+    def _load(self, name: str):
+        """One verified read of adapter ``name``: plan + every delta
+        file, checksummed against the dir's manifest with re-read heal
+        (``_READ_ATTEMPTS`` per file). Persistent corruption evicts any
+        cached copy and raises the typed ``AdapterCorruptError``."""
+        adir = self.registry.path(name)  # AdapterNotFound on miss
+        try:
+            plan = self.registry.plan(name)  # AdapterCorruptError on rot
+            manifest = integrity_manifest.load_manifest(adir)
+        except ValueError as e:
+            raise self._poison(name, AdapterCorruptError(str(e))) from e
+        paths = [os.path.join(adir, ADAPTER_PLAN_NAME)]
+        paths += [os.path.join(adir, plan.layer_file(ln)) for ln, _ in plan.layers]
+        guard = stat_guard(paths)
+        factors: dict[str, dict] = {}
+        nbytes = 0
+        healed = 0
+        for lname, rank in plan.layers:
+            path = os.path.join(adir, plan.layer_file(lname))
+            flat, healed_here = self._read_verified(
+                name, lname, path, manifest
+            )
+            healed += healed_here
+            a = flat.get("lora_A")
+            b = flat.get("lora_B")
+            if (
+                a is None
+                or b is None
+                or a.shape != (plan.hidden_size, rank)
+                or b.shape != (rank, plan.hidden_size)
+            ):
+                raise self._poison(
+                    name,
+                    AdapterCorruptError(
+                        f"{path}: delta shapes "
+                        f"{ {k: tuple(v.shape) for k, v in flat.items()} } "
+                        f"disagree with the plan ([{plan.hidden_size}, "
+                        f"{rank}] / [{rank}, {plan.hidden_size}]) — "
+                        "re-run prepare-adapter"
+                    ),
+                )
+            factors[lname] = {"lora_A": a, "lora_B": b}
+            nbytes += int(a.nbytes) + int(b.nbytes)
+        if healed:
+            with self._lock:
+                self.reread_heals += healed
+            obs_trace.instant(
+                "adapter_reread_heal", cat="adapter", adapter=name, n=healed
+            )
+        return (plan, factors), nbytes, guard or ()
+
+    def _read_verified(self, name: str, lname: str, path: str, manifest):
+        """One delta file, re-read until its checksum verifies or the
+        attempt budget is spent. Returns ``(flat, heals)``."""
+        mismatches = 0
+        for _ in range(_READ_ATTEMPTS):
+            try:
+                flat = st_load_file(path)
+            except FileNotFoundError:
+                raise self._poison(
+                    name,
+                    AdapterCorruptError(
+                        f"{path}: plan lists layer {lname!r} but the delta "
+                        "file is missing — audit with verify --adapter_dir"
+                    ),
+                ) from None
+            if self.injector is not None:
+                try:
+                    flat = self.injector.corrupt_flat(
+                        "corrupt_shard", flat, detail=f"adapter:{name}/{lname}"
+                    )
+                except InjectedFault:
+                    mismatches += 1
+                    continue
+            if manifest is not None:
+                try:
+                    integrity_manifest.verify_flat(
+                        lname, flat, manifest, path=path
+                    )
+                except integrity_manifest.ChecksumMismatch:
+                    mismatches += 1
+                    continue
+            return flat, mismatches
+        raise self._poison(
+            name,
+            AdapterCorruptError(
+                f"{path}: checksum mismatch survived {_READ_ATTEMPTS} "
+                "re-reads — on-disk corruption; adapter evicted (audit "
+                "with verify --adapter_dir, then re-prepare the adapter)"
+            ),
+        )
+
+    def _poison(self, name: str, err: AdapterCorruptError):
+        """Persistent corruption: drop any cached copy, count the
+        eviction, emit the trail, and hand back the typed error for the
+        caller to raise — only this tenant's requests fail."""
+        with self._lock:
+            if name in self._entries:
+                self._drop(name)
+                self.evictions += 1
+            self.corrupt_evictions += 1
+        obs_trace.instant(
+            "adapter_corrupt_evict", cat="adapter", adapter=name
+        )
+        return err
+
+    def _drop(self, name: str) -> None:
+        # flscheck: holds=_lock: internal helper — every caller already owns the lock
+        _value, nbytes, guard = self._entries.pop(name)
+        self.bytes -= nbytes
+        for p, _ in guard:
+            keys = self._by_path.get(p)
+            if keys is not None:
+                keys.discard(name)
+                if not keys:
+                    del self._by_path[p]
+
+    # -- invalidation / budget --------------------------------------------
+
+    def invalidate_path(self, path: str) -> int:
+        """Drop every cached adapter built from ``path``."""
+        with self._lock:
+            names = list(self._by_path.get(path, ()))
+            for n in names:
+                self._drop(n)
+            if names:
+                self.invalidations += len(names)
+            return len(names)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self._by_path.clear()
+            self.bytes = 0
+
+    def set_budget(self, budget_bytes: int) -> None:
+        """Resize the budget; a shrink evicts LRU-first while surviving
+        entries keep serving hits (capacity, never correctness). The
+        pressure ladder's ``adapter_evict`` lever."""
+        with self._lock:
+            self.budget_bytes = max(int(budget_bytes), 0)
+            while self.bytes > self.budget_bytes and self._entries:
+                self._drop(next(iter(self._entries)))
+                self.evictions += 1
+
+    # -- sweep accounting --------------------------------------------------
+
+    def note_applied(self, rows: int, nbytes: int) -> None:
+        """Per-sweep charge from the engine: how many batch rows took an
+        adapter delta and how many delta bytes crossed the link."""
+        with self._lock:
+            self.applied_rows += int(rows)
+            self.delta_bytes += int(nbytes)
+
+    # -- observability -----------------------------------------------------
+
+    def stats(self) -> dict[str, float]:
+        with self._lock:
+            total = self.hits + self.misses
+            return {
+                "loads": self.loads,
+                "hits": self.hits,
+                "misses": self.misses,
+                "evictions": self.evictions,
+                "invalidations": self.invalidations,
+                "reread_heals": self.reread_heals,
+                "corrupt_evictions": self.corrupt_evictions,
+                "applied_rows": self.applied_rows,
+                "delta_bytes": self.delta_bytes,
+                "entries": len(self._entries),
+                "bytes": self.bytes,
+                "budget_bytes": self.budget_bytes,
+                "hit_rate": round(self.hits / total, 4) if total else 0.0,
+            }
+
+
+# -- process-wide store ------------------------------------------------------
+# One store per process (the hostcache convention): the serving engine
+# rebuilds sources on recovery, fleet replicas share a host, and all of
+# them must hit the same verified entries. The same pressure-cap latch
+# machinery keeps a brownout shrink from being silently undone by the
+# next engine construction.
+
+_PROCESS_STORE: AdapterStore | None = None
+_PROCESS_ROOT: str | None = None
+_PROCESS_BUDGET_EXPLICIT = False
+_PRESSURE_CAP: int | None = None
+_PRESSURE_INTENDED: int | None = None
+_PROCESS_LOCK = threading.Lock()
+
+
+def _auto_budget_bytes() -> int:
+    avail = available_host_bytes()
+    return (
+        int(avail * ADAPTER_AUTO_FRACTION) if avail else _AUTO_FALLBACK_BYTES
+    )
+
+
+def store_for(cfg) -> AdapterStore | None:
+    """The process store for ``cfg.adapters``, or None when adapters are
+    off (no dir, or an explicit 0 budget). Budget precedence mirrors
+    ``hostcache.cache_for``: auto only grows an auto-sized store,
+    explicit wins exactly and pins, and a live pressure cap bounds every
+    resolution while tracking the intended budget for release. A
+    DIFFERENT registry root rebuilds the store (adapters from two roots
+    must never alias one namespace)."""
+    root = cfg.adapters.dir
+    if not root:
+        return None
+    budget = cfg.effective_adapter_bytes()
+    if budget <= 0:
+        return None
+    explicit = cfg.adapters.max_gb is not None
+    global _PROCESS_STORE, _PROCESS_ROOT, _PROCESS_BUDGET_EXPLICIT
+    global _PRESSURE_INTENDED
+    with _PROCESS_LOCK:
+        cap = _PRESSURE_CAP
+        if _PROCESS_STORE is not None and _PROCESS_ROOT != root:
+            _PROCESS_STORE.clear()
+            _PROCESS_STORE = None
+        if _PROCESS_STORE is None:
+            if cap is not None:
+                _PRESSURE_INTENDED = budget
+                budget = min(budget, max(cap, 1))
+            _PROCESS_STORE = AdapterStore(root, budget)
+            _PROCESS_ROOT = root
+            _PROCESS_BUDGET_EXPLICIT = explicit
+            _OBS_REGISTRY.register("adapter", _PROCESS_STORE.stats)
+        elif explicit:
+            if cap is not None:
+                _PRESSURE_INTENDED = budget
+                budget = min(budget, max(cap, 1))
+            if _PROCESS_STORE.budget_bytes != budget:
+                _PROCESS_STORE.set_budget(budget)
+            _PROCESS_BUDGET_EXPLICIT = True
+        elif not _PROCESS_BUDGET_EXPLICIT:
+            base = (
+                _PRESSURE_INTENDED
+                if cap is not None and _PRESSURE_INTENDED is not None
+                else _PROCESS_STORE.budget_bytes
+            )
+            if budget > base:
+                if cap is not None:
+                    _PRESSURE_INTENDED = budget
+                    budget = min(budget, max(cap, 1))
+                if budget > _PROCESS_STORE.budget_bytes:
+                    _PROCESS_STORE.set_budget(budget)
+        return _PROCESS_STORE
+
+
+def process_store() -> AdapterStore | None:
+    """The live process store, if any (pressure ladder / CLI stats)."""
+    with _PROCESS_LOCK:
+        return _PROCESS_STORE
+
+
+def apply_pressure_cap(shrink_frac: float) -> int | None:
+    """The ladder's ``adapter_evict`` lever: shrink the live store to
+    ``shrink_frac`` of its current budget (LRU eviction, reversible) and
+    latch the cap so later ``store_for`` resolutions cannot grow past it
+    while the brownout holds. Returns the pre-shrink budget, or None
+    when no store is live."""
+    global _PRESSURE_CAP, _PRESSURE_INTENDED
+    with _PROCESS_LOCK:
+        store = _PROCESS_STORE
+        if store is None:
+            return None
+        prev = store.budget_bytes
+        _PRESSURE_CAP = max(int(prev * shrink_frac), 1)
+        _PRESSURE_INTENDED = prev
+        cap = _PRESSURE_CAP
+    # Eviction work runs OFF the process lock (the hostcache rule).
+    store.set_budget(cap)
+    return prev
+
+
+def lift_pressure_cap(restore_bytes: int | None = None) -> None:
+    """Reverse :func:`apply_pressure_cap`: drop the latch and install
+    the INTENDED budget (normal precedence applied to every resolution
+    that landed mid-brownout); ``restore_bytes`` is only the fallback."""
+    global _PRESSURE_CAP, _PRESSURE_INTENDED
+    with _PROCESS_LOCK:
+        _PRESSURE_CAP = None
+        intended, _PRESSURE_INTENDED = _PRESSURE_INTENDED, None
+        store = _PROCESS_STORE
+    target = intended if intended is not None else restore_bytes
+    if store is not None and target and target != store.budget_bytes:
+        store.set_budget(target)
+
+
+def pressure_cap() -> int | None:
+    """The live brownout cap (tests/introspection)."""
+    with _PROCESS_LOCK:
+        return _PRESSURE_CAP
+
+
+def reset_process_store() -> None:
+    """Drop the process store (tests)."""
+    global _PROCESS_STORE, _PROCESS_ROOT, _PROCESS_BUDGET_EXPLICIT
+    global _PRESSURE_CAP, _PRESSURE_INTENDED
+    with _PROCESS_LOCK:
+        if _PROCESS_STORE is not None:
+            _PROCESS_STORE.clear()
+        _PROCESS_STORE = None
+        _PROCESS_ROOT = None
+        _PROCESS_BUDGET_EXPLICIT = False
+        _PRESSURE_CAP = None
+        _PRESSURE_INTENDED = None
+    _OBS_REGISTRY.unregister("adapter")
+
+
+__all__ = [
+    "ADAPTER_AUTO_FRACTION",
+    "AdapterStore",
+    "apply_pressure_cap",
+    "lift_pressure_cap",
+    "pressure_cap",
+    "process_store",
+    "reset_process_store",
+    "store_for",
+]

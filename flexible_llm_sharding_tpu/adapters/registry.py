@@ -1,0 +1,402 @@
+"""On-disk LoRA adapter registry: named per-layer delta dirs.
+
+Layout — one subdirectory per adapter under the registry root
+(``--adapter_dir``)::
+
+    <root>/<name>/
+        adapter_plan.json          # AdapterPlan (the PR 14 plan shape)
+        integrity.json             # integrity/manifest.py manifest
+        model.layers.0.safetensors # {"lora_A": [D, r], "lora_B": [r, D]}
+        model.layers.1.safetensors
+        ...
+
+``lora_A``/``lora_B`` are float32, laid out for the hidden-stream apply
+``h += (h @ A) @ B * scale`` at decoder-layer ENTRY — the row vector
+convention, NOT PEFT's transposed weight convention (the converter
+transposes). The plan records per-layer ranks (files may cover a subset
+of decoder layers); ``scale`` is adapter-wide ``alpha / rank``, and the
+PEFT converter folds per-module ``alpha/r`` into B then writes
+``alpha == rank`` so the stored factors apply at scale exactly 1.0.
+
+Integrity: every delta file is checksummed into the dir's manifest
+(``integrity/manifest.py``), so the ``verify`` CLI audits adapter dirs
+(integrity/verify.py:verify_adapter_dir) and the serving loader
+(adapters/loader.py) re-reads transient corruption away and types
+persistent corruption as the non-retried :class:`AdapterCorruptError`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import re
+from typing import Any, Mapping
+
+import numpy as np
+
+from flexible_llm_sharding_tpu.faults.retry import ShardLoadError
+from flexible_llm_sharding_tpu.integrity import manifest as integrity_manifest
+from flexible_llm_sharding_tpu.utils.checkpoint import (
+    LAYER_FILE_SUFFIX,
+    st_load_file,
+    st_save_file,
+)
+
+ADAPTER_PLAN_NAME = "adapter_plan.json"
+
+
+class AdapterNotFound(KeyError):
+    """No adapter of that name in the registry — a per-request input
+    error (the wave entry fails typed; the engine never retries it)."""
+
+
+class AdapterCorruptError(ShardLoadError):
+    """An adapter's on-disk artifacts are structurally wrong or their
+    corruption survived every re-read: a corrupt/missing plan, a delta
+    file whose shapes disagree with the plan, or a checksum mismatch that
+    persisted. Typed and NON-retried (the PrecisionMismatch convention):
+    retrying cannot fix bytes that are wrong on disk — the loader evicts
+    the adapter and only that tenant's requests fail, base traffic
+    unaffected. Audit with ``verify --adapter_dir``."""
+
+
+@dataclasses.dataclass(frozen=True)
+class AdapterPlan:
+    """A named adapter's layer->rank assignment plus its apply scale —
+    serialized as ``adapter_plan.json`` (the PrecisionPlan shape:
+    versioned layer map + explicit layer order, atomic write, load ->
+    None on missing / ValueError on corrupt).
+
+    ``layers`` is execution-ordered ``(decoder_layer_name, rank)`` —
+    e.g. ``("model.layers.3", 8)`` — covering exactly the layers that
+    have delta files. ``rank`` is the max per-layer rank (the padded
+    width grouped application stacks to); ``scale`` is the adapter-wide
+    ``alpha / rank`` multiplier."""
+
+    name: str
+    rank: int
+    alpha: float
+    hidden_size: int
+    layers: tuple[tuple[str, int], ...]
+    target_modules: tuple[str, ...] = ()
+
+    def __post_init__(self) -> None:
+        if self.rank < 1:
+            raise ValueError(f"AdapterPlan: rank must be >= 1, got {self.rank}")
+        if self.hidden_size < 1:
+            raise ValueError(
+                f"AdapterPlan: hidden_size must be >= 1, got {self.hidden_size}"
+            )
+        for lname, r in self.layers:
+            if not 1 <= r <= self.rank:
+                raise ValueError(
+                    f"AdapterPlan: layer {lname!r} has rank {r}; must be in "
+                    f"[1, {self.rank}] (rank is the plan-wide max)"
+                )
+
+    @property
+    def scale(self) -> float:
+        return float(self.alpha) / float(self.rank)
+
+    @property
+    def ranks(self) -> dict[str, int]:
+        return dict(self.layers)
+
+    def layer_file(self, layer_name: str) -> str:
+        return f"{layer_name}{LAYER_FILE_SUFFIX}"
+
+    def nbytes(self) -> int:
+        """Host bytes of the float32 factors the plan describes — the
+        loader's budget charge, computable without reading a tensor."""
+        return sum(2 * self.hidden_size * r * 4 for _, r in self.layers)
+
+    # -- serialization (the PrecisionPlan conventions) ---------------------
+
+    def to_json(self) -> dict:
+        return {
+            "version": 1,
+            "name": self.name,
+            "rank": self.rank,
+            "alpha": self.alpha,
+            "hidden_size": self.hidden_size,
+            "layers": {n: r for n, r in self.layers},
+            "layer_order": [n for n, _ in self.layers],
+            "target_modules": list(self.target_modules),
+        }
+
+    @classmethod
+    def from_json(cls, data: Mapping[str, Any]) -> "AdapterPlan":
+        layer_map = data["layers"]
+        order = data.get("layer_order") or sorted(layer_map)
+        return cls(
+            name=str(data["name"]),
+            rank=int(data["rank"]),
+            alpha=float(data["alpha"]),
+            hidden_size=int(data["hidden_size"]),
+            layers=tuple((n, int(layer_map[n])) for n in order),
+            target_modules=tuple(data.get("target_modules", ())),
+        )
+
+    def write(self, path: str) -> str:
+        """Atomically write the plan JSON (tmp + rename, the manifest
+        convention)."""
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(self.to_json(), f, sort_keys=True, indent=1)
+        os.replace(tmp, path)
+        return path
+
+    def save(self, adapter_dir: str) -> str:
+        return self.write(os.path.join(adapter_dir, ADAPTER_PLAN_NAME))
+
+    @classmethod
+    def load(cls, adapter_dir: str) -> "AdapterPlan | None":
+        """The plan in an adapter dir, or None when there is no plan file.
+        A corrupt plan raises ValueError and an existing-but-unreadable
+        one propagates its OSError — a plan that EXISTS but cannot be
+        checked must never silently read as "no adapter here"."""
+        path = os.path.join(adapter_dir, ADAPTER_PLAN_NAME)
+        try:
+            with open(path) as f:
+                raw = f.read()
+        except FileNotFoundError:
+            return None
+        try:
+            return cls.from_json(json.loads(raw))
+        except (ValueError, KeyError, TypeError) as e:
+            raise ValueError(
+                f"{path}: corrupt adapter plan ({e!r}); re-run "
+                "prepare-adapter or delete the adapter dir"
+            ) from e
+
+
+class AdapterRegistry:
+    """Named adapters under one root dir. Purely structural — byte
+    caching, budgets, and integrity retries live in adapters/loader.py;
+    the registry just resolves names to dirs and plans with the typed
+    error taxonomy the serve path relies on."""
+
+    def __init__(self, root: str):
+        self.root = root
+
+    def names(self) -> tuple[str, ...]:
+        """Every adapter name present (sorted): subdirectories holding an
+        ``adapter_plan.json``. An unreadable root reads as empty — the
+        typed miss surfaces per-request via :meth:`path`."""
+        try:
+            entries = sorted(os.listdir(self.root))
+        except OSError:
+            return ()
+        return tuple(
+            n
+            for n in entries
+            if os.path.isfile(os.path.join(self.root, n, ADAPTER_PLAN_NAME))
+        )
+
+    def path(self, name: str) -> str:
+        d = os.path.join(self.root, name)
+        if not os.path.isfile(os.path.join(d, ADAPTER_PLAN_NAME)):
+            raise AdapterNotFound(
+                f"adapter {name!r} not found under {self.root!r} "
+                f"(available: {list(self.names())})"
+            )
+        return d
+
+    def plan(self, name: str) -> AdapterPlan:
+        d = self.path(name)
+        try:
+            plan = AdapterPlan.load(d)
+        except ValueError as e:
+            raise AdapterCorruptError(str(e)) from e
+        if plan is None:  # pragma: no cover - path() just proved it exists
+            raise AdapterNotFound(f"adapter {name!r} has no plan file")
+        if plan.name != name:
+            raise AdapterCorruptError(
+                f"{d}/{ADAPTER_PLAN_NAME}: plan names adapter "
+                f"{plan.name!r} but lives in dir {name!r} — a moved or "
+                "hand-edited dir; re-run prepare-adapter"
+            )
+        return plan
+
+
+# ---------------------------------------------------------------------------
+# Writing adapters (tests, chaos, and the PEFT converter share this)
+# ---------------------------------------------------------------------------
+
+
+def save_adapter(
+    root: str,
+    name: str,
+    factors: Mapping[str, tuple[np.ndarray, np.ndarray]],
+    *,
+    alpha: float | None = None,
+    target_modules: tuple[str, ...] = (),
+) -> str:
+    """Write one adapter dir: per-layer delta safetensors + plan +
+    integrity manifest. ``factors`` maps decoder layer names
+    (``model.layers.N``) to ``(A [D, r], B [r, D])`` float32 pairs.
+    ``alpha`` defaults to the max rank, making the apply scale exactly
+    1.0 (the converter's convention — per-module scaling pre-folded into
+    B). Returns the adapter dir."""
+    if not factors:
+        raise ValueError(f"adapter {name!r}: no layer factors to save")
+    adir = os.path.join(root, name)
+    os.makedirs(adir, exist_ok=True)
+    layers = []
+    hidden = None
+    manifest_layers: dict[str, dict] = {}
+    for lname in sorted(factors, key=_layer_sort_key):
+        a, b = factors[lname]
+        a = np.ascontiguousarray(a, dtype=np.float32)
+        b = np.ascontiguousarray(b, dtype=np.float32)
+        if a.ndim != 2 or b.ndim != 2 or a.shape != b.shape[::-1]:
+            raise ValueError(
+                f"adapter {name!r} layer {lname!r}: A {a.shape} / B "
+                f"{b.shape} must be [D, r] / [r, D]"
+            )
+        if hidden is None:
+            hidden = int(a.shape[0])
+        elif int(a.shape[0]) != hidden:
+            raise ValueError(
+                f"adapter {name!r} layer {lname!r}: hidden size "
+                f"{a.shape[0]} disagrees with {hidden}"
+            )
+        r = int(a.shape[1])
+        if r < 1:
+            raise ValueError(f"adapter {name!r} layer {lname!r}: rank 0")
+        layers.append((lname, r))
+        flat = {"lora_A": a, "lora_B": b}
+        file_name = f"{lname}{LAYER_FILE_SUFFIX}"
+        st_save_file(flat, os.path.join(adir, file_name))
+        manifest_layers[lname] = integrity_manifest.layer_entry(
+            flat, file_name
+        )
+    rank = max(r for _, r in layers)
+    plan = AdapterPlan(
+        name=name,
+        rank=rank,
+        alpha=float(alpha) if alpha is not None else float(rank),
+        hidden_size=int(hidden),
+        layers=tuple(layers),
+        target_modules=tuple(target_modules),
+    )
+    plan.save(adir)
+    integrity_manifest.write_manifest(adir, manifest_layers)
+    return adir
+
+
+def _layer_sort_key(lname: str):
+    parts = lname.split(".")
+    try:
+        return (0, int(parts[2]))
+    except (IndexError, ValueError):
+        return (1, lname)
+
+
+# ---------------------------------------------------------------------------
+# HF PEFT conversion (the `prepare-adapter` CLI subcommand)
+# ---------------------------------------------------------------------------
+
+# base_model.model.model.layers.3.self_attn.q_proj.lora_A.weight
+_PEFT_KEY = re.compile(
+    r".*\.layers\.(\d+)\.(.+?)\.lora_(A|B)\.weight$"
+)
+
+
+def convert_peft_checkpoint(src_dir: str, root: str, name: str) -> str:
+    """Convert a HF PEFT LoRA checkpoint dir (``adapter_config.json`` +
+    ``adapter_model.safetensors``) into the per-layer registry layout.
+
+    v1 scope: SQUARE target modules only (in_features == out_features ==
+    hidden — q/k/v/o/gate-style projections on models where they are
+    square). Each layer's module deltas concatenate along the rank axis
+    into ONE hidden-stream factor pair applied at layer entry, with
+    every module's ``lora_alpha / r`` pre-folded into its B slice (the
+    stored plan has ``alpha == rank``, i.e. apply scale exactly 1.0).
+    This folds per-projection deltas into the layer-entry hidden-stream
+    form the sweep applies — the registry's one apply point — rather
+    than patching each projection in place. Non-square targets and
+    ``.bin`` (torch-pickle) checkpoints raise typed ValueErrors."""
+    cfg_path = os.path.join(src_dir, "adapter_config.json")
+    try:
+        with open(cfg_path) as f:
+            peft_cfg = json.load(f)
+    except FileNotFoundError:
+        raise ValueError(
+            f"{src_dir}: no adapter_config.json — not a PEFT checkpoint dir"
+        ) from None
+    st_path = os.path.join(src_dir, "adapter_model.safetensors")
+    if not os.path.isfile(st_path):
+        if os.path.isfile(os.path.join(src_dir, "adapter_model.bin")):
+            raise ValueError(
+                f"{src_dir}: only a torch-pickle adapter_model.bin — "
+                "re-save the PEFT checkpoint with safe_serialization=True "
+                "(this toolchain reads safetensors only)"
+            )
+        raise ValueError(f"{src_dir}: no adapter_model.safetensors")
+    tensors = st_load_file(st_path)
+    alpha = float(peft_cfg.get("lora_alpha", peft_cfg.get("r", 1)))
+    # (layer_idx, module) -> {"A": [r, D_in], "B": [D_out, r]} (PEFT layout)
+    pairs: dict[tuple[int, str], dict[str, np.ndarray]] = {}
+    for key, t in tensors.items():
+        m = _PEFT_KEY.match(key)
+        if m is None:
+            continue
+        idx, module, ab = int(m.group(1)), m.group(2), m.group(3)
+        pairs.setdefault((idx, module), {})[ab] = np.asarray(t, np.float32)
+    if not pairs:
+        raise ValueError(
+            f"{st_path}: no '*.layers.N.<module>.lora_A/B.weight' tensors "
+            "— unsupported PEFT layout"
+        )
+    hidden = None
+    per_layer: dict[int, list[tuple[np.ndarray, np.ndarray]]] = {}
+    for (idx, module), ab in sorted(pairs.items()):
+        if "A" not in ab or "B" not in ab:
+            raise ValueError(
+                f"{st_path}: layer {idx} module {module!r} has only half "
+                "a lora_A/lora_B pair"
+            )
+        a_w, b_w = ab["A"], ab["B"]  # [r, D_in], [D_out, r]
+        r = int(a_w.shape[0])
+        if a_w.shape[1] != b_w.shape[0] or b_w.shape[1] != r:
+            raise ValueError(
+                f"{st_path}: layer {idx} module {module!r} is non-square "
+                f"(lora_A {tuple(a_w.shape)}, lora_B {tuple(b_w.shape)}) — "
+                "v1 converts square target modules only (in == out == "
+                "hidden)"
+            )
+        d = int(a_w.shape[1])
+        if hidden is None:
+            hidden = d
+        elif d != hidden:
+            raise ValueError(
+                f"{st_path}: module {module!r} hidden size {d} disagrees "
+                f"with {hidden}"
+            )
+        # Row-vector layout with alpha/r folded into B: the stored pair
+        # applies at scale exactly 1.0.
+        per_layer.setdefault(idx, []).append(
+            (a_w.T, b_w.T * (alpha / float(r)))
+        )
+    factors = {
+        f"model.layers.{idx}": (
+            np.concatenate([a for a, _ in mods], axis=1),
+            np.concatenate([b for _, b in mods], axis=0),
+        )
+        for idx, mods in per_layer.items()
+    }
+    modules = tuple(sorted({mod for _, mod in pairs}))
+    return save_adapter(root, name, factors, target_modules=modules)
+
+
+__all__ = [
+    "ADAPTER_PLAN_NAME",
+    "AdapterCorruptError",
+    "AdapterNotFound",
+    "AdapterPlan",
+    "AdapterRegistry",
+    "convert_peft_checkpoint",
+    "save_adapter",
+]

@@ -334,18 +334,21 @@ _CHAOS_PREFIX = "chaos_"
 _PRESSURE_PREFIX = "pressure_"
 _SCHED_PREFIX = "sched_"
 _SLO_PREFIX = "slo_"
+_ADAPTER_PREFIX = "adapter_"
 
 # cli.py functions that thread parsed args into config constructions.
 _BATCH_READERS = (
     "config_from_args",
     "_fault_config_from_args",
     "_pressure_config_from_args",
+    "_adapter_config_from_args",
     "main",
 )
 _SERVE_READERS = (
     "serve_main",
     "_fault_config_from_args",
     "_pressure_config_from_args",
+    "_adapter_config_from_args",
     "_sched_config_from_args",
     "_slo_config_from_args",
 )
@@ -435,7 +438,8 @@ def _args_reads(tree: ast.Module) -> dict[str, dict[str, int]]:
 @project_rule(
     "KNOB-SYNC",
     "every FrameworkConfig/ServeConfig/SchedConfig/SLOConfig/FaultConfig/"
-    "PressureConfig flag exists in both CLI parsers (or is declared "
+    "PressureConfig/AdapterConfig flag exists in both CLI parsers (or is "
+    "declared "
     "single-parser; serving-only classes are exempt), maps to a real "
     "field, and is threaded into the construction",
 )
@@ -457,6 +461,7 @@ def knob_sync(ctx: ProjectContext) -> list[Finding]:
     pc = _class_fields(config.tree, "PressureConfig")
     sc = _class_fields(config.tree, "SchedConfig")
     oc = _class_fields(config.tree, "SLOConfig")
+    ac = _class_fields(config.tree, "AdapterConfig")
     flags = _parser_flags(cli.tree)
     batch = flags.get("build_parser", {})
     serve = flags.get("build_serve_parser", {})
@@ -493,6 +498,11 @@ def knob_sync(ctx: ProjectContext) -> list[Finding]:
             return ("SLOConfig", "enabled") if "enabled" in oc else ("?", flag)
         if flag.startswith(_SLO_PREFIX) and flag[len(_SLO_PREFIX):] in oc:
             return ("SLOConfig", flag[len(_SLO_PREFIX):])
+        # AdapterConfig (multi-tenant LoRA, adapters/): a SHARED runtime
+        # subsystem like FaultConfig/PressureConfig, so adapter_ flags
+        # fall through to the both-parsers requirement below.
+        if flag.startswith(_ADAPTER_PREFIX) and flag[len(_ADAPTER_PREFIX):] in ac:
+            return ("AdapterConfig", flag[len(_ADAPTER_PREFIX):])
         if flag in _FLAG_ALIASES:
             cls, field = _FLAG_ALIASES[flag]
             fields = sv if cls == "ServeConfig" else fw
@@ -622,6 +632,8 @@ def knob_sync(ctx: ProjectContext) -> list[Finding]:
         ("_fault_config_from_args", "serve", serve),
         ("_pressure_config_from_args", "batch", batch),
         ("_pressure_config_from_args", "serve", serve),
+        ("_adapter_config_from_args", "batch", batch),
+        ("_adapter_config_from_args", "serve", serve),
         # Serve-path-only readers: SchedConfig/SLOConfig are serving
         # subsystems, so their reads validate against the serve parser.
         ("_sched_config_from_args", "serve", serve),

@@ -172,6 +172,42 @@ def _add_robustness_flags(p: argparse.ArgumentParser) -> None:
                         "fewer host syncs on big batches, more HBM)")
 
 
+def _add_adapter_flags(p: argparse.ArgumentParser) -> None:
+    """Shared by the batch and serve parsers: multi-tenant LoRA adapter
+    serving (adapters/; docs/adapters.md has the registry layout, the
+    apply math, and the one-base-stream accounting)."""
+    p.add_argument("--adapter_dir", type=str, default="",
+                   help="registry root of named LoRA adapters — one "
+                        "subdir per adapter holding per-layer delta "
+                        "safetensors + adapter_plan.json + an integrity "
+                        "manifest (build one from a HF PEFT checkpoint "
+                        "with `prepare-adapter`). Requests carrying an "
+                        "adapter_id decode under that adapter's low-rank "
+                        "delta INSIDE the shared base-model sweep: N "
+                        "tenants ride one base stream for near-zero "
+                        "extra link bytes. Empty (default) = adapter "
+                        "serving off — adapter_id requests are rejected "
+                        "typed and the sweep is byte-identical to a "
+                        "build without adapters")
+    p.add_argument("--adapter_max_gb", type=_float_or_auto, default=None,
+                   help="host-resident adapter-factor LRU budget in GB "
+                        "(adapters/loader.py, the delta-weight analog of "
+                        "--host_cache_gb): 'auto' (default) = a small "
+                        "fraction of free RAM — auto stays ON under "
+                        "--chaos, unlike the shard cache, because the "
+                        "delta reads are themselves chaos sites; "
+                        "0 = adapter serving off even with --adapter_dir")
+
+
+def _adapter_config_from_args(args: argparse.Namespace):
+    from flexible_llm_sharding_tpu.config import AdapterConfig
+
+    return AdapterConfig(
+        dir=args.adapter_dir,
+        max_gb=args.adapter_max_gb,
+    )
+
+
 def _add_pressure_flags(p: argparse.ArgumentParser) -> None:
     """Shared by the batch and serve parsers: the resource-pressure
     brownout controller (runtime/pressure.py; docs/pressure.md has the
@@ -509,6 +545,7 @@ def build_parser() -> argparse.ArgumentParser:
                         "integrity counters — the machine-readable form "
                         "of the final stats line) to this path at run end")
     _add_robustness_flags(p)
+    _add_adapter_flags(p)
     _add_pressure_flags(p)
     _add_observability_flags(p)
     return p
@@ -565,6 +602,7 @@ def config_from_args(args: argparse.Namespace) -> FrameworkConfig:
         incident_settle_s=args.incident_settle_s,
         faults=_fault_config_from_args(args),
         pressure=_pressure_config_from_args(args),
+        adapters=_adapter_config_from_args(args),
     )
 
 
@@ -675,6 +713,7 @@ def build_serve_parser() -> argparse.ArgumentParser:
                         "cost, and output stays token-identical to 0 "
                         "(greedy-exact verification); 0 = off")
     _add_robustness_flags(p)
+    _add_adapter_flags(p)
     _add_pressure_flags(p)
     _add_observability_flags(p)
     _add_sched_flags(p)
@@ -733,6 +772,7 @@ def serve_main(argv: list[str] | None = None, tokenizer=None) -> None:
         incident_settle_s=args.incident_settle_s,
         faults=_fault_config_from_args(args),
         pressure=_pressure_config_from_args(args),
+        adapters=_adapter_config_from_args(args),
     )
     serve_cfg = ServeConfig(
         queue_capacity=args.queue_capacity,
@@ -852,6 +892,10 @@ def serve_main(argv: list[str] | None = None, tokenizer=None) -> None:
                         # bad-request reply below, never a silent default.
                         slo_class=d.get("slo_class"),
                         tenant_id=d.get("tenant_id"),
+                        # Multi-tenant LoRA (adapters/): an unknown or
+                        # corrupt adapter fails ONLY this request, typed,
+                        # at wave assembly — never the server.
+                        adapter_id=d.get("adapter_id"),
                     )
                 except Exception as e:
                     # One malformed line must not take the server down for
@@ -914,6 +958,13 @@ def build_verify_parser() -> argparse.ArgumentParser:
     p.add_argument("--spill_dir", type=str, default=None,
                    help="activation spill dir (--disk_folder of a run) to "
                         "audit")
+    p.add_argument("--adapter_dir", type=str, default=None,
+                   help="LoRA adapter registry root (the serve flag of "
+                        "the same name) to audit: every adapter's delta "
+                        "safetensors recomputed against its integrity "
+                        "manifest, plan <-> file structural drift "
+                        "reported (adapter_mismatch / plan_missing_file "
+                        "/ corrupt_plan)")
     p.add_argument("--hbm_pin_gb", type=str, default=None,
                    help="dry-run the device residency planner at this HBM "
                         "budget (GB, or 'auto' for the local chip's "
@@ -929,12 +980,15 @@ def build_verify_parser() -> argparse.ArgumentParser:
 
 def verify_main(argv: list[str] | None = None) -> None:
     args = build_verify_parser().parse_args(argv)
-    if not args.model_path and not args.spill_dir:
-        raise SystemExit("verify: give --model_path and/or --spill_dir")
+    if not args.model_path and not args.spill_dir and not args.adapter_dir:
+        raise SystemExit(
+            "verify: give --model_path, --spill_dir and/or --adapter_dir"
+        )
     if args.hbm_pin_gb is not None and not args.model_path:
         raise SystemExit("verify: --hbm_pin_gb requires --model_path")
     from flexible_llm_sharding_tpu.integrity.verify import (
         format_report,
+        verify_adapter_dir,
         verify_model_dir,
         verify_spill_dir,
     )
@@ -944,6 +998,8 @@ def verify_main(argv: list[str] | None = None) -> None:
         reports.append(verify_model_dir(args.model_path))
     if args.spill_dir:
         reports.append(verify_spill_dir(args.spill_dir))
+    if args.adapter_dir:
+        reports.append(verify_adapter_dir(args.adapter_dir))
     residency_plan = None
     if args.hbm_pin_gb is not None:
         from flexible_llm_sharding_tpu.runtime.residency import (
@@ -1082,6 +1138,67 @@ def plan_precision_main(argv: list[str] | None = None, tokenizer=None) -> None:
         )
 
 
+def build_prepare_adapter_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="flexible-llm-sharding-tpu prepare-adapter",
+        description="Convert a HF PEFT LoRA checkpoint dir "
+        "(adapter_config.json + adapter_model.safetensors) into the "
+        "serving registry layout under --adapter_dir: per-decoder-layer "
+        "delta safetensors, an adapter_plan.json (per-layer ranks, "
+        "alpha, hidden size), and an integrity manifest so `verify` can "
+        "audit it and corrupt deltas raise typed at serve time. "
+        "Per-module lora_alpha/r is pre-folded into the stored B "
+        "factors (apply scale exactly 1.0); v1 converts square target "
+        "modules only (docs/adapters.md).",
+    )
+    p.add_argument("--peft_dir", type=str, required=True,
+                   help="HF PEFT checkpoint dir to convert (must hold "
+                        "adapter_model.safetensors — torch-pickle .bin "
+                        "checkpoints are rejected typed)")
+    p.add_argument("--adapter_dir", type=str, required=True,
+                   help="registry root to write into (the serve flag of "
+                        "the same name); the adapter lands at "
+                        "<adapter_dir>/<name>")
+    p.add_argument("--name", type=str, required=True,
+                   help="adapter name — the adapter_id serving requests "
+                        "carry")
+    p.add_argument("--json", action="store_true",
+                   help="emit the written plan as JSON on stdout")
+    return p
+
+
+def prepare_adapter_main(argv: list[str] | None = None) -> None:
+    args = build_prepare_adapter_parser().parse_args(argv)
+    from flexible_llm_sharding_tpu.adapters.registry import (
+        AdapterPlan,
+        convert_peft_checkpoint,
+    )
+
+    try:
+        adir = convert_peft_checkpoint(
+            args.peft_dir, args.adapter_dir, args.name
+        )
+    except ValueError as e:
+        raise SystemExit(f"prepare-adapter: {e}")
+    plan = AdapterPlan.load(adir)
+    if args.json:
+        print(json.dumps(plan.to_json()))
+    else:
+        ranks = plan.ranks
+        print(
+            f"adapter {plan.name!r} -> {adir}: {len(plan.layers)} layers, "
+            f"rank {plan.rank} (alpha {plan.alpha:g}, scale "
+            f"{plan.scale:g}), hidden {plan.hidden_size}, "
+            f"{plan.nbytes() / 1e6:.2f} MB of deltas"
+        )
+        for lname, _ in plan.layers:
+            print(f"  r={ranks[lname]:<3d} {lname}")
+        print(
+            f"serve with: --adapter_dir {args.adapter_dir} ; requests "
+            f'carry {{"adapter_id": "{plan.name}"}}'
+        )
+
+
 def build_incidents_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="flexible-llm-sharding-tpu incidents",
@@ -1178,6 +1295,10 @@ def main(argv: list[str] | None = None, tokenizer=None) -> None:
     if argv and argv[0] == "plan-precision":
         # Mixed-precision calibration planner (docs/precision.md).
         return plan_precision_main(argv[1:], tokenizer=tokenizer)
+    if argv and argv[0] == "prepare-adapter":
+        # HF PEFT LoRA checkpoint -> serving registry layout
+        # (adapters/registry.py, docs/adapters.md).
+        return prepare_adapter_main(argv[1:])
     if argv and argv[0] == "check":
         # flscheck: the project-invariant static analyzer (docs/analysis.md).
         from flexible_llm_sharding_tpu.analysis import main as check_main

@@ -146,6 +146,32 @@ class PressureConfig:
             raise ValueError("step_down_polls must be >= 1")
 
 
+@dataclasses.dataclass(frozen=True)
+class AdapterConfig:
+    """Multi-tenant LoRA adapter serving (adapters/; docs/adapters.md).
+
+    ``dir`` is a directory of named adapters — one subdirectory per
+    adapter, each holding per-layer safetensors delta factors plus an
+    ``adapter_plan.json`` (the PR 14 plan shape) and an integrity
+    manifest. Empty (default) disables the subsystem entirely: requests
+    carrying an ``adapter_id`` are rejected and the sweep math is
+    byte-identical to a tree without adapters. ``max_gb`` budgets the
+    host-resident adapter LRU (``adapters/loader.py``): an explicit
+    number of GB, or None (auto) for a small fraction of available RAM —
+    auto stays ON under fault injection (chaos-exempt like the KV pool:
+    the chaos smoke serves adapters *under* faults, so the budget must
+    not silently vanish there)."""
+
+    dir: str = ""
+    max_gb: float | None = None
+
+    def __post_init__(self) -> None:
+        if self.max_gb is not None and self.max_gb < 0:
+            raise ValueError(
+                f"max_gb must be >= 0 (or None for auto), got {self.max_gb}"
+            )
+
+
 # Multimodal wrapper model types -> their language-model type. Published
 # Gemma-3 / Llama-4 checkpoints are vision+text bundles whose config nests
 # the text model under "text_config"; both the config parse and the
@@ -1060,6 +1086,9 @@ class FrameworkConfig:
     # Resource-pressure brownout ladder (off by default; the --pressure
     # CLI flag enables it — runtime/pressure.py, docs/pressure.md).
     pressure: PressureConfig = dataclasses.field(default_factory=PressureConfig)
+    # Multi-tenant LoRA adapter serving (off by default; --adapter_dir
+    # enables it — adapters/, docs/adapters.md).
+    adapters: AdapterConfig = dataclasses.field(default_factory=AdapterConfig)
 
     def __post_init__(self) -> None:
         loc = self.storage_location
@@ -1175,6 +1204,24 @@ class FrameworkConfig:
         if self.kv_pool_gb is not None:
             return int(self.kv_pool_gb * 1e9)
         from flexible_llm_sharding_tpu.runtime.kvpool import (
+            _auto_budget_bytes,
+        )
+
+        return _auto_budget_bytes()
+
+    def effective_adapter_bytes(self) -> int:
+        """Resolve the tri-state ``adapters.max_gb`` to a byte budget.
+
+        Explicit value -> that many GB (0 = off). None (auto) -> a small
+        slice of the host's available RAM (adapters.loader's auto
+        budget). Like the KV pool — and unlike the shard cache — auto
+        stays ON under fault injection: the adapter store's delta reads
+        are themselves ``corrupt_shard`` chaos sites (the chaos smoke
+        serves adapters *under* faults), so chaos runs must keep their
+        draws rather than lose the store entirely."""
+        if self.adapters.max_gb is not None:
+            return int(self.adapters.max_gb * 1e9)
+        from flexible_llm_sharding_tpu.adapters.loader import (
             _auto_budget_bytes,
         )
 

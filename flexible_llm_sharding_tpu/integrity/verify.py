@@ -290,6 +290,217 @@ def verify_spill_dir(spill_dir: str) -> dict:
     }
 
 
+def verify_adapter_dir(root: str) -> dict:
+    """Audit a LoRA adapter registry root (``--adapter_dir``): every
+    adapter subdir's delta safetensors recomputed against its integrity
+    manifest, plus plan <-> dir structural drift — strict, like the
+    model-dir audit (the serving loader tolerates what it can heal; the
+    audit reports everything).
+
+    Returns ``{"path", "ok", "adapters_checked", "layers_checked",
+    "tensors_checked", "problems"}``. Statuses: ``corrupt_plan`` (plan
+    missing/undecodable for a dir that holds delta files) |
+    ``plan_missing_file`` (planned layer's file gone) | ``not_in_plan``
+    | ``adapter_mismatch`` (checksum/size/shape diverges from the
+    manifest or plan — the offline face of the loader's typed
+    AdapterCorruptError) | the manifest statuses shared with
+    :func:`verify_model_dir` (``no_manifest`` | ``corrupt_manifest`` |
+    ``missing_file`` | ``not_in_manifest`` | ``unreadable`` |
+    ``tensor_diff``).
+    """
+    from flexible_llm_sharding_tpu.adapters.registry import (
+        ADAPTER_PLAN_NAME,
+        AdapterPlan,
+    )
+    from flexible_llm_sharding_tpu.utils.checkpoint import (
+        LAYER_FILE_SUFFIX as _LAYER_SUFFIX,
+    )
+    from flexible_llm_sharding_tpu.utils.checkpoint import st_load_file
+
+    problems: list[dict] = []
+    adapters_checked = layers_checked = tensors_checked = 0
+    try:
+        entries = sorted(os.listdir(root))
+    except OSError as e:
+        return {
+            "path": root,
+            "ok": False,
+            "adapters_checked": 0,
+            "layers_checked": 0,
+            "tensors_checked": 0,
+            "problems": [_problem(root, "unreadable", repr(e))],
+        }
+    for name in entries:
+        adir = os.path.join(root, name)
+        if not os.path.isdir(adir):
+            continue
+        disk_layers = {
+            f[: -len(_LAYER_SUFFIX)]
+            for f in os.listdir(adir)
+            if f.endswith(_LAYER_SUFFIX)
+        }
+        try:
+            plan = AdapterPlan.load(adir)
+        except (ValueError, OSError) as e:
+            problems.append(
+                _problem(f"{name}/{ADAPTER_PLAN_NAME}", "corrupt_plan", str(e))
+            )
+            plan = None
+        else:
+            if plan is None:
+                if not disk_layers:
+                    continue  # unrelated subdir, not an adapter
+                problems.append(
+                    _problem(
+                        f"{name}/{ADAPTER_PLAN_NAME}",
+                        "corrupt_plan",
+                        f"dir holds {len(disk_layers)} delta file(s) but "
+                        "no adapter plan; re-run prepare-adapter",
+                    )
+                )
+        if plan is None and not disk_layers:
+            continue
+        adapters_checked += 1
+        plan_ranks = dict(plan.layers) if plan is not None else {}
+        for layer in sorted(plan_ranks.keys() - disk_layers):
+            problems.append(
+                _problem(
+                    f"{name}/{layer}{_LAYER_SUFFIX}",
+                    "plan_missing_file",
+                    f"adapter plan covers layer {layer!r} but its delta "
+                    "file is gone",
+                )
+            )
+        for layer in sorted(disk_layers - plan_ranks.keys()):
+            if plan is not None:
+                problems.append(
+                    _problem(
+                        f"{name}/{layer}{_LAYER_SUFFIX}",
+                        "not_in_plan",
+                        f"delta file {layer!r} exists on disk but the "
+                        "adapter plan has no entry for it",
+                    )
+                )
+        try:
+            manifest = iman.load_manifest(adir)
+        except ValueError as e:
+            manifest = None
+            problems.append(
+                _problem(
+                    f"{name}/{iman.MANIFEST_NAME}", "corrupt_manifest", str(e)
+                )
+            )
+        else:
+            if manifest is None:
+                problems.append(
+                    _problem(
+                        f"{name}/{iman.MANIFEST_NAME}",
+                        "no_manifest",
+                        "adapter dir has no integrity manifest; re-run "
+                        "prepare-adapter to enable verification",
+                    )
+                )
+        man_layers = dict((manifest or {}).get("layers", {}))
+        for layer in sorted(man_layers.keys() - disk_layers):
+            problems.append(
+                _problem(
+                    f"{name}/{layer}{_LAYER_SUFFIX}",
+                    "missing_file",
+                    f"layer {layer!r} is in the manifest but its file is "
+                    "gone",
+                )
+            )
+        for layer in sorted(disk_layers - man_layers.keys()):
+            if manifest is not None:
+                problems.append(
+                    _problem(
+                        f"{name}/{layer}{_LAYER_SUFFIX}",
+                        "not_in_manifest",
+                        f"delta file {layer!r} exists on disk but the "
+                        "manifest has no entry for it",
+                    )
+                )
+        for layer in sorted(disk_layers):
+            fname = layer + _LAYER_SUFFIX
+            ref = f"{name}/{fname}"
+            try:
+                flat = st_load_file(os.path.join(adir, fname))
+            except Exception as e:  # truncated header, bad magic, ...
+                problems.append(_problem(ref, "unreadable", repr(e)))
+                continue
+            layers_checked += 1
+            if plan is not None and layer in plan_ranks:
+                # Shape audit against the plan — the offline face of the
+                # loader's AdapterCorruptError shape check.
+                want_a = (plan.hidden_size, plan_ranks[layer])
+                a = flat.get("lora_A")
+                b = flat.get("lora_B")
+                if a is not None and tuple(a.shape) != want_a:
+                    problems.append(
+                        _problem(
+                            ref,
+                            "adapter_mismatch",
+                            f"lora_A shape {tuple(a.shape)} vs plan "
+                            f"{want_a}",
+                        )
+                    )
+                if b is not None and tuple(b.shape) != want_a[::-1]:
+                    problems.append(
+                        _problem(
+                            ref,
+                            "adapter_mismatch",
+                            f"lora_B shape {tuple(b.shape)} vs plan "
+                            f"{want_a[::-1]}",
+                        )
+                    )
+            want = man_layers.get(layer, {}).get("tensors")
+            if want is None:
+                continue
+            missing = sorted(want.keys() - flat.keys())
+            extra = sorted(flat.keys() - want.keys())
+            if missing or extra:
+                problems.append(
+                    _problem(
+                        ref,
+                        "tensor_diff",
+                        f"manifest-only tensors {missing}, file-only "
+                        f"tensors {extra}",
+                    )
+                )
+            for key in sorted(want.keys() & flat.keys()):
+                tensors_checked += 1
+                arr = np.asarray(flat[key])
+                meta = want[key]
+                if int(arr.nbytes) != int(meta["n"]):
+                    problems.append(
+                        _problem(
+                            ref,
+                            "adapter_mismatch",
+                            f"tensor {key!r}: {arr.nbytes} bytes vs "
+                            f"manifest {meta['n']} (truncated/resized)",
+                        )
+                    )
+                    continue
+                got = iman.tensor_checksum(arr)
+                if got != meta["c"]:
+                    problems.append(
+                        _problem(
+                            ref,
+                            "adapter_mismatch",
+                            f"tensor {key!r}: checksum {got} != manifest "
+                            f"{meta['c']}",
+                        )
+                    )
+    return {
+        "path": root,
+        "ok": not problems,
+        "adapters_checked": adapters_checked,
+        "layers_checked": layers_checked,
+        "tensors_checked": tensors_checked,
+        "problems": problems,
+    }
+
+
 def format_report(report: dict) -> str:
     """Human-readable per-file lines + one summary line."""
     lines = []
@@ -305,4 +516,9 @@ def format_report(report: dict) -> str:
     return "\n".join(lines)
 
 
-__all__ = ["verify_model_dir", "verify_spill_dir", "format_report"]
+__all__ = [
+    "verify_adapter_dir",
+    "verify_model_dir",
+    "verify_spill_dir",
+    "format_report",
+]

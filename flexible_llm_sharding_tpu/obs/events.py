@@ -65,6 +65,9 @@ EVENT_KINDS = {
     "wave_reject": "warning",        # a wave failed at tokenization/init
     "watchdog_stall": "error",       # sweep made no progress; source aborted
     "wave_preempt": "info",          # scheduler retired a best-effort wave
+    "adapter_reject": "warning",     # unknown/corrupt LoRA adapter: that
+                                     # tenant's requests failed typed at
+                                     # wave assembly (base unaffected)
     # replica fleet (serve/fleet.py)
     "replica_dead": "critical",      # hard-fail: engine-fatal or stalled
     "replica_drain": "warning",      # graceful drain started

@@ -33,6 +33,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from flexible_llm_sharding_tpu.adapters.apply import lora_shift
 from flexible_llm_sharding_tpu.config import FrameworkConfig, LlamaConfig
 from flexible_llm_sharding_tpu.models import llama
 from flexible_llm_sharding_tpu.obs import trace as obs_trace
@@ -63,7 +64,7 @@ Params = dict[str, Any]
 @partial(jax.jit, static_argnums=(0, 1, 2), donate_argnums=(4, 5))
 def _prefill_decoders(
     cfg: LlamaConfig, use_pallas, tp_mesh, seg, prefix_h, suffix_h, prefix_len,
-    total_len=None,
+    total_len=None, delta=None,
 ):
     """Scan k layers over a block, emitting per-layer KV as scan outputs.
 
@@ -71,12 +72,28 @@ def _prefill_decoders(
     "rope": bool [k] or None (llama4 NoPE flags)}.
     Returns (prefix_h, suffix_h, kv) with kv leaves shaped [k, B, ...].
     ``total_len`` int32 [B]: longrope's per-prompt real-length selector.
+    ``delta``: optional multi-adapter LoRA shift (adapters/apply.py) —
+    {"A": [k, G, D, R], "B": [k, G, R, D], "g": [B], "scale": [G]};
+    applied to both hidden streams at each layer's ENTRY. ``None`` keeps
+    the traced computation byte-identical to a tree without adapters
+    (the branch is Python-level, resolved at trace time).
     """
     stacked, flags, rflags = seg["layers"], seg["sliding"], seg.get("rope")
+    xs_in = (
+        (stacked, flags, rflags)
+        if delta is None
+        else (stacked, flags, rflags, delta["A"], delta["B"])
+    )
 
     def body(carry, xs):
-        layer_params, sliding, rope_on = xs
+        if delta is None:
+            layer_params, sliding, rope_on = xs
+        else:
+            layer_params, sliding, rope_on, d_a, d_b = xs
         p, s = carry
+        if delta is not None:
+            p = lora_shift(p, d_a, d_b, delta["g"], delta["scale"])
+            s = lora_shift(s, d_a, d_b, delta["g"], delta["scale"])
 
         def one_layer(lp_, c_, p_, s_, plen_, tlen_):
             return llama.prefix_suffix_layer(
@@ -97,7 +114,7 @@ def _prefill_decoders(
         return (p, s), kv
 
     (prefix_h, suffix_h), kv = jax.lax.scan(
-        body, (prefix_h, suffix_h), (stacked, flags, rflags)
+        body, (prefix_h, suffix_h), xs_in
     )
     return prefix_h, suffix_h, kv
 
@@ -105,7 +122,7 @@ def _prefill_decoders(
 @partial(jax.jit, static_argnums=(0, 1, 2), donate_argnums=(5,))
 def _suffix_prefill_decoders(
     cfg: LlamaConfig, use_pallas, tp_mesh, seg, kv_p, suffix_h, prefix_len,
-    total_len=None,
+    total_len=None, delta=None,
 ):
     """Suffix-only prefill scan over a block, fed POOLED prefix KV.
 
@@ -117,11 +134,28 @@ def _suffix_prefill_decoders(
     kv_p: {"kp": [k, B, Lp, n_kv, hd], "vp": [k, B, Lp, n_kv, v_dim]} —
     NOT donated; the caller re-attaches these leaves to the decode-KV dict.
     Returns (suffix_h, {"ks","vs"} with leaves shaped [k, B, ...]).
+    ``delta``: the optional multi-adapter LoRA shift (see
+    ``_prefill_decoders``) applied to the suffix stream at layer entry —
+    bit-identical to the full-prefill path's suffix stream, because the
+    pooled prefix KV it reuses was itself produced under the SAME
+    adapter's shift (the KV pool keys fold in the adapter id).
     """
     stacked, flags, rflags = seg["layers"], seg["sliding"], seg.get("rope")
+    xs_in = (
+        (stacked, flags, rflags, kv_p["kp"], kv_p["vp"])
+        if delta is None
+        else (
+            stacked, flags, rflags, kv_p["kp"], kv_p["vp"],
+            delta["A"], delta["B"],
+        )
+    )
 
     def body(s, xs):
-        layer_params, sliding, rope_on, kp_l, vp_l = xs
+        if delta is None:
+            layer_params, sliding, rope_on, kp_l, vp_l = xs
+        else:
+            layer_params, sliding, rope_on, kp_l, vp_l, d_a, d_b = xs
+            s = lora_shift(s, d_a, d_b, delta["g"], delta["scale"])
 
         def one_layer(lp_, c_, kp_, vp_, s_, plen_, tlen_):
             return llama.suffix_only_layer(
@@ -140,9 +174,7 @@ def _suffix_prefill_decoders(
         s, kv_s = step(layer_params, cfg, kp_l, vp_l, s, prefix_len, total_len)
         return s, kv_s
 
-    suffix_h, kv_s = jax.lax.scan(
-        body, suffix_h, (stacked, flags, rflags, kv_p["kp"], kv_p["vp"])
-    )
+    suffix_h, kv_s = jax.lax.scan(body, suffix_h, xs_in)
     return suffix_h, kv_s
 
 
@@ -158,6 +190,7 @@ def _decode_decoders_impl(
     t,
     gen_only: bool = False,
     t_in_axis=None,
+    delta=None,
 ):
     """Scan k layers' decode over a block (K newest tokens per suffix).
 
@@ -170,11 +203,22 @@ def _decode_decoders_impl(
     ``gen_only`` (static) returns only the mutated {'kg','vg'} leaves as the
     scan's stacked output — the fused step path uses it so the read-only
     prefix/suffix KV is never re-materialised by the layer scan.
+    ``delta``: the optional multi-adapter LoRA shift (see
+    ``_prefill_decoders``) applied to ``x`` at each layer's entry.
     """
     stacked, flags, rflags = seg["layers"], seg["sliding"], seg.get("rope")
+    xs_in = (
+        (stacked, flags, rflags, kv)
+        if delta is None
+        else (stacked, flags, rflags, kv, delta["A"], delta["B"])
+    )
 
     def body(x, layer):
-        layer_params, sliding, rope_on, layer_kv = layer
+        if delta is None:
+            layer_params, sliding, rope_on, layer_kv = layer
+        else:
+            layer_params, sliding, rope_on, layer_kv, d_a, d_b = layer
+            x = lora_shift(x, d_a, d_b, delta["g"], delta["scale"])
         step = jax.vmap(
             partial(
                 llama.decode_step_layer,
@@ -190,7 +234,7 @@ def _decode_decoders_impl(
             layer_kv = {"kg": layer_kv["kg"], "vg": layer_kv["vg"]}
         return x, layer_kv
 
-    x, kv = jax.lax.scan(body, x, (stacked, flags, rflags, kv))
+    x, kv = jax.lax.scan(body, x, xs_in)
     return x, kv
 
 
@@ -281,7 +325,10 @@ def _fused_decode_steps(
 
 
 @partial(jax.jit, static_argnums=(0, 1), donate_argnums=(3, 4))
-def _spec_decoders(cfg: LlamaConfig, tp_mesh, seg, kv, x, prefix_len, suffix_eos, base):
+def _spec_decoders(
+    cfg: LlamaConfig, tp_mesh, seg, kv, x, prefix_len, suffix_eos, base,
+    delta=None,
+):
     """Scan k layers' K-token speculative verify step over a block.
 
     x [B, S, K, D] — the last accepted token plus K-1 drafts per suffix;
@@ -293,7 +340,7 @@ def _spec_decoders(cfg: LlamaConfig, tp_mesh, seg, kv, x, prefix_len, suffix_eos
     """
     return _decode_decoders_impl(
         cfg, False, tp_mesh, seg, kv, x, prefix_len, suffix_eos, base,
-        t_in_axis=0,
+        t_in_axis=0, delta=delta,
     )
 
 

@@ -211,20 +211,29 @@ class KVPagePool:
     # -- lease lifecycle ---------------------------------------------------
 
     def acquire(self, ids: tuple, prefix_len: int,
-                lp_bucket: int) -> PrefixHandle:
+                lp_bucket: int, salt=None) -> PrefixHandle:
         """Lease the trie path for one tokenized prefix. Creates missing
         nodes (the contribute path fills their pages) and refcounts every
         node; ``reusable`` when an earlier prefill sealed this exact
         (tokens, bucket) leaf — the caller then assembles instead of
-        prefilling."""
+        prefilling. ``salt`` (hashable, default None) forks the whole
+        trie path without touching chunk arithmetic: it wraps only the
+        FIRST chunk's key, so every descendant node hangs under a
+        salt-private subtree. The engine salts with the adapter id —
+        the same prefix under a different LoRA adapter is different KV
+        and must never cross-share pages. ``salt=None`` leaves keys
+        bit-identical to the unsalted pool."""
         with self._lock:
             if prefix_len <= 0 or self.page_tokens <= 0:
                 return PrefixHandle(self, [], prefix_len, lp_bucket,
                                     False, set())
             path = []
             node = self._root
-            for key, span in _chunk_keys(tuple(ids), prefix_len,
-                                         lp_bucket, self.page_tokens):
+            for ci, (key, span) in enumerate(
+                    _chunk_keys(tuple(ids), prefix_len,
+                                lp_bucket, self.page_tokens)):
+                if salt is not None and ci == 0:
+                    key = ("salted", salt, key)
                 child = node.children.get(key)
                 if child is None:
                     child = _Node(key, node, span)

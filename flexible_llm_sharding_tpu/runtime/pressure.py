@@ -34,6 +34,9 @@ covers replica death; this module covers **sustained resource pressure**
   1. shrink the host shard cache (``hostcache.apply_pressure_cap``:
      LRU-evicts down to ``cache_shrink_frac`` of the budget and pins a
      cap so auto re-resolution cannot grow it back mid-brownout);
+     then the LoRA adapter store the same way
+     (``adapters.loader.apply_pressure_cap`` — evicted deltas reload in
+     one checksummed read), then pooled prefix-KV pages;
   2. evict device residency pins back to streaming
      (``DeviceResidencyTier.pressure_unpin``: future sources stream
      everything; live sources keep their frozen structure);
@@ -254,11 +257,17 @@ class BrownoutController:
     """
 
     # Ladder levels above 0 (normal), in engage order.
-    # kv_evict sits between the shard-cache shrink (gentlest: cached
-    # shards re-read from disk) and pin eviction: pooled prefix-KV pages
+    # adapter_evict sits right after the shard-cache shrink: evicted
+    # LoRA deltas reload from disk in one checksummed read (cheapest
+    # give-back after clean shard-cache bytes), and the cap latch keeps
+    # later store resolutions from growing back mid-brownout.
+    # kv_evict sits between it and pin eviction: pooled prefix-KV pages
     # spill to checksummed disk (or drop and re-prefill) — cheaper to
     # give back than pinned weights, dearer than a clean shard cache.
-    LADDER = ("cache_shrink", "kv_evict", "pin_evict", "shed", "replica_drain")
+    LADDER = (
+        "cache_shrink", "adapter_evict", "kv_evict", "pin_evict", "shed",
+        "replica_drain",
+    )
 
     def __init__(self, cfg):
         self.cfg = cfg
@@ -270,12 +279,14 @@ class BrownoutController:
         self._queues: list = []  # guarded by: _lock
         self._fleet = None  # guarded by: _lock
         self._saved_cache_budget: int | None = None
+        self._saved_adapter_budget: int | None = None
         self._last: PressureSnapshot = PressureSnapshot()
         # Counters (all exported via stats(); COUNTER-EXPORT audited).
         self.steps_up = 0
         self.steps_down = 0
         self.sheds = 0
         self.cache_shrinks = 0
+        self.adapter_evictions = 0
         self.kv_evictions = 0
         self.pin_evictions = 0
         self.replica_drains = 0
@@ -424,6 +435,16 @@ class BrownoutController:
                     with self._lock:
                         self._saved_cache_budget = prev
                         self.cache_shrinks += 1
+            elif stage == "adapter_evict":
+                from flexible_llm_sharding_tpu.adapters import loader
+
+                prev = loader.apply_pressure_cap(
+                    self.pcfg.cache_shrink_frac
+                )
+                if prev is not None:
+                    with self._lock:
+                        self._saved_adapter_budget = prev
+                        self.adapter_evictions += 1
             elif stage == "kv_evict":
                 from flexible_llm_sharding_tpu.runtime import kvpool
 
@@ -468,6 +489,13 @@ class BrownoutController:
                     restore = self._saved_cache_budget
                     self._saved_cache_budget = None
                 hostcache.lift_pressure_cap(restore)
+            elif stage == "adapter_evict":
+                from flexible_llm_sharding_tpu.adapters import loader
+
+                with self._lock:
+                    restore = self._saved_adapter_budget
+                    self._saved_adapter_budget = None
+                loader.lift_pressure_cap(restore)
             elif stage == "kv_evict":
                 from flexible_llm_sharding_tpu.runtime import kvpool
 
@@ -506,6 +534,7 @@ class BrownoutController:
                 "steps_down": self.steps_down,
                 "sheds": self.sheds,
                 "cache_shrinks": self.cache_shrinks,
+                "adapter_evictions": self.adapter_evictions,
                 "kv_evictions": self.kv_evictions,
                 "pin_evictions": self.pin_evictions,
                 "replica_drains": self.replica_drains,

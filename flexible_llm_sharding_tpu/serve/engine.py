@@ -49,6 +49,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from flexible_llm_sharding_tpu.adapters import apply as adapter_apply
+from flexible_llm_sharding_tpu.adapters.registry import (
+    AdapterCorruptError,
+    AdapterNotFound,
+)
 from flexible_llm_sharding_tpu.config import (
     FrameworkConfig,
     LlamaConfig,
@@ -140,6 +145,22 @@ class _WaveState:
     # suffix-only scan over assembled pages).
     pool_handles: dict[int, Any] = dataclasses.field(default_factory=dict)
     reuse_blocks: set[int] = dataclasses.field(default_factory=set)
+    # Multi-tenant LoRA (adapters/): wave-level row grouping fixed at
+    # init. ``adapter_scales`` is None for a base-only wave — the delta
+    # kwarg then stays None at every decoder jit call, keeping the
+    # traced computation byte-identical to pre-adapter serving.
+    # ``adapter_ab`` caches the [k, G, D, R]/[k, G, R, D] device factor
+    # stacks per (shard_pos, decoder-segment) — built on first touch,
+    # reused by every later sweep of this wave, so delta bytes cross
+    # the host->HBM link once per wave, not once per sweep.
+    adapter_names: list = dataclasses.field(default_factory=list)
+    adapter_scales: Any = None            # [G] f32 host; None = base-only
+    adapter_factors: dict = dataclasses.field(default_factory=dict)
+    adapter_rank: int = 0                 # wave max rank (zero-pad target)
+    adapter_g: dict = dataclasses.field(default_factory=dict)  # b -> [B] i32
+    adapter_ab: dict = dataclasses.field(default_factory=dict)
+    adapter_gdev: dict = dataclasses.field(default_factory=dict)
+    adapter_scale_dev: Any = None
 
 
 class ServeEngine:
@@ -343,6 +364,21 @@ class ServeEngine:
                 "kvpool", kvpool.process_stats,
                 mirror=False,  # process-level: pool_for registers it
             )
+        # Multi-tenant LoRA adapters (adapters/, docs/adapters.md): the
+        # process-wide host-resident delta store — None when
+        # --adapter_dir is unset. Requests carry an adapter_id; waves
+        # group rows by adapter and the decoder scans apply the grouped
+        # low-rank shift at each layer entry, so N tenants' fine-tunes
+        # decode in one sweep over ONE base-model stream.
+        from flexible_llm_sharding_tpu.adapters import loader as adapter_loader
+
+        self._adapter_store = adapter_loader.store_for(cfg)
+        if self._adapter_store is not None:
+            self._adapter_store.injector = self._injector
+            self.metrics.register(
+                "adapter", self._adapter_store.stats,
+                mirror=False,  # process-level: store_for registers it
+            )
         self.batcher = ShardAwareBatcher(
             self.queue,
             self.serve_cfg.max_wave_requests,
@@ -401,6 +437,7 @@ class ServeEngine:
         callback: Callable[[Request], Any] | None = None,
         slo_class: str | None = None,
         tenant_id: str | None = None,
+        adapter_id: str | None = None,
     ) -> Request:
         """Enqueue one request (any thread). Backpressure/closed/deadline
         outcomes surface through the returned request's future; an
@@ -428,6 +465,7 @@ class ServeEngine:
             callback=callback,
             slo_class=slo,
             tenant_id=tenant_id if tenant_id is not None else "default",
+            adapter_id=adapter_id,
         )
         return self.submit_request(req)
 
@@ -849,6 +887,129 @@ class ServeEngine:
             )
         return tp
 
+    # -- multi-tenant LoRA adapters (adapters/) ----------------------------
+
+    def _entry_adapter(self, entry) -> str | None:
+        """The entry's adapter id (None = base). Coalescing folds the
+        adapter into its key (serve/sched/coalesce.py), so an entry's
+        members always agree."""
+        return getattr(entry.requests[0], "adapter_id", None)
+
+    def _resolve_adapters(self, wave):
+        """Resolve every entry's adapter at wave init (host side, before
+        tokenization): ``(ok, plans, factors)`` keyed by adapter name.
+        An unknown or corrupt adapter fails ONLY its own entry's
+        requests — typed (AdapterNotFound / AdapterCorruptError,
+        non-retried: the loader already exhausted its re-reads) — and
+        the entry drops from the wave; the base and every other tenant
+        in the same wave are untouched. ``ok`` False means no entries
+        survived (the wave was removed; re-check the queue)."""
+        entries = wave.ensure_entries()
+        plans: dict[str, Any] = {}
+        factors: dict[str, Any] = {}
+        keep: list = []
+        for e in entries:
+            aid = self._entry_adapter(e)
+            if aid is not None and aid not in plans:
+                try:
+                    if self._adapter_store is None:
+                        raise AdapterNotFound(
+                            f"adapter {aid!r} requested but adapter "
+                            "serving is off — start with --adapter_dir"
+                        )
+                    plan, fac = self._adapter_store.get(aid)
+                    if plan.hidden_size != self.model_cfg.hidden_size:
+                        raise AdapterCorruptError(
+                            f"adapter {aid!r} was built for hidden_size="
+                            f"{plan.hidden_size}; this model has "
+                            f"{self.model_cfg.hidden_size}"
+                        )
+                except (AdapterNotFound, ShardLoadError, OSError) as err:
+                    # AdapterCorruptError is a ShardLoadError; a stray
+                    # filesystem error resolving one tenant's delta must
+                    # likewise fail only that tenant, never the wave.
+                    for r in e.requests:
+                        if not r.status.terminal and r.fail(
+                            err, RequestStatus.FAILED
+                        ):
+                            self.metrics.count("failed")
+                    self.metrics.count("adapter_rejects")
+                    obs_trace.instant(
+                        "adapter_reject", cat="adapter",
+                        wave_id=wave.wave_id, adapter=aid,
+                        error=type(err).__name__,
+                    )
+                    obs_events.emit(
+                        "adapter_reject", adapter=aid,
+                        error=type(err).__name__, detail=str(err)[:200],
+                        request_ids=[r.request_id for r in e.requests],
+                    )
+                    continue
+                plans[aid] = plan
+                factors[aid] = fac
+            keep.append(e)
+        if len(keep) != len(entries):
+            wave.entries = keep
+            wave.requests = [r for e in keep for r in e.requests]
+            if not keep:
+                self.batcher.waves.remove(wave)
+                return False, plans, factors
+        return True, plans, factors
+
+    def _shard_decoder_layers(self, layer_idxs) -> list[str]:
+        """The shard's decoder layer names in stream order — consumed
+        k-at-a-time by the shard's decoder segments to pick which
+        adapters' per-layer factors each segment stacks."""
+        return [
+            self.layer_names[i]
+            for i in layer_idxs
+            if self.layer_names[i].startswith("model.layers.")
+        ]
+
+    def _segment_delta(self, st, shard_pos, di, seg_layers, b, act_dev):
+        """The delta pytree one decoder-segment jit call takes for block
+        ``b`` — None for a base-only wave (the zero-adapter fast path:
+        no stacking, no transfer, identical trace). The [k, G, D, R]
+        factor stacks are built and device_put ONCE per (shard,
+        segment) and cached on the wave; only then do their bytes count
+        against ``fls_adapter_delta_bytes`` — the link charge the bench
+        ratios against the base stream."""
+        if st.adapter_scales is None:
+            return None
+        key = (shard_pos, di)
+        ab = st.adapter_ab.get(key)
+        if ab is None:
+            stacks = [
+                adapter_apply.stack_layer(
+                    st.adapter_names, st.adapter_factors, lname,
+                    self.model_cfg.hidden_size, st.adapter_rank,
+                )
+                for lname in seg_layers
+            ]
+            a_np = np.stack([s[0] for s in stacks])
+            b_np = np.stack([s[1] for s in stacks])
+            ab = {
+                "A": jax.device_put(a_np, act_dev),
+                "B": jax.device_put(b_np, act_dev),
+            }
+            st.adapter_ab[key] = ab
+            if self._adapter_store is not None:
+                self._adapter_store.note_applied(
+                    0, int(a_np.nbytes) + int(b_np.nbytes)
+                )
+        g = st.adapter_gdev.get(b)
+        if g is None:
+            g = jax.device_put(st.adapter_g[b], act_dev)
+            st.adapter_gdev[b] = g
+        if st.adapter_scale_dev is None:
+            st.adapter_scale_dev = jax.device_put(
+                st.adapter_scales, act_dev
+            )
+        return {
+            "A": ab["A"], "B": ab["B"],
+            "g": g, "scale": st.adapter_scale_dev,
+        }
+
     # -- sweep-boundary preemption (serve/sched) ---------------------------
 
     def _maybe_preempt(self) -> None:
@@ -936,6 +1097,12 @@ class ServeEngine:
         per prefix-coalesced group) and allocate wave state. A bad
         workload (e.g. a longrope regime straddle) fails ONLY this
         wave's requests; the engine keeps serving."""
+        # Adapter resolution first (host side): a missing/corrupt
+        # adapter fails ONLY its own entry's requests; the survivors
+        # proceed as one wave.
+        ok, a_plans, a_factors = self._resolve_adapters(wave)
+        if not ok:
+            return False
         entries = wave.ensure_entries()
         # Speculative waves only where there is decode to amortize: a
         # wave whose whole budget is the prefill pick never drafts.
@@ -1001,7 +1168,11 @@ class ServeEngine:
                         int(t) for t in tp.prefix_ids[: tp.prefix_len]
                     )
                     pool_handles[i] = self._kv_pool.acquire(
-                        ids, int(tp.prefix_len), int(tp.prefix_ids.shape[0])
+                        ids, int(tp.prefix_len), int(tp.prefix_ids.shape[0]),
+                        # Same prefix under a different LoRA adapter is
+                        # different KV — the salt forks the trie so
+                        # cross-adapter waves never share pages.
+                        salt=self._entry_adapter(entries[i]),
                     )
                 for b, idxs in enumerate(blocks):
                     if idxs and all(pool_handles[i].reusable for i in idxs):
@@ -1034,6 +1205,32 @@ class ServeEngine:
                 self.model_cfg, self.cfg.dtype, toks, blocks, slots,
                 self._resident, device=self.device,
             )
+            # Multi-tenant LoRA grouping: ONE wave-level (names, g) so a
+            # single [G] scale vector and one stacked factor set serve
+            # every block. Base-only waves keep adapter state None.
+            a_names: list = []
+            a_scales = None
+            a_rank = 0
+            a_g: dict[int, np.ndarray] = {}
+            if a_plans:
+                a_names, g_all = adapter_apply.group_rows(
+                    [self._entry_adapter(e) for e in entries]
+                )
+                a_scales = adapter_apply.group_scales(a_names, a_plans)
+                a_rank = max(
+                    max((r for _, r in a_plans[n].layers), default=1)
+                    for n in a_names
+                    if n is not None
+                )
+                a_g = {
+                    b: g_all[np.asarray(idxs, np.int64)]
+                    for b, idxs in enumerate(blocks)
+                }
+                obs_trace.instant(
+                    "adapter_apply", cat="adapter", wave_id=wave.wave_id,
+                    adapters=[n for n in a_names if n is not None],
+                    rows=int((g_all != 0).sum()),
+                )
             wave.state = _WaveState(
                 toks=toks,
                 blocks=blocks,
@@ -1045,6 +1242,11 @@ class ServeEngine:
                 slots=slots,
                 pool_handles=pool_handles,
                 reuse_blocks=reuse_blocks,
+                adapter_names=a_names,
+                adapter_scales=a_scales,
+                adapter_factors=a_factors,
+                adapter_rank=a_rank,
+                adapter_g=a_g,
             )
             return True
         except (
@@ -1152,6 +1354,11 @@ class ServeEngine:
     def _prefill_shard(self, wave, shard_pos, layer_idxs, segments) -> None:
         st: _WaveState = wave.state
         act_dev = self._act_dev()
+        dec_names = (
+            self._shard_decoder_layers(layer_idxs)
+            if st.adapter_scales is not None
+            else ()
+        )
         for b in range(len(st.blocks)):
             prefix_ids, suffix_ids, prefix_len, suffix_eos = st.meta[b]
             # Pool-reuse block: every row leases a SEALED same-prefix pool
@@ -1169,6 +1376,7 @@ class ServeEngine:
             else:
                 ph, sh = st.kv_store.get(("h", b), act_dev)
             di = 0
+            dec_off = 0
             for kind, params in segments:
                 if kind == "embed":
                     if reuse:
@@ -1184,6 +1392,15 @@ class ServeEngine:
                             prefix_ids, suffix_ids,
                         )
                 elif kind == "decoders":
+                    if st.adapter_scales is not None:
+                        k = jax.tree_util.tree_leaves(params)[0].shape[0]
+                        delta = self._segment_delta(
+                            st, shard_pos, di,
+                            dec_names[dec_off:dec_off + k], b, act_dev,
+                        )
+                        dec_off += k
+                    else:
+                        delta = None
                     if reuse:
                         rows_k, rows_v = [], []
                         for i in st.blocks[b]:
@@ -1201,6 +1418,7 @@ class ServeEngine:
                         sh, kv_s = _suffix_prefill_decoders(
                             self.model_cfg, self._use_pallas, None, params,
                             {"kp": kp, "vp": vp}, sh, prefix_len, total_len,
+                            delta=delta,
                         )
                         kv = {
                             "kp": kp, "vp": vp,
@@ -1210,7 +1428,7 @@ class ServeEngine:
                     else:
                         ph, sh, kv = _prefill_decoders(
                             self.model_cfg, self._use_pallas, None, params,
-                            ph, sh, prefix_len, total_len,
+                            ph, sh, prefix_len, total_len, delta=delta,
                         )
                         if self._kv_pool is not None and st.pool_handles:
                             # Bank this segment's prefix KV into the pool
@@ -1248,6 +1466,11 @@ class ServeEngine:
     def _decode_shard(self, wave, shard_pos, layer_idxs, segments) -> None:
         st: _WaveState = wave.state
         act_dev = self._act_dev()
+        dec_names = (
+            self._shard_decoder_layers(layer_idxs)
+            if st.adapter_scales is not None
+            else ()
+        )
         t = jnp.int32(wave.steps - 1)  # this step's generated-KV slot
         for b in range(len(st.blocks)):
             # Blocks whose every request already resolved sit the sweep out
@@ -1269,6 +1492,7 @@ class ServeEngine:
                 else st.kv_store.get(("x", b), act_dev)
             )
             di = 0
+            dec_off = 0
             for kind, params in segments:
                 if kind == "embed":
                     x = llama.embed(
@@ -1280,10 +1504,19 @@ class ServeEngine:
                         self.model_cfg,
                     )
                 elif kind == "decoders":
+                    if st.adapter_scales is not None:
+                        k = jax.tree_util.tree_leaves(params)[0].shape[0]
+                        delta = self._segment_delta(
+                            st, shard_pos, di,
+                            dec_names[dec_off:dec_off + k], b, act_dev,
+                        )
+                        dec_off += k
+                    else:
+                        delta = None
                     kv = st.kv_store.get(("kv", shard_pos, di, b), act_dev)
                     x, kv = _decode_decoders(
                         self.model_cfg, self._use_pallas, None, params,
-                        kv, x, prefix_len, suffix_eos, t,
+                        kv, x, prefix_len, suffix_eos, t, delta=delta,
                     )
                     st.kv_store.put(("kv", shard_pos, di, b), kv)
                     di += 1
@@ -1352,6 +1585,11 @@ class ServeEngine:
         other waves' prefill/decode segments ride."""
         st: _WaveState = wave.state
         act_dev = self._act_dev()
+        dec_names = (
+            self._shard_decoder_layers(layer_idxs)
+            if st.adapter_scales is not None
+            else ()
+        )
         for b in range(len(st.blocks)):
             v = st.spec[b]
             # Finished blocks sit the sweep out: every suffix at budget,
@@ -1369,6 +1607,7 @@ class ServeEngine:
                 else st.kv_store.get(("x", b), act_dev)
             )
             di = 0
+            dec_off = 0
             for kind, params in segments:
                 if kind == "embed":
                     # Drafts are fixed per pass BEFORE the sweep's
@@ -1390,11 +1629,20 @@ class ServeEngine:
                         self.model_cfg,
                     )
                 elif kind == "decoders":
+                    if st.adapter_scales is not None:
+                        k = jax.tree_util.tree_leaves(params)[0].shape[0]
+                        delta = self._segment_delta(
+                            st, shard_pos, di,
+                            dec_names[dec_off:dec_off + k], b, act_dev,
+                        )
+                        dec_off += k
+                    else:
+                        delta = None
                     kv = st.kv_store.get(("kv", shard_pos, di, b), act_dev)
                     x, kv = _spec_decoders(
                         self.model_cfg, None, params, kv, x,
                         prefix_len, suffix_eos,
-                        jnp.asarray(st.spec_base[b]),
+                        jnp.asarray(st.spec_base[b]), delta=delta,
                     )
                     st.kv_store.put(("kv", shard_pos, di, b), kv)
                     di += 1
@@ -1459,6 +1707,18 @@ class ServeEngine:
                     # next sweep for this wave is a draft+verify pass.
                     self._init_spec(wave)
             st = wave.state
+            if (
+                self._adapter_store is not None
+                and st is not None
+                and st.adapter_scales is not None
+            ):
+                # Per-sweep charge: how many of this wave's batch rows
+                # decoded under an adapter delta this sweep.
+                rows = sum(
+                    int((g != 0).sum()) for g in st.adapter_g.values()
+                )
+                if rows:
+                    self._adapter_store.note_applied(rows, 0)
             for r in wave.requests:
                 if r.status.terminal:
                     continue

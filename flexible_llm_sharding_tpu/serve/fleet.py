@@ -586,14 +586,17 @@ class ReplicaFleet:
         callback=None,
         slo_class: str | None = None,
         tenant_id: str | None = None,
+        adapter_id: str | None = None,
     ) -> Request:
         """Enqueue one request (any thread) — the ``ServeEngine.submit``
         surface. The returned request's future resolves from whichever
         replica ultimately serves it; a mid-flight replica death is
-        invisible to the caller beyond latency. SLO class/tenant ride
-        every attempt: the replica's own scheduler fair-queues and may
-        preempt for them, and the router biases interactive dispatch
-        toward the replica nearest its shard-0 boundary."""
+        invisible to the caller beyond latency. SLO class/tenant and the
+        LoRA ``adapter_id`` ride every attempt: the replica's own
+        scheduler fair-queues and may preempt for them, the router
+        biases interactive dispatch toward the replica nearest its
+        shard-0 boundary, and every replica resolves the adapter from
+        the shared process store."""
         slo = sched_classes.parse_class(slo_class)
         if deadline_s is None:
             deadline_s = sched_classes.class_deadline_s(
@@ -617,6 +620,7 @@ class ReplicaFleet:
             callback=callback,
             slo_class=slo,
             tenant_id=tenant_id if tenant_id is not None else "default",
+            adapter_id=adapter_id,
         )
         if req.max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
@@ -694,6 +698,7 @@ class ReplicaFleet:
                         shed_exempt=redispatch,
                         slo_class=outer.slo_class,
                         tenant_id=outer.tenant_id,
+                        adapter_id=outer.adapter_id,
                     )
                     disp.inner = inner
                     disp.replica = replica

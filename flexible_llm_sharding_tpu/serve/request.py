@@ -206,6 +206,13 @@ class Request:
     # sweep-boundary preemption (docs/scheduling.md).
     slo_class: str = "standard"
     tenant_id: str = "default"
+    # Multi-tenant LoRA serving (adapters/): the named adapter whose
+    # low-rank delta this request decodes under, or None for the base
+    # model. Resolved at wave assembly (unknown/corrupt adapters fail
+    # ONLY this request, typed); folds into the prefix-coalesce key and
+    # the prefix-KV pool key — same text under different adapters is
+    # different math, so neither dedup may merge across adapters.
+    adapter_id: str | None = None
     # Preemption resume state (engine-owned, serve/sched): per decode
     # step already served before a sweep-boundary preemption, the
     # [n_suffixes, vocab] score slice and [n_suffixes] picked-token ids.

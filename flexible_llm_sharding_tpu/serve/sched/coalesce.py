@@ -40,7 +40,14 @@ def build_entries(requests, key_fn) -> list[WaveEntry]:
             key = ("resume", i)  # entry-private: suffixes get extended
         else:
             try:
-                key = ("prefix", key_fn(r.prefix))
+                # Same text under different LoRA adapters is different
+                # math — the adapter id is part of the coalesce key, so
+                # cross-adapter requests never share one prefill.
+                key = (
+                    "prefix",
+                    getattr(r, "adapter_id", None),
+                    key_fn(r.prefix),
+                )
             except Exception:  # flscheck: disable=EXC-TAXONOMY: a key-fn (tokenizer) failure must degrade to no-coalescing — the wave-init taxonomy still rejects a genuinely malformed request with full context
                 key = ("solo", i)
         if key not in groups:

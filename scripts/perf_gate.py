@@ -108,6 +108,16 @@ FLOOR_RULES = {
     # The pool disengaging collapses it to 0.0, which no runner noise
     # can fake — so this gates hard, the pinned_fraction precedent.
     "kv_prefix_reuse_frac": 0.95,
+    # Multi-tenant LoRA serving (ISSUE 17): base-only wall / adapters-on
+    # wall on the identical two-tenants-plus-base workload (warm passes;
+    # base-row token-identity and nonzero applied delta rows asserted by
+    # the phase before recording). Advisory: the healthy value IS parity
+    # — the deltas ride the existing sweep — so a hard floor near 1.0
+    # would flake on runner noise, while the structural claim (delta
+    # bytes a rank-sized sliver of the streamed base bytes) is asserted
+    # as a hard <0.05 ceiling inside the bench phase itself, because the
+    # healthy fraction (~1e-4) rounds any recorded-value floor to zero.
+    "adapter_overhead_ratio": 0.85,
 }
 
 # Ratios whose loss-of-mechanism signature is "collapses to parity": the
@@ -137,6 +147,7 @@ ADVISORY = {
     "trace_overhead_ratio",
     "recorder_overhead_ratio",
     "spec_mechanism_speedup",
+    "adapter_overhead_ratio",
 }
 
 # Hard metrics with a sub-parity WARN band: the hard floor derives from
@@ -173,6 +184,7 @@ def measure() -> dict:
     import bench
     from bench import (
         BenchTokenizer,
+        bench_adapters,
         bench_host_cache,
         bench_host_stream,
         bench_kv_reuse,
@@ -233,6 +245,9 @@ def measure() -> dict:
     # Paged prefix-KV pool (ISSUE 16): small token budget — the gate
     # needs cross-wave reuse witnessed, not a throughput measurement.
     bench_kv_reuse(fw(None), tok, result, budget, n_tok=4)
+    # Multi-tenant LoRA (ISSUE 17): small token budget — the gate needs
+    # parity + rank-sized delta bytes witnessed, not a full measurement.
+    bench_adapters(fw(None), tok, result, budget, n_tok=4)
     result["gate_wall_s"] = round(time.perf_counter() - t0, 1)
     return result
 

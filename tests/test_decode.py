@@ -534,6 +534,7 @@ def test_decode_fused_matches_loop_and_oracle(tiny_cfg, model):
         np.testing.assert_allclose(g, w, rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.slow  # heaviest in its file; tier-1 keeps sibling coverage
 def test_decode_fused_multi_segment(tmp_path_factory):
     """A mixed dense/MoE stack (llama4-style) yields SEVERAL decoder
     segments per shard, each with its own KV pytree; the fused program

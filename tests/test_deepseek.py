@@ -12,6 +12,13 @@ import numpy as np
 import pytest
 
 import jax
+
+# The long-context arm rides the sp path (jax.shard_map), which this
+# environment's jax predates; every other deepseek test stays live.
+_needs_shard_map = pytest.mark.skipif(
+    not hasattr(jax, "shard_map"),
+    reason="needs jax.shard_map (newer jax): the sp long-context path calls it",
+)
 import jax.numpy as jnp
 import torch
 
@@ -203,6 +210,7 @@ def test_deepseek_split_and_cli(tmp_path):
             full = np.append(full, int(np.argmax(want)))
 
 
+@_needs_shard_map
 def test_deepseek_long_context(tmp_path):
     """MLA on the sp mesh: the ring prefix assembles q/k/v through
     positioned_qkv per chunk (global positions keep the shared rope key's
@@ -315,6 +323,7 @@ def test_deepseek_speculative_decode(tmp_path):
         np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
 
 
+@pytest.mark.slow  # heaviest in its file; tier-1 keeps sibling coverage
 def test_deepseek_streamed_training():
     """The layer-streamed trainer backprops through the MLA assembly and
     DeepSeek MoE exactly like the monolithic train step. Dedicated rng

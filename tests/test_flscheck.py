@@ -1176,6 +1176,20 @@ class _StubInitEngine:
         self._sched = None  # scheduler off: the FIFO/parity path
         self._spec_k = 0  # speculation off: the plain decode path
         self._kv_pool = None  # pool off: the analytic-accounting path
+        self._adapter_store = None  # adapters off: base-only resolution
+
+    # The real resolution methods: _init_wave's adapter gate must run
+    # the way a live engine runs it (all-base here, so it's a pass-through
+    # to the tokenization failure under test).
+    def _entry_adapter(self, entry):
+        from flexible_llm_sharding_tpu.serve.engine import ServeEngine
+
+        return ServeEngine._entry_adapter(self, entry)
+
+    def _resolve_adapters(self, wave):
+        from flexible_llm_sharding_tpu.serve.engine import ServeEngine
+
+        return ServeEngine._resolve_adapters(self, wave)
 
     def tokenizer(self, prefix, suffixes):
         raise self._exc

@@ -1303,6 +1303,7 @@ def test_executor_end_to_end(cfg, rng, tmp_path):
             np.testing.assert_allclose(scores[s, 0], want, rtol=2e-4, atol=2e-5)
 
 
+@pytest.mark.slow  # heaviest in its file; tier-1 keeps sibling coverage
 @pytest.mark.parametrize("mode", ["mp", "dp"])
 def test_llama4_multichip(tmp_path, mode):
     """Llama4's mixed-structure stacks through the multi-chip orchestration:

@@ -242,36 +242,39 @@ def test_ladder_walks_up_engages_in_order_and_reverses(model_dir):
     assert cache.budget_bytes < before  # cache shrunk
     assert not q.shedding
     ctrl.on_sample(_pressured())
-    assert ctrl.level == 2  # kv evict (no pool live: position still taken)
+    assert ctrl.level == 2  # adapter evict (no store live: position taken)
     assert not q.shedding
     ctrl.on_sample(_pressured())
-    assert ctrl.level == 3  # pin evict (no tier live: position still taken)
+    assert ctrl.level == 3  # kv evict (no pool live: position still taken)
     assert not q.shedding
     ctrl.on_sample(_pressured())
-    assert ctrl.level == 4 and q.shedding
+    assert ctrl.level == 4  # pin evict (no tier live: position still taken)
+    assert not q.shedding
+    ctrl.on_sample(_pressured())
+    assert ctrl.level == 5 and q.shedding
     assert q.retry_after == ctrl.pcfg.shed_retry_after_s
     ctrl.on_sample(_pressured())
-    assert ctrl.level == 5 and fleet.drained == 1
+    assert ctrl.level == 6 and fleet.drained == 1
     # Holding at max: further pressure doesn't overflow the ladder.
     ctrl.on_sample(_pressured())
-    assert ctrl.level == 5
+    assert ctrl.level == 6
 
     # Reversal: step_down_polls clean polls per level, reverse order.
     clean = PressureSnapshot()
     for _ in range(ctrl.pcfg.step_down_polls):
         ctrl.on_sample(clean)
-    assert ctrl.level == 4 and fleet.restored == 1
-    assert q.shedding  # shed still engaged at level 4
+    assert ctrl.level == 5 and fleet.restored == 1
+    assert q.shedding  # shed still engaged at level 5
     for _ in range(ctrl.pcfg.step_down_polls):
         ctrl.on_sample(clean)
-    assert ctrl.level == 3 and not q.shedding
-    for _ in range(3 * ctrl.pcfg.step_down_polls):
+    assert ctrl.level == 4 and not q.shedding
+    for _ in range(4 * ctrl.pcfg.step_down_polls):
         ctrl.on_sample(clean)
     assert ctrl.level == 0
     assert cache.budget_bytes == before  # budget restored
     assert hostcache.pressure_cap() is None
     stats = ctrl.stats()
-    assert stats["steps_up"] == 5 and stats["steps_down"] == 5
+    assert stats["steps_up"] == 6 and stats["steps_down"] == 6
     assert stats["cache_shrinks"] == 1
 
 
@@ -286,7 +289,7 @@ def test_hard_event_jumps_straight_to_shed_level(model_dir):
     assert q.shedding
     assert ctrl.stats()["host_oom_events"] == 1
     # The jump engaged the skipped levels too (counted as steps).
-    assert ctrl.stats()["steps_up"] == 4
+    assert ctrl.stats()["steps_up"] == 5
 
 
 def test_queue_attached_mid_brownout_sheds_immediately(model_dir):
@@ -682,8 +685,8 @@ def test_fleet_pressure_drain_and_restore(model_dir):
         cfg = _fw(model_dir, pressure=_pcfg(step_down_polls=1))
         ctrl = BrownoutController(cfg)
         ctrl.attach_fleet(fleet)
-        # Walk to the drain level (5 pressured polls).
-        for _ in range(5):
+        # Walk to the drain level (6 pressured polls).
+        for _ in range(6):
             ctrl.on_sample(_pressured())
         deadline = time.monotonic() + 60
         while time.monotonic() < deadline and len(fleet.replicas) > 1:
@@ -691,7 +694,7 @@ def test_fleet_pressure_drain_and_restore(model_dir):
         assert len(fleet.replicas) == 1
         assert ctrl.stats()["replica_drains"] == 2
         # Clean polls all the way down: population restored.
-        for _ in range(5):
+        for _ in range(6):
             ctrl.on_sample(PressureSnapshot())
         deadline = time.monotonic() + 60
         while time.monotonic() < deadline and len(fleet.replicas) < 3:

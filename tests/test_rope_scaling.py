@@ -288,6 +288,7 @@ def test_longrope_regime_guard(tiny_cfg):
         check_longrope_regime(cfg, [short_prompt], extra_len=7)  # 65: crosses
 
 
+@pytest.mark.slow  # heaviest in its file; tier-1 keeps sibling coverage
 def test_longrope_phi3_split_and_cli(tmp_path):
     """Phi-3 longrope checkpoint end-to-end: HF save_pretrained (fused
     qkv/gate_up + longrope config) -> splitter -> streaming CLI scores vs

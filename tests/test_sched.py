@@ -388,6 +388,7 @@ def test_router_phase_bias_prefers_boundary_proximity():
 # Parity proofs (acceptance criteria)
 # ---------------------------------------------------------------------------
 
+@pytest.mark.slow  # heaviest in its file; tier-1 keeps sibling coverage
 def test_sched_single_tenant_parity_with_fifo_path(model):
     """A single-tenant single-class workload through the scheduler is
     token-identical to the offline oracle (the same pin the FIFO path

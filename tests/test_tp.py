@@ -10,6 +10,13 @@ import pytest
 
 import jax
 
+# The pallas-flash TP paths run under jax.shard_map, which this
+# environment's jax predates; the non-pallas TP tests stay live.
+_needs_shard_map = pytest.mark.skipif(
+    not hasattr(jax, "shard_map"),
+    reason="needs jax.shard_map (newer jax): the pallas TP path runs under it",
+)
+
 from flexible_llm_sharding_tpu.config import FrameworkConfig
 from flexible_llm_sharding_tpu.models import llama
 from flexible_llm_sharding_tpu.parallel.sharding import TpPlacement
@@ -124,6 +131,7 @@ def test_dp_tp_needs_two_groups(model_dir):
         )
 
 
+@pytest.mark.slow  # heaviest in its file; tier-1 keeps sibling coverage
 def test_dp_tp_decode(model_dir):
     """dp x tp KV decode: greedy scores equal the single-device decode."""
     from flexible_llm_sharding_tpu.runtime.orchestration import run_decode
@@ -143,6 +151,7 @@ def test_dp_tp_decode(model_dir):
         np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
 
 
+@_needs_shard_map
 def test_tp_pallas_flash(tmp_path_factory):
     """Flash attention under tensor parallelism: the kernels run per
     head-shard inside a shard_map (pallas_call has no GSPMD rule), and must
@@ -187,6 +196,7 @@ def test_tp_pallas_flash(tmp_path_factory):
         np.testing.assert_allclose(c, a, rtol=2e-5, atol=2e-6)
 
 
+@_needs_shard_map
 def test_tp_pallas_flash_mla(tmp_path_factory):
     """MLA under the TP flash path: since the kernels carry distinct qk/v
     head dims (r4), a DeepSeek-style config is flash-eligible and the
@@ -263,6 +273,7 @@ def _tp_vs_single(model_dir, tol=dict(rtol=1e-5, atol=1e-6), **kw):
         np.testing.assert_allclose(a, b, **tol)
 
 
+@pytest.mark.slow  # heaviest in its file; tier-1 keeps sibling coverage
 def test_tp_llama4_mixed_moe(tmp_path_factory):
     """Llama4 under TP (VERDICT r2 item 7): mixed dense / (shared + routed
     MoE) stacks split into homogeneous scan runs, each run taking its own
@@ -324,6 +335,7 @@ def test_tp_qwen3_moe_dense_interleave(tmp_path_factory):
     _tp_vs_single(d, layer_num_per_shard=2)
 
 
+@_needs_shard_map
 def test_tp_pallas_flash_decode(tmp_path_factory):
     """KV-cache decode with the flash decode kernel under tensor
     parallelism: the kernel runs per head-shard inside a shard_map

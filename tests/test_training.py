@@ -62,6 +62,7 @@ def test_lr_schedule_shape():
     assert float(sched(5)) < float(sched(9))  # warming up
 
 
+@pytest.mark.slow  # heaviest in its file; tier-1 keeps sibling coverage
 def test_checkpoint_roundtrip_continues_training(setup, tmp_path):
     """save at step 2, restore (onto a dp x tp mesh), one more step ==
     3 uninterrupted steps."""
