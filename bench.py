@@ -1251,6 +1251,82 @@ def bench_recorder_overhead(
         _shutil.rmtree(journal_dir, ignore_errors=True)
 
 
+def bench_wal_overhead(
+    result: dict, prompts, tok, budget_left, fw
+) -> None:
+    """Crash-safe serving satellite evidence (docs/recovery.md): the
+    durable request WAL must be (near) free on the serving hot path.
+
+    ``wal_overhead_ratio``: an identical small serve session — admit,
+    prefill, decode, resolve — with the WAL off vs armed to a real
+    directory under the default fsync policy (``admit``: admissions and
+    terminals fsync; sweep-boundary progress records ride the kernel
+    buffers), rotation-paired back-to-back like the trace/recorder
+    phases so disk and scheduler drift cancel. WAL writes happen per
+    request event and per sweep boundary — never per token or per shard
+    — so a healthy serve with the WAL armed must cost noise (~1.0); a
+    sinking ratio means journaling crept onto the per-shard path or the
+    fsync policy silently broadened.
+    """
+    import shutil as _shutil
+
+    from flexible_llm_sharding_tpu.config import ServeConfig
+    from flexible_llm_sharding_tpu.serve import ServeEngine
+
+    wal_dir = os.path.join(BENCH_DIR, "wal_bench")
+
+    def serve_once(base, wdir: str) -> float:
+        engine = ServeEngine(
+            base,
+            ServeConfig(
+                max_wave_requests=4,
+                default_max_new_tokens=4,
+                wal_dir=wdir,
+            ),
+            tokenizer=tok,
+            start=False,
+        )
+        t0 = time.perf_counter()
+        try:
+            reqs = [
+                engine.submit(p, s)
+                for p, s in prompts[: min(4, len(prompts))]
+            ]
+            engine.start()
+            for r in reqs:
+                r.future.result(timeout=600)
+        finally:
+            engine.shutdown(drain=True)
+            if engine._wal is not None:
+                engine._wal.close()
+        if engine.error is not None:
+            raise RuntimeError(f"wal bench engine error: {engine.error!r}")
+        return time.perf_counter() - t0
+
+    try:
+        base = fw(None)
+        serve_once(base, "")  # warm/compile outside both arms
+        ratios = []
+        for i in range(3):
+            w_off = serve_once(base, "")
+            _shutil.rmtree(wal_dir, ignore_errors=True)
+            w_on = serve_once(base, wal_dir)
+            ratios.append(w_off / w_on)
+            log(
+                f"wal-overhead pair {i}: off={w_off:.2f}s "
+                f"on={w_on:.2f}s ratio={ratios[-1]:.3f}"
+            )
+            if budget_left() < 0.7:
+                log("  wal-overhead pair budget exhausted; stopping reps")
+                break
+        _ratio_stats(result, "wal_overhead_ratio", ratios)
+        log(f"wal overhead: ratio={result['wal_overhead_ratio']}")
+    except Exception:
+        log("wal-overhead bench failed:\n" + traceback.format_exc())
+    finally:
+        _shutil.rmtree(wal_dir, ignore_errors=True)
+
+
 def _timed(fn) -> float:
     t0 = time.perf_counter()
     fn()
@@ -2098,6 +2174,11 @@ def run_bench(result: dict) -> None:
         log("skipping recorder-overhead bench (already captured)")
     else:
         bench_recorder_overhead(result, prompts, tok, budget_left, fw)
+
+    if "wal_overhead" in skip:
+        log("skipping wal-overhead bench (already captured)")
+    else:
+        bench_wal_overhead(result, prompts, tok, budget_left, fw)
 
     # Host->HBM link bandwidth: the binding constraint of weight streaming;
     # makes every throughput number legible (the axon tunnel runs ~100x

@@ -712,6 +712,27 @@ def build_serve_parser() -> argparse.ArgumentParser:
                         "multiply tokens-per-sweep at no extra stream "
                         "cost, and output stays token-identical to 0 "
                         "(greedy-exact verification); 0 = off")
+    p.add_argument("--wal_dir", type=str, default="",
+                   help="crash-safe serving (docs/recovery.md): directory "
+                        "for the durable request WAL — every admission, "
+                        "sweep-boundary progress mark, and terminal "
+                        "outcome is journaled, and on the next start "
+                        "every still-open request is replayed "
+                        "token-identically before new traffic is "
+                        "accepted; empty = WAL off")
+    p.add_argument("--wal_fsync", type=str, default="admit",
+                   choices=["always", "admit", "never"],
+                   help="WAL durability/throughput trade: 'always' fsyncs "
+                        "every record, 'admit' (default) fsyncs the "
+                        "records that change what a restart owes "
+                        "(admissions + terminals) and lets progress marks "
+                        "ride the kernel buffers, 'never' leaves all "
+                        "durability to the OS (still crash-consistent — "
+                        "torn tails truncate, never corrupt)")
+    p.add_argument("--wal_max_mb", type=float, default=64.0,
+                   help="WAL segment rotation size; sealed segments whose "
+                        "every request is terminal are compacted "
+                        "(deleted) automatically")
     _add_robustness_flags(p)
     _add_adapter_flags(p)
     _add_pressure_flags(p)
@@ -790,6 +811,9 @@ def serve_main(argv: list[str] | None = None, tokenizer=None) -> None:
         router_drain_recoveries=args.router_drain_recoveries,
         max_request_tokens=args.max_request_tokens,
         speculative_k=args.speculative_k,
+        wal_dir=args.wal_dir,
+        wal_fsync=args.wal_fsync,
+        wal_max_mb=args.wal_max_mb,
         sched=_sched_config_from_args(args),
         slo=_slo_config_from_args(args),
     )
@@ -819,8 +843,48 @@ def serve_main(argv: list[str] | None = None, tokenizer=None) -> None:
             file=sys.stderr,
             flush=True,
         )
+
+    # Crash-safe serving (docs/recovery.md): `wal` is None unless
+    # --wal_dir is set. Replay runs at the top of whichever frontend
+    # branch executes — every still-open request from the previous boot
+    # is re-admitted BEFORE new traffic, so the oldest owed work reaches
+    # the scheduler first.
+    wal = getattr(engine, "_wal", None)
+
+    def _replay_open(callback=None) -> None:
+        if wal is None:
+            return
+        from flexible_llm_sharding_tpu.serve import recovery
+
+        summary = recovery.replay(engine, wal, callback=callback)
+        print(
+            f"wal replay: {summary['replayed']} reopened, "
+            f"{summary['skipped_terminal']} already terminal, "
+            f"kv restored {summary['kv_restored']} "
+            f"(failed {summary['kv_failed']})",
+            file=sys.stderr,
+            flush=True,
+        )
+
+    import signal as _signal
+
+    def _on_sigterm(signum, frame):
+        # Graceful restart contract: stop admission, let the in-flight
+        # wave reach its sweep boundary, journal + spill, exit clean.
+        # Queued and in-flight requests land back in the WAL and replay
+        # on the next start.
+        engine.shutdown_for_restart()
+        raise SystemExit(143)
+
+    try:
+        _signal.signal(_signal.SIGTERM, _on_sigterm)
+    except ValueError:
+        # Embedded call from a non-main thread: signals are unavailable;
+        # the host process owns shutdown sequencing.
+        pass
     try:
         if args.prompt_pickle:
+            _replay_open()
             with open(args.prompt_pickle, "rb") as f:
                 prompts = pickle.load(f)
             requests = []
@@ -872,9 +936,15 @@ def serve_main(argv: list[str] | None = None, tokenizer=None) -> None:
                         "status": req.status.value,
                         "error": str(e),
                     }
+                # The caller's own id: request_id is per-process, so this
+                # is the one identity that survives a restart — a client
+                # deduping replayed (re-emitted) results keys on it.
+                if req.client_id is not None:
+                    line["client_id"] = req.client_id
                 with out_lock:
                     print(json.dumps(line), flush=True)
 
+            _replay_open(callback=reply)
             for line_no, raw in enumerate(sys.stdin, 1):
                 raw = raw.strip()
                 if not raw:
@@ -896,6 +966,10 @@ def serve_main(argv: list[str] | None = None, tokenizer=None) -> None:
                         # corrupt adapter fails ONLY this request, typed,
                         # at wave assembly — never the server.
                         adapter_id=d.get("adapter_id"),
+                        # WAL identity: the caller's "id" rides into the
+                        # admission record so replayed results remain
+                        # attributable across restarts.
+                        client_id=d.get("id"),
                     )
                 except Exception as e:
                     # One malformed line must not take the server down for

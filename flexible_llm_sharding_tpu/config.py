@@ -1585,6 +1585,24 @@ class ServeConfig:
     # exports — burn-rate/remaining-budget gauges (fls_slo_*) plus a
     # journal event (and, armed, an incident bundle) on exhaustion.
     slo: SLOConfig = dataclasses.field(default_factory=SLOConfig)
+    # --- crash-safe serving (serve/wal.py + serve/recovery.py) ---------
+    # Durable request WAL directory ("" = off, the default): every
+    # admission/progress/terminal transition appends a crc-framed record;
+    # after a process death, startup replay re-admits every unfinished
+    # request and serves it token-identically (greedy decode replays
+    # bit-for-bit). Fleet mode shares ONE log across replicas.
+    wal_dir: str = ""
+    # WAL durability policy: "always" fsyncs every record; "admit" (the
+    # default) fsyncs admission + terminal records only — progress is
+    # recomputable, so losing it to a power cut costs re-decode work,
+    # never correctness; "never" flushes to the kernel only (full
+    # process-crash durability; machine-crash durability delegated to the
+    # filesystem). Every record is flushed either way: SIGKILL loses at
+    # most the record in flight.
+    wal_fsync: str = "admit"
+    # Segment rotation threshold (MB): sealed segments whose every
+    # mentioned request id is terminal are compacted (deleted).
+    wal_max_mb: float = 64.0
 
     def __post_init__(self) -> None:
         if self.queue_capacity < 1:
@@ -1627,3 +1645,10 @@ class ServeConfig:
                 "ServeConfig.speculative_k must be in [0, 64], got "
                 f"{self.speculative_k}"
             )
+        if self.wal_fsync not in ("always", "admit", "never"):
+            raise ValueError(
+                "wal_fsync must be one of 'always'/'admit'/'never', got "
+                f"{self.wal_fsync!r}"
+            )
+        if self.wal_max_mb <= 0:
+            raise ValueError("wal_max_mb must be > 0")

@@ -84,6 +84,10 @@ EVENT_KINDS = {
     "slo_budget_exhausted": "error",  # a class burned its error budget
     # the incident recorder itself (obs/incident.py)
     "incident_capture": "info",      # a bundle landed on disk
+    # crash-safe serving (serve/wal.py, serve/recovery.py, serve/engine.py)
+    "wal_torn_tail": "warning",      # partial tail record truncated at scan
+    "wal_replay": "warning",         # warm restart re-admitted open requests
+    "shutdown_drain": "info",        # graceful restart drained at boundary
 }
 
 # Severity lattice (index = rank). severity_rank("critical") == 3.

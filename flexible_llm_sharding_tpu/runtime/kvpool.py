@@ -66,6 +66,19 @@ from flexible_llm_sharding_tpu.runtime.activations import (
 )
 
 
+def _dtype_named(name: str | None) -> np.dtype | None:
+    """Resolve a recorded dtype name, including ml_dtypes extension types
+    (``np.dtype("bfloat16")`` raises on stock numpy)."""
+    if not name:
+        return None
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
 class _Page:
     """KV rows for ONE token chunk of ONE decoder segment.
 
@@ -193,6 +206,11 @@ class KVPagePool:
         self.prefix_reuse_hits = 0  # guarded by: _lock
         self.bytes_resident = 0  # guarded by: _lock
         self.entries_sealed = 0  # guarded by: _lock
+        # Crash-safe serving (serve/wal.py): entries exported to durable
+        # page files at graceful shutdown / restored at replay.
+        self.entries_exported = 0  # guarded by: _lock
+        self.entries_restored = 0  # guarded by: _lock
+        self.restore_failures = 0  # guarded by: _lock
 
     # -- configuration -----------------------------------------------------
 
@@ -366,6 +384,102 @@ class KVPagePool:
                     if page is not None:
                         total += page.nbytes
             return total
+
+    # -- durable export/restore (serve/wal.py graceful restart) ------------
+
+    def export_entry(self, handle: PrefixHandle, dirpath: str,
+                     prefix_ids: tuple, salt=None) -> dict | None:
+        """Write one entry's prefix KV to checksummed ``.npy`` page files
+        under ``dirpath`` (atomic ``_save_npy`` + ``.crc`` sidecars — the
+        same machinery the spill tier uses) and return the JSON-able refs
+        a FRESH process's :meth:`restore_entry` rebuilds the entry from.
+        ``prefix_ids``/``salt`` are the acquire key (the handle doesn't
+        carry the raw token ids). Returns None — never raises — when the
+        entry can't be exported (released handle, unreadable pages, full
+        disk): the caller falls back to re-prefill, which is always
+        correct."""
+        if handle.released or not handle.path or not handle.segs:
+            return None
+        dtype_name = None
+        segs = []
+        try:
+            os.makedirs(dirpath, exist_ok=True)
+            for seg_key in sorted(handle.segs):
+                k, v = self.assemble(handle, seg_key)
+                if dtype_name is None:
+                    dtype_name = k.dtype.name
+                with self._lock:
+                    self._page_seq += 1
+                    stem = os.path.join(
+                        dirpath,
+                        f"walkv-{self._page_seq:08d}-"
+                        + "-".join(str(part) for part in seg_key),
+                    )
+                kp, vp = f"{stem}-k.npy", f"{stem}-v.npy"
+                _save_npy(kp, k)
+                _save_npy(vp, v)
+                segs.append([list(seg_key), kp, vp])
+        except (OSError, SpillCorruptError, SpillReadError, KeyError):
+            return None
+        with self._lock:
+            self.entries_exported += 1
+        return {
+            "prefix_ids": [int(t) for t in prefix_ids],
+            "prefix_len": int(handle.prefix_len),
+            "lp_bucket": int(handle.lp_bucket),
+            "salt": salt,
+            # _save_npy stores extension dtypes (bfloat16) as uint views,
+            # and a fresh pool's _np_dtype is None until its first
+            # contribute — the refs must carry the real dtype.
+            "dtype": dtype_name,
+            "segs": segs,
+        }
+
+    def restore_entry(self, refs: dict) -> bool:
+        """Rebuild one sealed entry from :meth:`export_entry` refs, page
+        files verified against their checksum sidecars. True on success
+        (including the already-present case: a surviving process or an
+        earlier restore sealed the same prefix); False — never a raise —
+        on any verification/read failure, and the caller re-prefills."""
+        try:
+            ids = tuple(int(t) for t in refs["prefix_ids"])
+            np_dtype = _dtype_named(refs["dtype"])
+            h = self.acquire(
+                ids, int(refs["prefix_len"]), int(refs["lp_bucket"]),
+                salt=refs.get("salt"),
+            )
+            try:
+                if h.reusable:
+                    return True
+                for seg, kp, vp in refs["segs"]:
+                    arrs = []
+                    for path in (kp, vp):
+                        arr = np.load(path)
+                        side = integrity_manifest.read_sidecar(path)
+                        if side is not None:
+                            csum, nbytes = side
+                            if (
+                                int(arr.nbytes) != nbytes
+                                or integrity_manifest.tensor_checksum(arr)
+                                != csum
+                            ):
+                                raise SpillCorruptError(
+                                    f"{path} (wal kv export): checksum "
+                                    "mismatch"
+                                )
+                        arrs.append(_restore_dtype(arr, np_dtype))
+                    self.contribute(h, tuple(seg), arrs[0], arrs[1])
+                self.seal(h)
+            finally:
+                self.release(h)
+        except (OSError, ValueError, EOFError, KeyError, TypeError,
+                SpillCorruptError, SpillReadError):
+            with self._lock:
+                self.restore_failures += 1
+            return False
+        with self._lock:
+            self.entries_restored += 1
+        return True
 
     # -- eviction / spill --------------------------------------------------
 
@@ -580,6 +694,9 @@ class KVPagePool:
                 "bytes_resident": self.bytes_resident,
                 "budget_bytes": self._effective_budget(),
                 "entries_sealed": self.entries_sealed,
+                "entries_exported": self.entries_exported,
+                "entries_restored": self.entries_restored,
+                "restore_failures": self.restore_failures,
             }
 
     def summary(self) -> dict:

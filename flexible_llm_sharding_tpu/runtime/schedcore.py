@@ -33,6 +33,8 @@ of a re-run prefill.
 
 from __future__ import annotations
 
+import time
+
 
 class SchedCore:
     """Shared scheduling policy; ``cfg`` is a FrameworkConfig or None
@@ -81,6 +83,23 @@ class SchedCore:
             model_cfg, dt, toks, blocks, gen_slots,
             device=device, n_chips=n_chips,
         )
+
+    # -- restart replay (serve/recovery.py) --------------------------------
+
+    def replay_deadline(self, deadline_left_s, now=None):
+        """Re-arm a replayed request's admission deadline from the WAL's
+        recorded REMAINING seconds (a duration — immune to restart
+        wall-clock skew): the clock restarts counting from replay, so
+        downtime and pre-crash queue wait are forgiven rather than
+        charged. A request the WAL shows already admitted replays with no
+        deadline at all (None in -> None out), the preemption-resume
+        precedent: once a request reached a wave, its time-to-first-token
+        contract is history and expiring the replay would throw away
+        committed work."""
+        if deadline_left_s is None:
+            return None
+        base = time.monotonic() if now is None else now
+        return base + max(float(deadline_left_s), 0.0)
 
     # -- spill policy ------------------------------------------------------
 

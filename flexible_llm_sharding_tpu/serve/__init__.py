@@ -27,6 +27,12 @@ over a fixed prompt set; this package turns the same runtime into a server:
   best-effort waves, per-tenant deficit-round-robin fairness and
   token-bucket rate limits, and cross-request prefix coalescing (one
   shared prefill for N same-prefix requests).
+- ``wal``      — crash-safe serving (docs/recovery.md): the durable
+  append-only request ledger (crc-framed segments, fsync policy,
+  rotation + terminal-only compaction, torn tails truncated not fatal).
+- ``recovery`` — startup replay: re-admit every open WAL request through
+  the normal scheduler core, restore checksummed spilled prefix-KV when
+  present, outputs token-identical to an uninterrupted run.
 """
 
 from flexible_llm_sharding_tpu.serve.request import (  # noqa: F401
@@ -37,10 +43,14 @@ from flexible_llm_sharding_tpu.serve.request import (  # noqa: F401
     RequestResult,
     RequestStatus,
     RequestTooLarge,
+    RestartPending,
+    ServeClosed,
     ServeFuture,
     WaveAborted,
 )
 from flexible_llm_sharding_tpu.serve.queue import AdmissionQueue  # noqa: F401
+from flexible_llm_sharding_tpu.serve.wal import RequestWAL, wal_for  # noqa: F401
+from flexible_llm_sharding_tpu.serve import recovery  # noqa: F401
 from flexible_llm_sharding_tpu.serve.batcher import ShardAwareBatcher  # noqa: F401
 from flexible_llm_sharding_tpu.serve.engine import ServeEngine  # noqa: F401
 from flexible_llm_sharding_tpu.serve.router import Router  # noqa: F401
@@ -66,11 +76,16 @@ __all__ = [
     "RequestResult",
     "RequestStatus",
     "RequestTooLarge",
+    "RequestWAL",
+    "RestartPending",
     "Router",
+    "ServeClosed",
     "ServeEngine",
     "ServeFuture",
     "ShardAwareBatcher",
     "SweepScheduler",
     "UnknownSLOClass",
     "WaveAborted",
+    "recovery",
+    "wal_for",
 ]

@@ -40,6 +40,8 @@ long-context routing.
 from __future__ import annotations
 
 import dataclasses
+import os
+import signal
 import threading
 import time
 from itertools import islice
@@ -103,6 +105,7 @@ from flexible_llm_sharding_tpu.serve.queue import AdmissionQueue
 from flexible_llm_sharding_tpu.serve.request import (
     Request,
     RequestStatus,
+    RestartPending,
     WaveAborted,
 )
 from flexible_llm_sharding_tpu.serve.sched import (
@@ -180,11 +183,18 @@ class ServeEngine:
         start: bool = True,
         process_metrics_mirror: bool = True,
         scheduler=None,
+        wal=None,
     ):
         # scheduler: a SHARED SweepScheduler (serve/fleet.py passes the
         # fleet-wide instance so tenant rate limits and DRR fairness span
         # replicas instead of multiplying by the replica count). None =
         # this engine builds its own when serve_cfg.sched.enabled.
+        # wal (serve/wal.RequestWAL or None): the durable request ledger
+        # for crash-safe serving — admission records write ahead of the
+        # queue, progress records land at sweep boundaries, and graceful
+        # restart (shutdown_for_restart) parks unfinished requests for a
+        # token-identical replay (serve/recovery.py). The fleet passes
+        # its shared instance so recycled replicas inherit the same log.
         if cfg.temperature > 0:
             raise ValueError(
                 "serving is greedy-only for now (per-request rng streams "
@@ -321,12 +331,22 @@ class ServeEngine:
             self._sched = SweepScheduler(self.serve_cfg.sched)
         if self._sched is not None:
             self.metrics.register("sched", self._sched.stats)
+        # Crash-safe request WAL (serve/wal.py): built here from the
+        # config unless the fleet handed down its shared instance.
+        if wal is None and self.serve_cfg.wal_dir:
+            from flexible_llm_sharding_tpu.serve.wal import wal_for
+
+            wal = wal_for(self.serve_cfg)
+        self._wal = wal
+        if self._wal is not None:
+            self.metrics.register("wal", self._wal.stats)
         self.queue = AdmissionQueue(
             self.serve_cfg.queue_capacity, metrics=self.metrics,
             injector=self._injector,
             max_request_tokens=self.serve_cfg.max_request_tokens,
             size_fn=self._request_size_tokens,
             scheduler=self._sched,
+            wal=self._wal,
         )
         # Resource-pressure brownout (runtime/pressure.py): the process
         # controller (None unless cfg.pressure.enabled) sheds through
@@ -400,6 +420,18 @@ class ServeEngine:
         self._watchdog: StepWatchdog | None = None
         self._error: BaseException | None = None
         self._thread: threading.Thread | None = None
+        # Graceful-restart flag (shutdown_for_restart): checked at the
+        # TOP of the run loop, so every in-flight wave has finished its
+        # current sweep (prefill complete, pool handles sealed) before
+        # the drain exports KV and parks the requests for replay.
+        self._restart_pending = False
+        # Process-death chaos drill (tests/test_wal.py, chaos smoke):
+        # SIGKILL this process mid-sweep after N completed sweeps. Env,
+        # not config: only the crash harness may aim this gun.
+        self._crash_sweeps = int(
+            os.environ.get("FLS_WAL_CRASH_SWEEPS", "0") or 0
+        )
+        self._sweeps_done = 0
         # Fleet hooks (serve/fleet.py). _sweep_pos/_heartbeat are the
         # sweep-progress watermark the router's phase scoring and liveness
         # check read lock-free (scalar writes from the engine thread only;
@@ -438,12 +470,16 @@ class ServeEngine:
         slo_class: str | None = None,
         tenant_id: str | None = None,
         adapter_id: str | None = None,
+        client_id=None,
     ) -> Request:
         """Enqueue one request (any thread). Backpressure/closed/deadline
         outcomes surface through the returned request's future; an
         unknown ``slo_class`` raises typed (UnknownSLOClass) to the
         submitter. Deadline precedence: the request's own, else the SLO
-        class's default (scheduler on), else the serve-level default."""
+        class's default (scheduler on), else the serve-level default.
+        ``client_id`` is the caller's stable correlation id — recorded in
+        the WAL and echoed in replies, it is the identity a client dedups
+        by across a crash/restart (``request_id`` is per-process)."""
         slo = parse_class(slo_class)
         if deadline_s is None:
             deadline_s = class_deadline_s(self.serve_cfg.sched, slo)
@@ -466,6 +502,7 @@ class ServeEngine:
             slo_class=slo,
             tenant_id=tenant_id if tenant_id is not None else "default",
             adapter_id=adapter_id,
+            client_id=client_id,
         )
         return self.submit_request(req)
 
@@ -501,6 +538,84 @@ class ServeEngine:
         # wide dump nor pin its object graph for the process lifetime.
         self.metrics.close()
         return ok
+
+    def shutdown_for_restart(self, timeout: float | None = None) -> bool:
+        """Graceful-restart shutdown (SIGTERM / preemption notice): stop
+        admission, let every in-flight wave finish its CURRENT sweep,
+        then — at the sweep boundary — flush progress + spilled-KV refs
+        to the WAL, park every unfinished request as ``RestartPending``
+        (no terminal record: they stay open for replay), and exit clean.
+        The next boot's ``serve.recovery.replay`` re-admits everything
+        parked here and serves it token-identically. Requires a WAL;
+        without one this is just ``shutdown(drain=False)``."""
+        if self._wal is None:
+            return self.shutdown(drain=False, timeout=timeout)
+        if self._pressure is not None:
+            self._pressure.detach_queue(self.queue)
+        # Park still-QUEUED requests first (persist=True -> RestartPending,
+        # admission records stay open), then flag the loop: it drains the
+        # in-flight waves at the next boundary and exits.
+        self.queue.close(drain=False, persist=True)
+        self._restart_pending = True
+        ok = True
+        if self._thread is not None:
+            self._thread.join(timeout)
+            ok = not self._thread.is_alive()
+        self._wal.flush(sync=True)
+        self._wal.maybe_compact()
+        obs_events.emit(
+            "shutdown_drain",
+            clean=ok,
+            open_requests=self._wal.stats()["open_requests"],
+        )
+        if self.metrics_server is not None:
+            self.metrics_server.close()
+        self.metrics.close()
+        return ok
+
+    def _drain_for_restart(self) -> None:
+        """Run-loop side of ``shutdown_for_restart``, at a sweep boundary:
+        every wave just completed a full sweep, so per-request progress
+        and pool KV are consistent. Export each live request's prefix-KV
+        pages (checksummed, via the pool's verified spill machinery) so
+        the restarted process can warm-start instead of re-prefilling,
+        write the final progress records, and park the requests."""
+        for wave in self.batcher.waves:
+            st = wave.state
+            for r in wave.requests:
+                if r.status.terminal or r.wal_id is None:
+                    continue
+                kv_refs = None
+                if (
+                    self._kv_pool is not None
+                    and st is not None
+                    and wave.steps > 0
+                ):
+                    e_idx, _, _ = wave.locate(r)
+                    handle = st.pool_handles.get(e_idx)
+                    tp = st.toks[e_idx]
+                    if handle is not None:
+                        kv_refs = self._kv_pool.export_entry(
+                            handle,
+                            self._wal.wal_dir,
+                            tuple(
+                                int(t)
+                                for t in tp.prefix_ids[: tp.prefix_len]
+                            ),
+                            salt=self._entry_adapter(wave.entries[e_idx]),
+                        )
+                self._wal.progress(r, kv=kv_refs)
+        waves = list(self.batcher.waves)  # fail_all_active clears the list
+        self.batcher.fail_all_active(
+            RestartPending(
+                "serve process restarting; in-flight request journaled "
+                "for token-identical replay"
+            )
+        )
+        for w in waves:
+            if w.state is not None:
+                w.state.kv_store.clear()
+                self._release_pool_handles(w.state)
 
     @property
     def error(self) -> BaseException | None:
@@ -594,6 +709,13 @@ class ServeEngine:
                 # Boundary passes are liveness too: an idle engine polling
                 # its empty queue must not look wedged to the fleet.
                 self._heartbeat = time.monotonic()
+                if self._restart_pending:
+                    # Graceful restart: every wave just finished a full
+                    # sweep (we are AT the boundary), so KV/handles are
+                    # consistent — export them, park every unfinished
+                    # request for WAL replay, and stop.
+                    self._drain_for_restart()
+                    break
                 # Preemption BEFORE admission: a retired best-effort wave
                 # frees slots this same boundary's pop hands to the
                 # waiting interactive work (serve/sched, never mid-sweep).
@@ -1319,6 +1441,16 @@ class ServeEngine:
                     self._injector.fire(
                         "engine_step", detail=f"shard{shard_pos}"
                     )
+                if (
+                    self._crash_sweeps
+                    and self._sweeps_done >= self._crash_sweeps
+                    and (shard_pos > 0 or len(self.shards) == 1)
+                ):
+                    # Process-death drill (FLS_WAL_CRASH_SWEEPS): SIGKILL
+                    # mid-sweep — no cleanup, no flush beyond what the
+                    # WAL already handed the kernel. The restart harness
+                    # asserts token-identical replay from exactly here.
+                    os.kill(os.getpid(), signal.SIGKILL)
                 if not layer_idxs:
                     continue
                 for wave in self.batcher.waves:
@@ -1722,6 +1854,7 @@ class ServeEngine:
             for r in wave.requests:
                 if r.status.terminal:
                     continue
+                prev_emitted = r.tokens_emitted
                 if prefilled and r.first_token_at is None:
                     r.first_token_at = now
                     self.metrics.observe_ttft(now - r.arrival, r.slo_class)
@@ -1752,9 +1885,19 @@ class ServeEngine:
                 elif r.tokens_emitted < r.max_new_tokens:
                     r.tokens_emitted += 1
                     emitted += 1
+                if self._wal is not None and r.tokens_emitted > prev_emitted:
+                    # Sweep-boundary progress record: the watermark plus
+                    # the token ids this sweep emitted (a DELTA — per-
+                    # request WAL cost stays linear in its output). The
+                    # ids are forensics/accounting; replay re-derives
+                    # them bit-identically (greedy decode).
+                    self._wal.progress(
+                        r, tok_delta=self._wal_tok_delta(wave, r, prev_emitted)
+                    )
                 if r.tokens_emitted >= r.max_new_tokens:
                     self._resolve(wave, r)
         self.metrics.count("sweeps")
+        self._sweeps_done += 1
         # SLO budgets (obs/slo.py): rate-limited re-evaluation so budget
         # exhaustion journals promptly even when nothing scrapes.
         self._slo.maybe_check()
@@ -1769,6 +1912,26 @@ class ServeEngine:
             if w.state is not None:
                 w.state.kv_store.clear()
                 self._release_pool_handles(w.state)
+
+    def _wal_tok_delta(self, wave: Wave, r: Request, prev_emitted: int):
+        """Token ids this sweep emitted for ``r`` (WAL progress payload):
+        ``[step][suffix]`` int lists. Speculative waves keep per-suffix
+        ragged histories, so they journal the watermark only (None) —
+        replay never needs the ids, it re-derives them greedily."""
+        st = wave.state
+        if st is None or st.spec is not None:
+            return None
+        e_idx, s_off, s_cnt = wave.locate(r)
+        b, row = st.loc[e_idx]
+        hist = st.tok_hist[b]
+        lo = prev_emitted - r.resume_len
+        hi = r.tokens_emitted - r.resume_len
+        if lo < 0 or hi > len(hist):
+            return None  # resume bookkeeping edge: watermark only
+        return [
+            [int(t) for t in hist[step][row, s_off : s_off + s_cnt]]
+            for step in range(lo, hi)
+        ]
 
     def _resolve(self, wave: Wave, r: Request) -> None:
         st: _WaveState = wave.state

@@ -68,6 +68,7 @@ from flexible_llm_sharding_tpu.serve.request import (
     DeadlineExceeded,
     Request,
     RequestStatus,
+    RestartPending,
     ServeClosed,
     WaveAborted,
 )
@@ -153,6 +154,13 @@ class ReplicaFleet:
         self._engine_cfg = dataclasses.replace(
             self.serve_cfg, metrics_port=None, replicas=1
         )
+        # ONE crash-safe request WAL shared by every replica (serve/wal.py;
+        # None when --wal_dir is unset): replicas append to the same
+        # segment sequence, recycled replicas inherit the log, and one
+        # startup replay (serve/recovery.py) covers the whole fleet.
+        from flexible_llm_sharding_tpu.serve.wal import wal_for
+
+        self._wal = wal_for(self.serve_cfg)
         self.metrics = RouterMetrics()
         self.router = Router(
             self.serve_cfg.router_phase_weight,
@@ -295,6 +303,47 @@ class ReplicaFleet:
             REGISTRY.unregister_if("sched", self._sched_source)
         return ok
 
+    def shutdown_for_restart(self, timeout: float | None = None) -> bool:
+        """Fleet-wide graceful restart (the ``ServeEngine.
+        shutdown_for_restart`` surface): every replica drains at its next
+        sweep boundary into the SHARED WAL, parked/pending dispatches
+        resolve ``RestartPending`` (their inner attempts' admission
+        records stay open for replay), and the fleet exits clean. One
+        replay at the next boot re-admits everything. Requires the WAL;
+        without one this is ``shutdown(drain=False)``."""
+        if self._wal is None:
+            return self.shutdown(drain=False, timeout=timeout)
+        if self._pressure is not None:
+            self._pressure.detach_fleet(self)
+        with self._lock:
+            self._closed = True
+            pending = list(self._pending)
+            self._pending.clear()
+        for disp in pending:
+            self._finish_error(
+                disp,
+                RestartPending(
+                    "replica fleet restarting; request parked for replay"
+                ),
+                RequestStatus.CANCELLED,
+            )
+        if self._started:
+            self._stop.set()
+            self._monitor.join(timeout=5.0)
+        with self._lock:
+            replicas = list(self._replicas)
+        ok = True
+        for rep in replicas:
+            rep.release.set()
+            ok = rep.engine.shutdown_for_restart(timeout=timeout) and ok
+            REGISTRY.unregister_if(f"replica{rep.idx}", rep.source)
+        if self.metrics_server is not None:
+            self.metrics_server.close()
+        REGISTRY.unregister_if("router", self._router_source)
+        if self._sched_source is not None:
+            REGISTRY.unregister_if("sched", self._sched_source)
+        return ok
+
     # -- replica lifecycle -------------------------------------------------
 
     def _mk_replica(self, start: bool = True) -> _Replica:
@@ -313,6 +362,9 @@ class ReplicaFleet:
             # Fleet-wide scheduling state: rate limits and fairness must
             # not multiply by the replica count.
             scheduler=self._sched,
+            # The fleet-shared request WAL: a recycled replica inherits
+            # the same log, so per-replica segment sequences never fork.
+            wal=self._wal,
         )
         with self._lock:
             idx = self._next_idx
@@ -587,6 +639,7 @@ class ReplicaFleet:
         slo_class: str | None = None,
         tenant_id: str | None = None,
         adapter_id: str | None = None,
+        client_id=None,
     ) -> Request:
         """Enqueue one request (any thread) — the ``ServeEngine.submit``
         surface. The returned request's future resolves from whichever
@@ -621,7 +674,18 @@ class ReplicaFleet:
             slo_class=slo,
             tenant_id=tenant_id if tenant_id is not None else "default",
             adapter_id=adapter_id,
+            client_id=client_id,
         )
+        return self.submit_request(req)
+
+    def submit_request(self, req: Request) -> Request:
+        """Enqueue a pre-built request — the same surface as
+        ``ServeEngine.submit_request``, so restart replay
+        (serve/recovery.py) re-admits through ONE interface whether the
+        process serves a single engine or a fleet. A replayed request
+        arrives with its WAL id already set; the first inner attempt
+        inherits it, so the reopen admission record lands under the same
+        durable identity."""
         if req.max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
         req.dispatch_id = req.request_id  # the stable dispatch id
@@ -683,6 +747,7 @@ class ReplicaFleet:
                     choice = "parked"
                 else:
                     choice = "dispatched"
+                    prev = disp.inner
                     inner = Request(
                         prefix=outer.prefix,
                         suffixes=outer.suffixes,
@@ -699,6 +764,16 @@ class ReplicaFleet:
                         slo_class=outer.slo_class,
                         tenant_id=outer.tenant_id,
                         adapter_id=outer.adapter_id,
+                        # Durable identity (serve/wal.py): every attempt
+                        # for one fleet request shares one WAL id — a
+                        # re-dispatch REOPENS it, a replayed request's
+                        # first attempt inherits it from the outer — so
+                        # replay/compaction fold all attempts into one
+                        # request, exactly like dispatch_id does in RAM.
+                        wal_id=(
+                            prev.wal_id if prev is not None else outer.wal_id
+                        ),
+                        client_id=outer.client_id,
                     )
                     disp.inner = inner
                     disp.replica = replica

@@ -43,6 +43,7 @@ from flexible_llm_sharding_tpu.serve.request import (
     Request,
     RequestStatus,
     RequestTooLarge,
+    RestartPending,
     ServeClosed,
 )
 
@@ -56,6 +57,7 @@ class AdmissionQueue:
         max_request_tokens: int = 0,
         size_fn=None,
         scheduler=None,
+        wal=None,
     ):
         # max_request_tokens/size_fn: admission-side request size cap —
         # size_fn(request) estimates prompt tokens + generation budget
@@ -66,6 +68,12 @@ class AdmissionQueue:
         # attached, pop_wave delegates the pick to its class-priority +
         # tenant-DRR policy instead of FIFO, and submit consults its
         # per-tenant rate limiter (over-limit -> typed RateLimited).
+        # wal (serve/wal.RequestWAL or None): when attached, submit
+        # writes the durable admission record BEFORE the request can
+        # join the queue (write-AHEAD: a crash after the record but
+        # before the enqueue replays harmlessly — the client sees the
+        # request served after restart instead of vanished), and
+        # close(persist=True) parks still-queued requests for replay.
         if capacity < 1:
             raise ValueError("capacity must be >= 1")
         self.capacity = capacity
@@ -74,6 +82,7 @@ class AdmissionQueue:
         self._max_request_tokens = max_request_tokens
         self._size_fn = size_fn
         self._scheduler = scheduler
+        self._wal = wal
         self._lock = threading.Lock()
         self._items: deque[Request] = deque()  # guarded by: _lock
         self._closed = False  # guarded by: _lock
@@ -178,6 +187,14 @@ class AdmissionQueue:
                 if self._metrics is not None:
                     self._metrics.count("rejected")
                 return request
+        if self._wal is not None:
+            # Write-AHEAD, past the cheap refusals but BEFORE the request
+            # can join the queue: once this record is durable, a process
+            # death cannot lose the request. A capacity/closed rejection
+            # below still terminates the id (the attached terminal hook
+            # writes the matching terminal record), so the WAL never
+            # replays a request the client was told was refused.
+            self._wal.admit(request)
         evicted: list[Request] = []
         with self._lock:
             if self._closed:
@@ -325,11 +342,18 @@ class AdmissionQueue:
         with self._lock:
             return self._closed
 
-    def close(self, drain: bool = True) -> list[Request]:
+    def close(self, drain: bool = True, persist: bool = False) -> list[Request]:
         """Refuse further submissions. ``drain=True`` leaves queued requests
         for the engine to serve out; ``drain=False`` cancels them (futures
         raise ServeClosed). Returns the requests cancelled (empty when
         draining). Idempotent.
+
+        ``persist=True`` (graceful restart, WAL attached): queued-but-
+        never-admitted requests resolve ``RestartPending`` instead of
+        ServeClosed — the terminal hook writes NO terminal record for
+        that error, so their admission records stay open in the WAL and
+        the next boot replays them. Without this, a restart converts
+        every queued request into a client-visible cancellation.
 
         Either way, requests whose deadline already passed but that lazy
         eviction hasn't reached yet resolve as EXPIRED (DeadlineExceeded) —
@@ -343,9 +367,14 @@ class AdmissionQueue:
             if not drain:
                 self._items.clear()
         self._finish_expired(evicted)
+        park = persist and self._wal is not None
         for r in cancelled:
             won = r.fail(
-                ServeClosed("serve queue shut down before admission"),
+                RestartPending(
+                    "serve process restarting; request journaled for replay"
+                )
+                if park
+                else ServeClosed("serve queue shut down before admission"),
                 RequestStatus.CANCELLED,
             )
             if won and self._metrics is not None:

@@ -1,0 +1,151 @@
+"""Warm restart from the request WAL: crash-safe serving, part 2.
+
+``replay(engine, wal)`` runs ONCE at startup, before the frontend accepts
+new traffic: scan every WAL segment, fold records per request id
+(``serve/wal.fold_records`` — duplicate admits collapse, terminal ids are
+skipped, which is exactly the dedup that makes a completed-but-unacked
+request safe), and re-admit every still-open request through the NORMAL
+scheduler core — ``engine.submit_request``, the same interface a fleet
+re-dispatch uses — so replayed work obeys admission quotas, SLO classes,
+tenant fairness, and adapter resolution like any live request.
+
+Token-identical by construction: serving is greedy (temperature=0 is
+enforced at engine construction), so re-decoding the ORIGINAL prompt under
+the ORIGINAL budget reproduces every token and score bit-for-bit — the
+replayed result is indistinguishable from an uninterrupted run. Progress
+records are therefore accounting and forensics, not resume state; what a
+warm restart recovers beyond correctness is TIME, via the prefix-KV pool:
+a graceful shutdown exports each live request's checksummed prefix-KV
+pages (``KVPagePool.export_entry``), and replay restores them
+(``restore_entry``) so the re-admitted request's prefill becomes a pool
+reuse hit instead of a recompute. A page that fails its checksum is
+counted and simply re-prefilled — KV restore is an optimization and may
+never be a correctness dependency.
+
+Deadline accounting across the restart (``SchedCore.replay_deadline``):
+the WAL records REMAINING seconds at admission (a duration — immune to
+wall-clock skew between boots); replay re-arms the clock from now, so
+downtime and pre-crash queue wait are forgiven. A request the WAL shows
+already ADMITTED (any progress record) replays with no deadline at all —
+the preemption-resume precedent: its time-to-first-token contract is
+already history, and expiring the replay would discard committed work.
+
+Output duplication contract: the WAL terminal record is written AFTER the
+client-facing callback, so a crash between the two re-emits that
+request's (identical) output after restart. Clients dedup by
+``client_id`` — at-least-once emission + idempotent merge = exactly-once
+results.
+"""
+
+from __future__ import annotations
+
+import time
+
+from flexible_llm_sharding_tpu.obs import events as obs_events
+from flexible_llm_sharding_tpu.runtime.schedcore import SchedCore
+from flexible_llm_sharding_tpu.serve.request import Request
+from flexible_llm_sharding_tpu.serve.wal import RequestWAL, WalEntry
+
+
+def _kv_pool_of(engine):
+    """The prefix-KV pool replay restores into: the engine's own, or —
+    fleet mode — any replica's (the pool is process-wide per config, so
+    one restore serves every replica)."""
+    pool = getattr(engine, "_kv_pool", None)
+    if pool is not None:
+        return pool
+    for rep in getattr(engine, "_replicas", []) or []:
+        pool = getattr(rep.engine, "_kv_pool", None)
+        if pool is not None:
+            return pool
+    return None
+
+
+def build_request(entry: WalEntry, callback=None, now=None) -> Request:
+    """One re-admittable Request from a folded WAL entry: the ORIGINAL
+    prompt and FULL budget (greedy decode replays the whole stream
+    bit-identically; partial progress is not resume state), the durable
+    identities (``wal_id`` so the reopen admission lands under the same
+    id, ``client_id`` so the client can dedup), and the re-armed
+    deadline."""
+    admit = entry.admit
+    deadline = (
+        None
+        if entry.emitted > 0  # already admitted pre-crash: contract history
+        else SchedCore().replay_deadline(
+            admit.get("deadline_left_s"), now=now
+        )
+    )
+    return Request(
+        prefix=admit["prefix"],
+        suffixes=tuple(admit["suffixes"]),
+        max_new_tokens=int(admit["max_new_tokens"]),
+        deadline=deadline,
+        callback=callback,
+        slo_class=admit.get("slo") or "standard",
+        tenant_id=admit.get("tenant") or "default",
+        adapter_id=admit.get("adapter"),
+        wal_id=entry.wal_id,
+        client_id=admit.get("client_id"),
+    )
+
+
+def replay(engine, wal: RequestWAL, callback=None) -> dict:
+    """Scan the WAL and re-admit every open (non-terminal) request through
+    ``engine.submit_request`` — ServeEngine and ReplicaFleet expose the
+    same surface. Returns the replay summary (also journaled as a
+    ``wal_replay`` event). Call BEFORE accepting new traffic: replayed
+    requests should reach the scheduler first, since they are the oldest
+    work the server owes.
+
+    ``callback`` is attached to each replayed request (the serve frontend
+    passes its reply emitter, so replayed results reach the client stream
+    exactly like live ones)."""
+    t0 = time.monotonic()
+    entries = wal.scan()
+    open_entries = sorted(
+        (e for e in entries.values() if e.open),
+        # Oldest admission first: replay preserves arrival order.
+        key=lambda e: e.admit.get("ts") or 0.0,
+    )
+    pool = _kv_pool_of(engine)
+    kv_restored = 0
+    kv_failed = 0
+    replayed = []
+    requests = []
+    for entry in open_entries:
+        if entry.kv is not None and pool is not None:
+            # Warm start: restore the checksummed exported prefix-KV pages
+            # so this request's prefill is a pool reuse hit. Failure is
+            # counted and harmless — the request re-prefills.
+            if pool.restore_entry(entry.kv):
+                kv_restored += 1
+            else:
+                kv_failed += 1
+        req = build_request(entry, callback=callback)
+        # The normal admission path: the queue writes the reopen admission
+        # record (same wal_id) and re-attaches the terminal hook; the
+        # scheduler core applies its quotas/fairness as for any request.
+        engine.submit_request(req)
+        replayed.append(entry.wal_id)
+        requests.append(req)
+    summary = {
+        "replayed": len(replayed),
+        "skipped_terminal": len(entries) - len(open_entries),
+        "kv_restored": kv_restored,
+        "kv_failed": kv_failed,
+        "scan_s": round(time.monotonic() - t0, 6),
+    }
+    obs_events.emit(
+        "wal_replay",
+        **summary,
+        wal_ids=replayed[:32],  # bounded: journal lines stay scannable
+    )
+    # Replay reopened every live id; anything whose every mention is now
+    # terminal again (fully-completed old segments) can go.
+    wal.maybe_compact()
+    summary["requests"] = requests
+    return summary
+
+
+__all__ = ["build_request", "replay"]

@@ -75,6 +75,16 @@ class ServeClosed(RuntimeError):
     no-drain shutdown)."""
 
 
+class RestartPending(ServeClosed):
+    """Graceful-shutdown resolution (serve/wal.py, serve/recovery.py): the
+    process is restarting and this request's state has been flushed to the
+    durable WAL — the request is not failed, it is PARKED. The WAL terminal
+    hook deliberately writes no terminal record for this error, so the next
+    boot's replay re-admits the request and serves it to a token-identical
+    completion. A ServeClosed subclass: callers that treat shutdown as
+    retriable already handle it."""
+
+
 class WaveAborted(RuntimeError):
     """The request's in-flight wave was aborted by a RECOVERABLE engine
     fault (an exhausted shard load, a watchdog-detected stall): only this
@@ -223,6 +233,25 @@ class Request:
     # uninterrupted token stream.
     resume_scores: list = dataclasses.field(default_factory=list, repr=False)
     resume_tokens: list = dataclasses.field(default_factory=list, repr=False)
+    # Crash-safe serving (serve/wal.py): the durable WAL id this request's
+    # admission/progress/terminal records are keyed by. Assigned by
+    # RequestWAL.admit at queue submit; stable across fleet re-dispatch
+    # attempts and restart replay (a re-admit under an existing wal_id
+    # REOPENS the id in the log). None when serving runs WAL-free.
+    wal_id: str | None = None
+    # Caller-chosen correlation id (the JSONL frontend's ``id`` field),
+    # recorded in the WAL and echoed in replies: ``request_id`` is a
+    # per-process counter, so across a crash/restart this is the only
+    # identity a client can dedup merged outputs by.
+    client_id: Any = None
+    # WAL terminal hook: fired exactly once on any terminal transition,
+    # AFTER the caller-facing callback — so a crash between output
+    # emission and the terminal record leaves the id OPEN and replay
+    # re-emits a duplicate the client dedups by client_id (at-least-once
+    # emission + idempotent merge = exactly-once results).
+    on_terminal: Callable[["Request", BaseException | None], Any] | None = (
+        dataclasses.field(default=None, repr=False)
+    )
     request_id: int = dataclasses.field(
         default_factory=lambda: next(_REQUEST_IDS)
     )
@@ -271,6 +300,16 @@ class Request:
             except Exception:  # flscheck: disable=EXC-TAXONOMY: user-supplied callback — a bug in it must not take down the serving loop (the request itself already resolved)
                 pass  # a callback bug must not take down the serving loop
 
+    def _fire_terminal_hook(self, error: BaseException | None) -> None:
+        """WAL bookkeeping hook, strictly AFTER the caller-facing callback:
+        crash between the two -> the WAL id stays open -> replay re-emits
+        the (identical) output and the client dedups by client_id."""
+        if self.on_terminal is not None:
+            try:
+                self.on_terminal(self, error)
+            except Exception:  # flscheck: disable=EXC-TAXONOMY: WAL bookkeeping failure (ENOSPC etc.) must not fail a request that already resolved; the WAL counts its own write errors
+                pass
+
     def resolve(self, scores: np.ndarray, updated: Prompt,
                 tokens: np.ndarray) -> bool:
         """Terminal DONE transition. Returns whether THIS call won the
@@ -292,6 +331,7 @@ class Request:
         self.finished_at = time.monotonic()
         self.future.finish_result(result)
         self._fire_callback()
+        self._fire_terminal_hook(None)
         return True
 
     def fail(self, error: BaseException, status: RequestStatus) -> bool:
@@ -303,6 +343,7 @@ class Request:
         self.finished_at = time.monotonic()
         self.future.finish_error(error)
         self._fire_callback()
+        self._fire_terminal_hook(error)
         return True
 
 
@@ -315,6 +356,7 @@ __all__ = [
     "RequestResult",
     "RequestStatus",
     "RequestTooLarge",
+    "RestartPending",
     "ServeClosed",
     "ServeFuture",
     "WaveAborted",

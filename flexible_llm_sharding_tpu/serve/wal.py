@@ -1,0 +1,500 @@
+"""Durable write-ahead request log: crash-safe serving, part 1.
+
+Every robustness layer before this one (faults, integrity, fleet
+failover, brownout, flight recorder) keeps a *living* process alive;
+when the process itself dies — TPU preemption, OOM-kill, a yanked rig —
+every accepted-but-unfinished request vanishes with no trace. This
+module is the durable request ledger that closes that hole: an
+append-only, length-prefix-framed, crc-checksummed segment log recording
+
+- **admission** (``admit``): the full request descriptor — prompt,
+  generation budget, tenant/SLO class/adapter, the REMAINING admission
+  deadline in seconds (a duration, never a wall-clock instant, so a
+  restart with wall-clock skew cannot corrupt deadline accounting),
+- **progress** (``progress``): per-request state at sweep boundaries —
+  emitted-token count, the newly emitted token ids since the last
+  record, and (on graceful shutdown) refs to checksummed host-spilled
+  prefix-KV pages for a warm restart,
+- **terminal outcomes** (``terminal``): done/failed/expired/rejected/
+  cancelled, so replay after a restart can dedup completed requests.
+
+Record framing: ``<4-byte LE payload length><8-hex-char crc32 of the
+payload (integrity/manifest.checksum_bytes — the PR 4 machinery)><UTF-8
+JSON payload>``. A torn tail (partial frame or crc mismatch — the
+process died mid-write) TRUNCATES the scan at the last good record and
+is counted + journaled (``wal_torn_tail``), never fatal: losing the
+record being written at the instant of death is the WAL's contract
+working, not failing.
+
+Durability policy (``ServeConfig.wal_fsync``): every record is
+``flush()``ed to the kernel (a SIGKILL'd process loses nothing already
+flushed); ``fsync`` additionally guards machine crashes —
+
+- ``always``: fsync every record (safest, slowest),
+- ``admit`` (default): fsync admission and terminal records only —
+  progress records are recomputable (greedy decode replays
+  bit-identically from the prompt), so losing them to a power cut
+  costs re-decode work, never correctness,
+- ``never``: flush only (process-crash durability; machine-crash
+  durability delegated to the filesystem's own interval).
+
+Segments rotate at ``wal_max_mb``; a sealed segment whose every
+mentioned request id is currently terminal is COMPACTED (deleted) —
+a request re-admitted after a terminal record (fleet re-dispatch)
+reopens its id and blocks compaction of every segment naming it until
+it is terminal again, so compaction can never drop the last trace of a
+non-terminal request.
+
+Replay lives in ``serve/recovery.py``; this module owns the record
+format, the scan/fold state machine it shares with compaction, and the
+terminal hook (``Request.on_terminal``) that keeps the ledger in sync
+with the request state machine. ``RestartPending`` terminals are
+deliberately NOT recorded: a graceful shutdown resolves unfinished
+requests with that typed error precisely so they stay OPEN in the WAL
+and replay after restart.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import struct
+import threading
+import time
+
+from flexible_llm_sharding_tpu.integrity.manifest import checksum_bytes
+from flexible_llm_sharding_tpu.obs import events as obs_events
+from flexible_llm_sharding_tpu.serve.request import Request, RestartPending
+
+_LEN = struct.Struct("<I")
+_CRC_BYTES = 8  # ascii hex crc32, checksum_bytes() format
+_HEADER = _LEN.size + _CRC_BYTES
+# A payload larger than this is framing garbage, not a record — treat it
+# as a torn tail instead of attempting a giant allocation.
+_MAX_PAYLOAD = 64 * 1024 * 1024
+FSYNC_POLICIES = ("always", "admit", "never")
+SEGMENT_PREFIX = "wal-"
+SEGMENT_SUFFIX = ".log"
+
+
+def _frame(payload: bytes) -> bytes:
+    return _LEN.pack(len(payload)) + checksum_bytes(payload).encode("ascii") + payload
+
+
+def read_segment(path: str) -> tuple[list[dict], int, bool]:
+    """Parse one segment file: ``(records, valid_bytes, torn)``.
+
+    Stops at the first bad frame — short header, short payload, crc
+    mismatch, or undecodable JSON — and reports everything before it.
+    ``valid_bytes`` is the offset of the last good record's end, so the
+    caller can physically truncate the torn tail away."""
+    records: list[dict] = []
+    valid = 0
+    torn = False
+    try:
+        with open(path, "rb") as f:
+            buf = f.read()
+    except OSError:
+        return records, 0, False
+    off = 0
+    n = len(buf)
+    while off < n:
+        if off + _HEADER > n:
+            torn = True
+            break
+        (plen,) = _LEN.unpack_from(buf, off)
+        if plen > _MAX_PAYLOAD or off + _HEADER + plen > n:
+            torn = True
+            break
+        crc = buf[off + _LEN.size : off + _HEADER]
+        payload = buf[off + _HEADER : off + _HEADER + plen]
+        if checksum_bytes(payload).encode("ascii") != crc:
+            torn = True
+            break
+        try:
+            rec = json.loads(payload.decode("utf-8"))
+        except (UnicodeDecodeError, ValueError):
+            torn = True
+            break
+        records.append(rec)
+        off += _HEADER + plen
+        valid = off
+    return records, valid, torn
+
+
+@dataclasses.dataclass
+class WalEntry:
+    """Folded per-request WAL state (the scan/replay state machine):
+    the latest admit descriptor, accumulated progress, and the terminal
+    outcome if any. An admit AFTER a terminal reopens the entry (fleet
+    re-dispatch; the latest admission is the live one)."""
+
+    wal_id: str
+    admit: dict
+    emitted: int = 0
+    tokens: list = dataclasses.field(default_factory=list)  # [step][suffix]
+    kv: dict | None = None
+    outcome: str | None = None  # None = open (replay candidate)
+
+    @property
+    def open(self) -> bool:
+        return self.outcome is None
+
+
+def fold_records(records) -> dict[str, WalEntry]:
+    """Dedup-by-request-id fold, in log order. Later records win:
+    a terminal closes the entry; a subsequent admit for the same id
+    REOPENS it with fresh descriptor/progress (re-dispatch semantics)."""
+    entries: dict[str, WalEntry] = {}
+    for rec in records:
+        wid = rec.get("id")
+        kind = rec.get("k")
+        if not wid or kind not in ("admit", "progress", "terminal"):
+            continue
+        e = entries.get(wid)
+        if kind == "admit":
+            if e is None or e.outcome is not None:
+                entries[wid] = WalEntry(wal_id=wid, admit=rec)
+            else:
+                e.admit = rec  # duplicate admit while open: refresh
+        elif e is not None:
+            if kind == "progress":
+                if e.outcome is not None:
+                    continue  # stray post-terminal progress never reopens
+                e.emitted = int(rec.get("emitted", e.emitted))
+                delta = rec.get("tok_delta")
+                if delta:
+                    e.tokens.extend(delta)
+                if rec.get("kv") is not None:
+                    e.kv = rec["kv"]
+            else:  # terminal
+                e.outcome = str(rec.get("outcome", "failed"))
+    return entries
+
+
+class RequestWAL:
+    """Append-only request ledger over rotating checksummed segments.
+
+    Thread-safe: admission runs on submitter threads, progress/terminal
+    on the engine thread, compaction wherever a terminal lands. One lock
+    orders the frames (a WAL whose records interleave mid-frame is
+    garbage); the writes are short appends on an already-open fd, the
+    same trade the event journal makes."""
+
+    def __init__(self, wal_dir: str, fsync: str = "admit",
+                 max_segment_bytes: int = 64 * 1024 * 1024):
+        if fsync not in FSYNC_POLICIES:
+            raise ValueError(
+                f"wal_fsync must be one of {FSYNC_POLICIES}, got {fsync!r}"
+            )
+        if max_segment_bytes < 4096:
+            raise ValueError("wal_max_mb too small: segment floor is 4 KiB")
+        self.wal_dir = wal_dir
+        self.fsync_policy = fsync
+        self.max_segment_bytes = int(max_segment_bytes)
+        os.makedirs(wal_dir, exist_ok=True)
+        self._lock = threading.Lock()
+        self._boot = os.urandom(4).hex()  # wal_id uniqueness across boots
+        self._seq = 0  # guarded by: _lock
+        self._f = None  # guarded by: _lock
+        self._cur_path: str | None = None  # guarded by: _lock
+        self._cur_bytes = 0  # guarded by: _lock
+        self._cur_ids: set[str] = set()  # guarded by: _lock
+        # sealed segments: [(path, ids mentioned)] — compaction input.
+        self._sealed: list[tuple[str, set[str]]] = []  # guarded by: _lock
+        # id -> terminal? : the global liveness view compaction consults.
+        self._terminal: dict[str, bool] = {}  # guarded by: _lock
+        # counters (stats())
+        self.records_written = 0  # guarded by: _lock
+        self.bytes_written = 0  # guarded by: _lock
+        self.fsyncs = 0  # guarded by: _lock
+        self.rotations = 0  # guarded by: _lock
+        self.torn_tails = 0  # guarded by: _lock
+        self.segments_compacted = 0  # guarded by: _lock
+        self.write_errors = 0  # guarded by: _lock
+        # Uncontended at construction (no other thread holds a reference
+        # yet), but the scan mutates guarded state, so take the lock.
+        with self._lock:
+            self._next_index = self._scan_existing()
+
+    # -- startup scan ------------------------------------------------------
+
+    def _segment_paths(self) -> list[str]:
+        try:
+            names = sorted(
+                n for n in os.listdir(self.wal_dir)
+                if n.startswith(SEGMENT_PREFIX) and n.endswith(SEGMENT_SUFFIX)
+            )
+        except OSError:
+            names = []
+        return [os.path.join(self.wal_dir, n) for n in names]
+
+    def _scan_existing(self) -> int:
+        """Index prior-boot segments: seal them (this boot appends only
+        to its own fresh segment), seed the terminal map for compaction,
+        truncate torn tails in place, and pick the next segment index."""
+        # flscheck: holds=_lock: constructor-only — __init__ takes the lock around the single call site
+        last = -1
+        for path in self._segment_paths():
+            name = os.path.basename(path)
+            try:
+                last = max(last, int(name[len(SEGMENT_PREFIX):-len(SEGMENT_SUFFIX)]))
+            except ValueError:
+                continue
+            records, valid, torn = read_segment(path)
+            if torn:
+                self.torn_tails += 1
+                try:
+                    os.truncate(path, valid)
+                except OSError:
+                    pass  # read-only dir: the scan-side truncation is enough
+                obs_events.emit(
+                    "wal_torn_tail", segment=name, valid_bytes=valid,
+                    records=len(records),
+                )
+            ids = set()
+            for rec in records:
+                wid = rec.get("id")
+                if not wid:
+                    continue
+                ids.add(wid)
+                if rec.get("k") == "terminal":
+                    self._terminal[wid] = True
+                elif rec.get("k") == "admit":
+                    self._terminal[wid] = False
+            self._sealed.append((path, ids))
+        return last + 1
+
+    def scan(self) -> dict[str, WalEntry]:
+        """Fold EVERY segment (sealed + current) into per-request entries
+        — the replay input. Safe to call at any time; recovery calls it
+        once at startup, before the engine serves."""
+        with self._lock:
+            paths = [p for p, _ in self._sealed]
+            if self._cur_path is not None:
+                if self._f is not None:
+                    self._f.flush()  # flscheck: disable=LOCK-IO: short flush of an already-open fd; scan must see every record this boot wrote
+                paths.append(self._cur_path)
+        records: list[dict] = []
+        for path in paths:
+            recs, _, _ = read_segment(path)
+            records.extend(recs)
+        return fold_records(records)
+
+    # -- write path --------------------------------------------------------
+
+    def _open_segment_locked(self) -> None:
+        # flscheck: holds=_lock: internal helper — every caller already owns the lock
+        path = os.path.join(
+            self.wal_dir,
+            f"{SEGMENT_PREFIX}{self._next_index:08d}{SEGMENT_SUFFIX}",
+        )
+        self._next_index += 1
+        self._f = open(path, "ab")  # flscheck: disable=LOCK-IO: segment open is rare (rotation) and must be ordered with the frames around it
+        self._cur_path = path
+        self._cur_bytes = 0
+        self._cur_ids = set()
+
+    def _write(self, rec: dict, sync: bool) -> None:
+        payload = json.dumps(rec, separators=(",", ":")).encode("utf-8")
+        frame = _frame(payload)
+        with self._lock:
+            try:
+                if self._f is None:
+                    self._open_segment_locked()
+                elif (
+                    self._cur_bytes
+                    and self._cur_bytes + len(frame) > self.max_segment_bytes
+                ):
+                    self._f.close()  # flscheck: disable=LOCK-IO: rotation close; frames must never interleave across the segment boundary
+                    self._sealed.append((self._cur_path, self._cur_ids))
+                    self.rotations += 1
+                    self._open_segment_locked()
+                self._f.write(frame)  # flscheck: disable=LOCK-IO: one short append; frame ordering requires the lock (event-journal precedent)
+                # flush() unconditionally: the kernel holds the bytes, so
+                # a SIGKILL'd process loses at most the record in flight.
+                self._f.flush()  # flscheck: disable=LOCK-IO: kernel handoff is the SIGKILL durability floor
+                if sync:
+                    os.fsync(self._f.fileno())
+                    self.fsyncs += 1
+                self._cur_bytes += len(frame)
+                self._cur_ids.add(rec["id"])
+                self.records_written += 1
+                self.bytes_written += len(frame)
+                wid = rec["id"]
+                self._terminal[wid] = rec["k"] == "terminal"
+            except OSError:
+                # A WAL write failure (ENOSPC, yanked volume) must never
+                # fail the request being served — durability degrades to
+                # a counted drop, exactly the flight-recorder contract.
+                self.write_errors += 1
+
+    # -- record emitters ---------------------------------------------------
+
+    def admit(self, req: Request) -> str:
+        """Record one admission (write-AHEAD: called before the request
+        joins the queue) and attach the terminal hook. A request that
+        already carries a ``wal_id`` (fleet re-dispatch, replayed after
+        restart) keeps it — the new admit record REOPENS the id."""
+        if req.wal_id is None:
+            with self._lock:
+                self._seq += 1
+                req.wal_id = f"{self._boot}-{self._seq}"
+        req.on_terminal = self._on_request_terminal
+        now = time.monotonic()
+        self._write(
+            {
+                "k": "admit",
+                "id": req.wal_id,
+                "ts": time.time(),
+                "prefix": req.prefix,
+                "suffixes": list(req.suffixes),
+                "max_new_tokens": int(req.max_new_tokens),
+                # REMAINING seconds, never an absolute instant: monotonic
+                # deadlines don't survive a process, and wall-clock
+                # deadlines don't survive clock skew. Replay re-arms from
+                # this duration (SchedCore.replay_deadline).
+                "deadline_left_s": (
+                    max(req.deadline - now, 0.0)
+                    if req.deadline is not None
+                    else None
+                ),
+                "slo": req.slo_class,
+                "tenant": req.tenant_id,
+                "adapter": req.adapter_id,
+                "client_id": req.client_id,
+                "dispatch_id": req.dispatch_id,
+            },
+            sync=self.fsync_policy in ("always", "admit"),
+        )
+        return req.wal_id
+
+    def progress(self, req: Request, tok_delta=None, kv=None) -> None:
+        """Record sweep-boundary progress: the emitted-token watermark,
+        the token ids emitted since the last progress record (a delta,
+        so a request's WAL cost stays linear in its output), and —
+        graceful shutdown only — spilled-KV page refs for warm restart."""
+        if req.wal_id is None:
+            return
+        rec = {
+            "k": "progress",
+            "id": req.wal_id,
+            "ts": time.time(),
+            "emitted": int(req.tokens_emitted),
+        }
+        if tok_delta is not None:
+            rec["tok_delta"] = tok_delta
+        if kv is not None:
+            rec["kv"] = kv
+        self._write(rec, sync=self.fsync_policy == "always")
+
+    def terminal(self, req: Request, outcome: str,
+                 error: BaseException | None = None) -> None:
+        if req.wal_id is None:
+            return
+        rec = {
+            "k": "terminal",
+            "id": req.wal_id,
+            "ts": time.time(),
+            "outcome": outcome,
+        }
+        if error is not None:
+            rec["error"] = f"{type(error).__name__}: {error}"[:200]
+        self._write(rec, sync=self.fsync_policy in ("always", "admit"))
+        self.maybe_compact()
+
+    def _on_request_terminal(self, req: Request,
+                             error: BaseException | None) -> None:
+        """``Request.on_terminal`` hook, fired by resolve()/fail() after
+        the first-wins claim. ``RestartPending`` is the graceful-shutdown
+        resolution — the request must stay OPEN in the WAL so the next
+        boot replays it, so no terminal record is written for it."""
+        if isinstance(error, RestartPending):
+            return
+        self.terminal(req, req.status.value, error)
+
+    # -- compaction --------------------------------------------------------
+
+    def maybe_compact(self) -> int:
+        """Delete sealed segments whose every mentioned request id is
+        terminal RIGHT NOW. An id reopened by a later admit (fleet
+        re-dispatch, replay) reads as non-terminal and pins every
+        segment naming it — compaction can never drop the last trace of
+        a non-terminal request. Returns segments removed."""
+        with self._lock:
+            victims = [
+                (path, ids)
+                for path, ids in self._sealed
+                if all(self._terminal.get(w, False) for w in ids)
+            ]
+            self._sealed = [s for s in self._sealed if s not in victims]
+        removed = 0
+        for path, _ in victims:
+            try:
+                os.remove(path)
+                removed += 1
+            except OSError:
+                pass  # already gone / read-only: retried next compaction
+        if removed:
+            with self._lock:
+                self.segments_compacted += removed
+        return removed
+
+    # -- lifecycle / introspection ----------------------------------------
+
+    def flush(self, sync: bool = True) -> None:
+        with self._lock:
+            if self._f is not None:
+                self._f.flush()  # flscheck: disable=LOCK-IO: shutdown flush must be ordered after the last frame
+                if sync:
+                    try:
+                        os.fsync(self._f.fileno())
+                        self.fsyncs += 1
+                    except OSError:
+                        self.write_errors += 1
+
+    def close(self) -> None:
+        self.flush(sync=True)
+        with self._lock:
+            if self._f is not None:
+                self._f.close()  # flscheck: disable=LOCK-IO: final close, ordered after the flush above
+                self._f = None
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "records_written": self.records_written,
+                "bytes_written": self.bytes_written,
+                "fsyncs": self.fsyncs,
+                "rotations": self.rotations,
+                "torn_tails": self.torn_tails,
+                "segments_compacted": self.segments_compacted,
+                "write_errors": self.write_errors,
+                "segments": len(self._sealed) + (1 if self._f else 0),
+                "open_requests": sum(
+                    1 for t in self._terminal.values() if not t
+                ),
+            }
+
+
+def wal_for(serve_cfg) -> RequestWAL | None:
+    """Build the WAL a ServeConfig asks for (None when ``wal_dir`` is
+    unset — the default: serving stays WAL-free and byte-identical to
+    pre-WAL behavior)."""
+    if not getattr(serve_cfg, "wal_dir", ""):
+        return None
+    return RequestWAL(
+        serve_cfg.wal_dir,
+        fsync=serve_cfg.wal_fsync,
+        max_segment_bytes=int(serve_cfg.wal_max_mb * 1024 * 1024),
+    )
+
+
+__all__ = [
+    "RequestWAL",
+    "WalEntry",
+    "fold_records",
+    "read_segment",
+    "wal_for",
+]

@@ -1078,6 +1078,129 @@ def main() -> int:
         f"adapter_chaos_ok heals={heals} evicted={evicted} failed_tenant=1"
     )
     adapter_loader.reset_process_store()
+
+    # 11) Process-death crash drill (serve/wal.py + serve/recovery.py,
+    # docs/recovery.md): a serve CLI subprocess with a durable request WAL
+    # is SIGKILLed mid-sweep at a seeded point (FLS_WAL_CRASH_SWEEPS —
+    # inside the shard loop, never at a boundary), with a LoRA adapter and
+    # a coalesced shared prefix in flight. A restart over the same WAL dir
+    # must replay every still-open request and the MERGED outputs
+    # (pre-crash completions + replayed, deduped by client id) must be
+    # token-identical to an uninterrupted oracle run. CI greps the
+    # crash_restart_ok marker below.
+    import signal
+    import subprocess
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    driver = (
+        "import os, sys\n"
+        "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "from tests.fake_tokenizer import FakeTokenizer\n"
+        "from flexible_llm_sharding_tpu.cli import serve_main\n"
+        "serve_main(sys.argv[1:], tokenizer=FakeTokenizer())\n"
+    )
+    # tenant-a only: phase 10 corrupted tenant-b's delta file on disk.
+    drill_reqs = [
+        {"id": "c0", "prefix": PROMPTS[0][0], "suffixes": list(PROMPTS[0][1])},
+        {"id": "c1", "prefix": PROMPTS[0][0], "suffixes": list(PROMPTS[0][1])},
+        {"id": "c2", "prefix": PROMPTS[1][0], "suffixes": list(PROMPTS[1][1]),
+         "adapter_id": "tenant-a"},
+        {"id": "c3", "prefix": PROMPTS[2][0], "suffixes": list(PROMPTS[2][1])},
+    ]
+
+    def _serve_proc(wal_dir, reqs, crash_sweeps=0):
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+        env["PYTHONPATH"] = root + os.pathsep + env.get("PYTHONPATH", "")
+        if crash_sweeps:
+            env["FLS_WAL_CRASH_SWEEPS"] = str(crash_sweeps)
+        else:
+            env.pop("FLS_WAL_CRASH_SWEEPS", None)
+        proc = subprocess.Popen(
+            [
+                sys.executable, "-c", driver,
+                "--model_path", model_dir,
+                "--wal_dir", wal_dir,
+                "--adapter_dir", adapter_root,
+                "--max_new_tokens", "3",
+                "--dtype", "float32",
+                "--bucket_multiple", "8",
+                "--block_size", "2",
+                "--prefetch_depth", "0",
+                "--max_wave_requests", "4",
+                "--sched",  # prefix coalescing on: c0/c1 share one prefill
+                "--stats_interval_s", "0",
+            ],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL, env=env, cwd=root, text=True,
+        )
+        out, _ = proc.communicate(
+            "".join(json.dumps(d) + "\n" for d in reqs), timeout=600
+        )
+        replies = {}
+        for ln in out.splitlines():
+            try:
+                d = json.loads(ln)
+            except ValueError:
+                continue
+            if d.get("status") == "done" and "client_id" in d:
+                replies[d["client_id"]] = d
+        return replies, proc.returncode
+
+    crash_oracle, rc = _serve_proc(
+        os.path.join(tmp, "wal_oracle"), drill_reqs
+    )
+    if rc != 0 or len(crash_oracle) != len(drill_reqs):
+        print(
+            f"FAIL: crash-drill oracle run rc={rc} "
+            f"completed={len(crash_oracle)}/{len(drill_reqs)}",
+            file=sys.stderr,
+        )
+        return 1
+    wal_dir = os.path.join(tmp, "wal_drill")
+    crashed, rc = _serve_proc(wal_dir, drill_reqs, crash_sweeps=2)
+    if rc != -signal.SIGKILL:
+        print(
+            f"FAIL: crash drill did not die by SIGKILL (rc={rc})",
+            file=sys.stderr,
+        )
+        return 1
+    if len(crashed) >= len(drill_reqs):
+        print(
+            "FAIL: crash fired too late — nothing was in flight",
+            file=sys.stderr,
+        )
+        return 1
+    replayed, rc = _serve_proc(wal_dir, [])
+    if rc != 0:
+        print(f"FAIL: restart run rc={rc}", file=sys.stderr)
+        return 1
+    merged = dict(crashed)
+    merged.update(replayed)  # at-least-once: replayed dupes overwrite
+    for d in drill_reqs:
+        cid = d["id"]
+        got = merged.get(cid)
+        if got is None:
+            print(
+                f"FAIL: request {cid} vanished across the crash",
+                file=sys.stderr,
+            )
+            return 1
+        if (
+            got["tokens"] != crash_oracle[cid]["tokens"]
+            or got["updated_suffixes"]
+            != crash_oracle[cid]["updated_suffixes"]
+        ):
+            print(
+                f"FAIL: request {cid} diverged from the uninterrupted "
+                "oracle after crash+replay",
+                file=sys.stderr,
+            )
+            return 1
+    print(f"crash_restart_ok replayed={len(replayed)}")
     return 0
 
 

@@ -118,6 +118,15 @@ FLOOR_RULES = {
     # as a hard <0.05 ceiling inside the bench phase itself, because the
     # healthy fraction (~1e-4) rounds any recorded-value floor to zero.
     "adapter_overhead_ratio": 0.85,
+    # Crash-safe serving (ISSUE 18): WAL-off wall / WAL-on wall on the
+    # identical small serve session under the default fsync policy
+    # (admit/terminal fsync only; sweep-boundary progress rides the
+    # kernel buffers). Advisory: the healthy value IS parity — WAL
+    # writes are per request event and per sweep boundary, never per
+    # token/shard — so a hard floor near 1.0 would flake on runner
+    # noise; what the tripwire watches is journaling or fsync creeping
+    # onto the per-shard hot path.
+    "wal_overhead_ratio": 0.85,
 }
 
 # Ratios whose loss-of-mechanism signature is "collapses to parity": the
@@ -148,6 +157,7 @@ ADVISORY = {
     "recorder_overhead_ratio",
     "spec_mechanism_speedup",
     "adapter_overhead_ratio",
+    "wal_overhead_ratio",
 }
 
 # Hard metrics with a sub-parity WARN band: the hard floor derives from
@@ -195,6 +205,7 @@ def measure() -> dict:
         bench_spec,
         bench_spec_serve,
         bench_trace_overhead,
+        bench_wal_overhead,
         make_model,
         make_prompts,
     )
@@ -236,6 +247,7 @@ def measure() -> dict:
     bench_mixedprec(result, model_path, prompts, tok, budget, fw)
     bench_trace_overhead(result, prompts, tok, budget, fw)
     bench_recorder_overhead(result, prompts, tok, budget, fw)
+    bench_wal_overhead(result, prompts, tok, budget, fw)
     bench_reference_schedule(jax, fw(None), prompts, tok, result, budget)
     # Speculative decoding (ISSUE 13): small token/draft budgets — the
     # gate needs the mechanism witnessed, not the full-depth measurement

@@ -1,0 +1,524 @@
+"""Crash-safe serving (serve/wal.py, serve/recovery.py): the durable
+request ledger and token-identical warm restart.
+
+The contract under test: every accepted request either completes or
+survives in the WAL; a torn tail (process died mid-write) truncates and
+is never fatal; compaction can never drop the last trace of a
+non-terminal request; replay after a restart re-serves every open
+request bit-identically to an uninterrupted run (greedy decode from the
+original prompt) with deadlines re-armed from recorded REMAINING
+seconds, so wall-clock skew between boots cannot expire anything; and a
+SIGKILL mid-sweep — the process-death chaos drill — loses nothing the
+client was owed."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from flexible_llm_sharding_tpu.config import FrameworkConfig, ServeConfig
+from flexible_llm_sharding_tpu.models import llama
+from flexible_llm_sharding_tpu.runtime import kvpool
+from flexible_llm_sharding_tpu.runtime.decode import DecodeGenerator
+from flexible_llm_sharding_tpu.runtime.schedcore import SchedCore
+from flexible_llm_sharding_tpu.serve import (
+    AdmissionQueue,
+    Request,
+    RequestStatus,
+    RequestWAL,
+    RestartPending,
+    ServeEngine,
+    recovery,
+)
+from flexible_llm_sharding_tpu.serve.wal import fold_records, read_segment
+from flexible_llm_sharding_tpu.utils.checkpoint import save_params
+from flexible_llm_sharding_tpu.utils.metrics import ServingMetrics
+
+from tests.fake_tokenizer import FakeTokenizer
+
+N_GEN = 3
+
+PROMPTS = [
+    ("The capital of France", (" is Paris", " is Rome")),
+    ("Two plus two equals", (" four", " five")),
+    ("The sky is", (" blue", " green")),
+    ("Hello world", (" again", " anew")),
+]
+
+
+@pytest.fixture(autouse=True)
+def _pool_hygiene():
+    kvpool.reset_process_pools()
+    yield
+    kvpool.reset_process_pools()
+
+
+@pytest.fixture(scope="module")
+def model(tiny_cfg, tmp_path_factory):
+    params = llama.init_params(jax.random.PRNGKey(0), tiny_cfg)
+    d = tmp_path_factory.mktemp("tiny_model_wal")
+    save_params(jax.tree.map(np.asarray, params), str(d), tiny_cfg)
+    return str(d), params
+
+
+def _fw(model_dir, **kw):
+    base = dict(
+        model_path=model_dir,
+        layer_num_per_shard=1,
+        storage_location="cpu",
+        dtype="float32",
+        bucket_multiple=8,
+        block_size=2,
+        prefetch_depth=0,
+        num_gen_token=N_GEN,
+    )
+    base.update(kw)
+    return FrameworkConfig(**base)
+
+
+def _req(**kw) -> Request:
+    base = dict(prefix="p", suffixes=("s",), max_new_tokens=4)
+    base.update(kw)
+    return Request(**base)
+
+
+# ---------------------------------------------------------------------------
+# Record format: framing, scan, torn tails
+# ---------------------------------------------------------------------------
+
+def test_wal_roundtrip_and_terminal_hook(tmp_path):
+    """Admit/progress/terminal round-trip through the segment format; the
+    terminal hook fired by resolve()/fail() keeps the ledger in sync, and
+    a RestartPending failure deliberately leaves the entry OPEN."""
+    wal = RequestWAL(str(tmp_path / "wal"))
+    done, parked = _req(client_id="c-1"), _req()
+    wal.admit(done)
+    wal.admit(parked)
+    done.tokens_emitted = 1
+    wal.progress(done, tok_delta=[[5, 6]])
+    # resolve()/fail() fire the terminal hook -> ledger record...
+    assert done.resolve(
+        np.zeros((1, 1, 2)), ("p", ["s"]), np.zeros((1, 1), np.int32)
+    )
+    # ...except RestartPending, which must leave the entry OPEN.
+    assert parked.fail(
+        RestartPending("restarting"), RequestStatus.CANCELLED
+    )
+
+    entries = wal.scan()
+    assert set(entries) == {done.wal_id, parked.wal_id}
+    assert not entries[done.wal_id].open
+    assert entries[done.wal_id].outcome == "done"
+    assert entries[done.wal_id].tokens == [[5, 6]]
+    assert entries[done.wal_id].admit["client_id"] == "c-1"
+    assert entries[parked.wal_id].open
+    st = wal.stats()
+    assert st["records_written"] == 4
+    assert st["open_requests"] == 1
+    wal.close()
+
+
+def test_torn_tail_truncated_mid_record_never_fatal(tmp_path):
+    """Chop the newest segment mid-frame (the process died mid-write):
+    the next boot's scan truncates the tail in place, keeps every record
+    before it, counts + journals the tear — and never raises."""
+    d = str(tmp_path / "wal")
+    wal = RequestWAL(d)
+    reqs = [_req() for _ in range(3)]
+    for r in reqs:
+        wal.admit(r)
+    wal.close()
+
+    (seg,) = [
+        os.path.join(d, n) for n in os.listdir(d) if n.startswith("wal-")
+    ]
+    _, valid, torn = read_segment(seg)
+    assert not torn
+    os.truncate(seg, valid - 7)  # mid-frame: inside the last admit record
+
+    wal2 = RequestWAL(d)  # scan-side truncation happens here
+    assert wal2.stats()["torn_tails"] == 1
+    assert os.path.getsize(seg) < valid - 7  # physically cut to last frame
+    entries = wal2.scan()
+    # The two complete admits survive; the torn third is gone — it was
+    # never acknowledged, so losing it is the contract, not data loss.
+    assert set(entries) == {reqs[0].wal_id, reqs[1].wal_id}
+    records, _, torn = read_segment(seg)
+    assert len(records) == 2 and not torn
+    wal2.close()
+
+
+def test_fold_dedup_reopen_and_stray_progress():
+    """The scan/replay state machine: a terminal closes the id (replay
+    dedup for completed-but-unacked requests), a LATER admit reopens it
+    (fleet re-dispatch), and a stray post-terminal progress record must
+    never reopen a completed request."""
+    recs = [
+        {"k": "admit", "id": "a", "ts": 1.0, "prefix": "p1"},
+        {"k": "progress", "id": "a", "emitted": 2},
+        {"k": "terminal", "id": "a", "outcome": "done"},
+        {"k": "admit", "id": "b", "ts": 2.0, "prefix": "p2"},
+        # stray progress after a's terminal: engine raced the crash
+        {"k": "progress", "id": "a", "emitted": 3},
+    ]
+    entries = fold_records(recs)
+    assert not entries["a"].open and entries["a"].emitted == 2
+    assert entries["b"].open
+
+    # Re-admission after terminal (same id) reopens with fresh state.
+    entries = fold_records(
+        recs + [{"k": "admit", "id": "a", "ts": 3.0, "prefix": "p1"}]
+    )
+    assert entries["a"].open and entries["a"].emitted == 0
+
+
+def test_replay_deadline_remaining_seconds_immune_to_clock_skew(tmp_path):
+    """Deadlines cross the restart as REMAINING durations, never
+    instants: the admit record stores seconds left at admission, and
+    replay re-arms from 'now' — so downtime is forgiven and a wall-clock
+    jump between boots (ts fields lying by hours) changes nothing."""
+    wal = RequestWAL(str(tmp_path / "wal"))
+    r = _req(deadline=time.monotonic() + 30.0)
+    wal.admit(r)
+    wal.close()
+    entry = RequestWAL(str(tmp_path / "wal")).scan()[r.wal_id]
+    left = entry.admit["deadline_left_s"]
+    assert 29.0 < left <= 30.0
+    # ts is wall-clock and may be garbage across boots — prove replay
+    # ignores it by rearming against an arbitrary 'now'.
+    entry.admit["ts"] = entry.admit["ts"] - 86400.0
+    rebuilt = recovery.build_request(entry, now=1000.0)
+    assert rebuilt.deadline == pytest.approx(1000.0 + left)
+    assert rebuilt.wal_id == r.wal_id
+
+    # Once ADMITTED (any progress), the TTFT contract is history: replay
+    # carries no deadline at all rather than expiring committed work.
+    entry.emitted = 1
+    assert recovery.build_request(entry, now=1000.0).deadline is None
+
+    core = SchedCore()
+    assert core.replay_deadline(None) is None
+    assert core.replay_deadline(5.0, now=100.0) == 105.0
+    assert core.replay_deadline(-3.0, now=100.0) == 100.0  # clamped
+
+
+def test_compaction_never_drops_nonterminal_record(tmp_path):
+    """Segments rotate at 4 KiB; sealed segments whose every id is
+    terminal compact away — but ANY open id mentioned in a segment pins
+    it, so the last trace of a non-terminal request can never vanish."""
+    wal = RequestWAL(str(tmp_path / "wal"), max_segment_bytes=4096)
+    survivor = _req(prefix="keepme")
+    wal.admit(survivor)
+    for _ in range(60):  # ~300 bytes/record: forces several rotations
+        r = _req(prefix="x" * 64)
+        wal.admit(r)
+        wal.terminal(r, "done")
+    st = wal.stats()
+    assert st["rotations"] >= 2
+    assert st["segments_compacted"] >= 1  # all-terminal segments went
+    # The survivor's segment (segment 0) is pinned by its open id.
+    entries = wal.scan()
+    assert entries[survivor.wal_id].open
+    assert entries[survivor.wal_id].admit["prefix"] == "keepme"
+
+    wal.terminal(survivor, "done")
+    wal.flush()
+    # Everything terminal: a fresh boot sees sealed segments it can drop.
+    wal2 = RequestWAL(str(tmp_path / "wal"), max_segment_bytes=4096)
+    wal2.maybe_compact()
+    assert wal2.stats()["open_requests"] == 0
+    assert wal2.scan() == {} or all(
+        not e.open for e in wal2.scan().values()
+    )
+    wal.close()
+    wal2.close()
+
+
+# ---------------------------------------------------------------------------
+# Admission queue: write-ahead + graceful-shutdown parking
+# ---------------------------------------------------------------------------
+
+def test_queue_writes_ahead_and_parks_on_persist_close(tmp_path):
+    """Queued-but-never-admitted requests survive a graceful restart:
+    close(drain=False, persist=True) fails them RestartPending (no
+    terminal record — the WAL keeps them open for replay), while a
+    capacity reject writes a terminal so it is NOT replayed."""
+    wal = RequestWAL(str(tmp_path / "wal"))
+    metrics = ServingMetrics()
+    q = AdmissionQueue(capacity=2, metrics=metrics, wal=wal)
+    kept = [_req(), _req()]
+    for r in kept:
+        assert q.submit(r).status is RequestStatus.QUEUED
+    rejected = q.submit(_req())
+    assert rejected.status is RequestStatus.REJECTED
+
+    q.close(drain=False, persist=True)
+    for r in kept:
+        assert r.status is RequestStatus.CANCELLED
+        with pytest.raises(RestartPending):
+            r.future.result(timeout=1)
+
+    entries = wal.scan()
+    assert entries[rejected.wal_id].outcome == "rejected"
+    open_ids = {w for w, e in entries.items() if e.open}
+    assert open_ids == {r.wal_id for r in kept}
+    wal.close()
+
+
+# ---------------------------------------------------------------------------
+# KV pool: durable export/restore for warm restart
+# ---------------------------------------------------------------------------
+
+def test_kvpool_export_restore_roundtrip_and_corruption(tmp_path):
+    """export_entry writes checksummed page files + JSON-able refs; a
+    FRESH pool restores them bit-identically (counted). A corrupted page
+    file fails the restore closed — counted, never raised — and the
+    caller re-prefills."""
+    def mk_pool():
+        return kvpool.KVPagePool(
+            page_tokens=4, budget_bytes=1 << 30,
+            spill_dir=str(tmp_path / "spill"), host_spill=True,
+        )
+
+    rng = np.random.default_rng(7)
+    k = rng.standard_normal((2, 16, 2, 4)).astype(np.float32)
+    v = rng.standard_normal((2, 16, 2, 4)).astype(np.float32)
+    ids = tuple(range(10, 26))
+
+    pool = mk_pool()
+    h = pool.acquire(ids, 16, 16)
+    pool.contribute(h, (0, 0), k, v)
+    pool.seal(h)
+    refs = pool.export_entry(h, str(tmp_path / "walkv"), ids)
+    pool.release(h)
+    assert refs is not None and refs["dtype"] == "float32"
+    assert json.loads(json.dumps(refs)) == refs  # WAL-record-able
+    assert pool.stats()["entries_exported"] == 1
+
+    fresh = mk_pool()
+    assert fresh.restore_entry(refs)
+    assert fresh.stats()["entries_restored"] == 1
+    h2 = fresh.acquire(ids, 16, 16)
+    assert h2.reusable  # the restore sealed it: prefill becomes a hit
+    k2, v2 = fresh.assemble(h2, (0, 0))
+    np.testing.assert_array_equal(k2, k)
+    np.testing.assert_array_equal(v2, v)
+    fresh.release(h2)
+
+    # Flip bytes in one exported page: restore must fail closed.
+    victim = refs["segs"][0][1]
+    with open(victim, "r+b") as f:
+        f.seek(128)
+        f.write(b"\xff" * 8)
+    broken = mk_pool()
+    assert not broken.restore_entry(refs)
+    assert broken.stats()["restore_failures"] == 1
+    h3 = broken.acquire(ids, 16, 16)
+    assert not h3.reusable  # nothing half-restored is servable
+    broken.release(h3)
+
+
+# ---------------------------------------------------------------------------
+# Engine: graceful restart is token-identical
+# ---------------------------------------------------------------------------
+
+def test_graceful_restart_replays_token_identically(model, tmp_path):
+    """shutdown_for_restart mid-service parks queued AND in-flight
+    requests (RestartPending, WAL entries open); a second engine over the
+    same WAL dir replays them through the normal scheduler core and every
+    merged result — completed-before-restart or replayed — is
+    token-identical to the uninterrupted offline oracle."""
+    model_dir, _ = model
+    cfg = _fw(model_dir)
+    off_scores, off_updated = DecodeGenerator(
+        cfg, tokenizer=FakeTokenizer()
+    )(list(PROMPTS))
+
+    wal_dir = str(tmp_path / "wal")
+    serve_cfg = ServeConfig(
+        max_wave_requests=2,
+        max_active_requests=2,  # 4 submits -> 2 in flight, 2 queued
+        default_max_new_tokens=N_GEN,
+        wal_dir=wal_dir,
+    )
+    engine = ServeEngine(cfg, serve_cfg, tokenizer=FakeTokenizer())
+    reqs = [
+        engine.submit(p, s, client_id=i)
+        for i, (p, s) in enumerate(PROMPTS)
+    ]
+    deadline = time.monotonic() + 120
+    while engine.metrics.counter("prefills") < 1:
+        assert time.monotonic() < deadline, "first wave never prefilled"
+        time.sleep(0.01)
+    assert engine.shutdown_for_restart(timeout=300)
+    assert engine.error is None
+
+    results = {}
+    for r in reqs:
+        if r.status is RequestStatus.DONE:
+            results[r.client_id] = r.future.result(timeout=1)
+        else:
+            with pytest.raises(RestartPending):
+                r.future.result(timeout=1)
+    engine._wal.close()
+
+    # The restart: a fresh engine over the same WAL dir.
+    engine2 = ServeEngine(cfg, serve_cfg, tokenizer=FakeTokenizer())
+    try:
+        summary = recovery.replay(engine2, engine2._wal)
+        assert summary["replayed"] == len(PROMPTS) - len(results)
+        assert summary["replayed"] >= 1  # the restart interrupted work
+        assert summary["skipped_terminal"] == len(results)
+        for rr in summary["requests"]:
+            results[rr.client_id] = rr.future.result(timeout=300)
+        assert engine2.drain(timeout=300)
+    finally:
+        engine2.shutdown(drain=False)
+    assert engine2.error is None
+
+    assert set(results) == set(range(len(PROMPTS)))
+    for i in range(len(PROMPTS)):
+        res = results[i]
+        assert res.updated == off_updated[i]
+        assert (res.scores.argmax(-1) == off_scores[i].argmax(-1)).all()
+        np.testing.assert_allclose(
+            res.scores, off_scores[i], rtol=1e-5, atol=1e-6
+        )
+    # Everything served: nothing left open for a third boot to replay.
+    assert engine2._wal.stats()["open_requests"] == 0
+
+
+# ---------------------------------------------------------------------------
+# Process-death chaos drill: SIGKILL mid-sweep, restart, merge, compare
+# ---------------------------------------------------------------------------
+
+_DRIVER = """
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+from tests.fake_tokenizer import FakeTokenizer
+from flexible_llm_sharding_tpu.cli import serve_main
+serve_main(sys.argv[1:], tokenizer=FakeTokenizer())
+"""
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _serve_proc(model_dir, wal_dir, adapter_dir, lines, crash_sweeps=0):
+    """One serve CLI process over the JSONL frontend. Returns (replies
+    keyed by client id, returncode)."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    env["PYTHONPATH"] = _ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    if crash_sweeps:
+        env["FLS_WAL_CRASH_SWEEPS"] = str(crash_sweeps)
+    else:
+        env.pop("FLS_WAL_CRASH_SWEEPS", None)
+    cmd = [
+        sys.executable, "-c", _DRIVER,
+        "--model_path", model_dir,
+        "--wal_dir", wal_dir,
+        "--adapter_dir", adapter_dir,
+        "--max_new_tokens", str(N_GEN),
+        "--dtype", "float32",
+        "--bucket_multiple", "8",
+        "--block_size", "2",
+        "--prefetch_depth", "0",
+        "--max_wave_requests", "4",
+        "--sched",  # prefix coalescing on: shared prefixes in flight
+        "--stats_interval_s", "0",
+    ]
+    proc = subprocess.Popen(
+        cmd, stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL, env=env, cwd=_ROOT, text=True,
+    )
+    try:
+        out, _ = proc.communicate(
+            "".join(json.dumps(d) + "\n" for d in lines), timeout=600
+        )
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        raise
+    replies = {}
+    for ln in out.splitlines():
+        try:
+            d = json.loads(ln)
+        except ValueError:
+            continue
+        if d.get("status") == "done" and "client_id" in d:
+            replies[d["client_id"]] = d
+    return replies, proc.returncode
+
+
+@pytest.mark.slow
+def test_crash_drill_sigkill_then_restart_merges_token_identically(
+    model, tmp_path
+):
+    """The drill the WAL exists for: SIGKILL the serve process mid-sweep
+    (seeded via FLS_WAL_CRASH_SWEEPS — inside shard iteration, not at a
+    boundary), restart over the same WAL dir, and the merged outputs
+    (pre-crash completions + replayed) are token-identical to an
+    uninterrupted run — with a LoRA adapter and a shared (coalesced)
+    prefix in flight at the kill."""
+    from flexible_llm_sharding_tpu.adapters.registry import save_adapter
+
+    model_dir, _ = model
+    rng = np.random.default_rng(11)
+    adapter_dir = str(tmp_path / "adapters")
+    save_adapter(
+        adapter_dir,
+        "tenant-a",
+        {
+            f"model.layers.{i}": (
+                (0.05 * rng.standard_normal((64, 2))).astype(np.float32),
+                (0.05 * rng.standard_normal((2, 64))).astype(np.float32),
+            )
+            for i in range(4)
+        },
+    )
+    lines = [
+        # Two requests sharing one prefix: coalesced into one shared
+        # prefill; the crash lands while they are in flight together.
+        {"id": "c0", "prefix": PROMPTS[0][0], "suffixes": list(PROMPTS[0][1])},
+        {"id": "c1", "prefix": PROMPTS[0][0], "suffixes": list(PROMPTS[0][1])},
+        {"id": "c2", "prefix": PROMPTS[1][0], "suffixes": list(PROMPTS[1][1]),
+         "adapter_id": "tenant-a"},
+        {"id": "c3", "prefix": PROMPTS[2][0], "suffixes": list(PROMPTS[2][1])},
+    ]
+
+    oracle, rc = _serve_proc(
+        model_dir, str(tmp_path / "wal_oracle"), adapter_dir, lines
+    )
+    assert rc == 0 and set(oracle) == {"c0", "c1", "c2", "c3"}
+
+    wal_dir = str(tmp_path / "wal")
+    crashed, rc = _serve_proc(
+        model_dir, wal_dir, adapter_dir, lines, crash_sweeps=2
+    )
+    assert rc == -signal.SIGKILL, "the drill must actually die by SIGKILL"
+    assert len(crashed) < len(lines), "crash too late: nothing in flight"
+
+    replayed, rc = _serve_proc(model_dir, wal_dir, adapter_dir, [])
+    assert rc == 0
+    assert set(replayed) >= set(lines_d["id"] for lines_d in lines) - set(
+        crashed
+    ), "replay lost an owed request"
+
+    merged = dict(crashed)
+    merged.update(replayed)  # at-least-once: replayed dupes overwrite
+    for d in lines:
+        cid = d["id"]
+        assert merged[cid]["tokens"] == oracle[cid]["tokens"], cid
+        assert (
+            merged[cid]["updated_suffixes"]
+            == oracle[cid]["updated_suffixes"]
+        ), cid
