@@ -124,6 +124,7 @@ HEADLINE_KEYS = (
     "kv_prefix_reuse_frac",
     "adapter_overhead_ratio",
     "adapter_delta_bytes_frac",
+    "fleet_stagger_convergence",
     "host_stream_zero_copy_warm_gbps",
     "host_stream_zero_copy_cold_gbps",
     "host_stream_cast_warm_gbps",
@@ -296,6 +297,7 @@ RATIO_SINGLETONS = (
     "kv_prefix_reuse_frac",
     "adapter_overhead_ratio",
     "adapter_delta_bytes_frac",
+    "fleet_stagger_convergence",
 )
 
 
@@ -382,6 +384,11 @@ PHASE_EVIDENCE_KEY = {
     # incident recorder armed must not tax the serving hot path
     # (rotation-paired journal-off vs journal-armed serve walls).
     "recorder_overhead": "recorder_overhead_ratio",
+    # ISSUE 19's stagger evidence: the closed-loop phase controller must
+    # converge a deliberately in-phase fleet and re-converge after a
+    # simulated recycle (deterministic synthetic-clock loop over the
+    # real controller; no hardware in the loop).
+    "stagger": "fleet_stagger_convergence",
 }
 
 
@@ -1327,6 +1334,73 @@ def bench_wal_overhead(
         _shutil.rmtree(wal_dir, ignore_errors=True)
 
 
+def bench_fleet_stagger(result: dict) -> None:
+    """Closed-loop sweep-stagger evidence (serve/autoscale.py,
+    docs/autoscale.md): the controller must pull an in-phase fleet to
+    the i/N offsets and RE-converge after a membership perturbation.
+
+    ``fleet_stagger_convergence``: 1 - final stagger error of a
+    deterministic two-replica closed loop — synthetic sweep clocks feed
+    the REAL controller through its injected ``now``/``observe``
+    surface, and its boundary holds feed back into the synthetic
+    schedules. Both replicas start dead in phase (error 1.0, the
+    worst case), must converge below tolerance, then a simulated
+    recycle (membership change + a 0.25-sweep phase jump) must
+    re-converge. Structural and timing-free (no wall clocks anywhere):
+    a healthy controller lands ~1.0; the hold math disengaging leaves
+    the initial error standing, which no runner noise can fake. The
+    phase refuses to record a value unless holds were actually applied
+    in BOTH rounds — convergence without actuation would mean the sim
+    went in-phase by accident, not that the controller works.
+    """
+    from flexible_llm_sharding_tpu.config import AutoscaleConfig
+    from flexible_llm_sharding_tpu.serve.autoscale import StaggerController
+
+    ctl = StaggerController(
+        AutoscaleConfig(enabled=True, stagger_tolerance=0.05)
+    )
+    wall = 1.0
+    nxt = {0: 0.0, 1: 0.0}  # next shard-0 boundary arrival
+    start = {0: 0.0, 1: 0.0}  # current sweep start (after any hold)
+    t = 0.0
+    err = 1.0
+    holds_by_round = [0, 0]
+    for step in range(800):
+        t = round(t + 0.1, 6)
+        if step == 400:
+            # Mid-sim recycle: the fleet drops the pending holds and the
+            # "new" replica comes back wherever chaos put it.
+            ctl.note_membership_change()
+            nxt[1] = round(nxt[1] + 0.25 * wall, 6)
+            start[1] = nxt[1] - wall
+        for idx in (0, 1):
+            while t >= nxt[idx]:
+                hold = ctl.on_boundary(idx, nxt[idx])
+                if hold > 0.0:
+                    holds_by_round[0 if step < 400 else 1] += 1
+                start[idx] = nxt[idx] + hold
+                nxt[idx] = round(start[idx] + wall, 6)
+        phases = {
+            i: min(max((t - start[i]) / wall, 0.0), 0.999) for i in (0, 1)
+        }
+        err = ctl.observe(phases)
+    stats = ctl.stats()
+    if holds_by_round[0] < 1 or holds_by_round[1] < 1:
+        log(
+            f"fleet stagger: controller never actuated "
+            f"(holds_by_round={holds_by_round}, stats={stats}) — "
+            f"refusing to record"
+        )
+        return
+    result["fleet_stagger_convergence"] = round(1.0 - err, 3)
+    log(
+        f"fleet stagger: convergence="
+        f"{result['fleet_stagger_convergence']} (final error "
+        f"{stats['stagger_error']}, holds={stats['holds_applied']}, "
+        f"restaggers={stats['restaggers']})"
+    )
+
+
 def _timed(fn) -> float:
     t0 = time.perf_counter()
     fn()
@@ -2179,6 +2253,12 @@ def run_bench(result: dict) -> None:
         log("skipping wal-overhead bench (already captured)")
     else:
         bench_wal_overhead(result, prompts, tok, budget_left, fw)
+
+    if "stagger" in skip:
+        log("skipping fleet-stagger bench (already captured)")
+    else:
+        # Deterministic synthetic-clock loop — costs milliseconds.
+        bench_fleet_stagger(result)
 
     # Host->HBM link bandwidth: the binding constraint of weight streaming;
     # makes every throughput number legible (the axon tunnel runs ~100x

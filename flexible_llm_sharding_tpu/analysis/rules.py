@@ -329,12 +329,15 @@ def guarded_by(info: FileInfo, ctx: ProjectContext) -> list[Finding]:
 _FLAG_ALIASES = {
     "max_new_tokens": ("ServeConfig", "default_max_new_tokens"),
     "deadline_s": ("ServeConfig", "default_deadline_s"),
+    # store_true negation: the flag DISABLES the stagger field.
+    "autoscale_no_stagger": ("AutoscaleConfig", "stagger"),
 }
 _CHAOS_PREFIX = "chaos_"
 _PRESSURE_PREFIX = "pressure_"
 _SCHED_PREFIX = "sched_"
 _SLO_PREFIX = "slo_"
 _ADAPTER_PREFIX = "adapter_"
+_AUTOSCALE_PREFIX = "autoscale_"
 
 # cli.py functions that thread parsed args into config constructions.
 _BATCH_READERS = (
@@ -351,6 +354,7 @@ _SERVE_READERS = (
     "_adapter_config_from_args",
     "_sched_config_from_args",
     "_slo_config_from_args",
+    "_autoscale_config_from_args",
 )
 
 
@@ -437,11 +441,10 @@ def _args_reads(tree: ast.Module) -> dict[str, dict[str, int]]:
 
 @project_rule(
     "KNOB-SYNC",
-    "every FrameworkConfig/ServeConfig/SchedConfig/SLOConfig/FaultConfig/"
-    "PressureConfig/AdapterConfig flag exists in both CLI parsers (or is "
-    "declared "
-    "single-parser; serving-only classes are exempt), maps to a real "
-    "field, and is threaded into the construction",
+    "every FrameworkConfig/ServeConfig/SchedConfig/SLOConfig/AutoscaleConfig/"
+    "FaultConfig/PressureConfig/AdapterConfig flag exists in both CLI parsers "
+    "(or is declared single-parser; serving-only classes are exempt), maps to "
+    "a real field, and is threaded into the construction",
 )
 def knob_sync(ctx: ProjectContext) -> list[Finding]:
     cli = ctx.get("cli.py")
@@ -462,6 +465,7 @@ def knob_sync(ctx: ProjectContext) -> list[Finding]:
     sc = _class_fields(config.tree, "SchedConfig")
     oc = _class_fields(config.tree, "SLOConfig")
     ac = _class_fields(config.tree, "AdapterConfig")
+    uc = _class_fields(config.tree, "AutoscaleConfig")
     flags = _parser_flags(cli.tree)
     batch = flags.get("build_parser", {})
     serve = flags.get("build_serve_parser", {})
@@ -498,6 +502,15 @@ def knob_sync(ctx: ProjectContext) -> list[Finding]:
             return ("SLOConfig", "enabled") if "enabled" in oc else ("?", flag)
         if flag.startswith(_SLO_PREFIX) and flag[len(_SLO_PREFIX):] in oc:
             return ("SLOConfig", flag[len(_SLO_PREFIX):])
+        if flag == "autoscale":
+            return (
+                ("AutoscaleConfig", "enabled") if "enabled" in uc else ("?", flag)
+            )
+        if (
+            flag.startswith(_AUTOSCALE_PREFIX)
+            and flag[len(_AUTOSCALE_PREFIX):] in uc
+        ):
+            return ("AutoscaleConfig", flag[len(_AUTOSCALE_PREFIX):])
         # AdapterConfig (multi-tenant LoRA, adapters/): a SHARED runtime
         # subsystem like FaultConfig/PressureConfig, so adapter_ flags
         # fall through to the both-parsers requirement below.
@@ -505,7 +518,7 @@ def knob_sync(ctx: ProjectContext) -> list[Finding]:
             return ("AdapterConfig", flag[len(_ADAPTER_PREFIX):])
         if flag in _FLAG_ALIASES:
             cls, field = _FLAG_ALIASES[flag]
-            fields = sv if cls == "ServeConfig" else fw
+            fields = {"ServeConfig": sv, "AutoscaleConfig": uc}.get(cls, fw)
             return (cls, field) if field in fields else ("?", flag)
         if parser_name == "serve" and flag in sv:
             return ("ServeConfig", flag)
@@ -539,7 +552,9 @@ def knob_sync(ctx: ProjectContext) -> list[Finding]:
                     )
                 )
                 continue
-            if cls in ("ServeConfig", "SchedConfig", "SLOConfig"):
+            if cls in (
+                "ServeConfig", "SchedConfig", "SLOConfig", "AutoscaleConfig"
+            ):
                 continue  # serving knobs are inherently serve-parser-only
             # "Shared" means the OTHER parser's same-named flag sets the
             # SAME field: a flag name reused for a different config class
@@ -638,6 +653,7 @@ def knob_sync(ctx: ProjectContext) -> list[Finding]:
         # subsystems, so their reads validate against the serve parser.
         ("_sched_config_from_args", "serve", serve),
         ("_slo_config_from_args", "serve", serve),
+        ("_autoscale_config_from_args", "serve", serve),
     ):
         for attr, line in sorted(reads.get(fn_name, {}).items()):
             if attr not in parser:

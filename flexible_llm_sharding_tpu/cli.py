@@ -405,6 +405,106 @@ def _slo_config_from_args(args: argparse.Namespace):
     )
 
 
+def _add_autoscale_flags(p: argparse.ArgumentParser) -> None:
+    """Serve parser only: closed-loop fleet elasticity + sweep-phase
+    stagger (serve/autoscale.py; docs/autoscale.md)."""
+    p.add_argument("--autoscale", action="store_true",
+                   help="enable the fleet autoscaler: a control loop "
+                        "polls SLO burn rate, queue depth, and the "
+                        "brownout pressure level and grows/drains the "
+                        "replica fleet between --autoscale_min/max with "
+                        "anti-flap hysteresis (consecutive-poll "
+                        "confirmation, per-direction cooldowns) and hard "
+                        "interlocks (never grow at shed-or-above "
+                        "pressure; never shrink below min or over an "
+                        "in-flight drain; WAL replay completes first). "
+                        "Also engages the sweep-phase stagger controller "
+                        "(replica offsets held at i/N so worst-case "
+                        "admission wait is sweep/N). Off = static fleet, "
+                        "free-drifting phases")
+    p.add_argument("--autoscale_min", type=int, default=1,
+                   help="fleet size floor the controller may drain to")
+    p.add_argument("--autoscale_max", type=int, default=4,
+                   help="fleet size ceiling the controller may grow to")
+    p.add_argument("--autoscale_poll_s", type=float, default=1.0,
+                   help="controller poll interval in seconds (decisions "
+                        "at most once per poll)")
+    p.add_argument("--autoscale_grow_burn_rate", type=float, default=1.0,
+                   help="grow when the worst per-class SLO burn rate "
+                        "sustains at or above this (1.0 = spending the "
+                        "entire error budget)")
+    p.add_argument("--autoscale_grow_queue_frac", type=float, default=0.75,
+                   help="grow when queue depth / capacity sustains at or "
+                        "above this fraction")
+    p.add_argument("--autoscale_shrink_burn_rate", type=float, default=0.25,
+                   help="shrink only when burn rate AND queue fraction "
+                        "are both below their shrink thresholds "
+                        "(hysteresis: must be <= the grow threshold)")
+    p.add_argument("--autoscale_shrink_queue_frac", type=float, default=0.10,
+                   help="queue-fraction half of the shrink band "
+                        "(must be <= the grow fraction)")
+    p.add_argument("--autoscale_confirm_polls", type=int, default=3,
+                   help="a breach must persist this many CONSECUTIVE "
+                        "polls before the controller acts — one spiky "
+                        "sample never scales the fleet")
+    p.add_argument("--autoscale_grow_cooldown_s", type=float, default=10.0,
+                   help="after any scale action, grow again only after "
+                        "this many seconds")
+    p.add_argument("--autoscale_shrink_cooldown_s", type=float, default=30.0,
+                   help="after any scale action, shrink only after this "
+                        "many seconds (longer than grow by default: "
+                        "capacity is cheap to hold, expensive to miss)")
+    p.add_argument("--autoscale_dry_run", action="store_true",
+                   help="journal every decision (autoscale_* events with "
+                        "dry_run=true) without acting — shadow-mode "
+                        "rehearsal before trusting the loop")
+    p.add_argument("--autoscale_no_stagger", action="store_true",
+                   help="disable the sweep-phase stagger controller "
+                        "(replica offsets drift free again)")
+    p.add_argument("--autoscale_stagger_tolerance", type=float,
+                   default=0.15,
+                   help="normalized stagger error at or under this "
+                        "counts as converged (0 = perfect i/N spread, "
+                        "1 = all replicas in phase)")
+    p.add_argument("--autoscale_stagger_hold_max_frac", type=float,
+                   default=0.5,
+                   help="cap on a single boundary hold as a fraction of "
+                        "one measured sweep wall")
+
+
+def _autoscale_config_from_args(args: argparse.Namespace):
+    from flexible_llm_sharding_tpu.config import AutoscaleConfig
+
+    if not args.autoscale:
+        return AutoscaleConfig()
+    return AutoscaleConfig(
+        enabled=True,
+        min=args.autoscale_min,
+        max=args.autoscale_max,
+        poll_s=args.autoscale_poll_s,
+        grow_burn_rate=args.autoscale_grow_burn_rate,
+        grow_queue_frac=args.autoscale_grow_queue_frac,
+        shrink_burn_rate=args.autoscale_shrink_burn_rate,
+        shrink_queue_frac=args.autoscale_shrink_queue_frac,
+        confirm_polls=args.autoscale_confirm_polls,
+        grow_cooldown_s=args.autoscale_grow_cooldown_s,
+        shrink_cooldown_s=args.autoscale_shrink_cooldown_s,
+        dry_run=args.autoscale_dry_run,
+        stagger=not args.autoscale_no_stagger,
+        stagger_tolerance=args.autoscale_stagger_tolerance,
+        stagger_hold_max_frac=args.autoscale_stagger_hold_max_frac,
+    )
+
+
+def _serve_wants_fleet(serve_cfg) -> bool:
+    """True when serve must run the replica fleet instead of a single
+    engine: more than one replica, or elasticity requested. The
+    autoscaler lives in ReplicaFleet, and "start at one replica, grow
+    under load" is the canonical elastic config — gating on the replica
+    count alone would silently disable ``--autoscale`` exactly there."""
+    return serve_cfg.replicas > 1 or serve_cfg.autoscale.enabled
+
+
 def _sched_config_from_args(args: argparse.Namespace):
     from flexible_llm_sharding_tpu.config import SchedConfig
 
@@ -739,6 +839,7 @@ def build_serve_parser() -> argparse.ArgumentParser:
     _add_observability_flags(p)
     _add_sched_flags(p)
     _add_slo_flags(p)
+    _add_autoscale_flags(p)
     # Demo driver: submit a prompt pickle at staggered times, write the
     # offline-contract outputs. Without it, requests are read as JSON lines
     # from stdin: {"prefix": ..., "suffixes": [...], "max_new_tokens": N}.
@@ -816,6 +917,7 @@ def serve_main(argv: list[str] | None = None, tokenizer=None) -> None:
         wal_max_mb=args.wal_max_mb,
         sched=_sched_config_from_args(args),
         slo=_slo_config_from_args(args),
+        autoscale=_autoscale_config_from_args(args),
     )
     if tokenizer is None:
         from transformers import AutoTokenizer
@@ -832,7 +934,7 @@ def serve_main(argv: list[str] | None = None, tokenizer=None) -> None:
     # --replicas > 1 swaps the single engine for the replica fleet
     # (serve/fleet.py) — same submit/drain/shutdown/stats surface, so the
     # demo and jsonl frontends below drive either interchangeably.
-    if serve_cfg.replicas > 1:
+    if _serve_wants_fleet(serve_cfg):
         engine = ReplicaFleet(cfg, serve_cfg, tokenizer=tokenizer)
     else:
         engine = ServeEngine(cfg, serve_cfg, tokenizer=tokenizer)
@@ -852,19 +954,24 @@ def serve_main(argv: list[str] | None = None, tokenizer=None) -> None:
     wal = getattr(engine, "_wal", None)
 
     def _replay_open(callback=None) -> None:
-        if wal is None:
-            return
-        from flexible_llm_sharding_tpu.serve import recovery
+        if wal is not None:
+            from flexible_llm_sharding_tpu.serve import recovery
 
-        summary = recovery.replay(engine, wal, callback=callback)
-        print(
-            f"wal replay: {summary['replayed']} reopened, "
-            f"{summary['skipped_terminal']} already terminal, "
-            f"kv restored {summary['kv_restored']} "
-            f"(failed {summary['kv_failed']})",
-            file=sys.stderr,
-            flush=True,
-        )
+            summary = recovery.replay(engine, wal, callback=callback)
+            print(
+                f"wal replay: {summary['replayed']} reopened, "
+                f"{summary['skipped_terminal']} already terminal, "
+                f"kv restored {summary['kv_restored']} "
+                f"(failed {summary['kv_failed']})",
+                file=sys.stderr,
+                flush=True,
+            )
+        # Autoscaler interlock: the controller's first scale decision
+        # waits until replay has re-admitted the owed work (idempotent;
+        # a fleet without a controller no-ops).
+        mark = getattr(engine, "mark_replay_complete", None)
+        if mark is not None:
+            mark()
 
     import signal as _signal
 

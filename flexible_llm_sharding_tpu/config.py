@@ -1483,6 +1483,104 @@ class SLOConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class AutoscaleConfig:
+    """Closed-loop fleet elasticity (serve/autoscale.py; docs/autoscale.md
+    has the interlock table and stagger math). Off by default — the fleet
+    then stays at the static ``replicas`` count, exactly the pre-autoscale
+    behaviour.
+
+    Enabled, a ``FleetAutoscaler`` control loop polls the signals the repo
+    already trusts under chaos — SLO burn rate (obs/slo.py), queue depth
+    watermarks, and the brownout pressure level (runtime/pressure.py) —
+    and drives ``add_replica``/``remove_replica(drain=True)`` between
+    ``min``/``max``, with anti-flap machinery (consecutive-poll
+    confirmation, separate grow/shrink cooldowns) and hard interlocks
+    (never grow at shed-or-above pressure, never shrink below min or over
+    an in-flight drain, WAL replay completes before the first decision).
+    The same config carries the sweep-phase stagger controller: replicas
+    hold at their shard-0 boundary (bounded) until their sweep offsets sit
+    at i/N, so worst-case admission wait drops to sweep/N."""
+
+    enabled: bool = False
+    # Fleet size bounds the controller may move between. The static
+    # ``--replicas`` count is the starting population and must sit inside
+    # [min, max] (cross-validated by ServeConfig).
+    min: int = 1
+    max: int = 4
+    # Controller poll interval (seconds) — decisions are made at most
+    # once per poll, and confirmation counts in polls.
+    poll_s: float = 1.0
+    # Grow when the worst per-class SLO burn rate sustains at or above
+    # this (burn 1.0 = spending the whole error budget) OR the queue
+    # depth fraction sustains at or above grow_queue_frac.
+    grow_burn_rate: float = 1.0
+    grow_queue_frac: float = 0.75
+    # Shrink only when burn AND queue are BOTH below these (hysteresis:
+    # the shrink thresholds sit well under the grow ones, so a reading
+    # between the bands holds steady instead of oscillating).
+    shrink_burn_rate: float = 0.25
+    shrink_queue_frac: float = 0.10
+    # A breach must persist this many CONSECUTIVE polls before acting —
+    # a single spiky sample never scales the fleet.
+    confirm_polls: int = 3
+    # Per-direction cooldowns (seconds) after ANY scale action: grow
+    # again only after grow_cooldown_s, shrink only after
+    # shrink_cooldown_s (shrink waits longer by default — capacity is
+    # cheap to hold and expensive to miss).
+    grow_cooldown_s: float = 10.0
+    shrink_cooldown_s: float = 30.0
+    # Journal every decision without acting (autoscale_* events carry
+    # dry_run=True) — the shadow-mode rehearsal before trusting the loop.
+    dry_run: bool = False
+    # --- sweep-phase stagger (ROADMAP item 4: sweep/N admission wait) ---
+    # Control replica sweep offsets to i/N via bounded boundary holds.
+    stagger: bool = True
+    # Normalized stagger error (0 = perfect i/N spread, 1 = all replicas
+    # in phase) at or under this counts as converged; the controller only
+    # injects holds while above it.
+    stagger_tolerance: float = 0.15
+    # Per-boundary hold cap as a fraction of one measured sweep wall —
+    # a hold can never stall a replica longer than this per sweep.
+    stagger_hold_max_frac: float = 0.5
+
+    def __post_init__(self) -> None:
+        if self.min < 1:
+            raise ValueError("autoscale min must be >= 1")
+        if self.max < self.min:
+            raise ValueError("autoscale max must be >= min")
+        if self.poll_s <= 0:
+            raise ValueError("autoscale poll_s must be > 0")
+        if self.grow_burn_rate < 0 or self.shrink_burn_rate < 0:
+            raise ValueError("autoscale burn-rate thresholds must be >= 0")
+        if self.shrink_burn_rate > self.grow_burn_rate:
+            raise ValueError(
+                "autoscale shrink_burn_rate must be <= grow_burn_rate "
+                "(the hysteresis band would invert)"
+            )
+        for name in ("grow_queue_frac", "shrink_queue_frac"):
+            v = getattr(self, name)
+            if not 0.0 <= v <= 1.0:
+                raise ValueError(f"autoscale {name} must be in [0, 1]")
+        if self.shrink_queue_frac > self.grow_queue_frac:
+            raise ValueError(
+                "autoscale shrink_queue_frac must be <= grow_queue_frac "
+                "(the hysteresis band would invert)"
+            )
+        if self.confirm_polls < 1:
+            raise ValueError("autoscale confirm_polls must be >= 1")
+        if self.grow_cooldown_s < 0 or self.shrink_cooldown_s < 0:
+            raise ValueError("autoscale cooldowns must be >= 0")
+        if not 0.0 < self.stagger_tolerance <= 1.0:
+            raise ValueError(
+                "autoscale stagger_tolerance must be in (0, 1]"
+            )
+        if not 0.0 <= self.stagger_hold_max_frac <= 1.0:
+            raise ValueError(
+                "autoscale stagger_hold_max_frac must be in [0, 1]"
+            )
+
+
+@dataclasses.dataclass(frozen=True)
 class ServeConfig:
     """Online-serving knobs (the ``serve`` CLI subcommand / serve.engine).
 
@@ -1585,6 +1683,16 @@ class ServeConfig:
     # exports — burn-rate/remaining-budget gauges (fls_slo_*) plus a
     # journal event (and, armed, an incident bundle) on exhaustion.
     slo: SLOConfig = dataclasses.field(default_factory=SLOConfig)
+    # Closed-loop fleet elasticity + sweep-phase stagger
+    # (serve/autoscale.py; --autoscale* flags): an SLO-burn/queue/
+    # pressure-driven controller moves the fleet between autoscale.min
+    # and autoscale.max with anti-flap hysteresis and hard interlocks,
+    # and holds replica sweep offsets at i/N so worst-case admission
+    # wait stays sweep/N. Off by default — the fleet stays at
+    # ``replicas`` and phases drift free, the pre-autoscale behaviour.
+    autoscale: AutoscaleConfig = dataclasses.field(
+        default_factory=AutoscaleConfig
+    )
     # --- crash-safe serving (serve/wal.py + serve/recovery.py) ---------
     # Durable request WAL directory ("" = off, the default): every
     # admission/progress/terminal transition appends a crc-framed record;
@@ -1644,6 +1752,14 @@ class ServeConfig:
             raise ValueError(
                 "ServeConfig.speculative_k must be in [0, 64], got "
                 f"{self.speculative_k}"
+            )
+        if self.autoscale.enabled and not (
+            self.autoscale.min <= self.replicas <= self.autoscale.max
+        ):
+            raise ValueError(
+                "replicas must sit inside [autoscale.min, autoscale.max] "
+                f"when autoscaling is enabled, got replicas={self.replicas} "
+                f"bounds=[{self.autoscale.min}, {self.autoscale.max}]"
             )
         if self.wal_fsync not in ("always", "admit", "never"):
             raise ValueError(

@@ -82,6 +82,10 @@ EVENT_KINDS = {
     "pressure_event": "error",       # hard resource event (OOM / ENOSPC)
     # SLO error budgets (obs/slo.py)
     "slo_budget_exhausted": "error",  # a class burned its error budget
+    # fleet autoscaler (serve/autoscale.py)
+    "autoscale_grow": "info",        # controller added a replica
+    "autoscale_shrink": "info",      # controller started a graceful drain
+    "autoscale_blocked": "warning",  # a wanted action hit an interlock
     # the incident recorder itself (obs/incident.py)
     "incident_capture": "info",      # a bundle landed on disk
     # crash-safe serving (serve/wal.py, serve/recovery.py, serve/engine.py)

@@ -31,6 +31,7 @@ hot path pays nothing for SLO accounting.
 
 from __future__ import annotations
 
+import collections
 import threading
 import time
 
@@ -41,6 +42,12 @@ from flexible_llm_sharding_tpu.obs import events as obs_events
 P95_ALLOWED_VIOLATION = 0.05
 # Exhaustion latch re-arms below this burn rate (hysteresis).
 REARM_BURN_RATE = 0.5
+# Worst-burn observations kept for burn_rate_trend() (one per stats()
+# evaluation — scrape / stats line / rate-limited sweep probe).
+TREND_HISTORY = 32
+# A windowed burn delta inside +/- this band reads as flat — scrape
+# jitter must not register as a direction.
+TREND_FLAT_BAND = 0.05
 
 
 def _p95(samples: list[float]) -> float:
@@ -91,6 +98,11 @@ class SLOTracker:
         self._latched: set = set()  # guarded by: _lock
         self._last_check = 0.0  # guarded by: _lock
         self.budget_exhausted_events = 0  # guarded by: _lock
+        # Worst burn rate per stats() evaluation, newest last — the
+        # burn_rate_trend() window. guarded by: _lock
+        self._burn_history: collections.deque = collections.deque(
+            maxlen=TREND_HISTORY
+        )
 
     # -- accounting --------------------------------------------------------
 
@@ -120,8 +132,15 @@ class SLOTracker:
         out["token_latency"] = tok
         self._judge("token_latency", tok, exhausted)
         out["availability"] = self._availability(exhausted)
+        worst = max(
+            [e["burn_rate"] for e in ttft.values()]
+            + [tok["burn_rate"], out["availability"]["burn_rate"]]
+        )
+        out["worst_burn_rate"] = worst
         with self._lock:
             out["budget_exhausted_events"] = self.budget_exhausted_events
+            self._burn_history.append(worst)
+        out["trend"] = self.burn_rate_trend()
         for key, entry in exhausted:
             metric, _, cls = key.partition(":")
             obs_events.emit(
@@ -171,6 +190,24 @@ class SLOTracker:
             elif not burning and entry["burn_rate"] < REARM_BURN_RATE:
                 self._latched.discard(key)
 
+    def burn_rate_trend(self, k: int = 8) -> dict:
+        """Windowed burn direction over the last ``k`` worst-burn
+        observations (one per :meth:`stats` evaluation): the autoscaler's
+        transient-spike filter — a single hot scrape reads flat until the
+        burn SUSTAINS across the window. Pre-seeded numeric (rising /
+        falling flags + signed delta) so the ``fls_slo_*`` family carries
+        it before the first sample. ``delta`` is newest - oldest inside
+        the window; a magnitude inside ``TREND_FLAT_BAND`` is flat."""
+        with self._lock:
+            window = list(self._burn_history)[-max(2, k):]
+        delta = window[-1] - window[0] if len(window) >= 2 else 0.0
+        return {
+            "window": len(window),
+            "burn_delta": round(delta, 4),
+            "rising": int(delta > TREND_FLAT_BAND),
+            "falling": int(delta < -TREND_FLAT_BAND),
+        }
+
     # -- hot-path probe ----------------------------------------------------
 
     def maybe_check(self, interval_s: float = 1.0) -> None:
@@ -188,4 +225,10 @@ class SLOTracker:
         self.stats()
 
 
-__all__ = ["P95_ALLOWED_VIOLATION", "REARM_BURN_RATE", "SLOTracker"]
+__all__ = [
+    "P95_ALLOWED_VIOLATION",
+    "REARM_BURN_RATE",
+    "SLOTracker",
+    "TREND_FLAT_BAND",
+    "TREND_HISTORY",
+]

@@ -355,6 +355,17 @@ class BrownoutController:
     def _level_of(self, name: str) -> int:
         return self.LADDER.index(name) + 1
 
+    def at_or_above(self, name: str) -> bool:
+        """True while the ladder is engaged at ``name``'s level or
+        higher. The public interlock probe (the autoscaler must never
+        grow the fleet while pressure says the MACHINE is the
+        bottleneck — at shed, adding a replica adds memory pressure,
+        not capacity). Unknown names raise: a typo'd interlock stage
+        must fail loudly, not read as 'never engaged'."""
+        level = self._level_of(name)  # raises ValueError on unknown
+        with self._lock:
+            return self.level >= level
+
     def on_sample(self, snap: PressureSnapshot) -> None:
         """One poll: decide under the lock, act (engage/release) outside
         it. Called from the monitor thread (or directly by tests).

@@ -1,0 +1,495 @@
+"""Closed-loop fleet elasticity + controlled sweep-phase stagger.
+
+The architecture's worst-case admission wait is one full model sweep
+(PAPER.md: requests join at shard-0 boundaries). Two controllers close
+the two loops the repo previously left open:
+
+:class:`FleetAutoscaler` — fleet SIZE. A daemon poll (the
+``PressureMonitor`` shape: injectable clock + samplers, so tests drive
+it deterministically) reads the signals the repo already trusts under
+chaos — the worst per-class SLO burn rate and its windowed trend
+(obs/slo.py), the aggregate admission-queue depth fraction, and the
+brownout pressure ladder (runtime/pressure.py) — and drives
+``ReplicaFleet.add_replica`` / ``remove_replica(drain=True)`` between
+``AutoscaleConfig.min`` and ``.max``. A feedback loop over a serving
+fleet is only safe with anti-flap machinery, all of it here:
+
+- **Consecutive-poll confirmation**: a breach must persist
+  ``confirm_polls`` polls before any action; one spiky sample never
+  scales the fleet. The SLO burn half of the grow signal additionally
+  requires the windowed burn trend not be *falling* — a transient spike
+  already draining does not buy a replica.
+- **Hysteresis**: the shrink thresholds sit strictly under the grow
+  thresholds (config-validated), so readings between the bands hold
+  steady instead of oscillating; grow and shrink carry SEPARATE
+  cooldowns measured from the last action in either direction.
+- **Hard interlocks**: never grow while the pressure ladder is engaged
+  at shed or above (pressure says the MACHINE is the bottleneck — a new
+  replica adds memory pressure, not capacity); never shrink below
+  ``min`` or while a drain is already in flight; no decision at all
+  until WAL replay has re-admitted the owed work.
+- **Dry run**: journals every decision (``dry_run=True`` fields)
+  without acting — shadow mode for rehearsing thresholds in production.
+
+Every decision is emitted through obs/events.py (``autoscale_grow`` /
+``autoscale_shrink`` / ``autoscale_blocked``), so incident bundles
+capture the scaling history; blocked emissions latch per reason so a
+standing interlock journals once, not once per poll.
+
+:class:`StaggerController` — fleet PHASE. With N replicas the
+admission-wait bound only drops to sweep/N if the replicas' sweep
+phases actually sit at offsets i/N; left alone they drift (and after a
+failover recycle they are wherever chaos put them). The fleet measures
+each busy replica's phase from its ``sweep_position()`` watermark, this
+controller computes the normalized *stagger error* (0 = perfect i/N
+spread, 1 = all replicas in phase — the circular-gap deviation, see
+:func:`stagger_error`), and corrects drift by assigning **bounded
+boundary holds**: at its next shard-0 boundary a replica sleeps at most
+``stagger_hold_max_frac`` of its own measured sweep wall, which shifts
+its phase backward relative to its free-running peers. Corrections are
+applied one round at a time (assign, wait for every hold to be
+consumed, re-measure), so an overshoot from a noisy wall estimate is
+corrected the next round instead of compounding. The fleet re-staggers
+after every membership change. The whole loop is pinned by the
+``fls_fleet_stagger_error`` gauge and exploited by the router: a
+pending hold is admission distance, so it rides the ``boundary_frac``
+score term (``hold_frac`` in the replica snapshot).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from flexible_llm_sharding_tpu.obs import events as obs_events
+from flexible_llm_sharding_tpu.obs import trace as obs_trace
+
+
+def stagger_targets(n: int) -> tuple[float, ...]:
+    """Ideal sweep-phase offsets for ``n`` replicas: i/n, the spacing
+    that makes the worst-case shard-0 admission wait sweep/n."""
+    if n < 1:
+        return ()
+    return tuple(i / n for i in range(n))
+
+
+def stagger_error(phases) -> float:
+    """Normalized distance of a phase set from the ideal i/N spread.
+
+    Sort the phases on the unit circle, take the N circular gaps (they
+    sum to 1), and measure total deviation from the ideal 1/N gap:
+    ``sum |gap_i - 1/N| / (2 * (1 - 1/N))``. The denominator is the
+    deviation of the worst case (all replicas in phase: one gap of 1,
+    N-1 gaps of 0), so the result lands in [0, 1] — 0 is a perfect
+    stagger, 1 is no stagger at all. Fewer than two phases are trivially
+    staggered (0.0)."""
+    ps = sorted(p % 1.0 for p in phases)
+    n = len(ps)
+    if n < 2:
+        return 0.0
+    gaps = [ps[i + 1] - ps[i] for i in range(n - 1)]
+    gaps.append(ps[0] + 1.0 - ps[-1])
+    ideal = 1.0 / n
+    dev = sum(abs(g - ideal) for g in gaps)
+    return min(1.0, dev / (2.0 * (1.0 - ideal)))
+
+
+class StaggerController:
+    """Phase-offset controller (module docstring). The fleet owns the
+    measurement (health-poll :meth:`observe`) and the actuation site
+    (``fleet_hook`` shard-0 steps call :meth:`on_boundary`); this class
+    owns the math and the bookkeeping, so it unit-tests without an
+    engine. Registered as the ``fleet`` registry source —
+    ``fls_fleet_stagger_error`` is the convergence pin."""
+
+    # Sweep-wall EMA weight for the newest observation.
+    WALL_ALPHA = 0.5
+
+    def __init__(self, auto_cfg):
+        self.cfg = auto_cfg
+        self._lock = threading.Lock()
+        self.restaggers = 0  # guarded by: _lock
+        self.holds_applied = 0  # guarded by: _lock
+        self.hold_wall_s = 0.0  # guarded by: _lock
+        self.last_error = 0.0  # guarded by: _lock
+        self.converged = True  # guarded by: _lock
+        self._holds: dict[int, float] = {}  # guarded by: _lock
+        self._walls: dict[int, float] = {}  # guarded by: _lock
+        self._last_boundary: dict[int, float] = {}  # guarded by: _lock
+
+    def note_membership_change(self) -> None:
+        """A replica joined, left, or was recycled: pending holds were
+        computed against a topology that no longer exists — drop them
+        and let the next :meth:`observe` re-stagger from fresh phases."""
+        with self._lock:
+            self.restaggers += 1
+            self._holds.clear()
+
+    def forget(self, idx: int) -> None:
+        """Drop a dead/removed replica's per-slot state (its recycled
+        successor carries a new idx and measures its own sweep wall)."""
+        with self._lock:
+            self._holds.pop(idx, None)
+            self._walls.pop(idx, None)
+            self._last_boundary.pop(idx, None)
+
+    def on_boundary(self, idx: int, now: float) -> float:
+        """Called from replica ``idx``'s engine thread at every shard-0
+        step: updates the replica's sweep-wall EMA (boundary-to-boundary
+        wall) and pops its pending hold. Returns the hold duration in
+        seconds (0.0 for none); the caller sleeps it at the boundary."""
+        with self._lock:
+            prev = self._last_boundary.get(idx)
+            self._last_boundary[idx] = now
+            if prev is not None and now > prev:
+                wall = now - prev
+                ema = self._walls.get(idx)
+                self._walls[idx] = (
+                    wall
+                    if ema is None
+                    else (1 - self.WALL_ALPHA) * ema + self.WALL_ALPHA * wall
+                )
+            hold = self._holds.pop(idx, 0.0)
+            if hold > 0.0:
+                self.holds_applied += 1
+                self.hold_wall_s += hold
+        return hold
+
+    def hold_frac(self, idx: int) -> float:
+        """Replica ``idx``'s pending hold as a fraction of its sweep
+        wall — extra admission distance the router folds into its
+        ``boundary_frac`` term (a replica about to hold is farther from
+        admitting than its raw phase says)."""
+        with self._lock:
+            hold = self._holds.get(idx, 0.0)
+            wall = self._walls.get(idx, 0.0)
+        if hold <= 0.0 or wall <= 0.0:
+            return 0.0
+        return min(1.0, hold / wall)
+
+    def observe(self, phases: dict[int, float]) -> float:
+        """One measurement round (fleet health poll): ``phases`` maps
+        replica idx -> sweep phase in [0, 1) for every BUSY serving
+        replica (idle replicas sit at their boundary ready to admit —
+        trivially staggered). Updates the error gauge; above tolerance,
+        assigns one round of bounded holds — but only once the previous
+        round's holds are all consumed, so corrections never stack on
+        unmeasured state."""
+        err = stagger_error(phases.values())
+        with self._lock:
+            self.last_error = err
+            self.converged = err <= self.cfg.stagger_tolerance
+            if self.converged or len(phases) < 2:
+                self._holds.clear()
+                return err
+            if self._holds:
+                return err  # previous correction still in flight
+            # Rank by phase descending and anchor on the most-advanced
+            # replica (it gets no hold): replica j's target offset is
+            # anchor - j/N, and holding for (phase - target) sweeps
+            # shifts it there relative to the free-running anchor.
+            items = sorted(phases.items(), key=lambda kv: -(kv[1] % 1.0))
+            n = len(items)
+            anchor = items[0][1] % 1.0
+            for j, (idx, p) in enumerate(items):
+                target = (anchor - j / n) % 1.0
+                need = ((p % 1.0) - target) % 1.0
+                wall = self._walls.get(idx, 0.0)
+                if need <= 1e-6 or wall <= 0.0:
+                    continue
+                hold = min(need, self.cfg.stagger_hold_max_frac) * wall
+                if hold > 0.0:
+                    self._holds[idx] = hold
+        return err
+
+    def stats(self) -> dict:
+        """The ``fleet`` registry source: ``fls_fleet_stagger_error``
+        (the convergence pin), the converged flag, and the correction
+        counters."""
+        with self._lock:
+            return {
+                "stagger_error": round(self.last_error, 4),
+                "stagger_converged": int(self.converged),
+                "restaggers": self.restaggers,
+                "holds_applied": self.holds_applied,
+                "hold_wall_s": round(self.hold_wall_s, 4),
+                "holds_pending": len(self._holds),
+            }
+
+
+class FleetAutoscaler:
+    """SLO-burn-driven elasticity control loop (module docstring).
+
+    Built and owned by :class:`~flexible_llm_sharding_tpu.serve.fleet.
+    ReplicaFleet` when ``AutoscaleConfig.enabled``; tests construct it
+    directly with an injected clock and samplers and call
+    :meth:`poll_once`. Registered as the ``autoscale`` registry source
+    (``fls_autoscale_*``)."""
+
+    def __init__(
+        self,
+        fleet,
+        auto_cfg,
+        *,
+        clock=time.monotonic,
+        burn_sampler=None,
+        queue_sampler=None,
+        pressure_sampler=None,
+        replay_pending: bool = False,
+    ):
+        self.fleet = fleet
+        self.cfg = auto_cfg
+        self._clock = clock
+        self._burn_sampler = burn_sampler or self._default_burn
+        self._queue_sampler = queue_sampler or self._default_queue_frac
+        self._pressure_sampler = pressure_sampler or self._default_pressure
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._lock = threading.Lock()
+        # Decision counters — all exported by stats() (COUNTER-EXPORT).
+        self.polls = 0  # guarded by: _lock
+        self.grows = 0  # guarded by: _lock
+        self.shrinks = 0  # guarded by: _lock
+        self.blocked = 0  # guarded by: _lock
+        self.dry_run_decisions = 0  # guarded by: _lock
+        # The population the controller is steering toward — what
+        # pressure_restore repopulates to on a runtime-resized fleet.
+        self.target = fleet.population()  # guarded by: _lock
+        self._grow_streak = 0  # guarded by: _lock
+        self._shrink_streak = 0  # guarded by: _lock
+        self._cooldown_grow_until = -1.0  # guarded by: _lock
+        self._cooldown_shrink_until = -1.0  # guarded by: _lock
+        self._blocked_latched: set[str] = set()  # guarded by: _lock
+        self._replay_pending = replay_pending  # guarded by: _lock
+        self._last_burn = 0.0  # guarded by: _lock
+        self._last_queue_frac = 0.0  # guarded by: _lock
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._run, name="fleet-autoscale", daemon=True
+        )
+        self._thread.start()
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.cfg.poll_s):
+            try:
+                self.poll_once()
+            except Exception:  # flscheck: disable=EXC-TAXONOMY: autoscaler daemon — a sampler/decision bug must not kill elasticity control; the fleet keeps serving at its current size and the next poll retries
+                obs_trace.instant("autoscale_poll_error", cat="autoscale")
+
+    def mark_replay_complete(self) -> None:
+        """Open the WAL-replay interlock: the fleet's owed work has been
+        re-admitted, so scale decisions now act on real demand instead of
+        a half-replayed queue. Idempotent; fleets without a WAL construct
+        the controller with the gate already open."""
+        with self._lock:
+            self._replay_pending = False
+
+    # -- default samplers (overridden by tests via the ctor) ---------------
+
+    def _default_burn(self) -> tuple[float, bool]:
+        """(worst per-class burn rate across serving replicas, whether
+        that worst replica's windowed burn trend is falling)."""
+        worst, falling = 0.0, False
+        for eng in self.fleet.serving_engines():
+            s = eng.slo_tracker.stats()
+            burn = s.get("worst_burn_rate", 0.0)
+            if burn >= worst:
+                worst = burn
+                falling = bool(s.get("trend", {}).get("falling", 0))
+        return worst, falling
+
+    def _default_queue_frac(self) -> float:
+        return self.fleet.queue_frac()
+
+    def _default_pressure(self) -> bool:
+        ctrl = getattr(self.fleet, "_pressure", None)
+        return ctrl is not None and ctrl.at_or_above("shed")
+
+    # -- the control loop --------------------------------------------------
+
+    def poll_once(self) -> dict:
+        """One decision cycle. Returns the decision record (tests assert
+        on it; the daemon loop discards it): ``action`` is one of
+        ``grow`` / ``shrink`` / ``blocked:<reason>`` / ``hold``."""
+        now = self._clock()
+        sampled = self._burn_sampler()
+        burn, falling = (
+            sampled if isinstance(sampled, tuple) else (sampled, False)
+        )
+        queue_frac = self._queue_sampler()
+        population = self.fleet.population()
+        # The burn half of the grow signal requires a non-falling trend:
+        # confirmation polls prove the breach PERSISTS, the trend proves
+        # it is not already draining on its own.
+        grow_signal = (
+            burn >= self.cfg.grow_burn_rate and not falling
+        ) or queue_frac >= self.cfg.grow_queue_frac
+        shrink_signal = (
+            burn < self.cfg.shrink_burn_rate
+            and queue_frac < self.cfg.shrink_queue_frac
+        )
+        with self._lock:
+            self.polls += 1
+            self._last_burn = burn
+            self._last_queue_frac = queue_frac
+            self._grow_streak = self._grow_streak + 1 if grow_signal else 0
+            self._shrink_streak = (
+                self._shrink_streak + 1 if shrink_signal else 0
+            )
+            grow_confirmed = self._grow_streak >= self.cfg.confirm_polls
+            shrink_confirmed = (
+                self._shrink_streak >= self.cfg.confirm_polls
+            )
+            replay_pending = self._replay_pending
+            grow_cooling = now < self._cooldown_grow_until
+            shrink_cooling = now < self._cooldown_shrink_until
+        fields = {
+            "population": population,
+            "burn_rate": round(burn, 4),
+            "queue_frac": round(queue_frac, 4),
+            "dry_run": self.cfg.dry_run,
+        }
+        action = "hold"
+        blocked_now: set[str] = set()
+        if grow_confirmed and population < self.cfg.max:
+            if replay_pending:
+                blocked_now.add("replay_pending")
+            elif self._pressure_sampler():
+                # THE capacity-vs-pressure interlock: at shed or above
+                # the machine is the bottleneck; growing would deepen
+                # the brownout the ladder is fighting.
+                blocked_now.add("pressure_shed")
+            elif grow_cooling:
+                blocked_now.add("grow_cooldown")
+            else:
+                action = self._act("grow", now, fields)
+        elif grow_confirmed and population >= self.cfg.max:
+            # Wanting capacity the ceiling refuses is an operator
+            # signal (raise --autoscale_max), not a silent hold.
+            blocked_now.add("at_max")
+        elif shrink_confirmed and population > self.cfg.min:
+            if replay_pending:
+                blocked_now.add("replay_pending")
+            elif self.fleet.drains_in_flight() > 0:
+                blocked_now.add("drain_in_flight")
+            elif shrink_cooling:
+                blocked_now.add("shrink_cooldown")
+            else:
+                action = self._act("shrink", now, fields)
+        # Shrink-confirmed AT min is the normal resting state of an idle
+        # fleet, not an interlock — no event.
+        if blocked_now:
+            action = "blocked:" + ",".join(sorted(blocked_now))
+            self._emit_blocked(blocked_now, fields)
+        else:
+            with self._lock:
+                self._blocked_latched.clear()
+        return {"action": action, **fields}
+
+    def _act(self, direction: str, now: float, fields: dict) -> str:
+        """Perform (or, dry-run, journal) one confirmed, uninterlocked
+        scale action; both cooldowns restart from it and the
+        confirmation streaks reset (the next action needs fresh
+        evidence either way)."""
+        dry = self.cfg.dry_run
+        if not dry:
+            try:
+                if direction == "grow":
+                    self.fleet.add_replica()
+                else:
+                    # Non-blocking: the monitor completes the drain; the
+                    # drain_in_flight interlock keeps this loop from
+                    # stacking a second one on top.
+                    self.fleet.remove_replica(drain=True, timeout=0.0)
+            except (ValueError, RuntimeError):
+                # Lost a race with a concurrent topology change (last
+                # serving replica, fleet closing): skip this cycle — the
+                # next poll re-measures real state.
+                return "hold"
+        # Read the fleet outside this controller's lock (lock order:
+        # never hold autoscaler._lock across a fleet._lock acquisition).
+        population = self.fleet.population()
+        with self._lock:
+            self._grow_streak = 0
+            self._shrink_streak = 0
+            self._cooldown_grow_until = now + self.cfg.grow_cooldown_s
+            self._cooldown_shrink_until = now + self.cfg.shrink_cooldown_s
+            self._blocked_latched.clear()
+            if dry:
+                self.dry_run_decisions += 1
+            elif direction == "grow":
+                self.grows += 1
+                self.target = population
+            else:
+                self.shrinks += 1
+                self.target = max(self.cfg.min, population)
+            target = self.target
+        if direction == "grow":
+            obs_events.emit("autoscale_grow", target=target, **fields)
+            obs_trace.instant(
+                "autoscale_grow", cat="autoscale", target=target, **fields
+            )
+        else:
+            obs_events.emit("autoscale_shrink", target=target, **fields)
+            obs_trace.instant(
+                "autoscale_shrink", cat="autoscale", target=target, **fields
+            )
+        return direction
+
+    def _emit_blocked(self, reasons: set, fields: dict) -> None:
+        """Latched per reason: a standing interlock journals once, and
+        re-arms only after a poll where it no longer blocks."""
+        with self._lock:
+            fresh = reasons - self._blocked_latched
+            self._blocked_latched = set(reasons)
+            self.blocked += len(fresh)
+        for reason in sorted(fresh):
+            obs_events.emit("autoscale_blocked", reason=reason, **fields)
+            obs_trace.instant(
+                "autoscale_blocked", cat="autoscale", reason=reason,
+                **fields,
+            )
+
+    # -- introspection -----------------------------------------------------
+
+    def stats(self) -> dict:
+        """The ``autoscale`` registry source (``fls_autoscale_*``):
+        decision counters, the current target population, streaks, and
+        the last sampled signals — pre-seeded from the first scrape."""
+        with self._lock:
+            return {
+                "enabled": 1,
+                "dry_run": int(self.cfg.dry_run),
+                "polls": self.polls,
+                "grows": self.grows,
+                "shrinks": self.shrinks,
+                "blocked": self.blocked,
+                "dry_run_decisions": self.dry_run_decisions,
+                "target_replicas": self.target,
+                "min_replicas": self.cfg.min,
+                "max_replicas": self.cfg.max,
+                "grow_streak": self._grow_streak,
+                "shrink_streak": self._shrink_streak,
+                "replay_pending": int(self._replay_pending),
+                "last_burn_rate": round(self._last_burn, 4),
+                "last_queue_frac": round(self._last_queue_frac, 4),
+            }
+
+
+__all__ = [
+    "FleetAutoscaler",
+    "StaggerController",
+    "stagger_error",
+    "stagger_targets",
+]

@@ -626,6 +626,14 @@ class ServeEngine:
 
     # -- fleet hooks (serve/fleet.py) --------------------------------------
 
+    @property
+    def slo_tracker(self):
+        """The engine's ``SLOTracker`` (obs/slo.py) — always present
+        (the ``fls_slo_*`` family pre-seeds even with SLO tracking off).
+        The fleet autoscaler reads burn rates and the windowed burn
+        trend through this instead of reaching into ``_slo``."""
+        return self._slo
+
     def sweep_position(self) -> dict:
         """Router/health snapshot, callable from any thread (lock-free
         scalar reads). ``boundary_frac`` is the fraction of a weight sweep

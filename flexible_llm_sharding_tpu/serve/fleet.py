@@ -63,6 +63,10 @@ from flexible_llm_sharding_tpu.obs import events as obs_events
 from flexible_llm_sharding_tpu.obs import incident as obs_incident
 from flexible_llm_sharding_tpu.obs import trace as obs_trace
 from flexible_llm_sharding_tpu.obs.registry import REGISTRY, MetricsServer
+from flexible_llm_sharding_tpu.serve.autoscale import (
+    FleetAutoscaler,
+    StaggerController,
+)
 from flexible_llm_sharding_tpu.serve.engine import ServeEngine
 from flexible_llm_sharding_tpu.serve.request import (
     DeadlineExceeded,
@@ -91,11 +95,12 @@ class _Replica:
     _Replica or dropped). ``release`` unwedges a chaos-stalled engine
     thread so it can observe its closed queue and exit."""
 
-    def __init__(self, idx: int, engine: ServeEngine):
+    def __init__(self, idx: int, engine: ServeEngine, stagger=None):
         self.idx = idx
         self.engine = engine
         self.state = "serving"
         self.release = threading.Event()
+        self.stagger = stagger
         # The exact source object mirrored process-wide, for identity-
         # checked unregistration (a recycled slot must not yank the
         # replacement's registration).
@@ -106,11 +111,20 @@ class _Replica:
         return self.state == "serving"
 
     def snapshot(self) -> dict:
-        """Router scoring inputs (lock-free engine reads)."""
+        """Router scoring inputs (lock-free engine reads).
+        ``hold_frac`` is this replica's pending stagger hold as a
+        fraction of its sweep wall — admission distance the phase term
+        must see (a replica about to hold at its boundary is farther
+        from admitting than its raw phase says)."""
         eng = self.engine
         pos = eng.sweep_position()
         return {
             "boundary_frac": pos["boundary_frac"],
+            "hold_frac": (
+                self.stagger.hold_frac(self.idx)
+                if self.stagger is not None
+                else 0.0
+            ),
             "queue_depth": len(eng.queue),
             "active": eng.batcher.active_requests,
             "max_active": eng.serve_cfg.max_active_requests,
@@ -209,6 +223,26 @@ class ReplicaFleet:
         )
         if self._sched_source is not None:
             REGISTRY.register("sched", self._sched_source)
+        # Closed-loop elasticity + sweep-phase stagger (serve/autoscale
+        # .py; docs/autoscale.md). The stagger controller must exist
+        # BEFORE the replica build loop (each replica's fleet_hook
+        # closes over it); the autoscaler is built after the loop, once
+        # the starting population exists to seed its target. Both are
+        # None unless autoscale.enabled — the fleet then behaves exactly
+        # as before this module existed.
+        auto_cfg = self.serve_cfg.autoscale
+        self._stagger = (
+            StaggerController(auto_cfg)
+            if auto_cfg.enabled and auto_cfg.stagger
+            else None
+        )
+        self._fleet_source = (
+            self._stagger.stats if self._stagger is not None else None
+        )
+        if self._fleet_source is not None:
+            REGISTRY.register("fleet", self._fleet_source)
+        self._autoscaler: FleetAutoscaler | None = None
+        self._autoscale_source = None
         # Process-registry registration: the bound method is kept so
         # shutdown's unregister_if identity check matches.
         self._router_source = self.metrics.snapshot
@@ -226,6 +260,15 @@ class ReplicaFleet:
         except BaseException:
             self.shutdown(drain=False, timeout=1.0)
             raise
+        if auto_cfg.enabled:
+            # The WAL-replay interlock starts closed only when there is
+            # a WAL to replay: the CLI (or embedding host) opens it via
+            # mark_replay_complete() once the owed work is re-admitted.
+            self._autoscaler = FleetAutoscaler(
+                self, auto_cfg, replay_pending=self._wal is not None
+            )
+            self._autoscale_source = self._autoscaler.stats
+            REGISTRY.register("autoscale", self._autoscale_source)
         self._stop = threading.Event()
         self._monitor = threading.Thread(
             target=self._monitor_loop, name="fleet-monitor", daemon=True
@@ -233,6 +276,8 @@ class ReplicaFleet:
         if start:
             self._started = True
             self._monitor.start()
+            if self._autoscaler is not None:
+                self._autoscaler.start()
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -244,6 +289,8 @@ class ReplicaFleet:
             for rep in replicas:
                 rep.engine.start()
             self._monitor.start()
+            if self._autoscaler is not None:
+                self._autoscaler.start()
         return self
 
     def __enter__(self) -> "ReplicaFleet":
@@ -273,6 +320,10 @@ class ReplicaFleet:
     ) -> bool:
         if self._pressure is not None:
             self._pressure.detach_fleet(self)
+        # Stop the autoscaler FIRST: a scale decision landing while the
+        # teardown loop walks the replica list would race it.
+        if self._autoscaler is not None:
+            self._autoscaler.close()
         with self._lock:
             self._closed = True
             pending = list(self._pending)
@@ -301,6 +352,10 @@ class ReplicaFleet:
         REGISTRY.unregister_if("router", self._router_source)
         if self._sched_source is not None:
             REGISTRY.unregister_if("sched", self._sched_source)
+        if self._autoscale_source is not None:
+            REGISTRY.unregister_if("autoscale", self._autoscale_source)
+        if self._fleet_source is not None:
+            REGISTRY.unregister_if("fleet", self._fleet_source)
         return ok
 
     def shutdown_for_restart(self, timeout: float | None = None) -> bool:
@@ -315,6 +370,8 @@ class ReplicaFleet:
             return self.shutdown(drain=False, timeout=timeout)
         if self._pressure is not None:
             self._pressure.detach_fleet(self)
+        if self._autoscaler is not None:
+            self._autoscaler.close()
         with self._lock:
             self._closed = True
             pending = list(self._pending)
@@ -342,6 +399,10 @@ class ReplicaFleet:
         REGISTRY.unregister_if("router", self._router_source)
         if self._sched_source is not None:
             REGISTRY.unregister_if("sched", self._sched_source)
+        if self._autoscale_source is not None:
+            REGISTRY.unregister_if("autoscale", self._autoscale_source)
+        if self._fleet_source is not None:
+            REGISTRY.unregister_if("fleet", self._fleet_source)
         return ok
 
     # -- replica lifecycle -------------------------------------------------
@@ -369,10 +430,10 @@ class ReplicaFleet:
         with self._lock:
             idx = self._next_idx
             self._next_idx += 1
-        rep = _Replica(idx, engine)
-        if self._injector is not None:
+        rep = _Replica(idx, engine, stagger=self._stagger)
+        if self._injector is not None or self._stagger is not None:
             engine.fleet_hook = (
-                lambda shard_pos, rep=rep: self._chaos_step(rep, shard_pos)
+                lambda shard_pos, rep=rep: self._fleet_step(rep, shard_pos)
             )
         # Per-replica visibility at the fleet endpoint: the replica's own
         # engine registry (serve counters, retries, integrity, watchdog)
@@ -398,6 +459,8 @@ class ReplicaFleet:
             raise ServeClosed("replica fleet is shut down")
         self.metrics.count("replicas_added")
         obs_trace.instant("replica_added", cat="fleet", replica=rep.idx)
+        if self._stagger is not None:
+            self._stagger.note_membership_change()
         self._flush_pending()
         return rep.idx
 
@@ -529,6 +592,9 @@ class ReplicaFleet:
                     self._replicas.remove(rep)
             return
         self.metrics.count("replicas_recycled")
+        if self._stagger is not None:
+            self._stagger.forget(rep.idx)
+            self._stagger.note_membership_change()
         obs_trace.instant(
             "replica_recycled", cat="fleet", replica=rep.idx,
             new_replica=new.idx,
@@ -543,6 +609,9 @@ class ReplicaFleet:
             if rep in self._replicas:
                 self._replicas.remove(rep)
         self.metrics.count("replicas_removed")
+        if self._stagger is not None:
+            self._stagger.forget(rep.idx)
+            self._stagger.note_membership_change()
 
     # -- brownout (runtime/pressure.py) ------------------------------------
 
@@ -575,14 +644,18 @@ class ReplicaFleet:
 
     def pressure_restore(self) -> int:
         """Reverse :meth:`pressure_drain`: add replicas back up to the
-        configured population. Returns how many were added. Safe to call
+        CURRENT population target — the autoscaler's target when one is
+        running, else the configured ``serve_cfg.replicas`` — so a
+        brownout that fires mid-scale does not snap the fleet back to a
+        stale boot-time size. Returns how many were added. Safe to call
         when nothing was drained (no-op) or after shutdown (0)."""
         restored = 0
         while True:
+            target = self.population_target()
             with self._lock:
                 if self._closed:
                     return restored
-                deficit = self.serve_cfg.replicas - len(
+                deficit = target - len(
                     [r for r in self._replicas if r.serving]
                 )
             if deficit <= 0:
@@ -592,6 +665,35 @@ class ReplicaFleet:
             except ServeClosed:
                 return restored
             restored += 1
+
+    # -- per-shard fleet hook (stagger + chaos) ----------------------------
+
+    def _fleet_step(self, rep: _Replica, shard_pos: int) -> None:
+        """The composite ``engine.fleet_hook``: fired from inside the
+        replica's engine thread at every shard step. Shard 0 is the
+        sweep boundary — the only point where a stagger hold is safe
+        (no wave is mid-flight), so the hold happens before any chaos
+        fault site can kill the step."""
+        if self._stagger is not None and shard_pos == 0:
+            hold = self._stagger.on_boundary(rep.idx, time.monotonic())
+            if hold > 0.0:
+                self._hold_at_boundary(rep, hold)
+        if self._injector is not None:
+            self._chaos_step(rep, shard_pos)
+
+    def _hold_at_boundary(self, rep: _Replica, hold: float) -> None:
+        """Park a replica's engine thread at its sweep-0 boundary to
+        shift its phase. The hold is capped below the liveness watchdog
+        (a correction must never read as a stall) and sliced so the
+        replica's release event — set on hard-fail AND by fleet
+        shutdown before engine teardown — interrupts it promptly."""
+        if self.serve_cfg.watchdog_abort_s > 0:
+            hold = min(hold, self.serve_cfg.watchdog_abort_s / 4.0)
+        deadline = time.monotonic() + hold
+        while True:
+            left = deadline - time.monotonic()
+            if left <= 0 or rep.release.wait(min(left, 0.05)):
+                break
 
     # -- chaos -------------------------------------------------------------
 
@@ -902,11 +1004,14 @@ class ReplicaFleet:
         with self._lock:
             replicas = list(self._replicas)
         serving = 0
+        phases: dict[int, float] = {}
         for rep in replicas:
             eng = rep.engine
             if rep.state == "serving":
                 serving += 1
                 pos = eng.sweep_position()
+                if pos["busy"] and pos["n_shards"] > 0:
+                    phases[rep.idx] = pos["shard_pos"] / pos["n_shards"]
                 stalled = (
                     self.serve_cfg.watchdog_abort_s > 0
                     and pos["busy"]
@@ -938,16 +1043,73 @@ class ReplicaFleet:
                     self._complete_drain(rep)
         self.metrics.gauge("replicas_serving", serving)
         self.metrics.gauge("replicas_total", len(replicas))
+        if self._stagger is not None:
+            self._stagger.observe(phases)
         with self._lock:
             self.metrics.gauge("pending_parked", len(self._pending))
+
+    # -- autoscaler surface ------------------------------------------------
+
+    def population(self) -> int:
+        """Serving replica count — the autoscaler's notion of fleet
+        size (draining/removing slots are already leaving)."""
+        with self._lock:
+            return sum(1 for r in self._replicas if r.serving)
+
+    def serving_engines(self) -> list:
+        """Engines of the serving replicas (burn-rate sampling)."""
+        with self._lock:
+            return [r.engine for r in self._replicas if r.serving]
+
+    def drains_in_flight(self) -> int:
+        """Replicas currently leaving (draining or removing) — a shrink
+        decision must wait until this hits zero."""
+        with self._lock:
+            return sum(
+                1 for r in self._replicas
+                if r.state in ("draining", "removing")
+            )
+
+    def queue_frac(self) -> float:
+        """Fleet-wide queued-work fraction: parked + per-replica queued
+        requests over the fleet's total admission capacity
+        (``queue_capacity`` per serving replica). Capped at 1.0 — an
+        over-full park deque is 'saturated', not 'more than full'."""
+        with self._lock:
+            engines = [r.engine for r in self._replicas if r.serving]
+            queued = len(self._pending)
+        queued += sum(len(eng.queue) for eng in engines)
+        cap = self.serve_cfg.queue_capacity * max(1, len(engines))
+        return min(1.0, queued / max(1, cap))
+
+    def population_target(self) -> int:
+        """The population the fleet is currently trying to hold: the
+        autoscaler's live target when one is running, else the
+        configured boot-time ``serve_cfg.replicas``."""
+        auto = self._autoscaler
+        if auto is not None:
+            return auto.target
+        return self.serve_cfg.replicas
+
+    def mark_replay_complete(self) -> None:
+        """WAL replay finished (cli._replay_open): release the
+        autoscaler's first-decision gate. No-op without one."""
+        auto = self._autoscaler
+        if auto is not None:
+            auto.mark_replay_complete()
 
     # -- introspection -----------------------------------------------------
 
     def stats(self) -> dict:
         """Fleet stats line: router counters/gauges + per-replica engine
         stats (each the same registry-assembled dict a single engine's
-        stats line prints)."""
+        stats line prints), plus the autoscale/stagger controller
+        snapshots when elasticity is on."""
         out: dict = {"event": "fleet_stats", "router": self.metrics.snapshot()}
+        if self._autoscaler is not None:
+            out["autoscale"] = self._autoscaler.stats()
+        if self._stagger is not None:
+            out["stagger"] = self._stagger.stats()
         with self._lock:
             replicas = list(self._replicas)
         out["replicas"] = {

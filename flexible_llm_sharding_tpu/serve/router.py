@@ -24,7 +24,9 @@ Lowest score wins; ties break to the lowest replica index (deterministic,
 and keeps a cold fleet filling from replica 0 so tests can reason about
 placement). Draining/dead replicas are never candidates — health is the
 fleet's job (``serve/fleet.py``); the router only ranks the replicas the
-fleet says are serving.
+fleet says are serving, minus any whose engine already set a fatal
+``error`` (dead but not yet swept up by the monitor — its queue is
+closed, so a dispatch there can only fail).
 """
 
 from __future__ import annotations
@@ -47,17 +49,24 @@ class Router:
 
     def score(self, snapshot: dict, phase_bias: float = 1.0) -> float:
         """Dispatch cost of one replica snapshot (lower = better):
-        ``{"boundary_frac", "queue_depth", "active", "max_active"}``.
-        ``phase_bias`` multiplies the phase term — the class-aware
-        dispatch hook (serve/sched): interactive requests weigh
-        boundary proximity harder, so they land on the replica whose
-        next shard-0 admission point is soonest even when a
-        farther-from-boundary replica is marginally less loaded."""
+        ``{"boundary_frac", "queue_depth", "active", "max_active"}``
+        plus optional ``hold_frac``. ``phase_bias`` multiplies the
+        phase term — the class-aware dispatch hook (serve/sched):
+        interactive requests weigh boundary proximity harder, so they
+        land on the replica whose next shard-0 admission point is
+        soonest even when a farther-from-boundary replica is marginally
+        less loaded. ``hold_frac`` — a pending stagger-correction hold
+        at the replica's next boundary, in sweep fractions
+        (serve/autoscale.py) — adds straight into the phase term: a
+        replica about to park at its boundary is exactly that much
+        farther from admitting, and the router must not steer
+        latency-sensitive work onto it."""
         load = (snapshot["queue_depth"] + snapshot["active"]) / max(
             snapshot.get("max_active", 1), 1
         )
+        boundary = snapshot["boundary_frac"] + snapshot.get("hold_frac", 0.0)
         return (
-            self.phase_weight * phase_bias * snapshot["boundary_frac"]
+            self.phase_weight * phase_bias * boundary
             + self.depth_weight * load
         )
 
@@ -70,8 +79,21 @@ class Router:
         failed on — is skipped whenever any alternative exists: an orphan
         must land on a SURVIVING replica, but with a single serving
         replica left (which may be the excluded one, freshly recovered)
-        serving beats failing."""
-        candidates = [r for r in replicas if r.serving]
+        serving beats failing. A replica whose engine has already set a
+        fatal ``error`` is never a candidate even before the fleet
+        monitor's next health poll marks it dead: its admission queue is
+        closed, so dispatching there burns one of the request's two
+        attempts on a certain failure — parking until the monitor
+        recycles the slot is strictly better (the window matters most on
+        a one-replica elastic fleet, where the "lone survivor" fallback
+        would otherwise resend every orphan straight back to the corpse
+        and terminally fail it)."""
+        candidates = [
+            r
+            for r in replicas
+            if r.serving
+            and getattr(getattr(r, "engine", None), "error", None) is None
+        ]
         if exclude is not None and len(candidates) > 1:
             candidates = [r for r in candidates if r is not exclude] or candidates
         if not candidates:

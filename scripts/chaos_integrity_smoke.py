@@ -1201,6 +1201,122 @@ def main() -> int:
             )
             return 1
     print(f"crash_restart_ok replayed={len(replayed)}")
+
+    # 12) Closed-loop fleet elasticity (serve/autoscale.py): a saturated
+    # 1-replica fleet must GROW to 2 on a confirmed queue-watermark
+    # breach, absorb a seeded replica_kill landing mid-scale
+    # (token-identical failover while the controller is live), then
+    # DRAIN back to min once the queue empties — exactly one grow and
+    # one shrink (anti-flap: confirmation + cooldowns held), with the
+    # sweep-phase stagger controller re-converged and the whole story
+    # visible on one metrics scrape (fls_autoscale_* / the
+    # fls_fleet_stagger_error gauge). CI greps the autoscale_chaos_ok
+    # marker below.
+    from flexible_llm_sharding_tpu.config import AutoscaleConfig
+
+    fleet = ReplicaFleet(
+        _cfg(
+            model_dir,
+            faults=FaultConfig(
+                enabled=True, seed=SEED, error_rate=1.0,
+                sites=("replica_kill",), max_faults=1,
+            ),
+        ),
+        ServeConfig(
+            replicas=1,
+            queue_capacity=8,
+            max_wave_requests=1,
+            max_active_requests=1,  # slow consumption: the queue SUSTAINS
+            default_max_new_tokens=1,
+            router_health_poll_s=0.05,
+            metrics_port=0,
+            autoscale=AutoscaleConfig(
+                enabled=True, min=1, max=2, poll_s=0.05,
+                confirm_polls=2, grow_queue_frac=0.5,
+                shrink_queue_frac=0.1, grow_cooldown_s=0.2,
+                shrink_cooldown_s=0.5,
+            ),
+        ),
+        tokenizer=FakeTokenizer(),
+    )
+    try:
+        reqs = [
+            fleet.submit(*PROMPTS[i % len(PROMPTS)]) for i in range(8)
+        ]
+        # The controller must see the sustained breach and add the
+        # second replica while the kill/recycle storm is in flight.
+        deadline = time.monotonic() + 120
+        auto = fleet._autoscaler
+        while auto.stats()["grows"] < 1 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        results = [r.future.result(timeout=600) for r in reqs]
+        # Queue empty + burn zero: the shrink side must confirm, wait
+        # out its cooldown, drain the extra replica, and settle at min.
+        deadline = time.monotonic() + 120
+        while (
+            auto.stats()["shrinks"] < 1 or fleet.population() > 1
+        ) and time.monotonic() < deadline:
+            time.sleep(0.05)
+        port = fleet.metrics_server.port
+        exposition = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=30
+        ).read().decode()
+        auto_stats = auto.stats()
+        stagger_stats = fleet._stagger.stats()
+    finally:
+        fleet.shutdown(drain=True)
+    if fleet.error is not None:
+        print(f"FAIL: autoscale fleet error {fleet.error!r}", file=sys.stderr)
+        return 1
+    for i, res in enumerate(results):
+        want = clean[i % len(PROMPTS)]
+        if not (res.scores.argmax(-1) == want.argmax(-1)).all():
+            print(
+                "FAIL: output diverged under autoscale + replica_kill",
+                file=sys.stderr,
+            )
+            return 1
+    if auto_stats["grows"] != 1 or auto_stats["shrinks"] != 1:
+        print(
+            f"FAIL: anti-flap broke — wanted exactly 1 grow + 1 shrink, "
+            f"got grows={auto_stats['grows']} "
+            f"shrinks={auto_stats['shrinks']}",
+            file=sys.stderr,
+        )
+        return 1
+    if fleet.metrics.counter("replicas_dead") < 1:
+        print(
+            "FAIL: the seeded replica_kill never landed mid-scale",
+            file=sys.stderr,
+        )
+        return 1
+    if not re.search(r"^fls_autoscale_grows 1\b", exposition, re.M):
+        print(
+            "FAIL: exposition carries no fls_autoscale_grows 1",
+            file=sys.stderr,
+        )
+        return 1
+    if not re.search(r"^fls_fleet_stagger_error ", exposition, re.M):
+        print(
+            "FAIL: exposition carries no fls_fleet_stagger_error gauge",
+            file=sys.stderr,
+        )
+        return 1
+    if stagger_stats["stagger_converged"] != 1:
+        print(
+            f"FAIL: stagger never re-converged after the membership "
+            f"churn: {stagger_stats}",
+            file=sys.stderr,
+        )
+        return 1
+    print(json.dumps({"event": "autoscale_stats", **auto_stats,
+                      **stagger_stats}))
+    print(
+        f"autoscale_chaos_ok grows={auto_stats['grows']} "
+        f"shrinks={auto_stats['shrinks']} "
+        f"restaggers={stagger_stats['restaggers']} "
+        f"stagger_error={stagger_stats['stagger_error']}"
+    )
     return 0
 
 

@@ -127,6 +127,17 @@ FLOOR_RULES = {
     # noise; what the tripwire watches is journaling or fsync creeping
     # onto the per-shard hot path.
     "wal_overhead_ratio": 0.85,
+    # Closed-loop sweep stagger (ISSUE 19): 1 - final stagger error of a
+    # deterministic synthetic-clock loop driving the REAL controller —
+    # two in-phase replicas must converge to the i/N offsets and
+    # re-converge after a simulated recycle, with the phase refusing to
+    # record unless boundary holds actually fired in both rounds.
+    # Structural and timing-free (injected clocks everywhere): healthy
+    # is 1.0 by construction; the hold math disengaging leaves the
+    # initial error standing and collapses this toward 0, which no
+    # runner noise can fake — so it gates hard, the pinned_fraction
+    # precedent.
+    "fleet_stagger_convergence": 0.95,
 }
 
 # Ratios whose loss-of-mechanism signature is "collapses to parity": the
@@ -195,6 +206,7 @@ def measure() -> dict:
     from bench import (
         BenchTokenizer,
         bench_adapters,
+        bench_fleet_stagger,
         bench_host_cache,
         bench_host_stream,
         bench_kv_reuse,
@@ -260,6 +272,9 @@ def measure() -> dict:
     # Multi-tenant LoRA (ISSUE 17): small token budget — the gate needs
     # parity + rank-sized delta bytes witnessed, not a full measurement.
     bench_adapters(fw(None), tok, result, budget, n_tok=4)
+    # Closed-loop sweep stagger (ISSUE 19): deterministic synthetic-clock
+    # loop over the real controller — milliseconds, no model in the loop.
+    bench_fleet_stagger(result)
     result["gate_wall_s"] = round(time.perf_counter() - t0, 1)
     return result
 

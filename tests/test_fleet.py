@@ -21,6 +21,7 @@ import pytest
 import jax
 
 from flexible_llm_sharding_tpu.config import (
+    AutoscaleConfig,
     FaultConfig,
     FrameworkConfig,
     ServeConfig,
@@ -176,6 +177,31 @@ def test_router_scoring_phase_and_depth():
     assert router.pick([dead]) is None
     with pytest.raises(ValueError):
         Router(phase_weight=-1)
+
+
+def test_router_never_picks_engine_with_fatal_error():
+    """A replica whose engine already set a fatal error is not a
+    candidate even while the fleet still lists it as serving (the
+    monitor hasn't polled yet): its queue is closed, so dispatching
+    there burns one of the request's two attempts on a certain failure.
+    On a one-replica fleet the old 'lone survivor' fallback resent every
+    orphan straight back to the corpse and terminally failed it."""
+
+    class _Eng:
+        def __init__(self, error=None):
+            self.error = error
+
+    router = Router()
+    corpse = _FakeReplica(0, frac=0.0, depth=0, active=0)
+    corpse.engine = _Eng(error=RuntimeError("killed"))
+    live = _FakeReplica(1, frac=0.9, depth=4, active=4)
+    live.engine = _Eng()
+    # The worse-scoring live replica still wins over the dead one…
+    assert router.pick([corpse, live]) is live
+    # …and a fleet of only corpses parks (None) instead of dispatching,
+    # even when the corpse is the lone non-excluded "survivor".
+    assert router.pick([corpse]) is None
+    assert router.pick([corpse], exclude=live) is None
 
 
 def test_reclaim_inflight_returns_orphans(model_dir):
@@ -450,3 +476,129 @@ def test_fleet_hard_remove_redispatches(model_dir, offline_oracle):
         assert fleet.metrics.counter("replicas_removed") == 1
     finally:
         fleet.shutdown(drain=True)
+
+
+# ---------------------------------------------------------------------------
+# Autoscale wiring (serve/autoscale.py): router term, restore target,
+# staggered live fleet
+# ---------------------------------------------------------------------------
+
+def test_router_score_folds_pending_stagger_hold():
+    """A pending stagger hold is admission distance: with equal raw
+    phase and load, the replica about to park at its boundary loses."""
+    router = Router(phase_weight=1.0, depth_weight=1.0)
+    held = _FakeReplica(0, frac=0.1, depth=0, active=0)
+    held._snap["hold_frac"] = 0.5
+    free = _FakeReplica(1, frac=0.1, depth=0, active=0)
+    assert router.pick([held, free]) is free
+    # Snapshots without the key (single engines, old fixtures) are
+    # unaffected.
+    assert router.score(free.snapshot()) == pytest.approx(0.1)
+    assert router.score(held.snapshot()) == pytest.approx(0.6)
+
+
+def test_pressure_restore_targets_autoscaler_population(model_dir):
+    """Satellite regression (drain -> scale -> restore): after the
+    autoscaler resized the fleet, pressure_restore repopulates to the
+    CONTROLLER's current target, not the stale boot-time replica
+    count."""
+    auto = AutoscaleConfig(enabled=True, min=1, max=4, stagger=False)
+    fleet = ReplicaFleet(
+        _fw(model_dir),
+        _serve_cfg(replicas=2, autoscale=auto),
+        tokenizer=FakeTokenizer(), start=False,
+    )
+    try:
+        assert fleet.population() == 2
+        assert fleet.population_target() == 2
+        # The controller scaled up (what a confirmed burn breach does).
+        fleet.add_replica()
+        with fleet._autoscaler._lock:
+            fleet._autoscaler.target = 3
+        # Brownout sheds down to one replica...
+        assert fleet.pressure_drain(keep=1) == 2
+        for rep in list(fleet._replicas):
+            if rep.state == "removing":
+                fleet._complete_drain(rep)
+        assert fleet.population() == 1
+        # ...and the restore honors the autoscaler's target, not the
+        # boot-time replicas=2.
+        assert fleet.pressure_restore() == 2
+        assert fleet.population() == 3
+    finally:
+        fleet.shutdown(drain=False)
+
+
+def test_pressure_restore_without_autoscaler_uses_config(model_dir):
+    """Static fleets keep the pre-autoscale behavior: restore returns
+    to serve_cfg.replicas."""
+    fleet = ReplicaFleet(
+        _fw(model_dir), _serve_cfg(replicas=2),
+        tokenizer=FakeTokenizer(), start=False,
+    )
+    try:
+        assert fleet.population_target() == 2
+        assert fleet.pressure_drain(keep=1) == 1
+        for rep in list(fleet._replicas):
+            if rep.state == "removing":
+                fleet._complete_drain(rep)
+        assert fleet.pressure_restore() == 1
+        assert fleet.population() == 2
+    finally:
+        fleet.shutdown(drain=False)
+
+
+def test_fleet_autoscale_helpers_and_stats_surface(model_dir):
+    """The controller-facing fleet surface: population / queue_frac /
+    drains_in_flight read consistently, replay gate forwards, and
+    stats() carries the autoscale + stagger sections."""
+    auto = AutoscaleConfig(enabled=True, min=1, max=4)
+    fleet = ReplicaFleet(
+        _fw(model_dir),
+        _serve_cfg(replicas=2, autoscale=auto),
+        tokenizer=FakeTokenizer(), start=False,
+    )
+    try:
+        assert fleet.population() == 2
+        assert fleet.drains_in_flight() == 0
+        assert fleet.queue_frac() == 0.0
+        assert len(fleet.serving_engines()) == 2
+        fleet.mark_replay_complete()  # no WAL: already open, idempotent
+        assert fleet._autoscaler.stats()["replay_pending"] == 0
+        stats = fleet.stats()
+        assert stats["autoscale"]["target_replicas"] == 2
+        assert "stagger_error" in stats["stagger"]
+        # Replica snapshots carry the router's hold_frac term.
+        for rep in fleet._replicas:
+            assert rep.snapshot()["hold_frac"] == 0.0
+    finally:
+        fleet.shutdown(drain=False)
+    assert fleet.error is None
+
+
+def test_fleet_staggered_parity_live(model_dir, offline_oracle):
+    """A live autoscale+stagger fleet serves token-identically: boundary
+    holds shift phases but never change tokens, and the stagger stats
+    export through fleet.stats()."""
+    off_scores, off_updated = offline_oracle
+    auto = AutoscaleConfig(
+        enabled=True, min=1, max=4, poll_s=0.05, confirm_polls=1000,
+    )
+    fleet = ReplicaFleet(
+        _fw(model_dir),
+        _serve_cfg(replicas=2, autoscale=auto),
+        tokenizer=FakeTokenizer(),
+    )
+    try:
+        reqs = [fleet.submit(p, s) for p, s in PROMPTS]
+        results = [r.future.result(timeout=300) for r in reqs]
+    finally:
+        fleet.shutdown(drain=True)
+    assert fleet.error is None
+    for res, want, upd in zip(results, off_scores, off_updated):
+        assert (res.scores.argmax(-1) == want.argmax(-1)).all()
+        np.testing.assert_allclose(res.scores, want, rtol=1e-5, atol=1e-6)
+        assert res.updated == upd
+    stats = fleet.stats()
+    assert stats["autoscale"]["polls"] >= 0  # daemon ran and closed clean
+    assert 0.0 <= stats["stagger"]["stagger_error"] <= 1.0

@@ -853,6 +853,54 @@ def test_counter_export_spec_family_positive_and_negative(tmp_path):
     assert not any("self.spec_accepted_tokens" in x for x in m)
 
 
+# The autoscaler decision-counter family (serve/autoscale.py stats): every
+# grow/shrink/blocked decision must reach the registered ``autoscale``
+# export — a scale decision that happened but never exported is invisible
+# to the operator judging the controller. Positive/negative pair.
+AUTOSCALE_COUNTER_OK = """
+class FleetAutoscaler:
+    def __init__(self, registry):
+        self.polls = 0
+        self.grows = 0
+        self.shrinks = 0
+        self.blocked = 0
+        registry.register("autoscale", self.stats)
+    def poll_once(self, direction):
+        self.polls += 1
+        if direction == "grow":
+            self.grows += 1
+        elif direction == "shrink":
+            self.shrinks += 1
+        else:
+            self.blocked += 1
+    def stats(self):
+        return {
+            "polls": self.polls,
+            "grows": self.grows,
+            "shrinks": self.shrinks,
+            "blocked": self.blocked,
+        }
+"""
+
+
+def test_counter_export_autoscale_family_positive_and_negative(tmp_path):
+    """The fls_autoscale_* family shape: decision counters incremented in
+    poll_once and exported through the registered ``autoscale`` source
+    pass; dropping one (here blocked) is the silent-decision defect."""
+    pkg = make_pkg(tmp_path, {"mod.py": AUTOSCALE_COUNTER_OK})
+    res = run_pkg(pkg, select=["COUNTER-EXPORT"])
+    assert msgs(res.findings, "COUNTER-EXPORT") == []
+
+    broken = AUTOSCALE_COUNTER_OK.replace(
+        '            "blocked": self.blocked,\n', ""
+    )
+    pkg = make_pkg(tmp_path, {"mod2.py": broken}, name="pkg2")
+    res = run_pkg(pkg, select=["COUNTER-EXPORT"])
+    m = msgs(res.findings, "COUNTER-EXPORT")
+    assert any("self.blocked" in x for x in m)
+    assert not any("self.grows" in x for x in m)
+
+
 def test_counter_export_integrity_keys(tmp_path):
     pkg = make_pkg(
         tmp_path, {"utils/metrics.py": METRICS_MOD, "utils/__init__.py": "",

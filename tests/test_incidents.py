@@ -326,6 +326,43 @@ def test_slo_burn_rate_math_and_exhaustion_latch(tmp_path):
     m.close()
 
 
+def test_slo_worst_burn_and_trend_direction():
+    """burn_rate_trend(): windowed rising/falling over the worst burn
+    per stats() evaluation — the autoscaler's transient-spike filter —
+    pre-seeded numeric before any samples."""
+    m = ServingMetrics(process_mirror=False)
+    t = SLOTracker(
+        SLOConfig(enabled=True, ttft_p95_s="interactive=0.1",
+                  min_samples=10_000),
+        m,
+    )
+    # Pre-seeded: no samples yet, trend is flat zeros.
+    s = t.stats()
+    assert s["worst_burn_rate"] == 0.0
+    assert s["trend"] == {"window": 1, "burn_delta": 0.0,
+                          "rising": 0, "falling": 0}
+    # Burn climbs across evaluations -> rising.
+    for _ in range(5):
+        m.observe_ttft(5.0, "interactive")
+        s = t.stats()
+    assert s["worst_burn_rate"] > 1.0
+    assert s["trend"]["rising"] == 1 and s["trend"]["falling"] == 0
+    # Flood compliant samples: burn collapses across the window ->
+    # falling (exactly the signal that vetoes a burn-driven grow).
+    for _ in range(300):
+        m.observe_ttft(0.01, "interactive")
+        if _ % 50 == 0:
+            t.stats()
+    s = t.stats()
+    assert s["trend"]["falling"] == 1 and s["trend"]["rising"] == 0
+    # Steady state: deltas inside the flat band read as no direction.
+    for _ in range(10):
+        s = t.stats()
+    assert s["trend"] == {"window": 8, "burn_delta": 0.0,
+                          "rising": 0, "falling": 0}
+    m.close()
+
+
 def test_slo_min_samples_gate():
     m = ServingMetrics(process_mirror=False)
     t = SLOTracker(

@@ -412,9 +412,13 @@ serve_main(sys.argv[1:], tokenizer=FakeTokenizer())
 _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _serve_proc(model_dir, wal_dir, adapter_dir, lines, crash_sweeps=0):
+def _serve_proc(
+    model_dir, wal_dir, adapter_dir, lines, crash_sweeps=0, extra=(),
+    want_stats=False,
+):
     """One serve CLI process over the JSONL frontend. Returns (replies
-    keyed by client id, returncode)."""
+    keyed by client id, returncode); with ``want_stats`` also the final
+    stats line the CLI prints to stderr at clean exit (None on crash)."""
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
@@ -436,13 +440,15 @@ def _serve_proc(model_dir, wal_dir, adapter_dir, lines, crash_sweeps=0):
         "--max_wave_requests", "4",
         "--sched",  # prefix coalescing on: shared prefixes in flight
         "--stats_interval_s", "0",
+        *extra,
     ]
     proc = subprocess.Popen(
         cmd, stdin=subprocess.PIPE, stdout=subprocess.PIPE,
-        stderr=subprocess.DEVNULL, env=env, cwd=_ROOT, text=True,
+        stderr=subprocess.PIPE if want_stats else subprocess.DEVNULL,
+        env=env, cwd=_ROOT, text=True,
     )
     try:
-        out, _ = proc.communicate(
+        out, err = proc.communicate(
             "".join(json.dumps(d) + "\n" for d in lines), timeout=600
         )
     except subprocess.TimeoutExpired:
@@ -456,7 +462,17 @@ def _serve_proc(model_dir, wal_dir, adapter_dir, lines, crash_sweeps=0):
             continue
         if d.get("status") == "done" and "client_id" in d:
             replies[d["client_id"]] = d
-    return replies, proc.returncode
+    if not want_stats:
+        return replies, proc.returncode
+    stats = None
+    for ln in (err or "").splitlines():
+        try:
+            d = json.loads(ln)
+        except ValueError:
+            continue
+        if isinstance(d, dict):
+            stats = d  # last JSON line on stderr is the final stats
+    return replies, proc.returncode, stats
 
 
 @pytest.mark.slow
@@ -522,3 +538,59 @@ def test_crash_drill_sigkill_then_restart_merges_token_identically(
             merged[cid]["updated_suffixes"]
             == oracle[cid]["updated_suffixes"]
         ), cid
+
+
+@pytest.mark.slow
+def test_crash_drill_replay_into_resized_fleet(model, tmp_path):
+    """Elasticity meets the WAL: SIGKILL a 3-replica serve mid-sweep,
+    then restart with a DIFFERENT --replicas (2). The replay owes the
+    same requests regardless of topology — merged outputs stay
+    token-identical, the restarted fleet really is 2 replicas, and its
+    dispatch counters are consistent (every replayed request dispatched
+    exactly once, no chaos so no re-dispatch)."""
+    model_dir, _ = model
+    adapter_dir = str(tmp_path / "adapters_unused")
+    os.makedirs(adapter_dir, exist_ok=True)
+    lines = [
+        {"id": f"r{i}", "prefix": p, "suffixes": list(s)}
+        for i, (p, s) in enumerate(PROMPTS[:4])
+    ]
+
+    oracle, rc = _serve_proc(
+        model_dir, str(tmp_path / "wal_oracle"), adapter_dir, lines
+    )
+    assert rc == 0 and set(oracle) == {d["id"] for d in lines}
+
+    wal_dir = str(tmp_path / "wal")
+    crashed, rc = _serve_proc(
+        model_dir, wal_dir, adapter_dir, lines, crash_sweeps=2,
+        extra=("--replicas", "3"),
+    )
+    assert rc == -signal.SIGKILL, "the drill must actually die by SIGKILL"
+    assert len(crashed) < len(lines), "crash too late: nothing in flight"
+
+    replayed, rc, stats = _serve_proc(
+        model_dir, wal_dir, adapter_dir, [],
+        extra=("--replicas", "2"), want_stats=True,
+    )
+    assert rc == 0
+    owed = {d["id"] for d in lines} - set(crashed)
+    assert set(replayed) >= owed, "replay lost an owed request"
+
+    merged = dict(crashed)
+    merged.update(replayed)  # at-least-once: replayed dupes overwrite
+    for d in lines:
+        cid = d["id"]
+        assert merged[cid]["tokens"] == oracle[cid]["tokens"], cid
+        assert (
+            merged[cid]["updated_suffixes"]
+            == oracle[cid]["updated_suffixes"]
+        ), cid
+
+    # The restarted fleet is really the NEW size, and its counters are
+    # consistent: one dispatch per replayed request, zero re-dispatches
+    # (no chaos, no replica death in the replay run).
+    assert stats is not None and stats.get("event") == "fleet_stats"
+    assert len(stats["replicas"]) == 2
+    assert stats["router"]["dispatches"] == len(replayed)
+    assert stats["router"].get("redispatches", 0) == 0
