@@ -121,6 +121,10 @@ HEADLINE_KEYS = (
     "spec_serve_tokens_per_sweep",
     "spec_serve_sweep_ratio",
     "spec_serve_acceptance",
+    "spec_adaptive_tokens_per_sweep",
+    "spec_adaptive_sweep_ratio",
+    "spec_adaptive_k_final",
+    "spec_adaptive_acceptance",
     "kv_prefix_reuse_frac",
     "adapter_overhead_ratio",
     "adapter_delta_bytes_frac",
@@ -294,6 +298,10 @@ RATIO_SINGLETONS = (
     "spec_serve_tokens_per_sweep",
     "spec_serve_sweep_ratio",
     "spec_serve_acceptance",
+    "spec_adaptive_tokens_per_sweep",
+    "spec_adaptive_sweep_ratio",
+    "spec_adaptive_k_final",
+    "spec_adaptive_acceptance",
     "kv_prefix_reuse_frac",
     "adapter_overhead_ratio",
     "adapter_delta_bytes_frac",
@@ -368,6 +376,12 @@ PHASE_EVIDENCE_KEY = {
     # Speculation on the SERVING path (serve/engine.py): the structural
     # tokens-per-sweep headline under a replay draft source.
     "spec_serve": "spec_serve_tokens_per_sweep",
+    # ISSUE 20's tentpole evidence: the resident draft model
+    # (runtime/draft.py) + adaptive-k controller (serve/spec.py) must
+    # lift tokens-per-sweep end to end at zero extra per-sweep stream
+    # bytes (token-identity + the structural byte claim asserted
+    # before recording).
+    "spec_adaptive": "spec_adaptive_tokens_per_sweep",
     # ISSUE 16's tentpole evidence: a prefix prefilled in wave N must be
     # served from pooled pages in wave N+1 (structural token counters;
     # pool-on/pool-off token-identity asserted before recording).
@@ -1928,6 +1942,123 @@ def bench_spec_serve(
     )
 
 
+def bench_spec_adaptive(
+    cfg_obj, tok, result: dict, budget_left, n_tok: int = 12,
+    start_k: int = 2, k_max: int = 7,
+) -> None:
+    """Resident draft model + adaptive-k headline: the acceptance-driven
+    k trajectory, at zero extra per-sweep stream bytes.
+
+    Serves the same two-request wave plain (k=0) then adaptive with the
+    TARGET checkpoint doubling as the resident draft model — every draft
+    agrees with verification, so acceptance is deterministically 1.0 and
+    the windowed controller must climb k from ``start_k`` toward
+    ``k_max`` pass over pass (the mechanism's upper bound isolated from
+    draft quality, the replay-draft idea realised through the real
+    runtime/draft.py path: pinned residency tier, real forwards). Both
+    runs force float32: at bfloat16 the draft's full-context recompute
+    and the target's KV-cached verify pass diverge in argmax often
+    enough (~0.6 acceptance) to turn the deterministic trajectory into a
+    rounding artifact. Token-identity AND the structural
+    zero-extra-stream claim (adaptive
+    per-sweep streamed bytes == plain per-sweep streamed bytes, from the
+    executors' own counters) are asserted before recording. Records:
+
+    - ``spec_adaptive_tokens_per_sweep``: tokens emitted / weight sweeps
+      in the adaptive run — the serving headline with the controller and
+      draft model live end to end.
+    - ``spec_adaptive_sweep_ratio``: plain sweeps / adaptive sweeps on
+      the SAME workload (structural and timing-free).
+    - ``spec_adaptive_k_final``: the largest per-class k the controller
+      reached — the acceptance-driven trajectory (start_k means the
+      control loop never moved; a lost observe/raise path cannot hide).
+    - ``spec_adaptive_acceptance``: accepted/drafted across the run.
+    """
+    import dataclasses
+
+    from flexible_llm_sharding_tpu.config import ServeConfig
+    from flexible_llm_sharding_tpu.runtime.executor import stream_stats
+    from flexible_llm_sharding_tpu.serve import ServeEngine
+
+    rng = np.random.default_rng(11)
+    words = [f"w{i}" for i in range(40)]
+    phrase = " ".join(rng.choice(words, size=12))
+    prompt = (f"{phrase} {phrase} {phrase}", (f" {phrase}",))
+    base = dataclasses.replace(cfg_obj, num_gen_token=n_tok,
+                               dtype="float32")
+
+    def run(serve_kw):
+        engine = ServeEngine(
+            base,
+            ServeConfig(
+                max_wave_requests=2,
+                default_max_new_tokens=n_tok,
+                **serve_kw,
+            ),
+            tokenizer=tok,
+            start=False,  # both requests admit at ONE boundary
+        )
+        # Measured AFTER construction: the draft pin loads once there,
+        # outside the per-sweep window the claim is about.
+        bytes0 = stream_stats()["streamed_bytes"]
+        try:
+            reqs = [engine.submit(*prompt) for _ in range(2)]
+            engine.start()
+            out = [r.future.result(timeout=600) for r in reqs]
+        finally:
+            engine.shutdown(drain=True)
+        if engine.error is not None:
+            raise RuntimeError(
+                f"adaptive bench engine error: {engine.error!r}"
+            )
+        return out, engine.stats(), stream_stats()["streamed_bytes"] - bytes0
+
+    plain, plain_stats, plain_bytes = run({})
+    spec, spec_stats, spec_bytes = run(dict(
+        speculative_k=start_k,
+        spec_adaptive=True,
+        spec_k_max=k_max,
+        spec_window=1,
+        draft_model_path=base.model_path,
+    ))
+
+    for p, s in zip(plain, spec):
+        if not (p.tokens == s.tokens).all():
+            raise RuntimeError(
+                "adaptive serve run diverged from plain (greedy-exact "
+                "verification broken) — refusing to record its numbers"
+            )
+    per_sweep, rem = divmod(plain_bytes, plain_stats["sweeps"])
+    if rem != 0 or spec_bytes != per_sweep * spec_stats["sweeps"]:
+        raise RuntimeError(
+            "adaptive run streamed extra per-sweep bytes (draft model "
+            f"not free: plain {plain_bytes}B/{plain_stats['sweeps']} "
+            f"sweeps vs adaptive {spec_bytes}B/{spec_stats['sweeps']}) "
+            "— refusing to record its numbers"
+        )
+    result["spec_adaptive_tokens_per_sweep"] = round(
+        spec_stats["tokens_emitted"] / spec_stats["sweeps"], 3
+    )
+    result["spec_adaptive_sweep_ratio"] = round(
+        plain_stats["sweeps"] / spec_stats["sweeps"], 3
+    )
+    result["spec_adaptive_k_final"] = max(
+        spec_stats["spec_ctrl"]["k_by_class"].values()
+    )
+    result["spec_adaptive_acceptance"] = spec_stats.get("spec", {}).get(
+        "acceptance_rate", 0.0
+    )
+    log(
+        f"spec adaptive: tokens_per_sweep="
+        f"{result['spec_adaptive_tokens_per_sweep']} "
+        f"sweep_ratio={result['spec_adaptive_sweep_ratio']} "
+        f"(plain {plain_stats['sweeps']} sweeps -> adaptive "
+        f"{spec_stats['sweeps']}) k {start_k}->"
+        f"{result['spec_adaptive_k_final']} acceptance="
+        f"{result['spec_adaptive_acceptance']}"
+    )
+
+
 def bench_kv_reuse(cfg_obj, tok, result: dict, budget_left,
                    n_tok: int = 8) -> None:
     """Paged prefix-KV pool headline: fraction of total prefix prefill
@@ -2473,6 +2604,13 @@ def run_bench(result: dict) -> None:
                 log("spec serve bench failed:\n" + traceback.format_exc())
         else:
             log("skipping spec serve bench (deadline budget exhausted)")
+        if budget_left() > 0.04:
+            try:
+                bench_spec_adaptive(fw(2), tok, result, budget_left)
+            except Exception:
+                log("spec adaptive bench failed:\n" + traceback.format_exc())
+        else:
+            log("skipping spec adaptive bench (deadline budget exhausted)")
         if budget_left() > 0.03:
             try:
                 bench_kv_reuse(fw(2), tok, result, budget_left)

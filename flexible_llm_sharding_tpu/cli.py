@@ -812,6 +812,40 @@ def build_serve_parser() -> argparse.ArgumentParser:
                         "multiply tokens-per-sweep at no extra stream "
                         "cost, and output stays token-identical to 0 "
                         "(greedy-exact verification); 0 = off")
+    p.add_argument("--draft_model_path", type=str, default="",
+                   help="resident draft model (docs/speculative.md): "
+                        "checkpoint dir of a SMALL model pinned whole on "
+                        "chip through its own residency tier and used as "
+                        "the speculative draft source instead of prompt "
+                        "lookup — draft decode runs against the pinned "
+                        "weights, adding ZERO bytes to the per-sweep "
+                        "weight stream; '' = off (prompt-lookup drafts)")
+    p.add_argument("--spec_adaptive", action="store_true",
+                   help="SLO-aware adaptive draft depth (serve/spec.py): "
+                        "per-class k follows windowed live acceptance "
+                        "between --spec_k_min and --spec_k_max, funds "
+                        "interactive rows first under --spec_draft_budget, "
+                        "and backs off to 0 as the brownout ladder's first "
+                        "lever; requires --speculative_k >= 1 (starting k)")
+    p.add_argument("--spec_k_min", type=int, default=0,
+                   help="adaptive-k lower bound (0 lets a class stop "
+                        "drafting entirely when drafts keep missing)")
+    p.add_argument("--spec_k_max", type=int, default=8,
+                   help="adaptive-k upper bound; the verify slot budget is "
+                        "provisioned at this k so k can grow mid-wave")
+    p.add_argument("--spec_window", type=int, default=8,
+                   help="acceptance window: a class's k moves only after "
+                        "this many observed drafting passes")
+    p.add_argument("--spec_raise_threshold", type=float, default=0.6,
+                   help="raise a class's k when its windowed acceptance "
+                        "reaches this")
+    p.add_argument("--spec_backoff_threshold", type=float, default=0.2,
+                   help="shrink a class's k when its windowed acceptance "
+                        "falls to this or below")
+    p.add_argument("--spec_draft_budget", type=int, default=0,
+                   help="per-pass draft-token budget across the wave, "
+                        "spent in strict SLO-class priority order "
+                        "(interactive first); 0 = unlimited")
     p.add_argument("--wal_dir", type=str, default="",
                    help="crash-safe serving (docs/recovery.md): directory "
                         "for the durable request WAL — every admission, "
@@ -912,6 +946,14 @@ def serve_main(argv: list[str] | None = None, tokenizer=None) -> None:
         router_drain_recoveries=args.router_drain_recoveries,
         max_request_tokens=args.max_request_tokens,
         speculative_k=args.speculative_k,
+        draft_model_path=args.draft_model_path,
+        spec_adaptive=args.spec_adaptive,
+        spec_k_min=args.spec_k_min,
+        spec_k_max=args.spec_k_max,
+        spec_window=args.spec_window,
+        spec_raise_threshold=args.spec_raise_threshold,
+        spec_backoff_threshold=args.spec_backoff_threshold,
+        spec_draft_budget=args.spec_draft_budget,
         wal_dir=args.wal_dir,
         wal_fsync=args.wal_fsync,
         wal_max_mb=args.wal_max_mb,

@@ -1672,6 +1672,35 @@ class ServeConfig:
     # coalescing (coalesced entries draft per-suffix), and the fleet
     # (re-dispatch restarts generation, greedy-exact either way).
     speculative_k: int = 0
+    # --- resident draft model + adaptive k (runtime/draft.py,
+    # serve/spec.py; docs/speculative.md) -------------------------------
+    # Checkpoint directory of a SMALL draft model pinned whole on chip
+    # through a dedicated residency tier ("" = off, keep prompt-lookup
+    # drafting). Draft decode runs entirely against the pinned weights:
+    # zero bytes added to the per-sweep host→HBM stream. Output stays
+    # token-identical whatever the draft model proposes.
+    draft_model_path: str = ""
+    # Close the loop: adapt per-SLO-class draft depth k from windowed
+    # live acceptance (raise while drafts land, shrink while they miss),
+    # fund interactive-class rows first, and back k off to 0 as the
+    # brownout ladder's first lever (runtime/pressure.py spec_backoff).
+    # Requires speculative_k > 0 (the starting k) — the slot budget is
+    # provisioned at spec_k_max so k can grow without re-planning waves.
+    spec_adaptive: bool = False
+    # Adaptive-k bounds: per-class k stays in [spec_k_min, spec_k_max].
+    spec_k_min: int = 0
+    spec_k_max: int = 8
+    # Acceptance window: a class's k moves only after this many observed
+    # drafting passes, comparing windowed acceptance against the two
+    # thresholds (raise at >= spec_raise_threshold, shrink at
+    # <= spec_backoff_threshold; in between holds).
+    spec_window: int = 8
+    spec_raise_threshold: float = 0.6
+    spec_backoff_threshold: float = 0.2
+    # Per-pass draft-token budget across the wave (0 = unlimited):
+    # rows are funded in strict SLO-class priority order, so under a
+    # budget best-effort drafts are the first to go.
+    spec_draft_budget: int = 0
     # Multi-tenant sweep scheduler (serve/sched/; --sched* flags): SLO
     # classes with strict priority + sweep-boundary preemption,
     # per-tenant fair queueing and rate limits, prefix coalescing. Off
@@ -1753,6 +1782,37 @@ class ServeConfig:
                 "ServeConfig.speculative_k must be in [0, 64], got "
                 f"{self.speculative_k}"
             )
+        if self.spec_adaptive and self.speculative_k < 1:
+            raise ValueError(
+                "spec_adaptive requires speculative_k >= 1 (the starting "
+                "draft depth)"
+            )
+        if not 0 <= self.spec_k_min <= self.spec_k_max <= 64:
+            raise ValueError(
+                "need 0 <= spec_k_min <= spec_k_max <= 64, got "
+                f"[{self.spec_k_min}, {self.spec_k_max}]"
+            )
+        if self.spec_adaptive and not (
+            self.spec_k_min <= self.speculative_k <= self.spec_k_max
+        ):
+            raise ValueError(
+                "speculative_k must sit inside [spec_k_min, spec_k_max] "
+                f"when spec_adaptive is on, got k={self.speculative_k} "
+                f"bounds=[{self.spec_k_min}, {self.spec_k_max}]"
+            )
+        if self.spec_window < 1:
+            raise ValueError("spec_window must be >= 1")
+        if not (
+            0.0 <= self.spec_backoff_threshold
+            <= self.spec_raise_threshold <= 1.0
+        ):
+            raise ValueError(
+                "need 0 <= spec_backoff_threshold <= spec_raise_threshold "
+                f"<= 1, got backoff={self.spec_backoff_threshold} "
+                f"raise={self.spec_raise_threshold}"
+            )
+        if self.spec_draft_budget < 0:
+            raise ValueError("spec_draft_budget must be >= 0 (0 = unlimited)")
         if self.autoscale.enabled and not (
             self.autoscale.min <= self.replicas <= self.autoscale.max
         ):

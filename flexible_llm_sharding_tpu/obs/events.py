@@ -82,6 +82,9 @@ EVENT_KINDS = {
     "pressure_event": "error",       # hard resource event (OOM / ENOSPC)
     # SLO error budgets (obs/slo.py)
     "slo_budget_exhausted": "error",  # a class burned its error budget
+    # adaptive speculation controller (serve/spec.py)
+    "spec_k_raise": "info",          # windowed acceptance earned a class +1 k
+    "spec_k_backoff": "info",        # k shrank: low acceptance or pressure
     # fleet autoscaler (serve/autoscale.py)
     "autoscale_grow": "info",        # controller added a replica
     "autoscale_shrink": "info",      # controller started a graceful drain

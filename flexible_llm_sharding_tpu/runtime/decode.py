@@ -356,6 +356,20 @@ def _spec_norm_head(cfg: LlamaConfig, norm_params, head_params, x):
     )
 
 
+# propose_draft scans at most this many trailing tokens of each haystack
+# (own context and each sibling-corpus pool). Without the cap the sweep
+# over sliding_window_view is O(context) per suffix per pass — a long
+# context rescans its whole token history every step for a draft whose
+# useful matches are overwhelmingly recent (the lookup wants the LAST
+# occurrence anyway). Bounding the scan to the trailing window keeps the
+# per-pass draft cost constant; behavior is identical whenever the
+# sequence fits the window (pinned by tests/test_spec_serve.py), and on
+# longer histories only matches older than the window are forgone —
+# a draft-quality change only, never a correctness one (verification is
+# draft-agnostic).
+DRAFT_SCAN_WINDOW = 512
+
+
 def propose_draft(context_ids, k: int, ngram: int = 2, corpus=None):
     """Prompt-lookup drafting (public technique — Saxena's prompt lookup
     decoding / HF assisted generation's n-gram candidate source): find the
@@ -391,11 +405,16 @@ def propose_draft(context_ids, k: int, ngram: int = 2, corpus=None):
         for hay, pool in [(ids[: n - 1], ids)] + [(p, p) for p in pools]:
             if len(hay) < g:
                 continue
-            win = np.lib.stride_tricks.sliding_window_view(hay, g)
+            # Bounded match window: scan only the trailing
+            # DRAFT_SCAN_WINDOW tokens; ``off`` maps window-relative hit
+            # positions back into the pool for the continuation slice.
+            off = max(0, len(hay) - DRAFT_SCAN_WINDOW)
+            win = np.lib.stride_tricks.sliding_window_view(hay[off:], g)
             hits = np.flatnonzero((win == tail[None, :]).all(axis=1))
             # Last match with a nonempty continuation (a pool match at the
             # pool's very end proposes nothing).
             for start in hits[::-1]:
+                start = off + int(start)
                 cont = pool[int(start) + g : int(start) + g + k]
                 if len(cont):
                     draft = [int(c) for c in cont]
@@ -506,6 +525,32 @@ class SpecVerifier:
                     self.hist_d[r][s] = [init_dist[r, s]] * bud
                     self.hist_t[r][s] = [int(init_toks[r, s])] * bud
         self._fed = self._drafts = self._base = None
+        # Per-pass per-row draft-request widths (None = every row drafts
+        # the full ``spec_k``) and the matching per-row accounting deltas
+        # of the latest finished pass — the serve engine's per-SLO-class
+        # counter split reads these instead of diffing the totals.
+        self._pass_k = None
+        self.last_drafted = np.zeros((bsz, s_b), np.int64)
+        self.last_accepted = np.zeros((bsz, s_b), np.int64)
+
+    def set_pass_k(self, karr) -> None:
+        """Cap the next passes' per-row draft requests at ``karr`` [B, S]
+        (clipped to [0, spec_k]; None restores the uniform default). The
+        fed window stays K+1 wide — static shapes, one compile — but a
+        row capped at ``k_use`` only drafts/verifies its first ``k_use``
+        slots; at 0 it requests no drafts at all (one token per pass,
+        the plain-path cadence). Acceptance accounting counts only the
+        requested slots, so an adaptive controller's signal is never
+        polluted by slots it chose not to spend."""
+        if karr is None:
+            self._pass_k = None
+            return
+        self._pass_k = np.clip(
+            np.asarray(karr, np.int64), 0, self.k
+        ).reshape(self.g.shape)
+
+    def _k_use(self, r: int, s: int) -> int:
+        return self.k if self._pass_k is None else int(self._pass_k[r, s])
 
     @property
     def done(self) -> bool:
@@ -541,18 +586,21 @@ class SpecVerifier:
                 # Draft only when an accepted token could still be
                 # emitted (remaining > 1): at remaining == 1 the pass
                 # emits exactly picks[0] whatever rides the draft slots.
-                if self.budgets[r, s] - self.g[r, s] > 1:
+                k_use = self._k_use(r, s)
+                if k_use > 0 and self.budgets[r, s] - self.g[r, s] > 1:
                     if self._corpus_ok:
                         sib = [
                             self.ctx[r][j]
                             for j in range(s_b)
                             if j != s and self.active[r, j]
                         ]
-                        drafts[r, s] = self._draft(
-                            self.ctx[r][s], self.k, corpus=sib
+                        drafts[r, s, :k_use] = self._draft(
+                            self.ctx[r][s], k_use, corpus=sib
                         )
                     else:
-                        drafts[r, s] = self._draft(self.ctx[r][s], self.k)
+                        drafts[r, s, :k_use] = self._draft(
+                            self.ctx[r][s], k_use
+                        )
         fed[:, :, 1:] = drafts
         self._fed, self._drafts = fed, drafts
         self._base = (self.g - 1).astype(np.int32)
@@ -569,22 +617,27 @@ class SpecVerifier:
         picks = np.argmax(dist, axis=-1)  # [B, S, K+1]
         bsz, s_b = self.g.shape
         emitted = np.zeros((bsz, s_b), np.int64)
+        self.last_drafted.fill(0)
+        self.last_accepted.fill(0)
         for r in range(bsz):
             for s in range(s_b):
                 if self.g[r, s] >= self.budgets[r, s]:
                     continue
+                k_use = self._k_use(r, s)
                 a = 0
                 while (
-                    a < self.k
+                    a < k_use
                     and picks[r, s, a] == self._drafts[r, s, a]
                 ):
                     a += 1
                 remaining = int(self.budgets[r, s] - self.g[r, s])
-                useful_k = min(self.k, remaining - 1)
+                useful_k = min(k_use, remaining - 1)
                 acc = min(a, useful_k)
                 self.drafted += useful_k
                 self.accepted += acc
                 self.rejected += useful_k - acc
+                self.last_drafted[r, s] = useful_k
+                self.last_accepted[r, s] = acc
                 emit = int(min(a + 1, remaining))
                 for j in range(emit):
                     # copy(): a bare dist[r, s, j] view would pin the

@@ -1,0 +1,169 @@
+"""Resident draft model: a small model pinned whole on chip as a
+first-class speculative draft source (ROADMAP item 3's close-out).
+
+The architecture's defining cost is that every decode sweep streams the
+TARGET model through the chip — so draft compute is the one thing the
+serving path can spend without touching the host→HBM link. A draft model
+small enough to live in leftover HBM is pinned permanently through the
+SAME residency machinery the target's hot layers use
+(``runtime/residency.py``: verified pin loads, demote-on-failure,
+stats), and draft decode between sweeps runs entirely against the pinned
+parameters: **zero** bytes added to the per-sweep weight stream (pinned
+by tests from the executors' own streamed-bytes counters — the pin loads
+count once at construction, never per sweep).
+
+``DraftModel.propose`` satisfies the ``SpecVerifier`` draft contract
+(``draft_fn(context_ids, k) -> exactly-k int64 ids``, the plain 2-arg
+signature — no sibling corpus; the draft model grounds in its own
+forward pass, not n-gram lookup). Verification stays draft-agnostic, so
+serving output remains greedy-exact/token-identical to
+``speculative_k=0`` whatever this model proposes; quality only moves
+acceptance, i.e. tokens per sweep.
+
+Deliberate simplification: drafting runs ``k`` monolithic
+``forward_full`` calls (bucket-padded, jit-cached per padded length)
+instead of keeping a KV cache. The draft model is small by contract and
+the calls never touch the link; a cached draft decode is a later
+optimisation, not a correctness or accounting difference.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from flexible_llm_sharding_tpu.config import LlamaConfig
+from flexible_llm_sharding_tpu.models.llama import forward_full
+from flexible_llm_sharding_tpu.utils import checkpoint
+
+# Draft contexts are padded up to a multiple of this before the forward:
+# one compile per padded-length bucket instead of one per context length.
+DRAFT_PAD_MULTIPLE = 64
+
+
+class DraftModel:
+    """Loads, pins, and serves greedy draft continuations for one draft
+    checkpoint. Construction is fail-fast: every layer must pin (a draft
+    model that would stream per call violates its whole premise)."""
+
+    def __init__(
+        self, model_path: str, device=None, np_dtype=np.float32,
+        retry_policy=None, injector=None, retry_recorder=None,
+        integrity=None, host_cache=None,
+    ):
+        from flexible_llm_sharding_tpu.runtime.executor import (
+            _HostShardLoader,
+        )
+        from flexible_llm_sharding_tpu.runtime.residency import (
+            DeviceResidencyTier,
+            full_pin_plan,
+        )
+
+        self.model_path = model_path
+        self.cfg = LlamaConfig.from_pretrained(model_path)
+        self._lock = threading.Lock()
+        # Draft-economy counters (exported via stats(); the engine
+        # registers stats as the ``draft`` metrics source).
+        self.draft_calls = 0
+        self.draft_tokens = 0
+        names = checkpoint.layer_names_for(
+            self.cfg.num_hidden_layers, self.cfg.tie_word_embeddings
+        )
+        self._loader = _HostShardLoader(
+            model_path,
+            names,
+            np_dtype,
+            tied_embeddings=self.cfg.tie_word_embeddings,
+            retry_policy=retry_policy,
+            injector=injector,
+            retry_recorder=retry_recorder,
+            integrity=integrity,
+            host_cache=host_cache,
+        )
+        plan = full_pin_plan(
+            model_path, names, self.cfg.tie_word_embeddings
+        )
+        # A dedicated tier — NEVER the process singleton (tier_for is
+        # keyed to the TARGET model, and the brownout ladder's pin_evict
+        # lever empties exactly that tier). The draft pins deliberately
+        # survive pressure: evicting them would turn every draft call
+        # into a full re-stream, and the ladder already has a cheaper
+        # draft lever (spec_backoff: stop drafting, keep the pins).
+        self.tier = DeviceResidencyTier(model_path, names, plan)
+        self.device = device if device is not None else jax.devices()[0]
+        params: dict = {}
+        stacks = []
+        for idx, name in enumerate(names):
+            segs = self.tier.segments(idx, self.device, self._loader)
+            for kind, p in segs:
+                if kind == "decoders":
+                    stacks.append(p["layers"])
+                elif kind == "embed":
+                    params["embed"] = p
+                elif kind == "norm":
+                    params["norm"] = p
+                elif kind == "head":
+                    params["lm_head"] = p
+        # One stacked pytree (leading layer axis) -> forward_full's scan
+        # path: one compile per padded-length bucket regardless of depth.
+        params["layers"] = jax.tree.map(
+            lambda *xs: jnp.concatenate(xs, axis=0), *stacks
+        )
+        self._params = params
+        cfg = self.cfg
+
+        def fwd(p, ids):
+            return forward_full(p, cfg, ids)
+
+        self._fwd = jax.jit(fwd)
+        # Forward contexts are truncated to the draft model's own
+        # positional reach; a draft over a trailing window is still just
+        # a draft (verification is draft-agnostic).
+        self._ctx_cap = int(self.cfg.max_position_embeddings)
+
+    def propose(self, context_ids, k: int) -> np.ndarray:
+        """Greedy k-token continuation of ``context_ids`` under the
+        pinned draft model — the SpecVerifier draft contract (exactly k
+        int64 ids, static shapes)."""
+        ids = np.asarray(context_ids, np.int64)
+        out: list[int] = []
+        for _ in range(k):
+            out.append(self._next_token(ids))
+            ids = np.append(ids, out[-1])
+        with self._lock:
+            self.draft_calls += 1
+            self.draft_tokens += k
+        return np.asarray(out, np.int64)
+
+    def _next_token(self, ids: np.ndarray) -> int:
+        if len(ids) >= self._ctx_cap:
+            ids = ids[-(self._ctx_cap - 1):]
+        n = len(ids)
+        pad = -(-n // DRAFT_PAD_MULTIPLE) * DRAFT_PAD_MULTIPLE
+        # Right padding is causally invisible to position n-1, so the
+        # bucket-padded forward scores the true last token exactly.
+        buf = np.zeros((1, pad), np.int64)
+        buf[0, :n] = ids
+        logits = self._fwd(self._params, jnp.asarray(buf))
+        return int(np.argmax(np.asarray(logits[0, n - 1])))
+
+    def stats(self) -> dict:
+        """The ``draft`` metrics source: call/token counters plus the
+        pin-side story (layers/bytes pinned, the one-time stream cost of
+        loading them) — the operator's witness that drafting is resident
+        compute, not link traffic."""
+        tier = self.tier.stats()
+        with self._lock:
+            return {
+                "draft_calls": self.draft_calls,
+                "draft_tokens": self.draft_tokens,
+                "pinned_layers": tier.get("pinned_layers", 0),
+                "pinned_bytes": tier.get("pinned_bytes", 0),
+                "pin_stream_bytes": self._loader.bytes_loaded,
+            }
+
+    def close(self) -> None:
+        self._loader.close()

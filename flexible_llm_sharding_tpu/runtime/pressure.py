@@ -257,6 +257,11 @@ class BrownoutController:
     """
 
     # Ladder levels above 0 (normal), in engage order.
+    # spec_backoff leads the ladder: speculative draft compute is pure
+    # optional spend (stopping it frees host/chip cycles at unchanged
+    # output, and costs only sweeps-per-token to re-earn), so it is the
+    # first thing a pressured host stops buying and the last thing a
+    # clean host restores on the way down.
     # adapter_evict sits right after the shard-cache shrink: evicted
     # LoRA deltas reload from disk in one checksummed read (cheapest
     # give-back after clean shard-cache bytes), and the cap latch keeps
@@ -265,8 +270,8 @@ class BrownoutController:
     # spill to checksummed disk (or drop and re-prefill) — cheaper to
     # give back than pinned weights, dearer than a clean shard cache.
     LADDER = (
-        "cache_shrink", "adapter_evict", "kv_evict", "pin_evict", "shed",
-        "replica_drain",
+        "spec_backoff", "cache_shrink", "adapter_evict", "kv_evict",
+        "pin_evict", "shed", "replica_drain",
     )
 
     def __init__(self, cfg):
@@ -278,6 +283,7 @@ class BrownoutController:
         self._events_pending = 0  # guarded by: _lock
         self._queues: list = []  # guarded by: _lock
         self._fleet = None  # guarded by: _lock
+        self._spec_ctrls: list = []  # guarded by: _lock
         self._saved_cache_budget: int | None = None
         self._saved_adapter_budget: int | None = None
         self._last: PressureSnapshot = PressureSnapshot()
@@ -291,6 +297,8 @@ class BrownoutController:
         self.pin_evictions = 0
         self.replica_drains = 0
         self.replica_restores = 0
+        self.spec_backoffs = 0
+        self.spec_restores = 0
         self.host_oom_events = 0
         self.disk_full_events = 0
         self.link_events = 0
@@ -324,6 +332,24 @@ class BrownoutController:
         with self._lock:
             if self._fleet is fleet:
                 self._fleet = None
+
+    def attach_spec(self, ctrl) -> None:
+        """Register an adaptive speculation controller (serve/spec.py) as
+        the spec_backoff lever's target. One attached while the ladder
+        already sits at (or above) that level backs off immediately —
+        the mid-brownout attach rule the queues follow."""
+        with self._lock:
+            if ctrl not in self._spec_ctrls:
+                self._spec_ctrls.append(ctrl)
+            backed_off = self.level >= self._level_of("spec_backoff")
+        if backed_off:
+            ctrl.pressure_backoff()
+
+    def detach_spec(self, ctrl) -> None:
+        with self._lock:
+            if ctrl in self._spec_ctrls:
+                self._spec_ctrls.remove(ctrl)
+        ctrl.pressure_restore()
 
     # -- event intake ------------------------------------------------------
 
@@ -436,7 +462,15 @@ class BrownoutController:
     def _engage(self, idx: int) -> None:
         stage = self.LADDER[idx]
         try:
-            if stage == "cache_shrink":
+            if stage == "spec_backoff":
+                with self._lock:
+                    ctrls = list(self._spec_ctrls)
+                for c in ctrls:
+                    c.pressure_backoff()
+                if ctrls:
+                    with self._lock:
+                        self.spec_backoffs += len(ctrls)
+            elif stage == "cache_shrink":
                 from flexible_llm_sharding_tpu.runtime import hostcache
 
                 prev = hostcache.apply_pressure_cap(
@@ -493,7 +527,15 @@ class BrownoutController:
     def _release(self, idx: int) -> None:
         stage = self.LADDER[idx]
         try:
-            if stage == "cache_shrink":
+            if stage == "spec_backoff":
+                with self._lock:
+                    ctrls = list(self._spec_ctrls)
+                for c in ctrls:
+                    c.pressure_restore()
+                if ctrls:
+                    with self._lock:
+                        self.spec_restores += len(ctrls)
+            elif stage == "cache_shrink":
                 from flexible_llm_sharding_tpu.runtime import hostcache
 
                 with self._lock:
@@ -544,6 +586,8 @@ class BrownoutController:
                 "steps_up": self.steps_up,
                 "steps_down": self.steps_down,
                 "sheds": self.sheds,
+                "spec_backoffs": self.spec_backoffs,
+                "spec_restores": self.spec_restores,
                 "cache_shrinks": self.cache_shrinks,
                 "adapter_evictions": self.adapter_evictions,
                 "kv_evictions": self.kv_evictions,

@@ -211,6 +211,25 @@ def plan_residency(
     )
 
 
+def full_pin_plan(
+    model_path: str,
+    layer_names: Sequence[str],
+    tied_embeddings: bool = False,
+) -> ResidencyPlan:
+    """A plan that pins EVERY layer — the resident draft model's case
+    (``runtime/draft.py``): the model is chosen precisely because it fits
+    on chip whole, so the budget is the model's own footprint and the
+    greedy knapsack degenerates to "all of it". Kept here so the draft
+    tier rides the same ``ResidencyPlan``/``DeviceResidencyTier``
+    machinery (verified pin loads, demote-on-failure, stats) instead of
+    a parallel pinning path."""
+    sizes = layer_stream_bytes(model_path, layer_names, tied_embeddings)
+    total = sum(sizes)
+    return plan_residency(
+        model_path, layer_names, max(total, 1), tied_embeddings
+    )
+
+
 def auto_pin_budget_bytes(device=None) -> int:
     """Auto pin budget: measured free HBM minus the activation headroom.
 

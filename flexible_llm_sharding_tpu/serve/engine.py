@@ -141,6 +141,10 @@ class _WaveState:
     # Per-sweep slot offsets fixed at the embed segment (shard 0) and
     # consumed by every decoder segment of the same sweep.
     spec_base: dict[int, np.ndarray] = dataclasses.field(default_factory=dict)
+    # Per-block [B][S] SLO-class name of each suffix row's OWNING request
+    # (None for bucket padding) — drives the per-class fls_spec_* split
+    # and the adaptive controller's per-row k assignment.
+    spec_classes: dict[int, list] = dataclasses.field(default_factory=dict)
     # Paged prefix-KV pool (runtime/kvpool.py): one PrefixHandle per wave
     # entry — the entry's lease on its block table, held from admission
     # to retire/preempt/abort — and the blocks whose EVERY row reuses a
@@ -362,6 +366,46 @@ class ServeEngine:
                 "pressure", self._pressure.stats,
                 mirror=False,  # process-level: controller_for registers it
             )
+        # Resident draft model (runtime/draft.py): a small model pinned
+        # whole on chip through its OWN residency tier and used as the
+        # draft source instead of prompt lookup — draft decode runs
+        # against the pinned weights, so speculation adds ZERO bytes to
+        # the per-sweep weight stream. Construction is fail-fast (a
+        # draft model that would stream per call defeats its premise).
+        self._draft_model = None
+        if self.serve_cfg.draft_model_path:
+            from flexible_llm_sharding_tpu.runtime.draft import DraftModel
+
+            self._draft_model = DraftModel(
+                self.serve_cfg.draft_model_path,
+                device=device,
+                retry_policy=self._retry_policy,
+                injector=self._injector,
+            )
+            self.metrics.register("draft", self._draft_model.stats)
+        # SLO-aware adaptive k (serve/spec.py): per-class draft depth
+        # follows windowed live acceptance. The verify slot budget is
+        # provisioned at spec_k_max so the controller can raise k
+        # without re-planning waves; per-pass depths are assigned via
+        # SpecVerifier.set_pass_k. Registered with the brownout ladder
+        # as the spec_backoff lever's target.
+        self._spec_ctrl = None
+        if self.serve_cfg.spec_adaptive:
+            from flexible_llm_sharding_tpu.serve.spec import SpecController
+
+            self._spec_k = self.serve_cfg.spec_k_max
+            self._spec_ctrl = SpecController(
+                self.serve_cfg.speculative_k,
+                self.serve_cfg.spec_k_min,
+                self.serve_cfg.spec_k_max,
+                self.serve_cfg.spec_window,
+                self.serve_cfg.spec_raise_threshold,
+                self.serve_cfg.spec_backoff_threshold,
+                self.serve_cfg.spec_draft_budget,
+            )
+            self.metrics.register("spec_ctrl", self._spec_ctrl.stats)
+            if self._pressure is not None:
+                self._pressure.attach_spec(self._spec_ctrl)
         # The one scheduling policy object (runtime/schedcore.py): wave
         # admission quotas, generated-KV slot sizing, and the residency
         # decision — shared verbatim with the offline DecodeGenerator so
@@ -526,6 +570,8 @@ class ServeEngine:
             # A dead engine's queue must stop being a shed target (and a
             # recycled replica's fresh queue attaches on construction).
             self._pressure.detach_queue(self.queue)
+            if self._spec_ctrl is not None:
+                self._pressure.detach_spec(self._spec_ctrl)
         self.queue.close(drain=drain)
         ok = True
         if self._thread is not None:
@@ -533,6 +579,8 @@ class ServeEngine:
             ok = not self._thread.is_alive()
         if self.metrics_server is not None:
             self.metrics_server.close()
+        if self._draft_model is not None:
+            self._draft_model.close()
         # Retract this engine's process-wide registry mirrors: a dead
         # engine must neither serve stale counters to a later process-
         # wide dump nor pin its object graph for the process lifetime.
@@ -552,6 +600,8 @@ class ServeEngine:
             return self.shutdown(drain=False, timeout=timeout)
         if self._pressure is not None:
             self._pressure.detach_queue(self.queue)
+            if self._spec_ctrl is not None:
+                self._pressure.detach_spec(self._spec_ctrl)
         # Park still-QUEUED requests first (persist=True -> RestartPending,
         # admission records stay open), then flag the loop: it drains the
         # in-flight waves at the next boundary and exits.
@@ -570,6 +620,8 @@ class ServeEngine:
         )
         if self.metrics_server is not None:
             self.metrics_server.close()
+        if self._draft_model is not None:
+            self._draft_model.close()
         self.metrics.close()
         return ok
 
@@ -1692,11 +1744,24 @@ class ServeEngine:
         one wave finish early per request, exactly like the plain path)."""
         st: _WaveState = wave.state
         st.spec = {}
+        st.spec_classes = {}
+        # Resident draft model (when configured) replaces prompt-lookup
+        # drafting; verification is draft-agnostic either way, so the
+        # choice moves only acceptance, never a token.
+        draft_fn = (
+            self._draft_model.propose
+            if self._draft_model is not None
+            else None
+        )
         for b, idxs in enumerate(st.blocks):
             bsz = len(idxs)
             s_b = st.toks[idxs[0]].suffix_ids.shape[0]
             budgets = np.ones((bsz, s_b), np.int64)
             active = np.zeros((bsz, s_b), bool)
+            # [B][S] owning request's SLO class (None = bucket padding):
+            # feeds the per-class fls_spec_* split and, adaptive, the
+            # controller's per-row k assignment.
+            classes: list[list] = [[None] * s_b for _ in range(bsz)]
             for row, e_idx in enumerate(idxs):
                 e = wave.entries[e_idx]
                 for (off, cnt), member in zip(e.slices, e.requests):
@@ -1704,12 +1769,15 @@ class ServeEngine:
                         member.max_new_tokens - member.resume_len
                     )
                     active[row, off : off + cnt] = True
+                    for s in range(off, off + cnt):
+                        classes[row][s] = member.slo_class
             # Padding rows: budget 1 (frozen immediately; their constant
             # history fill stays minimal).
             d0, t0 = st.scores[b][0], st.tok_hist[b][0]
+            st.spec_classes[b] = classes
             st.spec[b] = SpecVerifier(
                 self._spec_k,
-                None,
+                draft_fn,
                 draft_contexts([st.toks[i] for i in idxs], t0),
                 budgets,
                 d0,
@@ -1750,6 +1818,16 @@ class ServeEngine:
             dec_off = 0
             for kind, params in segments:
                 if kind == "embed":
+                    if self._spec_ctrl is not None:
+                        # Adaptive k: the controller assigns this pass's
+                        # per-row draft depth (class-priority funding,
+                        # 0 everywhere while pressure-backed-off) before
+                        # the drafts are fixed.
+                        v.set_pass_k(
+                            self._spec_ctrl.assign(
+                                st.spec_classes[b], v.budgets - v.g
+                            )
+                        )
                     # Drafts are fixed per pass BEFORE the sweep's
                     # decoders run; base rides wave state to every
                     # decoder segment of this sweep.
@@ -1805,9 +1883,37 @@ class ServeEngine:
                     d_draft = v.drafted - before[0]
                     d_acc = v.accepted - before[1]
                     d_rej = v.rejected - before[2]
-                    self.metrics.spec_count(
-                        drafted=d_draft, accepted=d_acc, rejected=d_rej
-                    )
+                    # Per-class split of the pass's draft economy (the
+                    # fls_spec_by_class_* family): the per-row drafted/
+                    # accepted the verifier just recorded, keyed by each
+                    # row's owning request's SLO class. Sums equal the
+                    # aggregate deltas exactly (padding rows draft 0).
+                    per_cls: dict[str, list[int]] = {}
+                    classes = st.spec_classes.get(b)
+                    if classes is not None:
+                        for r_i in range(v.last_drafted.shape[0]):
+                            for s_i in range(v.last_drafted.shape[1]):
+                                dk = int(v.last_drafted[r_i, s_i])
+                                if dk <= 0:
+                                    continue
+                                cls = classes[r_i][s_i]
+                                acc = per_cls.setdefault(cls, [0, 0])
+                                acc[0] += dk
+                                acc[1] += int(
+                                    v.last_accepted[r_i, s_i]
+                                )
+                    if per_cls:
+                        for cls, (c_d, c_a) in per_cls.items():
+                            self.metrics.spec_count(
+                                drafted=c_d, accepted=c_a,
+                                rejected=c_d - c_a, slo_class=cls,
+                            )
+                            if self._spec_ctrl is not None:
+                                self._spec_ctrl.observe(cls, c_d, c_a)
+                    else:
+                        self.metrics.spec_count(
+                            drafted=d_draft, accepted=d_acc, rejected=d_rej
+                        )
                     obs_trace.instant(
                         "spec_verify", cat="spec", wave_id=wave.wave_id,
                         block=b, accepted=int(d_acc), drafted=int(d_draft),

@@ -1,0 +1,201 @@
+"""SLO-aware adaptive speculation controller (``--spec_adaptive``).
+
+Closes the control loop around the serving spec path: the per-pass
+acceptance signal the engine already counts (the ``fls_spec_*`` family,
+now split per SLO class) drives per-class draft depth ``k`` — raise k
+for a class whose drafts keep landing, shrink toward ``spec_k_min`` for
+one whose drafts keep missing, and spend a bounded per-pass draft budget
+on interactive-class rows first (strict class priority, the scheduler's
+own order). Verification stays draft-agnostic, so every decision here
+moves only sweeps-per-token, never a single emitted token.
+
+The controller is also a brownout lever: ``runtime/pressure.py`` engages
+``spec_backoff`` as the ladder's FIRST (cheapest) stage — draft compute
+is pure spend, so it is the first thing a pressured host stops buying.
+While backed off every row drafts 0 (the plain one-token-per-sweep
+cadence at unchanged output); release restores the adapted per-class
+k's, which the acceptance windows keep warm across the event.
+
+Decisions journal as ``spec_k_raise`` / ``spec_k_backoff`` events and
+every counter is exported via ``stats()`` (registered as the
+``spec_ctrl`` metrics source).
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from flexible_llm_sharding_tpu.obs import events as obs_journal
+from flexible_llm_sharding_tpu.serve.sched.classes import SLO_CLASSES
+
+
+class SpecController:
+    """Per-SLO-class adaptive draft depth for one serving engine.
+
+    ``assign(classes, remaining)`` -> per-row k for the next verify pass
+    (the engine hands it to ``SpecVerifier.set_pass_k``);
+    ``observe(slo_class, drafted, accepted)`` feeds a pass's per-class
+    deltas back; every ``window`` observed passes per class the windowed
+    acceptance moves that class's k one step. All methods are called
+    from the serving loop; ``stats()`` is scraped concurrently."""
+
+    def __init__(
+        self,
+        spec_k: int,
+        k_min: int,
+        k_max: int,
+        window: int,
+        raise_threshold: float,
+        backoff_threshold: float,
+        draft_budget: int = 0,
+    ):
+        self._lock = threading.Lock()
+        self.k_min = int(k_min)
+        self.k_max = int(k_max)
+        self.window = int(window)
+        self.raise_threshold = float(raise_threshold)
+        self.backoff_threshold = float(backoff_threshold)
+        self.draft_budget = int(draft_budget)
+        start = min(max(int(spec_k), self.k_min), self.k_max)
+        self._k = {c: start for c in SLO_CLASSES}
+        # Per-class accumulation window: (observed passes, drafted,
+        # accepted) since the last decision.
+        self._win = {c: [0, 0, 0] for c in SLO_CLASSES}
+        self._backed_off = False
+        # Counters (all exported via stats(); COUNTER-EXPORT audited).
+        self.k_raises = 0
+        self.k_backoffs = 0
+        self.pressure_backoffs = 0
+        self.pressure_restores = 0
+        self.assigned_tokens = 0
+        self.budget_clipped_tokens = 0
+
+    # -- the per-pass allocation -------------------------------------------
+
+    def assign(self, classes, remaining) -> np.ndarray:
+        """Per-row draft depths for one verify pass. ``classes``: [B][S]
+        SLO-class names (None for padding/finished rows); ``remaining``:
+        [B, S] tokens each row may still emit. Rows are funded in strict
+        class-priority order — interactive first — and ``draft_budget``
+        (0 = unlimited) caps the pass's total drafted tokens, so under a
+        budget best-effort drafts are the first to go."""
+        rem = np.asarray(remaining)
+        karr = np.zeros(rem.shape, np.int64)
+        with self._lock:
+            if self._backed_off:
+                return karr
+            budget_left = self.draft_budget if self.draft_budget > 0 else None
+            for cls in SLO_CLASSES:
+                k_cls = self._k[cls]
+                if k_cls <= 0:
+                    continue
+                for r in range(rem.shape[0]):
+                    for s in range(rem.shape[1]):
+                        if classes[r][s] != cls or rem[r, s] <= 1:
+                            continue
+                        # A row can only turn remaining-1 drafts into
+                        # emissions; requesting more buys nothing.
+                        k_row = min(k_cls, int(rem[r, s]) - 1)
+                        if budget_left is not None:
+                            if budget_left <= 0:
+                                self.budget_clipped_tokens += k_row
+                                continue
+                            if k_row > budget_left:
+                                self.budget_clipped_tokens += (
+                                    k_row - budget_left
+                                )
+                                k_row = budget_left
+                            budget_left -= k_row
+                        karr[r, s] = k_row
+                        self.assigned_tokens += k_row
+        return karr
+
+    # -- the feedback edge -------------------------------------------------
+
+    def observe(self, slo_class: str, drafted: int, accepted: int) -> None:
+        """Feed one pass's per-class draft economy back. Padding-only or
+        zero-draft passes don't advance the window (no evidence)."""
+        if drafted <= 0:
+            return
+        decision = None
+        with self._lock:
+            win = self._win.get(slo_class)
+            if win is None:
+                win = self._win[slo_class] = [0, 0, 0]
+            win[0] += 1
+            win[1] += drafted
+            win[2] += accepted
+            if win[0] < self.window:
+                return
+            acceptance = win[2] / win[1]
+            self._win[slo_class] = [0, 0, 0]
+            k = self._k[slo_class]
+            if acceptance >= self.raise_threshold and k < self.k_max:
+                self._k[slo_class] = k + 1
+                self.k_raises += 1
+                decision = ("spec_k_raise", k + 1, acceptance)
+            elif acceptance <= self.backoff_threshold and k > self.k_min:
+                self._k[slo_class] = k - 1
+                self.k_backoffs += 1
+                decision = ("spec_k_backoff", k - 1, acceptance)
+        if decision is not None:
+            kind, new_k, acc = decision
+            obs_journal.emit(
+                kind, slo_class=slo_class, k=new_k,
+                acceptance=round(acc, 4), reason="acceptance",
+            )
+
+    # -- the brownout lever (runtime/pressure.py spec_backoff stage) -------
+
+    def pressure_backoff(self) -> None:
+        """Engage: stop requesting drafts (every row k=0) until release.
+        The adapted per-class k's and half-filled acceptance windows are
+        kept — the spend stops, the learning doesn't reset."""
+        with self._lock:
+            if self._backed_off:
+                return
+            self._backed_off = True
+            self.pressure_backoffs += 1
+            ks = dict(self._k)
+        obs_journal.emit(
+            "spec_k_backoff", k=0, reason="pressure",
+            **{f"k_{c}": v for c, v in ks.items()},
+        )
+
+    def pressure_restore(self) -> None:
+        """Release: resume drafting at the adapted per-class k's."""
+        with self._lock:
+            if not self._backed_off:
+                return
+            self._backed_off = False
+            self.pressure_restores += 1
+            ks = dict(self._k)
+        obs_journal.emit(
+            "spec_k_raise", reason="pressure_restore",
+            **{f"k_{c}": v for c, v in ks.items()},
+        )
+
+    # -- observability ------------------------------------------------------
+
+    def current_k(self, slo_class: str) -> int:
+        with self._lock:
+            if self._backed_off:
+                return 0
+            return self._k.get(slo_class, self.k_min)
+
+    def stats(self) -> dict:
+        """The ``spec_ctrl`` metrics source: live per-class k, the
+        backed-off flag, and every decision/allocation counter."""
+        with self._lock:
+            return {
+                "k_raises": self.k_raises,
+                "k_backoffs": self.k_backoffs,
+                "pressure_backoffs": self.pressure_backoffs,
+                "pressure_restores": self.pressure_restores,
+                "assigned_tokens": self.assigned_tokens,
+                "budget_clipped_tokens": self.budget_clipped_tokens,
+                "backed_off": int(self._backed_off),
+                "k_by_class": dict(self._k),
+            }

@@ -472,6 +472,18 @@ class ServingMetrics:
             "accepted_tokens": 0,
             "rejected_tokens": 0,
         }
+        # Per-SLO-class split of the same family (pre-seeded zeros for
+        # every class so fls_spec_by_class_<class>_<counter> is always
+        # scrapeable) — the adaptive controller's input signal
+        # (serve/spec.py) must be observable from the outside too.
+        self._spec_class: dict[str, dict[str, int]] = {
+            c: {
+                "drafted_tokens": 0,
+                "accepted_tokens": 0,
+                "rejected_tokens": 0,
+            }
+            for c in SLO_CLASS_NAMES
+        }
         self.registry = MetricsRegistry()
         self._host_cache = None
         self._residency = None
@@ -588,15 +600,30 @@ class ServingMetrics:
             return list(self._token_lat)
 
     def spec_count(
-        self, drafted: int = 0, accepted: int = 0, rejected: int = 0
+        self, drafted: int = 0, accepted: int = 0, rejected: int = 0,
+        slo_class: str | None = None,
     ) -> None:
         """One verify pass's draft economy (serve/engine.py spec path):
         USEFUL drafted slots, accepted, rejected — drafted == accepted +
-        rejected by construction (SpecVerifier.finish_pass)."""
+        rejected by construction (SpecVerifier.finish_pass). With
+        ``slo_class`` the same delta also lands in that class's split
+        (the aggregate family stays the cross-class total either way)."""
         with self._lock:
             self._spec["drafted_tokens"] += drafted
             self._spec["accepted_tokens"] += accepted
             self._spec["rejected_tokens"] += rejected
+            if slo_class is not None:
+                cls = self._spec_class.setdefault(
+                    slo_class,
+                    {
+                        "drafted_tokens": 0,
+                        "accepted_tokens": 0,
+                        "rejected_tokens": 0,
+                    },
+                )
+                cls["drafted_tokens"] += drafted
+                cls["accepted_tokens"] += accepted
+                cls["rejected_tokens"] += rejected
 
     def spec_snapshot(self) -> dict:
         """The ``spec`` registry source: raw counters + the two derived
@@ -615,6 +642,9 @@ class ServingMetrics:
                 "extra_tokens_per_sweep": round(accepted / sweeps, 4)
                 if sweeps
                 else 0.0,
+                "by_class": {
+                    c: dict(v) for c, v in sorted(self._spec_class.items())
+                },
             }
 
     def counter(self, name: str) -> int:

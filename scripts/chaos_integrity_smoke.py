@@ -1317,6 +1317,177 @@ def main() -> int:
         f"restaggers={stagger_stats['restaggers']} "
         f"stagger_error={stagger_stats['stagger_error']}"
     )
+
+    # 13) Resident draft model + SLO-aware adaptive k
+    # (docs/speculative.md): one engine under seeded shard_read faults
+    # with a hard pressure event landing BEFORE the first wave. The
+    # acceptance bar: the backed-off round serves at k=0 (zero drafts),
+    # the ladder release restores the controller, the drafting round
+    # accepts tokens (nonzero fls_spec_accepted_tokens on the scraped
+    # endpoint), BOTH rounds stay token-identical to the k=0 oracle, the
+    # backoff/restore edges land in the journal with their reasons, and
+    # the same adaptive config on a 3-replica fleet survives a seeded
+    # replica_kill token-identically. CI greps the spec_adaptive_chaos_ok
+    # marker below.
+    from flexible_llm_sharding_tpu.runtime.pressure import PressureSnapshot
+    pressure.reset_process_pressure()
+    obs_events.reset_journal()
+    draft_dir = os.path.join(tmp, "draft")
+    save_params(
+        jax.tree.map(np.asarray, llama.init_params(jax.random.PRNGKey(0), tiny)),
+        draft_dir,
+        tiny,
+    )
+    adaptive_cfg = dict(
+        max_wave_requests=2, default_max_new_tokens=spec_gen,
+        speculative_k=2, spec_adaptive=True, spec_k_max=4, spec_window=1,
+        draft_model_path=draft_dir,
+    )
+    engine = ServeEngine(
+        _cfg(
+            model_dir,
+            journal_dir=os.path.join(tmp, "spec_journal"),
+            pressure=PressureConfig(
+                enabled=True, poll_s=30.0, step_down_polls=1,
+            ),
+            faults=FaultConfig(
+                enabled=True, seed=SEED, error_rate=0.2,
+                sites=("shard_read",),
+            ),
+        ),
+        ServeConfig(metrics_port=0, **adaptive_cfg),
+        tokenizer=FakeTokenizer(),
+        start=False,
+    )
+    try:
+        pctrl = engine._pressure
+        reqs = [engine.submit(p, s) for p, s in PROMPTS]
+        # Hard event: the ladder jumps to shed, engaging spec_backoff on
+        # the way — the attached controller stops assigning drafts.
+        pctrl.note_event("host_oom")
+        pctrl.on_sample(PressureSnapshot())
+        if engine._spec_ctrl.stats()["backed_off"] != 1:
+            print("FAIL: hard pressure event did not back speculation off",
+                  file=sys.stderr)
+            return 1
+        engine.start()
+        backed = [r.future.result(timeout=600) for r in reqs]
+        backed_spec = dict(engine.metrics.spec_snapshot())
+        # Pressure lifts: one level per clean poll; spec_backoff is the
+        # LAST lever released.
+        for _ in range(len(pctrl.LADDER)):
+            pctrl.on_sample(PressureSnapshot())
+        if pctrl.level != 0 or engine._spec_ctrl.stats()["backed_off"]:
+            print(f"FAIL: ladder release left speculation backed off "
+                  f"(level={pctrl.level})", file=sys.stderr)
+            return 1
+        reqs = [engine.submit(p, s) for p, s in PROMPTS]
+        drafting = [r.future.result(timeout=600) for r in reqs]
+        sctl = engine._spec_ctrl.stats()
+        port = engine.metrics_server.port
+        exposition = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=30
+        ).read().decode()
+    finally:
+        engine.shutdown(drain=True)
+        pressure.reset_process_pressure()
+    if engine.error is not None:
+        print(f"FAIL: adaptive spec engine error {engine.error!r}",
+              file=sys.stderr)
+        return 1
+    for round_name, results in (("backed-off", backed),
+                                ("drafting", drafting)):
+        for res, want in zip(results, spec_oracle):
+            if not (res.tokens == want.argmax(-1)).all():
+                print(
+                    f"FAIL: adaptive spec {round_name} round diverged "
+                    "under shard_read",
+                    file=sys.stderr,
+                )
+                return 1
+    if backed_spec["drafted_tokens"] != 0:
+        print(
+            f"FAIL: backed-off round still drafted: {backed_spec}",
+            file=sys.stderr,
+        )
+        return 1
+    m = re.search(r"^fls_spec_accepted_tokens (\d+)", exposition, re.M)
+    if not m or int(m.group(1)) < 1:
+        print(
+            "FAIL: exposition reports no nonzero fls_spec_accepted_tokens "
+            "from the resident draft model",
+            file=sys.stderr,
+        )
+        return 1
+    n_draft_accepted = int(m.group(1))
+    if sctl["pressure_backoffs"] != 1 or sctl["pressure_restores"] != 1:
+        print(f"FAIL: controller missed a backoff/restore edge: {sctl}",
+              file=sys.stderr)
+        return 1
+    jevents = obs_events.JOURNAL.tail()
+    n_backoff = sum(
+        1 for e in jevents
+        if e["kind"] == "spec_k_backoff" and e.get("reason") == "pressure"
+    )
+    n_restore = sum(
+        1 for e in jevents
+        if e["kind"] == "spec_k_raise"
+        and e.get("reason") == "pressure_restore"
+    )
+    obs_events.reset_journal()
+    if n_backoff != 1 or n_restore != 1:
+        print(
+            f"FAIL: journal missed the spec pressure edges "
+            f"(backoffs={n_backoff} restores={n_restore})",
+            file=sys.stderr,
+        )
+        return 1
+
+    fleet = _Fleet(
+        _cfg(
+            model_dir,
+            faults=FaultConfig(
+                enabled=True, seed=SEED, error_rate=1.0,
+                sites=("replica_kill",), max_faults=1,
+            ),
+        ),
+        ServeConfig(
+            replicas=3, router_health_poll_s=0.05, **adaptive_cfg,
+        ),
+        tokenizer=FakeTokenizer(),
+    )
+    try:
+        reqs = [fleet.submit(p, s) for p, s in PROMPTS]
+        results = [r.future.result(timeout=600) for r in reqs]
+    finally:
+        fleet.shutdown(drain=True)
+    if fleet.error is not None:
+        print(f"FAIL: adaptive spec fleet error {fleet.error!r}",
+              file=sys.stderr)
+        return 1
+    for res, want in zip(results, spec_oracle):
+        if not (res.tokens == want.argmax(-1)).all():
+            print(
+                "FAIL: adaptive spec fleet output diverged under "
+                "replica_kill",
+                file=sys.stderr,
+            )
+            return 1
+    router = fleet.metrics.snapshot()
+    if router.get("redispatches", 0) < 1:
+        print(
+            f"FAIL: adaptive spec fleet saw no re-dispatch under "
+            f"replica_kill: {router}",
+            file=sys.stderr,
+        )
+        return 1
+    print(
+        f"spec_adaptive_chaos_ok accepted={n_draft_accepted} "
+        f"k_raises={sctl['k_raises']} "
+        f"pressure_backoffs={sctl['pressure_backoffs']} "
+        f"pressure_restores={sctl['pressure_restores']} "
+        f"redispatches={router['redispatches']}"
+    )
     return 0
 
 

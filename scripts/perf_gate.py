@@ -100,6 +100,22 @@ FLOOR_RULES = {
     #   which no runner noise can fake — so this one gates hard, the
     #   pinned_fraction precedent.
     "spec_serve_tokens_per_sweep": 0.95,
+    # Resident draft model + adaptive k (ISSUE 20): tokens-per-sweep
+    # with the REAL draft path live end to end — runtime/draft.py pinned
+    # through its residency tier (the phase refuses to record unless
+    # adaptive per-sweep streamed bytes equal plain's exactly) and the
+    # serve/spec.py controller climbing k on windowed acceptance.
+    # Structural and timing-free (sweep counts + byte counters): the
+    # draft model failing to draft, the controller failing to raise k,
+    # or the verifier disengaging each collapse it toward ~1
+    # token/sweep, which no runner noise can fake — hard gate, the
+    # pinned_fraction precedent.
+    "spec_adaptive_tokens_per_sweep": 0.95,
+    # The controller's acceptance-driven trajectory: largest per-class k
+    # reached under deterministic acceptance 1.0. Integer-exact on a
+    # fixed workload; staying at the starting k means the observe/raise
+    # loop is dead.
+    "spec_adaptive_k_final": 0.95,
     # Paged prefix-KV pool (ISSUE 16): fraction of total prefix prefill
     # work the second same-prefix wave serves from pooled pages, read
     # from the engine's own token counters — structural and timing-free
@@ -215,6 +231,7 @@ def measure() -> dict:
         bench_reference_schedule,
         bench_residency,
         bench_spec,
+        bench_spec_adaptive,
         bench_spec_serve,
         bench_trace_overhead,
         bench_wal_overhead,
@@ -266,6 +283,10 @@ def measure() -> dict:
     # the TPU capture runs (bench.py defaults).
     bench_spec(fw(None), tok, result, budget, n_tok=4, k=4)
     bench_spec_serve(fw(None), tok, result, budget)
+    # Resident draft model + adaptive k (ISSUE 20): small token budget —
+    # the gate needs the control loop and the zero-extra-stream claim
+    # witnessed (both asserted inside the phase), not full depth.
+    bench_spec_adaptive(fw(None), tok, result, budget, n_tok=8, k_max=5)
     # Paged prefix-KV pool (ISSUE 16): small token budget — the gate
     # needs cross-wave reuse witnessed, not a throughput measurement.
     bench_kv_reuse(fw(None), tok, result, budget, n_tok=4)

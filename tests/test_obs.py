@@ -303,6 +303,24 @@ def test_stats_line_layout_regression():
     assert line["pinned_bytes"] == 1024
     assert line["stream_bytes_saved"] == 4096
     assert line["residency"]["pin_hits"] == 2
+    # Speculative block: the aggregate family plus the per-SLO-class
+    # split, all three classes pre-seeded (scrapeable zeros) with the
+    # tagged class carrying the deltas.
+    m.spec_count(drafted=4, accepted=3, rejected=1, slo_class="interactive")
+    line = m.snapshot()
+    spec = line["spec"]
+    assert spec["drafted_tokens"] == 4 and spec["accepted_tokens"] == 3
+    assert set(spec["by_class"]) == {"best_effort", "interactive", "standard"}
+    assert spec["by_class"]["interactive"] == {
+        "drafted_tokens": 4, "accepted_tokens": 3, "rejected_tokens": 1,
+    }
+    assert spec["by_class"]["standard"] == {
+        "drafted_tokens": 0, "accepted_tokens": 0, "rejected_tokens": 0,
+    }
+    # The two-level flatten keeps the split on the Prometheus surface.
+    text = m.registry.prometheus_text()
+    assert "fls_spec_by_class_interactive_accepted_tokens 3" in text
+    assert "fls_spec_by_class_standard_drafted_tokens 0" in text
     # The SAME collection renders the line: no second assembly path.
     assert assemble_serve_stats(m.registry.collect()) == line
 

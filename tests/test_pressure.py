@@ -222,6 +222,23 @@ class _FakeFleet:
         return 2
 
 
+class _FakeSpecCtrl:
+    """Stands in for serve/spec.SpecController at the spec_backoff level."""
+
+    def __init__(self):
+        self.backed_off = False
+        self.backoffs = 0
+        self.restores = 0
+
+    def pressure_backoff(self):
+        self.backed_off = True
+        self.backoffs += 1
+
+    def pressure_restore(self):
+        self.backed_off = False
+        self.restores += 1
+
+
 def _pressured(**kw):
     return PressureSnapshot(tripped=frozenset(kw.get("tripped", {"host"})))
 
@@ -233,49 +250,62 @@ def test_ladder_walks_up_engages_in_order_and_reverses(model_dir):
     ctrl = BrownoutController(cfg)
     q = _FakeQueue()
     fleet = _FakeFleet()
+    spec = _FakeSpecCtrl()
     ctrl.attach_queue(q)
     ctrl.attach_fleet(fleet)
+    ctrl.attach_spec(spec)
 
     # Threshold pressure: one level per poll, gentlest lever first.
     ctrl.on_sample(_pressured())
-    assert ctrl.level == 1
+    assert ctrl.level == 1  # spec backoff: draft spend stops first
+    assert spec.backed_off and spec.backoffs == 1
+    assert cache.budget_bytes == before
+    assert not q.shedding
+    ctrl.on_sample(_pressured())
+    assert ctrl.level == 2
     assert cache.budget_bytes < before  # cache shrunk
     assert not q.shedding
     ctrl.on_sample(_pressured())
-    assert ctrl.level == 2  # adapter evict (no store live: position taken)
+    assert ctrl.level == 3  # adapter evict (no store live: position taken)
     assert not q.shedding
     ctrl.on_sample(_pressured())
-    assert ctrl.level == 3  # kv evict (no pool live: position still taken)
+    assert ctrl.level == 4  # kv evict (no pool live: position still taken)
     assert not q.shedding
     ctrl.on_sample(_pressured())
-    assert ctrl.level == 4  # pin evict (no tier live: position still taken)
+    assert ctrl.level == 5  # pin evict (no tier live: position still taken)
     assert not q.shedding
     ctrl.on_sample(_pressured())
-    assert ctrl.level == 5 and q.shedding
+    assert ctrl.level == 6 and q.shedding
     assert q.retry_after == ctrl.pcfg.shed_retry_after_s
     ctrl.on_sample(_pressured())
-    assert ctrl.level == 6 and fleet.drained == 1
+    assert ctrl.level == 7 and fleet.drained == 1
     # Holding at max: further pressure doesn't overflow the ladder.
     ctrl.on_sample(_pressured())
-    assert ctrl.level == 6
+    assert ctrl.level == 7
 
     # Reversal: step_down_polls clean polls per level, reverse order.
     clean = PressureSnapshot()
     for _ in range(ctrl.pcfg.step_down_polls):
         ctrl.on_sample(clean)
-    assert ctrl.level == 5 and fleet.restored == 1
-    assert q.shedding  # shed still engaged at level 5
+    assert ctrl.level == 6 and fleet.restored == 1
+    assert q.shedding  # shed still engaged at level 6
     for _ in range(ctrl.pcfg.step_down_polls):
         ctrl.on_sample(clean)
-    assert ctrl.level == 4 and not q.shedding
+    assert ctrl.level == 5 and not q.shedding
+    assert spec.backed_off  # spec backoff is the LAST lever released
     for _ in range(4 * ctrl.pcfg.step_down_polls):
         ctrl.on_sample(clean)
+    assert ctrl.level == 1 and spec.backed_off
+    for _ in range(ctrl.pcfg.step_down_polls):
+        ctrl.on_sample(clean)
     assert ctrl.level == 0
+    assert not spec.backed_off and spec.restores == 1
     assert cache.budget_bytes == before  # budget restored
     assert hostcache.pressure_cap() is None
     stats = ctrl.stats()
-    assert stats["steps_up"] == 6 and stats["steps_down"] == 6
+    assert stats["steps_up"] == 7 and stats["steps_down"] == 7
     assert stats["cache_shrinks"] == 1
+    assert stats["spec_backoffs"] == 1 and stats["spec_restores"] == 1
 
 
 def test_hard_event_jumps_straight_to_shed_level(model_dir):
@@ -289,7 +319,7 @@ def test_hard_event_jumps_straight_to_shed_level(model_dir):
     assert q.shedding
     assert ctrl.stats()["host_oom_events"] == 1
     # The jump engaged the skipped levels too (counted as steps).
-    assert ctrl.stats()["steps_up"] == 5
+    assert ctrl.stats()["steps_up"] == 6
 
 
 def test_queue_attached_mid_brownout_sheds_immediately(model_dir):
@@ -300,6 +330,21 @@ def test_queue_attached_mid_brownout_sheds_immediately(model_dir):
     late = _FakeQueue()
     ctrl.attach_queue(late)
     assert late.shedding  # a recycled replica is not a brownout bypass
+
+
+def test_spec_ctrl_attached_mid_brownout_backs_off_immediately(model_dir):
+    """The spec_backoff lever follows the queues' mid-brownout attach
+    rule: a controller registered while the ladder already sits at (or
+    above) the spec level starts backed off, and detach restores it."""
+    cfg = _fw(model_dir, pressure=_pcfg())
+    ctrl = BrownoutController(cfg)
+    ctrl.on_sample(_pressured())
+    assert ctrl.level >= ctrl._level_of("spec_backoff")
+    late = _FakeSpecCtrl()
+    ctrl.attach_spec(late)
+    assert late.backed_off
+    ctrl.detach_spec(late)
+    assert not late.backed_off
 
 
 def test_cache_for_cannot_grow_past_pressure_cap(model_dir):
@@ -685,8 +730,8 @@ def test_fleet_pressure_drain_and_restore(model_dir):
         cfg = _fw(model_dir, pressure=_pcfg(step_down_polls=1))
         ctrl = BrownoutController(cfg)
         ctrl.attach_fleet(fleet)
-        # Walk to the drain level (6 pressured polls).
-        for _ in range(6):
+        # Walk to the drain level (7 pressured polls).
+        for _ in range(7):
             ctrl.on_sample(_pressured())
         deadline = time.monotonic() + 60
         while time.monotonic() < deadline and len(fleet.replicas) > 1:
@@ -694,7 +739,7 @@ def test_fleet_pressure_drain_and_restore(model_dir):
         assert len(fleet.replicas) == 1
         assert ctrl.stats()["replica_drains"] == 2
         # Clean polls all the way down: population restored.
-        for _ in range(6):
+        for _ in range(7):
             ctrl.on_sample(PressureSnapshot())
         deadline = time.monotonic() + 60
         while time.monotonic() < deadline and len(fleet.replicas) < 3:

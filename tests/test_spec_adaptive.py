@@ -1,0 +1,526 @@
+"""Resident draft model + SLO-aware adaptive k (docs/speculative.md).
+
+Contracts under test:
+
+- the resident draft model (runtime/draft.py) pins whole through its own
+  residency tier and drafts at ZERO extra per-sweep streamed bytes —
+  asserted from the executors' own stream counters, never inferred;
+- the adaptive controller (serve/spec.py) lifts tokens-per-sweep on a
+  non-repetitive workload where prompt-lookup drafting scores ~0, raises
+  per-class k on windowed acceptance, and honors the per-pass budget;
+- serving output stays token-identical to ``speculative_k=0`` whatever
+  the draft source or the controller decide — including coalesced waves
+  and a brownout backing k off mid-serve;
+- the brownout ladder's spec_backoff lever drives k to 0 on a hard
+  pressure event and restores it on release, witnessed from the
+  controller's counters and the journal's spec_k_* events;
+- ``SpecVerifier.set_pass_k`` caps per-row draft requests without
+  touching the default path, and ``propose_draft``'s bounded match
+  window is behavior-identical whenever the sequence fits it.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from flexible_llm_sharding_tpu.config import (
+    FrameworkConfig,
+    PressureConfig,
+    SchedConfig,
+    ServeConfig,
+)
+from flexible_llm_sharding_tpu.models import llama
+from flexible_llm_sharding_tpu.obs import events as obs_events
+from flexible_llm_sharding_tpu.runtime import hostcache, pressure, residency
+from flexible_llm_sharding_tpu.runtime import decode as decode_mod
+from flexible_llm_sharding_tpu.runtime.decode import (
+    DecodeGenerator,
+    SpecVerifier,
+    propose_draft,
+)
+from flexible_llm_sharding_tpu.runtime.executor import stream_stats
+from flexible_llm_sharding_tpu.runtime.pressure import PressureSnapshot
+from flexible_llm_sharding_tpu.serve import ServeEngine
+from flexible_llm_sharding_tpu.serve.spec import SpecController
+from flexible_llm_sharding_tpu.utils.checkpoint import save_params
+
+from tests.fake_tokenizer import FakeTokenizer
+
+# Non-repetitive prompts: prompt-lookup's hostile regime (the generated
+# tokens never appear in the prompt, so self-lookup has nothing to match)
+# — exactly where a real draft model has to earn the acceptance.
+PROMPTS = [
+    ("The capital of France", (" is Paris", " is Rome")),
+    ("Two plus two equals", (" four", " five")),
+]
+
+N_GEN = 6
+START_K = 2
+
+
+@pytest.fixture(scope="module")
+def model_dir(tiny_cfg, tmp_path_factory):
+    params = llama.init_params(jax.random.PRNGKey(0), tiny_cfg)
+    d = tmp_path_factory.mktemp("tiny_model_spec_adaptive")
+    save_params(jax.tree.map(np.asarray, params), str(d), tiny_cfg)
+    return str(d)
+
+
+@pytest.fixture(scope="module")
+def draft_dir(tiny_cfg, tmp_path_factory, model_dir):
+    """Draft checkpoint with the SAME parameters as the target: every
+    draft agrees with verification, so acceptance is deterministic 100%
+    — the tests isolate the plumbing from draft quality."""
+    params = llama.init_params(jax.random.PRNGKey(0), tiny_cfg)
+    d = tmp_path_factory.mktemp("tiny_model_spec_draft")
+    save_params(jax.tree.map(np.asarray, params), str(d), tiny_cfg)
+    return str(d)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_process_state():
+    pressure.reset_process_pressure()
+    obs_events.reset_journal()
+    yield
+    pressure.reset_process_pressure()
+    obs_events.reset_journal()
+
+
+def _fw(model_dir, **kw) -> FrameworkConfig:
+    base = dict(
+        model_path=model_dir,
+        layer_num_per_shard=1,
+        storage_location="cpu",
+        dtype="float32",
+        bucket_multiple=8,
+        block_size=2,
+        prefetch_depth=0,
+        num_gen_token=N_GEN,
+    )
+    base.update(kw)
+    return FrameworkConfig(**base)
+
+
+def _adaptive(draft_dir, **kw) -> ServeConfig:
+    base = dict(
+        max_wave_requests=2,
+        default_max_new_tokens=N_GEN,
+        speculative_k=START_K,
+        spec_adaptive=True,
+        spec_k_max=4,
+        spec_window=1,
+        draft_model_path=draft_dir,
+    )
+    base.update(kw)
+    return ServeConfig(**base)
+
+
+def _run(model_dir, serve_cfg, prompts=PROMPTS, fw_kw=None):
+    """Serve ``prompts`` in one admission boundary; returns (results,
+    stats, streamed-bytes delta measured across start..shutdown)."""
+    engine = ServeEngine(
+        _fw(model_dir, **(fw_kw or {})), serve_cfg,
+        tokenizer=FakeTokenizer(), start=False,
+    )
+    base_bytes = stream_stats()["streamed_bytes"]
+    try:
+        reqs = [engine.submit(p, s) for p, s in prompts]
+        engine.start()
+        out = [r.future.result(timeout=300) for r in reqs]
+    finally:
+        engine.shutdown(drain=True)
+    assert engine.error is None
+    delta = stream_stats()["streamed_bytes"] - base_bytes
+    return out, engine.stats(), delta
+
+
+def _assert_same_result(res, want):
+    assert res.updated == want.updated
+    assert (res.tokens == want.tokens).all()
+    np.testing.assert_allclose(res.scores, want.scores, rtol=1e-5, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# Tentpole: resident draft drafts at zero extra per-sweep stream cost
+# and lifts tokens-per-sweep where prompt-lookup cannot
+# ---------------------------------------------------------------------------
+
+def test_draft_model_zero_extra_per_sweep_stream_bytes(model_dir, draft_dir):
+    """The defining claim, from the executors' own counters: with the
+    resident draft model drafting every sweep, per-sweep streamed bytes
+    equal the plain path's exactly — the draft pins load once at engine
+    construction (before the measured window) and never again."""
+    plain, p_stats, p_delta = _run(
+        model_dir, ServeConfig(max_wave_requests=2,
+                               default_max_new_tokens=N_GEN),
+    )
+    per_sweep, rem = divmod(p_delta, p_stats["sweeps"])
+    assert rem == 0 and per_sweep > 0
+    adapt, a_stats, a_delta = _run(model_dir, _adaptive(draft_dir))
+    for a, p in zip(adapt, plain):
+        _assert_same_result(a, p)
+    # Drafting really ran against the pinned weights...
+    assert a_stats["draft"]["draft_tokens"] > 0
+    assert a_stats["draft"]["pinned_layers"] > 0
+    assert a_stats["spec"]["accepted_tokens"] > 0
+    # ...and every sweep still streamed exactly the target model.
+    assert a_delta == per_sweep * a_stats["sweeps"]
+
+
+def test_adaptive_draft_lifts_tokens_per_sweep_on_hostile_workload(
+    model_dir, draft_dir, monkeypatch
+):
+    """On a workload where prompt-lookup drafting scores exactly 0 (the
+    non-repetitive regime, modelled deterministically by drafting a
+    token the greedy chains never emit), lookup serving saves no sweeps
+    while the resident draft model + controller cut sweeps and raise k
+    toward spec_k_max."""
+    plain, p_stats, _ = _run(
+        model_dir, ServeConfig(max_wave_requests=2,
+                               default_max_new_tokens=N_GEN),
+    )
+    # A draft token no request ever emits can never be accepted.
+    used = {int(t) for p in plain for t in p.tokens.ravel()}
+    t_bad = next(t for t in range(256) if t not in used)
+
+    def never_accepted(context_ids, k, ngram=2, corpus=None):
+        return np.full(k, t_bad, np.int64)
+
+    monkeypatch.setattr(decode_mod, "propose_draft", never_accepted)
+    lookup, l_stats, _ = _run(
+        model_dir, ServeConfig(max_wave_requests=2,
+                               default_max_new_tokens=N_GEN,
+                               speculative_k=START_K),
+    )
+    # The draft-model path never touches propose_draft: the monkeypatch
+    # cannot help or hurt it.
+    adapt, a_stats, _ = _run(model_dir, _adaptive(draft_dir))
+    for l, a, p in zip(lookup, adapt, plain):
+        _assert_same_result(l, p)
+        _assert_same_result(a, p)
+    # Prompt lookup on this workload: nothing lands, no sweeps saved.
+    assert l_stats["spec"]["accepted_tokens"] == 0
+    assert l_stats["sweeps"] == p_stats["sweeps"]
+    # The draft model lands: strictly fewer sweeps, k raised on the
+    # windowed acceptance, and the per-class split carries the tokens
+    # (default submissions are standard-class).
+    assert a_stats["sweeps"] < l_stats["sweeps"]
+    assert a_stats["spec"]["accepted_tokens"] > 0
+    ctrl = a_stats["spec_ctrl"]
+    assert ctrl["k_raises"] > 0
+    assert ctrl["k_by_class"]["standard"] > START_K
+    assert ctrl["assigned_tokens"] == a_stats["spec"]["drafted_tokens"]
+    by_class = a_stats["spec"]["by_class"]
+    assert (
+        by_class["standard"]["accepted_tokens"]
+        == a_stats["spec"]["accepted_tokens"]
+    )
+
+
+def test_spec_draft_budget_funds_interactive_first(model_dir, draft_dir):
+    """A per-pass draft budget smaller than the wave's appetite goes to
+    the interactive row; the best-effort row's clipped slots are counted
+    — and output stays token-identical to plain either way."""
+    prompts_kw = [
+        dict(slo_class="interactive", tenant_id="live"),
+        dict(slo_class="best_effort", tenant_id="batch"),
+    ]
+
+    def run(serve_cfg):
+        engine = ServeEngine(
+            _fw(model_dir), serve_cfg, tokenizer=FakeTokenizer(),
+            start=False,
+        )
+        try:
+            reqs = [
+                engine.submit(p, s, **kw)
+                for (p, s), kw in zip(PROMPTS, prompts_kw)
+            ]
+            engine.start()
+            out = [r.future.result(timeout=300) for r in reqs]
+        finally:
+            engine.shutdown(drain=True)
+        assert engine.error is None
+        return out, engine.stats()
+
+    plain, _ = run(
+        ServeConfig(max_wave_requests=2, default_max_new_tokens=N_GEN,
+                    sched=SchedConfig(enabled=True))
+    )
+    # Budget = the starting k: exactly one row per pass can draft fully.
+    adapt, stats = run(
+        _adaptive(draft_dir, spec_draft_budget=START_K,
+                  sched=SchedConfig(enabled=True))
+    )
+    for a, p in zip(adapt, plain):
+        _assert_same_result(a, p)
+    by_class = stats["spec"]["by_class"]
+    assert by_class["interactive"]["drafted_tokens"] > 0
+    assert stats["spec_ctrl"]["budget_clipped_tokens"] > 0
+    assert (
+        by_class["interactive"]["drafted_tokens"]
+        >= by_class["best_effort"]["drafted_tokens"]
+    )
+
+
+def test_spec_adaptive_coalesced_wave_token_identical(model_dir, draft_dir):
+    """Prefix coalescing + adaptive draft-model speculation: same-prefix
+    requests share ONE prefill, draft per-suffix under the controller,
+    and match the per-request offline oracle exactly."""
+    prefix = "repeat repeat repeat repeat repeat"
+    suffix_sets = [(" red blue", " blue red"), (" one two", " two one")]
+    oracle_scores, oracle_updated = DecodeGenerator(
+        _fw(model_dir), tokenizer=FakeTokenizer()
+    )([(prefix, s) for s in suffix_sets])
+    engine = ServeEngine(
+        _fw(model_dir),
+        _adaptive(draft_dir, sched=SchedConfig(enabled=True)),
+        tokenizer=FakeTokenizer(),
+        start=False,
+    )
+    try:
+        reqs = [engine.submit(prefix, s) for s in suffix_sets]
+        engine.start()
+        results = [r.future.result(timeout=300) for r in reqs]
+    finally:
+        engine.shutdown(drain=True)
+    assert engine.error is None
+    for res, w_s, w_u in zip(results, oracle_scores, oracle_updated):
+        assert res.updated == w_u
+        assert (res.tokens == w_s.argmax(-1)).all()
+        np.testing.assert_allclose(res.scores, w_s, rtol=1e-5, atol=1e-6)
+    assert engine.metrics.counter("prefills") == 1
+    assert engine.stats()["spec"]["accepted_tokens"] > 0
+
+
+# ---------------------------------------------------------------------------
+# Brownout: spec_backoff drives k to 0 mid-serve, release restores it
+# ---------------------------------------------------------------------------
+
+def test_pressure_event_backs_off_k_then_restores(
+    model_dir, draft_dir, tmp_path
+):
+    """A hard pressure event lands before the first wave: the engine
+    serves it at k=0 (zero drafts, plain sweep count — the backoff IS
+    the plain path), release restores the adapted k's and the next
+    request drafts again. Counters and journal events witness both
+    edges; every completion stays token-identical to plain serving."""
+    plain, p_stats, _ = _run(
+        model_dir,
+        ServeConfig(max_wave_requests=1, default_max_new_tokens=N_GEN),
+        prompts=PROMPTS[:1],
+    )
+    engine = ServeEngine(
+        _fw(
+            model_dir,
+            journal_dir=str(tmp_path / "journal"),
+            pressure=PressureConfig(
+                enabled=True, poll_s=30.0, step_down_polls=1,
+            ),
+        ),
+        _adaptive(draft_dir, max_wave_requests=1),
+        tokenizer=FakeTokenizer(),
+        start=False,
+    )
+    try:
+        ctrl = engine._pressure
+        assert ctrl is not None
+        first = engine.submit(*PROMPTS[0])
+        # Hard event: the ladder jumps to shed, engaging spec_backoff on
+        # the way — the attached controller stops assigning drafts.
+        ctrl.note_event("host_oom")
+        ctrl.on_sample(PressureSnapshot())
+        assert engine._spec_ctrl.stats()["backed_off"] == 1
+        engine.start()
+        first_res = first.future.result(timeout=300)
+        backed_sweeps = engine.metrics.counter("sweeps")
+        # The all-zero spec block is omitted from the stats line (the
+        # nonzero filter) — read the snapshot directly.
+        backed_spec = engine.metrics.spec_snapshot()
+        # Pressure lifts: step_down_polls=1 walks one level per clean
+        # poll; spec_backoff is the LAST lever released.
+        for _ in range(len(ctrl.LADDER)):
+            ctrl.on_sample(PressureSnapshot())
+        assert ctrl.level == 0
+        assert engine._spec_ctrl.stats()["backed_off"] == 0
+        second = engine.submit(*PROMPTS[0])
+        second_res = second.future.result(timeout=300)
+    finally:
+        engine.shutdown(drain=True)
+    assert engine.error is None
+    _assert_same_result(first_res, plain[0])
+    _assert_same_result(second_res, plain[0])
+    # Backed off, the engine really ran the plain cadence: no drafts,
+    # exactly the plain run's sweep count.
+    assert backed_spec["drafted_tokens"] == 0
+    assert backed_sweeps == p_stats["sweeps"]
+    # Restored, the second request drafted and saved sweeps.
+    stats = engine.stats()
+    assert stats["spec"]["accepted_tokens"] > 0
+    assert (
+        engine.metrics.counter("sweeps") - backed_sweeps
+        < p_stats["sweeps"]
+    )
+    ctrl_stats = stats["spec_ctrl"]
+    assert ctrl_stats["pressure_backoffs"] == 1
+    assert ctrl_stats["pressure_restores"] == 1
+    assert stats["pressure"]["spec_backoffs"] == 1
+    assert stats["pressure"]["spec_restores"] == 1
+    # Both edges journaled with their reasons.
+    events = obs_events.JOURNAL.tail()
+    backoffs = [
+        e for e in events
+        if e["kind"] == "spec_k_backoff" and e["reason"] == "pressure"
+    ]
+    restores = [
+        e for e in events
+        if e["kind"] == "spec_k_raise" and e["reason"] == "pressure_restore"
+    ]
+    assert len(backoffs) == 1 and len(restores) == 1
+
+
+# ---------------------------------------------------------------------------
+# SpecVerifier.set_pass_k (the controller's hook into the shared core)
+# ---------------------------------------------------------------------------
+
+def _mk_verifier(dfn, k=3, budgets=None, vocab=16):
+    budgets = np.array([[6, 6]]) if budgets is None else budgets
+    init_dist = np.zeros((1, 2, vocab), np.float32)
+    init_dist[:, :, 1] = 1.0
+    init_toks = np.array([[1, 1]])
+    ctxs = [[np.array([1, 2, 1]), np.array([3, 4, 1])]]
+    return SpecVerifier(k, dfn, ctxs, budgets, init_dist, init_toks)
+
+
+def test_set_pass_k_caps_per_row_draft_requests():
+    calls = []
+
+    def dfn(ctx, k):
+        calls.append((len(ctx), k))
+        return np.full(k, 2, np.int64)
+
+    v = _mk_verifier(dfn)
+    v.set_pass_k(np.array([[2, 0]]))
+    fed, base = v.begin_pass()
+    # Row 0 drafted exactly 2; row 1 (k=0) requested no drafts at all.
+    assert calls == [(3, 2)]
+    assert fed.shape == (1, 2, 4)  # window stays K+1 wide (one compile)
+    assert fed[0, 0, 1:3].tolist() == [2, 2] and fed[0, 0, 3] == 0
+    assert (fed[0, 1, 1:] == 0).all()
+    dist = np.zeros((1, 2, 4, 16), np.float32)
+    dist[:, :, :, 2] = 1.0  # argmax chain == the drafts: all accepted
+    emitted = v.finish_pass(dist)
+    # Accounting counts only the REQUESTED slots per row.
+    assert v.last_drafted[0].tolist() == [2, 0]
+    assert v.last_accepted[0].tolist() == [2, 0]
+    assert emitted[0].tolist() == [3, 1]
+    assert v.drafted == 2 and v.accepted == 2 and v.rejected == 0
+    # None restores the uniform default: both rows draft the full k.
+    calls.clear()
+    v.set_pass_k(None)
+    v.begin_pass()
+    assert [c[1] for c in calls] == [3, 3]
+
+
+def test_set_pass_k_full_width_identical_to_default():
+    """A uniform karr == spec_k is bit-identical to never calling
+    set_pass_k — the adaptive hook cannot disturb the default path."""
+    def dfn(ctx, k):
+        return (np.arange(k) + 5).astype(np.int64)
+
+    a, b = _mk_verifier(dfn), _mk_verifier(dfn)
+    b.set_pass_k(np.array([[3, 3]]))
+    fed_a, base_a = a.begin_pass()
+    fed_b, base_b = b.begin_pass()
+    assert (fed_a == fed_b).all() and (base_a == base_b).all()
+    dist = np.random.default_rng(0).random((1, 2, 4, 16)).astype(np.float32)
+    em_a, em_b = a.finish_pass(dist), b.finish_pass(dist)
+    assert (em_a == em_b).all()
+    assert a.stats() == b.stats()
+    assert a.g.tolist() == b.g.tolist()
+
+
+# ---------------------------------------------------------------------------
+# propose_draft's bounded match window (satellite)
+# ---------------------------------------------------------------------------
+
+def test_propose_draft_bounded_window_identity_on_short_contexts(
+    monkeypatch,
+):
+    """Behavior-identity pin: any context that fits DRAFT_SCAN_WINDOW
+    drafts exactly what the unbounded scan drafted."""
+    rng = np.random.default_rng(7)
+    cases = [
+        np.array([5, 6, 7, 8, 5, 6, 7, 9, 5, 6]),
+        np.array([1, 2, 3, 1, 2]),
+        np.array([1, 2, 3, 4]),
+        np.array([7]),
+        rng.integers(0, 8, size=decode_mod.DRAFT_SCAN_WINDOW),
+        rng.integers(0, 4, size=300),
+    ]
+    bounded = [propose_draft(ids, 4).tolist() for ids in cases]
+    monkeypatch.setattr(decode_mod, "DRAFT_SCAN_WINDOW", 10**9)
+    unbounded = [propose_draft(ids, 4).tolist() for ids in cases]
+    assert bounded == unbounded
+
+
+def test_propose_draft_window_really_bounds_the_scan(monkeypatch):
+    """A match older than the window is forgone (the draft falls back),
+    while the unbounded scan still finds it — the cap is live."""
+    ids = np.concatenate(
+        [[7, 8, 9], np.full(600, 5, np.int64), [7, 8]]
+    )
+    assert propose_draft(ids, 3).tolist() == [8, 8, 8]  # fallback
+    monkeypatch.setattr(decode_mod, "DRAFT_SCAN_WINDOW", 10**9)
+    assert propose_draft(ids, 3).tolist() == [9, 5, 5]  # old match found
+
+
+# ---------------------------------------------------------------------------
+# Controller unit seams + config/CLI surface
+# ---------------------------------------------------------------------------
+
+def test_spec_controller_window_and_thresholds():
+    ctrl = SpecController(2, 0, 4, window=2, raise_threshold=0.6,
+                          backoff_threshold=0.2)
+    # Two good passes fill the window: k raises once.
+    ctrl.observe("standard", 2, 2)
+    assert ctrl.current_k("standard") == 2  # window not full yet
+    ctrl.observe("standard", 2, 2)
+    assert ctrl.current_k("standard") == 3
+    # Two bad windows walk it back down; k never crosses k_min.
+    for _ in range(4):
+        ctrl.observe("standard", 2, 0)
+    assert ctrl.current_k("standard") == 1
+    # Zero-draft passes carry no evidence: the window doesn't advance.
+    ctrl.observe("interactive", 0, 0)
+    assert ctrl.stats()["k_by_class"]["interactive"] == 2
+    assert ctrl.stats()["k_raises"] == 1
+    assert ctrl.stats()["k_backoffs"] == 2
+
+
+def test_spec_adaptive_config_validation_and_cli():
+    with pytest.raises(ValueError, match="spec_adaptive"):
+        ServeConfig(spec_adaptive=True)  # needs a starting k
+    with pytest.raises(ValueError, match="spec_k_min"):
+        ServeConfig(spec_k_min=5, spec_k_max=2)
+    with pytest.raises(ValueError, match="spec_k_min"):
+        ServeConfig(speculative_k=8, spec_adaptive=True, spec_k_max=4)
+    with pytest.raises(ValueError, match="spec_window"):
+        ServeConfig(spec_window=0)
+    with pytest.raises(ValueError, match="backoff_threshold"):
+        ServeConfig(spec_raise_threshold=0.1, spec_backoff_threshold=0.5)
+    with pytest.raises(ValueError, match="spec_draft_budget"):
+        ServeConfig(spec_draft_budget=-1)
+    from flexible_llm_sharding_tpu.cli import build_serve_parser
+
+    args = build_serve_parser().parse_args([
+        "--model_path", "/x", "--speculative_k", "2", "--spec_adaptive",
+        "--draft_model_path", "/drafts/d1", "--spec_k_max", "6",
+        "--spec_window", "4", "--spec_draft_budget", "8",
+    ])
+    assert args.spec_adaptive and args.draft_model_path == "/drafts/d1"
+    assert args.spec_k_max == 6 and args.spec_window == 4
+    assert args.spec_draft_budget == 8
+    assert args.spec_raise_threshold == 0.6  # defaults thread too
+    assert args.spec_backoff_threshold == 0.2
